@@ -1,0 +1,25 @@
+"""deeplearning4j_tpu — a TPU-native deep-learning framework.
+
+A ground-up re-design of the deeplearning4j capability surface
+(reference: arunwizz/deeplearning4j) for TPU hardware:
+
+- the op-at-a-time JNI interpreter (libnd4j + NativeOpExecutioner /
+  CudaExecutioner) is replaced by whole-step trace-and-compile to XLA
+  via JAX — fit() lowers forward + backward + updater into ONE compiled
+  computation with donated buffers resident in HBM;
+- the layer-config DSL (NeuralNetConfiguration builder →
+  MultiLayerNetwork / ComputationGraph) is kept as a capability but
+  re-expressed as dataclass config trees with JSON round-trip;
+- single-node ParallelWrapper and the Spark/Aeron SharedTrainingMaster
+  are replaced by `jax.sharding.Mesh` data/tensor/pipeline/sequence/
+  expert parallelism with XLA collectives over ICI/DCN;
+- SameDiff's interpreted graph becomes a traced, compiled autodiff
+  graph with named variables and serialization.
+
+See SURVEY.md at the repo root for the full blueprint and the mapping
+from each reference component to its TPU-native equivalent.
+"""
+
+from deeplearning4j_tpu.version import __version__
+
+__all__ = ["__version__"]

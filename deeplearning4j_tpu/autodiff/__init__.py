@@ -1,0 +1,1 @@
+"""Autodiff graph API — the SameDiff role, compiled instead of interpreted."""

@@ -1,0 +1,16 @@
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterator import (
+    AsyncDataSetIterator,
+    DataSetIterator,
+    ExistingDataSetIterator,
+    NumpyDataSetIterator,
+)
+
+__all__ = [
+    "DataSet",
+    "MultiDataSet",
+    "DataSetIterator",
+    "NumpyDataSetIterator",
+    "ExistingDataSetIterator",
+    "AsyncDataSetIterator",
+]

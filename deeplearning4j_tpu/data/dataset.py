@@ -1,0 +1,84 @@
+"""DataSet / MultiDataSet — the `org.nd4j.linalg.dataset.DataSet` role.
+
+A minibatch: features + labels (+ optional masks for variable-length
+sequences, SURVEY.md §5.7).  Stored as numpy on host; transferred to device
+inside the compiled step (or prefetched by AsyncDataSetIterator).
+MultiDataSet generalizes to multi-input/multi-output models
+(ComputationGraph fit path, SURVEY.md §3.2).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class DataSet:
+    features: np.ndarray
+    labels: np.ndarray
+    features_mask: np.ndarray | None = None
+    labels_mask: np.ndarray | None = None
+
+    @property
+    def num_examples(self) -> int:
+        return int(self.features.shape[0])
+
+    def split_batches(self, batch_size: int) -> list["DataSet"]:
+        out = []
+        n = self.num_examples
+        for i in range(0, n, batch_size):
+            sl = slice(i, min(i + batch_size, n))
+            out.append(
+                DataSet(
+                    self.features[sl],
+                    self.labels[sl],
+                    None if self.features_mask is None else self.features_mask[sl],
+                    None if self.labels_mask is None else self.labels_mask[sl],
+                )
+            )
+        return out
+
+    def shuffle(self, rng: np.random.Generator) -> "DataSet":
+        perm = rng.permutation(self.num_examples)
+        return DataSet(
+            self.features[perm],
+            self.labels[perm],
+            None if self.features_mask is None else self.features_mask[perm],
+            None if self.labels_mask is None else self.labels_mask[perm],
+        )
+
+    @staticmethod
+    def merge(batches: list["DataSet"]) -> "DataSet":
+        return DataSet(
+            np.concatenate([b.features for b in batches]),
+            np.concatenate([b.labels for b in batches]),
+            None
+            if batches[0].features_mask is None
+            else np.concatenate([b.features_mask for b in batches]),
+            None
+            if batches[0].labels_mask is None
+            else np.concatenate([b.labels_mask for b in batches]),
+        )
+
+
+@dataclasses.dataclass
+class MultiDataSet:
+    features: tuple[np.ndarray, ...]
+    labels: tuple[np.ndarray, ...]
+    features_masks: tuple[np.ndarray | None, ...] | None = None
+    labels_masks: tuple[np.ndarray | None, ...] | None = None
+
+    @property
+    def num_examples(self) -> int:
+        return int(self.features[0].shape[0])
+
+    @staticmethod
+    def from_dataset(ds: DataSet) -> "MultiDataSet":
+        return MultiDataSet(
+            (ds.features,),
+            (ds.labels,),
+            None if ds.features_mask is None else (ds.features_mask,),
+            None if ds.labels_mask is None else (ds.labels_mask,),
+        )

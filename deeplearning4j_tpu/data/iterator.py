@@ -1,0 +1,160 @@
+"""DataSetIterator SPI + async prefetch.
+
+The reference's `DataSetIterator` contract and `AsyncDataSetIterator`
+(background prefetch thread feeding a bounded queue — the input-pipeline
+overlap mechanism, SURVEY.md §2.2).  TPU-native, the async iterator also
+moves batches to device ahead of time (`jax.device_put`) so the compiled
+step never waits on host→HBM DMA — the double-buffering idiom.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+from collections.abc import Iterable, Iterator
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+
+
+class DataSetIterator:
+    """Iterable over DataSet minibatches; resettable."""
+
+    def reset(self) -> None:
+        raise NotImplementedError
+
+    def __iter__(self) -> Iterator[DataSet]:
+        raise NotImplementedError
+
+    @property
+    def batch_size(self) -> int:
+        raise NotImplementedError
+
+
+class NumpyDataSetIterator(DataSetIterator):
+    """In-memory (features, labels) arrays -> shuffled minibatches."""
+
+    def __init__(
+        self,
+        features: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = False,
+    ):
+        if len(features) == 0:
+            raise ValueError("empty dataset")
+        self._data = DataSet(np.asarray(features), np.asarray(labels))
+        self._batch = int(batch_size)
+        self._shuffle = shuffle
+        self._rng = np.random.default_rng(seed)
+        self._drop_last = drop_last
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch
+
+    def reset(self) -> None:
+        pass  # stateless between epochs; shuffling re-drawn per __iter__
+
+    def __iter__(self) -> Iterator[DataSet]:
+        ds = self._data.shuffle(self._rng) if self._shuffle else self._data
+        batches = ds.split_batches(self._batch)
+        if self._drop_last:
+            kept = [b for b in batches if b.num_examples == self._batch]
+            # never drop EVERYTHING: a dataset smaller than batch_size still
+            # trains on its single short batch
+            batches = kept if kept else batches
+        yield from batches
+
+
+class ExistingDataSetIterator(DataSetIterator):
+    """Wraps any iterable of DataSet (the reference's ExistingDataSetIterator)."""
+
+    def __init__(self, batches: Iterable[DataSet]):
+        self._batches = list(batches)
+
+    @property
+    def batch_size(self) -> int:
+        return self._batches[0].num_examples if self._batches else 0
+
+    def reset(self) -> None:
+        pass
+
+    def __iter__(self) -> Iterator[DataSet]:
+        return iter(self._batches)
+
+
+class AsyncDataSetIterator(DataSetIterator):
+    """Background-thread prefetch with optional device placement.
+
+    Role of the reference's AsyncDataSetIterator (queue of prefetched
+    batches).  With device_put=True, batches are transferred to the default
+    device from the producer thread, overlapping host ETL + DMA with the
+    running step.
+    """
+
+    _END = object()
+
+    def __init__(self, base: DataSetIterator, queue_size: int = 2, device_put: bool = True):
+        self._base = base
+        self._qsize = max(1, queue_size)
+        self._device_put = device_put
+
+    @property
+    def batch_size(self) -> int:
+        return self._base.batch_size
+
+    def reset(self) -> None:
+        self._base.reset()
+
+    def __iter__(self) -> Iterator[DataSet]:
+        q: queue.Queue = queue.Queue(maxsize=self._qsize)
+        err: list[BaseException] = []
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that gives up when the consumer abandoned the
+            # iterator — otherwise the thread (and its pinned device
+            # buffers) would leak on early exit from the for-loop
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            try:
+                for batch in self._base:
+                    if self._device_put:
+                        batch = DataSet(
+                            jax.device_put(batch.features),
+                            jax.device_put(batch.labels),
+                            None if batch.features_mask is None else jax.device_put(batch.features_mask),
+                            None if batch.labels_mask is None else jax.device_put(batch.labels_mask),
+                        )
+                    if not put(batch):
+                        return
+            except BaseException as e:  # surfaced on the consumer side
+                err.append(e)
+            finally:
+                put(self._END)
+
+        t = threading.Thread(target=produce, daemon=True)
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if item is self._END:
+                    break
+                yield item
+        finally:
+            stop.set()
+            t.join(timeout=5.0)
+        if err:
+            raise err[0]

@@ -1,0 +1,4 @@
+from deeplearning4j_tpu.models.model import Model
+from deeplearning4j_tpu.models.sequential import SequentialModel
+
+__all__ = ["Model", "SequentialModel"]

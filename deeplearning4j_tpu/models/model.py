@@ -1,0 +1,59 @@
+"""Base Model API — the `org.deeplearning4j.nn.api.Model` role.
+
+Common surface shared by SequentialModel (MultiLayerNetwork role) and
+GraphModel (ComputationGraph role): init, fit, output, score, params
+accounting, listener dispatch, save/load.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import numpy as np
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+from deeplearning4j_tpu.utils.pytree import param_count, tree_flatten_with_paths
+
+
+class Model:
+    def __init__(self):
+        self.params: Any = None        # pytree {layer_name: {param_name: array}}
+        self.net_state: Any = None     # pytree of non-trainable state (BN stats...)
+        self.opt_state: Any = None     # optax state (updaterState.bin role)
+        self.iteration: int = 0
+        self.epoch: int = 0
+        self.listeners: list[TrainingListener] = []
+        self.last_batch_size: int = 0
+        self._last_score = None
+
+    # -- listeners ---------------------------------------------------------
+    def set_listeners(self, *listeners: TrainingListener) -> None:
+        self.listeners = list(listeners)
+
+    def add_listener(self, listener: TrainingListener) -> None:
+        self.listeners.append(listener)
+
+    def _dispatch_iteration(self, score) -> None:
+        for lst in self.listeners:
+            lst.iteration_done(self, self.iteration, self.epoch, score)
+
+    # -- params ------------------------------------------------------------
+    def num_params(self) -> int:
+        if self.params is None:
+            raise RuntimeError("model not initialized; call init()")
+        return param_count(self.params)
+
+    def param_table(self) -> dict[str, np.ndarray]:
+        """Flattened name->array view (the reference's paramTable())."""
+        return {k: np.asarray(v) for k, v in tree_flatten_with_paths(self.params)}
+
+    @property
+    def score_value(self) -> float:
+        """Last training loss (reference `Model.score()`); device-syncs."""
+        return float(self._last_score) if self._last_score is not None else float("nan")
+
+    # -- persistence (implemented in train.checkpoint) ---------------------
+    def save(self, path: str, save_updater: bool = True) -> None:
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+        ModelSerializer.write_model(self, path, save_updater)

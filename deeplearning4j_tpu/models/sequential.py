@@ -1,0 +1,332 @@
+"""SequentialModel — the MultiLayerNetwork role, compiled whole-step.
+
+The reference's MultiLayerNetwork.fit() interprets the layer stack op-by-op
+across JNI per minibatch (SURVEY.md §3.1: feedForwardToLayer →
+calcBackpropGradients → updater, one native call per op).  Here the ENTIRE
+training iteration — forward, loss (+regularization), backward, gradient
+clipping, updater, BN-stat update — is ONE jit-compiled XLA computation
+with donated param/opt-state buffers: zero host round-trips inside a step,
+everything resident in HBM, elementwise work fused into the matmuls.
+
+This is the north-star differentiator named in BASELINE.json.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import DataSetIterator, NumpyDataSetIterator
+from deeplearning4j_tpu.models.model import Model
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import SequentialConfiguration
+from deeplearning4j_tpu.nn.losses import (
+    FUSED_ACTIVATION_LOSSES,
+    Loss,
+    compute as compute_loss,
+)
+from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
+from deeplearning4j_tpu.nn.updaters import with_gradient_clipping
+import optax
+from deeplearning4j_tpu.runtime.backend import backend
+from deeplearning4j_tpu.runtime.rng import SeedStream
+
+
+def _as_iterator(data, batch_size: int | None) -> DataSetIterator:
+    if isinstance(data, DataSetIterator):
+        return data
+    if isinstance(data, DataSet):
+        from deeplearning4j_tpu.data.iterator import ExistingDataSetIterator
+
+        if batch_size:
+            return ExistingDataSetIterator(data.split_batches(batch_size))
+        return ExistingDataSetIterator([data])
+    if isinstance(data, tuple) and len(data) == 2:
+        return NumpyDataSetIterator(data[0], data[1], batch_size or 32)
+    raise TypeError(f"cannot interpret {type(data)} as training data")
+
+
+class SequentialModel(Model):
+    """Sequential layer stack with whole-step-compiled fit()."""
+
+    def __init__(self, conf: SequentialConfiguration):
+        super().__init__()
+        self.conf = conf
+        self._itypes = conf.layer_input_types()
+        self._flatten_before = self._compute_flatten_flags()
+        self._loss, self._out_activation, self._fused_loss = self._resolve_output()
+        self._bf16 = (
+            conf.bf16_compute if conf.bf16_compute is not None else backend().is_tpu
+        )
+        self._tx = with_gradient_clipping(
+            conf.updater.to_optax(conf.steps_per_epoch),
+            conf.gradient_clip_value,
+            conf.gradient_clip_norm,
+        )
+        self._tx = self._mask_frozen(self._tx)
+        self._stream = SeedStream(conf.seed)
+        self._step_fns: dict[Any, Any] = {}
+        self._infer_fn = None
+
+    # -- construction ------------------------------------------------------
+    def _compute_flatten_flags(self) -> list[bool]:
+        flags = []
+        cur = self.conf.input_type
+        for layer in self.conf.layers:
+            flat = layer.EXPECTS == "ff" and cur.kind in (
+                InputType.KIND_CNN,
+                InputType.KIND_CNN3D,
+            )
+            flags.append(flat)
+            if flat:
+                cur = InputType.feed_forward(cur.flat_size)
+            cur = layer.output_type(cur)
+        return flags
+
+    def _resolve_output(self) -> tuple[Loss, Activation, bool]:
+        """Returns (loss, output_activation, fused).
+
+        fused=True: training computes the loss directly on logits (stable
+        fused softmax/sigmoid path) because the declared activation IS the
+        loss's canonical activation.  fused=False: the declared activation
+        is applied before the loss, so training and output() see the same
+        function (non-fused losses, or a non-canonical activation).
+        """
+        last = self.conf.layers[-1]
+        if isinstance(last, (OutputLayer, LossLayer)):
+            loss = last.loss
+        else:
+            raise ValueError(
+                "last layer must be an OutputLayer or LossLayer declaring the loss"
+            )
+        canonical = {
+            Loss.MCXENT: Activation.SOFTMAX,
+            Loss.NEGATIVELOGLIKELIHOOD: Activation.SOFTMAX,
+            Loss.SPARSE_MCXENT: Activation.SOFTMAX,
+            Loss.XENT: Activation.SIGMOID,
+        }.get(loss, Activation.IDENTITY)
+        act = last.activation if last.activation is not None else canonical
+        fused = loss in FUSED_ACTIVATION_LOSSES and act == canonical
+        return loss, act, fused
+
+    def _mask_frozen(self, tx):
+        """Route frozen layers around the ENTIRE transformation (a frozen
+        layer must not even be touched by decoupled weight decay)."""
+        frozen_names = {l.name for l in self.conf.layers if l.frozen}
+        if not frozen_names:
+            return tx
+
+        def trainable_mask(params):
+            return {
+                name: jax.tree.map(lambda _: name not in frozen_names, sub)
+                for name, sub in params.items()
+            }
+
+        def frozen_mask(params):
+            return {
+                name: jax.tree.map(lambda _: name in frozen_names, sub)
+                for name, sub in params.items()
+            }
+
+        return optax.chain(
+            optax.masked(tx, trainable_mask),
+            optax.masked(optax.set_to_zero(), frozen_mask),
+        )
+
+    def init(self) -> "SequentialModel":
+        params, state = {}, {}
+        for layer, itype in zip(self.conf.layers, self._itypes):
+            p, s = layer.init(self._stream.key(f"init/{layer.name}"), itype)
+            if p:
+                params[layer.name] = p
+            if s:
+                state[layer.name] = s
+        self.params = params
+        self.net_state = state
+        self.opt_state = self._tx.init(params)
+        return self
+
+    # -- pure forward (traced) --------------------------------------------
+    def _forward(self, params, net_state, x, *, training: bool, rng):
+        if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(jnp.bfloat16)
+        new_state = {}
+        for i, layer in enumerate(self.conf.layers):
+            if self._flatten_before[i]:
+                x = x.reshape(x.shape[0], -1)
+            lp = params.get(layer.name, {})
+            ls = net_state.get(layer.name, {})
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            x, ns = layer.apply(lp, ls, x, training=training, rng=lrng)
+            if ns:
+                new_state[layer.name] = ns
+        return x, new_state
+
+    def _reg_loss(self, params):
+        reg = jnp.zeros((), jnp.float32)
+        for layer in self.conf.layers:
+            lp = params.get(layer.name)
+            if not lp:
+                continue
+            l1 = layer.l1 or 0.0
+            l2 = layer.l2 or 0.0
+            if l1 == 0.0 and l2 == 0.0:
+                continue
+            for pname in layer.REGULARIZED:
+                if pname in lp:
+                    w = lp[pname].astype(jnp.float32)
+                    if l1:
+                        reg = reg + l1 * jnp.sum(jnp.abs(w))
+                    if l2:
+                        reg = reg + 0.5 * l2 * jnp.sum(w * w)
+        return reg
+
+    # -- compiled train step ----------------------------------------------
+    def _get_step_fn(self, has_lmask: bool):
+        key = ("train", has_lmask)
+        if key not in self._step_fns:
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def step(params, opt_state, net_state, step_i, features, labels, lmask):
+                rng = SeedStream.fold(self._stream.root, step_i)
+
+                def loss_fn(p):
+                    out, new_state = self._forward(
+                        p, net_state, features, training=True, rng=rng
+                    )
+                    if not self._fused_loss:
+                        out = self._out_activation(out.astype(jnp.float32))
+                    data_loss = compute_loss(
+                        self._loss,
+                        out,
+                        labels,
+                        lmask if has_lmask else None,
+                        from_logits=self._fused_loss,
+                    )
+                    return data_loss + self._reg_loss(p), new_state
+
+                (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params
+                )
+                updates, opt_state = self._tx.update(grads, opt_state, params)
+                params = jax.tree.map(
+                    lambda p, u: (p + u.astype(p.dtype)), params, updates
+                )
+                # carry unchanged state subtrees forward
+                merged_state = {**net_state, **new_state}
+                return params, opt_state, merged_state, loss
+
+            self._step_fns[key] = step
+        return self._step_fns[key]
+
+    def fit(self, data, epochs: int = 1, batch_size: int | None = None) -> None:
+        if self.params is None:
+            self.init()
+        iterator = _as_iterator(data, batch_size)
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch)
+            for batch in iterator:
+                self.fit_batch(batch)
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+            iterator.reset()
+
+    def fit_batch(self, batch: DataSet) -> None:
+        if self.params is None:
+            self.init()
+        has_lmask = batch.labels_mask is not None
+        step = self._get_step_fn(has_lmask)
+        lmask = batch.labels_mask if has_lmask else np.zeros((0,), np.float32)
+        self.params, self.opt_state, self.net_state, loss = step(
+            self.params,
+            self.opt_state,
+            self.net_state,
+            jnp.uint32(self.iteration),
+            batch.features,
+            batch.labels,
+            lmask,
+        )
+        self._last_score = loss
+        self.last_batch_size = batch.num_examples
+        self.iteration += 1
+        self._dispatch_iteration(loss)
+
+    # -- inference ---------------------------------------------------------
+    def _get_infer_fn(self):
+        if self._infer_fn is None:
+
+            @jax.jit
+            def infer(params, net_state, features):
+                out, _ = self._forward(params, net_state, features, training=False, rng=None)
+                return self._out_activation(out.astype(jnp.float32))
+
+            self._infer_fn = infer
+        return self._infer_fn
+
+    def output(self, features) -> jax.Array:
+        """Forward pass with the output activation applied (reference
+        `MultiLayerNetwork.output()`)."""
+        if self.params is None:
+            self.init()
+        return self._get_infer_fn()(self.params, self.net_state, features)
+
+    def predict(self, features) -> np.ndarray:
+        """Argmax class predictions (reference `predict()`)."""
+        return np.asarray(jnp.argmax(self.output(features), axis=-1))
+
+    def feed_forward(self, features) -> list[jax.Array]:
+        """Per-layer activations (reference `feedForward()`); not jitted —
+        debugging/inspection path."""
+        acts = []
+        x = jnp.asarray(features)
+        if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(jnp.bfloat16)
+        for i, layer in enumerate(self.conf.layers):
+            if self._flatten_before[i]:
+                x = x.reshape(x.shape[0], -1)
+            lp = self.params.get(layer.name, {})
+            ls = self.net_state.get(layer.name, {})
+            x, _ = layer.apply(lp, ls, x, training=False, rng=None)
+            acts.append(x)
+        return acts
+
+    def score(self, ds: DataSet) -> float:
+        """Loss (incl. regularization) on a dataset without updating."""
+        out, _ = self._forward(
+            self.params, self.net_state, jnp.asarray(ds.features), training=False, rng=None
+        )
+        if not self._fused_loss:
+            out = self._out_activation(out.astype(jnp.float32))
+        loss = compute_loss(
+            self._loss, out, jnp.asarray(ds.labels), ds.labels_mask,
+            from_logits=self._fused_loss,
+        )
+        return float(loss + self._reg_loss(self.params))
+
+    def evaluate(self, data, batch_size: int | None = None):
+        from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+
+        iterator = _as_iterator(data, batch_size)
+        ev = Evaluation()
+        for batch in iterator:
+            probs = np.asarray(self.output(batch.features))
+            ev.eval(batch.labels, probs, mask=batch.labels_mask)
+        return ev
+
+    # -- serialization helpers --------------------------------------------
+    def clone(self) -> "SequentialModel":
+        m = SequentialModel(self.conf)
+        if self.params is not None:
+            m.params = jax.tree.map(jnp.copy, self.params)
+            m.net_state = jax.tree.map(jnp.copy, self.net_state)
+            m.opt_state = jax.tree.map(jnp.copy, self.opt_state)
+        return m

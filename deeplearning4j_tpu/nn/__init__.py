@@ -1,0 +1,42 @@
+"""Neural-network framework: config DSL, layers, models, updaters, losses.
+
+The DL4J-proper role (SURVEY.md §1 L4): `NeuralNetConfiguration`-style
+builder DSL producing JSON-serializable config trees; layer implementations;
+SequentialModel (MultiLayerNetwork role) and ComputationGraph models whose
+fit() compiles the whole step to one XLA computation.
+"""
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.updaters import (
+    AdaDelta,
+    AdaGrad,
+    AdaMax,
+    Adam,
+    AmsGrad,
+    Nadam,
+    Nesterovs,
+    NoOp,
+    RmsProp,
+    Sgd,
+    Updater,
+)
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+__all__ = [
+    "Activation",
+    "Loss",
+    "WeightInit",
+    "Updater",
+    "Adam",
+    "AdamW",
+    "Sgd",
+    "Nesterovs",
+    "RmsProp",
+    "AdaGrad",
+    "AdaDelta",
+    "AdaMax",
+    "Nadam",
+    "AmsGrad",
+    "NoOp",
+]

@@ -1,0 +1,77 @@
+"""Activation functions — the `org.nd4j.linalg.activations.Activation` enum role.
+
+The reference enumerates activations as op classes dispatched per-call
+through the executioner; here each is a pure jnp function fused by XLA into
+the surrounding computation (elementwise ops ride along with the matmul's
+HBM traffic for free — SURVEY.md §2.1 TPU mapping note).
+"""
+
+from __future__ import annotations
+
+import enum
+from collections.abc import Callable
+
+import jax
+import jax.numpy as jnp
+
+
+class Activation(str, enum.Enum):
+    IDENTITY = "identity"
+    RELU = "relu"
+    RELU6 = "relu6"
+    LEAKYRELU = "leakyrelu"
+    ELU = "elu"
+    SELU = "selu"
+    GELU = "gelu"
+    SILU = "silu"            # a.k.a. swish
+    SIGMOID = "sigmoid"
+    HARDSIGMOID = "hardsigmoid"
+    TANH = "tanh"
+    HARDTANH = "hardtanh"
+    SOFTMAX = "softmax"
+    LOGSOFTMAX = "logsoftmax"
+    SOFTPLUS = "softplus"
+    SOFTSIGN = "softsign"
+    CUBE = "cube"
+    RATIONALTANH = "rationaltanh"
+    RECTIFIEDTANH = "rectifiedtanh"
+    THRESHOLDEDRELU = "thresholdedrelu"
+    MISH = "mish"
+
+    def fn(self) -> Callable[[jax.Array], jax.Array]:
+        return _TABLE[self]
+
+    def __call__(self, x: jax.Array) -> jax.Array:
+        return _TABLE[self](x)
+
+
+def _rational_tanh(x):
+    # DL4J's rationaltanh: 1.7159 * tanh-approx via rational polynomial.
+    a = jnp.abs(x)
+    approx = jnp.clip(x * (1.0 + a / 2 + a * a / 16), -1.0, 1.0)
+    return 1.7159 * approx
+
+
+_TABLE: dict[Activation, Callable] = {
+    Activation.IDENTITY: lambda x: x,
+    Activation.RELU: jax.nn.relu,
+    Activation.RELU6: jax.nn.relu6,
+    Activation.LEAKYRELU: lambda x: jax.nn.leaky_relu(x, 0.01),
+    Activation.ELU: jax.nn.elu,
+    Activation.SELU: jax.nn.selu,
+    Activation.GELU: jax.nn.gelu,
+    Activation.SILU: jax.nn.silu,
+    Activation.SIGMOID: jax.nn.sigmoid,
+    Activation.HARDSIGMOID: jax.nn.hard_sigmoid,
+    Activation.TANH: jnp.tanh,
+    Activation.HARDTANH: lambda x: jnp.clip(x, -1.0, 1.0),
+    Activation.SOFTMAX: lambda x: jax.nn.softmax(x, axis=-1),
+    Activation.LOGSOFTMAX: lambda x: jax.nn.log_softmax(x, axis=-1),
+    Activation.SOFTPLUS: jax.nn.softplus,
+    Activation.SOFTSIGN: jax.nn.soft_sign,
+    Activation.CUBE: lambda x: x * x * x,
+    Activation.RATIONALTANH: _rational_tanh,
+    Activation.RECTIFIEDTANH: lambda x: jnp.maximum(jnp.tanh(x), 0.0),
+    Activation.THRESHOLDEDRELU: lambda x: jnp.where(x > 1.0, x, 0.0),
+    Activation.MISH: lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+}

@@ -1,0 +1,43 @@
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    GlobalPooling,
+    LayerConfig,
+    LayerNorm,
+    LocalResponseNormalization,
+    OutputLayer,
+    PoolingType,
+    Subsampling,
+    Upsampling2D,
+    ZeroPadding2D,
+)
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import (
+    NeuralNetConfiguration,
+    SequentialConfiguration,
+)
+
+__all__ = [
+    "InputType",
+    "LayerConfig",
+    "Dense",
+    "Conv2D",
+    "Subsampling",
+    "PoolingType",
+    "BatchNorm",
+    "LayerNorm",
+    "LocalResponseNormalization",
+    "Dropout",
+    "Embedding",
+    "GlobalPooling",
+    "ActivationLayer",
+    "OutputLayer",
+    "Upsampling2D",
+    "ZeroPadding2D",
+    "NeuralNetConfiguration",
+    "SequentialConfiguration",
+]

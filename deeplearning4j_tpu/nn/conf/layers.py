@@ -1,0 +1,612 @@
+"""Layer configuration dataclasses + their pure forward implementations.
+
+The reference splits layer *config* (org.deeplearning4j.nn.conf.layers.*)
+from layer *runtime* (org.deeplearning4j.nn.layers.*) because runtime
+layers hold mutable INDArray state.  TPU-native there is no mutable layer
+object: each config owns three pure functions —
+
+    output_type(input_type)          static shape inference
+    init(key, input_type)            -> (params pytree, state pytree)
+    apply(params, state, x, ...)     -> (y, new_state)
+
+`apply` is traced into the model's single compiled train/inference step, so
+"layers" cost nothing at runtime; XLA fuses across them.  There is no
+backpropGradient anywhere — jax.grad differentiates the whole step
+(replacing the reference's per-layer hand-written backward passes).
+
+Layout: NHWC / seq-major (B, T, F) — see input_type.py for why.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.utils import serde
+
+
+class PoolingType(str, enum.Enum):
+    MAX = "max"
+    AVG = "avg"
+    SUM = "sum"
+    PNORM = "pnorm"
+
+
+def _pair(v) -> tuple[int, int]:
+    if isinstance(v, (tuple, list)):
+        return (int(v[0]), int(v[1]))
+    return (int(v), int(v))
+
+
+def _dropout(x, rate: float, training: bool, rng):
+    """Inverted dropout on the layer input (reference semantics: dropOut
+    applies to a layer's input activations)."""
+    if not training or rate <= 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0.0).astype(x.dtype)
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerConfig:
+    """Base layer config.
+
+    Fields that default to None are filled from the model-level
+    NeuralNetConfiguration defaults at build time (the reference's
+    global-config-with-layer-override pattern).
+    """
+
+    name: Optional[str] = None
+    activation: Optional[Activation] = None
+    weight_init: Optional[WeightInit] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
+    dropout_rate: Optional[float] = None   # probability of dropping (NOT retain prob)
+    frozen: bool = False                   # FrozenLayer role: excluded from updates
+
+    # Which input kind apply() expects; the model auto-inserts reshapes
+    # (the reference's InputPreProcessor role) when kinds mismatch.
+    EXPECTS = "any"
+    HAS_PARAMS = True
+
+    def output_type(self, itype: InputType) -> InputType:
+        return itype
+
+    def init(self, key: jax.Array, itype: InputType) -> tuple[dict, dict]:
+        return {}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        raise NotImplementedError
+
+    # regularization hook: which param names are penalized by l1/l2
+    REGULARIZED = ("W",)
+
+    def _act(self, default=Activation.IDENTITY) -> Activation:
+        return self.activation if self.activation is not None else default
+
+    def _winit(self, default=WeightInit.XAVIER) -> WeightInit:
+        return self.weight_init if self.weight_init is not None else default
+
+
+# ---------------------------------------------------------------------------
+# Feed-forward layers
+# ---------------------------------------------------------------------------
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class Dense(LayerConfig):
+    """Fully connected layer (DenseLayer role). nIn is inferred."""
+
+    n_out: int = 0
+    has_bias: bool = True
+
+    EXPECTS = "ff"
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, itype):
+        n_in = itype.size
+        w = self._winit().init(key, (n_in, self.n_out), fan_in=n_in, fan_out=self.n_out)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        w = params["W"].astype(x.dtype)
+        y = x @ w
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self._act()(y), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class OutputLayer(Dense):
+    """Dense + declared loss (the reference's OutputLayer).  apply() returns
+    PRE-activation logits; the model fuses activation into the loss for
+    training and applies it for output()/predict."""
+
+    loss: Loss = Loss.MCXENT
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        w = params["W"].astype(x.dtype)
+        y = x @ w
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return y, state   # logits; activation fused into loss / applied at output()
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class LossLayer(LayerConfig):
+    """Parameterless output: attaches a loss to whatever precedes it."""
+
+    loss: Loss = Loss.MCXENT
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return x, state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class ActivationLayer(LayerConfig):
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return self._act()(x), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class Dropout(LayerConfig):
+    """Standalone dropout layer (DropoutLayer role)."""
+
+    rate: float = 0.5
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return _dropout(x, self.rate, training, rng), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class Embedding(LayerConfig):
+    """EmbeddingLayer/EmbeddingSequenceLayer role: int ids -> vectors.
+
+    Accepts (B,) -> (B, n_out) [ff] or (B, T) -> (B, T, n_out) [rnn].
+    """
+
+    n_in: int = 0
+    n_out: int = 0
+    EXPECTS = "any"
+    REGULARIZED = ("W",)
+
+    def output_type(self, itype: InputType) -> InputType:
+        if itype.kind == InputType.KIND_RNN:
+            return InputType.recurrent(self.n_out, itype.shape[0])
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, itype):
+        n_in = self.n_in
+        if n_in <= 0:
+            raise ValueError("Embedding.n_in (vocab size) must be set explicitly")
+        w = self._winit().init(key, (n_in, self.n_out), fan_in=n_in, fan_out=self.n_out)
+        return {"W": w}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        ids = x.astype(jnp.int32)
+        y = jnp.take(params["W"], ids, axis=0)
+        return self._act()(y), state
+
+
+# ---------------------------------------------------------------------------
+# Convolutional layers (NHWC)
+# ---------------------------------------------------------------------------
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class Conv2D(LayerConfig):
+    """2D convolution (ConvolutionLayer role).
+
+    The reference lowers conv to im2col+gemm in libnd4j or cuDNN
+    (SURVEY.md §3.1); here it is one lax.conv_general_dilated that XLA maps
+    directly onto the MXU.  Kernel layout HWIO, feature-map layout NHWC.
+    """
+
+    n_out: int = 0
+    kernel: tuple[int, int] = (3, 3)
+    stride: tuple[int, int] = (1, 1)
+    padding: str = "valid"             # "same" | "valid"
+    dilation: tuple[int, int] = (1, 1)
+    groups: int = 1                    # n_in groups => depthwise
+    has_bias: bool = True
+
+    EXPECTS = "cnn"
+
+    def _out_hw(self, h: int, w: int) -> tuple[int, int]:
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        dh, dw = _pair(self.dilation)
+        ekh, ekw = (kh - 1) * dh + 1, (kw - 1) * dw + 1
+        if self.padding == "same":
+            return -(-h // sh), -(-w // sw)
+        return (h - ekh) // sh + 1, (w - ekw) // sw + 1
+
+    def output_type(self, itype: InputType) -> InputType:
+        h, w, _ = itype.shape
+        oh, ow = self._out_hw(h, w)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init(self, key, itype):
+        c_in = itype.channels
+        kh, kw = _pair(self.kernel)
+        if c_in % self.groups:
+            raise ValueError(f"channels {c_in} not divisible by groups {self.groups}")
+        shape = (kh, kw, c_in // self.groups, self.n_out)
+        fan_in = kh * kw * (c_in // self.groups)
+        fan_out = kh * kw * self.n_out // self.groups
+        w = self._winit(WeightInit.RELU).init(key, shape, fan_in=fan_in, fan_out=fan_out)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        w = params["W"].astype(x.dtype)
+        y = lax.conv_general_dilated(
+            x,
+            w,
+            window_strides=_pair(self.stride),
+            padding=self.padding.upper(),
+            rhs_dilation=_pair(self.dilation),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=self.groups,
+        ).astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self._act(Activation.IDENTITY)(y), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class SeparableConv2D(LayerConfig):
+    """Depthwise + pointwise conv (SeparableConvolution2D role)."""
+
+    n_out: int = 0
+    kernel: tuple[int, int] = (3, 3)
+    stride: tuple[int, int] = (1, 1)
+    padding: str = "valid"
+    depth_multiplier: int = 1
+    has_bias: bool = True
+
+    EXPECTS = "cnn"
+
+    def output_type(self, itype: InputType) -> InputType:
+        h, w, _ = itype.shape
+        dummy = Conv2D(n_out=self.n_out, kernel=self.kernel, stride=self.stride, padding=self.padding)
+        oh, ow = dummy._out_hw(h, w)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init(self, key, itype):
+        c_in = itype.channels
+        kh, kw = _pair(self.kernel)
+        k1, k2 = jax.random.split(key)
+        wi = self._winit(WeightInit.RELU)
+        depth = wi.init(k1, (kh, kw, 1, c_in * self.depth_multiplier), fan_in=kh * kw, fan_out=self.depth_multiplier)
+        point = wi.init(
+            k2,
+            (1, 1, c_in * self.depth_multiplier, self.n_out),
+            fan_in=c_in * self.depth_multiplier,
+            fan_out=self.n_out,
+        )
+        params = {"depthW": depth, "pointW": point}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), jnp.float32)
+        return params, {}
+
+    REGULARIZED = ("depthW", "pointW")
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        c_in = x.shape[-1]
+        y = lax.conv_general_dilated(
+            x,
+            params["depthW"].astype(x.dtype),
+            window_strides=_pair(self.stride),
+            padding=self.padding.upper(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+            feature_group_count=c_in,
+        ).astype(x.dtype)
+        y = lax.conv_general_dilated(
+            y,
+            params["pointW"].astype(x.dtype),
+            window_strides=(1, 1),
+            padding="VALID",
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ).astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self._act()(y), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class Deconv2D(LayerConfig):
+    """Transposed convolution (Deconvolution2D role)."""
+
+    n_out: int = 0
+    kernel: tuple[int, int] = (2, 2)
+    stride: tuple[int, int] = (2, 2)
+    padding: str = "valid"
+    has_bias: bool = True
+
+    EXPECTS = "cnn"
+
+    def output_type(self, itype: InputType) -> InputType:
+        h, w, _ = itype.shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        if self.padding == "same":
+            oh, ow = h * sh, w * sw
+        else:
+            oh, ow = (h - 1) * sh + kh, (w - 1) * sw + kw
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init(self, key, itype):
+        c_in = itype.channels
+        kh, kw = _pair(self.kernel)
+        w = self._winit(WeightInit.RELU).init(
+            key, (kh, kw, c_in, self.n_out), fan_in=kh * kw * c_in, fan_out=kh * kw * self.n_out
+        )
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        y = lax.conv_transpose(
+            x,
+            params["W"].astype(x.dtype),
+            strides=_pair(self.stride),
+            padding=self.padding.upper(),
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        ).astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return self._act()(y), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class Subsampling(LayerConfig):
+    """Pooling layer (SubsamplingLayer role)."""
+
+    pooling: PoolingType = PoolingType.MAX
+    kernel: tuple[int, int] = (2, 2)
+    stride: tuple[int, int] = (2, 2)
+    padding: str = "valid"
+    pnorm: int = 2
+
+    EXPECTS = "cnn"
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def output_type(self, itype: InputType) -> InputType:
+        h, w, c = itype.shape
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        if self.padding == "same":
+            oh, ow = -(-h // sh), -(-w // sw)
+        else:
+            oh, ow = (h - kh) // sh + 1, (w - kw) // sw + 1
+        return InputType.convolutional(oh, ow, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        kh, kw = _pair(self.kernel)
+        sh, sw = _pair(self.stride)
+        dims = (1, kh, kw, 1)
+        strides = (1, sh, sw, 1)
+        pad = self.padding.upper()
+        if self.pooling is PoolingType.MAX:
+            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+        elif self.pooling is PoolingType.SUM:
+            y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+        elif self.pooling is PoolingType.AVG:
+            s = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
+            if pad == "SAME":
+                ones = jnp.ones(x.shape[:1] + x.shape[1:], x.dtype)
+                cnt = lax.reduce_window(ones, 0.0, lax.add, dims, strides, pad)
+                y = s / cnt
+            else:
+                y = s / (kh * kw)
+        elif self.pooling is PoolingType.PNORM:
+            p = float(self.pnorm)
+            s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strides, pad)
+            y = s ** (1.0 / p)
+        else:
+            raise ValueError(f"unhandled pooling {self.pooling}")
+        return y, state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class GlobalPooling(LayerConfig):
+    """GlobalPoolingLayer role: collapse spatial (CNN) or time (RNN) dims."""
+
+    pooling: PoolingType = PoolingType.AVG
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def output_type(self, itype: InputType) -> InputType:
+        if itype.kind == InputType.KIND_CNN:
+            return InputType.feed_forward(itype.channels)
+        if itype.kind == InputType.KIND_RNN:
+            return InputType.feed_forward(itype.size)
+        return itype
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        axes = tuple(range(1, x.ndim - 1))
+        if self.pooling is PoolingType.MAX:
+            return jnp.max(x, axis=axes), state
+        if self.pooling is PoolingType.SUM:
+            return jnp.sum(x, axis=axes), state
+        if self.pooling is PoolingType.PNORM:
+            p = 2.0
+            return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1 / p), state
+        if mask is not None:
+            m = mask[..., None].astype(x.dtype)
+            denom = jnp.maximum(jnp.sum(m, axis=axes), 1.0)
+            return jnp.sum(x * m, axis=axes) / denom, state
+        return jnp.mean(x, axis=axes), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class ZeroPadding2D(LayerConfig):
+    padding: tuple[int, int, int, int] = (1, 1, 1, 1)   # top, bottom, left, right
+    EXPECTS = "cnn"
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def output_type(self, itype: InputType) -> InputType:
+        h, w, c = itype.shape
+        t, b, l, r = self.padding
+        return InputType.convolutional(h + t + b, w + l + r, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        t, b, l, r = self.padding
+        return jnp.pad(x, ((0, 0), (t, b), (l, r), (0, 0))), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class Upsampling2D(LayerConfig):
+    size: tuple[int, int] = (2, 2)
+    EXPECTS = "cnn"
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def output_type(self, itype: InputType) -> InputType:
+        h, w, c = itype.shape
+        return InputType.convolutional(h * self.size[0], w * self.size[1], c)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = jnp.repeat(jnp.repeat(x, self.size[0], axis=1), self.size[1], axis=2)
+        return y, state
+
+
+# ---------------------------------------------------------------------------
+# Normalization layers
+# ---------------------------------------------------------------------------
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class BatchNorm(LayerConfig):
+    """BatchNormalization role.
+
+    Running mean/var live in layer STATE (the functional analog of the
+    reference's mutable running stats); training returns updated state from
+    inside the compiled step.  Under data-parallel sharding the batch mean
+    is a global mean — GSPMD inserts the cross-replica reduction, which is
+    exactly synchronized ("sync BN") semantics.
+    """
+
+    epsilon: float = 1e-5
+    decay: float = 0.9        # running-stat momentum (reference default 0.9)
+    lock_gamma_beta: bool = False
+
+    HAS_PARAMS = True
+    REGULARIZED = ()
+
+    def init(self, key, itype):
+        c = itype.shape[-1]
+        params = {}
+        if not self.lock_gamma_beta:
+            params = {"gamma": jnp.ones((c,), jnp.float32), "beta": jnp.zeros((c,), jnp.float32)}
+        state = {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+        return params, state
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        axes = tuple(range(x.ndim - 1))
+        if training:
+            xf = x.astype(jnp.float32)
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            new_state = {
+                "mean": self.decay * state["mean"] + (1 - self.decay) * mean,
+                "var": self.decay * state["var"] + (1 - self.decay) * var,
+            }
+        else:
+            mean, var = state["mean"], state["var"]
+            new_state = state
+        inv = lax.rsqrt(var + self.epsilon)
+        scale = params.get("gamma", 1.0) * inv
+        shift = params.get("beta", 0.0) - mean * scale
+        y = (x.astype(jnp.float32) * scale + shift).astype(x.dtype)
+        return self._act()(y), new_state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class LayerNorm(LayerConfig):
+    """Layer normalization over the feature (last) dim."""
+
+    epsilon: float = 1e-5
+    HAS_PARAMS = True
+    REGULARIZED = ()
+
+    def init(self, key, itype):
+        c = itype.shape[-1]
+        return {"gamma": jnp.ones((c,), jnp.float32), "beta": jnp.zeros((c,), jnp.float32)}, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=-1, keepdims=True)
+        var = jnp.var(xf, axis=-1, keepdims=True)
+        y = (xf - mean) * lax.rsqrt(var + self.epsilon)
+        y = y * params["gamma"] + params["beta"]
+        return self._act()(y.astype(x.dtype)), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class LocalResponseNormalization(LayerConfig):
+    """LRN role (AlexNet-era)."""
+
+    k: float = 2.0
+    n: int = 5
+    alpha: float = 1e-4
+    beta: float = 0.75
+    EXPECTS = "cnn"
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        sq = x.astype(jnp.float32) ** 2
+        half = self.n // 2
+        # sum over a window along the channel axis
+        padded = jnp.pad(sq, ((0, 0), (0, 0), (0, 0), (half, half)))
+        windows = [padded[..., i : i + x.shape[-1]] for i in range(self.n)]
+        s = sum(windows)
+        y = x.astype(jnp.float32) / (self.k + self.alpha * s) ** self.beta
+        return y.astype(x.dtype), state

@@ -1,0 +1,203 @@
+"""NeuralNetConfiguration builder DSL — the reference's central config entry.
+
+Mirrors the capability of
+`new NeuralNetConfiguration.Builder().seed(..).updater(..).list().layer(..)
+ .setInputType(..).build()` (SURVEY.md §2.2): model-level defaults flow into
+layers that didn't override them; the result is a JSON-round-trippable
+SequentialConfiguration with all shapes inferred.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers import LayerConfig
+from deeplearning4j_tpu.nn.updaters import Sgd, Updater
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.utils import serde
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class SequentialConfiguration:
+    """The MultiLayerConfiguration role: resolved, serializable."""
+
+    layers: tuple[LayerConfig, ...] = ()
+    input_type: Optional[InputType] = None
+    updater: Updater = dataclasses.field(default_factory=Sgd)
+    seed: int = 0
+    gradient_clip_value: Optional[float] = None
+    gradient_clip_norm: Optional[float] = None
+    # Cast activations to bfloat16 inside the step (params stay f32).
+    # None = auto: bf16 on TPU, f32 elsewhere.
+    bf16_compute: Optional[bool] = None
+    # Iterations per epoch, used to lower epoch-based LR schedules
+    # (ScheduleType.EPOCH role). Set via builder.steps_per_epoch().
+    steps_per_epoch: int = 1
+
+    def to_json(self) -> str:
+        return serde.dumps(self)
+
+    @staticmethod
+    def from_json(s: str) -> "SequentialConfiguration":
+        cfg = serde.loads(s)
+        if not isinstance(cfg, SequentialConfiguration):
+            raise TypeError(f"JSON did not decode to SequentialConfiguration: {type(cfg)}")
+        return cfg
+
+    def layer_input_types(self) -> list[InputType]:
+        """Input type seen by each layer, walking output_type down the stack.
+
+        Handles the implicit CNN->FF flatten (InputPreProcessor role): when a
+        layer EXPECTS 'ff' but the incoming type is CNN, the model flattens —
+        reflected here by collapsing the type.
+        """
+        if self.input_type is None:
+            raise ValueError("configuration has no input_type; call set_input_type")
+        itypes = []
+        cur = self.input_type
+        for layer in self.layers:
+            if layer.EXPECTS == "ff" and cur.kind in (InputType.KIND_CNN, InputType.KIND_CNN3D):
+                cur = InputType.feed_forward(cur.flat_size)
+            itypes.append(cur)
+            cur = layer.output_type(cur)
+        return itypes
+
+    def output_type(self) -> InputType:
+        itypes = self.layer_input_types()
+        return self.layers[-1].output_type(itypes[-1])
+
+
+class NeuralNetConfiguration:
+    """Fluent builder. Example:
+
+        conf = (NeuralNetConfiguration.builder()
+                .seed(123)
+                .updater(Adam(1e-3))
+                .weight_init(WeightInit.XAVIER)
+                .activation(Activation.RELU)
+                .l2(1e-4)
+                .list()
+                .layer(Conv2D(n_out=20, kernel=(5, 5)))
+                .layer(Subsampling(kernel=(2, 2), stride=(2, 2)))
+                .layer(Dense(n_out=500))
+                .layer(OutputLayer(n_out=10, loss=Loss.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional(28, 28, 1))
+                .build())
+    """
+
+    def __init__(self):
+        self._seed = 0
+        self._updater: Updater = Sgd()
+        self._activation: Optional[Activation] = None
+        self._weight_init: Optional[WeightInit] = None
+        self._l1: Optional[float] = None
+        self._l2: Optional[float] = None
+        self._dropout: Optional[float] = None
+        self._clip_value: Optional[float] = None
+        self._clip_norm: Optional[float] = None
+        self._bf16: Optional[bool] = None
+        self._steps_per_epoch = 1
+        self._layers: list[LayerConfig] = []
+        self._input_type: Optional[InputType] = None
+
+    @staticmethod
+    def builder() -> "NeuralNetConfiguration":
+        return NeuralNetConfiguration()
+
+    def seed(self, s: int):
+        self._seed = int(s)
+        return self
+
+    def updater(self, u: Updater):
+        self._updater = u
+        return self
+
+    def activation(self, a: Activation):
+        self._activation = a
+        return self
+
+    def weight_init(self, w: WeightInit):
+        self._weight_init = w
+        return self
+
+    def l1(self, v: float):
+        self._l1 = v
+        return self
+
+    def l2(self, v: float):
+        self._l2 = v
+        return self
+
+    def dropout(self, rate: float):
+        self._dropout = rate
+        return self
+
+    def gradient_clip(self, value: float | None = None, norm: float | None = None):
+        self._clip_value, self._clip_norm = value, norm
+        return self
+
+    def bf16_compute(self, on: bool):
+        self._bf16 = on
+        return self
+
+    def steps_per_epoch(self, n: int):
+        """Iterations per epoch — required for per-epoch LR schedules."""
+        self._steps_per_epoch = max(1, int(n))
+        return self
+
+    def list(self):
+        return self
+
+    def layer(self, layer: LayerConfig):
+        self._layers.append(self._fill_defaults(layer))
+        return self
+
+    def set_input_type(self, itype: InputType):
+        self._input_type = itype
+        return self
+
+    def _fill_defaults(self, layer: LayerConfig) -> LayerConfig:
+        updates = {}
+        # The global activation default never flows into output layers: their
+        # activation is resolved from the loss (softmax for MCXENT etc.);
+        # a global RELU leaking in would corrupt output()/predict().
+        is_output = hasattr(layer, "loss")
+        if layer.activation is None and self._activation is not None and not is_output:
+            updates["activation"] = self._activation
+        if layer.weight_init is None and self._weight_init is not None:
+            updates["weight_init"] = self._weight_init
+        if layer.l1 is None and self._l1 is not None:
+            updates["l1"] = self._l1
+        if layer.l2 is None and self._l2 is not None:
+            updates["l2"] = self._l2
+        if layer.dropout_rate is None and self._dropout is not None:
+            updates["dropout_rate"] = self._dropout
+        if layer.name is None:
+            updates["name"] = f"layer{len(self._layers)}"
+        return dataclasses.replace(layer, **updates) if updates else layer
+
+    def build(self) -> SequentialConfiguration:
+        if not self._layers:
+            raise ValueError("no layers configured")
+        names = [l.name for l in self._layers]
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(
+                f"duplicate layer names {sorted(dupes)}: explicit names collide "
+                "with auto-generated 'layer<N>' names or each other"
+            )
+        return SequentialConfiguration(
+            layers=tuple(self._layers),
+            input_type=self._input_type,
+            updater=self._updater,
+            seed=self._seed,
+            gradient_clip_value=self._clip_value,
+            gradient_clip_norm=self._clip_norm,
+            bf16_compute=self._bf16,
+            steps_per_epoch=self._steps_per_epoch,
+        )

@@ -1,0 +1,124 @@
+"""Loss functions — the `org.nd4j.linalg.lossfunctions.LossFunctions` role.
+
+Conventions: predictions enter PRE-activation for the fused softmax/sigmoid
+losses (MCXENT, XENT) — the output layer declares its activation and the
+loss fuses it for numerical stability, same as the reference fuses
+softmax+MCXENT.  Per-example masks (variable-length sequence support,
+SURVEY.md §5.7) multiply per-element losses before reduction; reduction is
+mean over unmasked elements.
+"""
+
+from __future__ import annotations
+
+import enum
+
+import jax
+import jax.numpy as jnp
+
+
+class Loss(str, enum.Enum):
+    MCXENT = "mcxent"                    # softmax cross-entropy, integer or one-hot labels
+    NEGATIVELOGLIKELIHOOD = "nll"        # alias of MCXENT in the reference
+    XENT = "xent"                        # sigmoid binary cross-entropy
+    MSE = "mse"
+    MAE = "l1"
+    L2 = "l2"                            # sum-of-squares (no 1/n): reference semantics
+    SPARSE_MCXENT = "sparse_mcxent"
+    HINGE = "hinge"
+    SQUARED_HINGE = "squared_hinge"
+    HUBER = "huber"
+    POISSON = "poisson"
+    COSINE_PROXIMITY = "cosine_proximity"
+    KL_DIVERGENCE = "kld"
+
+    def __call__(self, preds, labels, mask=None):
+        return compute(self, preds, labels, mask)
+
+
+def _masked_mean(per_elem: jax.Array, mask) -> jax.Array:
+    if mask is None:
+        return jnp.mean(per_elem)
+    mask = jnp.broadcast_to(mask, per_elem.shape).astype(per_elem.dtype)
+    denom = jnp.maximum(jnp.sum(mask), 1.0)
+    return jnp.sum(per_elem * mask) / denom
+
+
+FUSED_ACTIVATION_LOSSES = (
+    Loss.MCXENT,
+    Loss.NEGATIVELOGLIKELIHOOD,
+    Loss.SPARSE_MCXENT,
+    Loss.XENT,
+)
+
+
+def compute(
+    loss: Loss, preds: jax.Array, labels: jax.Array, mask=None, from_logits: bool = True
+) -> jax.Array:
+    """Scalar loss.
+
+    For the fused-activation losses (MCXENT/XENT family), `preds` are
+    pre-activation logits when from_logits=True (the numerically-stable
+    fused path), or already-activated probabilities when from_logits=False
+    (used when the output layer declared a non-standard activation).
+    Other losses always receive activated predictions.
+
+    `mask` broadcasts against the per-example loss (shape preds.shape[:-1])
+    for categorical losses, or against preds for elementwise losses.
+    """
+    f32 = jnp.float32
+    preds = preds.astype(f32)
+    if loss in (Loss.MCXENT, Loss.NEGATIVELOGLIKELIHOOD, Loss.SPARSE_MCXENT):
+        if from_logits:
+            logp = jax.nn.log_softmax(preds, axis=-1)
+        else:
+            logp = jnp.log(jnp.maximum(preds, 1e-12))
+        if labels.ndim == preds.ndim - 1 or loss is Loss.SPARSE_MCXENT:
+            labels_int = labels.astype(jnp.int32)
+            if labels_int.ndim == preds.ndim:      # one-hot passed to sparse
+                labels_int = jnp.argmax(labels_int, axis=-1)
+            nll = -jnp.take_along_axis(logp, labels_int[..., None], axis=-1)[..., 0]
+        else:
+            nll = -jnp.sum(labels.astype(f32) * logp, axis=-1)
+        return _masked_mean(nll, mask)
+    if loss is Loss.XENT:
+        labels = labels.astype(f32)
+        if from_logits:
+            per = jnp.maximum(preds, 0) - preds * labels + jnp.log1p(jnp.exp(-jnp.abs(preds)))
+        else:
+            p = jnp.clip(preds, 1e-7, 1 - 1e-7)
+            per = -(labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p))
+        per = jnp.sum(per, axis=-1)
+        return _masked_mean(per, mask)
+    labels = labels.astype(f32)
+    if loss is Loss.MSE:
+        return _masked_mean(jnp.mean((preds - labels) ** 2, axis=-1), mask)
+    if loss is Loss.MAE:
+        return _masked_mean(jnp.mean(jnp.abs(preds - labels), axis=-1), mask)
+    if loss is Loss.L2:
+        return _masked_mean(jnp.sum((preds - labels) ** 2, axis=-1), mask)
+    if loss is Loss.HINGE:
+        # labels in {-1, +1} (or {0,1} → remapped)
+        y = jnp.where(labels > 0, 1.0, -1.0)
+        per = jnp.mean(jnp.maximum(0.0, 1.0 - y * preds), axis=-1)
+        return _masked_mean(per, mask)
+    if loss is Loss.SQUARED_HINGE:
+        y = jnp.where(labels > 0, 1.0, -1.0)
+        per = jnp.mean(jnp.maximum(0.0, 1.0 - y * preds) ** 2, axis=-1)
+        return _masked_mean(per, mask)
+    if loss is Loss.HUBER:
+        d = preds - labels
+        a = jnp.abs(d)
+        per = jnp.mean(jnp.where(a <= 1.0, 0.5 * d * d, a - 0.5), axis=-1)
+        return _masked_mean(per, mask)
+    if loss is Loss.POISSON:
+        per = jnp.mean(preds - labels * jnp.log(jnp.maximum(preds, 1e-12)), axis=-1)
+        return _masked_mean(per, mask)
+    if loss is Loss.COSINE_PROXIMITY:
+        pn = preds / jnp.maximum(jnp.linalg.norm(preds, axis=-1, keepdims=True), 1e-12)
+        ln = labels / jnp.maximum(jnp.linalg.norm(labels, axis=-1, keepdims=True), 1e-12)
+        return _masked_mean(-jnp.sum(pn * ln, axis=-1), mask)
+    if loss is Loss.KL_DIVERGENCE:
+        p = jnp.maximum(labels, 1e-12)
+        q = jnp.maximum(preds, 1e-12)
+        return _masked_mean(jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=-1), mask)
+    raise ValueError(f"unhandled loss {loss}")

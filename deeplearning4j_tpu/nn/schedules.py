@@ -1,0 +1,131 @@
+"""Learning-rate schedules — the `org.nd4j.linalg.schedule.ISchedule` role.
+
+Each schedule is a JSON-serializable dataclass that lowers to an optax
+schedule function (step -> lr), evaluated inside the compiled train step.
+The reference's ScheduleType.{ITERATION,EPOCH} distinction is expressed by
+`steps_per_epoch` at lowering time.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Union
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.utils import serde
+
+
+@dataclasses.dataclass(frozen=True)
+class Schedule:
+    """Base; subclasses define value(step)."""
+
+    def to_fn(self, steps_per_epoch: int = 1):
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedSchedule(Schedule):
+    value: float = 1e-3
+
+    def to_fn(self, steps_per_epoch: int = 1):
+        v = self.value
+        return lambda step: jnp.full((), v, jnp.float32)
+
+
+@dataclasses.dataclass(frozen=True)
+class StepSchedule(Schedule):
+    """lr * decay_rate ^ floor(t / step)."""
+
+    initial: float = 1e-3
+    decay_rate: float = 0.5
+    step: float = 1000.0
+    per_epoch: bool = False
+
+    def to_fn(self, steps_per_epoch: int = 1):
+        unit = self.step * (steps_per_epoch if self.per_epoch else 1.0)
+        return lambda t: self.initial * self.decay_rate ** jnp.floor(t / unit)
+
+
+@dataclasses.dataclass(frozen=True)
+class ExponentialSchedule(Schedule):
+    initial: float = 1e-3
+    gamma: float = 0.999
+
+    def to_fn(self, steps_per_epoch: int = 1):
+        return lambda t: self.initial * self.gamma**t
+
+
+@dataclasses.dataclass(frozen=True)
+class PolySchedule(Schedule):
+    initial: float = 1e-3
+    power: float = 1.0
+    max_iter: int = 10000
+
+    def to_fn(self, steps_per_epoch: int = 1):
+        def fn(t):
+            frac = jnp.clip(t / self.max_iter, 0.0, 1.0)
+            return self.initial * (1.0 - frac) ** self.power
+
+        return fn
+
+
+@dataclasses.dataclass(frozen=True)
+class SigmoidSchedule(Schedule):
+    initial: float = 1e-3
+    gamma: float = 0.01
+    step_size: int = 1000
+
+    def to_fn(self, steps_per_epoch: int = 1):
+        def fn(t):
+            return self.initial / (1.0 + jnp.exp(self.gamma * (t - self.step_size)))
+
+        return fn
+
+
+@dataclasses.dataclass(frozen=True)
+class InverseSchedule(Schedule):
+    initial: float = 1e-3
+    gamma: float = 1e-3
+    power: float = 1.0
+
+    def to_fn(self, steps_per_epoch: int = 1):
+        return lambda t: self.initial / (1.0 + self.gamma * t) ** self.power
+
+
+@dataclasses.dataclass(frozen=True)
+class CosineSchedule(Schedule):
+    """Cosine decay with optional linear warmup (the transformer staple)."""
+
+    initial: float = 1e-3
+    decay_steps: int = 10000
+    warmup_steps: int = 0
+    final_fraction: float = 0.0
+
+    def to_fn(self, steps_per_epoch: int = 1):
+        def fn(t):
+            t = jnp.asarray(t, jnp.float32)
+            warm = self.initial * t / max(self.warmup_steps, 1)
+            prog = jnp.clip(
+                (t - self.warmup_steps) / max(self.decay_steps - self.warmup_steps, 1),
+                0.0,
+                1.0,
+            )
+            cos = self.final_fraction + (1 - self.final_fraction) * 0.5 * (
+                1 + jnp.cos(math.pi * prog)
+            )
+            return jnp.where(t < self.warmup_steps, warm, self.initial * cos)
+
+        return fn
+
+
+for _cls in (FixedSchedule, StepSchedule, ExponentialSchedule, PolySchedule,
+             SigmoidSchedule, InverseSchedule, CosineSchedule):
+    serde.register(_cls)
+
+ScheduleLike = Union[Schedule, float]
+
+
+def as_schedule(s: ScheduleLike) -> Schedule:
+    return FixedSchedule(float(s)) if isinstance(s, (int, float)) else s

@@ -1,0 +1,169 @@
+"""Updaters (optimizers) — the `org.nd4j.linalg.learning.config.IUpdater` role.
+
+Each updater is a JSON-serializable dataclass config that lowers to an
+optax GradientTransformation.  Unlike the reference — where updater kernels
+run as separate libnd4j ops per parameter block (SURVEY.md §3.1) — the
+transformation is traced into the same XLA computation as forward+backward,
+so Adam's moment updates fuse with the gradient producers.
+
+Updater STATE (moments etc.) is a pytree checkpointed alongside params,
+matching the reference's updaterState.bin (SURVEY.md §5.4).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import optax
+
+from deeplearning4j_tpu.nn.schedules import ScheduleLike, as_schedule
+from deeplearning4j_tpu.utils import serde
+
+
+@dataclasses.dataclass(frozen=True)
+class Updater:
+    """Base updater config. learning_rate may be a float or a Schedule."""
+
+    learning_rate: ScheduleLike = 1e-3
+
+    def _lr(self, steps_per_epoch: int):
+        return as_schedule(self.learning_rate).to_fn(steps_per_epoch)
+
+    def to_optax(self, steps_per_epoch: int = 1) -> optax.GradientTransformation:
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class Sgd(Updater):
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.sgd(self._lr(steps_per_epoch))
+
+
+@dataclasses.dataclass(frozen=True)
+class Nesterovs(Updater):
+    learning_rate: ScheduleLike = 0.1
+    momentum: float = 0.9
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.sgd(self._lr(steps_per_epoch), momentum=self.momentum, nesterov=True)
+
+
+@dataclasses.dataclass(frozen=True)
+class Momentum(Updater):
+    learning_rate: ScheduleLike = 0.1
+    momentum: float = 0.9
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.sgd(self._lr(steps_per_epoch), momentum=self.momentum)
+
+
+@dataclasses.dataclass(frozen=True)
+class Adam(Updater):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.adam(self._lr(steps_per_epoch), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdamW(Updater):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+    weight_decay: float = 0.01
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.adamw(
+            self._lr(steps_per_epoch),
+            b1=self.beta1,
+            b2=self.beta2,
+            eps=self.epsilon,
+            weight_decay=self.weight_decay,
+        )
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaMax(Updater):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.adamax(self._lr(steps_per_epoch), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@dataclasses.dataclass(frozen=True)
+class Nadam(Updater):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.nadam(self._lr(steps_per_epoch), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@dataclasses.dataclass(frozen=True)
+class AmsGrad(Updater):
+    beta1: float = 0.9
+    beta2: float = 0.999
+    epsilon: float = 1e-8
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.amsgrad(self._lr(steps_per_epoch), b1=self.beta1, b2=self.beta2, eps=self.epsilon)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaGrad(Updater):
+    epsilon: float = 1e-6
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.adagrad(self._lr(steps_per_epoch), eps=self.epsilon)
+
+
+@dataclasses.dataclass(frozen=True)
+class AdaDelta(Updater):
+    rho: float = 0.95
+    epsilon: float = 1e-6
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        # AdaDelta in the reference ignores the learning rate.
+        return optax.adadelta(rho=self.rho, eps=self.epsilon)
+
+
+@dataclasses.dataclass(frozen=True)
+class RmsProp(Updater):
+    decay: float = 0.95
+    epsilon: float = 1e-8
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.rmsprop(self._lr(steps_per_epoch), decay=self.decay, eps=self.epsilon)
+
+
+@dataclasses.dataclass(frozen=True)
+class NoOp(Updater):
+    """Frozen parameters (the reference's NoOp updater / FrozenLayer)."""
+
+    def to_optax(self, steps_per_epoch: int = 1):
+        return optax.set_to_zero()
+
+
+for _cls in (Sgd, Nesterovs, Momentum, Adam, AdamW, AdaMax, Nadam, AmsGrad,
+             AdaGrad, AdaDelta, RmsProp, NoOp):
+    serde.register(_cls)
+
+
+def with_gradient_clipping(
+    tx: optax.GradientTransformation,
+    clip_value: float | None = None,
+    clip_norm: float | None = None,
+) -> optax.GradientTransformation:
+    """GradientNormalization.{ClipElementWiseAbsoluteValue,ClipL2PerLayer} role."""
+    chain = []
+    if clip_value is not None:
+        chain.append(optax.clip(clip_value))
+    if clip_norm is not None:
+        chain.append(optax.clip_by_global_norm(clip_norm))
+    chain.append(tx)
+    return optax.chain(*chain)

@@ -1,0 +1,97 @@
+"""Weight initialization schemes — the `org.deeplearning4j.nn.weights.WeightInit` role.
+
+Fan-in/fan-out are derived from the shape the same way the reference's
+`WeightInitUtil` does; every scheme is a pure function of a PRNG key.
+"""
+
+from __future__ import annotations
+
+import enum
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class WeightInit(str, enum.Enum):
+    XAVIER = "xavier"              # glorot normal
+    XAVIER_UNIFORM = "xavier_uniform"
+    RELU = "relu"                  # he normal
+    RELU_UNIFORM = "relu_uniform"
+    LECUN_NORMAL = "lecun_normal"
+    LECUN_UNIFORM = "lecun_uniform"
+    NORMAL = "normal"              # N(0, 1/sqrt(fan_in))
+    UNIFORM = "uniform"            # U(-a, a), a = 1/sqrt(fan_in)
+    ZERO = "zero"
+    ONES = "ones"
+    CONSTANT = "constant"
+    IDENTITY = "identity"
+    ORTHOGONAL = "orthogonal"
+    VAR_SCALING_NORMAL_FAN_AVG = "var_scaling_normal_fan_avg"
+
+    def init(
+        self,
+        key: jax.Array,
+        shape: tuple[int, ...],
+        fan_in: int | None = None,
+        fan_out: int | None = None,
+        dtype=jnp.float32,
+        constant: float = 0.0,
+    ) -> jax.Array:
+        if fan_in is None or fan_out is None:
+            fi, fo = _fans(shape)
+            fan_in = fan_in if fan_in is not None else fi
+            fan_out = fan_out if fan_out is not None else fo
+        w = self
+        if w is WeightInit.ZERO:
+            return jnp.zeros(shape, dtype)
+        if w is WeightInit.ONES:
+            return jnp.ones(shape, dtype)
+        if w is WeightInit.CONSTANT:
+            return jnp.full(shape, constant, dtype)
+        if w is WeightInit.IDENTITY:
+            if len(shape) != 2 or shape[0] != shape[1]:
+                raise ValueError(f"IDENTITY init needs a square 2D shape, got {shape}")
+            return jnp.eye(shape[0], dtype=dtype)
+        if w is WeightInit.ORTHOGONAL:
+            return jax.nn.initializers.orthogonal()(key, shape, dtype)
+        if w is WeightInit.XAVIER:
+            std = math.sqrt(2.0 / (fan_in + fan_out))
+            return std * jax.random.normal(key, shape, dtype)
+        if w is WeightInit.XAVIER_UNIFORM:
+            a = math.sqrt(6.0 / (fan_in + fan_out))
+            return jax.random.uniform(key, shape, dtype, -a, a)
+        if w is WeightInit.RELU:
+            std = math.sqrt(2.0 / fan_in)
+            return std * jax.random.normal(key, shape, dtype)
+        if w is WeightInit.RELU_UNIFORM:
+            a = math.sqrt(6.0 / fan_in)
+            return jax.random.uniform(key, shape, dtype, -a, a)
+        if w is WeightInit.LECUN_NORMAL:
+            std = math.sqrt(1.0 / fan_in)
+            return std * jax.random.normal(key, shape, dtype)
+        if w is WeightInit.LECUN_UNIFORM:
+            a = math.sqrt(3.0 / fan_in)
+            return jax.random.uniform(key, shape, dtype, -a, a)
+        if w is WeightInit.NORMAL:
+            return jax.random.normal(key, shape, dtype) / math.sqrt(fan_in)
+        if w is WeightInit.UNIFORM:
+            a = 1.0 / math.sqrt(fan_in)
+            return jax.random.uniform(key, shape, dtype, -a, a)
+        if w is WeightInit.VAR_SCALING_NORMAL_FAN_AVG:
+            std = math.sqrt(2.0 / (fan_in + fan_out))
+            return std * jax.random.normal(key, shape, dtype)
+        raise ValueError(f"unhandled WeightInit {w}")
+
+
+def _fans(shape: tuple[int, ...]) -> tuple[int, int]:
+    """(fan_in, fan_out) for dense [in,out] and conv [kh,kw,in,out] shapes."""
+    if len(shape) < 1:
+        return 1, 1
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    receptive = int(np.prod(shape[:-2]))
+    return shape[-2] * receptive, shape[-1] * receptive

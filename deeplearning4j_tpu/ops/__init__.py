@@ -1,0 +1,8 @@
+"""Functional op layer — the ND4J op-library role, TPU-native.
+
+Where the reference enumerates ~500 declarable ops executed one JNI call at
+a time (SURVEY.md §2.1), here ops are pure jax functions meant to be traced
+into larger computations.  jnp/lax already cover the op surface; this
+package holds the ops worth owning: fused attention (incl. ring/Ulysses in
+parallel/), and op-validation utilities used by the test corpus.
+"""

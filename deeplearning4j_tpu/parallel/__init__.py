@@ -1,0 +1,7 @@
+"""Parallelism strategies over jax.sharding.Mesh.
+
+Replaces the reference's ParallelWrapper (single-node DP), Spark training
+masters and the Aeron parameter server (SURVEY.md §2.3) with sharding +
+XLA collectives, and adds the strategies the reference lacks: tensor,
+pipeline, sequence/context (ring attention, Ulysses) and expert parallel.
+"""

@@ -1,0 +1,32 @@
+"""Runtime substrate: device/backend discovery, mesh construction, flags, RNG.
+
+Plays the role of the reference's L0/L1/L2 stack (libnd4j NativeOps ABI,
+JavaCPP presets, Nd4jBackend SPI — see SURVEY.md §1) except that the kernels
+themselves are supplied by XLA:TPU; what remains host-side is device
+bootstrap, mesh topology, runtime flags and deterministic RNG.
+"""
+
+from deeplearning4j_tpu.runtime.backend import (
+    Backend,
+    backend,
+    device_count,
+    devices,
+    platform,
+)
+from deeplearning4j_tpu.runtime.flags import Environment, environment
+from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh, virtual_cpu_devices
+from deeplearning4j_tpu.runtime.rng import SeedStream
+
+__all__ = [
+    "Backend",
+    "backend",
+    "device_count",
+    "devices",
+    "platform",
+    "Environment",
+    "environment",
+    "MeshSpec",
+    "make_mesh",
+    "virtual_cpu_devices",
+    "SeedStream",
+]

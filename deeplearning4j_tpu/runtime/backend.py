@@ -1,0 +1,64 @@
+"""Backend discovery — the `Nd4jBackend` SPI role, TPU-native.
+
+The reference selects an execution backend (nd4j-native CPU vs nd4j-cuda)
+by classpath service discovery and routes every op through that backend's
+OpExecutioner (SURVEY.md §1 L2, §2.2).  Here the "backend" is a PJRT
+platform reported by JAX; ops never route through a host-side executioner —
+whole computations are compiled — so the backend object only carries
+identity, capability and preferred-dtype information.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+
+import jax
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Backend:
+    """Identity + capabilities of the active PJRT platform."""
+
+    platform: str                 # "tpu" | "cpu" | "gpu" | experimental names
+    device_kind: str              # e.g. "TPU v5 lite"
+    num_devices: int
+    supports_bfloat16_matmul: bool
+
+    @property
+    def is_tpu(self) -> bool:
+        # Experimental transports (e.g. the axon tunnel) still expose TPU
+        # device kinds; detect by device kind as well as platform name.
+        return self.platform == "tpu" or "TPU" in self.device_kind
+
+    @property
+    def compute_dtype(self):
+        """Preferred matmul/conv dtype: bf16 on TPU (MXU-native), f32 on CPU."""
+        return np.dtype("bfloat16") if self.supports_bfloat16_matmul else np.dtype("float32")
+
+
+@functools.cache
+def backend() -> Backend:
+    devs = jax.devices()
+    d0 = devs[0]
+    kind = getattr(d0, "device_kind", d0.platform)
+    is_tpu_like = d0.platform == "tpu" or "TPU" in str(kind)
+    return Backend(
+        platform=d0.platform,
+        device_kind=str(kind),
+        num_devices=len(devs),
+        supports_bfloat16_matmul=is_tpu_like,
+    )
+
+
+def devices():
+    return jax.devices()
+
+
+def device_count() -> int:
+    return len(jax.devices())
+
+
+def platform() -> str:
+    return backend().platform

@@ -1,0 +1,65 @@
+"""Runtime environment flags — the `Nd4j.getEnvironment()` role.
+
+The reference centralizes runtime-mutable knobs (debug, verbose, NaN/Inf
+panic profiling modes) in `Nd4j.getEnvironment()` / `ND4JSystemProperties`
+(SURVEY.md §5.6, §5.1).  TPU-native, most correctness knobs map onto
+jax.config switches; this module gives them one typed home plus env-var
+initialization (prefix DL4J_TPU_*).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+
+import jax
+
+
+def _env_bool(name: str, default: bool = False) -> bool:
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.strip().lower() in ("1", "true", "yes", "on")
+
+
+@dataclasses.dataclass
+class Environment:
+    """Mutable runtime configuration.
+
+    nan_panic mirrors the reference's OpExecutioner ProfilingMode.NAN_PANIC:
+    enabling it flips jax_debug_nans so any NaN produced under jit raises.
+    """
+
+    debug: bool = False
+    verbose: bool = False
+    nan_panic: bool = False
+    # Preferred training dtype for matmul/conv inputs; params stay f32.
+    use_bfloat16_compute: bool = True
+    # Shape-bucketing quantum for variable-length sequence batches
+    # (recompilation hygiene, SURVEY.md §7.3 item 6).
+    sequence_bucket_size: int = 64
+
+    def set_nan_panic(self, on: bool) -> None:
+        self.nan_panic = on
+        jax.config.update("jax_debug_nans", on)
+
+    @staticmethod
+    def from_env() -> "Environment":
+        env = Environment(
+            debug=_env_bool("DL4J_TPU_DEBUG"),
+            verbose=_env_bool("DL4J_TPU_VERBOSE"),
+            use_bfloat16_compute=_env_bool("DL4J_TPU_BF16", True),
+        )
+        if _env_bool("DL4J_TPU_NAN_PANIC"):
+            env.set_nan_panic(True)
+        return env
+
+
+_ENV: Environment | None = None
+
+
+def environment() -> Environment:
+    global _ENV
+    if _ENV is None:
+        _ENV = Environment.from_env()
+    return _ENV

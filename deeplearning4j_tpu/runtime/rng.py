@@ -1,0 +1,51 @@
+"""Deterministic RNG threading.
+
+The reference's RNG is a stateful native generator shared through
+NativeOps (SURVEY.md §2.1).  JAX RNG is functional: a SeedStream wraps a
+root PRNG key and hands out named/folded subkeys so layer init and dropout
+are reproducible and jit-safe.  Inside a compiled train step, per-step keys
+are derived by folding the step counter into the stream key — no host
+round-trip, no state.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _stable_hash(name: str) -> int:
+    # Python's hash() is salted per-process; use a stable FNV-1a instead so
+    # named keys are reproducible across runs.
+    h = 0xCBF29CE484222325
+    for b in name.encode():
+        h = ((h ^ b) * 0x100000001B3) & 0xFFFFFFFFFFFFFFFF
+    return h & 0x7FFFFFFF
+
+
+class SeedStream:
+    """Hands out independent subkeys from one root seed.
+
+    - ``stream.key(name)`` — stable named key (layer init).
+    - ``stream.next()`` — sequential key (ad-hoc host-side use).
+    - ``SeedStream.fold(key, step)`` — derive a per-step key inside jit.
+    """
+
+    def __init__(self, seed: int | jax.Array = 0):
+        self._key = seed if isinstance(seed, jax.Array) else jax.random.key(seed)
+        self._count = 0
+
+    @property
+    def root(self) -> jax.Array:
+        return self._key
+
+    def key(self, name: str) -> jax.Array:
+        return jax.random.fold_in(self._key, _stable_hash(name))
+
+    def next(self) -> jax.Array:
+        self._count += 1
+        return jax.random.fold_in(self._key, self._count)
+
+    @staticmethod
+    def fold(key: jax.Array, step: jax.Array | int) -> jax.Array:
+        return jax.random.fold_in(key, jnp.asarray(step, dtype=jnp.uint32))

@@ -1,0 +1,13 @@
+from deeplearning4j_tpu.train.listeners import (
+    CollectScoresListener,
+    PerformanceListener,
+    ScoreIterationListener,
+    TrainingListener,
+)
+
+__all__ = [
+    "TrainingListener",
+    "ScoreIterationListener",
+    "PerformanceListener",
+    "CollectScoresListener",
+]

@@ -1,0 +1,109 @@
+"""Model serialization — the `org.deeplearning4j.util.ModelSerializer` role.
+
+Same container capability as the reference's model zip (SURVEY.md §5.4):
+one file holding configuration JSON + flattened params + updater state +
+net state (BN running stats) + training counters.  Format: a .zip with
+  configuration.json   — serde config tree (incl. which model class)
+  params.npz           — flattened path->array
+  netstate.npz         — non-trainable state
+  updater.npz          — optax state leaves (structure rebuilt from config)
+  meta.json            — iteration/epoch counters, format version
+Restore rebuilds the model from config, then loads arrays back into the
+freshly-initialized pytrees (structure comes from code, data from the file —
+robust to optax internals as long as the leaf count matches).
+"""
+
+from __future__ import annotations
+
+import io
+import json
+import zipfile
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.utils import serde
+
+FORMAT_VERSION = 1
+
+
+def _save_npz_pytree(zf: zipfile.ZipFile, name: str, tree) -> None:
+    leaves = jax.tree.leaves(tree)
+    buf = io.BytesIO()
+    np.savez(buf, *[np.asarray(x) for x in leaves])
+    zf.writestr(name, buf.getvalue())
+
+
+def _load_npz_into(zf: zipfile.ZipFile, name: str, tree):
+    data = np.load(io.BytesIO(zf.read(name)), allow_pickle=False)
+    leaves = [data[k] for k in data.files]
+    ref_leaves, treedef = jax.tree.flatten(tree)
+    if len(leaves) != len(ref_leaves):
+        raise ValueError(
+            f"{name}: checkpoint has {len(leaves)} arrays, model expects {len(ref_leaves)}"
+        )
+    new = [
+        jnp.asarray(saved, dtype=ref.dtype) if hasattr(ref, "dtype") else saved
+        for saved, ref in zip(leaves, ref_leaves)
+    ]
+    return jax.tree.unflatten(treedef, new)
+
+
+class ModelSerializer:
+    @staticmethod
+    def write_model(model, path: str, save_updater: bool = True) -> None:
+        if model.params is None:
+            raise RuntimeError("model not initialized")
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr(
+                "configuration.json",
+                json.dumps(
+                    {
+                        "model_class": type(model).__name__,
+                        "conf": serde.to_jsonable(model.conf),
+                    },
+                    indent=2,
+                ),
+            )
+            _save_npz_pytree(zf, "params.npz", model.params)
+            _save_npz_pytree(zf, "netstate.npz", model.net_state)
+            if save_updater and model.opt_state is not None:
+                _save_npz_pytree(zf, "updater.npz", model.opt_state)
+            zf.writestr(
+                "meta.json",
+                json.dumps(
+                    {
+                        "format_version": FORMAT_VERSION,
+                        "iteration": model.iteration,
+                        "epoch": model.epoch,
+                    }
+                ),
+            )
+
+    @staticmethod
+    def restore(path: str):
+        """Restore any saved model (restoreMultiLayerNetwork /
+        restoreComputationGraph role, class-dispatched)."""
+        with zipfile.ZipFile(path, "r") as zf:
+            cfg = json.loads(zf.read("configuration.json"))
+            conf = serde.from_jsonable(cfg["conf"])
+            model_class = cfg["model_class"]
+            if model_class == "SequentialModel":
+                from deeplearning4j_tpu.models.sequential import SequentialModel
+
+                model = SequentialModel(conf).init()
+            elif model_class == "GraphModel":
+                from deeplearning4j_tpu.models.computation_graph import GraphModel
+
+                model = GraphModel(conf).init()
+            else:
+                raise ValueError(f"unknown model class in checkpoint: {model_class}")
+            model.params = _load_npz_into(zf, "params.npz", model.params)
+            model.net_state = _load_npz_into(zf, "netstate.npz", model.net_state)
+            if "updater.npz" in zf.namelist():
+                model.opt_state = _load_npz_into(zf, "updater.npz", model.opt_state)
+            meta = json.loads(zf.read("meta.json"))
+            model.iteration = meta.get("iteration", 0)
+            model.epoch = meta.get("epoch", 0)
+        return model

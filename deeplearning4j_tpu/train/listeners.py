@@ -1,0 +1,88 @@
+"""Training listeners — the `org.deeplearning4j.optimize.api.TrainingListener` SPI.
+
+PerformanceListener is the measurement instrument behind every BASELINE
+number (samples/sec during fit(), SURVEY.md §5.1).  Note on honesty of the
+numbers: the first iterations include XLA compile time; PerformanceListener
+reports both the including- and excluding-warmup rates.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class TrainingListener:
+    def iteration_done(self, model, iteration: int, epoch: int, score: float) -> None:
+        pass
+
+    def on_epoch_start(self, model, epoch: int) -> None:
+        pass
+
+    def on_epoch_end(self, model, epoch: int) -> None:
+        pass
+
+
+class ScoreIterationListener(TrainingListener):
+    def __init__(self, print_every: int = 10):
+        self.print_every = max(1, print_every)
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.print_every == 0:
+            log.info("Score at iteration %d is %s", iteration, score)
+
+
+class CollectScoresListener(TrainingListener):
+    def __init__(self):
+        self.scores: list[tuple[int, float]] = []
+
+    def iteration_done(self, model, iteration, epoch, score):
+        self.scores.append((iteration, float(score)))
+
+
+class PerformanceListener(TrainingListener):
+    """samples/sec + batches/sec, with warmup-excluded steady-state rate."""
+
+    def __init__(self, frequency: int = 10, warmup_iterations: int = 10):
+        self.frequency = max(1, frequency)
+        self.warmup = warmup_iterations
+        self._count = 0
+        self._samples = 0
+        self._t0: float | None = None
+        self._steady_t0: float | None = None
+        self._steady_samples = 0
+        self._steady_batches = 0
+
+    def iteration_done(self, model, iteration, epoch, score):
+        now = time.perf_counter()
+        batch = getattr(model, "last_batch_size", 0)
+        if self._t0 is None:
+            self._t0 = now
+        self._count += 1
+        self._samples += batch
+        if self._count == self.warmup:
+            self._steady_t0 = now
+        elif self._count > self.warmup and self._steady_t0 is not None:
+            self._steady_samples += batch
+            self._steady_batches += 1
+        if self._count % self.frequency == 0 and self._count > 1:
+            total_dt = now - self._t0
+            msg = f"iteration {iteration}: {self._samples / total_dt:.1f} samples/sec overall"
+            if self._steady_batches:
+                msg += f", {self.samples_per_sec():.1f} samples/sec steady-state"
+            log.info(msg)
+
+    def samples_per_sec(self) -> float:
+        """Steady-state (post-warmup) samples/sec — the BASELINE metric."""
+        if not self._steady_batches or self._steady_t0 is None:
+            return 0.0
+        dt = time.perf_counter() - self._steady_t0
+        return self._steady_samples / dt if dt > 0 else 0.0
+
+    def batches_per_sec(self) -> float:
+        if not self._steady_batches or self._steady_t0 is None:
+            return 0.0
+        dt = time.perf_counter() - self._steady_t0
+        return self._steady_batches / dt if dt > 0 else 0.0

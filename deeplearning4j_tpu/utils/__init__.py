@@ -1,0 +1,7 @@
+from deeplearning4j_tpu.utils.pytree import (
+    param_count,
+    tree_bytes,
+    tree_flatten_with_paths,
+)
+
+__all__ = ["param_count", "tree_bytes", "tree_flatten_with_paths"]

@@ -1,0 +1,1 @@
+"""Model zoo — the `org.deeplearning4j.zoo` role."""

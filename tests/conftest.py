@@ -1,0 +1,33 @@
+"""Test configuration: force an 8-device virtual CPU platform.
+
+The reference tests multi-node without a cluster via Spark local[N] and
+Aeron loopback (SURVEY.md §4.2); our equivalent is
+xla_force_host_platform_device_count=8 on the CPU plugin, so every sharding
+test runs on a real 8-way Mesh with real XLA collectives, no TPU needed.
+These env vars MUST be set before jax initializes its backends — hence here,
+at conftest import time, before any test module imports jax.
+"""
+
+import os
+
+prev = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in prev:
+    os.environ["XLA_FLAGS"] = (prev + " --xla_force_host_platform_device_count=8").strip()
+os.environ.setdefault("JAX_ENABLE_X64", "0")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+import jax  # noqa: E402
+
+# The env var JAX_PLATFORMS=cpu is overridden by experimental PJRT plugins
+# (axon); the config update is authoritative.
+jax.config.update("jax_platforms", "cpu")
+
+# This XLA CPU build defaults to low-precision matmul (bf16-sized error on a
+# plain f32 matmul); pin to float32 so numeric assertions are meaningful.
+jax.config.update("jax_default_matmul_precision", "float32")
+
+
+@pytest.fixture
+def rng():
+    return np.random.default_rng(42)

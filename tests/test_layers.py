@@ -1,0 +1,181 @@
+"""Per-layer forward-shape and semantics tests (the libnd4j layers_tests role)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    ActivationLayer,
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    GlobalPooling,
+    InputType,
+    LayerNorm,
+    OutputLayer,
+    PoolingType,
+    Subsampling,
+    Upsampling2D,
+    ZeroPadding2D,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    Deconv2D,
+    LocalResponseNormalization,
+    SeparableConv2D,
+)
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+KEY = jax.random.key(0)
+
+
+def run_layer(layer, itype, x, training=False, rng=None):
+    params, state = layer.init(KEY, itype)
+    y, new_state = layer.apply(params, state, jnp.asarray(x), training=training, rng=rng)
+    expected = layer.output_type(itype)
+    assert y.shape == (x.shape[0], *expected.shape), (
+        f"{type(layer).__name__}: got {y.shape}, expected batch+{expected.shape}"
+    )
+    return y, params, new_state
+
+
+def test_dense_shapes_and_linearity():
+    layer = Dense(n_out=7, name="d", activation=Activation.IDENTITY)
+    x = np.random.default_rng(0).normal(size=(4, 5)).astype(np.float32)
+    y, params, _ = run_layer(layer, InputType.feed_forward(5), x)
+    np.testing.assert_allclose(
+        np.asarray(y), x @ np.asarray(params["W"]) + np.asarray(params["b"]), rtol=1e-5
+    )
+
+
+def test_dense_activation():
+    layer = Dense(n_out=3, name="d", activation=Activation.RELU)
+    x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+    y, _, _ = run_layer(layer, InputType.feed_forward(4), x)
+    assert np.all(np.asarray(y) >= 0)
+
+
+@pytest.mark.parametrize("padding,expected_hw", [("valid", (24, 24)), ("same", (28, 28))])
+def test_conv2d_shapes(padding, expected_hw):
+    layer = Conv2D(n_out=6, kernel=(5, 5), padding=padding, name="c")
+    itype = InputType.convolutional(28, 28, 1)
+    out = layer.output_type(itype)
+    assert out.shape == (*expected_hw, 6)
+    x = np.random.default_rng(0).normal(size=(2, 28, 28, 1)).astype(np.float32)
+    run_layer(layer, itype, x)
+
+
+def test_conv2d_stride_dilation():
+    layer = Conv2D(n_out=4, kernel=(3, 3), stride=(2, 2), dilation=(2, 2), name="c")
+    itype = InputType.convolutional(16, 16, 3)
+    x = np.random.default_rng(0).normal(size=(2, 16, 16, 3)).astype(np.float32)
+    run_layer(layer, itype, x)
+
+
+def test_conv2d_matches_manual_1x1():
+    # 1x1 conv == per-pixel matmul
+    layer = Conv2D(n_out=3, kernel=(1, 1), name="c", activation=Activation.IDENTITY)
+    itype = InputType.convolutional(4, 4, 2)
+    x = np.random.default_rng(0).normal(size=(2, 4, 4, 2)).astype(np.float32)
+    y, params, _ = run_layer(layer, itype, x)
+    w = np.asarray(params["W"])[0, 0]  # [in, out]
+    manual = x @ w + np.asarray(params["b"])
+    np.testing.assert_allclose(np.asarray(y), manual, rtol=1e-4, atol=1e-5)
+
+
+def test_separable_and_deconv_shapes():
+    it = InputType.convolutional(8, 8, 4)
+    x = np.random.default_rng(0).normal(size=(2, 8, 8, 4)).astype(np.float32)
+    run_layer(SeparableConv2D(n_out=6, kernel=(3, 3), name="s"), it, x)
+    run_layer(Deconv2D(n_out=2, kernel=(2, 2), stride=(2, 2), name="d"), it, x)
+
+
+def test_maxpool_values():
+    layer = Subsampling(pooling=PoolingType.MAX, kernel=(2, 2), stride=(2, 2), name="p")
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    y, _, _ = run_layer(layer, InputType.convolutional(4, 4, 1), x)
+    np.testing.assert_array_equal(np.asarray(y)[0, :, :, 0], [[5, 7], [13, 15]])
+
+
+def test_avgpool_values():
+    layer = Subsampling(pooling=PoolingType.AVG, kernel=(2, 2), stride=(2, 2), name="p")
+    x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    y, _, _ = run_layer(layer, InputType.convolutional(4, 4, 1), x)
+    np.testing.assert_allclose(np.asarray(y)[0, :, :, 0], [[2.5, 4.5], [10.5, 12.5]])
+
+
+def test_batchnorm_train_and_infer():
+    layer = BatchNorm(name="bn", decay=0.5)
+    itype = InputType.feed_forward(3)
+    x = np.random.default_rng(0).normal(loc=5.0, scale=2.0, size=(64, 3)).astype(np.float32)
+    params, state = layer.init(KEY, itype)
+    y, new_state = layer.apply(params, state, jnp.asarray(x), training=True, rng=None)
+    # batch-normalized output ~ zero mean unit var
+    np.testing.assert_allclose(np.asarray(y).mean(axis=0), 0.0, atol=1e-4)
+    np.testing.assert_allclose(np.asarray(y).std(axis=0), 1.0, atol=1e-2)
+    # running stats moved toward batch stats
+    assert np.all(np.asarray(new_state["mean"]) != 0.0)
+    # inference path uses running stats, returns same state
+    y2, s2 = layer.apply(params, new_state, jnp.asarray(x), training=False, rng=None)
+    assert s2 is new_state
+
+
+def test_layernorm():
+    layer = LayerNorm(name="ln")
+    x = np.random.default_rng(0).normal(size=(4, 10)).astype(np.float32)
+    y, _, _ = run_layer(layer, InputType.feed_forward(10), x)
+    np.testing.assert_allclose(np.asarray(y).mean(axis=-1), 0.0, atol=1e-5)
+
+
+def test_dropout_train_vs_infer():
+    layer = Dropout(rate=0.5, name="do")
+    x = np.ones((10, 100), np.float32)
+    y_inf, _ = layer.apply({}, {}, jnp.asarray(x), training=False, rng=None)
+    np.testing.assert_array_equal(np.asarray(y_inf), x)
+    y_tr, _ = layer.apply({}, {}, jnp.asarray(x), training=True, rng=jax.random.key(1))
+    arr = np.asarray(y_tr)
+    assert np.any(arr == 0.0)
+    assert abs(arr.mean() - 1.0) < 0.1  # inverted dropout preserves expectation
+
+
+def test_embedding_ff_and_seq():
+    layer = Embedding(n_in=50, n_out=8, name="e")
+    params, _ = layer.init(KEY, InputType.feed_forward(50))
+    ids = jnp.asarray([[1, 2, 3], [4, 5, 6]])
+    y, _ = layer.apply(params, {}, ids, training=False, rng=None)
+    assert y.shape == (2, 3, 8)
+    np.testing.assert_array_equal(np.asarray(y[0, 0]), np.asarray(params["W"])[1])
+
+
+def test_global_pooling_cnn():
+    layer = GlobalPooling(pooling=PoolingType.AVG, name="gp")
+    x = np.random.default_rng(0).normal(size=(2, 4, 4, 5)).astype(np.float32)
+    y, _, _ = run_layer(layer, InputType.convolutional(4, 4, 5), x)
+    np.testing.assert_allclose(np.asarray(y), x.mean(axis=(1, 2)), rtol=1e-5)
+
+
+def test_zeropad_upsample_lrn_activation():
+    it = InputType.convolutional(4, 4, 2)
+    x = np.random.default_rng(0).normal(size=(2, 4, 4, 2)).astype(np.float32)
+    run_layer(ZeroPadding2D(padding=(1, 1, 2, 2), name="zp"), it, x)
+    run_layer(Upsampling2D(size=(2, 2), name="up"), it, x)
+    run_layer(LocalResponseNormalization(name="lrn"), it, x)
+    run_layer(ActivationLayer(activation=Activation.TANH, name="a"), it, x)
+
+
+def test_weight_inits():
+    key = jax.random.key(3)
+    for wi in WeightInit:
+        if wi in (WeightInit.IDENTITY,):
+            w = wi.init(key, (6, 6))
+            np.testing.assert_array_equal(np.asarray(w), np.eye(6))
+            continue
+        w = wi.init(key, (50, 60))
+        assert w.shape == (50, 60)
+        assert np.all(np.isfinite(np.asarray(w)))
+    # he-normal std ~ sqrt(2/fan_in)
+    w = WeightInit.RELU.init(key, (1000, 100))
+    assert abs(np.asarray(w).std() - np.sqrt(2 / 1000)) < 0.005

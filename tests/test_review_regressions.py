@@ -1,0 +1,146 @@
+"""Regression tests for review findings on the initial core."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, NumpyDataSetIterator
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn import Adam
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.updaters import AdamW
+
+
+def test_regression_head_trains_the_served_function():
+    """OutputLayer(activation=TANH, loss=MSE): training must optimize
+    tanh(logits), the same function output() serves."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(256, 3)).astype(np.float32)
+    y = np.tanh(x @ rng.normal(size=(3, 1)).astype(np.float32))
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(5)
+        .updater(Adam(1e-2))
+        .list()
+        .layer(Dense(n_out=16, activation=Activation.TANH))
+        .layer(OutputLayer(n_out=1, loss=Loss.MSE, activation=Activation.TANH))
+        .set_input_type(InputType.feed_forward(3))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    m.fit(NumpyDataSetIterator(x, y, batch_size=64, seed=1), epochs=30)
+    pred = np.asarray(m.output(x))
+    assert np.all(np.abs(pred) <= 1.0)
+    mse = float(np.mean((pred - y) ** 2))
+    assert mse < 0.01, f"served function not optimized, mse={mse}"
+
+
+def test_small_dataset_still_trains():
+    """Dataset smaller than batch_size must not be silently skipped."""
+    x = np.random.default_rng(0).normal(size=(20, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(1).integers(0, 2, 20)]
+    conf = (
+        NeuralNetConfiguration.builder()
+        .updater(Adam(1e-2))
+        .list()
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT))
+        .set_input_type(InputType.feed_forward(2))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    m.fit((x, y), epochs=1)
+    assert m.iteration > 0
+
+
+def test_frozen_layer_immune_to_weight_decay():
+    """AdamW decoupled weight decay must not shrink frozen layers."""
+    x = np.random.default_rng(0).normal(size=(64, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.random.default_rng(1).integers(0, 2, 64)]
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(2)
+        .updater(AdamW(learning_rate=1e-2, weight_decay=0.5))
+        .list()
+        .layer(Dense(n_out=8, frozen=True, activation=Activation.RELU))
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    w0 = np.asarray(m.params["layer0"]["W"]).copy()
+    m.fit(NumpyDataSetIterator(x, y, batch_size=32), epochs=3)
+    np.testing.assert_array_equal(np.asarray(m.params["layer0"]["W"]), w0)
+
+
+def test_duplicate_layer_names_rejected():
+    with pytest.raises(ValueError, match="duplicate layer names"):
+        (
+            NeuralNetConfiguration.builder()
+            .list()
+            .layer(Dense(n_out=4))
+            .layer(Dense(name="layer0", n_out=4))
+            .layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(2))
+            .build()
+        )
+
+
+def test_global_activation_does_not_leak_into_output_layer():
+    """builder.activation(RELU) must not override the OutputLayer's
+    loss-canonical softmax."""
+    x = np.random.default_rng(0).normal(size=(8, 2)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[[0, 1] * 4]
+    conf = (
+        NeuralNetConfiguration.builder()
+        .activation(Activation.RELU)
+        .updater(Adam(1e-3))
+        .list()
+        .layer(Dense(n_out=4))
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT))
+        .set_input_type(InputType.feed_forward(2))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    out = np.asarray(m.output(x))
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+    assert np.all(out > 0)
+
+
+def test_async_iterator_early_exit_no_deadlock():
+    from deeplearning4j_tpu.data import AsyncDataSetIterator
+    import threading
+
+    x = np.zeros((512, 4), np.float32)
+    y = np.zeros((512, 2), np.float32)
+    base = NumpyDataSetIterator(x, y, batch_size=16, shuffle=False)
+    before = threading.active_count()
+    for _ in range(5):
+        it = iter(AsyncDataSetIterator(base, queue_size=1, device_put=False))
+        next(it)
+        it.close()  # early abandonment
+    # producer threads must have exited
+    import time
+
+    deadline = time.time() + 5
+    while threading.active_count() > before and time.time() < deadline:
+        time.sleep(0.05)
+    assert threading.active_count() <= before + 1
+
+
+def test_async_iterator_full_consumption_matches_base():
+    from deeplearning4j_tpu.data import AsyncDataSetIterator
+
+    x = np.arange(64, dtype=np.float32).reshape(16, 4)
+    y = np.zeros((16, 2), np.float32)
+    base = NumpyDataSetIterator(x, y, batch_size=4, shuffle=False)
+    got = [b.features for b in AsyncDataSetIterator(base, device_put=False)]
+    want = [b.features for b in base]
+    assert len(got) == len(want)
+    for g, w in zip(got, want):
+        np.testing.assert_array_equal(np.asarray(g), w)

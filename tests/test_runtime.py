@@ -1,0 +1,42 @@
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.runtime import MeshSpec, SeedStream, make_mesh
+from deeplearning4j_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS
+
+
+def test_virtual_devices_present():
+    assert len(jax.devices()) == 8
+
+
+def test_make_mesh_data_parallel():
+    mesh = make_mesh(MeshSpec.data_parallel())
+    assert mesh.shape[DATA_AXIS] == 8
+
+
+def test_make_mesh_2d():
+    mesh = make_mesh(MeshSpec.of(data=2, model=4))
+    assert mesh.shape[DATA_AXIS] == 2
+    assert mesh.shape[MODEL_AXIS] == 4
+
+
+def test_mesh_wildcard():
+    spec = MeshSpec.of(data=-1, model=2)
+    resolved = dict(spec.resolve(8))
+    assert resolved == {"data": 4, "model": 2}
+
+
+def test_mesh_bad_divisor():
+    with pytest.raises(ValueError):
+        MeshSpec.of(data=3).resolve(8)
+
+
+def test_seed_stream_deterministic():
+    a = SeedStream(7)
+    b = SeedStream(7)
+    ka = jax.random.normal(a.key("layer0"), (4,))
+    kb = jax.random.normal(b.key("layer0"), (4,))
+    np.testing.assert_array_equal(np.asarray(ka), np.asarray(kb))
+    kc = jax.random.normal(a.key("layer1"), (4,))
+    assert not np.allclose(np.asarray(ka), np.asarray(kc))

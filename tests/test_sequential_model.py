@@ -1,0 +1,195 @@
+"""SequentialModel end-to-end: the MultiLayerNetwork-role contract tests."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, NumpyDataSetIterator
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn import Adam, Sgd
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    Subsampling,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.train import CollectScoresListener
+
+
+def two_moons(n=512, seed=0):
+    """Simple separable 2-class problem."""
+    rng = np.random.default_rng(seed)
+    theta = rng.uniform(0, np.pi, n)
+    cls = rng.integers(0, 2, n)
+    x = np.stack(
+        [
+            np.cos(theta) + cls * 1.0 + rng.normal(0, 0.1, n),
+            np.sin(theta) * (1 - 2 * cls) + rng.normal(0, 0.1, n),
+        ],
+        axis=1,
+    ).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[cls]
+    return x, y
+
+
+def mlp_conf(updater=None, seed=12345):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(seed)
+        .updater(updater or Adam(1e-2))
+        .weight_init(WeightInit.XAVIER)
+        .activation(Activation.RELU)
+        .list()
+        .layer(Dense(n_out=32))
+        .layer(Dense(n_out=32))
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.feed_forward(2))
+        .build()
+    )
+
+
+def test_mlp_learns_two_moons():
+    x, y = two_moons()
+    model = SequentialModel(mlp_conf()).init()
+    scores = CollectScoresListener()
+    model.set_listeners(scores)
+    model.fit(NumpyDataSetIterator(x, y, batch_size=64, seed=1), epochs=30)
+    first = scores.scores[0][1]
+    last = scores.scores[-1][1]
+    assert last < first * 0.5, f"loss did not drop: {first} -> {last}"
+    ev = model.evaluate(DataSet(x, y))
+    assert ev.accuracy() > 0.95
+
+
+def test_output_probabilities_sum_to_one():
+    x, y = two_moons(64)
+    model = SequentialModel(mlp_conf()).init()
+    out = np.asarray(model.output(x))
+    np.testing.assert_allclose(out.sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_deterministic_init_and_fit():
+    x, y = two_moons(128)
+    it = lambda: NumpyDataSetIterator(x, y, batch_size=32, seed=5)
+    m1 = SequentialModel(mlp_conf(seed=7)).init()
+    m2 = SequentialModel(mlp_conf(seed=7)).init()
+    for k in m1.params:
+        for p in m1.params[k]:
+            np.testing.assert_array_equal(
+                np.asarray(m1.params[k][p]), np.asarray(m2.params[k][p])
+            )
+    m1.fit(it(), epochs=2)
+    m2.fit(it(), epochs=2)
+    np.testing.assert_allclose(
+        np.asarray(m1.params["layer0"]["W"]),
+        np.asarray(m2.params["layer0"]["W"]),
+        rtol=1e-6,
+    )
+
+
+def test_small_cnn_runs_and_learns():
+    rng = np.random.default_rng(0)
+    # toy images: class 0 bright top half, class 1 bright bottom half
+    n = 256
+    cls = rng.integers(0, 2, n)
+    x = rng.normal(0, 0.3, (n, 8, 8, 1)).astype(np.float32)
+    for i, c in enumerate(cls):
+        if c == 0:
+            x[i, :4] += 1.0
+        else:
+            x[i, 4:] += 1.0
+    y = np.eye(2, dtype=np.float32)[cls]
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(1)
+        .updater(Adam(5e-3))
+        .activation(Activation.RELU)
+        .list()
+        .layer(Conv2D(n_out=4, kernel=(3, 3)))
+        .layer(Subsampling(kernel=(2, 2), stride=(2, 2)))
+        .layer(BatchNorm())
+        .layer(Dense(n_out=16))
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.convolutional(8, 8, 1))
+        .build()
+    )
+    model = SequentialModel(conf).init()
+    model.fit(NumpyDataSetIterator(x, y, batch_size=64, seed=2), epochs=15)
+    assert model.evaluate(DataSet(x, y)).accuracy() > 0.9
+    # BN running stats were updated inside the compiled step
+    assert np.any(np.asarray(model.net_state["layer2"]["mean"]) != 0.0)
+
+
+def test_num_params_and_param_table():
+    model = SequentialModel(mlp_conf()).init()
+    # 2*32+32 + 32*32+32 + 32*2+2 = 96+32+1024+32+64+2
+    assert model.num_params() == (2 * 32 + 32) + (32 * 32 + 32) + (32 * 2 + 2)
+    table = model.param_table()
+    assert "layer0.W" in table and table["layer0.W"].shape == (2, 32)
+
+
+def test_frozen_layer_not_updated():
+    x, y = two_moons(128)
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(3)
+        .updater(Sgd(0.1))
+        .list()
+        .layer(Dense(n_out=8, frozen=True, activation=Activation.RELU))
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.feed_forward(2))
+        .build()
+    )
+    model = SequentialModel(conf).init()
+    w_before = np.asarray(model.params["layer0"]["W"]).copy()
+    model.fit(NumpyDataSetIterator(x, y, batch_size=32), epochs=2)
+    np.testing.assert_array_equal(np.asarray(model.params["layer0"]["W"]), w_before)
+    assert not np.array_equal(
+        np.asarray(model.params["layer1"]["W"]),
+        w_before[: 8, :2] if False else np.asarray(model.params["layer1"]["W"]) * 0,
+    )
+
+
+def test_l2_regularization_shrinks_weights():
+    x, y = two_moons(256)
+    conf_plain = mlp_conf(seed=11)
+    conf_reg = (
+        NeuralNetConfiguration.builder()
+        .seed(11)
+        .updater(Adam(1e-2))
+        .weight_init(WeightInit.XAVIER)
+        .activation(Activation.RELU)
+        .l2(0.5)
+        .list()
+        .layer(Dense(n_out=32))
+        .layer(Dense(n_out=32))
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.feed_forward(2))
+        .build()
+    )
+    m_plain = SequentialModel(conf_plain).init()
+    m_reg = SequentialModel(conf_reg).init()
+    it = lambda: NumpyDataSetIterator(x, y, batch_size=64, seed=4)
+    m_plain.fit(it(), epochs=10)
+    m_reg.fit(it(), epochs=10)
+    norm_plain = np.linalg.norm(np.asarray(m_plain.params["layer0"]["W"]))
+    norm_reg = np.linalg.norm(np.asarray(m_reg.params["layer0"]["W"]))
+    assert norm_reg < norm_plain
+
+
+def test_score_and_masked_loss():
+    x, y = two_moons(64)
+    model = SequentialModel(mlp_conf()).init()
+    s = model.score(DataSet(x, y))
+    assert np.isfinite(s) and s > 0
+    # mask out half the examples
+    mask = np.zeros((64,), np.float32)
+    mask[:32] = 1.0
+    ds = DataSet(x, y, labels_mask=mask)
+    model.fit_batch(ds)  # must not crash; masked mean over 32 examples
+    assert np.isfinite(model.score_value)

@@ -1,0 +1,75 @@
+"""Config JSON round-trip tests (MultiLayerConfiguration.toJson/fromJson role)."""
+
+import numpy as np
+
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn import Adam, Nesterovs
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    BatchNorm,
+    Conv2D,
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    SequentialConfiguration,
+    Subsampling,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.schedules import CosineSchedule, StepSchedule
+from deeplearning4j_tpu.nn.weights import WeightInit
+
+
+def build_conf():
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(99)
+        .updater(Adam(learning_rate=CosineSchedule(initial=1e-3, decay_steps=500)))
+        .weight_init(WeightInit.RELU)
+        .activation(Activation.RELU)
+        .l2(1e-4)
+        .list()
+        .layer(Conv2D(n_out=8, kernel=(3, 3), padding="same"))
+        .layer(Subsampling(kernel=(2, 2), stride=(2, 2)))
+        .layer(BatchNorm())
+        .layer(Dense(n_out=16))
+        .layer(OutputLayer(n_out=4, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.convolutional(8, 8, 3))
+        .build()
+    )
+
+
+def test_json_round_trip_equality():
+    conf = build_conf()
+    s = conf.to_json()
+    conf2 = SequentialConfiguration.from_json(s)
+    assert conf == conf2  # frozen dataclasses: structural equality
+    assert conf2.to_json() == s
+
+
+def test_round_tripped_conf_builds_identical_model():
+    conf = build_conf()
+    conf2 = SequentialConfiguration.from_json(conf.to_json())
+    m1 = SequentialModel(conf).init()
+    m2 = SequentialModel(conf2).init()
+    for lname in m1.params:
+        for pname in m1.params[lname]:
+            np.testing.assert_array_equal(
+                np.asarray(m1.params[lname][pname]), np.asarray(m2.params[lname][pname])
+            )
+
+
+def test_schedule_serde():
+    from deeplearning4j_tpu.utils import serde
+
+    s = StepSchedule(initial=0.1, decay_rate=0.5, step=100)
+    rt = serde.loads(serde.dumps(s))
+    assert rt == s
+
+
+def test_updater_serde_with_float_lr():
+    from deeplearning4j_tpu.utils import serde
+
+    u = Nesterovs(learning_rate=0.05, momentum=0.8)
+    rt = serde.loads(serde.dumps(u))
+    assert rt == u
