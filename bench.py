@@ -1,0 +1,74 @@
+#!/usr/bin/env python
+"""Benchmark harness — prints ONE JSON line with the headline metric.
+
+Round-1 flagship benchmark: LeNet MNIST `fit()` samples/sec on one TPU chip
+(BASELINE config 1).  Protocol follows BASELINE.md: warm up past XLA compile,
+then report steady-state samples/sec over >=200 iterations via
+PerformanceListener — the same instrument the reference uses.
+
+vs_baseline: BASELINE.json carries no published reference numbers
+(`published: {}` — see BASELINE.md provenance).  We normalize against a
+DOCUMENTED ASSUMPTION of the reference's capability: DL4J nd4j-native CPU
+LeNet/MNIST training throughput is on the order of 5,000 samples/sec
+(multi-core CPU, batch 128 — the order of magnitude the dl4j-examples
+benchmark discussions report).  vs_baseline = ours / 5000.
+"""
+
+import json
+import sys
+import time
+
+ASSUMED_BASELINE_SAMPLES_PER_SEC = 5000.0
+
+
+def main() -> None:
+    import numpy as np
+
+    from deeplearning4j_tpu.data.builtin import MnistDataSetIterator
+    from deeplearning4j_tpu.train import PerformanceListener
+    from deeplearning4j_tpu.zoo.lenet import LeNet
+
+    batch = 512
+    train = MnistDataSetIterator(batch_size=batch, train=True, num_examples=30000)
+    model = LeNet().init_model()
+
+    perf = PerformanceListener(frequency=10**9, warmup_iterations=10)
+    model.set_listeners(perf)
+
+    # warmup + steady state: enough epochs for >=210 iterations
+    iters_per_epoch = train.num_examples // batch
+    epochs = max(1, (210 + iters_per_epoch - 1) // iters_per_epoch)
+    t0 = time.time()
+    model.fit(train, epochs=epochs)
+    wall = time.time() - t0
+
+    value = perf.samples_per_sec()
+    test = MnistDataSetIterator(batch_size=1000, train=False, num_examples=5000)
+    acc = None
+    try:
+        ev = model.evaluate(test)
+        acc = round(ev.accuracy(), 4)
+    except Exception:
+        pass
+
+    print(
+        json.dumps(
+            {
+                "metric": "LeNet MNIST fit() samples/sec (1 TPU chip, batch 512, steady-state)",
+                "value": round(value, 1),
+                "unit": "samples/sec",
+                "vs_baseline": round(value / ASSUMED_BASELINE_SAMPLES_PER_SEC, 3),
+                "extra": {
+                    "wall_s": round(wall, 1),
+                    "iterations": model.iteration,
+                    "final_accuracy": acc,
+                    "synthetic_data": train.is_synthetic,
+                    "baseline_assumption": "DL4J nd4j-native CPU ~5000 samples/sec (unpublished; BASELINE.json published={})",
+                },
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    sys.exit(main())

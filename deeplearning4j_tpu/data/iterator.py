@@ -47,6 +47,11 @@ class NumpyDataSetIterator(DataSetIterator):
     ):
         if len(features) == 0:
             raise ValueError("empty dataset")
+        if len(features) != len(labels):
+            raise ValueError(
+                f"features ({len(features)}) and labels ({len(labels)}) "
+                "have different numbers of examples"
+            )
         self._data = DataSet(np.asarray(features), np.asarray(labels))
         self._batch = int(batch_size)
         self._shuffle = shuffle

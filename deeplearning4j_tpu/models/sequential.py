@@ -60,7 +60,7 @@ class SequentialModel(Model):
         super().__init__()
         self.conf = conf
         self._itypes = conf.layer_input_types()
-        self._flatten_before = self._compute_flatten_flags()
+        self._flatten_before = conf.flatten_flags()
         self._loss, self._out_activation, self._fused_loss = self._resolve_output()
         self._bf16 = (
             conf.bf16_compute if conf.bf16_compute is not None else backend().is_tpu
@@ -76,20 +76,6 @@ class SequentialModel(Model):
         self._infer_fn = None
 
     # -- construction ------------------------------------------------------
-    def _compute_flatten_flags(self) -> list[bool]:
-        flags = []
-        cur = self.conf.input_type
-        for layer in self.conf.layers:
-            flat = layer.EXPECTS == "ff" and cur.kind in (
-                InputType.KIND_CNN,
-                InputType.KIND_CNN3D,
-            )
-            flags.append(flat)
-            if flat:
-                cur = InputType.feed_forward(cur.flat_size)
-            cur = layer.output_type(cur)
-        return flags
-
     def _resolve_output(self) -> tuple[Loss, Activation, bool]:
         """Returns (loss, output_activation, fused).
 

@@ -13,6 +13,7 @@ from deeplearning4j_tpu.nn.updaters import (
     AdaGrad,
     AdaMax,
     Adam,
+    AdamW,
     AmsGrad,
     Nadam,
     Nesterovs,

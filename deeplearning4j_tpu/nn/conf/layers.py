@@ -368,7 +368,8 @@ class Deconv2D(LayerConfig):
         if self.padding == "same":
             oh, ow = h * sh, w * sw
         else:
-            oh, ow = (h - 1) * sh + kh, (w - 1) * sw + kw
+            # matches lax.conv_transpose VALID: h*s + max(k-s, 0)
+            oh, ow = h * sh + max(kh - sh, 0), w * sw + max(kw - sw, 0)
         return InputType.convolutional(oh, ow, self.n_out)
 
     def init(self, key, itype):

@@ -48,23 +48,35 @@ class SequentialConfiguration:
             raise TypeError(f"JSON did not decode to SequentialConfiguration: {type(cfg)}")
         return cfg
 
-    def layer_input_types(self) -> list[InputType]:
-        """Input type seen by each layer, walking output_type down the stack.
-
-        Handles the implicit CNN->FF flatten (InputPreProcessor role): when a
-        layer EXPECTS 'ff' but the incoming type is CNN, the model flattens —
-        reflected here by collapsing the type.
-        """
+    def _walk_types(self) -> tuple[list[InputType], list[bool]]:
+        """Single source of truth for the type walk down the stack,
+        including the implicit CNN->FF flatten (InputPreProcessor role):
+        when a layer EXPECTS 'ff' but the incoming type is CNN, a reshape
+        is inserted; flags[i] records it so the model applies the SAME rule
+        at trace time."""
         if self.input_type is None:
             raise ValueError("configuration has no input_type; call set_input_type")
-        itypes = []
+        itypes, flags = [], []
         cur = self.input_type
         for layer in self.layers:
-            if layer.EXPECTS == "ff" and cur.kind in (InputType.KIND_CNN, InputType.KIND_CNN3D):
+            flat = layer.EXPECTS == "ff" and cur.kind in (
+                InputType.KIND_CNN,
+                InputType.KIND_CNN3D,
+            )
+            if flat:
                 cur = InputType.feed_forward(cur.flat_size)
+            flags.append(flat)
             itypes.append(cur)
             cur = layer.output_type(cur)
-        return itypes
+        return itypes, flags
+
+    def layer_input_types(self) -> list[InputType]:
+        """Input type seen by each layer (post-flatten where applicable)."""
+        return self._walk_types()[0]
+
+    def flatten_flags(self) -> list[bool]:
+        """Whether an implicit flatten precedes each layer."""
+        return self._walk_types()[1]
 
     def output_type(self) -> InputType:
         itypes = self.layer_input_types()

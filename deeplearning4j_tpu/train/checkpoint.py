@@ -94,8 +94,13 @@ class ModelSerializer:
 
                 model = SequentialModel(conf).init()
             elif model_class == "GraphModel":
-                from deeplearning4j_tpu.models.computation_graph import GraphModel
-
+                try:
+                    from deeplearning4j_tpu.models.computation_graph import GraphModel
+                except ImportError as e:
+                    raise ValueError(
+                        f"checkpoint needs model class {model_class!r}, "
+                        f"unavailable in this build: {e}"
+                    ) from e
                 model = GraphModel(conf).init()
             else:
                 raise ValueError(f"unknown model class in checkpoint: {model_class}")

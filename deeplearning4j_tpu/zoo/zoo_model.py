@@ -1,0 +1,49 @@
+"""ZooModel SPI — the `org.deeplearning4j.zoo.ZooModel` role.
+
+Each zoo entry builds a ready-to-train model config for a named
+architecture.  The reference's initPretrained() downloads checked-summed
+weights; with no network, pretrained loading resolves from a local
+directory ($DL4J_TPU_PRETRAINED_DIR) of ModelSerializer zips instead.
+"""
+
+from __future__ import annotations
+
+import os
+from pathlib import Path
+
+
+class ZooModel:
+    """Subclasses define conf() and NAME."""
+
+    NAME = "zoo"
+
+    def __init__(self, num_classes: int = 10, seed: int = 123):
+        self.num_classes = num_classes
+        self.seed = seed
+
+    def conf(self):
+        raise NotImplementedError
+
+    def init_model(self):
+        """Build + init a fresh randomly-initialized model (ZooModel.init())."""
+        conf = self.conf()
+        if type(conf).__name__ == "GraphConfiguration":
+            from deeplearning4j_tpu.models.computation_graph import GraphModel
+
+            return GraphModel(conf).init()
+        from deeplearning4j_tpu.models.sequential import SequentialModel
+
+        return SequentialModel(conf).init()
+
+    def init_pretrained(self):
+        """Load pretrained weights from the local pretrained directory."""
+        root = Path(os.environ.get("DL4J_TPU_PRETRAINED_DIR", "~/.dl4j_tpu/models")).expanduser()
+        path = root / f"{self.NAME}.zip"
+        if not path.exists():
+            raise FileNotFoundError(
+                f"no pretrained weights for {self.NAME} at {path} "
+                "(no-network environment: place ModelSerializer zips there)"
+            )
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+        return ModelSerializer.restore(str(path))

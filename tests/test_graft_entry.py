@@ -1,0 +1,19 @@
+"""The driver-facing entry points must stay green."""
+
+import jax
+import numpy as np
+
+
+def test_entry_compiles_single_device():
+    import __graft_entry__ as ge
+
+    fn, args = ge.entry()
+    out = jax.jit(fn)(*args)
+    assert out.shape == (8, 10)
+    assert np.all(np.isfinite(np.asarray(out, dtype=np.float32)))
+
+
+def test_dryrun_multichip_8():
+    import __graft_entry__ as ge
+
+    ge.dryrun_multichip(8)
