@@ -1,4 +1,5 @@
 from deeplearning4j_tpu.models.model import Model
 from deeplearning4j_tpu.models.sequential import SequentialModel
+from deeplearning4j_tpu.models.computation_graph import GraphModel
 
-__all__ = ["Model", "SequentialModel"]
+__all__ = ["Model", "SequentialModel", "GraphModel"]

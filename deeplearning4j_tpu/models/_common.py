@@ -1,0 +1,80 @@
+"""Shared training-step machinery for SequentialModel and GraphModel."""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.losses import FUSED_ACTIVATION_LOSSES, Loss
+
+CANONICAL_ACTIVATION = {
+    Loss.MCXENT: Activation.SOFTMAX,
+    Loss.NEGATIVELOGLIKELIHOOD: Activation.SOFTMAX,
+    Loss.SPARSE_MCXENT: Activation.SOFTMAX,
+    Loss.XENT: Activation.SIGMOID,
+}
+
+
+def resolve_output_spec(layer) -> tuple[Loss, Activation, bool]:
+    """(loss, output_activation, fused) for an Output/Loss layer.
+
+    fused=True: the training loss runs on logits via the numerically-stable
+    fused softmax/sigmoid path, because the declared activation IS the
+    loss's canonical one.  fused=False: the activation is applied before
+    the loss so training optimizes exactly the function output() serves.
+    """
+    loss = layer.loss
+    canonical = CANONICAL_ACTIVATION.get(loss, Activation.IDENTITY)
+    act = layer.activation if layer.activation is not None else canonical
+    fused = loss in FUSED_ACTIVATION_LOSSES and act == canonical
+    return loss, act, fused
+
+
+def mask_frozen_tx(tx, frozen_names: set[str]):
+    """Route frozen layers around the ENTIRE optimizer transform — a frozen
+    layer must not even be touched by decoupled weight decay."""
+    if not frozen_names:
+        return tx
+
+    def trainable_mask(params):
+        return {
+            name: jax.tree.map(lambda _: name not in frozen_names, sub)
+            for name, sub in params.items()
+        }
+
+    def frozen_mask(params):
+        return {
+            name: jax.tree.map(lambda _: name in frozen_names, sub)
+            for name, sub in params.items()
+        }
+
+    return optax.chain(
+        optax.masked(tx, trainable_mask),
+        optax.masked(optax.set_to_zero(), frozen_mask),
+    )
+
+
+def regularization_loss(params, named_layers) -> jax.Array:
+    """Sum of per-layer l1*|W| + 0.5*l2*W^2 penalties over REGULARIZED params.
+
+    named_layers: iterable of (name, LayerConfig).
+    """
+    reg = jnp.zeros((), jnp.float32)
+    for name, layer in named_layers:
+        lp = params.get(name)
+        if not lp:
+            continue
+        l1 = layer.l1 or 0.0
+        l2 = layer.l2 or 0.0
+        if l1 == 0.0 and l2 == 0.0:
+            continue
+        for pname in layer.REGULARIZED:
+            if pname in lp:
+                w = lp[pname].astype(jnp.float32)
+                if l1:
+                    reg = reg + l1 * jnp.sum(jnp.abs(w))
+                if l2:
+                    reg = reg + 0.5 * l2 * jnp.sum(w * w)
+    return reg

@@ -1,0 +1,321 @@
+"""GraphModel — the ComputationGraph role, compiled whole-step.
+
+The reference walks GraphVertex[] in topological order per minibatch with
+per-vertex workspaces (SURVEY.md §3.2).  Here the topological walk happens
+once at TRACE time; the training iteration over the whole DAG — all
+branches, merges, skip connections, multiple outputs — is one compiled XLA
+computation with donated buffers, exactly like SequentialModel.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.models.model import Model
+from deeplearning4j_tpu.models._common import (
+    mask_frozen_tx,
+    regularization_loss,
+    resolve_output_spec,
+)
+from deeplearning4j_tpu.nn.conf.graph_conf import GraphConfiguration
+from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
+from deeplearning4j_tpu.nn.losses import compute as compute_loss
+from deeplearning4j_tpu.nn.updaters import with_gradient_clipping
+from deeplearning4j_tpu.runtime.backend import backend
+from deeplearning4j_tpu.runtime.rng import SeedStream
+
+
+class GraphModel(Model):
+    def __init__(self, conf: GraphConfiguration):
+        super().__init__()
+        self.conf = conf
+        self._topo = conf.topological_order()
+        self._types, self._flatten = conf.infer_types()
+        self._out_specs = self._resolve_outputs()
+        self._bf16 = (
+            conf.bf16_compute if conf.bf16_compute is not None else backend().is_tpu
+        )
+        self._tx = with_gradient_clipping(
+            conf.updater.to_optax(conf.steps_per_epoch),
+            conf.gradient_clip_value,
+            conf.gradient_clip_norm,
+        )
+        self._tx = self._mask_frozen(self._tx)
+        self._stream = SeedStream(conf.seed)
+        self._step_fns: dict[Any, Any] = {}
+        self._infer_fn = None
+
+    # -- construction ------------------------------------------------------
+    def _resolve_outputs(self):
+        """(loss, activation, fused) per network output, in declared order."""
+        by_name = {n.name: n for n in self.conf.nodes}
+        specs = []
+        for out in self.conf.network_outputs:
+            layer = by_name[out].layer
+            if not isinstance(layer, (OutputLayer, LossLayer)):
+                raise ValueError(
+                    f"network output {out!r} must be an OutputLayer/LossLayer"
+                )
+            specs.append(resolve_output_spec(layer))
+        return specs
+
+    def _mask_frozen(self, tx):
+        return mask_frozen_tx(
+            tx,
+            {n.name for n in self.conf.nodes if n.layer is not None and n.layer.frozen},
+        )
+
+    def _layer_itype(self, node):
+        """Post-flatten input type for a layer node, from the cached walk."""
+        t = self._types[node.inputs[0]]
+        if self._flatten[node.name]:
+            from deeplearning4j_tpu.nn.conf.input_type import InputType
+
+            t = InputType.feed_forward(t.flat_size)
+        return t
+
+    def init(self) -> "GraphModel":
+        params, state = {}, {}
+        for node in self._topo:
+            if node.layer is None:
+                continue
+            itype = self._layer_itype(node)
+            p, s = node.layer.init(self._stream.key(f"init/{node.name}"), itype)
+            if p:
+                params[node.name] = p
+            if s:
+                state[node.name] = s
+        self.params = params
+        self.net_state = state
+        self.opt_state = self._tx.init(params)
+        return self
+
+    # -- pure forward ------------------------------------------------------
+    def _forward(self, params, net_state, inputs: dict, *, training: bool, rng):
+        """inputs: {input_name: array}. Returns ({output_name: logits}, new_state)."""
+        acts: dict[str, jax.Array] = {}
+        for name, x in inputs.items():
+            if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(jnp.bfloat16)
+            acts[name] = x
+        new_state = {}
+        for i, node in enumerate(self._topo):
+            xs = [acts[n] for n in node.inputs]
+            if node.layer is not None:
+                x = xs[0]
+                if self._flatten[node.name]:
+                    x = x.reshape(x.shape[0], -1)
+                lp = params.get(node.name, {})
+                ls = net_state.get(node.name, {})
+                lrng = jax.random.fold_in(rng, i) if rng is not None else None
+                y, ns = node.layer.apply(lp, ls, x, training=training, rng=lrng)
+                if ns:
+                    new_state[node.name] = ns
+            else:
+                y = node.vertex.apply(xs)
+            acts[node.name] = y
+        return {o: acts[o] for o in self.conf.network_outputs}, new_state
+
+    def _reg_loss(self, params):
+        return regularization_loss(
+            params,
+            [(n.name, n.layer) for n in self.conf.nodes if n.layer is not None],
+        )
+
+    # -- compiled train step ----------------------------------------------
+    def _get_step_fn(self, n_masks: int):
+        key = ("train", n_masks)
+        if key not in self._step_fns:
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def step(params, opt_state, net_state, step_i, features, labels, lmasks):
+                rng = SeedStream.fold(self._stream.root, step_i)
+                inputs = dict(zip(self.conf.network_inputs, features))
+
+                def loss_fn(p):
+                    outs, new_state = self._forward(
+                        p, net_state, inputs, training=True, rng=rng
+                    )
+                    total = jnp.zeros((), jnp.float32)
+                    for (loss, act, fused), oname, lab, m in zip(
+                        self._out_specs,
+                        self.conf.network_outputs,
+                        labels,
+                        lmasks if n_masks else [None] * len(labels),
+                    ):
+                        out = outs[oname]
+                        if not fused:
+                            out = act(out.astype(jnp.float32))
+                        total = total + compute_loss(loss, out, lab, m, from_logits=fused)
+                    return total + self._reg_loss(p), new_state
+
+                (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+                    params
+                )
+                updates, opt_state = self._tx.update(grads, opt_state, params)
+                params = jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
+                merged_state = {**net_state, **new_state}
+                return params, opt_state, merged_state, loss
+
+            self._step_fns[key] = step
+        return self._step_fns[key]
+
+    # -- data plumbing -----------------------------------------------------
+    @staticmethod
+    def _as_mds(batch) -> MultiDataSet:
+        if isinstance(batch, MultiDataSet):
+            return batch
+        if isinstance(batch, DataSet):
+            return MultiDataSet.from_dataset(batch)
+        raise TypeError(f"cannot interpret {type(batch)} as a graph batch")
+
+    @staticmethod
+    def _as_batches(data, batch_size: int | None = None):
+        """Normalize fit/evaluate input to an iterable of batches, accepting
+        the same forms as SequentialModel ((x, y) tuple, DataSet,
+        MultiDataSet, or any iterator of those)."""
+        if isinstance(data, (DataSet, MultiDataSet)):
+            return [data]
+        if (
+            isinstance(data, tuple)
+            and len(data) == 2
+            and all(isinstance(a, np.ndarray) for a in data)
+        ):
+            from deeplearning4j_tpu.data.iterator import NumpyDataSetIterator
+
+            return NumpyDataSetIterator(data[0], data[1], batch_size or 32)
+        if hasattr(data, "__iter__"):
+            return data
+        raise TypeError(f"cannot interpret {type(data)} as graph training data")
+
+    def fit(self, data, epochs: int = 1, batch_size: int | None = None) -> None:
+        if self.params is None:
+            self.init()
+        iterator = self._as_batches(data, batch_size)
+        for _ in range(epochs):
+            for lst in self.listeners:
+                lst.on_epoch_start(self, self.epoch)
+            for batch in iterator:
+                self.fit_batch(batch)
+            for lst in self.listeners:
+                lst.on_epoch_end(self, self.epoch)
+            self.epoch += 1
+            if hasattr(iterator, "reset"):
+                iterator.reset()
+
+    def fit_batch(self, batch) -> None:
+        if self.params is None:
+            self.init()
+        mds = self._as_mds(batch)
+        if len(mds.features) != len(self.conf.network_inputs):
+            raise ValueError(
+                f"graph has {len(self.conf.network_inputs)} inputs, batch has "
+                f"{len(mds.features)} feature arrays"
+            )
+        if len(mds.labels) != len(self.conf.network_outputs):
+            raise ValueError(
+                f"graph has {len(self.conf.network_outputs)} outputs, batch has "
+                f"{len(mds.labels)} label arrays"
+            )
+        masks = mds.labels_masks
+        if masks is not None and len(masks) != len(mds.labels):
+            raise ValueError(
+                f"labels_masks has {len(masks)} entries for {len(mds.labels)} "
+                "outputs (one mask per output, use None entries for unmasked)"
+            )
+        n_masks = len(masks) if masks is not None else 0
+        step = self._get_step_fn(n_masks)
+        self.params, self.opt_state, self.net_state, loss = step(
+            self.params,
+            self.opt_state,
+            self.net_state,
+            jnp.uint32(self.iteration),
+            tuple(mds.features),
+            tuple(mds.labels),
+            tuple(masks) if masks is not None else (),
+        )
+        self._last_score = loss
+        self.last_batch_size = mds.num_examples
+        self.iteration += 1
+        self._dispatch_iteration(loss)
+
+    # -- inference ---------------------------------------------------------
+    def _get_infer_fn(self):
+        if self._infer_fn is None:
+
+            @jax.jit
+            def infer(params, net_state, features):
+                inputs = dict(zip(self.conf.network_inputs, features))
+                outs, _ = self._forward(params, net_state, inputs, training=False, rng=None)
+                result = []
+                for (loss, act, fused), oname in zip(
+                    self._out_specs, self.conf.network_outputs
+                ):
+                    result.append(act(outs[oname].astype(jnp.float32)))
+                return tuple(result)
+
+            self._infer_fn = infer
+        return self._infer_fn
+
+    def output(self, *features) -> tuple[jax.Array, ...]:
+        """Activated outputs for the given inputs (one array per network
+        output; pass one array per network input)."""
+        if self.params is None:
+            self.init()
+        if len(features) != len(self.conf.network_inputs):
+            raise ValueError(
+                f"graph has {len(self.conf.network_inputs)} inputs "
+                f"{self.conf.network_inputs}, got {len(features)} arrays"
+            )
+        outs = self._get_infer_fn()(self.params, self.net_state, tuple(features))
+        return outs if len(outs) > 1 else outs[0]
+
+    def predict(self, *features) -> np.ndarray:
+        out = self.output(*features)
+        first = out[0] if isinstance(out, tuple) else out
+        return np.asarray(jnp.argmax(first, axis=-1))
+
+    def evaluate(self, data, output_index: int = 0):
+        from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+
+        iterator = self._as_batches(data)
+        ev = Evaluation()
+        for batch in iterator:
+            mds = self._as_mds(batch)
+            out = self.output(*mds.features)
+            arr = out[output_index] if isinstance(out, tuple) else out
+            mask = None
+            if mds.labels_masks is not None:
+                mask = mds.labels_masks[output_index]
+            ev.eval(mds.labels[output_index], np.asarray(arr), mask=mask)
+        return ev
+
+    def score(self, batch) -> float:
+        mds = self._as_mds(batch)
+        inputs = dict(zip(self.conf.network_inputs, [jnp.asarray(f) for f in mds.features]))
+        outs, _ = self._forward(self.params, self.net_state, inputs, training=False, rng=None)
+        masks = mds.labels_masks or (None,) * len(mds.labels)
+        total = jnp.zeros((), jnp.float32)
+        for (loss, act, fused), oname, lab, m in zip(
+            self._out_specs, self.conf.network_outputs, mds.labels, masks
+        ):
+            out = outs[oname]
+            if not fused:
+                out = act(out.astype(jnp.float32))
+            total = total + compute_loss(loss, out, jnp.asarray(lab), m, from_logits=fused)
+
+        return float(total + self._reg_loss(self.params))
+
+    def clone(self) -> "GraphModel":
+        m = GraphModel(self.conf)
+        if self.params is not None:
+            m.params = jax.tree.map(jnp.copy, self.params)
+            m.net_state = jax.tree.map(jnp.copy, self.net_state)
+            m.opt_state = jax.tree.map(jnp.copy, self.opt_state)
+        return m

@@ -27,14 +27,14 @@ from deeplearning4j_tpu.models.model import Model
 from deeplearning4j_tpu.nn.activations import Activation
 from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.conf.neural_net_configuration import SequentialConfiguration
-from deeplearning4j_tpu.nn.losses import (
-    FUSED_ACTIVATION_LOSSES,
-    Loss,
-    compute as compute_loss,
-)
+from deeplearning4j_tpu.nn.losses import Loss, compute as compute_loss
 from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
 from deeplearning4j_tpu.nn.updaters import with_gradient_clipping
-import optax
+from deeplearning4j_tpu.models._common import (
+    mask_frozen_tx,
+    regularization_loss,
+    resolve_output_spec,
+)
 from deeplearning4j_tpu.runtime.backend import backend
 from deeplearning4j_tpu.runtime.rng import SeedStream
 
@@ -77,54 +77,15 @@ class SequentialModel(Model):
 
     # -- construction ------------------------------------------------------
     def _resolve_output(self) -> tuple[Loss, Activation, bool]:
-        """Returns (loss, output_activation, fused).
-
-        fused=True: training computes the loss directly on logits (stable
-        fused softmax/sigmoid path) because the declared activation IS the
-        loss's canonical activation.  fused=False: the declared activation
-        is applied before the loss, so training and output() see the same
-        function (non-fused losses, or a non-canonical activation).
-        """
         last = self.conf.layers[-1]
-        if isinstance(last, (OutputLayer, LossLayer)):
-            loss = last.loss
-        else:
+        if not isinstance(last, (OutputLayer, LossLayer)):
             raise ValueError(
                 "last layer must be an OutputLayer or LossLayer declaring the loss"
             )
-        canonical = {
-            Loss.MCXENT: Activation.SOFTMAX,
-            Loss.NEGATIVELOGLIKELIHOOD: Activation.SOFTMAX,
-            Loss.SPARSE_MCXENT: Activation.SOFTMAX,
-            Loss.XENT: Activation.SIGMOID,
-        }.get(loss, Activation.IDENTITY)
-        act = last.activation if last.activation is not None else canonical
-        fused = loss in FUSED_ACTIVATION_LOSSES and act == canonical
-        return loss, act, fused
+        return resolve_output_spec(last)
 
     def _mask_frozen(self, tx):
-        """Route frozen layers around the ENTIRE transformation (a frozen
-        layer must not even be touched by decoupled weight decay)."""
-        frozen_names = {l.name for l in self.conf.layers if l.frozen}
-        if not frozen_names:
-            return tx
-
-        def trainable_mask(params):
-            return {
-                name: jax.tree.map(lambda _: name not in frozen_names, sub)
-                for name, sub in params.items()
-            }
-
-        def frozen_mask(params):
-            return {
-                name: jax.tree.map(lambda _: name in frozen_names, sub)
-                for name, sub in params.items()
-            }
-
-        return optax.chain(
-            optax.masked(tx, trainable_mask),
-            optax.masked(optax.set_to_zero(), frozen_mask),
-        )
+        return mask_frozen_tx(tx, {l.name for l in self.conf.layers if l.frozen})
 
     def init(self) -> "SequentialModel":
         params, state = {}, {}
@@ -156,23 +117,7 @@ class SequentialModel(Model):
         return x, new_state
 
     def _reg_loss(self, params):
-        reg = jnp.zeros((), jnp.float32)
-        for layer in self.conf.layers:
-            lp = params.get(layer.name)
-            if not lp:
-                continue
-            l1 = layer.l1 or 0.0
-            l2 = layer.l2 or 0.0
-            if l1 == 0.0 and l2 == 0.0:
-                continue
-            for pname in layer.REGULARIZED:
-                if pname in lp:
-                    w = lp[pname].astype(jnp.float32)
-                    if l1:
-                        reg = reg + l1 * jnp.sum(jnp.abs(w))
-                    if l2:
-                        reg = reg + 0.5 * l2 * jnp.sum(w * w)
-        return reg
+        return regularization_loss(params, [(l.name, l) for l in self.conf.layers])
 
     # -- compiled train step ----------------------------------------------
     def _get_step_fn(self, has_lmask: bool):

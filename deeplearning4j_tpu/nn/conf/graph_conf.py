@@ -1,0 +1,393 @@
+"""Graph (DAG) configuration — the `ComputationGraphConfiguration` role.
+
+The reference builds DAGs of GraphVertex (LayerVertex wrapping a Layer;
+MergeVertex concat; ElementWiseVertex add/... — ResNet skip connections are
+ElementWiseVertex(Op.Add); SURVEY.md §3.2) with a GraphBuilder DSL.  Same
+capability here: named vertices, multi-input/multi-output, topological-order
+walk computed once at build, JSON round-trip.  At runtime the whole DAG is
+traced into one XLA computation — topology costs nothing per step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Optional
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers import LayerConfig
+from deeplearning4j_tpu.nn.updaters import Sgd, Updater
+from deeplearning4j_tpu.utils import serde
+
+
+class ElementWiseOp(str, enum.Enum):
+    ADD = "add"
+    SUBTRACT = "subtract"
+    PRODUCT = "product"
+    AVERAGE = "average"
+    MAX = "max"
+
+
+@dataclasses.dataclass(frozen=True)
+class VertexConfig:
+    """Base graph vertex: pure function of its input tensors."""
+
+    def output_type(self, itypes: list[InputType]) -> InputType:
+        raise NotImplementedError
+
+    def apply(self, xs: list, **kwargs):
+        raise NotImplementedError
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class MergeVertex(VertexConfig):
+    """Concatenate along the feature (last) axis."""
+
+    def output_type(self, itypes):
+        first = itypes[0]
+        if first.kind == InputType.KIND_FF:
+            return InputType.feed_forward(sum(t.size for t in itypes))
+        if first.kind == InputType.KIND_CNN:
+            h, w, _ = first.shape
+            for t in itypes[1:]:
+                if t.shape[:2] != (h, w):
+                    raise ValueError(f"MergeVertex spatial mismatch: {itypes}")
+            return InputType.convolutional(h, w, sum(t.channels for t in itypes))
+        if first.kind == InputType.KIND_RNN:
+            return InputType.recurrent(sum(t.size for t in itypes), first.shape[0])
+        raise ValueError(f"MergeVertex: unsupported {first}")
+
+    def apply(self, xs, **kwargs):
+        return jnp.concatenate(xs, axis=-1)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class ElementWiseVertex(VertexConfig):
+    op: ElementWiseOp = ElementWiseOp.ADD
+
+    def output_type(self, itypes):
+        first = itypes[0]
+        for t in itypes[1:]:
+            if t.shape != first.shape:
+                raise ValueError(f"ElementWiseVertex shape mismatch: {itypes}")
+        return first
+
+    def apply(self, xs, **kwargs):
+        out = xs[0]
+        for x in xs[1:]:
+            if self.op is ElementWiseOp.ADD:
+                out = out + x
+            elif self.op is ElementWiseOp.SUBTRACT:
+                out = out - x
+            elif self.op is ElementWiseOp.PRODUCT:
+                out = out * x
+            elif self.op is ElementWiseOp.MAX:
+                out = jnp.maximum(out, x)
+            elif self.op is ElementWiseOp.AVERAGE:
+                out = out + x
+            else:
+                raise ValueError(f"unhandled {self.op}")
+        if self.op is ElementWiseOp.AVERAGE:
+            out = out / len(xs)
+        return out
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class SubsetVertex(VertexConfig):
+    """Feature-range slice [frm, to] inclusive (reference SubsetVertex)."""
+
+    frm: int = 0
+    to: int = 0
+
+    def output_type(self, itypes):
+        t = itypes[0]
+        n = self.to - self.frm + 1
+        if t.kind == InputType.KIND_FF:
+            return InputType.feed_forward(n)
+        if t.kind == InputType.KIND_RNN:
+            return InputType.recurrent(n, t.shape[0])
+        if t.kind == InputType.KIND_CNN:
+            return InputType.convolutional(t.shape[0], t.shape[1], n)
+        raise ValueError(f"SubsetVertex: unsupported {t}")
+
+    def apply(self, xs, **kwargs):
+        return xs[0][..., self.frm : self.to + 1]
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class ScaleVertex(VertexConfig):
+    scale: float = 1.0
+
+    def output_type(self, itypes):
+        return itypes[0]
+
+    def apply(self, xs, **kwargs):
+        return xs[0] * jnp.asarray(self.scale, xs[0].dtype)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class L2NormalizeVertex(VertexConfig):
+    epsilon: float = 1e-8
+
+    def output_type(self, itypes):
+        return itypes[0]
+
+    def apply(self, xs, **kwargs):
+        x = xs[0]
+        n = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True))
+        return (x / jnp.maximum(n, self.epsilon).astype(x.dtype)).astype(x.dtype)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class GraphNode:
+    """A named node: either a layer or a structural vertex, plus its inputs."""
+
+    name: str = ""
+    inputs: tuple[str, ...] = ()
+    layer: Optional[LayerConfig] = None
+    vertex: Optional[VertexConfig] = None
+
+    def __post_init__(self):
+        if (self.layer is None) == (self.vertex is None):
+            raise ValueError(f"node {self.name}: exactly one of layer/vertex required")
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class GraphConfiguration:
+    """Resolved DAG config (ComputationGraphConfiguration role)."""
+
+    nodes: tuple[GraphNode, ...] = ()
+    network_inputs: tuple[str, ...] = ()
+    network_outputs: tuple[str, ...] = ()
+    input_types: tuple[InputType, ...] = ()
+    updater: Updater = dataclasses.field(default_factory=Sgd)
+    seed: int = 0
+    gradient_clip_value: Optional[float] = None
+    gradient_clip_norm: Optional[float] = None
+    bf16_compute: Optional[bool] = None
+    steps_per_epoch: int = 1
+
+    def to_json(self) -> str:
+        return serde.dumps(self)
+
+    @staticmethod
+    def from_json(s: str) -> "GraphConfiguration":
+        cfg = serde.loads(s)
+        if not isinstance(cfg, GraphConfiguration):
+            raise TypeError(f"JSON did not decode to GraphConfiguration: {type(cfg)}")
+        return cfg
+
+    # -- topology ----------------------------------------------------------
+    def topological_order(self) -> list[GraphNode]:
+        by_name = {n.name: n for n in self.nodes}
+        for n in self.nodes:
+            for i in n.inputs:
+                if i not in by_name and i not in self.network_inputs:
+                    raise ValueError(f"node {n.name}: unknown input {i!r}")
+        order: list[GraphNode] = []
+        state: dict[str, int] = {}  # 0=unvisited 1=visiting 2=done
+        net_inputs = set(self.network_inputs)
+
+        def visit(root: str):
+            # iterative DFS: deep linear chains must not hit the Python
+            # recursion limit
+            stack: list[tuple[str, bool]] = [(root, False)]
+            while stack:
+                name, expanded = stack.pop()
+                if name in net_inputs or state.get(name) == 2:
+                    continue
+                if expanded:
+                    state[name] = 2
+                    order.append(by_name[name])
+                    continue
+                if state.get(name) == 1:
+                    raise ValueError(f"cycle involving {name!r}")
+                state[name] = 1
+                stack.append((name, True))
+                for i in by_name[name].inputs:
+                    if state.get(i) == 1 and i not in net_inputs:
+                        raise ValueError(f"cycle involving {i!r}")
+                    stack.append((i, False))
+
+        for out in self.network_outputs:
+            if out not in by_name:
+                raise ValueError(f"network output {out!r} is not a node")
+            visit(out)
+        # include nodes not reachable from outputs (the reference warns;
+        # we include them so their params exist — harmless under XLA DCE)
+        for n in self.nodes:
+            visit(n.name)
+        return order
+
+    def infer_types(self) -> tuple[dict[str, InputType], dict[str, bool]]:
+        """Type of every node's OUTPUT + whether an implicit CNN->FF flatten
+        precedes each layer node (single source of truth, as in the
+        sequential walk)."""
+        types: dict[str, InputType] = dict(zip(self.network_inputs, self.input_types))
+        flatten: dict[str, bool] = {}
+        for node in self.topological_order():
+            in_types = [types[i] for i in node.inputs]
+            if node.layer is not None:
+                t = in_types[0]
+                flat = node.layer.EXPECTS == "ff" and t.kind in (
+                    InputType.KIND_CNN,
+                    InputType.KIND_CNN3D,
+                )
+                flatten[node.name] = flat
+                if flat:
+                    t = InputType.feed_forward(t.flat_size)
+                types[node.name] = node.layer.output_type(t)
+            else:
+                flatten[node.name] = False
+                types[node.name] = node.vertex.output_type(in_types)
+        return types, flatten
+
+class GraphBuilder:
+    """Fluent DAG builder (ComputationGraphConfiguration.GraphBuilder role).
+
+        conf = (GraphBuilder()
+                .add_inputs("in")
+                .set_input_types(InputType.convolutional(32, 32, 3))
+                .add_layer("c1", Conv2D(n_out=16, kernel=(3,3)), "in")
+                .add_layer("c2", Conv2D(n_out=16, kernel=(3,3), padding="same"), "c1")
+                .add_vertex("skip", ElementWiseVertex(ElementWiseOp.ADD), "c1", "c2")
+                .add_layer("out", OutputLayer(n_out=10), "skip")
+                .set_outputs("out")
+                .updater(Adam(1e-3))
+                .build())
+    """
+
+    def __init__(self):
+        self._nodes: list[GraphNode] = []
+        self._inputs: tuple[str, ...] = ()
+        self._outputs: tuple[str, ...] = ()
+        self._input_types: tuple[InputType, ...] = ()
+        self._updater: Updater = Sgd()
+        self._seed = 0
+        self._clip_value: Optional[float] = None
+        self._clip_norm: Optional[float] = None
+        self._bf16: Optional[bool] = None
+        self._steps_per_epoch = 1
+        # layer-level defaults (same semantics as NeuralNetConfiguration)
+        self._activation = None
+        self._weight_init = None
+        self._l1 = None
+        self._l2 = None
+        self._dropout = None
+
+    def add_inputs(self, *names: str):
+        self._inputs = tuple(names)
+        return self
+
+    def set_input_types(self, *types: InputType):
+        self._input_types = tuple(types)
+        return self
+
+    def add_layer(self, name: str, layer: LayerConfig, *inputs: str):
+        layer = self._fill_defaults(name, layer)
+        self._nodes.append(GraphNode(name=name, inputs=tuple(inputs), layer=layer))
+        return self
+
+    def add_vertex(self, name: str, vertex: VertexConfig, *inputs: str):
+        self._nodes.append(GraphNode(name=name, inputs=tuple(inputs), vertex=vertex))
+        return self
+
+    def set_outputs(self, *names: str):
+        self._outputs = tuple(names)
+        return self
+
+    def updater(self, u: Updater):
+        self._updater = u
+        return self
+
+    def seed(self, s: int):
+        self._seed = int(s)
+        return self
+
+    def activation(self, a):
+        self._activation = a
+        return self
+
+    def weight_init(self, w):
+        self._weight_init = w
+        return self
+
+    def l1(self, v: float):
+        self._l1 = v
+        return self
+
+    def l2(self, v: float):
+        self._l2 = v
+        return self
+
+    def dropout(self, rate: float):
+        self._dropout = rate
+        return self
+
+    def gradient_clip(self, value: float | None = None, norm: float | None = None):
+        self._clip_value, self._clip_norm = value, norm
+        return self
+
+    def bf16_compute(self, on: bool):
+        self._bf16 = on
+        return self
+
+    def steps_per_epoch(self, n: int):
+        self._steps_per_epoch = max(1, int(n))
+        return self
+
+    def _fill_defaults(self, name: str, layer: LayerConfig) -> LayerConfig:
+        updates = {}
+        is_output = hasattr(layer, "loss")
+        if layer.activation is None and self._activation is not None and not is_output:
+            updates["activation"] = self._activation
+        if layer.weight_init is None and self._weight_init is not None:
+            updates["weight_init"] = self._weight_init
+        if layer.l1 is None and self._l1 is not None:
+            updates["l1"] = self._l1
+        if layer.l2 is None and self._l2 is not None:
+            updates["l2"] = self._l2
+        if layer.dropout_rate is None and self._dropout is not None:
+            updates["dropout_rate"] = self._dropout
+        updates["name"] = name
+        return dataclasses.replace(layer, **updates)
+
+    def build(self) -> GraphConfiguration:
+        if not self._nodes:
+            raise ValueError("no nodes configured")
+        if not self._inputs:
+            raise ValueError("no network inputs declared (add_inputs)")
+        if not self._outputs:
+            raise ValueError("no network outputs declared (set_outputs)")
+        if len(self._input_types) != len(self._inputs):
+            raise ValueError(
+                f"{len(self._inputs)} inputs but {len(self._input_types)} input types"
+            )
+        names = [n.name for n in self._nodes] + list(self._inputs)
+        dupes = {n for n in names if names.count(n) > 1}
+        if dupes:
+            raise ValueError(f"duplicate node names: {sorted(dupes)}")
+        conf = GraphConfiguration(
+            nodes=tuple(self._nodes),
+            network_inputs=self._inputs,
+            network_outputs=self._outputs,
+            input_types=self._input_types,
+            updater=self._updater,
+            seed=self._seed,
+            gradient_clip_value=self._clip_value,
+            gradient_clip_norm=self._clip_norm,
+            bf16_compute=self._bf16,
+            steps_per_epoch=self._steps_per_epoch,
+        )
+        conf.topological_order()  # validates acyclicity + input references
+        conf.infer_types()        # validates shapes compose
+        return conf

@@ -1,1 +1,10 @@
 """Model zoo — the `org.deeplearning4j.zoo` role."""
+
+from deeplearning4j_tpu.zoo.zoo_model import ZooModel
+from deeplearning4j_tpu.zoo.lenet import LeNet
+from deeplearning4j_tpu.zoo.resnet import ResNet50
+from deeplearning4j_tpu.zoo.simplecnn import SimpleCNN
+from deeplearning4j_tpu.zoo.unet import UNet
+from deeplearning4j_tpu.zoo.vgg import VGG16, VGG19
+
+__all__ = ["ZooModel", "LeNet", "ResNet50", "SimpleCNN", "UNet", "VGG16", "VGG19"]

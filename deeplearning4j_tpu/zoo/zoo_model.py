@@ -26,13 +26,13 @@ class ZooModel:
 
     def init_model(self):
         """Build + init a fresh randomly-initialized model (ZooModel.init())."""
-        conf = self.conf()
-        if type(conf).__name__ == "GraphConfiguration":
-            from deeplearning4j_tpu.models.computation_graph import GraphModel
-
-            return GraphModel(conf).init()
+        from deeplearning4j_tpu.models.computation_graph import GraphModel
         from deeplearning4j_tpu.models.sequential import SequentialModel
+        from deeplearning4j_tpu.nn.conf.graph_conf import GraphConfiguration
 
+        conf = self.conf()
+        if isinstance(conf, GraphConfiguration):
+            return GraphModel(conf).init()
         return SequentialModel(conf).init()
 
     def init_pretrained(self):

@@ -1,0 +1,168 @@
+"""GraphModel (ComputationGraph role) tests: topology, shapes, training."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.data.dataset import MultiDataSet
+from deeplearning4j_tpu.models import GraphModel
+from deeplearning4j_tpu.nn import Adam
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    Conv2D,
+    Dense,
+    InputType,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.graph_conf import (
+    ElementWiseOp,
+    ElementWiseVertex,
+    GraphBuilder,
+    GraphConfiguration,
+    MergeVertex,
+    SubsetVertex,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+
+
+def residual_mlp_conf(seed=7):
+    return (
+        GraphBuilder()
+        .seed(seed)
+        .updater(Adam(1e-2))
+        .activation(Activation.RELU)
+        .add_inputs("in")
+        .set_input_types(InputType.feed_forward(4))
+        .add_layer("fc1", Dense(n_out=16), "in")
+        .add_layer("fc2", Dense(n_out=16), "fc1")
+        .add_vertex("skip", ElementWiseVertex(ElementWiseOp.ADD), "fc1", "fc2")
+        .add_layer("out", OutputLayer(n_out=3, loss=Loss.MCXENT), "skip")
+        .set_outputs("out")
+        .build()
+    )
+
+
+def test_topological_order_and_types():
+    conf = residual_mlp_conf()
+    order = [n.name for n in conf.topological_order()]
+    assert order.index("fc1") < order.index("fc2") < order.index("skip") < order.index("out")
+    types, _ = conf.infer_types()
+    assert types["skip"].size == 16
+    assert types["out"].size == 3
+
+
+def test_cycle_detection():
+    with pytest.raises(ValueError, match="cycle"):
+        (
+            GraphBuilder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(2))
+            .add_layer("a", Dense(n_out=2), "b")
+            .add_layer("b", Dense(n_out=2), "a")
+            .add_layer("out", OutputLayer(n_out=2), "b")
+            .set_outputs("out")
+            .build()
+        )
+
+
+def test_unknown_input_rejected():
+    with pytest.raises(ValueError, match="unknown input"):
+        (
+            GraphBuilder()
+            .add_inputs("in")
+            .set_input_types(InputType.feed_forward(2))
+            .add_layer("a", Dense(n_out=2), "nonexistent")
+            .add_layer("out", OutputLayer(n_out=2), "a")
+            .set_outputs("out")
+            .build()
+        )
+
+
+def test_residual_graph_learns():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(512, 4)).astype(np.float32)
+    cls = (x[:, 0] + x[:, 1] * x[:, 2] > 0).astype(int) + (x[:, 3] > 0.5).astype(int)
+    y = np.eye(3, dtype=np.float32)[cls]
+    model = GraphModel(residual_mlp_conf()).init()
+    from deeplearning4j_tpu.data import NumpyDataSetIterator
+
+    it = NumpyDataSetIterator(x, y, batch_size=64, seed=1)
+    model.fit(it, epochs=30)
+    assert model.evaluate(DataSet(x, y)).accuracy() > 0.85
+
+
+def test_merge_and_subset_vertices():
+    conf = (
+        GraphBuilder()
+        .seed(1)
+        .updater(Adam(1e-2))
+        .add_inputs("a", "b")
+        .set_input_types(InputType.feed_forward(3), InputType.feed_forward(5))
+        .add_layer("fa", Dense(n_out=8, activation=Activation.RELU), "a")
+        .add_layer("fb", Dense(n_out=8, activation=Activation.RELU), "b")
+        .add_vertex("cat", MergeVertex(), "fa", "fb")
+        .add_vertex("sub", SubsetVertex(frm=0, to=7), "cat")
+        .add_layer("out", OutputLayer(n_out=2, loss=Loss.MCXENT), "cat")
+        .add_layer("aux", OutputLayer(n_out=2, loss=Loss.MCXENT), "sub")
+        .set_outputs("out", "aux")
+        .build()
+    )
+    types, _ = conf.infer_types()
+    assert types["cat"].size == 16
+    assert types["sub"].size == 8
+    model = GraphModel(conf).init()
+    xa = np.random.default_rng(0).normal(size=(32, 3)).astype(np.float32)
+    xb = np.random.default_rng(1).normal(size=(32, 5)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[np.arange(32) % 2]
+    mds = MultiDataSet((xa, xb), (y, y))
+    model.fit_batch(mds)
+    assert np.isfinite(model.score_value)
+    out, aux = model.output(xa, xb)
+    assert out.shape == (32, 2) and aux.shape == (32, 2)
+    np.testing.assert_allclose(np.asarray(out).sum(axis=1), 1.0, rtol=1e-4)
+
+
+def test_graph_json_round_trip():
+    conf = residual_mlp_conf()
+    s = conf.to_json()
+    conf2 = GraphConfiguration.from_json(s)
+    assert conf == conf2
+    m1, m2 = GraphModel(conf).init(), GraphModel(conf2).init()
+    for n in m1.params:
+        for p in m1.params[n]:
+            np.testing.assert_array_equal(
+                np.asarray(m1.params[n][p]), np.asarray(m2.params[n][p])
+            )
+
+
+def test_graph_checkpoint_round_trip(tmp_path):
+    from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+    model = GraphModel(residual_mlp_conf()).init()
+    x = np.random.default_rng(0).normal(size=(16, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[np.arange(16) % 3]
+    model.fit_batch(DataSet(x, y))
+    p = tmp_path / "graph.zip"
+    model.save(str(p))
+    m2 = ModelSerializer.restore(str(p))
+    np.testing.assert_allclose(
+        np.asarray(model.output(x)), np.asarray(m2.output(x)), rtol=1e-5
+    )
+
+
+def test_cnn_graph_with_flatten():
+    conf = (
+        GraphBuilder()
+        .seed(3)
+        .updater(Adam(1e-3))
+        .add_inputs("img")
+        .set_input_types(InputType.convolutional(8, 8, 1))
+        .add_layer("c", Conv2D(n_out=4, kernel=(3, 3), activation=Activation.RELU), "img")
+        .add_layer("out", OutputLayer(n_out=2, loss=Loss.MCXENT), "c")
+        .set_outputs("out")
+        .build()
+    )
+    model = GraphModel(conf).init()
+    x = np.random.default_rng(0).normal(size=(4, 8, 8, 1)).astype(np.float32)
+    out = model.output(x)
+    assert out.shape == (4, 2)
