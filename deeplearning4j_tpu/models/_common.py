@@ -70,11 +70,10 @@ def regularization_loss(params, named_layers) -> jax.Array:
         l2 = layer.l2 or 0.0
         if l1 == 0.0 and l2 == 0.0:
             continue
-        for pname in layer.REGULARIZED:
-            if pname in lp:
-                w = lp[pname].astype(jnp.float32)
-                if l1:
-                    reg = reg + l1 * jnp.sum(jnp.abs(w))
-                if l2:
-                    reg = reg + 0.5 * l2 * jnp.sum(w * w)
+        for w in layer.regularizable_params(lp):
+            w = w.astype(jnp.float32)
+            if l1:
+                reg = reg + l1 * jnp.sum(jnp.abs(w))
+            if l2:
+                reg = reg + 0.5 * l2 * jnp.sum(w * w)
     return reg

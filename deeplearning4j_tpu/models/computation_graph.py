@@ -24,7 +24,6 @@ from deeplearning4j_tpu.models._common import (
     resolve_output_spec,
 )
 from deeplearning4j_tpu.nn.conf.graph_conf import GraphConfiguration
-from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
 from deeplearning4j_tpu.nn.losses import compute as compute_loss
 from deeplearning4j_tpu.nn.updaters import with_gradient_clipping
 from deeplearning4j_tpu.runtime.backend import backend
@@ -58,9 +57,10 @@ class GraphModel(Model):
         specs = []
         for out in self.conf.network_outputs:
             layer = by_name[out].layer
-            if not isinstance(layer, (OutputLayer, LossLayer)):
+            if layer is None or not hasattr(layer, "loss"):
                 raise ValueError(
-                    f"network output {out!r} must be an OutputLayer/LossLayer"
+                    f"network output {out!r} must be an OutputLayer/"
+                    "RnnOutputLayer/LossLayer"
                 )
             specs.append(resolve_output_spec(layer))
         return specs

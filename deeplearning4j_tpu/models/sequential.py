@@ -28,7 +28,6 @@ from deeplearning4j_tpu.nn.activations import Activation
 from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.conf.neural_net_configuration import SequentialConfiguration
 from deeplearning4j_tpu.nn.losses import Loss, compute as compute_loss
-from deeplearning4j_tpu.nn.conf.layers import LossLayer, OutputLayer
 from deeplearning4j_tpu.nn.updaters import with_gradient_clipping
 from deeplearning4j_tpu.models._common import (
     mask_frozen_tx,
@@ -73,14 +72,14 @@ class SequentialModel(Model):
         self._tx = self._mask_frozen(self._tx)
         self._stream = SeedStream(conf.seed)
         self._step_fns: dict[Any, Any] = {}
-        self._infer_fn = None
 
     # -- construction ------------------------------------------------------
     def _resolve_output(self) -> tuple[Loss, Activation, bool]:
         last = self.conf.layers[-1]
-        if not isinstance(last, (OutputLayer, LossLayer)):
+        if not hasattr(last, "loss"):
             raise ValueError(
-                "last layer must be an OutputLayer or LossLayer declaring the loss"
+                "last layer must be an OutputLayer, RnnOutputLayer or "
+                "LossLayer declaring the loss"
             )
         return resolve_output_spec(last)
 
@@ -101,37 +100,76 @@ class SequentialModel(Model):
         return self
 
     # -- pure forward (traced) --------------------------------------------
-    def _forward(self, params, net_state, x, *, training: bool, rng):
+    def _forward(
+        self, params, net_state, x, *, training: bool, rng, fmask=None, carries=None
+    ):
+        """carries: {rnn_layer_name: carry} initial RNN states (TBPTT /
+        streaming inference); when given, the third return value holds the
+        final carries.  fmask: (B, T) sequence mask threaded into
+        mask-aware layers until the time axis collapses."""
+        from deeplearning4j_tpu.nn.conf.recurrent import RecurrentLayerConfig
+
         if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
             x = x.astype(jnp.bfloat16)
-        new_state = {}
+        new_state, new_carries = {}, {}
+        mask = fmask
         for i, layer in enumerate(self.conf.layers):
             if self._flatten_before[i]:
                 x = x.reshape(x.shape[0], -1)
             lp = params.get(layer.name, {})
             ls = net_state.get(layer.name, {})
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
-            x, ns = layer.apply(lp, ls, x, training=training, rng=lrng)
+            if carries is not None and isinstance(layer, RecurrentLayerConfig):
+                carry = carries.get(layer.name)
+                if carry is None:
+                    carry = layer.init_carry(x.shape[0], x.dtype)
+                x, fin = layer.apply_with_carry(
+                    lp, x, carry, mask=mask, training=training, rng=lrng
+                )
+                new_carries[layer.name] = fin
+                ns = {}
+            elif layer.ACCEPTS_MASK:
+                x, ns = layer.apply(
+                    lp, ls, x, training=training, rng=lrng, mask=mask
+                )
+            else:
+                x, ns = layer.apply(lp, ls, x, training=training, rng=lrng)
             if ns:
                 new_state[layer.name] = ns
+            # once the time axis collapses (RNN -> FF), the mask is spent
+            if self._itypes[i].kind == "rnn" and layer.output_type(self._itypes[i]).kind != "rnn":
+                mask = None
+        if carries is not None:
+            return x, new_state, new_carries
         return x, new_state
 
     def _reg_loss(self, params):
         return regularization_loss(params, [(l.name, l) for l in self.conf.layers])
 
     # -- compiled train step ----------------------------------------------
-    def _get_step_fn(self, has_lmask: bool):
-        key = ("train", has_lmask)
+    def _get_step_fn(self, has_lmask: bool, has_fmask: bool, with_carries: bool):
+        key = ("train", has_lmask, has_fmask, with_carries)
         if key not in self._step_fns:
 
             @partial(jax.jit, donate_argnums=(0, 1, 2))
-            def step(params, opt_state, net_state, step_i, features, labels, lmask):
+            def step(params, opt_state, net_state, step_i, features, labels, lmask, fmask, carries):
                 rng = SeedStream.fold(self._stream.root, step_i)
 
                 def loss_fn(p):
-                    out, new_state = self._forward(
-                        p, net_state, features, training=True, rng=rng
+                    fwd = self._forward(
+                        p,
+                        net_state,
+                        features,
+                        training=True,
+                        rng=rng,
+                        fmask=fmask if has_fmask else None,
+                        carries=carries if with_carries else None,
                     )
+                    if with_carries:
+                        out, new_state, new_carries = fwd
+                    else:
+                        out, new_state = fwd
+                        new_carries = {}
                     if not self._fused_loss:
                         out = self._out_activation(out.astype(jnp.float32))
                     data_loss = compute_loss(
@@ -141,18 +179,18 @@ class SequentialModel(Model):
                         lmask if has_lmask else None,
                         from_logits=self._fused_loss,
                     )
-                    return data_loss + self._reg_loss(p), new_state
+                    return data_loss + self._reg_loss(p), (new_state, new_carries)
 
-                (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-                    params
-                )
+                (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
                 updates, opt_state = self._tx.update(grads, opt_state, params)
                 params = jax.tree.map(
                     lambda p, u: (p + u.astype(p.dtype)), params, updates
                 )
                 # carry unchanged state subtrees forward
                 merged_state = {**net_state, **new_state}
-                return params, opt_state, merged_state, loss
+                return params, opt_state, merged_state, loss, new_carries
 
             self._step_fns[key] = step
         return self._step_fns[key]
@@ -174,41 +212,129 @@ class SequentialModel(Model):
     def fit_batch(self, batch: DataSet) -> None:
         if self.params is None:
             self.init()
+        if self.conf.backprop_type == "tbptt" and self.conf.tbptt_length > 0:
+            self._fit_batch_tbptt(batch)
+            return
+        self._run_step(batch, carries=None)
+
+    def _run_step(self, batch: DataSet, carries):
         has_lmask = batch.labels_mask is not None
-        step = self._get_step_fn(has_lmask)
-        lmask = batch.labels_mask if has_lmask else np.zeros((0,), np.float32)
-        self.params, self.opt_state, self.net_state, loss = step(
+        has_fmask = batch.features_mask is not None
+        with_carries = carries is not None
+        step = self._get_step_fn(has_lmask, has_fmask, with_carries)
+        empty = np.zeros((0,), np.float32)
+        self.params, self.opt_state, self.net_state, loss, new_carries = step(
             self.params,
             self.opt_state,
             self.net_state,
             jnp.uint32(self.iteration),
             batch.features,
             batch.labels,
-            lmask,
+            batch.labels_mask if has_lmask else empty,
+            batch.features_mask if has_fmask else empty,
+            carries if with_carries else {},
         )
         self._last_score = loss
         self.last_batch_size = batch.num_examples
         self.iteration += 1
         self._dispatch_iteration(loss)
+        return new_carries
+
+    def _fit_batch_tbptt(self, batch: DataSet) -> None:
+        """Truncated BPTT: split the time axis into tbptt_length windows;
+        gradients are confined to each window, RNN carries flow across
+        windows (values only — the window boundary stops the gradient,
+        matching BackpropType.TruncatedBPTT)."""
+        T = batch.features.shape[1]
+        L = self.conf.tbptt_length
+        if batch.labels.ndim < 2 or batch.labels.shape[1] != T:
+            raise ValueError(
+                "TBPTT needs per-timestep labels with a (B, T, ...) time "
+                f"axis matching features; got {batch.labels.shape} for "
+                f"T={T} — use standard backprop for sequence-to-one models"
+            )
+        carries: dict = {}
+        for t0 in range(0, T, L):
+            sl = slice(t0, min(t0 + L, T))
+            window = DataSet(
+                batch.features[:, sl],
+                batch.labels[:, sl],
+                None if batch.features_mask is None else batch.features_mask[:, sl],
+                None if batch.labels_mask is None else batch.labels_mask[:, sl],
+            )
+            carries = self._run_step(window, carries=carries)
 
     # -- inference ---------------------------------------------------------
-    def _get_infer_fn(self):
-        if self._infer_fn is None:
+    def _get_infer_fn(self, has_fmask: bool = False):
+        key = ("infer", has_fmask)
+        if key not in self._step_fns:
 
             @jax.jit
-            def infer(params, net_state, features):
-                out, _ = self._forward(params, net_state, features, training=False, rng=None)
+            def infer(params, net_state, features, fmask):
+                out, _ = self._forward(
+                    params,
+                    net_state,
+                    features,
+                    training=False,
+                    rng=None,
+                    fmask=fmask if has_fmask else None,
+                )
                 return self._out_activation(out.astype(jnp.float32))
 
-            self._infer_fn = infer
-        return self._infer_fn
+            self._step_fns[key] = infer
+        return self._step_fns[key]
 
-    def output(self, features) -> jax.Array:
+    def output(self, features, features_mask=None) -> jax.Array:
         """Forward pass with the output activation applied (reference
         `MultiLayerNetwork.output()`)."""
         if self.params is None:
             self.init()
-        return self._get_infer_fn()(self.params, self.net_state, features)
+        has_fmask = features_mask is not None
+        return self._get_infer_fn(has_fmask)(
+            self.params,
+            self.net_state,
+            features,
+            features_mask if has_fmask else np.zeros((0,), np.float32),
+        )
+
+    # -- stateful streaming inference (rnnTimeStep role) -------------------
+    def _init_carries(self, batch: int) -> dict:
+        from deeplearning4j_tpu.nn.conf.recurrent import RecurrentLayerConfig
+
+        dtype = jnp.bfloat16 if self._bf16 else jnp.float32
+        return {
+            l.name: l.init_carry(batch, dtype)
+            for l in self.conf.layers
+            if isinstance(l, RecurrentLayerConfig)
+        }
+
+    def rnn_time_step(self, features) -> jax.Array:
+        """Streaming RNN inference: feed a chunk (B, T, F), carry hidden
+        state to the next call (the reference's rnnTimeStep).  Output
+        activation applied.  Jitted (cached per chunk shape) so
+        token-by-token generation loops stay fast."""
+        if self.params is None:
+            self.init()
+        if not getattr(self, "_rnn_stream_state", None):
+            self._rnn_stream_state = self._init_carries(features.shape[0])
+        key = "rnn_step"
+        if key not in self._step_fns:
+
+            @jax.jit
+            def rnn_step(params, net_state, x, carries):
+                out, _, new_carries = self._forward(
+                    params, net_state, x, training=False, rng=None, carries=carries
+                )
+                return self._out_activation(out.astype(jnp.float32)), new_carries
+
+            self._step_fns[key] = rnn_step
+        out, self._rnn_stream_state = self._step_fns[key](
+            self.params, self.net_state, jnp.asarray(features), self._rnn_stream_state
+        )
+        return out
+
+    def rnn_clear_previous_state(self) -> None:
+        self._rnn_stream_state = {}
 
     def predict(self, features) -> np.ndarray:
         """Argmax class predictions (reference `predict()`)."""
@@ -233,7 +359,12 @@ class SequentialModel(Model):
     def score(self, ds: DataSet) -> float:
         """Loss (incl. regularization) on a dataset without updating."""
         out, _ = self._forward(
-            self.params, self.net_state, jnp.asarray(ds.features), training=False, rng=None
+            self.params,
+            self.net_state,
+            jnp.asarray(ds.features),
+            training=False,
+            rng=None,
+            fmask=ds.features_mask,
         )
         if not self._fused_loss:
             out = self._out_activation(out.astype(jnp.float32))
@@ -249,7 +380,7 @@ class SequentialModel(Model):
         iterator = _as_iterator(data, batch_size)
         ev = Evaluation()
         for batch in iterator:
-            probs = np.asarray(self.output(batch.features))
+            probs = np.asarray(self.output(batch.features, batch.features_mask))
             ev.eval(batch.labels, probs, mask=batch.labels_mask)
         return ev
 

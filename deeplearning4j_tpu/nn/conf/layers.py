@@ -78,6 +78,9 @@ class LayerConfig:
     # (the reference's InputPreProcessor role) when kinds mismatch.
     EXPECTS = "any"
     HAS_PARAMS = True
+    # Layers that consume the (B, T) sequence mask declare this; the model
+    # threads features_mask into their apply(mask=...) kwarg.
+    ACCEPTS_MASK = False
 
     def output_type(self, itype: InputType) -> InputType:
         return itype
@@ -90,6 +93,11 @@ class LayerConfig:
 
     # regularization hook: which param names are penalized by l1/l2
     REGULARIZED = ("W",)
+
+    def regularizable_params(self, lp: dict) -> list:
+        """Arrays the l1/l2 penalty applies to (wrappers with nested param
+        dicts override this)."""
+        return [lp[p] for p in self.REGULARIZED if p in lp]
 
     def _act(self, default=Activation.IDENTITY) -> Activation:
         return self.activation if self.activation is not None else default
@@ -457,6 +465,7 @@ class GlobalPooling(LayerConfig):
     pooling: PoolingType = PoolingType.AVG
     HAS_PARAMS = False
     REGULARIZED = ()
+    ACCEPTS_MASK = True
 
     def output_type(self, itype: InputType) -> InputType:
         if itype.kind == InputType.KIND_CNN:
@@ -467,15 +476,27 @@ class GlobalPooling(LayerConfig):
 
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         axes = tuple(range(1, x.ndim - 1))
+        m = None
+        if mask is not None:
+            # (B, T) sequence mask broadcast over features; every pooling
+            # type must exclude padded steps (the reference masks all four)
+            m = mask.astype(x.dtype)
+            while m.ndim < x.ndim:
+                m = m[..., None]
         if self.pooling is PoolingType.MAX:
+            if m is not None:
+                x = jnp.where(m > 0, x, jnp.asarray(-jnp.inf, x.dtype))
             return jnp.max(x, axis=axes), state
         if self.pooling is PoolingType.SUM:
+            if m is not None:
+                x = x * m
             return jnp.sum(x, axis=axes), state
         if self.pooling is PoolingType.PNORM:
             p = 2.0
+            if m is not None:
+                x = x * m
             return jnp.sum(jnp.abs(x) ** p, axis=axes) ** (1 / p), state
-        if mask is not None:
-            m = mask[..., None].astype(x.dtype)
+        if m is not None:
             denom = jnp.maximum(jnp.sum(m, axis=axes), 1.0)
             return jnp.sum(x * m, axis=axes) / denom, state
         return jnp.mean(x, axis=axes), state

@@ -37,6 +37,11 @@ class SequentialConfiguration:
     # Iterations per epoch, used to lower epoch-based LR schedules
     # (ScheduleType.EPOCH role). Set via builder.steps_per_epoch().
     steps_per_epoch: int = 1
+    # BackpropType role: "standard" or "tbptt" (truncated BPTT for long
+    # sequences: gradients flow within tbptt_length windows; RNN carries
+    # are forwarded across windows).
+    backprop_type: str = "standard"
+    tbptt_length: int = 0
 
     def to_json(self) -> str:
         return serde.dumps(self)
@@ -114,6 +119,8 @@ class NeuralNetConfiguration:
         self._clip_norm: Optional[float] = None
         self._bf16: Optional[bool] = None
         self._steps_per_epoch = 1
+        self._backprop_type = "standard"
+        self._tbptt_length = 0
         self._layers: list[LayerConfig] = []
         self._input_type: Optional[InputType] = None
 
@@ -160,6 +167,13 @@ class NeuralNetConfiguration:
     def steps_per_epoch(self, n: int):
         """Iterations per epoch — required for per-epoch LR schedules."""
         self._steps_per_epoch = max(1, int(n))
+        return self
+
+    def tbptt(self, length: int):
+        """Enable truncated BPTT with the given window length
+        (BackpropType.TruncatedBPTT role)."""
+        self._backprop_type = "tbptt"
+        self._tbptt_length = int(length)
         return self
 
     def list(self):
@@ -212,4 +226,6 @@ class NeuralNetConfiguration:
             gradient_clip_norm=self._clip_norm,
             bf16_compute=self._bf16,
             steps_per_epoch=self._steps_per_epoch,
+            backprop_type=self._backprop_type,
+            tbptt_length=self._tbptt_length,
         )

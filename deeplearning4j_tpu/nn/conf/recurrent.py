@@ -1,0 +1,364 @@
+"""Recurrent layers — the `org.deeplearning4j.nn.layers.recurrent` role.
+
+The reference's GravesLSTM/LSTM run a per-timestep Java loop issuing cell
+ops through JNI (LSTMHelpers.activateHelper — SURVEY.md §5.7); here the
+time loop is a `lax.scan` INSIDE the compiled step, with the input
+projection x@Wx for ALL timesteps hoisted out of the scan as one large
+(B*T, F)x(F, 4H) matmul that rides the MXU; only the small recurrent
+h@Wh matmul remains sequential.
+
+Masking (variable-length batches): masked steps pass the carry through
+unchanged and output zeros — matching the reference's mask propagation.
+
+Layout: (B, T, F) batch-major in the API; scan runs time-major internally.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers import LayerConfig, _dropout
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.utils import serde
+
+
+class RecurrentLayerConfig(LayerConfig):
+    """Base for layers with a time-carry.  Subclasses implement
+    init_carry(batch, dtype) and apply_with_carry(...); plain apply()
+    starts from a zero carry and discards the final one."""
+
+    EXPECTS = "rnn"
+    REGULARIZED = ("Wx", "Wh")
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, itype.shape[0])
+
+    def init_carry(self, batch: int, dtype):
+        raise NotImplementedError
+
+    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
+        raise NotImplementedError
+
+    ACCEPTS_MASK = True
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        carry = self.init_carry(x.shape[0], x.dtype)
+        y, _ = self.apply_with_carry(
+            params, x, carry, mask=mask, training=training, rng=rng
+        )
+        return y, state
+
+
+def _scan_time_major(cell, carry, x, mask):
+    """x: (B,T,...) -> scan over T. Returns (ys (B,T,H), final_carry)."""
+    xt = jnp.swapaxes(x, 0, 1)  # (T, B, ...)
+    if mask is not None:
+        mt = jnp.swapaxes(mask.astype(x.dtype), 0, 1)[..., None]  # (T, B, 1)
+    else:
+        mt = jnp.ones((xt.shape[0], xt.shape[1], 1), x.dtype)
+    carry, ys = lax.scan(cell, carry, (xt, mt))
+    return jnp.swapaxes(ys, 0, 1), carry
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class LSTM(RecurrentLayerConfig):
+    """Standard LSTM (the reference's `LSTM` layer).
+
+    Gate order in the fused weight matrices: [i, f, g, o].
+    forget_gate_bias=1.0 follows the reference default.
+    """
+
+    n_out: int = 0
+    forget_gate_bias: float = 1.0
+    gate_activation: Activation = Activation.SIGMOID
+
+    def init(self, key, itype):
+        n_in, n_out = itype.size, self.n_out
+        k1, k2 = jax.random.split(key)
+        wi = self._winit(WeightInit.XAVIER)
+        params = {
+            "Wx": wi.init(k1, (n_in, 4 * n_out), fan_in=n_in, fan_out=n_out),
+            "Wh": wi.init(k2, (n_out, 4 * n_out), fan_in=n_out, fan_out=n_out),
+            "b": jnp.zeros((4 * n_out,), jnp.float32)
+            .at[n_out : 2 * n_out]
+            .set(self.forget_gate_bias),
+        }
+        return params, {}
+
+    def init_carry(self, batch, dtype):
+        return (
+            jnp.zeros((batch, self.n_out), dtype),
+            jnp.zeros((batch, self.n_out), dtype),
+        )
+
+    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        n_out = self.n_out
+        act = self._act(Activation.TANH)
+        gate_act = self.gate_activation
+        wx = params["Wx"].astype(x.dtype)
+        wh = params["Wh"].astype(x.dtype)
+        b = params["b"].astype(x.dtype)
+        # hoist the input projection out of the scan: one big MXU matmul
+        xproj = x @ wx + b  # (B, T, 4H)
+
+        def cell(c, inp):
+            (h, cstate) = c
+            xt, mt = inp
+            z = xt + h @ wh
+            i = gate_act(z[..., :n_out])
+            f = gate_act(z[..., n_out : 2 * n_out])
+            g = act(z[..., 2 * n_out : 3 * n_out])
+            o = gate_act(z[..., 3 * n_out :])
+            c_new = f * cstate + i * g
+            h_new = o * act(c_new)
+            c_new = mt * c_new + (1 - mt) * cstate
+            h_new = mt * h_new + (1 - mt) * h
+            return (h_new, c_new), h_new * mt
+
+        return _scan_time_major(cell, carry, xproj, mask)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class GravesLSTM(LSTM):
+    """LSTM with peephole connections (Graves 2013) — the reference's
+    GravesLSTM (BASELINE config 3).  Diagonal peephole weights: c_{t-1}
+    feeds i and f gates; c_t feeds the o gate."""
+
+    def init(self, key, itype):
+        params, state = super().init(key, itype)
+        params["pI"] = jnp.zeros((self.n_out,), jnp.float32)
+        params["pF"] = jnp.zeros((self.n_out,), jnp.float32)
+        params["pO"] = jnp.zeros((self.n_out,), jnp.float32)
+        return params, state
+
+    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        n_out = self.n_out
+        act = self._act(Activation.TANH)
+        gate_act = self.gate_activation
+        wx = params["Wx"].astype(x.dtype)
+        wh = params["Wh"].astype(x.dtype)
+        b = params["b"].astype(x.dtype)
+        pI = params["pI"].astype(x.dtype)
+        pF = params["pF"].astype(x.dtype)
+        pO = params["pO"].astype(x.dtype)
+        xproj = x @ wx + b
+
+        def cell(c, inp):
+            (h, cstate) = c
+            xt, mt = inp
+            z = xt + h @ wh
+            i = gate_act(z[..., :n_out] + pI * cstate)
+            f = gate_act(z[..., n_out : 2 * n_out] + pF * cstate)
+            g = act(z[..., 2 * n_out : 3 * n_out])
+            c_new = f * cstate + i * g
+            o = gate_act(z[..., 3 * n_out :] + pO * c_new)
+            h_new = o * act(c_new)
+            c_new = mt * c_new + (1 - mt) * cstate
+            h_new = mt * h_new + (1 - mt) * h
+            return (h_new, c_new), h_new * mt
+
+        return _scan_time_major(cell, carry, xproj, mask)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class GRU(RecurrentLayerConfig):
+    """GRU cell. Gate order: [r, z, n]."""
+
+    n_out: int = 0
+
+    def init(self, key, itype):
+        n_in, n_out = itype.size, self.n_out
+        k1, k2 = jax.random.split(key)
+        wi = self._winit(WeightInit.XAVIER)
+        return {
+            "Wx": wi.init(k1, (n_in, 3 * n_out), fan_in=n_in, fan_out=n_out),
+            "Wh": wi.init(k2, (n_out, 3 * n_out), fan_in=n_out, fan_out=n_out),
+            "b": jnp.zeros((3 * n_out,), jnp.float32),
+        }, {}
+
+    def init_carry(self, batch, dtype):
+        return (jnp.zeros((batch, self.n_out), dtype),)
+
+    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        n_out = self.n_out
+        act = self._act(Activation.TANH)
+        wx = params["Wx"].astype(x.dtype)
+        wh = params["Wh"].astype(x.dtype)
+        b = params["b"].astype(x.dtype)
+        xproj = x @ wx + b
+
+        def cell(c, inp):
+            (h,) = c
+            xt, mt = inp
+            hz = h @ wh
+            r = jax.nn.sigmoid(xt[..., :n_out] + hz[..., :n_out])
+            z = jax.nn.sigmoid(xt[..., n_out : 2 * n_out] + hz[..., n_out : 2 * n_out])
+            n = act(xt[..., 2 * n_out :] + r * hz[..., 2 * n_out :])
+            h_new = (1 - z) * n + z * h
+            h_new = mt * h_new + (1 - mt) * h
+            return (h_new,), h_new * mt
+
+        return _scan_time_major(cell, carry, xproj, mask)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class SimpleRnn(RecurrentLayerConfig):
+    """Elman RNN (the reference's SimpleRnn)."""
+
+    n_out: int = 0
+
+    def init(self, key, itype):
+        n_in, n_out = itype.size, self.n_out
+        k1, k2 = jax.random.split(key)
+        wi = self._winit(WeightInit.XAVIER)
+        return {
+            "Wx": wi.init(k1, (n_in, n_out), fan_in=n_in, fan_out=n_out),
+            "Wh": wi.init(k2, (n_out, n_out), fan_in=n_out, fan_out=n_out),
+            "b": jnp.zeros((n_out,), jnp.float32),
+        }, {}
+
+    def init_carry(self, batch, dtype):
+        return (jnp.zeros((batch, self.n_out), dtype),)
+
+    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        act = self._act(Activation.TANH)
+        wx = params["Wx"].astype(x.dtype)
+        wh = params["Wh"].astype(x.dtype)
+        b = params["b"].astype(x.dtype)
+        xproj = x @ wx + b
+
+        def cell(c, inp):
+            (h,) = c
+            xt, mt = inp
+            h_new = act(xt + h @ wh)
+            h_new = mt * h_new + (1 - mt) * h
+            return (h_new,), h_new * mt
+
+        return _scan_time_major(cell, carry, xproj, mask)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class Bidirectional(LayerConfig):
+    """Bidirectional wrapper (the reference's Bidirectional): runs the
+    wrapped RNN forward and time-reversed, combining outputs."""
+
+    layer: Optional[RecurrentLayerConfig] = None
+    mode: str = "concat"  # concat | add | mul | ave
+
+    EXPECTS = "rnn"
+    ACCEPTS_MASK = True
+
+    def output_type(self, itype: InputType) -> InputType:
+        inner = self.layer.output_type(itype)
+        size = inner.size * 2 if self.mode == "concat" else inner.size
+        return InputType.recurrent(size, itype.shape[0])
+
+    def init(self, key, itype):
+        k1, k2 = jax.random.split(key)
+        fwd, _ = self.layer.init(k1, itype)
+        bwd, _ = self.layer.init(k2, itype)
+        return {"fwd": fwd, "bwd": bwd}, {}
+
+    REGULARIZED = ()
+
+    def regularizable_params(self, lp):
+        out = []
+        for half in ("fwd", "bwd"):
+            if half in lp:
+                out.extend(self.layer.regularizable_params(lp[half]))
+        return out
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        carry = self.layer.init_carry(x.shape[0], x.dtype)
+        yf, _ = self.layer.apply_with_carry(
+            params["fwd"], x, carry, mask=mask, training=training, rng=rng
+        )
+        xr = jnp.flip(x, axis=1)
+        mr = None if mask is None else jnp.flip(mask, axis=1)
+        yb, _ = self.layer.apply_with_carry(
+            params["bwd"], xr, carry, mask=mr, training=training, rng=rng
+        )
+        yb = jnp.flip(yb, axis=1)
+        if self.mode == "concat":
+            return jnp.concatenate([yf, yb], axis=-1), state
+        if self.mode == "add":
+            return yf + yb, state
+        if self.mode == "mul":
+            return yf * yb, state
+        if self.mode == "ave":
+            return (yf + yb) / 2, state
+        raise ValueError(f"unknown Bidirectional mode {self.mode}")
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class LastTimeStep(LayerConfig):
+    """Collapse (B,T,H) -> (B,H) at the last UNMASKED step per example
+    (the reference's LastTimeStep wrapper)."""
+
+    EXPECTS = "rnn"
+    HAS_PARAMS = False
+    REGULARIZED = ()
+    ACCEPTS_MASK = True
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.feed_forward(itype.size)
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        if mask is None:
+            return x[:, -1, :], state
+        # index of the LAST nonzero mask entry per example (sum-1 would be
+        # wrong for non-contiguous masks)
+        T = x.shape[1]
+        idx = T - 1 - jnp.argmax(jnp.flip(mask, axis=1), axis=1)
+        idx = jnp.clip(idx.astype(jnp.int32), 0, T - 1)
+        return jnp.take_along_axis(x, idx[:, None, None], axis=1)[:, 0, :], state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class RnnOutputLayer(LayerConfig):
+    """Per-timestep dense + loss (the reference's RnnOutputLayer):
+    (B,T,H) -> (B,T,n_out) logits; the loss masks padded steps via
+    labels_mask."""
+
+    n_out: int = 0
+    loss: Loss = Loss.MCXENT
+    has_bias: bool = True
+
+    EXPECTS = "rnn"
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.recurrent(self.n_out, itype.shape[0])
+
+    def init(self, key, itype):
+        n_in = itype.size
+        w = self._winit().init(key, (n_in, self.n_out), fan_in=n_in, fan_out=self.n_out)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        y = x @ params["W"].astype(x.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(x.dtype)
+        return y, state  # logits; loss/activation handled by the model

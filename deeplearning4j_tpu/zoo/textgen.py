@@ -1,0 +1,51 @@
+"""TextGenerationLSTM — the reference zoo's char-RNN (GravesLSTM stack,
+BASELINE config 3 architecture): embedding-free one-hot chars ->
+2x GravesLSTM -> per-timestep softmax."""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    GravesLSTM,
+    InputType,
+    NeuralNetConfiguration,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.zoo.zoo_model import ZooModel
+
+
+class TextGenerationLSTM(ZooModel):
+    NAME = "textgenlstm"
+
+    def __init__(self, vocab_size: int = 77, hidden: int = 200, seed: int = 123,
+                 learning_rate: float = 1e-2, tbptt_length: int = 50):
+        super().__init__(vocab_size, seed)
+        self.vocab_size = vocab_size
+        self.hidden = hidden
+        self.learning_rate = learning_rate
+        self.tbptt_length = tbptt_length
+
+    def conf(self):
+        b = (
+            NeuralNetConfiguration.builder()
+            .seed(self.seed)
+            .updater(Adam(self.learning_rate))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(GravesLSTM(n_out=self.hidden, activation=Activation.TANH))
+            .layer(GravesLSTM(n_out=self.hidden, activation=Activation.TANH))
+            .layer(
+                RnnOutputLayer(
+                    n_out=self.vocab_size,
+                    loss=Loss.MCXENT,
+                    activation=Activation.SOFTMAX,
+                )
+            )
+            .set_input_type(InputType.recurrent(self.vocab_size))
+        )
+        if self.tbptt_length:
+            b.tbptt(self.tbptt_length)
+        return b.build()

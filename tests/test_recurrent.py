@@ -1,0 +1,245 @@
+"""Recurrent layer + TBPTT + streaming tests (BASELINE config 3 coverage)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet, NumpyDataSetIterator
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn import Adam
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    GRU,
+    Bidirectional,
+    Dense,
+    GravesLSTM,
+    InputType,
+    LSTM,
+    LastTimeStep,
+    NeuralNetConfiguration,
+    OutputLayer,
+    RnnOutputLayer,
+    SimpleRnn,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+
+KEY = jax.random.key(0)
+
+
+@pytest.mark.parametrize("cls", [LSTM, GravesLSTM, GRU, SimpleRnn])
+def test_rnn_layer_shapes(cls):
+    layer = cls(n_out=8, name="r")
+    itype = InputType.recurrent(5)
+    params, _ = layer.init(KEY, itype)
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(3, 7, 5)).astype(np.float32))
+    y, _ = layer.apply(params, {}, x, training=False, rng=None)
+    assert y.shape == (3, 7, 8)
+    assert np.all(np.isfinite(np.asarray(y)))
+
+
+def test_masked_steps_carry_state_and_zero_output():
+    layer = LSTM(n_out=4, name="r")
+    params, _ = layer.init(KEY, InputType.recurrent(3))
+    x = jnp.asarray(np.random.default_rng(0).normal(size=(2, 6, 3)).astype(np.float32))
+    mask = jnp.asarray([[1, 1, 1, 0, 0, 0], [1, 1, 1, 1, 1, 1]], jnp.float32)
+    y, _ = layer.apply(params, {}, x, training=False, rng=None, mask=mask)
+    arr = np.asarray(y)
+    # outputs at masked steps are zero
+    np.testing.assert_allclose(arr[0, 3:], 0.0, atol=1e-6)
+    # carry freezes at the mask boundary: recompute with truncated seq
+    carry = layer.init_carry(2, x.dtype)
+    _, fin_full = layer.apply_with_carry(params, x, carry, mask=mask)
+    _, fin_trunc = layer.apply_with_carry(
+        params, x[:, :3], layer.init_carry(2, x.dtype), mask=mask[:, :3]
+    )
+    np.testing.assert_allclose(
+        np.asarray(fin_full[0][0]), np.asarray(fin_trunc[0][0]), rtol=1e-5
+    )
+
+
+def test_streaming_equals_full_sequence():
+    """rnn_time_step over chunks must equal one full-sequence pass."""
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(4)
+        .updater(Adam(1e-3))
+        .list()
+        .layer(LSTM(n_out=6, activation=Activation.TANH))
+        .layer(RnnOutputLayer(n_out=3, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(2))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    x = np.random.default_rng(0).normal(size=(2, 8, 2)).astype(np.float32)
+    full = np.asarray(m.output(x))
+    m.rnn_clear_previous_state()
+    parts = [np.asarray(m.rnn_time_step(x[:, i : i + 2])) for i in range(0, 8, 2)]
+    stream = np.concatenate(parts, axis=1)
+    np.testing.assert_allclose(stream, full, rtol=1e-4, atol=1e-5)
+
+
+def test_sequence_classification_learns():
+    """Seq-to-one: classify whether the sum of a noisy sequence is positive."""
+    rng = np.random.default_rng(0)
+    n, T = 512, 12
+    x = rng.normal(0, 1, (n, T, 1)).astype(np.float32)
+    cls = (x.sum(axis=(1, 2)) > 0).astype(int)
+    y = np.eye(2, dtype=np.float32)[cls]
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(1)
+        .updater(Adam(5e-3))
+        .list()
+        .layer(LSTM(n_out=16, activation=Activation.TANH))
+        .layer(LastTimeStep())
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(1))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    m.fit(NumpyDataSetIterator(x, y, batch_size=64, seed=2), epochs=15)
+    assert m.evaluate(DataSet(x, y)).accuracy() > 0.9
+
+
+def test_char_rnn_learns_next_token():
+    """Seq-to-seq: learn a deterministic cyclic token sequence."""
+    V, T, n = 5, 20, 256
+    rng = np.random.default_rng(0)
+    starts = rng.integers(0, V, n)
+    seqs = (starts[:, None] + np.arange(T + 1)[None, :]) % V
+    x = np.eye(V, dtype=np.float32)[seqs[:, :-1]]
+    y = np.eye(V, dtype=np.float32)[seqs[:, 1:]]
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(2)
+        .updater(Adam(1e-2))
+        .list()
+        .layer(GravesLSTM(n_out=24, activation=Activation.TANH))
+        .layer(RnnOutputLayer(n_out=V, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(V))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    m.fit(NumpyDataSetIterator(x, y, batch_size=64, seed=3), epochs=20)
+    pred = np.asarray(m.output(x[:32])).argmax(axis=-1)
+    acc = (pred == seqs[:32, 1:]).mean()
+    assert acc > 0.95, f"next-token acc {acc}"
+
+
+def test_tbptt_trains_and_matches_window_count():
+    V, T = 4, 24
+    rng = np.random.default_rng(0)
+    starts = rng.integers(0, V, 64)
+    seqs = (starts[:, None] + np.arange(T + 1)[None, :]) % V
+    x = np.eye(V, dtype=np.float32)[seqs[:, :-1]]
+    y = np.eye(V, dtype=np.float32)[seqs[:, 1:]]
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(3)
+        .updater(Adam(5e-3))
+        .list()
+        .layer(LSTM(n_out=12, activation=Activation.TANH))
+        .layer(RnnOutputLayer(n_out=V, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(V))
+        .tbptt(8)
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    m.fit_batch(DataSet(x, y))
+    # 24 timesteps / window 8 = 3 optimizer steps
+    assert m.iteration == 3
+    for _ in range(30):
+        m.fit_batch(DataSet(x, y))
+    pred = np.asarray(m.output(x[:16])).argmax(axis=-1)
+    acc = (pred == seqs[:16, 1:]).mean()
+    assert acc > 0.9, f"tbptt next-token acc {acc}"
+
+
+def test_bidirectional_shapes_and_training():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(5)
+        .updater(Adam(5e-3))
+        .list()
+        .layer(Bidirectional(layer=LSTM(n_out=8, activation=Activation.TANH)))
+        .layer(LastTimeStep())
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(3))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    x = np.random.default_rng(0).normal(size=(4, 6, 3)).astype(np.float32)
+    out = m.output(x)
+    assert out.shape == (4, 2)
+    m.fit_batch(DataSet(x, np.eye(2, dtype=np.float32)[[0, 1, 0, 1]]))
+    assert np.isfinite(m.score_value)
+    # concat mode doubles the feature size into the next layer
+    assert m.params["layer0"]["fwd"]["Wx"].shape == (3, 32)
+
+
+def test_variable_length_masked_training():
+    rng = np.random.default_rng(0)
+    n, T = 256, 10
+    lengths = rng.integers(3, T + 1, n)
+    x = rng.normal(0, 1, (n, T, 1)).astype(np.float32)
+    fmask = (np.arange(T)[None, :] < lengths[:, None]).astype(np.float32)
+    x = x * fmask[..., None]
+    sums = (x[..., 0] * fmask).sum(axis=1)
+    y = np.eye(2, dtype=np.float32)[(sums > 0).astype(int)]
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(6)
+        .updater(Adam(5e-3))
+        .list()
+        .layer(LSTM(n_out=12, activation=Activation.TANH))
+        .layer(LastTimeStep())
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(1))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    for _ in range(40):
+        m.fit_batch(DataSet(x, y, features_mask=fmask))
+    probs = np.asarray(m.output(x, fmask))
+    acc = (probs.argmax(axis=1) == y.argmax(axis=1)).mean()
+    assert acc > 0.9, f"masked acc {acc}"
+
+
+def test_textgen_zoo_builds():
+    from deeplearning4j_tpu.zoo.textgen import TextGenerationLSTM
+
+    m = TextGenerationLSTM(vocab_size=10, hidden=16, tbptt_length=5).init_model()
+    out = m.output(np.zeros((2, 7, 10), np.float32))
+    assert out.shape == (2, 7, 10)
+
+
+def test_last_timestep_non_contiguous_mask():
+    from deeplearning4j_tpu.nn.conf import LastTimeStep
+
+    layer = LastTimeStep(name="lts")
+    x = jnp.asarray(np.arange(2 * 4 * 3, dtype=np.float32).reshape(2, 4, 3))
+    mask = jnp.asarray([[1, 0, 1, 0], [1, 1, 1, 1]], jnp.float32)
+    y, _ = layer.apply({}, {}, x, mask=mask)
+    np.testing.assert_array_equal(np.asarray(y[0]), np.asarray(x[0, 2]))
+    np.testing.assert_array_equal(np.asarray(y[1]), np.asarray(x[1, 3]))
+
+
+def test_global_max_pooling_respects_mask():
+    from deeplearning4j_tpu.nn.conf import GlobalPooling, PoolingType
+
+    layer = GlobalPooling(pooling=PoolingType.MAX, name="gp")
+    # valid activations all negative; padding zeros must NOT win the max
+    x = jnp.asarray([[[-3.0], [-1.0], [0.0], [0.0]]], jnp.float32)
+    mask = jnp.asarray([[1, 1, 0, 0]], jnp.float32)
+    y, _ = layer.apply({}, {}, x, mask=mask)
+    np.testing.assert_allclose(np.asarray(y), [[-1.0]])
+
+
+def test_rnn_l2_regularization_not_noop():
+    from deeplearning4j_tpu.models._common import regularization_loss
+
+    layer = LSTM(n_out=4, name="r", l2=0.1)
+    params, _ = layer.init(KEY, InputType.recurrent(3))
+    reg = regularization_loss({"r": params}, [("r", layer)])
+    assert float(reg) > 0.0
