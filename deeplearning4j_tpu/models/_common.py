@@ -66,11 +66,7 @@ def regularization_loss(params, named_layers) -> jax.Array:
         lp = params.get(name)
         if not lp:
             continue
-        l1 = layer.l1 or 0.0
-        l2 = layer.l2 or 0.0
-        if l1 == 0.0 and l2 == 0.0:
-            continue
-        for w in layer.regularizable_params(lp):
+        for l1, l2, w in layer.regularization_terms(lp):
             w = w.astype(jnp.float32)
             if l1:
                 reg = reg + l1 * jnp.sum(jnp.abs(w))

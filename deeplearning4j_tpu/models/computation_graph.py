@@ -231,14 +231,18 @@ class GraphModel(Model):
             )
         n_masks = len(masks) if masks is not None else 0
         step = self._get_step_fn(n_masks)
+        from deeplearning4j_tpu.parallel.data_parallel import place_batch
+
         self.params, self.opt_state, self.net_state, loss = step(
             self.params,
             self.opt_state,
             self.net_state,
             jnp.uint32(self.iteration),
-            tuple(mds.features),
-            tuple(mds.labels),
-            tuple(masks) if masks is not None else (),
+            tuple(place_batch(self, f) for f in mds.features),
+            tuple(place_batch(self, l, is_label=True) for l in mds.labels),
+            tuple(place_batch(self, m, is_mask=True) for m in masks)
+            if masks is not None
+            else (),
         )
         self._last_score = loss
         self.last_batch_size = mds.num_examples

@@ -218,6 +218,8 @@ class SequentialModel(Model):
         self._run_step(batch, carries=None)
 
     def _run_step(self, batch: DataSet, carries):
+        from deeplearning4j_tpu.parallel.data_parallel import place_batch
+
         has_lmask = batch.labels_mask is not None
         has_fmask = batch.features_mask is not None
         with_carries = carries is not None
@@ -228,10 +230,10 @@ class SequentialModel(Model):
             self.opt_state,
             self.net_state,
             jnp.uint32(self.iteration),
-            batch.features,
-            batch.labels,
-            batch.labels_mask if has_lmask else empty,
-            batch.features_mask if has_fmask else empty,
+            place_batch(self, batch.features),
+            place_batch(self, batch.labels, is_label=True),
+            place_batch(self, batch.labels_mask, is_mask=True) if has_lmask else empty,
+            place_batch(self, batch.features_mask, is_mask=True) if has_fmask else empty,
             carries if with_carries else {},
         )
         self._last_score = loss
@@ -245,13 +247,24 @@ class SequentialModel(Model):
         gradients are confined to each window, RNN carries flow across
         windows (values only — the window boundary stops the gradient,
         matching BackpropType.TruncatedBPTT)."""
+        from deeplearning4j_tpu.nn.conf.recurrent import Bidirectional
+
         T = batch.features.shape[1]
         L = self.conf.tbptt_length
+        if self.conf.output_type().kind != "rnn":
+            raise ValueError(
+                "TBPTT requires a per-timestep output (RnnOutputLayer); this "
+                "network collapses the time axis — use standard backprop"
+            )
+        if any(isinstance(l, Bidirectional) for l in self.conf.layers):
+            raise ValueError(
+                "TBPTT is undefined for bidirectional networks (the backward "
+                "direction crosses window boundaries) — use standard backprop"
+            )
         if batch.labels.ndim < 2 or batch.labels.shape[1] != T:
             raise ValueError(
                 "TBPTT needs per-timestep labels with a (B, T, ...) time "
-                f"axis matching features; got {batch.labels.shape} for "
-                f"T={T} — use standard backprop for sequence-to-one models"
+                f"axis matching features; got {batch.labels.shape} for T={T}"
             )
         carries: dict = {}
         for t0 in range(0, T, L):
@@ -315,6 +328,13 @@ class SequentialModel(Model):
         token-by-token generation loops stay fast."""
         if self.params is None:
             self.init()
+        from deeplearning4j_tpu.nn.conf.recurrent import Bidirectional
+
+        if any(isinstance(l, Bidirectional) for l in self.conf.layers):
+            raise ValueError(
+                "rnn_time_step is undefined for bidirectional networks (the "
+                "backward pass needs the full future sequence) — use output()"
+            )
         if not getattr(self, "_rnn_stream_state", None):
             self._rnn_stream_state = self._init_carries(features.shape[0])
         key = "rnn_step"

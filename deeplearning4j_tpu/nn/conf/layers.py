@@ -99,6 +99,14 @@ class LayerConfig:
         dicts override this)."""
         return [lp[p] for p in self.REGULARIZED if p in lp]
 
+    def regularization_terms(self, lp: dict) -> list:
+        """(l1, l2, array) triples — wrappers override to surface their
+        inner layer's own coefficients."""
+        l1, l2 = self.l1 or 0.0, self.l2 or 0.0
+        if not l1 and not l2:
+            return []
+        return [(l1, l2, w) for w in self.regularizable_params(lp)]
+
     def _act(self, default=Activation.IDENTITY) -> Activation:
         return self.activation if self.activation is not None else default
 

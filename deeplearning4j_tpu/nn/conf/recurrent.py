@@ -285,6 +285,15 @@ class Bidirectional(LayerConfig):
                 out.extend(self.layer.regularizable_params(lp[half]))
         return out
 
+    def regularization_terms(self, lp):
+        # outer coefficients win when set (builder defaults land on the
+        # wrapper); otherwise the inner layer's own l1/l2 apply
+        l1 = self.l1 if self.l1 is not None else (self.layer.l1 or 0.0)
+        l2 = self.l2 if self.l2 is not None else (self.layer.l2 or 0.0)
+        if not l1 and not l2:
+            return []
+        return [(l1, l2, w) for w in self.regularizable_params(lp)]
+
     def apply(self, params, state, x, *, training=False, rng=None, mask=None):
         carry = self.layer.init_carry(x.shape[0], x.dtype)
         yf, _ = self.layer.apply_with_carry(

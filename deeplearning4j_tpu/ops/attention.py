@@ -1,0 +1,171 @@
+"""Attention ops: dense MHA + sequence-parallel ring / Ulysses variants.
+
+The reference exposes attention only as the `multi_head_dot_product_attention`
+custom op + SelfAttentionLayer, single-device O(T^2) (SURVEY.md §5.7).  The
+TPU build makes long-context first-class:
+
+- `mha`: standard fused attention for one device (XLA fuses the softmax
+  chain; the two matmuls ride the MXU).
+- `ring_attention`: Q stays put, KV blocks rotate around the `seq` mesh
+  axis via ppermute with flash-style ONLINE SOFTMAX accumulation (running
+  rowmax m, normalizer l, weighted values o) — exact attention over the
+  full sequence with per-device memory O(T_local^2-ish), communication
+  overlapped with compute by XLA.
+- `ulysses_attention`: all_to_all scatters heads / gathers sequence, runs
+  dense local attention on H/P heads of the FULL sequence, then the
+  inverse all_to_all — cheaper collectives when H >= P.
+
+Shapes: (B, T, H, D) batch, time, heads, head_dim.  All functions are pure
+and differentiable; the ring/ulysses versions must run inside
+shard_map/pjit with the named `axis` present in the mesh.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _scale(d: int) -> float:
+    return 1.0 / (d**0.5)
+
+
+def mha(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    causal: bool = False,
+    mask: jax.Array | None = None,
+    q_offset=0,
+    kv_offset=0,
+) -> jax.Array:
+    """Dense attention. q,k,v: (B, Tq|Tk, H, D) -> (B, Tq, H, D).
+
+    q_offset/kv_offset: global position offsets (used by ring attention for
+    cross-shard causal masking); scalars or traced ints.
+    """
+    d = q.shape[-1]
+    logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * _scale(d)
+    logits = logits.astype(jnp.float32)
+    if causal:
+        qi = jnp.arange(q.shape[1]) + q_offset
+        ki = jnp.arange(k.shape[1]) + kv_offset
+        cmask = qi[:, None] >= ki[None, :]
+        logits = jnp.where(cmask[None, None], logits, -jnp.inf)
+    if mask is not None:
+        # mask: (B, Tk) keep-mask over keys
+        logits = jnp.where(mask[:, None, None, :] > 0, logits, -jnp.inf)
+    # guard fully-masked rows (softmax of all -inf)
+    w = jax.nn.softmax(logits, axis=-1)
+    w = jnp.where(jnp.isnan(w), 0.0, w)
+    return jnp.einsum("bhqk,bkhd->bqhd", w.astype(q.dtype), v)
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str,
+    causal: bool = False,
+    mask: jax.Array | None = None,
+) -> jax.Array:
+    """Exact attention with KV rotating around the `axis` ring.
+
+    Called under shard_map with the sequence dim sharded over `axis`:
+    q,k,v are the LOCAL (B, T_local, H, D) shards.  Returns the local
+    output shard.  mask: local (B, T_local) keep-mask over this shard's
+    keys (rotates with KV).
+    """
+    n = lax.axis_size(axis)
+    idx = lax.axis_index(axis)
+    t_local = q.shape[1]
+    d = q.shape[-1]
+    scale = _scale(d)
+
+    q32 = q.astype(jnp.float32)
+    q_off = idx * t_local
+
+    def block(carry, kv_and_src):
+        o, m, l = carry
+        kb, vb, src, mb = kv_and_src
+        logits = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32)) * scale
+        if causal:
+            qi = jnp.arange(t_local) + q_off
+            ki = jnp.arange(t_local) + src * t_local
+            cmask = qi[:, None] >= ki[None, :]
+            logits = jnp.where(cmask[None, None], logits, -jnp.inf)
+        if mb is not None:
+            logits = jnp.where(mb[:, None, None, :] > 0, logits, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        # guard: rows with no unmasked key yet keep m=-inf; exp(-inf - -inf)
+        safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
+        p = jnp.exp(logits - safe_m[..., None])
+        p = jnp.where(jnp.isneginf(logits), 0.0, p)
+        corr = jnp.where(jnp.isneginf(m), 0.0, jnp.exp(m - safe_m))
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] + jnp.einsum(
+            "bhqk,bkhd->bhqd", p, vb.astype(jnp.float32)
+        )
+        return (o_new, m_new, l_new), None
+
+    b, h = q.shape[0], q.shape[2]
+    o = jnp.zeros((b, h, t_local, d), jnp.float32)
+    m = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
+    l = jnp.zeros((b, h, t_local), jnp.float32)
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    kb, vb = k, v
+    src = idx
+    mb = mask
+    carry = (o, m, l)
+    # n steps: process local block, then rotate KV (and its mask/source id)
+    for _ in range(n):
+        carry, _ = block(carry, (kb, vb, src, mb))
+        kb = lax.ppermute(kb, axis, perm)
+        vb = lax.ppermute(vb, axis, perm)
+        if mb is not None:
+            mb = lax.ppermute(mb, axis, perm)
+        src = lax.ppermute(src, axis, perm)
+    o, m, l = carry
+    l = jnp.maximum(l, 1e-20)
+    out = (o / l[..., None]).astype(q.dtype)
+    return jnp.einsum("bhqd->bqhd", out)
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    *,
+    axis: str,
+    causal: bool = False,
+    mask: jax.Array | None = None,
+) -> jax.Array:
+    """DeepSpeed-Ulysses style: all_to_all heads<->sequence, dense local
+    attention over the FULL sequence on H/P heads, inverse all_to_all.
+
+    Under shard_map with seq sharded on `axis`; requires H % axis_size == 0.
+    q,k,v local: (B, T_local, H, D) -> returns (B, T_local, H, D).
+    mask: local (B, T_local) keep-mask (all-gathered internally).
+    """
+    n = lax.axis_size(axis)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"ulysses needs heads ({h}) divisible by axis size ({n})")
+
+    def scatter_heads(x):
+        # (B, T_local, H, D) -> (B, T_full, H/P, D)
+        return lax.all_to_all(x, axis, split_axis=2, concat_axis=1, tiled=True)
+
+    def gather_heads(x):
+        return lax.all_to_all(x, axis, split_axis=1, concat_axis=2, tiled=True)
+
+    qf, kf, vf = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    mf = None
+    if mask is not None:
+        mf = lax.all_gather(mask, axis, axis=1, tiled=True)  # (B, T_full)
+    out = mha(qf, kf, vf, causal=causal, mask=mf)
+    return gather_heads(out)

@@ -5,3 +5,15 @@ masters and the Aeron parameter server (SURVEY.md §2.3) with sharding +
 XLA collectives, and adds the strategies the reference lacks: tensor,
 pipeline, sequence/context (ring attention, Ulysses) and expert parallel.
 """
+
+from deeplearning4j_tpu.parallel.data_parallel import distribute
+from deeplearning4j_tpu.parallel.strategy import ParallelConfig, param_specs
+from deeplearning4j_tpu.parallel.wrapper import ParallelInference, ParallelWrapper
+
+__all__ = [
+    "distribute",
+    "ParallelConfig",
+    "param_specs",
+    "ParallelWrapper",
+    "ParallelInference",
+]

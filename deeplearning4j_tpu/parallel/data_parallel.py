@@ -1,0 +1,68 @@
+"""distribute(): attach a mesh + shardings to a model's compiled fit().
+
+The ParallelWrapper capability (one model, N devices, synchronized
+updates — SURVEY.md §2.3) expressed TPU-natively: params/opt-state are
+placed with NamedShardings (replicated for DP, partitioned on "model" for
+TP), each batch is placed with the batch sharding, and the SAME jitted
+train step the single-chip path uses becomes an SPMD program — GSPMD
+inserts the gradient AllReduce over ICI that the reference implemented as
+threshold-encoded Aeron gossip.
+
+Works for SequentialModel and GraphModel.  Usage:
+
+    model = SequentialModel(conf).init()
+    distribute(model, ParallelConfig(data=-1, model=2))
+    model.fit(iterator)        # now data-parallel over the mesh
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.strategy import (
+    ParallelConfig,
+    batch_sharding,
+    param_specs,
+    replicate,
+    shard_params,
+)
+from deeplearning4j_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=None):
+    """Place an initialized model's state onto a mesh and make fit()/output()
+    shard incoming batches.  Returns the model (for chaining)."""
+    if model.params is None:
+        model.init()
+    config = config or ParallelConfig.data_parallel()
+    mesh = mesh or config.build_mesh(devices)
+
+    tp = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
+    if tp:
+        specs = param_specs(model.params, model.conf)
+        model.params = shard_params(model.params, mesh, specs)
+    else:
+        model.params = replicate(model.params, mesh)
+    model.net_state = replicate(model.net_state, mesh)
+    model.opt_state = replicate(model.opt_state, mesh)
+
+    sp = SEQ_AXIS if SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1 else None
+    model._mesh = mesh
+    model._batch_sharding = batch_sharding(mesh, seq_axis=sp)
+    # labels/masks may lack the time axis (seq-to-one): shard batch dim only
+    # and let GSPMD reshard per-timestep labels if profitable
+    model._label_sharding = NamedSharding(mesh, P(DATA_AXIS))
+    return model
+
+
+def place_batch(model, arr, is_mask: bool = False, is_label: bool = False):
+    """Shard a host batch array onto the model's mesh (no-op when the model
+    was never distributed)."""
+    sharding = getattr(model, "_batch_sharding", None)
+    if sharding is None or arr is None or np.ndim(arr) == 0 or np.size(arr) == 0:
+        return arr
+    if is_mask or is_label:
+        sharding = getattr(model, "_label_sharding", sharding)
+    return jax.device_put(arr, sharding)

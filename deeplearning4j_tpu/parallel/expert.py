@@ -1,0 +1,84 @@
+"""Expert parallelism — Mixture-of-Experts FFN with all_to_all dispatch.
+
+Absent from the reference (SURVEY.md §2.3: "Expert parallel: NO");
+first-class here.  Top-k router -> capacity-bounded dispatch tensor ->
+einsum dispatch -> expert FFN (experts sharded over the "expert" mesh
+axis via shard_map; tokens reach their expert through the all_to_all that
+GSPMD derives from the sharded einsum) -> combine weighted outputs.
+Dense dispatch/combine einsums keep everything MXU-shaped and
+differentiable; the load-balancing auxiliary loss follows Switch
+Transformer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    n_experts: int = 8
+    d_model: int = 512
+    d_hidden: int = 2048
+    top_k: int = 2
+    capacity_factor: float = 1.25
+
+
+def init_moe(key: jax.Array, cfg: MoEConfig) -> dict:
+    k1, k2, k3 = jax.random.split(key, 3)
+    s1 = (2.0 / cfg.d_model) ** 0.5
+    s2 = (2.0 / cfg.d_hidden) ** 0.5
+    return {
+        "router": jax.random.normal(k1, (cfg.d_model, cfg.n_experts)) * s1,
+        "Wi": jax.random.normal(k2, (cfg.n_experts, cfg.d_model, cfg.d_hidden)) * s1,
+        "Wo": jax.random.normal(k3, (cfg.n_experts, cfg.d_hidden, cfg.d_model)) * s2,
+    }
+
+
+def moe_apply(params: dict, x: jax.Array, cfg: MoEConfig):
+    """x: (B, T, d_model) -> (y, aux_loss).
+
+    Pure function; shard params["Wi"/"Wo"] on the "expert" axis (leading
+    dim) and GSPMD turns the dispatch einsums into all_to_all over ICI.
+    """
+    b, t, d = x.shape
+    n_tok = b * t
+    e = cfg.n_experts
+    cap = max(1, int(cfg.capacity_factor * n_tok * cfg.top_k / e))
+
+    xf = x.reshape(n_tok, d)
+    logits = (xf.astype(jnp.float32)) @ params["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)          # (N, E)
+
+    # top-k selection per token
+    gate_vals, gate_idx = jax.lax.top_k(probs, cfg.top_k)   # (N, k)
+
+    # position of each (token, choice) within its expert's capacity buffer
+    onehot = jax.nn.one_hot(gate_idx, e, dtype=jnp.int32)    # (N, k, E)
+    flat_choice = onehot.reshape(n_tok * cfg.top_k, e)
+    pos_in_expert = jnp.cumsum(flat_choice, axis=0) * flat_choice  # 1-based
+    pos = (pos_in_expert.reshape(n_tok, cfg.top_k, e).sum(-1) - 1)  # (N, k)
+    kept = (pos >= 0) & (pos < cap)
+
+    # dispatch (N, E, C) and gate-weighted combine tensors
+    oh_e = jax.nn.one_hot(gate_idx, e, dtype=jnp.float32)               # (N, k, E)
+    oh_c = jax.nn.one_hot(jnp.clip(pos, 0, cap - 1), cap, dtype=jnp.float32)  # (N, k, C)
+    keep = kept.astype(jnp.float32)                                     # (N, k)
+    disp = jnp.einsum("nke,nkc,nk->nec", oh_e, oh_c, keep)
+    comb = jnp.einsum("nke,nkc,nk->nec", oh_e, oh_c, keep * gate_vals)
+
+    # route tokens: (E, C, D)
+    expert_in = jnp.einsum("nec,nd->ecd", disp, xf.astype(jnp.float32))
+    h = jax.nn.relu(jnp.einsum("ecd,edh->ech", expert_in, params["Wi"].astype(jnp.float32)))
+    expert_out = jnp.einsum("ech,ehd->ecd", h, params["Wo"].astype(jnp.float32))
+    y = jnp.einsum("nec,ecd->nd", comb, expert_out)
+
+    # Switch-style load-balance loss
+    frac_tokens = jnp.mean(jax.nn.one_hot(gate_idx[:, 0], e, dtype=jnp.float32), axis=0)
+    frac_probs = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(frac_tokens * frac_probs)
+
+    return y.reshape(b, t, d).astype(x.dtype), aux

@@ -1,0 +1,93 @@
+"""Pipeline parallelism — GPipe-style microbatch pipeline over a mesh axis.
+
+Absent from the reference (SURVEY.md §2.3: "Pipeline parallel: NO");
+first-class here.  All `pipe`-axis devices run the same shard_map program:
+each holds ONE stage's params; activations flow stage-to-stage via
+lax.ppermute.  The schedule runs n_micro + n_stages - 1 ticks (the classic
+GPipe bubble); every tick each device applies its stage to whatever just
+arrived and passes the result on.  The whole schedule is one lax.scan —
+differentiable end-to-end (ppermute transposes to the reverse permute), so
+jax.grad through `pipeline_apply` IS the backward pipeline.
+
+The stage fn must be shape-preserving in its pipelined activation
+(classic transformer-block stacks) — inter-stage reshapes belong inside a
+stage.
+"""
+
+from __future__ import annotations
+
+from collections.abc import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    x_micro: jax.Array,
+    *,
+    axis: str,
+):
+    """Run the pipelined stack under shard_map.
+
+    stage_fn(params, x) -> y, applied by every device to its own stage.
+    stage_params: the LOCAL stage's params (leading stage dim already
+    sharded away by shard_map in_specs).
+    x_micro: (n_micro, B_micro, ...) microbatches — full copy on stage 0's
+    view (replicated in_spec); only stage 0 feeds them in.
+    Returns (n_micro, B_micro, ...) outputs valid on the LAST stage
+    (read them with an out_spec that takes the last pipe shard).
+    """
+    n_stages = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    n_micro = x_micro.shape[0]
+    total = n_micro + n_stages - 1
+    perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    buf_shape = x_micro.shape[1:]
+    state = jnp.zeros(buf_shape, x_micro.dtype)
+    outputs = jnp.zeros((n_micro,) + buf_shape, x_micro.dtype)
+
+    def tick(carry, t):
+        state, outputs = carry
+        # stage 0 ingests microbatch t (while t < n_micro)
+        feed = x_micro[jnp.minimum(t, n_micro - 1)]
+        state = jnp.where(stage == 0, feed, state)
+        y = stage_fn(stage_params, state)
+        # last stage writes its result for microbatch (t - n_stages + 1)
+        out_idx = t - (n_stages - 1)
+        valid = (stage == n_stages - 1) & (out_idx >= 0)
+        outputs = lax.cond(
+            valid,
+            lambda o: lax.dynamic_update_index_in_dim(
+                o, y, jnp.maximum(out_idx, 0), axis=0
+            ),
+            lambda o: o,
+            outputs,
+        )
+        # pass activations to the next stage
+        state = lax.ppermute(y, axis, perm)
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (state, outputs), jnp.arange(total))
+    # only the last stage holds real outputs; psum the masked buffers so
+    # every device returns the same tensor (enables replicated out_specs
+    # and keeps the consumer oblivious to which shard "owns" the result)
+    outputs = lax.psum(
+        jnp.where(stage == n_stages - 1, outputs, jnp.zeros_like(outputs)), axis
+    )
+    return outputs
+
+
+def split_microbatches(x: jax.Array, n_micro: int) -> jax.Array:
+    """(B, ...) -> (n_micro, B/n_micro, ...)."""
+    b = x.shape[0]
+    if b % n_micro:
+        raise ValueError(f"batch {b} not divisible into {n_micro} microbatches")
+    return x.reshape((n_micro, b // n_micro) + x.shape[1:])
+
+
+def merge_microbatches(y: jax.Array) -> jax.Array:
+    return y.reshape((-1,) + y.shape[2:])

@@ -1,0 +1,63 @@
+"""ParallelWrapper / ParallelInference — API-compatible facades.
+
+The reference's ParallelWrapper clones the model per GPU, round-robins
+batches to trainer threads and merges updates via averaging or encoded
+gradients (SURVEY.md §3.4).  On TPU the same capability is one SPMD
+program: `ParallelWrapper(model).fit(iterator)` distributes the model
+data-parallel over all local devices and runs the normal compiled step —
+synchronization IS the gradient AllReduce XLA inserts, which is strictly
+stronger than the reference's async encoded exchange (exact, every step).
+
+ParallelInference covers the reference's batched multi-device serving:
+requests are padded/split to the device count and run under the same
+sharded forward.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.parallel.data_parallel import distribute
+from deeplearning4j_tpu.parallel.strategy import ParallelConfig
+
+
+class ParallelWrapper:
+    def __init__(self, model, config: ParallelConfig | None = None, devices=None):
+        self.model = model
+        self._config = config or ParallelConfig.data_parallel()
+        self._devices = devices
+        self._distributed = False
+
+    def _ensure(self):
+        if not self._distributed:
+            distribute(self.model, self._config, self._devices)
+            self._distributed = True
+
+    def fit(self, data, epochs: int = 1, **kw) -> None:
+        self._ensure()
+        self.model.fit(data, epochs=epochs, **kw)
+
+    def output(self, *features, **kw):
+        self._ensure()
+        return self.model.output(*features, **kw)
+
+
+class ParallelInference:
+    """Batched inference facade (the reference's request-coalescing
+    InferenceWorker becomes: pad to a device-divisible batch, run the
+    sharded forward, slice the answer)."""
+
+    def __init__(self, model, config: ParallelConfig | None = None, devices=None):
+        self.model = model
+        distribute(model, config or ParallelConfig.data_parallel(), devices)
+        self._n = int(np.prod(list(model._mesh.shape.values())))
+
+    def output(self, features: np.ndarray) -> np.ndarray:
+        b = features.shape[0]
+        pad = (-b) % self._n
+        if pad:
+            features = np.concatenate(
+                [features, np.repeat(features[-1:], pad, axis=0)], axis=0
+            )
+        out = np.asarray(self.model.output(features))
+        return out[:b]

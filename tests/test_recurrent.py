@@ -243,3 +243,47 @@ def test_rnn_l2_regularization_not_noop():
     params, _ = layer.init(KEY, InputType.recurrent(3))
     reg = regularization_loss({"r": params}, [("r", layer)])
     assert float(reg) > 0.0
+
+
+def test_bidirectional_inner_regularization_counts():
+    from deeplearning4j_tpu.models._common import regularization_loss
+
+    layer = Bidirectional(layer=LSTM(n_out=4, l2=0.1), name="bi")
+    params, _ = layer.init(KEY, InputType.recurrent(3))
+    reg = regularization_loss({"bi": params}, [("bi", layer)])
+    assert float(reg) > 0.0
+
+
+def test_rnn_time_step_rejects_bidirectional():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .updater(Adam(1e-3))
+        .list()
+        .layer(Bidirectional(layer=LSTM(n_out=4, activation=Activation.TANH)))
+        .layer(RnnOutputLayer(n_out=2, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(3))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    with pytest.raises(ValueError, match="bidirectional"):
+        m.rnn_time_step(np.zeros((1, 2, 3), np.float32))
+
+
+def test_tbptt_rejects_seq_to_one():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .updater(Adam(1e-3))
+        .list()
+        .layer(LSTM(n_out=4, activation=Activation.TANH))
+        .layer(LastTimeStep())
+        .layer(OutputLayer(n_out=12, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(2))
+        .tbptt(4)
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    # 12 classes == T: the old shape-only guard would false-pass
+    x = np.zeros((2, 12, 2), np.float32)
+    y = np.eye(12, dtype=np.float32)[[0, 1]]
+    with pytest.raises(ValueError, match="per-timestep output"):
+        m.fit_batch(DataSet(x, y))
