@@ -1,1 +1,5 @@
 """Autodiff graph API — the SameDiff role, compiled instead of interpreted."""
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable, TrainingConfig
+
+__all__ = ["SameDiff", "SDVariable", "TrainingConfig"]

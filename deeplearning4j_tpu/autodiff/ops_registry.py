@@ -1,0 +1,159 @@
+"""Op registry for the autodiff graph — named, serializable op set.
+
+The reference maps each SameDiff op onto a libnd4j opNum executed one JNI
+call at a time (SURVEY.md §3.3).  Here each op name maps to a pure jnp
+function; a recorded graph stores op NAMES (strings) + attrs, so graphs
+serialize/deserialize without pickling code, and execution traces the
+whole graph into ONE XLA computation.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def _conv2d(x, w, *, stride=(1, 1), padding="SAME"):
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _max_pool2d(x, *, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+    return jax.lax.reduce_window(
+        x, -jnp.inf, jax.lax.max,
+        (1, *kernel, 1), (1, *stride, 1), padding,
+    )
+
+
+def _avg_pool2d(x, *, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+    dims, strides = (1, *kernel, 1), (1, *stride, 1)
+    s = jax.lax.reduce_window(x, 0.0, jax.lax.add, dims, strides, padding)
+    if padding == "SAME":
+        # divide by the per-window count of REAL elements, not kernel area
+        cnt = jax.lax.reduce_window(
+            jnp.ones_like(x), 0.0, jax.lax.add, dims, strides, padding
+        )
+        return s / cnt
+    return s / (kernel[0] * kernel[1])
+
+
+def _layer_norm(x, gamma, beta, *, epsilon=1e-5):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + epsilon) * gamma + beta
+
+
+def _softmax_cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.sum(labels * logp, axis=-1))
+
+
+def _sparse_softmax_cross_entropy(logits, labels):
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    picked = jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32), axis=-1)
+    return -jnp.mean(picked)
+
+
+def _sigmoid_cross_entropy(logits, labels):
+    per = jnp.maximum(logits, 0) - logits * labels + jnp.log1p(jnp.exp(-jnp.abs(logits)))
+    return jnp.mean(per)
+
+
+OPS: dict[str, callable] = {
+    # elementwise arithmetic
+    "add": jnp.add,
+    "sub": jnp.subtract,
+    "mul": jnp.multiply,
+    "div": jnp.divide,
+    "pow": jnp.power,
+    "neg": jnp.negative,
+    "abs": jnp.abs,
+    "exp": jnp.exp,
+    "log": jnp.log,
+    "sqrt": jnp.sqrt,
+    "square": jnp.square,
+    "rsqrt": jax.lax.rsqrt,
+    "sign": jnp.sign,
+    "floor": jnp.floor,
+    "ceil": jnp.ceil,
+    "clip": lambda x, *, lo, hi: jnp.clip(x, lo, hi),
+    "maximum": jnp.maximum,
+    "minimum": jnp.minimum,
+    # comparisons / selection
+    "greater": lambda a, b: (a > b).astype(jnp.float32),
+    "less": lambda a, b: (a < b).astype(jnp.float32),
+    "equal": lambda a, b: (a == b).astype(jnp.float32),
+    "where": jnp.where,
+    # linalg
+    "matmul": jnp.matmul,
+    "transpose": lambda x, *, axes=None: jnp.transpose(x, axes),
+    "einsum": lambda *xs, equation: jnp.einsum(equation, *xs),
+    "tensordot": lambda a, b, *, axes=2: jnp.tensordot(a, b, axes=axes),
+    # shape
+    "reshape": lambda x, *, shape: jnp.reshape(x, shape),
+    "concat": lambda *xs, axis=-1: jnp.concatenate(xs, axis=axis),
+    "stack": lambda *xs, axis=0: jnp.stack(xs, axis=axis),
+    "squeeze": lambda x, *, axis: jnp.squeeze(x, axis=axis),
+    "expand_dims": lambda x, *, axis: jnp.expand_dims(x, axis),
+    "slice": lambda x, *, begin, size: jax.lax.dynamic_slice(x, begin, size),
+    "gather": lambda x, idx, *, axis=0: jnp.take(x, idx.astype(jnp.int32), axis=axis),
+    "one_hot": lambda x, *, depth: jax.nn.one_hot(x.astype(jnp.int32), depth),
+    "tile": lambda x, *, reps: jnp.tile(x, reps),
+    "pad": lambda x, *, paddings: jnp.pad(x, paddings),
+    # reductions
+    "sum": lambda x, *, axis=None, keepdims=False: jnp.sum(x, axis=_ax(axis), keepdims=keepdims),
+    "mean": lambda x, *, axis=None, keepdims=False: jnp.mean(x, axis=_ax(axis), keepdims=keepdims),
+    "max": lambda x, *, axis=None, keepdims=False: jnp.max(x, axis=_ax(axis), keepdims=keepdims),
+    "min": lambda x, *, axis=None, keepdims=False: jnp.min(x, axis=_ax(axis), keepdims=keepdims),
+    "prod": lambda x, *, axis=None, keepdims=False: jnp.prod(x, axis=_ax(axis), keepdims=keepdims),
+    "var": lambda x, *, axis=None, keepdims=False: jnp.var(x, axis=_ax(axis), keepdims=keepdims),
+    "std": lambda x, *, axis=None, keepdims=False: jnp.std(x, axis=_ax(axis), keepdims=keepdims),
+    "argmax": lambda x, *, axis=-1: jnp.argmax(x, axis=axis),
+    "argmin": lambda x, *, axis=-1: jnp.argmin(x, axis=axis),
+    "norm2": lambda x, *, axis=None: jnp.sqrt(jnp.sum(jnp.square(x), axis=_ax(axis))),
+    "cumsum": lambda x, *, axis=0: jnp.cumsum(x, axis=axis),
+    # activations
+    "relu": jax.nn.relu,
+    "relu6": jax.nn.relu6,
+    "leaky_relu": lambda x, *, alpha=0.01: jax.nn.leaky_relu(x, alpha),
+    "elu": jax.nn.elu,
+    "selu": jax.nn.selu,
+    "gelu": jax.nn.gelu,
+    "silu": jax.nn.silu,
+    "sigmoid": jax.nn.sigmoid,
+    "tanh": jnp.tanh,
+    "softmax": lambda x, *, axis=-1: jax.nn.softmax(x, axis=axis),
+    "log_softmax": lambda x, *, axis=-1: jax.nn.log_softmax(x, axis=axis),
+    "softplus": jax.nn.softplus,
+    "sin": jnp.sin,
+    "cos": jnp.cos,
+    # nn composite
+    "conv2d": _conv2d,
+    "max_pool2d": _max_pool2d,
+    "avg_pool2d": _avg_pool2d,
+    "layer_norm": _layer_norm,
+    "bias_add": lambda x, b: x + b,
+    "dropout": lambda x, *, rate=0.5, seed=0: x,  # inference identity; fit wires real rng
+    # losses
+    "softmax_cross_entropy": _softmax_cross_entropy,
+    "sparse_softmax_cross_entropy": _sparse_softmax_cross_entropy,
+    "sigmoid_cross_entropy": _sigmoid_cross_entropy,
+    "mse_loss": lambda pred, lab: jnp.mean(jnp.square(pred - lab)),
+    "l1_loss": lambda pred, lab: jnp.mean(jnp.abs(pred - lab)),
+}
+
+
+def _ax(axis):
+    if axis is None:
+        return None
+    if isinstance(axis, (list, tuple)):
+        return tuple(axis)
+    return axis
+
+
+def get_op(name: str):
+    if name not in OPS:
+        raise KeyError(f"unknown autodiff op {name!r}; known: {sorted(OPS)}")
+    return OPS[name]

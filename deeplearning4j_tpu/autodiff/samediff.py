@@ -1,0 +1,463 @@
+"""SameDiff — the reference's autodiff graph API, compiled not interpreted.
+
+The reference's SameDiff (org.nd4j.autodiff.samediff, SURVEY.md §3.3)
+resolves graph order in Java EVERY step and crosses JNI per op; its
+backward graph is built by graph transformation.  This SameDiff records
+the same declarative surface — named variables/placeholders/constants, op
+namespaces (math via operator overloading, sd.nn, sd.loss), a TrainingConfig
+— but execution traces the whole graph ONCE into a jit-compiled XLA
+computation, and the backward pass is jax.grad of that trace (no
+hand-built backward graph, no per-op dispatch).
+
+Serialization stores the graph def (op names + attrs) as JSON and variable
+values as npz — the .fb flatbuffers role.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import io
+import json
+import zipfile
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.ops_registry import get_op
+from deeplearning4j_tpu.nn.updaters import Updater, Sgd
+from deeplearning4j_tpu.runtime.rng import SeedStream
+from deeplearning4j_tpu.utils import serde
+
+
+@dataclasses.dataclass
+class SDVariable:
+    """Symbolic handle to a graph value (reference SDVariable)."""
+
+    sd: "SameDiff"
+    name: str
+    kind: str  # "variable" | "placeholder" | "constant" | "op"
+
+    # -- operator overloading (the sd.math namespace) ----------------------
+    def _bin(self, other, op):
+        other = self.sd._lift(other)
+        return self.sd.apply(op, self, other)
+
+    def __add__(self, o):
+        return self._bin(o, "add")
+
+    def __radd__(self, o):
+        return self.sd._lift(o)._bin(self, "add")
+
+    def __sub__(self, o):
+        return self._bin(o, "sub")
+
+    def __rsub__(self, o):
+        return self.sd._lift(o)._bin(self, "sub")
+
+    def __mul__(self, o):
+        return self._bin(o, "mul")
+
+    def __rmul__(self, o):
+        return self.sd._lift(o)._bin(self, "mul")
+
+    def __truediv__(self, o):
+        return self._bin(o, "div")
+
+    def __rtruediv__(self, o):
+        return self.sd._lift(o)._bin(self, "div")
+
+    def __pow__(self, o):
+        return self._bin(o, "pow")
+
+    def __neg__(self):
+        return self.sd.apply("neg", self)
+
+    def __matmul__(self, o):
+        return self._bin(o, "matmul")
+
+    # convenience forwards
+    def sum(self, axis=None, keepdims=False):
+        return self.sd.apply("sum", self, axis=axis, keepdims=keepdims)
+
+    def mean(self, axis=None, keepdims=False):
+        return self.sd.apply("mean", self, axis=axis, keepdims=keepdims)
+
+    def reshape(self, shape):
+        return self.sd.apply("reshape", self, shape=tuple(shape))
+
+    def transpose(self, axes=None):
+        return self.sd.apply("transpose", self, axes=axes)
+
+    def eval(self, placeholders: dict[str, Any] | None = None):
+        """Concrete value of this variable (reference SDVariable.eval())."""
+        return self.sd.output(placeholders or {}, self.name)
+
+    def __repr__(self):
+        return f"SDVariable({self.name!r}, {self.kind})"
+
+
+@dataclasses.dataclass
+class _OpNode:
+    op: str
+    inputs: tuple[str, ...]
+    output: str
+    attrs: dict[str, Any]
+
+
+class _Namespace:
+    """sd.nn / sd.loss / sd.math function namespaces."""
+
+    def __init__(self, sd: "SameDiff", ops: tuple[str, ...]):
+        self._sd = sd
+        self._ops = set(ops)
+
+    def __getattr__(self, op: str):
+        if op.startswith("_") or op not in self._ops:
+            raise AttributeError(op)
+
+        def call(*args, name: str | None = None, **attrs):
+            vars_ = [self._sd._lift(a) for a in args]
+            return self._sd.apply(op, *vars_, name=name, **attrs)
+
+        return call
+
+
+_NN_OPS = (
+    "relu", "relu6", "leaky_relu", "elu", "selu", "gelu", "silu", "sigmoid",
+    "tanh", "softmax", "log_softmax", "softplus", "conv2d", "max_pool2d",
+    "avg_pool2d", "layer_norm", "bias_add", "dropout", "one_hot",
+)
+_LOSS_OPS = (
+    "softmax_cross_entropy", "sparse_softmax_cross_entropy",
+    "sigmoid_cross_entropy", "mse_loss", "l1_loss",
+)
+_MATH_OPS = (
+    "add", "sub", "mul", "div", "pow", "neg", "abs", "exp", "log", "sqrt",
+    "square", "rsqrt", "sign", "floor", "ceil", "clip", "maximum", "minimum",
+    "greater", "less", "equal", "where", "matmul", "transpose", "einsum",
+    "tensordot", "reshape", "concat", "stack", "squeeze", "expand_dims",
+    "gather", "one_hot", "tile", "pad", "sum", "mean", "max", "min", "prod",
+    "var", "std", "argmax", "argmin", "norm2", "cumsum", "sin", "cos",
+)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class TrainingConfig:
+    """The reference's org.nd4j.autodiff.samediff.TrainingConfig."""
+
+    updater: Updater = dataclasses.field(default_factory=Sgd)
+    l2: float = 0.0
+    loss_variable: str = ""
+
+
+class SameDiff:
+    def __init__(self, seed: int = 0):
+        self._vars: dict[str, SDVariable] = {}
+        self._values: dict[str, jnp.ndarray] = {}   # variables + constants
+        self._trainable: set[str] = set()
+        self._placeholders: set[str] = set()
+        self._ops: list[_OpNode] = []
+        self._loss_var: str | None = None
+        self._training_config: TrainingConfig | None = None
+        self._opt_state = None
+        self._stream = SeedStream(seed)
+        self._compiled: dict[Any, Any] = {}
+        self._counter = 0
+        self.nn = _Namespace(self, _NN_OPS)
+        self.loss = _Namespace(self, _LOSS_OPS)
+        self.math = _Namespace(self, _MATH_OPS)
+
+    # -- graph construction ------------------------------------------------
+    def _fresh(self, base: str) -> str:
+        self._counter += 1
+        return f"{base}_{self._counter}"
+
+    def _register(self, name: str, kind: str) -> SDVariable:
+        if name in self._vars:
+            raise ValueError(f"variable {name!r} already exists")
+        v = SDVariable(self, name, kind)
+        self._vars[name] = v
+        return v
+
+    def placeholder(self, name: str, shape=None, dtype=None) -> SDVariable:
+        v = self._register(name, "placeholder")
+        self._placeholders.add(name)
+        return v
+
+    def var(self, name: str, value) -> SDVariable:
+        """Trainable variable with an initial value (reference sd.var())."""
+        v = self._register(name, "variable")
+        self._values[name] = jnp.asarray(value, jnp.float32)
+        self._trainable.add(name)
+        return v
+
+    def constant(self, name: str, value) -> SDVariable:
+        v = self._register(name, "constant")
+        self._values[name] = jnp.asarray(value)
+        return v
+
+    def _lift(self, x) -> SDVariable:
+        if isinstance(x, SDVariable):
+            return x
+        name = self._fresh("const")
+        return self.constant(name, x)
+
+    def apply(self, op: str, *inputs: SDVariable, name: str | None = None, **attrs) -> SDVariable:
+        get_op(op)  # validate eagerly
+        out_name = name or self._fresh(op)
+        v = self._register(out_name, "op")  # validates the name FIRST
+        self._ops.append(_OpNode(op, tuple(i.name for i in inputs), out_name, attrs))
+        self._compiled.clear()  # graph changed; drop compiled artifacts
+        return v
+
+    def set_loss(self, v: SDVariable) -> None:
+        self._loss_var = v.name
+        self._compiled.clear()
+
+    # -- execution ---------------------------------------------------------
+    def _execute(self, values: dict[str, jnp.ndarray], requested: tuple[str, ...], rng=None):
+        """Topological interpretation at TRACE time: runs once under jit,
+        emitting the whole graph into one XLA computation."""
+        env = dict(values)
+        needed = set(requested)
+        # ops are recorded in construction order == topological order
+        for node in self._ops:
+            if node.output in env:
+                continue
+            if any(i not in env for i in node.inputs):
+                # depends on an unfed placeholder — only legal when the
+                # requested outputs don't need it (checked below)
+                continue
+            args = [env[i] for i in node.inputs]
+            attrs = dict(node.attrs)
+            if node.op == "dropout" and rng is not None:
+                import zlib
+
+                rate = attrs.get("rate", 0.5)
+                keep = 1.0 - rate
+                # crc32, not hash(): PYTHONHASHSEED randomization would break
+                # cross-process reproducibility of seeded training
+                k = jax.random.fold_in(rng, zlib.crc32(node.output.encode()))
+                x = args[0]
+                m = jax.random.bernoulli(k, keep, x.shape)
+                env[node.output] = jnp.where(m, x / keep, 0.0).astype(x.dtype)
+                continue
+            env[node.output] = get_op(node.op)(*args, **attrs)
+        missing = needed - set(env)
+        if missing:
+            raise KeyError(f"variables never computed: {sorted(missing)}")
+        return tuple(env[r] for r in requested)
+
+    def _required_placeholders(self, outputs: tuple[str, ...]) -> set[str]:
+        """Placeholders reachable walking backward from the outputs — only
+        these must be fed (labels aren't needed for a logits-only pass)."""
+        producers = {n.output: n for n in self._ops}
+        needed: set[str] = set()
+        stack = list(outputs)
+        seen: set[str] = set()
+        while stack:
+            name = stack.pop()
+            if name in seen:
+                continue
+            seen.add(name)
+            if name in self._placeholders:
+                needed.add(name)
+            elif name in producers:
+                stack.extend(producers[name].inputs)
+        return needed
+
+    def output(self, placeholders: dict[str, Any], *outputs: str):
+        """Compiled forward pass (reference SameDiff.output())."""
+        ph_names = tuple(sorted(placeholders))
+        missing = self._required_placeholders(outputs) - set(ph_names)
+        if missing:
+            raise ValueError(f"missing placeholder values: {sorted(missing)}")
+        key = ("output", ph_names, outputs)
+        if key not in self._compiled:
+
+            @jax.jit
+            def fn(values, ph):
+                env = {**values, **ph}
+                return self._execute(env, outputs, rng=None)
+
+            self._compiled[key] = fn
+        ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
+        res = self._compiled[key](self._values, ph)
+        return res if len(outputs) > 1 else res[0]
+
+    def grad(self, placeholders: dict[str, Any], *wrt: str) -> dict[str, jnp.ndarray]:
+        """Gradients of the loss variable w.r.t. the given (or all)
+        trainable variables — the createGradFunction/getGradient role."""
+        if self._loss_var is None:
+            raise ValueError("no loss variable set; call set_loss()")
+        wrt = wrt or tuple(sorted(self._trainable))
+        ph_names = tuple(sorted(placeholders))
+        key = ("grad", ph_names, wrt, self._loss_var)
+        if key not in self._compiled:
+
+            @jax.jit
+            def fn(values, ph):
+                def loss_fn(train):
+                    env = {**values, **train, **ph}
+                    (loss,) = self._execute(env, (self._loss_var,), rng=None)
+                    return loss
+
+                train = {n: values[n] for n in wrt}
+                return jax.grad(loss_fn)(train)
+
+            self._compiled[key] = fn
+        ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
+        return self._compiled[key](self._values, ph)
+
+    # -- training ----------------------------------------------------------
+    def set_training_config(self, cfg: TrainingConfig) -> None:
+        self._training_config = cfg
+        if cfg.loss_variable:
+            self._loss_var = cfg.loss_variable
+        self._opt_state = None
+        # compiled step/grad closures capture tx/l2/loss_var — drop them
+        self._compiled.clear()
+
+    def fit_batch(self, placeholders: dict[str, Any]) -> float:
+        """One training step: whole graph + grad + updater in one compiled
+        computation (the TrainingSession.trainingIteration role, minus the
+        per-op JNI crossings)."""
+        if self._training_config is None:
+            raise ValueError("call set_training_config() first")
+        if self._loss_var is None:
+            raise ValueError("no loss variable set")
+        tx = self._training_config.updater.to_optax()
+        trainable = {n: self._values[n] for n in sorted(self._trainable)}
+        if self._opt_state is None:
+            self._opt_state = tx.init(trainable)
+        ph_names = tuple(sorted(placeholders))
+        key = ("fit", ph_names, self._loss_var)
+        if key not in self._compiled:
+            l2 = self._training_config.l2
+
+            @jax.jit
+            def step(values, opt_state, ph, rng):
+                train = {n: values[n] for n in sorted(self._trainable)}
+                frozen = {k: v for k, v in values.items() if k not in self._trainable}
+
+                def loss_fn(train):
+                    env = {**frozen, **train, **ph}
+                    (loss,) = self._execute(env, (self._loss_var,), rng=rng)
+                    if l2:
+                        for v in train.values():
+                            loss = loss + 0.5 * l2 * jnp.sum(jnp.square(v))
+                    return loss
+
+                loss, grads = jax.value_and_grad(loss_fn)(train)
+                updates, opt_state = tx.update(grads, opt_state, train)
+                train = jax.tree.map(lambda p, u: p + u, train, updates)
+                return train, opt_state, loss
+
+            self._compiled[key] = step
+        ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
+        rng = self._stream.next()
+        new_train, self._opt_state, loss = self._compiled[key](
+            self._values, self._opt_state, ph, rng
+        )
+        self._values.update(new_train)
+        return float(loss)
+
+    def fit(self, batches, epochs: int = 1) -> list[float]:
+        if epochs > 1 and not isinstance(batches, (list, tuple)):
+            # a generator would be exhausted after epoch 1 and silently
+            # train on nothing afterwards
+            batches = list(batches)
+        losses = []
+        for _ in range(epochs):
+            for ph in batches:
+                losses.append(self.fit_batch(ph))
+        return losses
+
+    # -- introspection -----------------------------------------------------
+    def variables(self) -> list[str]:
+        return sorted(self._trainable)
+
+    def get_value(self, name: str) -> np.ndarray:
+        return np.asarray(self._values[name])
+
+    def set_value(self, name: str, value) -> None:
+        if name not in self._values:
+            raise KeyError(name)
+        self._values[name] = jnp.asarray(value, self._values[name].dtype)
+        self._compiled.clear()
+
+    # -- serialization (the .fb save/load role) ----------------------------
+    def save(self, path: str) -> None:
+        graph = {
+            "placeholders": sorted(self._placeholders),
+            "trainable": sorted(self._trainable),
+            "constants": sorted(
+                set(self._values) - self._trainable
+            ),
+            "loss_var": self._loss_var,
+            "counter": self._counter,
+            "ops": [
+                {
+                    "op": n.op,
+                    "inputs": list(n.inputs),
+                    "output": n.output,
+                    "attrs": _jsonify_attrs(n.attrs),
+                }
+                for n in self._ops
+            ],
+            "training_config": serde.to_jsonable(self._training_config)
+            if self._training_config
+            else None,
+        }
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("graph.json", json.dumps(graph, indent=2))
+            buf = io.BytesIO()
+            names = sorted(self._values)
+            np.savez(buf, **{n: np.asarray(self._values[n]) for n in names})
+            zf.writestr("values.npz", buf.getvalue())
+
+    @staticmethod
+    def load(path: str) -> "SameDiff":
+        sd = SameDiff()
+        with zipfile.ZipFile(path, "r") as zf:
+            graph = json.loads(zf.read("graph.json"))
+            data = np.load(io.BytesIO(zf.read("values.npz")), allow_pickle=False)
+        for name in graph["placeholders"]:
+            sd.placeholder(name)
+        for name in graph["trainable"]:
+            sd.var(name, data[name])
+        for name in graph["constants"]:
+            sd.constant(name, data[name])
+        for n in graph["ops"]:
+            node = _OpNode(n["op"], tuple(n["inputs"]), n["output"], _unjsonify_attrs(n["attrs"]))
+            sd._ops.append(node)
+            sd._vars[node.output] = SDVariable(sd, node.output, "op")
+        sd._loss_var = graph.get("loss_var")
+        sd._counter = graph.get("counter", len(sd._vars))
+        if graph.get("training_config"):
+            sd.set_training_config(serde.from_jsonable(graph["training_config"]))
+        return sd
+
+    def __getitem__(self, name: str) -> SDVariable:
+        return self._vars[name]
+
+
+def _jsonify_attrs(attrs: dict) -> dict:
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, tuple):
+            v = list(v)
+        out[k] = v
+    return out
+
+
+def _unjsonify_attrs(attrs: dict) -> dict:
+    out = {}
+    for k, v in attrs.items():
+        if isinstance(v, list):
+            v = tuple(v)
+        out[k] = v
+    return out

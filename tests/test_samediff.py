@@ -1,0 +1,195 @@
+"""SameDiff-role autodiff graph tests."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import SameDiff, TrainingConfig
+from deeplearning4j_tpu.nn import Adam
+
+
+def test_forward_arithmetic():
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    w = sd.var("w", np.array([[2.0, 0.0], [0.0, 3.0]], np.float32))
+    y = (x @ w) + 1.0
+    out = np.asarray(sd.output({"x": np.eye(2, dtype=np.float32)}, y.name))
+    np.testing.assert_allclose(out, [[3.0, 1.0], [1.0, 4.0]])
+
+
+def test_grad_matches_analytic():
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    w = sd.var("w", np.array([1.0, 2.0, 3.0], np.float32))
+    loss = ((x * w) ** 2.0).sum()
+    sd.set_loss(loss)
+    xval = np.array([1.0, 1.0, 2.0], np.float32)
+    g = sd.grad({"x": xval})
+    # d/dw sum((x*w)^2) = 2*x^2*w
+    np.testing.assert_allclose(np.asarray(g["w"]), 2 * xval**2 * np.array([1, 2, 3]), rtol=1e-5)
+
+
+def test_namespaces_and_eval():
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    h = sd.nn.relu(x, name="h")
+    s = sd.nn.softmax(h, name="probs")
+    out = np.asarray(sd.output({"x": np.array([[1.0, -1.0]], np.float32)}, "probs"))
+    np.testing.assert_allclose(out.sum(axis=-1), 1.0, rtol=1e-5)
+    assert out[0, 0] > out[0, 1]
+
+
+def test_linear_regression_trains():
+    rng = np.random.default_rng(0)
+    true_w = np.array([[2.0], [-3.0]], np.float32)
+    X = rng.normal(size=(256, 2)).astype(np.float32)
+    Y = X @ true_w + 0.01 * rng.normal(size=(256, 1)).astype(np.float32)
+
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    y = sd.placeholder("y")
+    w = sd.var("w", np.zeros((2, 1), np.float32))
+    b = sd.var("b", np.zeros((1,), np.float32))
+    pred = (x @ w) + b
+    loss = sd.loss.mse_loss(pred, y, name="loss")
+    sd.set_loss(loss)
+    sd.set_training_config(TrainingConfig(updater=Adam(learning_rate=0.1)))
+    for _ in range(200):
+        sd.fit_batch({"x": X, "y": Y})
+    np.testing.assert_allclose(sd.get_value("w"), true_w, atol=0.05)
+
+
+def test_mlp_classification_trains():
+    rng = np.random.default_rng(1)
+    X = rng.normal(size=(512, 2)).astype(np.float32)
+    labels = (X[:, 0] * X[:, 1] > 0).astype(np.int64)
+    Y = np.eye(2, dtype=np.float32)[labels]
+
+    sd = SameDiff(seed=3)
+    x = sd.placeholder("x")
+    y = sd.placeholder("y")
+    w1 = sd.var("w1", 0.5 * rng.normal(size=(2, 32)).astype(np.float32))
+    b1 = sd.var("b1", np.zeros(32, np.float32))
+    w2 = sd.var("w2", 0.5 * rng.normal(size=(32, 2)).astype(np.float32))
+    b2 = sd.var("b2", np.zeros(2, np.float32))
+    h = sd.nn.tanh((x @ w1) + b1)
+    logits = sd.apply("add", sd.apply("matmul", h, w2), b2, name="logits")
+    loss = sd.loss.softmax_cross_entropy(logits, y, name="loss")
+    sd.set_training_config(TrainingConfig(updater=Adam(1e-2), loss_variable="loss"))
+    for _ in range(300):
+        sd.fit_batch({"x": X, "y": Y})
+    pred = np.asarray(sd.output({"x": X}, "logits")).argmax(axis=1)
+    assert (pred == labels).mean() > 0.95
+
+
+def test_save_load_round_trip(tmp_path):
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    w = sd.var("w", np.array([[1.5]], np.float32))
+    out = sd.nn.sigmoid(x @ w, name="out")
+    p = str(tmp_path / "graph.sdz")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    xv = np.array([[2.0]], np.float32)
+    np.testing.assert_allclose(
+        np.asarray(sd.output({"x": xv}, "out")),
+        np.asarray(sd2.output({"x": xv}, "out")),
+    )
+
+
+def test_save_load_resumes_training(tmp_path):
+    rng = np.random.default_rng(2)
+    X = rng.normal(size=(64, 3)).astype(np.float32)
+    Y = (X @ np.array([[1.0], [2.0], [3.0]], np.float32))
+    sd = SameDiff()
+    x, y = sd.placeholder("x"), sd.placeholder("y")
+    w = sd.var("w", np.zeros((3, 1), np.float32))
+    loss = sd.loss.mse_loss(x @ w, y, name="loss")
+    sd.set_training_config(TrainingConfig(updater=Adam(0.05), loss_variable="loss"))
+    for _ in range(50):
+        sd.fit_batch({"x": X, "y": Y})
+    p = str(tmp_path / "g.sdz")
+    sd.save(p)
+    sd2 = SameDiff.load(p)
+    l0 = sd2.fit_batch({"x": X, "y": Y})
+    for _ in range(100):
+        l1 = sd2.fit_batch({"x": X, "y": Y})
+    assert l1 < l0
+
+
+def test_missing_placeholder_rejected():
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    y = sd.placeholder("y")
+    z = x + y
+    with pytest.raises(ValueError, match="missing placeholder"):
+        sd.output({"x": np.ones(2, np.float32)}, z.name)
+
+
+def test_duplicate_variable_rejected():
+    sd = SameDiff()
+    sd.var("w", np.zeros(2))
+    with pytest.raises(ValueError, match="already exists"):
+        sd.var("w", np.zeros(3))
+
+
+def test_conv_graph():
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    k = sd.var("k", 0.1 * np.ones((3, 3, 1, 4), np.float32))
+    c = sd.nn.conv2d(x, k, name="c", stride=(1, 1), padding="SAME")
+    pooled = sd.nn.max_pool2d(c, name="p", kernel=(2, 2), stride=(2, 2))
+    out = np.asarray(
+        sd.output({"x": np.ones((2, 8, 8, 1), np.float32)}, "p")
+    )
+    assert out.shape == (2, 4, 4, 4)
+
+
+def test_changing_training_config_recompiles():
+    from deeplearning4j_tpu.nn import Sgd
+
+    rng = np.random.default_rng(3)
+    X = rng.normal(size=(32, 2)).astype(np.float32)
+    Y = X @ np.array([[1.0], [1.0]], np.float32)
+    sd = SameDiff()
+    x, y = sd.placeholder("x"), sd.placeholder("y")
+    w = sd.var("w", np.zeros((2, 1), np.float32))
+    loss = sd.loss.mse_loss(x @ w, y, name="loss")
+    sd.set_training_config(TrainingConfig(updater=Sgd(0.1), loss_variable="loss"))
+    sd.fit_batch({"x": X, "y": Y})
+    # switching updater must not reuse the cached Sgd step with Adam state
+    sd.set_training_config(TrainingConfig(updater=Adam(0.05), loss_variable="loss"))
+    l = sd.fit_batch({"x": X, "y": Y})
+    assert np.isfinite(l)
+
+
+def test_duplicate_op_name_leaves_graph_clean():
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    with pytest.raises(ValueError, match="already exists"):
+        sd.apply("relu", x, name="x")
+    # the failed apply must not leave a dangling node
+    assert len(sd._ops) == 0
+    out = sd.nn.relu(x, name="ok")
+    np.testing.assert_allclose(
+        np.asarray(sd.output({"x": -np.ones(2, np.float32)}, "ok")), 0.0
+    )
+
+
+def test_dropout_without_rate_infers_and_outputs():
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    h = sd.nn.dropout(x, name="h")
+    out = np.asarray(sd.output({"x": np.ones((2, 4), np.float32)}, "h"))
+    np.testing.assert_allclose(out, 1.0)  # inference identity
+
+
+def test_fit_with_generator_trains_all_epochs():
+    X = np.ones((8, 1), np.float32)
+    Y = 2 * X
+    sd = SameDiff()
+    x, y = sd.placeholder("x"), sd.placeholder("y")
+    w = sd.var("w", np.zeros((1, 1), np.float32))
+    sd.loss.mse_loss(x @ w, y, name="loss")
+    sd.set_training_config(TrainingConfig(updater=Adam(0.1), loss_variable="loss"))
+    losses = sd.fit(({"x": X, "y": Y} for _ in range(3)), epochs=4)
+    assert len(losses) == 12
