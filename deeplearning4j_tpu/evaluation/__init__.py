@@ -1,3 +1,14 @@
 from deeplearning4j_tpu.evaluation.evaluation import Evaluation
+from deeplearning4j_tpu.evaluation.roc import ROC, ROCBinary, ROCMultiClass
+from deeplearning4j_tpu.evaluation.regression import RegressionEvaluation
+from deeplearning4j_tpu.evaluation.binary import EvaluationBinary, EvaluationCalibration
 
-__all__ = ["Evaluation"]
+__all__ = [
+    "Evaluation",
+    "ROC",
+    "ROCBinary",
+    "ROCMultiClass",
+    "RegressionEvaluation",
+    "EvaluationBinary",
+    "EvaluationCalibration",
+]

@@ -1,0 +1,182 @@
+"""Evaluation-suite tests: ROC/AUC, regression metrics, binary, calibration.
+
+Mirrors the reference's nd4j evaluation test pattern: metrics asserted against
+hand-computed / analytically-known values on tiny inputs, plus streaming
+equivalence (many small batches == one big batch).
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.evaluation import (
+    ROC,
+    Evaluation,
+    EvaluationBinary,
+    EvaluationCalibration,
+    ROCBinary,
+    ROCMultiClass,
+    RegressionEvaluation,
+)
+
+
+class TestROC:
+    def test_perfect_separation_auc_1(self):
+        roc = ROC()
+        roc.eval(np.array([0, 0, 1, 1]), np.array([0.1, 0.2, 0.8, 0.9]))
+        assert roc.calculate_auc() == pytest.approx(1.0)
+        assert roc.calculate_auprc() == pytest.approx(1.0)
+
+    def test_random_scores_auc_half(self):
+        rng = np.random.default_rng(0)
+        labels = rng.integers(0, 2, 20000)
+        scores = rng.random(20000)
+        roc = ROC()
+        roc.eval(labels, scores)
+        assert roc.calculate_auc() == pytest.approx(0.5, abs=0.02)
+
+    def test_known_auc(self):
+        # scores: 0.9(1) 0.8(0) 0.7(1) 0.6(0) -> pairs: (1>0): of 4 pairs
+        # concordant: (0.9,0.8),(0.9,0.6),(0.7,0.6) = 3; discordant (0.7,0.8)=1
+        # AUC = 3/4
+        roc = ROC()
+        roc.eval(np.array([1, 0, 1, 0]), np.array([0.9, 0.8, 0.7, 0.6]))
+        assert roc.calculate_auc() == pytest.approx(0.75)
+
+    def test_streaming_equals_batch(self):
+        rng = np.random.default_rng(1)
+        labels = rng.integers(0, 2, 1000)
+        scores = rng.random(1000)
+        batch = ROC()
+        batch.eval(labels, scores)
+        stream = ROC()
+        for i in range(0, 1000, 64):
+            stream.eval(labels[i : i + 64], scores[i : i + 64])
+        assert stream.calculate_auc() == pytest.approx(batch.calculate_auc())
+
+    def test_thresholded_mode_approximates_exact(self):
+        rng = np.random.default_rng(2)
+        labels = rng.integers(0, 2, 5000)
+        scores = np.clip(rng.normal(0.3 + 0.4 * labels, 0.2), 0, 1)
+        exact, stepped = ROC(0), ROC(200)
+        exact.eval(labels, scores)
+        stepped.eval(labels, scores)
+        assert stepped.calculate_auc() == pytest.approx(exact.calculate_auc(), abs=0.01)
+
+    def test_two_column_probability_input(self):
+        roc = ROC()
+        probs = np.array([[0.9, 0.1], [0.2, 0.8]])
+        roc.eval(np.array([[1, 0], [0, 1]]), probs)
+        assert roc.calculate_auc() == pytest.approx(1.0)
+
+
+class TestROCBinaryMulti:
+    def test_roc_binary_per_output(self):
+        rb = ROCBinary()
+        labels = np.array([[1, 0], [0, 1], [1, 1], [0, 0]])
+        # output 0 perfectly ranked, output 1 anti-ranked
+        # (col-1 positives score 0.1/0.2, below every negative's 0.8/0.9)
+        preds = np.array([[0.9, 0.9], [0.1, 0.2], [0.8, 0.1], [0.2, 0.8]])
+        rb.eval(labels, preds)
+        assert rb.num_outputs == 2
+        assert rb.calculate_auc(0) == pytest.approx(1.0)
+        assert rb.calculate_auc(1) == pytest.approx(0.0)
+        assert rb.calculate_average_auc() == pytest.approx(0.5)
+
+    def test_roc_multiclass_one_vs_all(self):
+        rm = ROCMultiClass()
+        labels = np.array([0, 1, 2, 0, 1, 2])
+        preds = np.eye(3)[labels] * 0.8 + 0.1  # peaked on true class
+        rm.eval(labels, preds)
+        assert rm.num_classes == 3
+        for c in range(3):
+            assert rm.calculate_auc(c) == pytest.approx(1.0)
+
+
+class TestRegressionEvaluation:
+    def test_known_values(self):
+        ev = RegressionEvaluation()
+        labels = np.array([[1.0], [2.0], [3.0]])
+        preds = np.array([[1.5], [2.0], [2.5]])
+        ev.eval(labels, preds)
+        assert ev.mean_squared_error(0) == pytest.approx((0.25 + 0 + 0.25) / 3)
+        assert ev.mean_absolute_error(0) == pytest.approx(1.0 / 3)
+        assert ev.root_mean_squared_error(0) == pytest.approx(np.sqrt(0.5 / 3))
+        # R^2 = 1 - SSE/SST; SST = 2, SSE = 0.5
+        assert ev.r_squared(0) == pytest.approx(1 - 0.5 / 2.0)
+        assert ev.pearson_correlation(0) == pytest.approx(1.0)
+
+    def test_streaming_equals_batch(self):
+        rng = np.random.default_rng(3)
+        labels = rng.normal(size=(500, 3))
+        preds = labels + 0.1 * rng.normal(size=(500, 3))
+        batch = RegressionEvaluation()
+        batch.eval(labels, preds)
+        stream = RegressionEvaluation()
+        for i in range(0, 500, 37):
+            stream.eval(labels[i : i + 37], preds[i : i + 37])
+        for col in range(3):
+            assert stream.mean_squared_error(col) == pytest.approx(batch.mean_squared_error(col))
+            assert stream.r_squared(col) == pytest.approx(batch.r_squared(col))
+        assert "RMSE" in batch.stats() or "RegressionEvaluation" in batch.stats()
+
+
+class TestEvaluationBinary:
+    def test_confusion_counts(self):
+        eb = EvaluationBinary()
+        labels = np.array([[1, 0], [1, 1], [0, 0], [0, 1]])
+        preds = np.array([[0.9, 0.8], [0.2, 0.7], [0.3, 0.1], [0.6, 0.4]])
+        eb.eval(labels, preds)
+        # output 0: tp=1 (row0), fn=1 (row1), tn=1 (row2), fp=1 (row3)
+        assert eb.true_positives(0) == 1
+        assert eb.false_negatives(0) == 1
+        assert eb.true_negatives(0) == 1
+        assert eb.false_positives(0) == 1
+        assert eb.accuracy(0) == pytest.approx(0.5)
+        # output 1: tp=2 (rows 0,1... row0 label 0 -> no). labels col1: 0,1,0,1
+        # preds col1>=0.5: 1,1,0,0 -> tp=1(row1), fp=1(row0), tn=1(row2), fn=1(row3)
+        assert eb.true_positives(1) == 1
+        assert eb.f1(1) == pytest.approx(0.5)
+
+    def test_custom_threshold(self):
+        eb = EvaluationBinary(decision_threshold=0.9)
+        eb.eval(np.array([[1], [1]]), np.array([[0.95], [0.8]]))
+        assert eb.true_positives(0) == 1
+        assert eb.false_negatives(0) == 1
+
+
+class TestEvaluationCalibration:
+    def test_perfectly_calibrated_low_ece(self):
+        rng = np.random.default_rng(4)
+        n = 50000
+        p = rng.uniform(0.5, 1.0, n)
+        correct = rng.random(n) < p
+        probs = np.stack([np.where(correct, p, 1 - p), np.where(correct, 1 - p, p)], axis=1)
+        labels = np.zeros(n, dtype=np.int64)  # true class always 0
+        ec = EvaluationCalibration()
+        ec.eval(labels, probs)
+        assert ec.expected_calibration_error() < 0.02
+
+    def test_overconfident_high_ece(self):
+        n = 1000
+        probs = np.tile(np.array([[0.99, 0.01]]), (n, 1))
+        labels = (np.arange(n) % 2).astype(np.int64)  # 50% accuracy
+        ec = EvaluationCalibration()
+        ec.eval(labels, probs)
+        assert ec.expected_calibration_error() > 0.4
+        assert ec.probability_histogram().sum() == 2 * n
+
+    def test_stats_strings(self):
+        for ev in (ROC(), ROCBinary(), ROCMultiClass(), EvaluationBinary(), EvaluationCalibration()):
+            labels = np.array([[1, 0], [0, 1]])
+            preds = np.array([[0.8, 0.2], [0.3, 0.7]])
+            ev.eval(labels, preds)
+            assert isinstance(ev.stats(), str)
+
+
+class TestEvaluationMask:
+    def test_mask_excludes_rows(self):
+        ev = Evaluation()
+        labels = np.array([0, 1, 1])
+        preds = np.array([[0.9, 0.1], [0.2, 0.8], [0.9, 0.1]])
+        ev.eval(labels, preds, mask=np.array([1, 1, 0]))
+        assert ev.accuracy() == pytest.approx(1.0)
