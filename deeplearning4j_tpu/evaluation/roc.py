@@ -66,6 +66,8 @@ class ROC:
         if self.threshold_steps == 0:
             scores = np.concatenate(self._scores) if self._scores else np.empty(0)
             labels = np.concatenate(self._labels) if self._labels else np.empty(0, np.int64)
+            if scores.size == 0:
+                return scores, np.empty(0, np.int64), np.empty(0, np.int64), 0, 0
             order = np.argsort(-scores, kind="stable")
             scores, labels = scores[order], labels[order]
             tp = np.cumsum(labels == 1)

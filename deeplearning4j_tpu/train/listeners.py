@@ -86,3 +86,159 @@ class PerformanceListener(TrainingListener):
             return 0.0
         dt = time.perf_counter() - self._steady_t0
         return self._steady_batches / dt if dt > 0 else 0.0
+
+
+class TimeIterationListener(TrainingListener):
+    """ETA logging (`TimeIterationListener` role): given the expected total
+    iteration count, logs remaining-time estimates."""
+
+    def __init__(self, total_iterations: int, frequency: int = 10):
+        self.total = total_iterations
+        self.frequency = max(1, frequency)
+        self._start: float | None = None
+        self._done = 0
+
+    def iteration_done(self, model, iteration, epoch, score):
+        now = time.perf_counter()
+        if self._start is None:
+            self._start = now
+        self._done += 1
+        if self._done % self.frequency == 0 and self._done > 0:
+            elapsed = now - self._start
+            per_iter = elapsed / self._done
+            remaining = max(0, self.total - self._done) * per_iter
+            log.info(
+                "iteration %d/%d, %.1fs elapsed, ~%.1fs remaining",
+                self._done, self.total, elapsed, remaining,
+            )
+
+    def remaining_seconds(self) -> float:
+        if self._start is None or self._done == 0:
+            return float("nan")
+        per_iter = (time.perf_counter() - self._start) / self._done
+        return max(0, self.total - self._done) * per_iter
+
+
+class EvaluativeListener(TrainingListener):
+    """Periodic evaluation on a held-out iterator (`EvaluativeListener`
+    role); `frequency` counts iterations (invocation type ITERATION) or
+    epochs (invocation type EPOCH_END via `on_epoch`)."""
+
+    ITERATION = "iteration"
+    EPOCH_END = "epoch_end"
+
+    def __init__(self, data, frequency: int = 100, invocation: str = ITERATION,
+                 evaluation_factory=None, callback=None):
+        from deeplearning4j_tpu.evaluation import Evaluation
+
+        self.data = data
+        self.frequency = max(1, frequency)
+        self.invocation = invocation
+        self._factory = evaluation_factory or Evaluation
+        self.callback = callback
+        self.evaluations: list = []
+
+    def _evaluate(self, model) -> None:
+        import numpy as np
+
+        ev = self._factory()
+        for batch in self.data:
+            if batch.features_mask is not None:
+                probs = np.asarray(model.output(batch.features, batch.features_mask))
+            else:
+                probs = np.asarray(model.output(batch.features))
+            ev.eval(batch.labels, probs, mask=batch.labels_mask)
+        self.evaluations.append(ev)
+        if self.callback is not None:
+            self.callback(model, ev)
+        else:
+            log.info("EvaluativeListener:\n%s", ev.stats())
+
+    def iteration_done(self, model, iteration, epoch, score):
+        # iteration arrives 1-based (models increment before dispatch), so a
+        # bare modulo fires every `frequency` completed updates
+        if self.invocation == self.ITERATION and iteration % self.frequency == 0:
+            self._evaluate(model)
+
+    def on_epoch_end(self, model, epoch):
+        if self.invocation == self.EPOCH_END and (epoch + 1) % self.frequency == 0:
+            self._evaluate(model)
+
+
+class CheckpointListener(TrainingListener):
+    """Rolling checkpoints (`CheckpointListener` role): save the model every
+    N iterations or epochs into `directory` with a `checkpoint.txt` index;
+    retention via keep_last / keep_every."""
+
+    def __init__(self, directory: str, save_every_n_iterations: int | None = None,
+                 save_every_n_epochs: int | None = None, keep_last: int | None = None,
+                 keep_every: int = 1):
+        import os
+
+        if (save_every_n_iterations is None) == (save_every_n_epochs is None):
+            raise ValueError("set exactly one of save_every_n_iterations / save_every_n_epochs")
+        self.directory = directory
+        self.every_iters = save_every_n_iterations
+        self.every_epochs = save_every_n_epochs
+        self.keep_last = keep_last
+        self.keep_every = max(1, keep_every)
+        self._saved: list[tuple[int, str]] = []  # (checkpoint number, path)
+        self._num = 0
+        os.makedirs(directory, exist_ok=True)
+
+    def _index_path(self) -> str:
+        import os
+
+        return os.path.join(self.directory, "checkpoint.txt")
+
+    def _save(self, model, iteration: int, epoch: int) -> None:
+        import os
+
+        path = os.path.join(self.directory, f"checkpoint_{self._num}_Model.zip")
+        model.save(path)
+        self._saved.append((self._num, path))
+        with open(self._index_path(), "a") as f:
+            f.write(f"{self._num},{iteration},{epoch},{time.time():.0f},{os.path.basename(path)}\n")
+        self._num += 1
+        if self.keep_last is not None:
+            removable = [
+                (n, p) for (n, p) in self._saved[: -self.keep_last]
+                if n % self.keep_every != 0 or self.keep_every == 1
+            ]
+            for n, p in removable:
+                if os.path.exists(p):
+                    os.remove(p)
+                self._saved.remove((n, p))
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if self.every_iters and iteration % self.every_iters == 0:
+            self._save(model, iteration, epoch)
+
+    def on_epoch_end(self, model, epoch):
+        if self.every_epochs and (epoch + 1) % self.every_epochs == 0:
+            self._save(model, model.iteration, epoch)
+
+    # -- static loaders (reference parity: lastCheckpoint(dir) etc.) -------
+    @staticmethod
+    def available_checkpoints(directory: str) -> list[str]:
+        import os
+
+        index = os.path.join(directory, "checkpoint.txt")
+        if not os.path.exists(index):
+            return []
+        names = []
+        with open(index) as f:
+            for line in f:
+                name = line.strip().split(",")[-1]
+                if os.path.exists(os.path.join(directory, name)):
+                    names.append(os.path.join(directory, name))
+        return names
+
+    @staticmethod
+    def last_checkpoint(directory: str):
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+        paths = CheckpointListener.available_checkpoints(directory)
+        if not paths:
+            raise FileNotFoundError(f"no checkpoints in {directory}")
+        return ModelSerializer.restore(paths[-1])

@@ -173,6 +173,18 @@ class TestEvaluationCalibration:
             assert isinstance(ev.stats(), str)
 
 
+class TestEmptyROC:
+    def test_empty_roc_does_not_crash(self):
+        roc = ROC()
+        assert roc.calculate_auc() == pytest.approx(0.5)
+        assert isinstance(roc.stats(), str)
+
+    def test_fully_masked_eval(self):
+        roc = ROC()
+        roc.eval(np.array([0, 1]), np.array([0.2, 0.8]), mask=np.array([0, 0]))
+        roc.calculate_auc()  # must not raise
+
+
 class TestEvaluationMask:
     def test_mask_excludes_rows(self):
         ev = Evaluation()

@@ -1,0 +1,300 @@
+"""Early stopping, transfer learning, and listener tests.
+
+Mirrors the reference's `org.deeplearning4j.earlystopping` and
+`org.deeplearning4j.nn.transferlearning` test patterns: small synthetic
+problems, assertions on termination reasons / frozen-param invariance /
+checkpoint retention.
+"""
+
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.iterator import NumpyDataSetIterator
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers import Dense, OutputLayer
+from deeplearning4j_tpu.nn.conf.neural_net_configuration import NeuralNetConfiguration
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.updaters import Adam, Sgd
+from deeplearning4j_tpu.models.sequential import SequentialModel
+from deeplearning4j_tpu.train import (
+    CheckpointListener,
+    DataSetLossCalculator,
+    EarlyStoppingConfiguration,
+    EarlyStoppingTrainer,
+    EvaluativeListener,
+    FineTuneConfiguration,
+    MaxEpochsTerminationCondition,
+    MaxScoreIterationTerminationCondition,
+    ScoreImprovementEpochTerminationCondition,
+    TerminationReason,
+    TimeIterationListener,
+    TransferLearning,
+    TransferLearningHelper,
+)
+
+
+def _toy_problem(n=256, n_in=8, k=3, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, n_in)).astype(np.float32)
+    w = rng.normal(size=(n_in, k))
+    y = np.argmax(x @ w, axis=1)
+    onehot = np.eye(k, dtype=np.float32)[y]
+    return x, onehot
+
+
+def _mlp(n_in=8, k=3, hidden=16, lr=0.05):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(42)
+        .updater(Adam(lr))
+        .list()
+        .layer(Dense(n_out=hidden, activation=Activation.RELU, name="d0"))
+        .layer(Dense(n_out=hidden, activation=Activation.RELU, name="d1"))
+        .layer(OutputLayer(n_out=k, loss=Loss.MCXENT, activation=Activation.SOFTMAX, name="out"))
+        .set_input_type(InputType.feed_forward(n_in))
+        .build()
+    )
+
+
+class TestEarlyStopping:
+    def test_max_epochs_termination(self):
+        x, y = _toy_problem()
+        train = NumpyDataSetIterator(x, y, batch_size=64)
+        val = NumpyDataSetIterator(x, y, batch_size=128, shuffle=False)
+        model = SequentialModel(_mlp()).init()
+        cfg = (
+            EarlyStoppingConfiguration.builder()
+            .score_calculator(DataSetLossCalculator(val))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(3))
+            .build()
+        )
+        result = EarlyStoppingTrainer(cfg, model, train).fit()
+        assert result.termination_reason == TerminationReason.EPOCH_CONDITION
+        assert result.termination_details == "MaxEpochsTerminationCondition"
+        assert result.total_epochs == 3
+        assert result.best_model is not None
+        assert len(result.score_vs_epoch) == 3
+        # best model should score at least as well as epoch-0 score
+        assert result.best_model_score <= result.score_vs_epoch[0] + 1e-9
+
+    def test_score_improvement_patience(self):
+        x, y = _toy_problem()
+        train = NumpyDataSetIterator(x, y, batch_size=64)
+        val = NumpyDataSetIterator(x, y, batch_size=128, shuffle=False)
+        # lr=0 -> no improvement ever -> patience trips after 2 stale epochs
+        model = SequentialModel(_mlp(lr=0.0)).init()
+        cfg = (
+            EarlyStoppingConfiguration.builder()
+            .score_calculator(DataSetLossCalculator(val))
+            .epoch_termination_conditions(
+                ScoreImprovementEpochTerminationCondition(2),
+                MaxEpochsTerminationCondition(50),
+            )
+            .build()
+        )
+        result = EarlyStoppingTrainer(cfg, model, train).fit()
+        assert result.termination_details == "ScoreImprovementEpochTerminationCondition"
+        assert result.total_epochs <= 5
+
+    def test_iteration_divergence_guard(self):
+        x, y = _toy_problem()
+        train = NumpyDataSetIterator(x, y, batch_size=64)
+        val = NumpyDataSetIterator(x, y, batch_size=128, shuffle=False)
+        model = SequentialModel(_mlp()).init()
+        cfg = (
+            EarlyStoppingConfiguration.builder()
+            .score_calculator(DataSetLossCalculator(val))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(50))
+            .iteration_termination_conditions(MaxScoreIterationTerminationCondition(1e-12))
+            .build()
+        )
+        result = EarlyStoppingTrainer(cfg, model, train).fit()
+        assert result.termination_reason == TerminationReason.ITERATION_CONDITION
+        # guard listener must be removed after fit
+        assert all(type(l).__name__ != "_IterGuard" for l in model.listeners)
+
+
+class TestTransferLearning:
+    def _trained(self):
+        x, y = _toy_problem()
+        model = SequentialModel(_mlp()).init()
+        model.fit(NumpyDataSetIterator(x, y, batch_size=64), epochs=2)
+        return model, x, y
+
+    def test_feature_extractor_freezes_params(self):
+        model, x, y = self._trained()
+        tl = (
+            TransferLearning.Builder(model)
+            .fine_tune_configuration(FineTuneConfiguration(updater=Sgd(0.1)))
+            .set_feature_extractor("d1")
+            .build()
+        )
+        assert tl.conf.layers[0].frozen and tl.conf.layers[1].frozen
+        assert not tl.conf.layers[2].frozen
+        # pretrained params carried over
+        np.testing.assert_array_equal(
+            np.asarray(tl.params["d0"]["W"]), np.asarray(model.params["d0"]["W"])
+        )
+        frozen_before = {k: np.asarray(v) for k, v in tl.params["d0"].items()}
+        tl.fit(NumpyDataSetIterator(x, y, batch_size=64), epochs=1)
+        for k, before in frozen_before.items():
+            np.testing.assert_array_equal(before, np.asarray(tl.params["d0"][k]))
+        # unfrozen output layer DID move
+        assert not np.allclose(
+            np.asarray(tl.params["out"]["W"]), np.asarray(model.params["out"]["W"])
+        )
+
+    def test_n_out_replace_reinits_downstream(self):
+        model, x, y = self._trained()
+        tl = (
+            TransferLearning.Builder(model)
+            .set_feature_extractor("d0")
+            .n_out_replace("d1", 32)
+            .build()
+        )
+        assert tl.conf.layers[1].n_out == 32
+        assert tl.params["d1"]["W"].shape[-1] == 32
+        assert tl.params["out"]["W"].shape[0] == 32
+        # d0 retained
+        np.testing.assert_array_equal(
+            np.asarray(tl.params["d0"]["W"]), np.asarray(model.params["d0"]["W"])
+        )
+        tl.fit(NumpyDataSetIterator(x, y, batch_size=64), epochs=1)  # must run
+
+    def test_replace_head(self):
+        model, x, y = self._trained()
+        tl = (
+            TransferLearning.Builder(model)
+            .set_feature_extractor("d1")
+            .remove_output_layer()
+            .add_layer(OutputLayer(n_out=5, loss=Loss.MCXENT,
+                                   activation=Activation.SOFTMAX, name="newout"))
+            .build()
+        )
+        assert tl.conf.layers[-1].name == "newout"
+        out = tl.output(x[:4])
+        assert out.shape == (4, 5)
+
+    def test_helper_featurize_matches_full_forward(self):
+        model, x, y = self._trained()
+        tl = TransferLearning.Builder(model).set_feature_extractor("d1").build()
+        helper = TransferLearningHelper(tl)
+        from deeplearning4j_tpu.data.dataset import DataSet
+
+        ds = DataSet(x[:32], y[:32])
+        feat = helper.featurize(ds)
+        assert feat.features.shape == (32, 16)
+        out_via_helper = np.asarray(helper.output_from_featurized(feat.features))
+        out_full = np.asarray(tl.output(x[:32]))
+        np.testing.assert_allclose(out_via_helper, out_full, rtol=1e-4, atol=1e-5)
+        # train the top, merge back, still consistent
+        helper.fit_featurized(feat, epochs=1)
+        full = helper.to_full_model()
+        np.testing.assert_allclose(
+            np.asarray(full.output(x[:32])),
+            np.asarray(helper.output_from_featurized(feat.features)),
+            rtol=1e-4, atol=1e-5,
+        )
+
+
+class TestReviewRegressions:
+    def test_max_epochs_respected_with_sparse_evaluation(self):
+        x, y = _toy_problem(n=128)
+        train = NumpyDataSetIterator(x, y, batch_size=64)
+        val = NumpyDataSetIterator(x, y, batch_size=128, shuffle=False)
+        model = SequentialModel(_mlp()).init()
+        cfg = (
+            EarlyStoppingConfiguration.builder()
+            .score_calculator(DataSetLossCalculator(val))
+            .epoch_termination_conditions(MaxEpochsTerminationCondition(4))
+            .evaluate_every_n_epochs(2)
+            .build()
+        )
+        result = EarlyStoppingTrainer(cfg, model, train).fit()
+        assert result.total_epochs == 4  # no overshoot past the max
+
+    def test_save_last_model(self):
+        from deeplearning4j_tpu.train import InMemoryModelSaver
+
+        x, y = _toy_problem(n=128)
+        train = NumpyDataSetIterator(x, y, batch_size=64)
+        val = NumpyDataSetIterator(x, y, batch_size=128, shuffle=False)
+        model = SequentialModel(_mlp()).init()
+        saver = InMemoryModelSaver()
+        cfg = EarlyStoppingConfiguration(
+            score_calculator=DataSetLossCalculator(val),
+            epoch_termination_conditions=[MaxEpochsTerminationCondition(2)],
+            model_saver=saver,
+            save_last_model=True,
+        )
+        EarlyStoppingTrainer(cfg, model, train).fit()
+        latest = saver.get_latest_model()
+        assert latest is not None
+        # latest reflects the final epoch's params
+        np.testing.assert_array_equal(
+            np.asarray(latest.params["out"]["W"]), np.asarray(model.params["out"]["W"])
+        )
+
+    def test_helper_featurize_across_cnn_flatten_boundary(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.nn.conf.layers import Conv2D, Subsampling
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(32, 8, 8, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 32)]
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(1)
+            .updater(Adam(1e-3))
+            .list()
+            .layer(Conv2D(n_out=4, kernel=(3, 3), activation=Activation.RELU, name="c0"))
+            .layer(Subsampling(kernel=(2, 2), stride=(2, 2), name="p0"))
+            .layer(Dense(n_out=8, activation=Activation.RELU, name="d0"))
+            .layer(OutputLayer(n_out=2, loss=Loss.MCXENT, activation=Activation.SOFTMAX, name="out"))
+            .set_input_type(InputType.convolutional(8, 8, 1))
+            .build()
+        )
+        model = SequentialModel(conf).init()
+        tl = TransferLearning.Builder(model).set_feature_extractor("p0").build()
+        helper = TransferLearningHelper(tl)
+        feat = helper.featurize(DataSet(x, y))
+        assert feat.features.ndim == 2  # flattened across the CNN->FF boundary
+        out_via_helper = np.asarray(helper.output_from_featurized(feat.features))
+        np.testing.assert_allclose(
+            out_via_helper, np.asarray(tl.output(x)), rtol=1e-4, atol=1e-5
+        )
+
+
+class TestListeners:
+    def test_checkpoint_listener_rolling(self, tmp_path):
+        x, y = _toy_problem(n=128)
+        model = SequentialModel(_mlp()).init()
+        lst = CheckpointListener(str(tmp_path), save_every_n_iterations=2, keep_last=2)
+        model.set_listeners(lst)
+        model.fit(NumpyDataSetIterator(x, y, batch_size=16), epochs=1)  # 8 iters -> 4 saves
+        avail = CheckpointListener.available_checkpoints(str(tmp_path))
+        assert len(avail) == 2  # rolling retention
+        restored = CheckpointListener.last_checkpoint(str(tmp_path))
+        assert restored.num_params() == model.num_params()
+        assert os.path.exists(tmp_path / "checkpoint.txt")
+
+    def test_evaluative_listener_epoch_end(self):
+        x, y = _toy_problem(n=128)
+        val = NumpyDataSetIterator(x, y, batch_size=64, shuffle=False)
+        model = SequentialModel(_mlp()).init()
+        lst = EvaluativeListener(val, frequency=1, invocation=EvaluativeListener.EPOCH_END)
+        model.set_listeners(lst)
+        model.fit(NumpyDataSetIterator(x, y, batch_size=64), epochs=2)
+        assert len(lst.evaluations) == 2
+        assert 0.0 <= lst.evaluations[-1].accuracy() <= 1.0
+
+    def test_time_iteration_listener(self):
+        x, y = _toy_problem(n=64)
+        model = SequentialModel(_mlp()).init()
+        lst = TimeIterationListener(total_iterations=100, frequency=1)
+        model.set_listeners(lst)
+        model.fit(NumpyDataSetIterator(x, y, batch_size=32), epochs=1)
+        assert lst.remaining_seconds() >= 0
