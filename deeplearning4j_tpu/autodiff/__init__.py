@@ -1,5 +1,19 @@
 """Autodiff graph API — the SameDiff role, compiled instead of interpreted."""
 
 from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable, TrainingConfig
+from deeplearning4j_tpu.autodiff.validation import (
+    GradCheckResult,
+    OpValidation,
+    TestCase,
+    gradient_check,
+)
 
-__all__ = ["SameDiff", "SDVariable", "TrainingConfig"]
+__all__ = [
+    "SameDiff",
+    "SDVariable",
+    "TrainingConfig",
+    "OpValidation",
+    "TestCase",
+    "GradCheckResult",
+    "gradient_check",
+]

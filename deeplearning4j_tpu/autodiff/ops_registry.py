@@ -61,6 +61,92 @@ def _sigmoid_cross_entropy(logits, labels):
     return jnp.mean(per)
 
 
+def _conv1d(x, w, *, stride=1, padding="SAME"):
+    """x: (N, T, C), w: (K, C, O)."""
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=(stride,), padding=padding,
+        dimension_numbers=("NWC", "WIO", "NWC"),
+    )
+
+
+def _conv3d(x, w, *, stride=(1, 1, 1), padding="SAME"):
+    """x: (N, D, H, W, C), w: (Kd, Kh, Kw, C, O)."""
+    return jax.lax.conv_general_dilated(
+        x, w, window_strides=tuple(stride), padding=padding,
+        dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),
+    )
+
+
+def _depthwise_conv2d(x, w, *, stride=(1, 1), padding="SAME"):
+    """w: (Kh, Kw, C, M) -> per-channel conv with multiplier M."""
+    c = x.shape[-1]
+    return jax.lax.conv_general_dilated(
+        x, w.reshape(w.shape[0], w.shape[1], 1, -1),
+        window_strides=tuple(stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c,
+    )
+
+
+def _deconv2d(x, w, *, stride=(2, 2), padding="SAME"):
+    """Transposed conv; w: (Kh, Kw, I, O)."""
+    return jax.lax.conv_transpose(
+        x, w, strides=tuple(stride), padding=padding,
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    )
+
+
+def _batch_norm(x, mean, var, gamma, beta, *, epsilon=1e-5):
+    return (x - mean) * jax.lax.rsqrt(var + epsilon) * gamma + beta
+
+
+def _lstm_cell(x, h, c, w, r, b):
+    """Single LSTM step. x:(N,I) h,c:(N,H) w:(I,4H) r:(H,4H) b:(4H,).
+    Gate order i,f,g,o (input, forget, cell, output)."""
+    z = x @ w + h @ r + b
+    i, f, g, o = jnp.split(z, 4, axis=-1)
+    c_new = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
+    h_new = jax.nn.sigmoid(o) * jnp.tanh(c_new)
+    return jnp.stack([h_new, c_new])
+
+
+def _gru_cell(x, h, w, r, b):
+    """Single GRU step. x:(N,I) h:(N,H) w:(I,3H) r:(H,3H) b:(3H,).
+    Gate order r,z,n (reset, update, candidate)."""
+    hh = h.shape[-1]
+    zx = x @ w + b
+    zr = h @ r
+    rx, ux, nx = jnp.split(zx, 3, axis=-1)
+    rr, ur, nr = jnp.split(zr, 3, axis=-1)
+    reset = jax.nn.sigmoid(rx + rr)
+    update = jax.nn.sigmoid(ux + ur)
+    cand = jnp.tanh(nx + reset * nr)
+    del hh
+    return (1.0 - update) * cand + update * h
+
+
+def _resize(x, *, size, method="bilinear"):
+    """x: (N, H, W, C) -> (N, size[0], size[1], C)."""
+    n, _, _, c = x.shape
+    return jax.image.resize(x, (n, size[0], size[1], c), method=method)
+
+
+def _crop(x, *, offset, size):
+    """Static crop: x[:, oh:oh+h, ow:ow+w, :]."""
+    oh, ow = offset
+    h, w = size
+    return x[:, oh : oh + h, ow : ow + w, :]
+
+
+def _adjust_contrast(x, *, factor):
+    mean = jnp.mean(x, axis=(-3, -2), keepdims=True)
+    return (x - mean) * factor + mean
+
+
+def _rgb_to_grayscale(x):
+    w = jnp.asarray([0.2989, 0.5870, 0.1140], x.dtype)
+    return jnp.sum(x * w, axis=-1, keepdims=True)
+
+
 OPS: dict[str, callable] = {
     # elementwise arithmetic
     "add": jnp.add,
@@ -142,6 +228,59 @@ OPS: dict[str, callable] = {
     "sigmoid_cross_entropy": _sigmoid_cross_entropy,
     "mse_loss": lambda pred, lab: jnp.mean(jnp.square(pred - lab)),
     "l1_loss": lambda pred, lab: jnp.mean(jnp.abs(pred - lab)),
+    # cnn extras (sd.cnn namespace; conv2d/pooling above)
+    "conv1d": _conv1d,
+    "conv3d": _conv3d,
+    "depthwise_conv2d": _depthwise_conv2d,
+    "deconv2d": _deconv2d,
+    "batch_norm": _batch_norm,
+    "im2col": lambda x, *, kernel, stride=(1, 1): jax.lax.conv_general_dilated_patches(
+        x, filter_shape=tuple(kernel), window_strides=tuple(stride), padding="VALID",
+        dimension_numbers=("NHWC", "HWIO", "NHWC"),
+    ),
+    "space_to_depth": lambda x, *, block: x.reshape(
+        x.shape[0], x.shape[1] // block, block, x.shape[2] // block, block, x.shape[3]
+    ).transpose(0, 1, 3, 2, 4, 5).reshape(
+        x.shape[0], x.shape[1] // block, x.shape[2] // block, block * block * x.shape[3]
+    ),
+    "depth_to_space": lambda x, *, block: x.reshape(
+        x.shape[0], x.shape[1], x.shape[2], block, block, x.shape[3] // (block * block)
+    ).transpose(0, 1, 3, 2, 4, 5).reshape(
+        x.shape[0], x.shape[1] * block, x.shape[2] * block, x.shape[3] // (block * block)
+    ),
+    # rnn cells (sd.rnn namespace; reference lstmLayer/gruCell declarable ops)
+    "lstm_cell": _lstm_cell,
+    "gru_cell": _gru_cell,
+    # image ops (sd.image namespace)
+    "resize": _resize,
+    "crop": _crop,
+    "flip_lr": lambda x: x[:, :, ::-1, :],
+    "flip_ud": lambda x: x[:, ::-1, :, :],
+    "adjust_brightness": lambda x, *, delta: x + delta,
+    "adjust_contrast": _adjust_contrast,
+    "rgb_to_grayscale": _rgb_to_grayscale,
+    "normalize_image": lambda x, mean, std: (x - mean) / std,
+    # linalg (sd.linalg namespace)
+    "inv": jnp.linalg.inv,
+    "det": jnp.linalg.det,
+    "cholesky": jnp.linalg.cholesky,
+    "solve": jnp.linalg.solve,
+    "svd": lambda x: jnp.linalg.svd(x, compute_uv=False),
+    "qr": lambda x: jnp.linalg.qr(x)[0],
+    "matrix_trace": jnp.trace,
+    "diag": jnp.diag,
+    "diag_part": jnp.diagonal,
+    "matrix_transpose": lambda x: jnp.swapaxes(x, -1, -2),
+    "lstsq": lambda a, b: jnp.linalg.lstsq(a, b)[0],
+    "triu": lambda x, *, k=0: jnp.triu(x, k),
+    "tril": lambda x, *, k=0: jnp.tril(x, k),
+    # bitwise (sd.bitwise namespace; integer inputs)
+    "bitwise_and": lambda a, b: jnp.bitwise_and(a.astype(jnp.int32), b.astype(jnp.int32)),
+    "bitwise_or": lambda a, b: jnp.bitwise_or(a.astype(jnp.int32), b.astype(jnp.int32)),
+    "bitwise_xor": lambda a, b: jnp.bitwise_xor(a.astype(jnp.int32), b.astype(jnp.int32)),
+    "bitwise_not": lambda a: jnp.bitwise_not(a.astype(jnp.int32)),
+    "left_shift": lambda a, *, bits: jnp.left_shift(a.astype(jnp.int32), bits),
+    "right_shift": lambda a, *, bits: jnp.right_shift(a.astype(jnp.int32), bits),
 }
 
 

@@ -141,6 +141,25 @@ _MATH_OPS = (
     "gather", "one_hot", "tile", "pad", "sum", "mean", "max", "min", "prod",
     "var", "std", "argmax", "argmin", "norm2", "cumsum", "sin", "cos",
 )
+_CNN_OPS = (
+    "conv1d", "conv2d", "conv3d", "depthwise_conv2d", "deconv2d",
+    "max_pool2d", "avg_pool2d", "batch_norm", "im2col", "space_to_depth",
+    "depth_to_space",
+)
+_RNN_OPS = ("lstm_cell", "gru_cell")
+_IMAGE_OPS = (
+    "resize", "crop", "flip_lr", "flip_ud", "adjust_brightness",
+    "adjust_contrast", "rgb_to_grayscale", "normalize_image",
+)
+_LINALG_OPS = (
+    "matmul", "inv", "det", "cholesky", "solve", "svd", "qr", "matrix_trace",
+    "diag", "diag_part", "matrix_transpose", "lstsq", "triu", "tril",
+    "tensordot", "einsum",
+)
+_BITWISE_OPS = (
+    "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
+    "left_shift", "right_shift",
+)
 
 
 @serde.register
@@ -169,6 +188,11 @@ class SameDiff:
         self.nn = _Namespace(self, _NN_OPS)
         self.loss = _Namespace(self, _LOSS_OPS)
         self.math = _Namespace(self, _MATH_OPS)
+        self.cnn = _Namespace(self, _CNN_OPS)
+        self.rnn = _Namespace(self, _RNN_OPS)
+        self.image = _Namespace(self, _IMAGE_OPS)
+        self.linalg = _Namespace(self, _LINALG_OPS)
+        self.bitwise = _Namespace(self, _BITWISE_OPS)
 
     # -- graph construction ------------------------------------------------
     def _fresh(self, base: str) -> str:
@@ -217,6 +241,44 @@ class SameDiff:
         self._loss_var = v.name
         self._compiled.clear()
 
+    # -- control flow -------------------------------------------------------
+    # The reference's TF-style Switch/Merge/Enter/Exit frames become native
+    # XLA control flow: lax.cond / lax.while_loop, compiled into the same
+    # whole-graph computation (SURVEY.md §2.2 SameDiff If/While).
+    def if_cond(self, pred: SDVariable, true_fn, false_fn, *inputs: SDVariable,
+                name: str | None = None) -> SDVariable:
+        """lax.cond over the captured inputs.  `true_fn`/`false_fn` take the
+        input arrays and return one array of identical shape/dtype."""
+        out = name or self._fresh("cond")
+        v = self._register(out, "op")
+        self._ops.append(_OpNode(
+            "_cond", (pred.name,) + tuple(i.name for i in inputs), out,
+            {"true_fn": true_fn, "false_fn": false_fn},
+        ))
+        self._compiled.clear()
+        return v
+
+    def while_loop(self, cond_fn, body_fn, *loop_vars: SDVariable,
+                   name: str | None = None) -> tuple[SDVariable, ...]:
+        """lax.while_loop.  `cond_fn(*vars) -> bool scalar`,
+        `body_fn(*vars) -> tuple of same-shaped vars`.  Returns the final
+        loop variables."""
+        base = name or self._fresh("while")
+        tuple_name = base + "#tuple"
+        self._register(tuple_name, "op")
+        self._ops.append(_OpNode(
+            "_while", tuple(v.name for v in loop_vars), tuple_name,
+            {"cond_fn": cond_fn, "body_fn": body_fn},
+        ))
+        outs = []
+        for i in range(len(loop_vars)):
+            nm = f"{base}_{i}"
+            vv = self._register(nm, "op")
+            self._ops.append(_OpNode("_tuple_get", (tuple_name,), nm, {"index": i}))
+            outs.append(vv)
+        self._compiled.clear()
+        return tuple(outs)
+
     # -- execution ---------------------------------------------------------
     def _execute(self, values: dict[str, jnp.ndarray], requested: tuple[str, ...], rng=None):
         """Topological interpretation at TRACE time: runs once under jit,
@@ -233,6 +295,33 @@ class SameDiff:
                 continue
             args = [env[i] for i in node.inputs]
             attrs = dict(node.attrs)
+            if node.op == "_cond":
+                pred = jnp.asarray(args[0]).astype(bool).reshape(())
+                operands = tuple(args[1:])
+                env[node.output] = jax.lax.cond(
+                    pred,
+                    lambda ops: attrs["true_fn"](*ops),
+                    lambda ops: attrs["false_fn"](*ops),
+                    operands,
+                )
+                continue
+            if node.op == "_while":
+                body = attrs["body_fn"]
+                cond = attrs["cond_fn"]
+
+                def body_wrap(vs, _body=body):
+                    out = _body(*vs)
+                    return tuple(out) if isinstance(out, (tuple, list)) else (out,)
+
+                env[node.output] = jax.lax.while_loop(
+                    lambda vs, _c=cond: jnp.asarray(_c(*vs)).astype(bool).reshape(()),
+                    body_wrap,
+                    tuple(args),
+                )
+                continue
+            if node.op == "_tuple_get":
+                env[node.output] = args[0][attrs["index"]]
+                continue
             if node.op == "dropout" and rng is not None:
                 import zlib
 
@@ -391,6 +480,13 @@ class SameDiff:
 
     # -- serialization (the .fb save/load role) ----------------------------
     def save(self, path: str) -> None:
+        for n in self._ops:
+            if n.op in ("_cond", "_while"):
+                raise ValueError(
+                    "graphs containing control-flow lambdas (if_cond/"
+                    "while_loop) hold Python callables and cannot be "
+                    "serialized; rebuild the graph in code after load"
+                )
         graph = {
             "placeholders": sorted(self._placeholders),
             "trainable": sorted(self._trainable),

@@ -1,0 +1,183 @@
+"""Op/gradient validation harness — `org.nd4j.autodiff.validation.OpValidation` role.
+
+Reference parity: the nd4j OpValidation/TestCase pattern (SURVEY.md §4.1) —
+per-op forward check against expected outputs plus a numeric
+central-finite-difference gradient check against the autodiff gradient, and
+DL4J's `GradientCheckUtil` for whole-network checks.  Here the autodiff
+gradient is `jax.grad` of the whole-graph computation, so one harness covers
+both granularities: any pure scalar-valued function of a params pytree.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import jax
+import numpy as np
+
+from deeplearning4j_tpu.utils.pytree import tree_flatten_with_paths
+
+
+@dataclasses.dataclass
+class GradCheckResult:
+    passed: bool
+    max_rel_error: float
+    failures: list[str]
+
+    def __bool__(self) -> bool:
+        return self.passed
+
+
+def gradient_check(
+    loss_fn: Callable[[Any], Any],
+    params: Any,
+    eps: float = 1e-3,
+    rtol: float = 5e-2,
+    atol: float = 1e-4,
+    max_checks_per_array: int = 16,
+    seed: int = 0,
+) -> GradCheckResult:
+    """Central finite differences vs jax.grad on a scalar loss of a params
+    pytree.  Checks a random subset of entries per array (the reference
+    checks all entries in float64; we sample because f32 full sweeps on big
+    nets are noise-dominated anyway — sampled entries use the same
+    central-difference formula)."""
+    loss_fn_c = jax.jit(loss_fn)
+    analytic = jax.jit(jax.grad(loss_fn_c))(params)
+    flat_params = dict(tree_flatten_with_paths(params))
+    flat_grads = dict(tree_flatten_with_paths(analytic))
+    rng = np.random.default_rng(seed)
+    failures: list[str] = []
+    max_rel = 0.0
+
+    # mutate a copy of the flat dict and rebuild via paths
+    def _perturbed(path: str, idx: tuple, delta: float):
+        p = jax.tree_util.tree_map(lambda x: x, params)  # fresh containers, shared leaves
+        keys = path.split(".")
+        node = p
+        for k in keys[:-1]:
+            node = node[k] if isinstance(node, dict) else node[int(k)]
+        last = keys[-1] if isinstance(node, dict) else int(keys[-1])
+        arr = np.array(node[last], dtype=np.float64)
+        arr[idx] += delta
+        node[last] = arr.astype(np.float32)
+        return p
+
+    for path, arr in flat_params.items():
+        arr = np.asarray(arr)
+        if not np.issubdtype(arr.dtype, np.floating):
+            continue
+        g = np.asarray(flat_grads[path])
+        n = arr.size
+        k = min(max_checks_per_array, n)
+        flat_idx = rng.choice(n, size=k, replace=False)
+        for fi in flat_idx:
+            idx = np.unravel_index(fi, arr.shape)
+            lp = float(loss_fn_c(_perturbed(path, idx, +eps)))
+            lm = float(loss_fn_c(_perturbed(path, idx, -eps)))
+            numeric = (lp - lm) / (2 * eps)
+            a = float(g[idx])
+            denom = max(abs(numeric), abs(a), 1e-8)
+            rel = abs(numeric - a) / denom
+            if abs(numeric - a) > atol and rel > rtol:
+                failures.append(
+                    f"{path}{list(idx)}: analytic {a:.6g} vs numeric {numeric:.6g} "
+                    f"(rel {rel:.3g})"
+                )
+            max_rel = max(max_rel, rel if abs(numeric - a) > atol else 0.0)
+    return GradCheckResult(passed=not failures, max_rel_error=max_rel, failures=failures)
+
+
+@dataclasses.dataclass
+class TestCase:
+    """One op/graph validation case (`org.nd4j.autodiff.validation.TestCase`
+    role): forward expectations + gradient check on a SameDiff graph."""
+
+    __test__ = False  # not a pytest class despite the name
+
+    sd: Any
+    placeholders: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    expected: dict[str, np.ndarray] = dataclasses.field(default_factory=dict)
+    gradient_check: bool = True
+    wrt: Optional[list[str]] = None
+    eps: float = 1e-3
+    rtol: float = 5e-2
+    atol: float = 1e-4
+    forward_rtol: float = 1e-4
+    forward_atol: float = 1e-5
+
+
+class OpValidation:
+    """Validates TestCases; collects per-op coverage like the reference's
+    unvalidated-op report."""
+
+    _validated_ops: set[str] = set()
+
+    @staticmethod
+    def validate(tc: TestCase) -> list[str]:
+        """Returns a list of failure strings; empty == pass."""
+        errors: list[str] = []
+        sd = tc.sd
+        # forward expectations
+        if tc.expected:
+            outs = sd.output(tc.placeholders, *tc.expected.keys())
+            if not isinstance(outs, tuple):
+                outs = (outs,)
+            for (name, exp), got in zip(tc.expected.items(), outs):
+                got = np.asarray(got)
+                exp = np.asarray(exp)
+                if got.shape != exp.shape:
+                    errors.append(f"{name}: shape {got.shape} != expected {exp.shape}")
+                elif not np.allclose(got, exp, rtol=tc.forward_rtol, atol=tc.forward_atol):
+                    err = float(np.max(np.abs(got - exp)))
+                    errors.append(f"{name}: forward mismatch, max abs err {err:.3g}")
+        # gradient check against finite differences
+        if tc.gradient_check:
+            if sd._loss_var is None:
+                errors.append("gradient_check requested but no loss set")
+            else:
+                wrt = tc.wrt or sorted(sd._trainable)
+                analytic = sd.grad(tc.placeholders, *wrt)
+                for name in wrt:
+                    base = np.array(sd.get_value(name), dtype=np.float64)
+                    g = np.asarray(analytic[name])
+                    rng = np.random.default_rng(0)
+                    n = base.size
+                    for fi in rng.choice(n, size=min(8, n), replace=False):
+                        idx = np.unravel_index(fi, base.shape)
+                        orig = base[idx]
+                        sd.set_value(name, _with(base, idx, orig + tc.eps))
+                        lp = float(sd.output(tc.placeholders, sd._loss_var))
+                        sd.set_value(name, _with(base, idx, orig - tc.eps))
+                        lm = float(sd.output(tc.placeholders, sd._loss_var))
+                        sd.set_value(name, base)
+                        numeric = (lp - lm) / (2 * tc.eps)
+                        a = float(g[idx])
+                        denom = max(abs(numeric), abs(a), 1e-8)
+                        if abs(numeric - a) > tc.atol and abs(numeric - a) / denom > tc.rtol:
+                            errors.append(
+                                f"grad {name}{list(idx)}: analytic {a:.6g} "
+                                f"vs numeric {numeric:.6g}"
+                            )
+        if not errors:
+            for node in sd._ops:
+                OpValidation._validated_ops.add(node.op)
+        return errors
+
+    @staticmethod
+    def coverage_report() -> str:
+        from deeplearning4j_tpu.autodiff.ops_registry import OPS
+
+        validated = OpValidation._validated_ops & set(OPS)
+        unvalidated = sorted(set(OPS) - validated)
+        return (
+            f"op validation coverage: {len(validated)}/{len(OPS)}\n"
+            f"unvalidated: {', '.join(unvalidated)}"
+        )
+
+
+def _with(arr: np.ndarray, idx, value) -> np.ndarray:
+    out = np.array(arr, dtype=np.float32)
+    out[idx] = value
+    return out

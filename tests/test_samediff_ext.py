@@ -1,0 +1,219 @@
+"""SameDiff extension tests: new namespaces, control flow, validation harness.
+
+Mirrors the reference's SameDiff op tests + OpValidation pattern
+(SURVEY.md §4.1): per-op forward expectations, finite-difference gradient
+checks, control-flow semantics.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff import (
+    OpValidation,
+    SameDiff,
+    TestCase,
+    gradient_check,
+)
+
+
+class TestNamespaces:
+    def test_cnn_conv1d_shapes(self):
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        w = sd.var("w", np.random.default_rng(0).normal(size=(3, 4, 8)).astype(np.float32) * 0.1)
+        out = sd.cnn.conv1d(x, w, name="y")
+        y = sd.output({"x": np.zeros((2, 16, 4), np.float32)}, "y")
+        assert np.asarray(y).shape == (2, 16, 8)
+        del out
+
+    def test_cnn_depthwise_and_deconv(self):
+        rng = np.random.default_rng(1)
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        wd = sd.var("wd", rng.normal(size=(3, 3, 4, 2)).astype(np.float32) * 0.1)
+        sd.cnn.depthwise_conv2d(x, wd, name="dw")
+        y = sd.output({"x": np.ones((1, 8, 8, 4), np.float32)}, "dw")
+        assert np.asarray(y).shape == (1, 8, 8, 8)  # C * multiplier
+
+        sd2 = SameDiff()
+        x2 = sd2.placeholder("x")
+        wt = sd2.var("wt", rng.normal(size=(2, 2, 4, 6)).astype(np.float32) * 0.1)
+        sd2.cnn.deconv2d(x2, wt, stride=(2, 2), name="up")
+        y2 = sd2.output({"x": np.ones((1, 5, 5, 4), np.float32)}, "up")
+        assert np.asarray(y2).shape == (1, 10, 10, 6)
+
+    def test_rnn_lstm_cell_math(self):
+        rng = np.random.default_rng(2)
+        n, i, h = 2, 3, 4
+        x = rng.normal(size=(n, i)).astype(np.float32)
+        h0 = np.zeros((n, h), np.float32)
+        c0 = np.zeros((n, h), np.float32)
+        w = rng.normal(size=(i, 4 * h)).astype(np.float32)
+        r = rng.normal(size=(h, 4 * h)).astype(np.float32)
+        b = np.zeros(4 * h, np.float32)
+        sd = SameDiff()
+        px = sd.placeholder("x")
+        sd.rnn.lstm_cell(px, sd.constant("h0", h0), sd.constant("c0", c0),
+                         sd.constant("w", w), sd.constant("r", r), sd.constant("b", b),
+                         name="hc")
+        out = np.asarray(sd.output({"x": x}, "hc"))
+        assert out.shape == (2, n, h)
+        # hand-computed expectation
+        z = x @ w + h0 @ r + b
+        ii, ff, gg, oo = np.split(z, 4, axis=-1)
+        sig = lambda t: 1 / (1 + np.exp(-t))
+        c_new = sig(ff) * c0 + sig(ii) * np.tanh(gg)
+        h_new = sig(oo) * np.tanh(c_new)
+        np.testing.assert_allclose(out[0], h_new, rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(out[1], c_new, rtol=1e-4, atol=1e-5)
+
+    def test_image_ops(self):
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        sd.image.resize(x, size=(4, 4), name="r")
+        sd.image.rgb_to_grayscale(x, name="g")
+        sd.image.flip_lr(x, name="f")
+        img = np.arange(2 * 2 * 2 * 3, dtype=np.float32).reshape(2, 2, 2, 3)
+        r, g, f = sd.output({"x": img}, "r", "g", "f")
+        assert np.asarray(r).shape == (2, 4, 4, 3)
+        assert np.asarray(g).shape == (2, 2, 2, 1)
+        np.testing.assert_array_equal(np.asarray(f), img[:, :, ::-1, :])
+
+    def test_linalg_ops(self):
+        rng = np.random.default_rng(3)
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        a = a @ a.T + 4 * np.eye(4, dtype=np.float32)  # SPD
+        sd = SameDiff()
+        pa = sd.constant("a", a)
+        sd.linalg.inv(pa, name="inv")
+        sd.linalg.cholesky(pa, name="chol")
+        sd.linalg.det(pa, name="det")
+        inv, chol, det = sd.output({}, "inv", "chol", "det")
+        np.testing.assert_allclose(np.asarray(inv) @ a, np.eye(4), atol=1e-3)
+        np.testing.assert_allclose(np.asarray(chol) @ np.asarray(chol).T, a, rtol=1e-3, atol=1e-3)
+        assert float(det) == pytest.approx(float(np.linalg.det(a)), rel=1e-3)
+
+    def test_bitwise_ops(self):
+        sd = SameDiff()
+        a = sd.constant("a", np.array([0b1100, 0b1010], np.int32))
+        b = sd.constant("b", np.array([0b1010, 0b0110], np.int32))
+        sd.bitwise.bitwise_and(a, b, name="and_")
+        sd.bitwise.bitwise_xor(a, b, name="xor_")
+        sd.bitwise.left_shift(a, bits=1, name="shl")
+        and_, xor_, shl = sd.output({}, "and_", "xor_", "shl")
+        np.testing.assert_array_equal(np.asarray(and_), [0b1000, 0b0010])
+        np.testing.assert_array_equal(np.asarray(xor_), [0b0110, 0b1100])
+        np.testing.assert_array_equal(np.asarray(shl), [0b11000, 0b10100])
+
+
+class TestControlFlow:
+    def test_if_cond(self):
+        import jax.numpy as jnp
+
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        pred = sd.placeholder("p")
+        sd.if_cond(pred, lambda v: v * 2.0, lambda v: v - 1.0, x, name="y")
+        y_true = sd.output({"x": np.array([3.0], np.float32), "p": np.array(True)}, "y")
+        y_false = sd.output({"x": np.array([3.0], np.float32), "p": np.array(False)}, "y")
+        np.testing.assert_allclose(np.asarray(y_true), [6.0])
+        np.testing.assert_allclose(np.asarray(y_false), [2.0])
+        del jnp
+
+    def test_while_loop(self):
+        import jax.numpy as jnp
+
+        sd = SameDiff()
+        i0 = sd.constant("i0", np.array(0.0, np.float32))
+        acc0 = sd.placeholder("acc0")
+        i_f, acc_f = sd.while_loop(
+            lambda i, acc: i < 5.0,
+            lambda i, acc: (i + 1.0, acc + i),
+            i0, acc0, name="loop",
+        )
+        out_i, out_acc = sd.output({"acc0": np.array(0.0, np.float32)}, i_f.name, acc_f.name)
+        assert float(out_i) == 5.0
+        assert float(out_acc) == 0 + 1 + 2 + 3 + 4
+        del jnp
+
+    def test_control_flow_not_serializable(self, tmp_path):
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        sd.if_cond(sd.constant("p", np.array(True)), lambda v: v, lambda v: -v, x, name="y")
+        with pytest.raises(ValueError, match="control-flow"):
+            sd.save(str(tmp_path / "g.zip"))
+
+
+class TestValidationHarness:
+    def test_gradient_check_passes_correct_grad(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        params = {"w": rng.normal(size=(5, 3)).astype(np.float32),
+                  "b": np.zeros(3, np.float32)}
+        x = rng.normal(size=(7, 5)).astype(np.float32)
+
+        def loss(p):
+            return jnp.mean(jnp.square(x @ p["w"] + p["b"]))
+
+        res = gradient_check(loss, params)
+        assert res.passed, res.failures
+
+    def test_gradient_check_catches_wrong_grad(self):
+        import jax
+
+        # a function with a deliberately wrong custom gradient
+        @jax.custom_vjp
+        def bad_square(x):
+            return x * x
+
+        def fwd(x):
+            return x * x, x
+
+        def bwd(x, g):
+            return (g * 3.0 * x,)  # wrong: should be 2x
+
+        bad_square.defvjp(fwd, bwd)
+        import jax.numpy as jnp
+
+        params = {"w": np.array([1.0, 2.0, -1.5], np.float32)}
+
+        def loss(p):
+            return jnp.sum(bad_square(p["w"]))
+
+        res = gradient_check(loss, params)
+        assert not res.passed
+        assert res.max_rel_error > 0.2
+
+    def test_opvalidation_testcase(self):
+        rng = np.random.default_rng(1)
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        w = sd.var("w", rng.normal(size=(4, 2)).astype(np.float32))
+        y = sd.math.matmul(x, w, name="y")
+        labels = sd.placeholder("labels")
+        loss = sd.loss.mse_loss(y, labels, name="loss")
+        sd.set_loss(loss)
+        xv = rng.normal(size=(3, 4)).astype(np.float32)
+        lv = rng.normal(size=(3, 2)).astype(np.float32)
+        tc = TestCase(
+            sd,
+            placeholders={"x": xv, "labels": lv},
+            expected={"y": xv @ np.asarray(sd.get_value("w"))},
+        )
+        errors = OpValidation.validate(tc)
+        assert errors == []
+        assert "matmul" in OpValidation.coverage_report() or "coverage" in OpValidation.coverage_report()
+
+    def test_opvalidation_detects_forward_mismatch(self):
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        sd.math.square(x, name="y")
+        tc = TestCase(
+            sd,
+            placeholders={"x": np.array([2.0], np.float32)},
+            expected={"y": np.array([5.0], np.float32)},  # wrong: 4.0
+            gradient_check=False,
+        )
+        errors = OpValidation.validate(tc)
+        assert errors and "mismatch" in errors[0]
