@@ -112,7 +112,6 @@ def _lstm_cell(x, h, c, w, r, b):
 def _gru_cell(x, h, w, r, b):
     """Single GRU step. x:(N,I) h:(N,H) w:(I,3H) r:(H,3H) b:(3H,).
     Gate order r,z,n (reset, update, candidate)."""
-    hh = h.shape[-1]
     zx = x @ w + b
     zr = h @ r
     rx, ux, nx = jnp.split(zx, 3, axis=-1)
@@ -120,7 +119,6 @@ def _gru_cell(x, h, w, r, b):
     reset = jax.nn.sigmoid(rx + rr)
     update = jax.nn.sigmoid(ux + ur)
     cand = jnp.tanh(nx + reset * nr)
-    del hh
     return (1.0 - update) * cand + update * h
 
 
@@ -267,9 +265,9 @@ OPS: dict[str, callable] = {
     "solve": jnp.linalg.solve,
     "svd": lambda x: jnp.linalg.svd(x, compute_uv=False),
     "qr": lambda x: jnp.linalg.qr(x)[0],
-    "matrix_trace": jnp.trace,
+    "matrix_trace": lambda x: jnp.trace(x, axis1=-2, axis2=-1),
     "diag": jnp.diag,
-    "diag_part": jnp.diagonal,
+    "diag_part": lambda x: jnp.diagonal(x, axis1=-2, axis2=-1),
     "matrix_transpose": lambda x: jnp.swapaxes(x, -1, -2),
     "lstsq": lambda a, b: jnp.linalg.lstsq(a, b)[0],
     "triu": lambda x, *, k=0: jnp.triu(x, k),

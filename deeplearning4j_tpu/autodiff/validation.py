@@ -14,6 +14,7 @@ import dataclasses
 from typing import Any, Callable, Optional
 
 import jax
+import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.utils.pytree import tree_flatten_with_paths
@@ -39,51 +40,53 @@ def gradient_check(
     seed: int = 0,
 ) -> GradCheckResult:
     """Central finite differences vs jax.grad on a scalar loss of a params
-    pytree.  Checks a random subset of entries per array (the reference
-    checks all entries in float64; we sample because f32 full sweeps on big
-    nets are noise-dominated anyway — sampled entries use the same
-    central-difference formula)."""
-    loss_fn_c = jax.jit(loss_fn)
-    analytic = jax.jit(jax.grad(loss_fn_c))(params)
-    flat_params = dict(tree_flatten_with_paths(params))
-    flat_grads = dict(tree_flatten_with_paths(analytic))
+    pytree (any container shapes — dicts, tuples, bare arrays; integer
+    leaves pass through untouched).  Checks a random subset of entries per
+    float array (the reference checks all entries in float64; we sample
+    because f32 full sweeps on big nets are noise-dominated anyway)."""
+    leaves, treedef = jax.tree_util.tree_flatten(params)
+    paths = [p for p, _ in tree_flatten_with_paths(params)]
+    float_idx = [
+        i for i, l in enumerate(leaves)
+        if np.issubdtype(np.asarray(l).dtype, np.floating)
+    ]
+
+    def loss_of_floats(float_leaves):
+        rebuilt = list(leaves)
+        for i, fl in zip(float_idx, float_leaves):
+            rebuilt[i] = fl
+        return loss_fn(jax.tree_util.tree_unflatten(treedef, rebuilt))
+
+    loss_jit = jax.jit(loss_of_floats)
+    float_leaves = [leaves[i] for i in float_idx]
+    analytic = jax.jit(jax.grad(loss_of_floats))(float_leaves)
     rng = np.random.default_rng(seed)
     failures: list[str] = []
     max_rel = 0.0
-
-    # mutate a copy of the flat dict and rebuild via paths
-    def _perturbed(path: str, idx: tuple, delta: float):
-        p = jax.tree_util.tree_map(lambda x: x, params)  # fresh containers, shared leaves
-        keys = path.split(".")
-        node = p
-        for k in keys[:-1]:
-            node = node[k] if isinstance(node, dict) else node[int(k)]
-        last = keys[-1] if isinstance(node, dict) else int(keys[-1])
-        arr = np.array(node[last], dtype=np.float64)
-        arr[idx] += delta
-        node[last] = arr.astype(np.float32)
-        return p
-
-    for path, arr in flat_params.items():
-        arr = np.asarray(arr)
-        if not np.issubdtype(arr.dtype, np.floating):
-            continue
-        g = np.asarray(flat_grads[path])
+    for pos, leaf_i in enumerate(float_idx):
+        arr = np.asarray(leaves[leaf_i])
+        g = np.asarray(analytic[pos])
         n = arr.size
         k = min(max_checks_per_array, n)
-        flat_idx = rng.choice(n, size=k, replace=False)
-        for fi in flat_idx:
+        for fi in rng.choice(n, size=k, replace=False):
             idx = np.unravel_index(fi, arr.shape)
-            lp = float(loss_fn_c(_perturbed(path, idx, +eps)))
-            lm = float(loss_fn_c(_perturbed(path, idx, -eps)))
+            perturbed = [np.asarray(l) for l in float_leaves]
+            plus = np.array(arr)
+            plus[idx] += eps
+            perturbed[pos] = plus.astype(arr.dtype)
+            lp = float(loss_jit(perturbed))
+            minus = np.array(arr)
+            minus[idx] -= eps
+            perturbed[pos] = minus.astype(arr.dtype)
+            lm = float(loss_jit(perturbed))
             numeric = (lp - lm) / (2 * eps)
             a = float(g[idx])
             denom = max(abs(numeric), abs(a), 1e-8)
             rel = abs(numeric - a) / denom
             if abs(numeric - a) > atol and rel > rtol:
                 failures.append(
-                    f"{path}{list(idx)}: analytic {a:.6g} vs numeric {numeric:.6g} "
-                    f"(rel {rel:.3g})"
+                    f"{paths[leaf_i]}{list(idx)}: analytic {a:.6g} vs numeric "
+                    f"{numeric:.6g} (rel {rel:.3g})"
                 )
             max_rel = max(max_rel, rel if abs(numeric - a) > atol else 0.0)
     return GradCheckResult(passed=not failures, max_rel_error=max_rel, failures=failures)
@@ -106,6 +109,7 @@ class TestCase:
     atol: float = 1e-4
     forward_rtol: float = 1e-4
     forward_atol: float = 1e-5
+    max_checks_per_array: int = 8
 
 
 class OpValidation:
@@ -132,34 +136,29 @@ class OpValidation:
                 elif not np.allclose(got, exp, rtol=tc.forward_rtol, atol=tc.forward_atol):
                     err = float(np.max(np.abs(got - exp)))
                     errors.append(f"{name}: forward mismatch, max abs err {err:.3g}")
-        # gradient check against finite differences
+        # gradient check: delegate to gradient_check over a closure that
+        # feeds the checked variables through ONE compiled executable (no
+        # set_value -> no compile-cache invalidation per probe)
         if tc.gradient_check:
             if sd._loss_var is None:
                 errors.append("gradient_check requested but no loss set")
             else:
                 wrt = tc.wrt or sorted(sd._trainable)
-                analytic = sd.grad(tc.placeholders, *wrt)
-                for name in wrt:
-                    base = np.array(sd.get_value(name), dtype=np.float64)
-                    g = np.asarray(analytic[name])
-                    rng = np.random.default_rng(0)
-                    n = base.size
-                    for fi in rng.choice(n, size=min(8, n), replace=False):
-                        idx = np.unravel_index(fi, base.shape)
-                        orig = base[idx]
-                        sd.set_value(name, _with(base, idx, orig + tc.eps))
-                        lp = float(sd.output(tc.placeholders, sd._loss_var))
-                        sd.set_value(name, _with(base, idx, orig - tc.eps))
-                        lm = float(sd.output(tc.placeholders, sd._loss_var))
-                        sd.set_value(name, base)
-                        numeric = (lp - lm) / (2 * tc.eps)
-                        a = float(g[idx])
-                        denom = max(abs(numeric), abs(a), 1e-8)
-                        if abs(numeric - a) > tc.atol and abs(numeric - a) / denom > tc.rtol:
-                            errors.append(
-                                f"grad {name}{list(idx)}: analytic {a:.6g} "
-                                f"vs numeric {numeric:.6g}"
-                            )
+                base = {name: np.asarray(sd.get_value(name)) for name in wrt}
+                ph = {k: jnp.asarray(v) for k, v in tc.placeholders.items()}
+
+                def loss_of(vars_dict):
+                    values = dict(sd._values)
+                    values.update(vars_dict)
+                    values.update(ph)
+                    (out,) = sd._execute(values, (sd._loss_var,))
+                    return out
+
+                res = gradient_check(
+                    loss_of, base, eps=tc.eps, rtol=tc.rtol, atol=tc.atol,
+                    max_checks_per_array=tc.max_checks_per_array,
+                )
+                errors.extend(f"grad {f}" for f in res.failures)
         if not errors:
             for node in sd._ops:
                 OpValidation._validated_ops.add(node.op)
@@ -175,9 +174,3 @@ class OpValidation:
             f"op validation coverage: {len(validated)}/{len(OPS)}\n"
             f"unvalidated: {', '.join(unvalidated)}"
         )
-
-
-def _with(arr: np.ndarray, idx, value) -> np.ndarray:
-    out = np.array(arr, dtype=np.float32)
-    out[idx] = value
-    return out

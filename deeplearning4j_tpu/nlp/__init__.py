@@ -1,0 +1,27 @@
+"""NLP subsystem — the `deeplearning4j-nlp` role (Word2Vec, GloVe,
+ParagraphVectors, tokenizers, vocab, word-vector serialization)."""
+
+from deeplearning4j_tpu.nlp.tokenizer import (
+    CommonPreprocessor,
+    DefaultTokenizer,
+    DefaultTokenizerFactory,
+    NGramTokenizerFactory,
+)
+from deeplearning4j_tpu.nlp.vocab import VocabCache, VocabWord
+from deeplearning4j_tpu.nlp.word2vec import Word2Vec
+from deeplearning4j_tpu.nlp.glove import Glove
+from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
+from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+
+__all__ = [
+    "DefaultTokenizer",
+    "DefaultTokenizerFactory",
+    "NGramTokenizerFactory",
+    "CommonPreprocessor",
+    "VocabCache",
+    "VocabWord",
+    "Word2Vec",
+    "Glove",
+    "ParagraphVectors",
+    "WordVectorSerializer",
+]

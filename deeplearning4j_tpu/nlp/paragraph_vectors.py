@@ -1,0 +1,163 @@
+"""ParagraphVectors (Doc2Vec) — `org.deeplearning4j.models.paragraphvectors` role.
+
+Reference parity: PV-DBOW (`DBOW` sequence learning algorithm — the doc
+vector predicts each word in the document) and PV-DM (`DM` — doc vector +
+context mean predicts the center word), labelled documents, and
+`inferVector()` for unseen documents (gradient steps on a fresh doc vector
+with word vectors frozen).  Shares Word2Vec's jit-compiled negative-sampling
+step; doc vectors live in their own embedding matrix.
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.nlp.tokenizer import CommonPreprocessor, DefaultTokenizerFactory
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.nlp.word2vec import _ns_step
+
+
+class ParagraphVectors:
+    def __init__(self, layer_size: int = 100, window_size: int = 5,
+                 min_word_frequency: int = 1, negative_sample: int = 5,
+                 epochs: int = 5, learning_rate: float = 0.025,
+                 algorithm: str = "dbow", seed: int = 42,
+                 batch_size: int = 2048, tokenizer_factory=None):
+        if algorithm not in ("dbow", "dm"):
+            raise ValueError(f"unknown algorithm {algorithm!r}")
+        self.vector_size = layer_size
+        self.window = window_size
+        self.min_word_frequency = min_word_frequency
+        self.negative = max(1, negative_sample)
+        self.epochs_ = epochs
+        self.lr = learning_rate
+        self.algorithm = algorithm
+        self.seed = seed
+        self.batch_size = batch_size
+        if tokenizer_factory is None:
+            tokenizer_factory = DefaultTokenizerFactory()
+            tokenizer_factory.set_token_pre_processor(CommonPreprocessor())
+        self.tokenizer_factory = tokenizer_factory
+        self.vocab: VocabCache | None = None
+        self.labels: list[str] = []
+        self._label_idx: dict[str, int] = {}
+        self.doc_vectors: np.ndarray | None = None
+        self.syn0: np.ndarray | None = None      # word vectors
+        self._syn1neg: np.ndarray | None = None  # output vectors (for infer)
+
+    def fit(self, documents: Iterable[str], labels: Sequence[str] | None = None) -> "ParagraphVectors":
+        docs = [self.tokenizer_factory.create(d).get_tokens() for d in documents]
+        if labels is None:
+            labels = [f"DOC_{i}" for i in range(len(docs))]
+        if len(labels) != len(docs):
+            raise ValueError("labels/documents length mismatch")
+        self.labels = list(labels)
+        self._label_idx = {l: i for i, l in enumerate(self.labels)}
+        self.vocab = VocabCache(self.min_word_frequency)
+        for toks in docs:
+            self.vocab.track(toks)
+        self.vocab.finish()
+        v, d = len(self.vocab), self.vector_size
+        if v == 0:
+            raise ValueError("empty vocabulary")
+        rng = np.random.default_rng(self.seed)
+        ndocs = len(docs)
+        # one concatenated embedding: rows [0,v) words, [v, v+ndocs) docs.
+        # NS targets are always words; "centers" may be doc ids (DBOW).
+        syn0 = ((rng.random((v + ndocs, d)) - 0.5) / d).astype(np.float32)
+        synout = np.zeros((v + ndocs, d), dtype=np.float32)
+        enc = [
+            np.array([self.vocab.index_of(t) for t in toks if t in self.vocab], dtype=np.int32)
+            for toks in docs
+        ]
+        ns_probs = self.vocab.negative_table()
+        syn0j, synoutj = jnp.asarray(syn0), jnp.asarray(synout)
+        for _ in range(self.epochs_):
+            centers, targets = self._pairs(enc, v, rng)
+            for i in range(0, len(centers), self.batch_size):
+                c = centers[i : i + self.batch_size]
+                t = targets[i : i + self.batch_size]
+                negs = rng.choice(v, size=(len(c), self.negative), p=ns_probs).astype(np.int32)
+                syn0j, synoutj, _ = _ns_step(
+                    syn0j, synoutj, jnp.asarray(c), jnp.asarray(t),
+                    jnp.asarray(negs), jnp.float32(self.lr),
+                )
+        full = np.asarray(syn0j)
+        self.syn0 = full[:v]
+        self.doc_vectors = full[v:]
+        self._syn1neg = np.asarray(synoutj)[:v]
+        return self
+
+    def _pairs(self, enc, v, rng):
+        cs, ts = [], []
+        for doc_i, words in enumerate(enc):
+            if words.size == 0:
+                continue
+            doc_row = v + doc_i
+            if self.algorithm == "dbow":
+                # doc vector predicts every word
+                cs.append(np.full(words.size, doc_row, np.int32))
+                ts.append(words)
+            else:  # dm, pairwise approximation: doc + each context word predict center
+                cs.append(np.full(words.size, doc_row, np.int32))
+                ts.append(words)
+                n = words.size
+                for off in range(1, min(self.window, n - 1) + 1):
+                    idx = np.arange(n - off)
+                    cs.append(words[idx])
+                    ts.append(words[idx + off])
+        centers = np.concatenate(cs)
+        targets = np.concatenate(ts)
+        perm = rng.permutation(centers.size)
+        return centers[perm], targets[perm]
+
+    # -- lookups -----------------------------------------------------------
+    def get_doc_vector(self, label: str) -> np.ndarray:
+        return self.doc_vectors[self._label_idx[label]]
+
+    def similarity(self, label_a: str, label_b: str) -> float:
+        a, b = self.get_doc_vector(label_a), self.get_doc_vector(label_b)
+        denom = np.linalg.norm(a) * np.linalg.norm(b)
+        return float(a @ b / denom) if denom else 0.0
+
+    def nearest_labels(self, text_or_label: str, n: int = 5) -> list[str]:
+        if text_or_label in self._label_idx:
+            vec = self.get_doc_vector(text_or_label)
+            exclude = {text_or_label}
+        else:
+            vec = self.infer_vector(text_or_label)
+            exclude = set()
+        norms = np.linalg.norm(self.doc_vectors, axis=1) * max(np.linalg.norm(vec), 1e-12)
+        sims = self.doc_vectors @ vec / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        out = [self.labels[int(i)] for i in order if self.labels[int(i)] not in exclude]
+        return out[:n]
+
+    def infer_vector(self, text: str, steps: int = 50, lr: float = 0.05,
+                     seed: int = 0) -> np.ndarray:
+        """Gradient steps on a fresh doc vector with word/output vectors
+        frozen (reference `inferVector`)."""
+        toks = self.tokenizer_factory.create(text).get_tokens()
+        words = np.array(
+            [self.vocab.index_of(t) for t in toks if t in self.vocab], dtype=np.int32
+        )
+        rng = np.random.default_rng(seed)
+        d = self.vector_size
+        vec = ((rng.random(d) - 0.5) / d).astype(np.float32)
+        if words.size == 0:
+            return vec
+        ns_probs = self.vocab.negative_table()
+        u_pos = self._syn1neg[words]  # (T,D)
+        for _ in range(steps):
+            negs = rng.choice(len(self.vocab), size=(words.size, self.negative), p=ns_probs)
+            u_neg = self._syn1neg[negs]  # (T,K,D)
+            logits_p = u_pos @ vec
+            logits_n = np.einsum("tkd,d->tk", u_neg, vec)
+            gp = 1 / (1 + np.exp(-logits_p)) - 1.0
+            gn = 1 / (1 + np.exp(-logits_n))
+            grad = gp @ u_pos + np.einsum("tk,tkd->d", gn, u_neg)
+            vec -= lr * grad / words.size
+        return vec

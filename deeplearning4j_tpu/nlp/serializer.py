@@ -1,0 +1,80 @@
+"""Word-vector serialization — `WordVectorSerializer` role.
+
+Reference parity: the word2vec text format ("V D" header, then one
+"word v1 v2 ..." line per word) readable by the original C tool, gensim and
+the reference's `WordVectorSerializer.writeWord2VecModel/readWord2VecModel`.
+"""
+
+from __future__ import annotations
+
+import gzip
+
+import numpy as np
+
+from deeplearning4j_tpu.nlp.vocab import VocabCache
+
+
+class _StaticWordVectors:
+    """Lookup-only word vectors loaded from disk."""
+
+    def __init__(self, words: list[str], matrix: np.ndarray):
+        self.syn0 = matrix
+        self.vocab = VocabCache()
+        for w in words:
+            self.vocab.track([w])
+        # preserve file order as index order (VocabCache orders by count,
+        # all equal here -> insertion order of most_common is preserved)
+        self.vocab.finish()
+        self._order = {w: i for i, w in enumerate(words)}
+
+    def has_word(self, word: str) -> bool:
+        return word in self._order
+
+    def get_word_vector(self, word: str) -> np.ndarray:
+        return self.syn0[self._order[word]]
+
+    def similarity(self, a: str, b: str) -> float:
+        va, vb = self.get_word_vector(a), self.get_word_vector(b)
+        denom = np.linalg.norm(va) * np.linalg.norm(vb)
+        return float(va @ vb / denom) if denom else 0.0
+
+    def words_nearest(self, word: str, n: int = 10) -> list[str]:
+        vec = self.get_word_vector(word)
+        norms = np.linalg.norm(self.syn0, axis=1) * max(np.linalg.norm(vec), 1e-12)
+        sims = self.syn0 @ vec / np.maximum(norms, 1e-12)
+        order = np.argsort(-sims)
+        words = list(self._order)
+        return [words[int(i)] for i in order if words[int(i)] != word][:n]
+
+    def vocab_words(self) -> list[str]:
+        return list(self._order)
+
+
+class WordVectorSerializer:
+    @staticmethod
+    def write_word2vec_model(model, path: str) -> None:
+        """word2vec text format; .gz suffix compresses."""
+        words = model.vocab_words() if hasattr(model, "vocab_words") else model.vocab.words()
+        mat = model.syn0
+        opener = gzip.open if path.endswith(".gz") else open
+        with opener(path, "wt", encoding="utf-8") as f:
+            f.write(f"{len(words)} {mat.shape[1]}\n")
+            for i, w in enumerate(words):
+                vec = " ".join(f"{x:.6f}" for x in mat[i])
+                f.write(f"{w} {vec}\n")
+
+    @staticmethod
+    def read_word2vec_model(path: str) -> _StaticWordVectors:
+        opener = gzip.open if path.endswith(".gz") else open
+        words: list[str] = []
+        rows: list[np.ndarray] = []
+        with opener(path, "rt", encoding="utf-8") as f:
+            header = f.readline().split()
+            v, d = int(header[0]), int(header[1])
+            for line in f:
+                parts = line.rstrip("\n").split(" ")
+                words.append(parts[0])
+                rows.append(np.array(parts[1 : d + 1], dtype=np.float32))
+        if len(words) != v:
+            raise ValueError(f"header declared {v} words, file had {len(words)}")
+        return _StaticWordVectors(words, np.stack(rows))

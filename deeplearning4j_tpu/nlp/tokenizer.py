@@ -1,0 +1,89 @@
+"""Tokenizers — `org.deeplearning4j.text.tokenization` role.
+
+Reference parity: `DefaultTokenizer` (whitespace/punct split),
+`NGramTokenizerFactory`, `CommonPreprocessor` (lowercase + strip
+punctuation), and the `TokenizerFactory` SPI that pipelines a token
+preprocessor into every produced tokenizer.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Callable, Iterator, Optional
+
+_TOKEN_RE = re.compile(r"\S+")
+_PUNCT_RE = re.compile(r"[^\w]", re.UNICODE)
+
+
+class CommonPreprocessor:
+    """Lowercase + strip punctuation (`CommonPreprocessor` role)."""
+
+    def pre_process(self, token: str) -> str:
+        return _PUNCT_RE.sub("", token.lower())
+
+    __call__ = pre_process
+
+
+class DefaultTokenizer:
+    def __init__(self, text: str, preprocessor: Optional[Callable[[str], str]] = None):
+        self._tokens = _TOKEN_RE.findall(text)
+        self._pre = preprocessor
+
+    def count_tokens(self) -> int:
+        return len(self._tokens)
+
+    def get_tokens(self) -> list[str]:
+        out = []
+        for t in self._tokens:
+            if self._pre is not None:
+                t = self._pre(t)
+            if t:
+                out.append(t)
+        return out
+
+    def __iter__(self) -> Iterator[str]:
+        return iter(self.get_tokens())
+
+
+class DefaultTokenizerFactory:
+    """`TokenizerFactory` SPI: create() per document, with a shared token
+    preprocessor."""
+
+    def __init__(self):
+        self._pre: Optional[Callable[[str], str]] = None
+
+    def set_token_pre_processor(self, pre: Callable[[str], str]) -> None:
+        self._pre = pre
+
+    def create(self, text: str) -> DefaultTokenizer:
+        return DefaultTokenizer(text, self._pre)
+
+
+class NGramTokenizerFactory:
+    """Emits word n-grams joined by spaces (`NGramTokenizerFactory` role)."""
+
+    def __init__(self, min_n: int, max_n: int):
+        self.min_n, self.max_n = min_n, max_n
+        self._pre: Optional[Callable[[str], str]] = None
+
+    def set_token_pre_processor(self, pre: Callable[[str], str]) -> None:
+        self._pre = pre
+
+    def create(self, text: str):
+        base = DefaultTokenizer(text, self._pre).get_tokens()
+        grams: list[str] = []
+        for n in range(self.min_n, self.max_n + 1):
+            for i in range(len(base) - n + 1):
+                grams.append(" ".join(base[i : i + n]))
+
+        class _T:
+            def get_tokens(self):
+                return grams
+
+            def count_tokens(self):
+                return len(grams)
+
+            def __iter__(self):
+                return iter(grams)
+
+        return _T()

@@ -1,0 +1,139 @@
+"""Vocabulary cache — `org.deeplearning4j.models.word2vec.wordstore.VocabCache` role.
+
+Word counts, index assignment (frequency-ordered), min-frequency filtering,
+the unigram^0.75 negative-sampling table, and Huffman coding for
+hierarchical softmax (batched-friendly: codes/points stored as padded
+matrices so the HS loss is one gather + sigmoid under jit, not a per-node
+tree walk).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import heapq
+from collections import Counter
+from typing import Iterable
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class VocabWord:
+    word: str
+    count: int
+    index: int
+    # Huffman path (hierarchical softmax): inner-node ids + branch codes
+    codes: list[int] = dataclasses.field(default_factory=list)
+    points: list[int] = dataclasses.field(default_factory=list)
+
+
+class VocabCache:
+    def __init__(self, min_word_frequency: int = 1):
+        self.min_word_frequency = min_word_frequency
+        self._counter: Counter = Counter()
+        self._words: dict[str, VocabWord] = {}
+        self._by_index: list[VocabWord] = []
+        self._ns_table: np.ndarray | None = None
+
+    # -- building ----------------------------------------------------------
+    def track(self, tokens: Iterable[str]) -> None:
+        self._counter.update(tokens)
+
+    def finish(self) -> "VocabCache":
+        """Freeze: assign frequency-ordered indices, build Huffman codes."""
+        kept = [
+            (w, c) for w, c in self._counter.most_common()
+            if c >= self.min_word_frequency
+        ]
+        self._words = {}
+        self._by_index = []
+        for i, (w, c) in enumerate(kept):
+            vw = VocabWord(word=w, count=c, index=i)
+            self._words[w] = vw
+            self._by_index.append(vw)
+        if self._by_index:
+            self._build_huffman()
+        return self
+
+    def _build_huffman(self) -> None:
+        """Standard word2vec Huffman tree over word counts; node ids index
+        the inner-node (syn1) matrix."""
+        n = len(self._by_index)
+        # heap of (count, tiebreak, node_id); leaves 0..n-1, inner n..2n-2
+        heap = [(vw.count, i, i) for i, vw in enumerate(self._by_index)]
+        heapq.heapify(heap)
+        parent = {}
+        binary = {}
+        next_id = n
+        while len(heap) > 1:
+            c1, _, a = heapq.heappop(heap)
+            c2, _, b = heapq.heappop(heap)
+            parent[a] = next_id
+            parent[b] = next_id
+            binary[a] = 0
+            binary[b] = 1
+            heapq.heappush(heap, (c1 + c2, next_id, next_id))
+            next_id += 1
+        root = heap[0][2] if heap else None
+        for i, vw in enumerate(self._by_index):
+            codes, points = [], []
+            node = i
+            while node != root:
+                codes.append(binary[node])
+                points.append(parent[node] - n)  # inner-node id, 0-based
+                node = parent[node]
+            vw.codes = codes[::-1]
+            vw.points = points[::-1]
+
+    # -- queries -----------------------------------------------------------
+    def __contains__(self, word: str) -> bool:
+        return word in self._words
+
+    def __len__(self) -> int:
+        return len(self._by_index)
+
+    def word_for(self, index: int) -> str:
+        return self._by_index[index].word
+
+    def index_of(self, word: str) -> int:
+        return self._words[word].index
+
+    def word_frequency(self, word: str) -> int:
+        return self._words[word].count if word in self._words else 0
+
+    def words(self) -> list[str]:
+        return [vw.word for vw in self._by_index]
+
+    def total_word_count(self) -> int:
+        return sum(vw.count for vw in self._by_index)
+
+    # -- sampling tables ---------------------------------------------------
+    def negative_table(self) -> np.ndarray:
+        """Unigram^0.75 sampling distribution (word2vec's table)."""
+        if self._ns_table is None:
+            counts = np.array([vw.count for vw in self._by_index], dtype=np.float64)
+            probs = counts**0.75
+            self._ns_table = (probs / probs.sum()).astype(np.float64)
+        return self._ns_table
+
+    def subsample_keep_probs(self, t: float = 1e-3) -> np.ndarray:
+        """word2vec frequent-word subsampling keep probability per index."""
+        total = max(1, self.total_word_count())
+        freq = np.array([vw.count for vw in self._by_index], dtype=np.float64) / total
+        keep = np.minimum(1.0, np.sqrt(t / np.maximum(freq, 1e-12)) + t / np.maximum(freq, 1e-12))
+        return keep
+
+    def huffman_matrices(self) -> tuple[np.ndarray, np.ndarray, np.ndarray]:
+        """(codes [V,L], points [V,L], mask [V,L]) padded to the max code
+        length — the batched-HS layout."""
+        max_len = max((len(vw.codes) for vw in self._by_index), default=0)
+        v = len(self._by_index)
+        codes = np.zeros((v, max_len), dtype=np.float32)
+        points = np.zeros((v, max_len), dtype=np.int32)
+        mask = np.zeros((v, max_len), dtype=np.float32)
+        for i, vw in enumerate(self._by_index):
+            l = len(vw.codes)
+            codes[i, :l] = vw.codes
+            points[i, :l] = vw.points
+            mask[i, :l] = 1.0
+        return codes, points, mask

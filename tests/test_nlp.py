@@ -1,0 +1,199 @@
+"""NLP tests: tokenizers, vocab/Huffman, Word2Vec (NS + HS), GloVe,
+ParagraphVectors, serialization round-trip.
+
+Mirrors the reference's `deeplearning4j-nlp` test pattern: tiny synthetic
+corpora with known co-occurrence structure; assert that related words embed
+closer than unrelated ones.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import (
+    CommonPreprocessor,
+    DefaultTokenizerFactory,
+    Glove,
+    NGramTokenizerFactory,
+    ParagraphVectors,
+    VocabCache,
+    Word2Vec,
+    WordVectorSerializer,
+)
+
+
+def _synthetic_corpus(n=300, seed=0):
+    """Two topic clusters: {cat,dog,pet} and {car,road,drive}; sentences
+    stay within one cluster, so intra-cluster similarity should dominate."""
+    rng = np.random.default_rng(seed)
+    animals = ["cat", "dog", "pet", "fur", "paw"]
+    cars = ["car", "road", "drive", "wheel", "fuel"]
+    out = []
+    for _ in range(n):
+        group = animals if rng.random() < 0.5 else cars
+        out.append(" ".join(rng.choice(group, size=8)))
+    return out
+
+
+class TestTokenizers:
+    def test_default_tokenizer_with_preprocessor(self):
+        tf = DefaultTokenizerFactory()
+        tf.set_token_pre_processor(CommonPreprocessor())
+        toks = tf.create("Hello, World! 123 foo-bar").get_tokens()
+        assert toks == ["hello", "world", "123", "foobar"]
+
+    def test_ngram_tokenizer(self):
+        tf = NGramTokenizerFactory(1, 2)
+        toks = tf.create("a b c").get_tokens()
+        assert toks == ["a", "b", "c", "a b", "b c"]
+
+
+class TestVocab:
+    def test_min_frequency_and_order(self):
+        vc = VocabCache(min_word_frequency=2)
+        vc.track("a a a b b c".split())
+        vc.finish()
+        assert "c" not in vc
+        assert vc.index_of("a") == 0  # most frequent first
+        assert vc.index_of("b") == 1
+        assert vc.word_frequency("a") == 3
+
+    def test_huffman_codes_prefix_free(self):
+        vc = VocabCache()
+        vc.track(list("aaaabbbccd"))
+        vc.finish()
+        codes = {}
+        for w in vc.words():
+            vw = vc._words[w]
+            codes[w] = "".join(map(str, vw.codes))
+        # prefix-free: no code is a prefix of another
+        cs = list(codes.values())
+        for i, a in enumerate(cs):
+            for j, b in enumerate(cs):
+                if i != j:
+                    assert not b.startswith(a), (codes,)
+        # more frequent word gets shorter (or equal) code
+        assert len(codes["a"]) <= len(codes["d"])
+
+    def test_huffman_matrices_shapes(self):
+        vc = VocabCache()
+        vc.track(list("aabbc"))
+        vc.finish()
+        codes, points, mask = vc.huffman_matrices()
+        v = len(vc)
+        assert codes.shape == points.shape == mask.shape
+        assert codes.shape[0] == v
+        assert int(points.max()) <= v - 2  # inner nodes are 0..V-2
+
+
+class TestWord2Vec:
+    @pytest.mark.parametrize("negative", [5, 0])  # 0 -> hierarchical softmax
+    def test_clusters_separate(self, negative):
+        w2v = (
+            Word2Vec.builder()
+            .min_word_frequency(1)
+            .layer_size(16)
+            .window_size(3)
+            .negative_sample(negative)
+            .epochs(6)
+            .seed(1)
+            .build()
+        )
+        w2v.fit(_synthetic_corpus())
+        assert w2v.has_word("cat") and w2v.has_word("car")
+        intra = w2v.similarity("cat", "dog")
+        inter = w2v.similarity("cat", "road")
+        assert intra > inter, (intra, inter)
+        near = w2v.words_nearest("cat", 3)
+        animal_hits = len(set(near) & {"dog", "pet", "fur", "paw"})
+        assert animal_hits >= 2, near
+
+    def test_cbow_runs(self):
+        w2v = (
+            Word2Vec.builder().min_word_frequency(1).layer_size(8)
+            .window_size(2).epochs(2).build()
+        )
+        w2v.elements = None
+        w2v.algorithm = "cbow"
+        w2v.fit(_synthetic_corpus(n=50))
+        assert w2v.syn0.shape[1] == 8
+
+    def test_get_word_vector_shape(self):
+        w2v = (
+            Word2Vec.builder().min_word_frequency(1).layer_size(12)
+            .epochs(1).build()
+        )
+        w2v.fit(_synthetic_corpus(n=30))
+        assert w2v.get_word_vector("cat").shape == (12,)
+
+
+class TestGlove:
+    def test_clusters_separate(self):
+        g = Glove(layer_size=16, window_size=3, epochs=40, seed=3)
+        g.fit(_synthetic_corpus())
+        intra = g.similarity("cat", "dog")
+        inter = g.similarity("cat", "road")
+        assert intra > inter, (intra, inter)
+
+
+class TestParagraphVectors:
+    def test_doc_similarity_by_topic(self):
+        rng = np.random.default_rng(4)
+        animals = ["cat", "dog", "pet", "fur", "paw"]
+        cars = ["car", "road", "drive", "wheel", "fuel"]
+        docs, labels = [], []
+        for i in range(40):
+            group = animals if i % 2 == 0 else cars
+            docs.append(" ".join(rng.choice(group, size=12)))
+            labels.append(f"{'animal' if i % 2 == 0 else 'car'}_{i}")
+        pv = ParagraphVectors(layer_size=16, epochs=15, seed=5)
+        pv.fit(docs, labels)
+        same = pv.similarity("animal_0", "animal_2")
+        diff = pv.similarity("animal_0", "car_1")
+        assert same > diff, (same, diff)
+
+    def test_infer_vector_nearest(self):
+        rng = np.random.default_rng(6)
+        animals = ["cat", "dog", "pet", "fur", "paw"]
+        cars = ["car", "road", "drive", "wheel", "fuel"]
+        docs, labels = [], []
+        for i in range(30):
+            group = animals if i % 2 == 0 else cars
+            docs.append(" ".join(rng.choice(group, size=12)))
+            labels.append(f"{'animal' if i % 2 == 0 else 'car'}_{i}")
+        pv = ParagraphVectors(layer_size=16, epochs=15, seed=7)
+        pv.fit(docs, labels)
+        vec = pv.infer_vector("cat dog pet fur paw cat dog")
+        assert vec.shape == (16,)
+        near = pv.nearest_labels("cat dog pet fur paw cat dog", n=5)
+        animal_hits = sum(1 for l in near if l.startswith("animal"))
+        assert animal_hits >= 3, near
+
+
+class TestSerialization:
+    def test_round_trip(self, tmp_path):
+        w2v = (
+            Word2Vec.builder().min_word_frequency(1).layer_size(8)
+            .epochs(1).build()
+        )
+        w2v.fit(_synthetic_corpus(n=40))
+        path = str(tmp_path / "vecs.txt")
+        WordVectorSerializer.write_word2vec_model(w2v, path)
+        loaded = WordVectorSerializer.read_word2vec_model(path)
+        for w in ("cat", "car"):
+            np.testing.assert_allclose(
+                loaded.get_word_vector(w), w2v.get_word_vector(w), atol=1e-5
+            )
+        assert loaded.similarity("cat", "dog") == pytest.approx(
+            w2v.similarity("cat", "dog"), abs=1e-4
+        )
+
+    def test_gzip_round_trip(self, tmp_path):
+        w2v = (
+            Word2Vec.builder().min_word_frequency(1).layer_size(4)
+            .epochs(1).build()
+        )
+        w2v.fit(_synthetic_corpus(n=20))
+        path = str(tmp_path / "vecs.txt.gz")
+        WordVectorSerializer.write_word2vec_model(w2v, path)
+        loaded = WordVectorSerializer.read_word2vec_model(path)
+        assert set(loaded.vocab_words()) == set(w2v.vocab_words())
