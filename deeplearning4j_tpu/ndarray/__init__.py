@@ -1,0 +1,59 @@
+"""Imperative n-d array façade — the nd4j-api `INDArray`/`Nd4j` role.
+
+The reference's user-facing tensor API is `org.nd4j.linalg.api.ndarray.INDArray`
+plus the `Nd4j` factory statics (SURVEY.md §2.2 "nd4j-api: INDArray core"),
+executing op-at-a-time through a backend executioner.  TPU-native, the same
+capability is a thin stateful wrapper over `jax.Array`: every method lowers to
+jax.numpy (XLA-compiled, fused, async), in-place `*i` methods rebind the
+wrapper's buffer (functional under the hood — XLA owns memory, so there is no
+aliasing to manage and no workspace machinery to replicate), and `.npy`
+interop goes through numpy directly.
+"""
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray
+from deeplearning4j_tpu.ndarray import factory as nd
+from deeplearning4j_tpu.ndarray.factory import (
+    create,
+    zeros,
+    ones,
+    full,
+    value_array_of,
+    rand,
+    randn,
+    arange,
+    linspace,
+    eye,
+    scalar,
+    vstack,
+    hstack,
+    concat,
+    stack,
+    from_npy,
+    to_npy,
+    read_npy,
+    write_npy,
+)
+
+__all__ = [
+    "NDArray",
+    "nd",
+    "create",
+    "zeros",
+    "ones",
+    "full",
+    "value_array_of",
+    "rand",
+    "randn",
+    "arange",
+    "linspace",
+    "eye",
+    "scalar",
+    "vstack",
+    "hstack",
+    "concat",
+    "stack",
+    "from_npy",
+    "to_npy",
+    "read_npy",
+    "write_npy",
+]

@@ -1,0 +1,150 @@
+"""Factory statics — the `org.nd4j.linalg.factory.Nd4j` role.
+
+Creation, random, stacking and `.npy` interop for :class:`NDArray`
+(SURVEY.md §2.2: "Nd4j factory statics ... Numpy .npy interop too").
+Random creation uses the runtime's deterministic counter-based RNG
+(threefry) rather than a mutable global Mersenne state — same capability
+(seedable, reproducible), TPU-native mechanism.
+"""
+
+from __future__ import annotations
+
+import io
+import os
+import threading
+from typing import Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ndarray.ndarray import NDArray, _unwrap
+
+_rng_lock = threading.Lock()
+_rng_key = None
+
+
+def set_seed(seed: int) -> None:
+    """Seed the factory RNG (reference `Nd4j.getRandom().setSeed`)."""
+    global _rng_key
+    with _rng_lock:
+        _rng_key = jax.random.key(seed)
+
+
+def _next_key():
+    global _rng_key
+    with _rng_lock:
+        if _rng_key is None:
+            _rng_key = jax.random.key(0)
+        _rng_key, sub = jax.random.split(_rng_key)
+        return sub
+
+
+def create(data, dtype=None) -> NDArray:
+    a = jnp.asarray(_unwrap(data))
+    if dtype is not None:
+        a = a.astype(dtype)
+    return NDArray(a)
+
+
+def zeros(*shape, dtype=jnp.float32) -> NDArray:
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return NDArray(jnp.zeros(shape, dtype))
+
+
+def ones(*shape, dtype=jnp.float32) -> NDArray:
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return NDArray(jnp.ones(shape, dtype))
+
+
+def full(shape, value, dtype=jnp.float32) -> NDArray:
+    return NDArray(jnp.full(tuple(shape), value, dtype))
+
+
+def value_array_of(shape, value, dtype=jnp.float32) -> NDArray:
+    """Reference `Nd4j.valueArrayOf`."""
+    return full(shape, value, dtype)
+
+
+def scalar(value, dtype=None) -> NDArray:
+    return NDArray(jnp.asarray(value, dtype=dtype))
+
+
+def rand(*shape, dtype=jnp.float32) -> NDArray:
+    """Uniform [0,1) (reference `Nd4j.rand`)."""
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return NDArray(jax.random.uniform(_next_key(), shape, dtype))
+
+
+def randn(*shape, dtype=jnp.float32) -> NDArray:
+    """Standard normal (reference `Nd4j.randn`)."""
+    if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+        shape = tuple(shape[0])
+    return NDArray(jax.random.normal(_next_key(), shape, dtype))
+
+
+def arange(*args, dtype=None) -> NDArray:
+    return NDArray(jnp.arange(*args, dtype=dtype))
+
+
+def linspace(start, stop, num, dtype=jnp.float32) -> NDArray:
+    return NDArray(jnp.linspace(start, stop, num, dtype=dtype))
+
+
+def eye(n: int, dtype=jnp.float32) -> NDArray:
+    return NDArray(jnp.eye(n, dtype=dtype))
+
+
+def vstack(arrays: Sequence) -> NDArray:
+    return NDArray(jnp.vstack([_unwrap(a) for a in arrays]))
+
+
+def hstack(arrays: Sequence) -> NDArray:
+    return NDArray(jnp.hstack([_unwrap(a) for a in arrays]))
+
+
+def concat(axis: int, *arrays) -> NDArray:
+    """Reference `Nd4j.concat(dim, arrays...)` argument order."""
+    if len(arrays) == 1 and isinstance(arrays[0], (tuple, list)):
+        arrays = tuple(arrays[0])
+    return NDArray(jnp.concatenate([_unwrap(a) for a in arrays], axis=axis))
+
+
+def stack(axis: int, *arrays) -> NDArray:
+    if len(arrays) == 1 and isinstance(arrays[0], (tuple, list)):
+        arrays = tuple(arrays[0])
+    return NDArray(jnp.stack([_unwrap(a) for a in arrays], axis=axis))
+
+
+def where(cond, x, y) -> NDArray:
+    return NDArray(jnp.where(_unwrap(cond), _unwrap(x), _unwrap(y)))
+
+
+def sort(array, axis: int = -1, descending: bool = False) -> NDArray:
+    s = jnp.sort(_unwrap(array), axis=axis)
+    if descending:
+        s = jnp.flip(s, axis=axis)
+    return NDArray(s)
+
+
+# --- .npy / .npz interop (reference Nd4j.createFromNpyFile / Nd4j.write) ---
+
+def from_npy(data: bytes) -> NDArray:
+    return NDArray(np.load(io.BytesIO(data), allow_pickle=False))
+
+
+def to_npy(array) -> bytes:
+    buf = io.BytesIO()
+    np.save(buf, np.asarray(_unwrap(array)), allow_pickle=False)
+    return buf.getvalue()
+
+
+def read_npy(path: str | os.PathLike) -> NDArray:
+    return NDArray(np.load(path, allow_pickle=False))
+
+
+def write_npy(array, path: str | os.PathLike) -> None:
+    np.save(path, np.asarray(_unwrap(array)), allow_pickle=False)

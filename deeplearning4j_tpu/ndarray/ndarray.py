@@ -1,0 +1,507 @@
+"""NDArray: imperative tensor wrapper over jax.Array.
+
+Role parity: `org.nd4j.linalg.api.ndarray.INDArray` / `BaseNDArray`
+(SURVEY.md §2.2).  Differences by design, not omission:
+
+- **No host/device dual buffers.**  The value is a `jax.Array`; PJRT keeps it
+  resident on device (HBM on TPU) and transfers lazily on host reads.
+- **`*i` in-place methods rebind, not mutate.**  XLA arrays are immutable;
+  `addi` computes functionally and swaps the wrapper's buffer.  User-visible
+  semantics match the reference (the receiver observes the new value, and the
+  method returns `self` for chaining); true aliasing views do not exist, and
+  writes through a sliced view must go through `put`/`put_scalar` on the
+  parent.
+- **Ops fuse.**  A chain of NDArray calls issues XLA ops that dispatch
+  asynchronously; there is no per-op JNI crossing to amortize (the reference's
+  op-at-a-time bottleneck, SURVEY.md §3.1).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def _unwrap(x: Any):
+    return x._value if isinstance(x, NDArray) else x
+
+
+def _wrap(x) -> "NDArray":
+    return NDArray(x)
+
+
+class NDArray:
+    """Imperative n-d array; every method lowers to jax.numpy."""
+
+    __slots__ = ("_value",)
+
+    # Make numpy binary ops defer to our __r*__ implementations.
+    __array_priority__ = 100
+
+    def __init__(self, value):
+        if isinstance(value, NDArray):
+            value = value._value
+        if not isinstance(value, jax.Array):
+            value = jnp.asarray(value)
+        self._value = value
+
+    # --- identity / introspection -------------------------------------
+    @property
+    def value(self) -> jax.Array:
+        """The underlying jax.Array (device-resident)."""
+        return self._value
+
+    @property
+    def shape(self) -> tuple:
+        return tuple(self._value.shape)
+
+    @property
+    def rank(self) -> int:
+        return self._value.ndim
+
+    @property
+    def ndim(self) -> int:
+        return self._value.ndim
+
+    @property
+    def length(self) -> int:
+        return int(self._value.size)
+
+    @property
+    def size(self) -> int:
+        return int(self._value.size)
+
+    @property
+    def dtype(self) -> np.dtype:
+        return np.dtype(self._value.dtype)
+
+    def is_scalar(self) -> bool:
+        return self._value.ndim == 0 or self._value.size == 1
+
+    def is_vector(self) -> bool:
+        return self._value.ndim == 1 or (
+            self._value.ndim == 2 and 1 in self._value.shape
+        )
+
+    def is_matrix(self) -> bool:
+        return self._value.ndim == 2
+
+    def rows(self) -> int:
+        return int(self._value.shape[0])
+
+    def columns(self) -> int:
+        return int(self._value.shape[1])
+
+    # --- conversion ----------------------------------------------------
+    def to_numpy(self) -> np.ndarray:
+        return np.asarray(self._value)
+
+    def __array__(self, dtype=None):
+        a = np.asarray(self._value)
+        return a.astype(dtype) if dtype is not None else a
+
+    def astype(self, dtype) -> "NDArray":
+        return _wrap(self._value.astype(dtype))
+
+    def cast_to(self, dtype) -> "NDArray":
+        return self.astype(dtype)
+
+    def item(self):
+        return self._value.item()
+
+    def get_double(self, *indices) -> float:
+        return float(self._value[tuple(indices)])
+
+    def get_int(self, *indices) -> int:
+        return int(self._value[tuple(indices)])
+
+    # --- shape ops ------------------------------------------------------
+    def dup(self) -> "NDArray":
+        """Independent copy (reference `INDArray.dup()`)."""
+        return _wrap(jnp.array(self._value, copy=True))
+
+    def reshape(self, *shape) -> "NDArray":
+        if len(shape) == 1 and isinstance(shape[0], (tuple, list)):
+            shape = tuple(shape[0])
+        return _wrap(self._value.reshape(shape))
+
+    def ravel(self) -> "NDArray":
+        return _wrap(self._value.ravel())
+
+    def flatten(self) -> "NDArray":
+        return self.ravel()
+
+    def transpose(self, *axes) -> "NDArray":
+        if not axes:
+            return _wrap(self._value.T)
+        if len(axes) == 1 and isinstance(axes[0], (tuple, list)):
+            axes = tuple(axes[0])
+        return _wrap(jnp.transpose(self._value, axes))
+
+    def permute(self, *axes) -> "NDArray":
+        return self.transpose(*axes)
+
+    def swap_axes(self, a: int, b: int) -> "NDArray":
+        return _wrap(jnp.swapaxes(self._value, a, b))
+
+    def expand_dims(self, axis: int) -> "NDArray":
+        return _wrap(jnp.expand_dims(self._value, axis))
+
+    def squeeze(self, axis=None) -> "NDArray":
+        return _wrap(jnp.squeeze(self._value, axis))
+
+    def broadcast_to(self, shape) -> "NDArray":
+        return _wrap(jnp.broadcast_to(self._value, tuple(shape)))
+
+    def repeat(self, repeats: int, axis: int = None) -> "NDArray":
+        return _wrap(jnp.repeat(self._value, repeats, axis=axis))
+
+    def tile(self, reps) -> "NDArray":
+        return _wrap(jnp.tile(self._value, reps))
+
+    # --- indexing -------------------------------------------------------
+    def __getitem__(self, idx) -> "NDArray":
+        idx = jax.tree_util.tree_map(_unwrap, idx, is_leaf=lambda x: isinstance(x, NDArray))
+        return _wrap(self._value[idx])
+
+    def __setitem__(self, idx, val) -> None:
+        idx = jax.tree_util.tree_map(_unwrap, idx, is_leaf=lambda x: isinstance(x, NDArray))
+        self._value = self._value.at[idx].set(_unwrap(val))
+
+    def get_row(self, i: int) -> "NDArray":
+        return _wrap(self._value[i])
+
+    def get_column(self, j: int) -> "NDArray":
+        return _wrap(self._value[:, j])
+
+    def get_rows(self, rows: Sequence[int]) -> "NDArray":
+        return _wrap(self._value[jnp.asarray(list(rows))])
+
+    def get_columns(self, cols: Sequence[int]) -> "NDArray":
+        return _wrap(self._value[:, jnp.asarray(list(cols))])
+
+    def put_scalar(self, indices, value) -> "NDArray":
+        if isinstance(indices, int):
+            indices = (indices,)
+        self._value = self._value.at[tuple(indices)].set(value)
+        return self
+
+    def get_scalar(self, *indices) -> "NDArray":
+        return _wrap(self._value[tuple(indices)])
+
+    def put(self, idx, val) -> "NDArray":
+        self[idx] = val
+        return self
+
+    def put_row(self, i: int, row) -> "NDArray":
+        self._value = self._value.at[i].set(_unwrap(row))
+        return self
+
+    def put_column(self, j: int, col) -> "NDArray":
+        self._value = self._value.at[:, j].set(_unwrap(col))
+        return self
+
+    def assign(self, other) -> "NDArray":
+        """Overwrite contents (reference `INDArray.assign`)."""
+        v = _unwrap(other)
+        self._value = jnp.broadcast_to(jnp.asarray(v, dtype=self._value.dtype), self._value.shape)
+        return self
+
+    # --- arithmetic (pure + in-place-style) -----------------------------
+    def _binary(self, other, fn) -> "NDArray":
+        return _wrap(fn(self._value, _unwrap(other)))
+
+    def _ibinary(self, other, fn) -> "NDArray":
+        self._value = fn(self._value, _unwrap(other))
+        return self
+
+    def add(self, other) -> "NDArray":
+        return self._binary(other, jnp.add)
+
+    def sub(self, other) -> "NDArray":
+        return self._binary(other, jnp.subtract)
+
+    def mul(self, other) -> "NDArray":
+        return self._binary(other, jnp.multiply)
+
+    def div(self, other) -> "NDArray":
+        return self._binary(other, jnp.divide)
+
+    def rsub(self, other) -> "NDArray":
+        return self._binary(other, lambda a, b: jnp.subtract(b, a))
+
+    def rdiv(self, other) -> "NDArray":
+        return self._binary(other, lambda a, b: jnp.divide(b, a))
+
+    def addi(self, other) -> "NDArray":
+        return self._ibinary(other, jnp.add)
+
+    def subi(self, other) -> "NDArray":
+        return self._ibinary(other, jnp.subtract)
+
+    def muli(self, other) -> "NDArray":
+        return self._ibinary(other, jnp.multiply)
+
+    def divi(self, other) -> "NDArray":
+        return self._ibinary(other, jnp.divide)
+
+    def rsubi(self, other) -> "NDArray":
+        return self._ibinary(other, lambda a, b: jnp.subtract(b, a))
+
+    def rdivi(self, other) -> "NDArray":
+        return self._ibinary(other, lambda a, b: jnp.divide(b, a))
+
+    def neg(self) -> "NDArray":
+        return _wrap(-self._value)
+
+    def negi(self) -> "NDArray":
+        self._value = -self._value
+        return self
+
+    def fmod(self, other) -> "NDArray":
+        return self._binary(other, jnp.fmod)
+
+    # operator sugar
+    def __add__(self, o):
+        return self.add(o)
+
+    def __radd__(self, o):
+        return self.add(o)
+
+    def __sub__(self, o):
+        return self.sub(o)
+
+    def __rsub__(self, o):
+        return self.rsub(o)
+
+    def __mul__(self, o):
+        return self.mul(o)
+
+    def __rmul__(self, o):
+        return self.mul(o)
+
+    def __truediv__(self, o):
+        return self.div(o)
+
+    def __rtruediv__(self, o):
+        return self.rdiv(o)
+
+    def __neg__(self):
+        return self.neg()
+
+    def __pow__(self, o):
+        return self._binary(o, jnp.power)
+
+    def __matmul__(self, o):
+        return self.mmul(o)
+
+    # --- linear algebra -------------------------------------------------
+    def mmul(self, other) -> "NDArray":
+        """Matrix multiply (MXU-native on TPU; bf16 inputs hit peak FLOPs)."""
+        return _wrap(jnp.matmul(self._value, _unwrap(other)))
+
+    def mmuli(self, other) -> "NDArray":
+        self._value = jnp.matmul(self._value, _unwrap(other))
+        return self
+
+    def dot(self, other) -> "NDArray":
+        return _wrap(jnp.dot(self._value, _unwrap(other)))
+
+    def tensordot(self, other, axes) -> "NDArray":
+        return _wrap(jnp.tensordot(self._value, _unwrap(other), axes=axes))
+
+    def outer(self, other) -> "NDArray":
+        return _wrap(jnp.outer(self._value, _unwrap(other)))
+
+    def norm1(self, axis=None) -> "NDArray":
+        return _wrap(jnp.sum(jnp.abs(self._value), axis=axis))
+
+    def norm2(self, axis=None) -> "NDArray":
+        return _wrap(jnp.sqrt(jnp.sum(jnp.square(self._value), axis=axis)))
+
+    def norm_max(self, axis=None) -> "NDArray":
+        return _wrap(jnp.max(jnp.abs(self._value), axis=axis))
+
+    # --- elementwise transforms ----------------------------------------
+    def abs(self) -> "NDArray":
+        return _wrap(jnp.abs(self._value))
+
+    def sqrt(self) -> "NDArray":
+        return _wrap(jnp.sqrt(self._value))
+
+    def square(self) -> "NDArray":
+        return _wrap(jnp.square(self._value))
+
+    def exp(self) -> "NDArray":
+        return _wrap(jnp.exp(self._value))
+
+    def log(self) -> "NDArray":
+        return _wrap(jnp.log(self._value))
+
+    def pow(self, p) -> "NDArray":
+        return _wrap(jnp.power(self._value, _unwrap(p)))
+
+    def clip(self, lo, hi) -> "NDArray":
+        return _wrap(jnp.clip(self._value, lo, hi))
+
+    def floor(self) -> "NDArray":
+        return _wrap(jnp.floor(self._value))
+
+    def ceil(self) -> "NDArray":
+        return _wrap(jnp.ceil(self._value))
+
+    def round(self) -> "NDArray":
+        return _wrap(jnp.round(self._value))
+
+    def sign(self) -> "NDArray":
+        return _wrap(jnp.sign(self._value))
+
+    def tanh(self) -> "NDArray":
+        return _wrap(jnp.tanh(self._value))
+
+    def sigmoid(self) -> "NDArray":
+        return _wrap(jax.nn.sigmoid(self._value))
+
+    def relu(self) -> "NDArray":
+        return _wrap(jax.nn.relu(self._value))
+
+    def softmax(self, axis: int = -1) -> "NDArray":
+        return _wrap(jax.nn.softmax(self._value, axis=axis))
+
+    # --- reductions -----------------------------------------------------
+    def sum(self, axis=None, keepdims: bool = False) -> "NDArray":
+        return _wrap(jnp.sum(self._value, axis=axis, keepdims=keepdims))
+
+    def mean(self, axis=None, keepdims: bool = False) -> "NDArray":
+        return _wrap(jnp.mean(self._value, axis=axis, keepdims=keepdims))
+
+    def std(self, axis=None, ddof: int = 1, keepdims: bool = False) -> "NDArray":
+        # nd4j's std is the sample (Bessel-corrected) std by default.
+        return _wrap(jnp.std(self._value, axis=axis, ddof=ddof, keepdims=keepdims))
+
+    def var(self, axis=None, ddof: int = 1, keepdims: bool = False) -> "NDArray":
+        return _wrap(jnp.var(self._value, axis=axis, ddof=ddof, keepdims=keepdims))
+
+    def max(self, axis=None, keepdims: bool = False) -> "NDArray":
+        return _wrap(jnp.max(self._value, axis=axis, keepdims=keepdims))
+
+    def min(self, axis=None, keepdims: bool = False) -> "NDArray":
+        return _wrap(jnp.min(self._value, axis=axis, keepdims=keepdims))
+
+    def prod(self, axis=None, keepdims: bool = False) -> "NDArray":
+        return _wrap(jnp.prod(self._value, axis=axis, keepdims=keepdims))
+
+    def argmax(self, axis=None) -> "NDArray":
+        return _wrap(jnp.argmax(self._value, axis=axis))
+
+    def argmin(self, axis=None) -> "NDArray":
+        return _wrap(jnp.argmin(self._value, axis=axis))
+
+    def cumsum(self, axis=None) -> "NDArray":
+        return _wrap(jnp.cumsum(self._value, axis=axis))
+
+    def sum_number(self) -> float:
+        return float(jnp.sum(self._value))
+
+    def mean_number(self) -> float:
+        return float(jnp.mean(self._value))
+
+    def max_number(self) -> float:
+        return float(jnp.max(self._value))
+
+    def min_number(self) -> float:
+        return float(jnp.min(self._value))
+
+    # --- comparisons / conditionals -------------------------------------
+    def gt(self, o) -> "NDArray":
+        return self._binary(o, jnp.greater)
+
+    def gte(self, o) -> "NDArray":
+        return self._binary(o, jnp.greater_equal)
+
+    def lt(self, o) -> "NDArray":
+        return self._binary(o, jnp.less)
+
+    def lte(self, o) -> "NDArray":
+        return self._binary(o, jnp.less_equal)
+
+    def eq(self, o) -> "NDArray":
+        return self._binary(o, jnp.equal)
+
+    def neq(self, o) -> "NDArray":
+        return self._binary(o, jnp.not_equal)
+
+    def __gt__(self, o):
+        return self.gt(o)
+
+    def __ge__(self, o):
+        return self.gte(o)
+
+    def __lt__(self, o):
+        return self.lt(o)
+
+    def __le__(self, o):
+        return self.lte(o)
+
+    def where(self, cond, other) -> "NDArray":
+        """self where cond else other (reference `Nd4j.where` / replaceWhere)."""
+        return _wrap(jnp.where(_unwrap(cond), self._value, _unwrap(other)))
+
+    def replace_where(self, replacement, cond) -> "NDArray":
+        self._value = jnp.where(_unwrap(cond), _unwrap(replacement), self._value)
+        return self
+
+    def isnan(self) -> "NDArray":
+        return _wrap(jnp.isnan(self._value))
+
+    def isinf(self) -> "NDArray":
+        return _wrap(jnp.isinf(self._value))
+
+    def any(self) -> bool:
+        return bool(jnp.any(self._value))
+
+    def all(self) -> bool:
+        return bool(jnp.all(self._value))
+
+    def equals(self, other, eps: float = 1e-5) -> bool:
+        o = _unwrap(other)
+        if tuple(jnp.shape(o)) != self.shape:
+            return False
+        return bool(jnp.all(jnp.abs(self._value - o) <= eps))
+
+    # --- broadcast-along-dimension family (reference addRowVector etc.) --
+    def add_row_vector(self, row) -> "NDArray":
+        return _wrap(self._value + jnp.reshape(_unwrap(row), (1, -1)))
+
+    def add_column_vector(self, col) -> "NDArray":
+        return _wrap(self._value + jnp.reshape(_unwrap(col), (-1, 1)))
+
+    def mul_row_vector(self, row) -> "NDArray":
+        return _wrap(self._value * jnp.reshape(_unwrap(row), (1, -1)))
+
+    def mul_column_vector(self, col) -> "NDArray":
+        return _wrap(self._value * jnp.reshape(_unwrap(col), (-1, 1)))
+
+    def sub_row_vector(self, row) -> "NDArray":
+        return _wrap(self._value - jnp.reshape(_unwrap(row), (1, -1)))
+
+    def div_row_vector(self, row) -> "NDArray":
+        return _wrap(self._value / jnp.reshape(_unwrap(row), (1, -1)))
+
+    # --- misc -----------------------------------------------------------
+    def block_until_ready(self) -> "NDArray":
+        jax.block_until_ready(self._value)
+        return self
+
+    def __len__(self) -> int:
+        return int(self._value.shape[0])
+
+    def __iter__(self):
+        for i in range(len(self)):
+            yield _wrap(self._value[i])
+
+    def __repr__(self) -> str:
+        return f"NDArray(shape={self.shape}, dtype={self.dtype.name})\n{np.asarray(self._value)!r}"

@@ -1,0 +1,255 @@
+"""NDArray façade tests — INDArray/Nd4j role parity.
+
+Mirrors the reference's nd4j-api test tier (SURVEY.md §4.1 "ND4J Java op
+tests": INDArray semantics, ops, dtype behavior, serialization, numpy
+parity).  Numeric oracle is numpy throughout.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ndarray import NDArray, nd
+
+
+class TestCreation:
+    def test_zeros_ones_full(self):
+        assert nd.zeros(2, 3).shape == (2, 3)
+        assert nd.ones((4,)).sum_number() == 4.0
+        assert nd.full((2, 2), 7.0).to_numpy().tolist() == [[7.0, 7.0], [7.0, 7.0]]
+        assert nd.value_array_of((3,), 2.5).to_numpy().tolist() == [2.5, 2.5, 2.5]
+
+    def test_create_from_nested_list(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        assert a.shape == (2, 2)
+        assert a.get_double(1, 0) == 3.0
+
+    def test_arange_linspace_eye(self):
+        assert nd.arange(5).to_numpy().tolist() == [0, 1, 2, 3, 4]
+        np.testing.assert_allclose(nd.linspace(0, 1, 5).to_numpy(), np.linspace(0, 1, 5), rtol=1e-6)
+        np.testing.assert_array_equal(nd.eye(3).to_numpy(), np.eye(3, dtype=np.float32))
+
+    def test_rand_seeded_reproducible(self):
+        nd.set_seed(42)
+        a = nd.rand(3, 3).to_numpy()
+        nd.set_seed(42)
+        b = nd.rand(3, 3).to_numpy()
+        np.testing.assert_array_equal(a, b)
+        assert 0.0 <= a.min() and a.max() < 1.0
+
+    def test_randn_statistics(self):
+        nd.set_seed(0)
+        a = nd.randn(10000).to_numpy()
+        assert abs(a.mean()) < 0.05
+        assert abs(a.std() - 1.0) < 0.05
+
+
+class TestArithmetic:
+    def test_pure_ops_do_not_mutate(self):
+        a = nd.create([1.0, 2.0])
+        b = a.add(10.0)
+        assert a.to_numpy().tolist() == [1.0, 2.0]
+        assert b.to_numpy().tolist() == [11.0, 12.0]
+
+    def test_inplace_i_ops_rebind_receiver(self):
+        a = nd.create([1.0, 2.0])
+        r = a.addi(1.0).muli(3.0)
+        assert r is a
+        assert a.to_numpy().tolist() == [6.0, 9.0]
+
+    def test_operator_sugar(self):
+        a = nd.create([2.0, 4.0])
+        np.testing.assert_allclose((a + 1).to_numpy(), [3, 5])
+        np.testing.assert_allclose((1 - a).to_numpy(), [-1, -3])
+        np.testing.assert_allclose((a * a).to_numpy(), [4, 16])
+        np.testing.assert_allclose((8 / a).to_numpy(), [4, 2])
+        np.testing.assert_allclose((-a).to_numpy(), [-2, -4])
+        np.testing.assert_allclose((a ** 2).to_numpy(), [4, 16])
+
+    def test_rsub_rdiv(self):
+        a = nd.create([2.0, 4.0])
+        np.testing.assert_allclose(a.rsub(10.0).to_numpy(), [8, 6])
+        np.testing.assert_allclose(a.rdiv(8.0).to_numpy(), [4, 2])
+        a.rsubi(10.0)
+        np.testing.assert_allclose(a.to_numpy(), [8, 6])
+
+    def test_broadcasting(self):
+        m = nd.ones(3, 4)
+        row = nd.create([1.0, 2.0, 3.0, 4.0])
+        np.testing.assert_allclose(m.add_row_vector(row).to_numpy()[0], [2, 3, 4, 5])
+        col = nd.create([1.0, 2.0, 3.0])
+        out = m.add_column_vector(col).to_numpy()
+        np.testing.assert_allclose(out[:, 0], [2, 3, 4])
+
+
+class TestLinalg:
+    def test_mmul_matches_numpy(self):
+        rng = np.random.default_rng(0)
+        a, b = rng.normal(size=(5, 7)).astype(np.float32), rng.normal(size=(7, 3)).astype(np.float32)
+        np.testing.assert_allclose(nd.create(a).mmul(nd.create(b)).to_numpy(), a @ b, atol=1e-5)
+
+    def test_matmul_operator(self):
+        a = nd.eye(3)
+        b = nd.create(np.arange(9.0).reshape(3, 3))
+        np.testing.assert_allclose((a @ b).to_numpy(), np.arange(9.0).reshape(3, 3))
+
+    def test_norms(self):
+        a = nd.create([[3.0, -4.0]])
+        assert a.norm1().item() == 7.0
+        assert abs(a.norm2().item() - 5.0) < 1e-6
+        assert a.norm_max().item() == 4.0
+
+    def test_tensordot(self):
+        rng = np.random.default_rng(1)
+        a = rng.normal(size=(2, 3, 4)).astype(np.float32)
+        b = rng.normal(size=(4, 5)).astype(np.float32)
+        np.testing.assert_allclose(
+            nd.create(a).tensordot(nd.create(b), axes=1).to_numpy(),
+            np.tensordot(a, b, axes=1),
+            atol=1e-5,
+        )
+
+
+class TestShapeAndIndexing:
+    def test_reshape_transpose_ravel(self):
+        a = nd.arange(6, dtype=np.float32).reshape(2, 3)
+        assert a.transpose().shape == (3, 2)
+        assert a.ravel().shape == (6,)
+        assert a.reshape((3, 2)).get_double(2, 1) == 5.0
+
+    def test_dup_is_independent(self):
+        a = nd.create([1.0, 2.0])
+        b = a.dup()
+        b.addi(100.0)
+        assert a.to_numpy().tolist() == [1.0, 2.0]
+
+    def test_getitem_setitem(self):
+        a = nd.zeros(3, 3)
+        a[1, 2] = 5.0
+        assert a.get_double(1, 2) == 5.0
+        a[0] = nd.create([1.0, 2.0, 3.0])
+        assert a.get_row(0).to_numpy().tolist() == [1.0, 2.0, 3.0]
+        assert a[0:2, 2].to_numpy().tolist() == [3.0, 5.0]
+
+    def test_put_get_rows_columns(self):
+        a = nd.zeros(2, 2)
+        a.put_row(0, nd.create([1.0, 2.0])).put_column(1, nd.create([9.0, 9.0]))
+        assert a.to_numpy().tolist() == [[1.0, 9.0], [0.0, 9.0]]
+        assert a.get_column(0).to_numpy().tolist() == [1.0, 0.0]
+
+    def test_put_scalar_chain(self):
+        a = nd.zeros(2, 2).put_scalar((0, 0), 1.0).put_scalar((1, 1), 2.0)
+        np.testing.assert_array_equal(a.to_numpy(), [[1, 0], [0, 2]])
+
+    def test_assign_broadcasts(self):
+        a = nd.zeros(2, 3)
+        a.assign(7.0)
+        assert a.to_numpy().tolist() == [[7.0] * 3] * 2
+
+
+class TestReductions:
+    def test_axis_reductions(self):
+        a = nd.create([[1.0, 2.0], [3.0, 4.0]])
+        np.testing.assert_allclose(a.sum(axis=0).to_numpy(), [4, 6])
+        np.testing.assert_allclose(a.mean(axis=1).to_numpy(), [1.5, 3.5])
+        assert a.max_number() == 4.0
+        assert a.argmax(axis=1).to_numpy().tolist() == [1, 1]
+
+    def test_std_is_sample_std(self):
+        # nd4j std defaults to Bessel-corrected (ddof=1), unlike numpy.
+        a = nd.create([1.0, 2.0, 3.0, 4.0])
+        assert abs(a.std().item() - np.std([1, 2, 3, 4], ddof=1)) < 1e-6
+
+    def test_cumsum(self):
+        np.testing.assert_allclose(nd.create([1.0, 2.0, 3.0]).cumsum().to_numpy(), [1, 3, 6])
+
+
+class TestComparisonsConditionals:
+    def test_comparison_masks(self):
+        a = nd.create([1.0, 5.0, 3.0])
+        assert a.gt(2.0).to_numpy().tolist() == [False, True, True]
+        assert (a < 4.0).to_numpy().tolist() == [True, False, True]
+        assert a.eq(5.0).any()
+        assert not a.gt(10.0).any()
+
+    def test_replace_where(self):
+        a = nd.create([1.0, -2.0, 3.0])
+        a.replace_where(0.0, a.lt(0.0))
+        assert a.to_numpy().tolist() == [1.0, 0.0, 3.0]
+
+    def test_equals_epsilon(self):
+        a = nd.create([1.0, 2.0])
+        assert a.equals(nd.create([1.0 + 1e-7, 2.0]))
+        assert not a.equals(nd.create([1.1, 2.0]))
+        assert not a.equals(nd.create([1.0, 2.0, 3.0]))
+
+    def test_nan_inf_detection(self):
+        a = nd.create([1.0, float("nan"), float("inf")])
+        assert a.isnan().to_numpy().tolist() == [False, True, False]
+        assert a.isinf().to_numpy().tolist() == [False, False, True]
+
+
+class TestTransforms:
+    def test_elementwise_transforms(self):
+        a = nd.create([0.0, 1.0, 4.0])
+        np.testing.assert_allclose(a.sqrt().to_numpy(), [0, 1, 2])
+        np.testing.assert_allclose(a.exp().to_numpy(), np.exp([0, 1, 4]), rtol=1e-6)
+        np.testing.assert_allclose(a.relu().to_numpy(), [0, 1, 4])
+        s = a.softmax().to_numpy()
+        assert abs(s.sum() - 1.0) < 1e-6
+
+    def test_clip_round(self):
+        a = nd.create([-1.5, 0.4, 2.7])
+        np.testing.assert_allclose(a.clip(0.0, 1.0).to_numpy(), [0, 0.4, 1.0])
+        np.testing.assert_allclose(a.round().to_numpy(), [-2, 0, 3])
+
+
+class TestStackingInterop:
+    def test_stack_concat(self):
+        a, b = nd.ones(2, 2), nd.zeros(2, 2)
+        assert nd.vstack([a, b]).shape == (4, 2)
+        assert nd.hstack([a, b]).shape == (2, 4)
+        assert nd.concat(1, a, b).shape == (2, 4)
+        assert nd.stack(0, a, b).shape == (2, 2, 2)
+
+    def test_npy_roundtrip(self, tmp_path):
+        a = nd.randn(3, 4)
+        p = tmp_path / "a.npy"
+        nd.write_npy(a, p)
+        b = nd.read_npy(p)
+        np.testing.assert_array_equal(a.to_numpy(), b.to_numpy())
+        # bytes-level too (Nd4j.toNpyByteArray / createFromNpy role)
+        np.testing.assert_array_equal(nd.from_npy(nd.to_npy(a)).to_numpy(), a.to_numpy())
+
+    def test_numpy_protocol(self):
+        a = nd.create([[1.0, 2.0]])
+        assert np.asarray(a).shape == (1, 2)
+        assert np.asarray(a, dtype=np.float64).dtype == np.float64
+
+    def test_dtype_cast(self):
+        a = nd.create([1.9, 2.1]).astype(np.int32)
+        assert a.dtype == np.int32
+        assert a.to_numpy().tolist() == [1, 2]
+
+    def test_iteration_and_len(self):
+        a = nd.create([[1.0], [2.0], [3.0]])
+        assert len(a) == 3
+        assert [float(r.item()) for r in a] == [1.0, 2.0, 3.0]
+
+    def test_where_factory(self):
+        out = nd.where(nd.create([True, False]), nd.create([1.0, 1.0]), nd.create([2.0, 2.0]))
+        assert out.to_numpy().tolist() == [1.0, 2.0]
+
+    def test_sort(self):
+        a = nd.create([3.0, 1.0, 2.0])
+        assert nd.sort(a).to_numpy().tolist() == [1.0, 2.0, 3.0]
+        assert nd.sort(a, descending=True).to_numpy().tolist() == [3.0, 2.0, 1.0]
+
+
+class TestIntrospection:
+    def test_shape_properties(self):
+        a = nd.zeros(3, 4)
+        assert a.rank == 2 and a.length == 12
+        assert a.rows() == 3 and a.columns() == 4
+        assert a.is_matrix() and not a.is_vector()
+        assert nd.scalar(5.0).is_scalar()
+        assert nd.create([1.0, 2.0]).is_vector()
