@@ -1,0 +1,36 @@
+"""DataVec-role ETL: records, readers, schema, declarative transforms.
+
+Role parity with the reference's `datavec/` tree (SURVEY.md §2.2 "DataVec
+(ETL)"): a record abstraction over CSV/lines/collections/images, a typed
+`Schema`, a declarative `TransformProcess` of column operations, and the
+`RecordReaderDataSetIterator` bridge into the training pipeline.
+
+TPU-native stance: transforms are pure functions over columnar numpy
+batches (vectorized, host-side — ETL stays off the accelerator), the
+iterator bridge emits fixed-shape `DataSet` batches so the compiled train
+step never recompiles, and async prefetch (`AsyncDataSetIterator`) overlaps
+host ETL with device steps.
+"""
+
+from deeplearning4j_tpu.datavec.records import (
+    RecordReader,
+    CollectionRecordReader,
+    CSVRecordReader,
+    LineRecordReader,
+    ImageRecordReader,
+)
+from deeplearning4j_tpu.datavec.schema import Schema, ColumnType
+from deeplearning4j_tpu.datavec.transform import TransformProcess
+from deeplearning4j_tpu.datavec.bridge import RecordReaderDataSetIterator
+
+__all__ = [
+    "RecordReader",
+    "CollectionRecordReader",
+    "CSVRecordReader",
+    "LineRecordReader",
+    "ImageRecordReader",
+    "Schema",
+    "ColumnType",
+    "TransformProcess",
+    "RecordReaderDataSetIterator",
+]

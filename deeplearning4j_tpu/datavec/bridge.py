@@ -1,0 +1,102 @@
+"""RecordReader → DataSetIterator bridge.
+
+Role parity: `org.deeplearning4j.datasets.datavec.RecordReaderDataSetIterator`
+(SURVEY.md §2.2 "Dataset iterators") — consumes a RecordReader, splits each
+record into features / label, one-hots classification labels, and emits
+`DataSet` minibatches.  Fixed batch shapes (final short batch padded-or-
+dropped by choice) keep the compiled TPU step from recompiling.
+"""
+
+from __future__ import annotations
+
+from typing import Iterator, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import DataSetIterator
+from deeplearning4j_tpu.datavec.records import RecordReader
+
+
+class RecordReaderDataSetIterator(DataSetIterator):
+    """Batches records from a RecordReader.
+
+    Classification: `label_index` selects the label column, one-hotted to
+    `num_classes` (reference constructor `(reader, batch, labelIdx, numClasses)`).
+    Regression: `regression=True` keeps the label columns raw; `label_index`
+    .. `label_index_to` select a contiguous label span (inclusive), matching
+    the reference's regression constructor.
+    Image records (`[ndarray, label]`): the feature cell is used as-is.
+    """
+
+    def __init__(
+        self,
+        reader: RecordReader,
+        batch_size: int,
+        label_index: Optional[int] = None,
+        num_classes: Optional[int] = None,
+        *,
+        regression: bool = False,
+        label_index_to: Optional[int] = None,
+        drop_last: bool = False,
+    ):
+        if not regression and label_index is not None and num_classes is None:
+            raise ValueError("classification mode requires num_classes")
+        self._reader = reader
+        self._batch = int(batch_size)
+        self._label_index = label_index
+        self._label_index_to = label_index_to if label_index_to is not None else label_index
+        self._num_classes = num_classes
+        self._regression = regression
+        self._drop_last = drop_last
+
+    @property
+    def batch_size(self) -> int:
+        return self._batch
+
+    def reset(self) -> None:
+        self._reader.reset()
+
+    def _split(self, record: list):
+        if self._label_index is None:
+            return record, None
+        if (
+            len(record) == 2
+            and isinstance(record[0], np.ndarray)
+            and self._label_index == 1
+        ):
+            # image record: [tensor, label]
+            return record[0], record[1]
+        lo, hi = self._label_index, self._label_index_to
+        label = record[lo : hi + 1]
+        feats = record[:lo] + record[hi + 1 :]
+        return feats, label[0] if len(label) == 1 else label
+
+    def _emit(self, feats: list, labels: list) -> DataSet:
+        f = np.asarray(feats, dtype=np.float32)
+        if not labels or labels[0] is None:
+            return DataSet(f, np.zeros((len(feats), 0), np.float32))
+        if self._regression:
+            y = np.asarray(labels, dtype=np.float32)
+            if y.ndim == 1:
+                y = y[:, None]
+        else:
+            idx = np.asarray(labels, dtype=np.int64).reshape(-1)
+            if (idx < 0).any() or (idx >= self._num_classes).any():
+                raise ValueError(
+                    f"label out of range [0, {self._num_classes}): {idx.min()}..{idx.max()}"
+                )
+            y = np.eye(self._num_classes, dtype=np.float32)[idx]
+        return DataSet(f, y)
+
+    def __iter__(self) -> Iterator[DataSet]:
+        feats, labels = [], []
+        for record in self._reader:
+            x, y = self._split(list(record))
+            feats.append(x)
+            labels.append(y)
+            if len(feats) == self._batch:
+                yield self._emit(feats, labels)
+                feats, labels = [], []
+        if feats and not self._drop_last:
+            yield self._emit(feats, labels)

@@ -1,0 +1,204 @@
+"""Record readers — the `org.datavec.api.records.reader.RecordReader` role.
+
+A record is a plain Python list of values (the reference's `List<Writable>`;
+Writable boxing is a JVM artifact, not a capability).  Readers are iterables
+with `reset()`, matching the reference SPI's `hasNext/next/reset` loop
+(SURVEY.md §2.2 "DataVec (ETL)").
+
+`ImageRecordReader` mirrors `org.datavec.image.recordreader.ImageRecordReader`:
+walks a directory tree, labels from the parent directory name
+(ParentPathLabelGenerator behavior), decodes via PIL instead of JavaCV,
+emits HWC float arrays — channels-last, the TPU-friendly conv layout.
+"""
+
+from __future__ import annotations
+
+import csv
+import io
+import os
+import random
+from pathlib import Path
+from typing import Iterable, Iterator, List, Optional, Sequence
+
+import numpy as np
+
+
+class RecordReader:
+    """Iterable-with-reset SPI.
+
+    The stepwise `has_next()`/`next_record()` pair shares one lazily-created
+    iterator plus a one-record peek buffer; `reset()` discards both so the
+    next step starts a fresh pass.
+    """
+
+    _iter: Optional[Iterator[list]] = None
+    _peek: Optional[list] = None
+
+    def __iter__(self) -> Iterator[list]:
+        raise NotImplementedError
+
+    def reset(self) -> None:
+        self._iter = None
+        self._peek = None
+
+    def next_record(self):
+        """Convenience single-step API (reference `next()`)."""
+        if self._peek is not None:
+            rec, self._peek = self._peek, None
+            return rec
+        if self._iter is None:
+            self._iter = iter(self)
+        return next(self._iter)
+
+    def has_next(self) -> bool:
+        if self._peek is not None:
+            return True
+        if self._iter is None:
+            self._iter = iter(self)
+        try:
+            self._peek = next(self._iter)
+        except StopIteration:
+            return False
+        return True
+
+
+class CollectionRecordReader(RecordReader):
+    """In-memory records (reference `CollectionRecordReader`)."""
+
+    def __init__(self, records: Sequence[Sequence]):
+        self._records = [list(r) for r in records]
+
+    def __iter__(self):
+        return iter([list(r) for r in self._records])
+
+
+class LineRecordReader(RecordReader):
+    """One record per line: `[line]` (reference `LineRecordReader`)."""
+
+    def __init__(self, path: str | os.PathLike):
+        self._path = Path(path)
+
+    def __iter__(self):
+        with open(self._path, "r") as f:
+            for line in f:
+                yield [line.rstrip("\n")]
+
+
+class CSVRecordReader(RecordReader):
+    """CSV parsing with skip-lines and delimiter (reference `CSVRecordReader`).
+
+    Values are type-sniffed per cell: int → float → string, matching how the
+    reference's Writables come out of CSVRecordReader + downstream conversion.
+    """
+
+    def __init__(
+        self,
+        path: str | os.PathLike | None = None,
+        skip_lines: int = 0,
+        delimiter: str = ",",
+        *,
+        text: str | None = None,
+    ):
+        if (path is None) == (text is None):
+            raise ValueError("exactly one of path/text required")
+        self._path = Path(path) if path is not None else None
+        self._text = text
+        self._skip = skip_lines
+        self._delim = delimiter
+
+    @staticmethod
+    def _convert(cell: str):
+        cell = cell.strip()
+        try:
+            return int(cell)
+        except ValueError:
+            pass
+        try:
+            return float(cell)
+        except ValueError:
+            pass
+        return cell
+
+    def __iter__(self):
+        if self._path is not None:
+            f = open(self._path, "r", newline="")
+        else:
+            f = io.StringIO(self._text)
+        try:
+            reader = csv.reader(f, delimiter=self._delim)
+            for i, row in enumerate(reader):
+                if i < self._skip or not row:
+                    continue
+                yield [self._convert(c) for c in row]
+        finally:
+            f.close()
+
+
+_IMAGE_EXTS = {".png", ".jpg", ".jpeg", ".bmp", ".gif", ".npy"}
+
+
+class ImageRecordReader(RecordReader):
+    """Directory-tree image reader with parent-dir labels.
+
+    Record layout: `[image(H,W,C) float32 ndarray, label_index int]` —
+    channels-last (NHWC batches downstream; XLA:TPU's preferred conv layout),
+    where the reference emits NCHW for cuDNN.  `.npy` files are read directly
+    (golden-fixture path); everything else decodes through PIL.
+    """
+
+    def __init__(
+        self,
+        height: int,
+        width: int,
+        channels: int = 3,
+        *,
+        shuffle_seed: Optional[int] = None,
+    ):
+        self.height, self.width, self.channels = height, width, channels
+        self._shuffle_seed = shuffle_seed
+        self._files: List[Path] = []
+        self.labels: List[str] = []
+
+    def initialize(self, root: str | os.PathLike) -> "ImageRecordReader":
+        root = Path(root)
+        self._files = sorted(
+            p for p in root.rglob("*") if p.suffix.lower() in _IMAGE_EXTS and p.is_file()
+        )
+        if not self._files:
+            raise FileNotFoundError(f"no images under {root}")
+        self.labels = sorted({p.parent.name for p in self._files})
+        if self._shuffle_seed is not None:
+            random.Random(self._shuffle_seed).shuffle(self._files)
+        return self
+
+    def num_labels(self) -> int:
+        return len(self.labels)
+
+    def _decode(self, path: Path) -> np.ndarray:
+        if path.suffix.lower() == ".npy":
+            img = np.load(path).astype(np.float32)
+            if img.ndim == 2:
+                img = img[:, :, None]
+        else:
+            from PIL import Image
+
+            with Image.open(path) as im:
+                im = im.convert("L" if self.channels == 1 else "RGB")
+                im = im.resize((self.width, self.height))
+                img = np.asarray(im, dtype=np.float32)
+                if img.ndim == 2:
+                    img = img[:, :, None]
+        if img.shape != (self.height, self.width, self.channels):
+            # pad/crop npy fixtures that bypass PIL resizing
+            out = np.zeros((self.height, self.width, self.channels), np.float32)
+            h = min(self.height, img.shape[0])
+            w = min(self.width, img.shape[1])
+            c = min(self.channels, img.shape[2])
+            out[:h, :w, :c] = img[:h, :w, :c]
+            img = out
+        return img
+
+    def __iter__(self):
+        label_idx = {name: i for i, name in enumerate(self.labels)}
+        for p in self._files:
+            yield [self._decode(p), label_idx[p.parent.name]]

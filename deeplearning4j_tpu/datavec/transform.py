@@ -1,0 +1,378 @@
+"""Declarative column transforms — the `TransformProcess` role.
+
+Reference: `org.datavec.api.transform.TransformProcess` — a builder of
+column operations, each mapping (Schema, records) → (Schema, records),
+executed by a local or Spark executor (SURVEY.md §2.2).  Here the executor
+is local and vectorized where possible; the Spark tier's role (cluster ETL)
+belongs to the data-parallel input pipeline, not a JVM cluster.
+
+Each step is (schema_fn, records_fn); the process composes them and exposes
+`final_schema` statically — same contract as the reference, so a pipeline's
+output layout is known before any data flows.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Callable, List, Optional, Sequence
+
+from deeplearning4j_tpu.datavec.schema import ColumnMeta, ColumnType, Schema
+
+Records = List[list]
+
+
+class _Step:
+    def __init__(self, name: str, schema_fn, records_fn, spec: dict):
+        self.name = name
+        self.schema_fn = schema_fn
+        self.records_fn = records_fn
+        self.spec = spec  # JSON-serializable description
+
+
+class TransformProcess:
+    """Composed, schema-checked column pipeline with a builder DSL."""
+
+    def __init__(self, initial_schema: Schema, steps: Sequence[_Step]):
+        self.initial_schema = initial_schema
+        self.steps = list(steps)
+        # propagate schemas eagerly: config errors surface at build time,
+        # matching the reference's behavior.
+        s = initial_schema
+        self._schemas = [s]
+        for st in self.steps:
+            s = st.schema_fn(s)
+            self._schemas.append(s)
+
+    @property
+    def final_schema(self) -> Schema:
+        return self._schemas[-1]
+
+    def execute(self, records: Records) -> Records:
+        out = [list(r) for r in records]
+        for st, schema in zip(self.steps, self._schemas[:-1]):
+            out = st.records_fn(schema, out)
+        return out
+
+    def to_json(self) -> str:
+        return json.dumps(
+            {
+                "initial_schema": json.loads(self.initial_schema.to_json()),
+                "steps": [s.spec for s in self.steps],
+            }
+        )
+
+    @staticmethod
+    def from_json(text: str) -> "TransformProcess":
+        d = json.loads(text)
+        schema = Schema.from_json(json.dumps(d["initial_schema"]))
+        b = TransformProcess.builder(schema)
+        for spec in d["steps"]:
+            kind = spec["kind"]
+            if kind == "derive_column":
+                # the custom fn is not serializable (reference parity: custom
+                # transforms round-trip by class name only) — fail loudly
+                # instead of rebuilding a pipeline that crashes at execute.
+                raise ValueError(
+                    "cannot deserialize a derive_column step: its fn is not "
+                    "JSON-serializable; rebuild the pipeline in code"
+                )
+            args = {k: v for k, v in spec.items() if k != "kind"}
+            if not hasattr(b, kind):
+                raise ValueError(f"unknown transform step {kind!r}")
+            getattr(b, kind)(**args)
+        return b.build()
+
+    @staticmethod
+    def builder(schema: Schema) -> "TransformProcess.Builder":
+        return TransformProcess.Builder(schema)
+
+    # ------------------------------------------------------------------
+    class Builder:
+        def __init__(self, schema: Schema):
+            self._schema = schema
+            self._steps: List[_Step] = []
+
+        def build(self) -> "TransformProcess":
+            return TransformProcess(self._schema, self._steps)
+
+        def _add(self, name, schema_fn, records_fn, spec):
+            self._steps.append(_Step(name, schema_fn, records_fn, spec))
+            return self
+
+        # --- column selection ---------------------------------------
+        def remove_columns(self, *names: str):
+            names_l = list(names) if not (len(names) == 1 and isinstance(names[0], list)) else list(names[0])
+
+            def schema_fn(s: Schema) -> Schema:
+                for n in names_l:
+                    s.index_of(n)
+                return Schema([c for c in s.columns if c.name not in names_l])
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                keep = [i for i, c in enumerate(s.columns) if c.name not in names_l]
+                return [[r[i] for i in keep] for r in recs]
+
+            return self._add("remove_columns", schema_fn, records_fn, {"kind": "remove_columns", "names": names_l})
+
+        def keep_columns(self, *names: str):
+            names_l = list(names) if not (len(names) == 1 and isinstance(names[0], list)) else list(names[0])
+
+            def schema_fn(s: Schema) -> Schema:
+                return Schema([s.meta(n) for n in names_l])
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                idx = [s.index_of(n) for n in names_l]
+                return [[r[i] for i in idx] for r in recs]
+
+            return self._add("keep_columns", schema_fn, records_fn, {"kind": "keep_columns", "names": names_l})
+
+        def rename_column(self, old: str, new: str):
+            def schema_fn(s: Schema) -> Schema:
+                i = s.index_of(old)
+                cols = list(s.columns)
+                cols[i] = ColumnMeta(new, cols[i].type, cols[i].categories)
+                return Schema(cols)
+
+            return self._add(
+                "rename_column", schema_fn, lambda s, recs: recs,
+                {"kind": "rename_column", "old": old, "new": new},
+            )
+
+        def reorder_columns(self, *names: str):
+            names_l = list(names) if not (len(names) == 1 and isinstance(names[0], list)) else list(names[0])
+
+            def schema_fn(s: Schema) -> Schema:
+                rest = [c.name for c in s.columns if c.name not in names_l]
+                return Schema([s.meta(n) for n in names_l + rest])
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                rest = [c.name for c in s.columns if c.name not in names_l]
+                idx = [s.index_of(n) for n in names_l + rest]
+                return [[r[i] for i in idx] for r in recs]
+
+            return self._add("reorder_columns", schema_fn, records_fn, {"kind": "reorder_columns", "names": names_l})
+
+        # --- categorical --------------------------------------------
+        def string_to_categorical(self, name: str, categories: Sequence[str]):
+            cats = tuple(categories)
+
+            def schema_fn(s: Schema) -> Schema:
+                i = s.index_of(name)
+                cols = list(s.columns)
+                cols[i] = ColumnMeta(name, ColumnType.CATEGORICAL, cats)
+                return Schema(cols)
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                for r in recs:
+                    if r[i] not in cats:
+                        raise ValueError(f"value {r[i]!r} not in categories {cats} for column {name!r}")
+                return recs
+
+            return self._add(
+                "string_to_categorical", schema_fn, records_fn,
+                {"kind": "string_to_categorical", "name": name, "categories": list(cats)},
+            )
+
+        def categorical_to_integer(self, name: str):
+            def schema_fn(s: Schema) -> Schema:
+                i = s.index_of(name)
+                if s.columns[i].type != ColumnType.CATEGORICAL:
+                    raise ValueError(f"{name!r} is not categorical")
+                cols = list(s.columns)
+                cols[i] = ColumnMeta(name, ColumnType.INTEGER)
+                return Schema(cols)
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                lookup = {c: j for j, c in enumerate(s.columns[i].categories)}
+                for r in recs:
+                    r[i] = lookup[r[i]]
+                return recs
+
+            return self._add(
+                "categorical_to_integer", schema_fn, records_fn,
+                {"kind": "categorical_to_integer", "name": name},
+            )
+
+        def categorical_to_one_hot(self, name: str):
+            def schema_fn(s: Schema) -> Schema:
+                i = s.index_of(name)
+                if s.columns[i].type != ColumnType.CATEGORICAL:
+                    raise ValueError(f"{name!r} is not categorical")
+                cols = list(s.columns)
+                onehot = [ColumnMeta(f"{name}[{c}]", ColumnType.INTEGER) for c in s.columns[i].categories]
+                return Schema(cols[:i] + onehot + cols[i + 1:])
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                cats = s.columns[i].categories
+                lookup = {c: j for j, c in enumerate(cats)}
+                out = []
+                for r in recs:
+                    vec = [0] * len(cats)
+                    vec[lookup[r[i]]] = 1
+                    out.append(r[:i] + vec + r[i + 1:])
+                return out
+
+            return self._add(
+                "categorical_to_one_hot", schema_fn, records_fn,
+                {"kind": "categorical_to_one_hot", "name": name},
+            )
+
+        # --- math ----------------------------------------------------
+        def double_math_op(self, name: str, op: str, scalar: float):
+            ops = {
+                "add": lambda v: v + scalar,
+                "subtract": lambda v: v - scalar,
+                "multiply": lambda v: v * scalar,
+                "divide": lambda v: v / scalar,
+                "power": lambda v: v ** scalar,
+            }
+            if op not in ops:
+                raise ValueError(f"unknown op {op!r}; have {sorted(ops)}")
+
+            def schema_fn(s: Schema) -> Schema:
+                i = s.index_of(name)
+                cols = list(s.columns)
+                cols[i] = ColumnMeta(name, ColumnType.DOUBLE)
+                return Schema(cols)
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                f = ops[op]
+                for r in recs:
+                    r[i] = f(float(r[i]))
+                return recs
+
+            return self._add(
+                "double_math_op", schema_fn, records_fn,
+                {"kind": "double_math_op", "name": name, "op": op, "scalar": scalar},
+            )
+
+        def normalize_min_max(self, name: str, min_val: float, max_val: float):
+            """Scale [min_val, max_val] → [0, 1] (reference Normalize.MinMax)."""
+            span = max_val - min_val
+
+            def schema_fn(s: Schema) -> Schema:
+                i = s.index_of(name)
+                cols = list(s.columns)
+                cols[i] = ColumnMeta(name, ColumnType.DOUBLE)
+                return Schema(cols)
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                for r in recs:
+                    r[i] = (float(r[i]) - min_val) / span
+                return recs
+
+            return self._add(
+                "normalize_min_max", schema_fn, records_fn,
+                {"kind": "normalize_min_max", "name": name, "min_val": min_val, "max_val": max_val},
+            )
+
+        def normalize_standardize(self, name: str, mean: float, std: float):
+            def schema_fn(s: Schema) -> Schema:
+                i = s.index_of(name)
+                cols = list(s.columns)
+                cols[i] = ColumnMeta(name, ColumnType.DOUBLE)
+                return Schema(cols)
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                for r in recs:
+                    r[i] = (float(r[i]) - mean) / std
+                return recs
+
+            return self._add(
+                "normalize_standardize", schema_fn, records_fn,
+                {"kind": "normalize_standardize", "name": name, "mean": mean, "std": std},
+            )
+
+        # --- filter / replace ---------------------------------------
+        def filter_rows(self, name: str, condition: str, value):
+            """Drop rows where the condition HOLDS (reference FilterInvalidValues/
+            ConditionFilter semantics: filter = remove matching)."""
+            conds = {
+                "lt": lambda v: v < value,
+                "lte": lambda v: v <= value,
+                "gt": lambda v: v > value,
+                "gte": lambda v: v >= value,
+                "eq": lambda v: v == value,
+                "neq": lambda v: v != value,
+            }
+            if condition not in conds:
+                raise ValueError(f"unknown condition {condition!r}")
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                f = conds[condition]
+                return [r for r in recs if not f(r[i])]
+
+            return self._add(
+                "filter_rows", lambda s: s, records_fn,
+                {"kind": "filter_rows", "name": name, "condition": condition, "value": value},
+            )
+
+        def replace_where(self, name: str, condition: str, value, replacement):
+            conds = {
+                "lt": lambda v: v < value,
+                "lte": lambda v: v <= value,
+                "gt": lambda v: v > value,
+                "gte": lambda v: v >= value,
+                "eq": lambda v: v == value,
+                "neq": lambda v: v != value,
+            }
+            if condition not in conds:
+                raise ValueError(f"unknown condition {condition!r}; have {sorted(conds)}")
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                f = conds[condition]
+                for r in recs:
+                    if f(r[i]):
+                        r[i] = replacement
+                return recs
+
+            return self._add(
+                "replace_where", lambda s: s, records_fn,
+                {"kind": "replace_where", "name": name, "condition": condition,
+                 "value": value, "replacement": replacement},
+            )
+
+        # --- derived columns ----------------------------------------
+        def add_constant_column(self, name: str, col_type: str, value):
+            def schema_fn(s: Schema) -> Schema:
+                return Schema(list(s.columns) + [ColumnMeta(name, ColumnType(col_type))])
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                for r in recs:
+                    r.append(value)
+                return recs
+
+            return self._add(
+                "add_constant_column", schema_fn, records_fn,
+                {"kind": "add_constant_column", "name": name, "col_type": col_type, "value": value},
+            )
+
+        def derive_column(self, name: str, col_type: str, sources: Sequence[str],
+                          fn: Optional[Callable] = None):
+            """Custom derived column.  `fn(*source_values)`; not JSON round-trippable
+            (reference parity: custom transforms serialize by class name only)."""
+            srcs = list(sources)
+
+            def schema_fn(s: Schema) -> Schema:
+                for n in srcs:
+                    s.index_of(n)
+                return Schema(list(s.columns) + [ColumnMeta(name, ColumnType(col_type))])
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                idx = [s.index_of(n) for n in srcs]
+                for r in recs:
+                    r.append(fn(*[r[i] for i in idx]))
+                return recs
+
+            return self._add(
+                "derive_column", schema_fn, records_fn,
+                {"kind": "derive_column", "name": name, "col_type": col_type, "sources": srcs},
+            )

@@ -446,6 +446,15 @@ class NDArray:
     def __le__(self, o):
         return self.lte(o)
 
+    def __eq__(self, o):
+        return self.eq(o)
+
+    def __ne__(self, o):
+        return self.neq(o)
+
+    # elementwise __eq__ makes instances unhashable, same as numpy arrays
+    __hash__ = None
+
     def where(self, cond, other) -> "NDArray":
         """self where cond else other (reference `Nd4j.where` / replaceWhere)."""
         return _wrap(jnp.where(_unwrap(cond), self._value, _unwrap(other)))

@@ -182,6 +182,12 @@ class TestComparisonsConditionals:
         assert not a.equals(nd.create([1.1, 2.0]))
         assert not a.equals(nd.create([1.0, 2.0, 3.0]))
 
+    def test_eq_operator_is_elementwise(self):
+        a = nd.create([1.0, 2.0])
+        b = nd.create([1.0, 3.0])
+        assert (a == b).to_numpy().tolist() == [True, False]
+        assert (a != b).to_numpy().tolist() == [False, True]
+
     def test_nan_inf_detection(self):
         a = nd.create([1.0, float("nan"), float("inf")])
         assert a.isnan().to_numpy().tolist() == [False, True, False]
