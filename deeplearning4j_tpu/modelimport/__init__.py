@@ -1,0 +1,1 @@
+"""Model import — the reference's `deeplearning4j-modelimport` / samediff-import role."""

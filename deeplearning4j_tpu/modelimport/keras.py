@@ -1,0 +1,477 @@
+"""Keras HDF5 import — the `KerasModelImport` role.
+
+Reference: `org.deeplearning4j.nn.modelimport.keras.KerasModelImport` parses a
+Keras HDF5 file (architecture JSON + weight groups) into a DL4J network with
+per-layer mappers (SURVEY.md §2.2 "Keras import").  Here the target is our
+TPU-compiled `SequentialModel`; weight layouts need almost no transposition
+because both Keras and this framework use (in, out) dense kernels, HWIO conv
+kernels and channels-last feature maps (the reference had to convert
+everything to NCHW for cuDNN — that conversion is exactly what we avoid).
+
+Supported: Sequential models and linear Functional graphs, with layers
+InputLayer, Dense, Conv2D, MaxPooling2D, AveragePooling2D,
+GlobalAverage/MaxPooling1D/2D, Flatten, Dropout, Activation,
+BatchNormalization, ZeroPadding2D, Embedding, LSTM.  Both Keras-2 and
+Keras-3 legacy-H5 config dialects are handled.  Branching functional graphs
+and other layer types raise with a clear message (reference parity gap,
+tracked).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import InputType, NeuralNetConfiguration
+from deeplearning4j_tpu.nn.conf.layers import (
+    ActivationLayer,
+    BatchNorm,
+    Conv2D,
+    Dense,
+    Dropout,
+    Embedding,
+    GlobalPooling,
+    LossLayer,
+    OutputLayer,
+    PoolingType,
+    Subsampling,
+    ZeroPadding2D,
+)
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.updaters import Adam
+
+
+class KerasImportError(ValueError):
+    pass
+
+
+_ACTIVATIONS = {
+    "linear": Activation.IDENTITY,
+    "relu": Activation.RELU,
+    "relu6": Activation.RELU6,
+    "elu": Activation.ELU,
+    "selu": Activation.SELU,
+    "gelu": Activation.GELU,
+    "swish": Activation.SILU,
+    "silu": Activation.SILU,
+    "sigmoid": Activation.SIGMOID,
+    "hard_sigmoid": Activation.HARDSIGMOID,
+    "tanh": Activation.TANH,
+    "softmax": Activation.SOFTMAX,
+    "softplus": Activation.SOFTPLUS,
+    "softsign": Activation.SOFTSIGN,
+    "leaky_relu": Activation.LEAKYRELU,
+    "mish": Activation.MISH,
+}
+
+_LOSSES = {
+    "categorical_crossentropy": Loss.MCXENT,
+    "sparse_categorical_crossentropy": Loss.SPARSE_MCXENT,
+    "binary_crossentropy": Loss.XENT,
+    "mean_squared_error": Loss.MSE,
+    "mse": Loss.MSE,
+    "mean_absolute_error": Loss.MAE,
+    "mae": Loss.MAE,
+    "huber": Loss.HUBER,
+    "poisson": Loss.POISSON,
+    "kl_divergence": Loss.KL_DIVERGENCE,
+    "cosine_similarity": Loss.COSINE_PROXIMITY,
+    "hinge": Loss.HINGE,
+    "squared_hinge": Loss.SQUARED_HINGE,
+}
+
+
+def _act(name: Optional[str]) -> Activation:
+    if name is None:
+        return Activation.IDENTITY
+    if isinstance(name, dict):  # keras serialized activation object
+        name = name.get("config", {}).get("activation", name.get("class_name", "linear"))
+    name = str(name).lower()
+    if name not in _ACTIVATIONS:
+        raise KerasImportError(f"unsupported Keras activation {name!r}")
+    return _ACTIVATIONS[name]
+
+
+from deeplearning4j_tpu.nn.conf.layers import _pair  # shared int-or-seq → 2-tuple
+
+
+def _padding(cfg: dict) -> str:
+    p = cfg.get("padding", "valid")
+    if p not in ("same", "valid"):
+        raise KerasImportError(f"unsupported padding {p!r}")
+    return p
+
+
+def _input_shape(cfg: dict) -> Optional[tuple]:
+    # keras2: batch_input_shape; keras3: batch_shape
+    shape = cfg.get("batch_input_shape") or cfg.get("batch_shape")
+    if shape is None:
+        return None
+    return tuple(shape[1:])  # drop batch dim
+
+
+def _itype_from_shape(shape: tuple) -> InputType:
+    if len(shape) == 1 and shape[0] is not None:
+        return InputType.feed_forward(int(shape[0]))
+    if len(shape) == 2 and shape[1] is not None:
+        # None timesteps (variable-length sequences) map to timesteps=-1
+        t = -1 if shape[0] is None else int(shape[0])
+        return InputType.recurrent(int(shape[1]), t)
+    if len(shape) == 3 and None not in shape:
+        return InputType.convolutional(int(shape[0]), int(shape[1]), int(shape[2]))
+    raise KerasImportError(f"cannot infer InputType from input shape {shape}")
+
+
+# --- per-layer config mappers (None return = structural no-op layer) -------
+
+def _map_dense(cfg, name):
+    return Dense(
+        name=name,
+        n_out=int(cfg["units"]),
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)),
+    )
+
+
+def _map_conv2d(cfg, name):
+    if cfg.get("data_format") not in (None, "channels_last"):
+        raise KerasImportError("only channels_last Conv2D supported (TPU-native layout)")
+    return Conv2D(
+        name=name,
+        n_out=int(cfg["filters"]),
+        kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        padding=_padding(cfg),
+        dilation=_pair(cfg.get("dilation_rate", 1)),
+        groups=int(cfg.get("groups", 1)),
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)),
+    )
+
+
+def _map_pool(pooling: PoolingType):
+    def mapper(cfg, name):
+        pool = _pair(cfg.get("pool_size", 2))
+        return Subsampling(
+            name=name,
+            pooling=pooling,
+            kernel=pool,
+            stride=_pair(cfg.get("strides") or pool),
+            padding=_padding(cfg),
+        )
+
+    return mapper
+
+
+def _map_global_pool(pooling: PoolingType):
+    def mapper(cfg, name):
+        return GlobalPooling(name=name, pooling=pooling)
+
+    return mapper
+
+
+def _map_batchnorm(cfg, name):
+    # our BatchNorm normalizes the trailing (channel) axis; any other axis
+    # would import silently wrong, so it is validated against the layer's
+    # actual input rank after shape inference (see import_keras_model).
+    return BatchNorm(
+        name=name,
+        epsilon=float(cfg.get("epsilon", 1e-3)),
+        decay=float(cfg.get("momentum", 0.99)),
+    )
+
+
+def _bn_axis(cfg) -> int:
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, list):
+        axis = axis[0]
+    return int(axis)
+
+
+_TENSOR_RANK = {InputType.KIND_FF: 2, InputType.KIND_RNN: 3, InputType.KIND_CNN: 4}
+
+
+def _map_lstm(cfg, name):
+    if _act(cfg.get("activation", "tanh")) != Activation.TANH:
+        raise KerasImportError("LSTM import supports tanh cell activation only")
+    return LSTM(
+        name=name,
+        n_out=int(cfg["units"]),
+        gate_activation=_act(cfg.get("recurrent_activation", "sigmoid")),
+        forget_gate_bias=1.0 if cfg.get("unit_forget_bias", True) else 0.0,
+    )
+
+
+_LAYER_MAPPERS: Dict[str, Callable] = {
+    "Dense": _map_dense,
+    "Conv2D": _map_conv2d,
+    "MaxPooling2D": _map_pool(PoolingType.MAX),
+    "AveragePooling2D": _map_pool(PoolingType.AVG),
+    "GlobalAveragePooling2D": _map_global_pool(PoolingType.AVG),
+    "GlobalMaxPooling2D": _map_global_pool(PoolingType.MAX),
+    "GlobalAveragePooling1D": _map_global_pool(PoolingType.AVG),
+    "GlobalMaxPooling1D": _map_global_pool(PoolingType.MAX),
+    "BatchNormalization": _map_batchnorm,
+    "Dropout": lambda cfg, name: Dropout(name=name, rate=float(cfg["rate"])),
+    "Activation": lambda cfg, name: ActivationLayer(name=name, activation=_act(cfg["activation"])),
+    "ZeroPadding2D": lambda cfg, name: ZeroPadding2D(name=name, padding=_pair2d(cfg.get("padding", 1))),
+    "Embedding": lambda cfg, name: Embedding(
+        name=name, n_in=int(cfg["input_dim"]), n_out=int(cfg["output_dim"])
+    ),
+    "LSTM": _map_lstm,
+    # structural no-ops: our model auto-inserts reshapes between cnn/ff kinds
+    "Flatten": lambda cfg, name: None,
+    "InputLayer": lambda cfg, name: None,
+}
+
+
+def _pair2d(v):
+    # keras ZeroPadding2D padding int | (h,w) | ((t,b),(l,r)) → our (t,b,l,r)
+    if isinstance(v, int):
+        return (v, v, v, v)
+    v = list(v)
+    if isinstance(v[0], int):
+        return (v[0], v[0], v[1], v[1])
+    return (int(v[0][0]), int(v[0][1]), int(v[1][0]), int(v[1][1]))
+
+
+# --- weight mapping ---------------------------------------------------------
+
+def _collect_layer_weights(h5group) -> Dict[str, np.ndarray]:
+    """Flatten all datasets under a layer's weight group, keyed by the
+    trailing path component without the ':0' suffix."""
+    out: Dict[str, np.ndarray] = {}
+
+    def visit(name, obj):
+        import h5py
+
+        if isinstance(obj, h5py.Dataset):
+            key = name.split("/")[-1].split(":")[0]
+            out[key] = np.asarray(obj)
+
+    h5group.visititems(visit)
+    return out
+
+
+def _apply_weights(layer_conf, weights: Dict[str, np.ndarray], params: dict, state: dict):
+    """Write Keras weights into our param/state dicts for one layer."""
+    name = layer_conf.name
+    if isinstance(layer_conf, (Dense, OutputLayer, Conv2D)):
+        p = dict(params[name])
+        p["W"] = weights["kernel"].astype(np.float32)
+        if "bias" in weights and "b" in p:
+            p["b"] = weights["bias"].astype(np.float32)
+        params[name] = p
+    elif isinstance(layer_conf, BatchNorm):
+        p = dict(params.get(name, {}))
+        if "gamma" in weights:
+            p["gamma"] = weights["gamma"].astype(np.float32)
+        if "beta" in weights:
+            p["beta"] = weights["beta"].astype(np.float32)
+        params[name] = p
+        state[name] = {
+            "mean": weights["moving_mean"].astype(np.float32),
+            "var": weights["moving_variance"].astype(np.float32),
+        }
+    elif isinstance(layer_conf, Embedding):
+        p = dict(params[name])
+        p["W"] = weights["embeddings"].astype(np.float32)
+        params[name] = p
+    elif isinstance(layer_conf, LSTM):
+        # keras fused gate order [i, f, c, o] == ours [i, f, g, o]
+        p = dict(params[name])
+        p["Wx"] = weights["kernel"].astype(np.float32)
+        p["Wh"] = weights["recurrent_kernel"].astype(np.float32)
+        if "bias" in weights:
+            p["b"] = weights["bias"].astype(np.float32)
+        params[name] = p
+    elif weights:
+        raise KerasImportError(
+            f"layer {name!r} ({type(layer_conf).__name__}) has weights "
+            f"{sorted(weights)} but no weight mapper"
+        )
+
+
+# --- model assembly ---------------------------------------------------------
+
+def _layer_list(model_cfg: dict) -> List[dict]:
+    cls = model_cfg["class_name"]
+    cfg = model_cfg["config"]
+    if isinstance(cfg, list):  # very old keras1 sequential dialect
+        return cfg
+    layers = cfg["layers"]
+    if cls == "Sequential":
+        return layers
+    if cls in ("Functional", "Model"):
+        # accept only linear chains: every layer consumes the previous one
+        for lyr in layers:
+            inbound = lyr.get("inbound_nodes", [])
+            n_inputs = 0
+            if inbound:
+                node = inbound[0]
+                if isinstance(node, dict):  # keras3 dialect
+                    args = node.get("args", [])
+                    n_inputs = len(args[0]) if args and isinstance(args[0], list) else 1
+                else:  # keras2: [[[name, 0, 0, {}], ...]]
+                    n_inputs = len(node)
+            if n_inputs > 1:
+                raise KerasImportError(
+                    "branching Functional graphs not yet supported; "
+                    "only linear chains import (ComputationGraph import tracked)"
+                )
+        return layers
+    raise KerasImportError(f"unsupported Keras model class {cls!r}")
+
+
+def _infer_loss(training_cfg: Optional[dict], last_act: Activation) -> Loss:
+    if training_cfg:
+        loss = training_cfg.get("loss")
+        if isinstance(loss, dict):
+            loss = next(iter(loss.values()))
+        if isinstance(loss, dict):  # serialized loss object
+            loss = loss.get("config", {}).get("name") or loss.get("class_name")
+        if isinstance(loss, str):
+            key = loss.lower()
+            if key in _LOSSES:
+                return _LOSSES[key]
+    # fall back on the output activation
+    if last_act == Activation.SOFTMAX:
+        return Loss.MCXENT
+    if last_act == Activation.SIGMOID:
+        return Loss.XENT
+    return Loss.MSE
+
+
+def import_keras_model(path: str) -> SequentialModel:
+    """Load architecture + weights from a Keras HDF5 file.
+
+    Reference: `KerasModelImport.importKerasSequentialModelAndWeights`.
+    """
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            raise KerasImportError(
+                f"{path}: no model_config attribute — is this a weights-only file?"
+            )
+        if isinstance(raw, bytes):
+            raw = raw.decode("utf-8")
+        model_cfg = json.loads(raw)
+
+        training_cfg = None
+        raw_t = f.attrs.get("training_config")
+        if raw_t is not None:
+            training_cfg = json.loads(raw_t.decode("utf-8") if isinstance(raw_t, bytes) else raw_t)
+
+        layer_dicts = _layer_list(model_cfg)
+
+        # 1) map configs
+        input_type: Optional[InputType] = None
+        confs = []
+        bn_axes: Dict[str, int] = {}
+        for ld in layer_dicts:
+            cls, cfg = ld["class_name"], ld.get("config", {})
+            name = cfg.get("name") or ld.get("name")
+            shape = _input_shape(cfg)
+            if shape is not None and input_type is None:
+                input_type = _itype_from_shape(shape)
+            if cls not in _LAYER_MAPPERS:
+                raise KerasImportError(f"unsupported Keras layer {cls!r} ({name})")
+            mapped = _LAYER_MAPPERS[cls](cfg, name)
+            if mapped is not None:
+                confs.append(mapped)
+                if cls == "BatchNormalization":
+                    bn_axes[mapped.name] = _bn_axis(cfg)
+        if input_type is None:
+            raise KerasImportError("no input shape found in model config")
+        if not confs:
+            raise KerasImportError("model has no importable layers")
+
+        # 2) attach an output/loss head.  A trailing Activation layer folds
+        # into the promoted OutputLayer; a non-Dense tail gets a LossLayer.
+        tail_act: Optional[Activation] = None
+        if isinstance(confs[-1], ActivationLayer) and len(confs) > 1:
+            tail_act = confs[-1].activation
+            confs = confs[:-1]
+        last = confs[-1]
+        if isinstance(last, Dense) and not isinstance(last, OutputLayer):
+            act = tail_act if tail_act is not None else last.activation
+            loss = _infer_loss(training_cfg, act or Activation.IDENTITY)
+            confs[-1] = OutputLayer(
+                name=last.name,
+                n_out=last.n_out,
+                has_bias=last.has_bias,
+                activation=act,
+                loss=loss,
+            )
+        elif not isinstance(last, OutputLayer):
+            act = tail_act if tail_act is not None else Activation.IDENTITY
+            loss = _infer_loss(training_cfg, act)
+            confs.append(LossLayer(name="imported_loss", loss=loss, activation=act))
+
+        # 3) build + init, then overwrite with imported weights
+        b = NeuralNetConfiguration.builder().updater(Adam(1e-3)).list()
+        for c in confs:
+            b.layer(c)
+        model = SequentialModel(b.set_input_type(input_type).build()).init()
+
+        # BatchNorm axis check needs the inferred input ranks: our BatchNorm
+        # normalizes the trailing axis only.
+        for conf, itype in zip(model.conf.layers, model.conf.layer_input_types()):
+            ax = bn_axes.get(conf.name)
+            if ax is not None:
+                rank = _TENSOR_RANK.get(itype.kind, 2)
+                if ax not in (-1, rank - 1):
+                    raise KerasImportError(
+                        f"BatchNormalization {conf.name!r} has axis={ax} but input "
+                        f"rank {rank}: only trailing-axis (channels_last) BN imports"
+                    )
+
+        params = dict(model.params)
+        state = dict(model.net_state)
+        wroot = f["model_weights"] if "model_weights" in f else f
+        by_name = {c.name: c for c in confs}
+        loaded = set()
+        for gname in wroot:
+            if gname not in by_name:
+                continue
+            weights = _collect_layer_weights(wroot[gname])
+            if weights:
+                _apply_weights(by_name[gname], weights, params, state)
+                loaded.add(gname)
+
+        # every parameterized layer must have received weights, at the
+        # initialized shapes — silently keeping random init would "import"
+        # a model that predicts garbage.
+        for conf in confs:
+            if conf.name in model.params and conf.name not in loaded:
+                raise KerasImportError(
+                    f"no weights found in H5 for parameterized layer {conf.name!r} "
+                    f"(groups present: {sorted(wroot)})"
+                )
+        for lname, lp in model.params.items():
+            for pname, arr in lp.items():
+                got = np.shape(params[lname][pname])
+                want = np.shape(arr)
+                if got != want:
+                    raise KerasImportError(
+                        f"weight shape mismatch for {lname}/{pname}: "
+                        f"H5 has {got}, architecture needs {want}"
+                    )
+        model.params = params
+        model.net_state = state
+        model.opt_state = model._tx.init(params)
+        return model
+
+
+class KerasModelImport:
+    """Static façade matching the reference entry-point naming."""
+
+    import_keras_sequential_model_and_weights = staticmethod(import_keras_model)
+    import_keras_model_and_weights = staticmethod(import_keras_model)
