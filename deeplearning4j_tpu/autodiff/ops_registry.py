@@ -13,9 +13,10 @@ import jax
 import jax.numpy as jnp
 
 
-def _conv2d(x, w, *, stride=(1, 1), padding="SAME"):
+def _conv2d(x, w, *, stride=(1, 1), padding="SAME", dilation=(1, 1)):
     return jax.lax.conv_general_dilated(
         x, w, window_strides=tuple(stride), padding=padding,
+        rhs_dilation=tuple(dilation),
         dimension_numbers=("NHWC", "HWIO", "NHWC"),
     )
 
@@ -77,12 +78,13 @@ def _conv3d(x, w, *, stride=(1, 1, 1), padding="SAME"):
     )
 
 
-def _depthwise_conv2d(x, w, *, stride=(1, 1), padding="SAME"):
+def _depthwise_conv2d(x, w, *, stride=(1, 1), padding="SAME", dilation=(1, 1)):
     """w: (Kh, Kw, C, M) -> per-channel conv with multiplier M."""
     c = x.shape[-1]
     return jax.lax.conv_general_dilated(
         x, w.reshape(w.shape[0], w.shape[1], 1, -1),
         window_strides=tuple(stride), padding=padding,
+        rhs_dilation=tuple(dilation),
         dimension_numbers=("NHWC", "HWIO", "NHWC"), feature_group_count=c,
     )
 
@@ -181,11 +183,19 @@ OPS: dict[str, callable] = {
     "stack": lambda *xs, axis=0: jnp.stack(xs, axis=axis),
     "squeeze": lambda x, *, axis: jnp.squeeze(x, axis=axis),
     "expand_dims": lambda x, *, axis: jnp.expand_dims(x, axis),
-    "slice": lambda x, *, begin, size: jax.lax.dynamic_slice(x, begin, size),
+    # static slice; size -1 = "to end of dim" (TF convention)
+    "slice": lambda x, *, begin, size: x[
+        tuple(slice(b, None if s == -1 else b + s) for b, s in zip(begin, size))
+    ],
     "gather": lambda x, idx, *, axis=0: jnp.take(x, idx.astype(jnp.int32), axis=axis),
-    "one_hot": lambda x, *, depth: jax.nn.one_hot(x.astype(jnp.int32), depth),
+    "one_hot": lambda x, *, depth, on_value=1.0, off_value=0.0, axis=-1: (
+        jax.nn.one_hot(x.astype(jnp.int32), depth, axis=axis) * (on_value - off_value)
+        + off_value
+    ),
     "tile": lambda x, *, reps: jnp.tile(x, reps),
-    "pad": lambda x, *, paddings: jnp.pad(x, paddings),
+    "pad": lambda x, *, paddings, constant_values=0.0: jnp.pad(
+        x, paddings, constant_values=constant_values
+    ),
     # reductions
     "sum": lambda x, *, axis=None, keepdims=False: jnp.sum(x, axis=_ax(axis), keepdims=keepdims),
     "mean": lambda x, *, axis=None, keepdims=False: jnp.mean(x, axis=_ax(axis), keepdims=keepdims),
@@ -213,6 +223,21 @@ OPS: dict[str, callable] = {
     "softplus": jax.nn.softplus,
     "sin": jnp.sin,
     "cos": jnp.cos,
+    # TF-import primitives
+    "identity": lambda x: x,
+    "erf": jax.scipy.special.erf,
+    "cast": lambda x, *, dtype: x.astype(dtype),
+    "squared_difference": lambda a, b: jnp.square(a - b),
+    "greater_equal": lambda a, b: (a >= b).astype(jnp.float32),
+    "less_equal": lambda a, b: (a <= b).astype(jnp.float32),
+    "not_equal": lambda a, b: (a != b).astype(jnp.float32),
+    "logical_and": lambda a, b: jnp.logical_and(a > 0, b > 0).astype(jnp.float32),
+    "logical_or": lambda a, b: jnp.logical_or(a > 0, b > 0).astype(jnp.float32),
+    "logical_not": lambda a: jnp.logical_not(a > 0).astype(jnp.float32),
+    "reciprocal": lambda x: 1.0 / x,
+    "floor_div": lambda a, b: jnp.floor_divide(a, b),
+    "mod": jnp.mod,
+    "atan2": jnp.arctan2,
     # nn composite
     "conv2d": _conv2d,
     "max_pool2d": _max_pool2d,

@@ -196,8 +196,22 @@ class SameDiff:
 
     # -- graph construction ------------------------------------------------
     def _fresh(self, base: str) -> str:
-        self._counter += 1
-        return f"{base}_{self._counter}"
+        # skip names already taken or reserved — imported graphs (TF node
+        # names like "matmul_2") share the same namespace as generated ones,
+        # and an importer may reserve all its node names up front
+        reserved = getattr(self, "_reserved", ())
+        while True:
+            self._counter += 1
+            name = f"{base}_{self._counter}"
+            if name not in self._vars and name not in reserved:
+                return name
+
+    def reserve_names(self, names) -> None:
+        """Mark names as taken so auto-generated op names never collide
+        (used by graph importers before materializing nodes)."""
+        if not hasattr(self, "_reserved"):
+            self._reserved = set()
+        self._reserved.update(names)
 
     def _register(self, name: str, kind: str) -> SDVariable:
         if name in self._vars:

@@ -1,0 +1,599 @@
+"""TF frozen-GraphDef import — the `TFGraphMapper` role.
+
+Reference: `org.nd4j.imports.graphmapper.tf.TFGraphMapper` /
+`samediff-import-tensorflow` map a frozen TF GraphDef into SameDiff with
+per-op mapping rules (SURVEY.md §2.2 "TF/ONNX import", §3.3 call stack —
+this is the BERT fine-tune entry path, BASELINE config 4).
+
+TPU-native differences: the imported graph lands in our compiled SameDiff
+(whole-graph XLA, not op-at-a-time), and TF's const-fed "attribute tensors"
+(reshape shapes, reduction axes, pad amounts...) are constant-folded at
+import time so the jitted computation keeps static shapes — exactly what
+XLA wants.
+
+Scope: the op set covering classic frozen inference graphs (MLPs, convnets,
+and transformer encoders: matmul/batched-matmul, decomposed layer-norm,
+erf-gelu, embedding gather, attention softmax).  Control flow
+(Switch/Merge/Enter/Exit) and dynamic-shape ops (Shape/Size at runtime) are
+rejected with a clear message rather than imported wrong.
+
+ONNX import is gated: the `onnx` package is not available in this
+environment (`import_onnx` raises ImportError with guidance).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, List, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+
+
+class TFImportError(ValueError):
+    pass
+
+
+_UNSUPPORTED_CONTROL_FLOW = {
+    "Switch", "Merge", "Enter", "Exit", "NextIteration", "LoopCond",
+    "TensorArrayV3", "While", "StatelessWhile", "If", "StatelessIf",
+}
+
+_DTYPES = {
+    1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
+    6: np.int8, 7: np.dtype("S1"), 9: np.int64, 10: np.bool_, 14: np.float16,
+}
+
+
+def _tensor_to_np(tensor_proto) -> np.ndarray:
+    """Decode a TensorProto without importing tensorflow's session machinery."""
+    shape = [d.size for d in tensor_proto.tensor_shape.dim]
+    dtype = _DTYPES.get(tensor_proto.dtype)
+    if dtype is None:
+        raise TFImportError(f"unsupported tensor dtype enum {tensor_proto.dtype}")
+    if tensor_proto.tensor_content:
+        arr = np.frombuffer(tensor_proto.tensor_content, dtype=dtype)
+        return arr.reshape(shape)
+    # scalar/splat encodings
+    if list(tensor_proto.half_val):  # fp16 stores raw uint16 bit patterns
+        arr = np.array(tensor_proto.half_val, np.uint16).view(np.float16)
+        if shape:
+            arr = np.full(shape, arr[0], np.float16) if arr.size == 1 else arr.reshape(shape)
+        elif arr.size == 1:
+            arr = arr.reshape(())
+        return arr
+    for field in ("float_val", "double_val", "int_val", "int64_val", "bool_val"):
+        vals = list(getattr(tensor_proto, field, []))
+        if vals:
+            arr = np.asarray(vals, dtype=dtype)
+            if shape:
+                if arr.size == 1:
+                    arr = np.full(shape, arr[0], dtype=dtype)
+                else:
+                    arr = arr.reshape(shape)
+            elif arr.size == 1:
+                arr = arr.reshape(())
+            return arr
+    return np.zeros(shape, dtype=dtype)
+
+
+def _input_name(raw: str) -> tuple[str, int]:
+    """'node:1' → ('node', 1); '^node' (control dep) → ('node', -1)."""
+    if raw.startswith("^"):
+        return raw[1:], -1
+    if ":" in raw:
+        name, idx = raw.rsplit(":", 1)
+        return name, int(idx)
+    return raw, 0
+
+
+class _Importer:
+    def __init__(self, graph_def, trainable: bool = False):
+        self.gd = graph_def
+        self.sd = SameDiff()
+        self.trainable = trainable
+        self.vars: Dict[str, SDVariable] = {}      # tf node name -> SDVariable
+        self.consts: Dict[str, np.ndarray] = {}    # static-value table for attr-feeding
+
+    # --- static-value resolution ------------------------------------
+    def static_value(self, name: str) -> np.ndarray:
+        if name not in self.consts:
+            raise TFImportError(
+                f"op input {name!r} must be a compile-time constant "
+                "(graph feeds it dynamically; dynamic shapes don't compile to XLA)"
+            )
+        return self.consts[name]
+
+    def in_var(self, raw: str) -> SDVariable:
+        name, idx = _input_name(raw)
+        if idx > 0:
+            name = f"{name}:{idx}"
+        if name not in self.vars:
+            base, _ = _input_name(raw)
+            if base in self.consts and base not in self.vars:
+                value = self.consts[base]
+                # frozen weights become trainable variables on request (the
+                # reference's import-then-fine-tune path, BASELINE config 4)
+                if (
+                    self.trainable
+                    and np.issubdtype(value.dtype, np.floating)
+                    and value.ndim >= 1
+                ):
+                    self.vars[base] = self.sd.var(base, value)
+                else:
+                    self.vars[base] = self.sd.constant(base, value)
+                return self.vars[base]
+            raise TFImportError(f"input {raw!r} resolves to unknown node {name!r}")
+        return self.vars[name]
+
+    def data_inputs(self, node) -> List[str]:
+        return [i for i in node.input if not i.startswith("^")]
+
+    # --- attr helpers ------------------------------------------------
+    @staticmethod
+    def attr(node, key, default=None):
+        if key not in node.attr:
+            return default
+        a = node.attr[key]
+        kind = a.WhichOneof("value")
+        if kind == "i":
+            return a.i
+        if kind == "f":
+            return a.f
+        if kind == "b":
+            return a.b
+        if kind == "s":
+            return a.s.decode()
+        if kind == "list":
+            if a.list.i:
+                return list(a.list.i)
+            if a.list.f:
+                return list(a.list.f)
+            if a.list.s:
+                return [s.decode() for s in a.list.s]
+            return []
+        if kind == "shape":
+            return [d.size for d in a.shape.dim]
+        if kind == "type":
+            return a.type
+        if kind == "tensor":
+            return a.tensor
+        return default
+
+    def nhwc(self, node):
+        fmt = self.attr(node, "data_format", "NHWC")
+        if fmt != "NHWC":
+            raise TFImportError(f"{node.name}: only NHWC supported (got {fmt}) — TPU layout")
+
+    # --- main loop ----------------------------------------------------
+    def run(self) -> SameDiff:
+        # auto-generated names (op decompositions, _lift consts) must never
+        # collide with a TF node name that imports later
+        self.sd.reserve_names(n.name for n in self.gd.node)
+        for node in self.gd.node:
+            op = node.op
+            if op in _UNSUPPORTED_CONTROL_FLOW:
+                raise TFImportError(
+                    f"{node.name}: TF control-flow op {op!r} not supported; "
+                    "re-export the graph without loops/conds (or lower them "
+                    "into the model fn with lax.cond/lax.scan)"
+                )
+            handler = getattr(self, f"op_{op}", None)
+            if handler is None:
+                raise TFImportError(f"{node.name}: unsupported TF op {op!r}")
+            handler(node)
+        return self.sd
+
+    def _bind(self, node, var: SDVariable, static: Optional[np.ndarray] = None):
+        self.vars[node.name] = var
+        if static is not None:
+            self.consts[node.name] = static
+
+    # --- sources -----------------------------------------------------
+    def op_Placeholder(self, node):
+        shape = self.attr(node, "shape")
+        self._bind(node, self.sd.placeholder(node.name, shape=shape))
+
+    op_PlaceholderV2 = op_Placeholder
+
+    def op_Const(self, node):
+        value = _tensor_to_np(self.attr(node, "value"))
+        self.consts[node.name] = value
+        # defer creating the graph constant until something consumes it as a
+        # tensor (most consts only feed static attrs)
+
+    def op_Identity(self, node):
+        src = self.data_inputs(node)[0]
+        base, _ = _input_name(src)
+        if base in self.consts:
+            self.consts[node.name] = self.consts[base]
+            # also addressable as a fetchable graph constant (cheap: a value,
+            # not an op)
+            if node.name not in self.sd._vars:
+                self.vars[node.name] = self.sd.constant(node.name, self.consts[base])
+        else:
+            # a real graph node, so the TF name stays addressable in output()
+            self._bind(node, self.sd.apply("identity", self.in_var(src), name=node.name))
+
+    op_StopGradient = op_Identity
+    op_PreventGradient = op_Identity
+    op_CheckNumerics = op_Identity
+
+    def op_NoOp(self, node):
+        pass
+
+    # --- elementwise binary ------------------------------------------
+    def _binary(self, node, sd_op):
+        a, b = self.data_inputs(node)[:2]
+        self._bind(node, self.sd.apply(sd_op, self.in_var(a), self.in_var(b), name=node.name))
+
+    def op_Add(self, node):
+        self._binary(node, "add")
+
+    op_AddV2 = op_Add
+
+    def op_BiasAdd(self, node):
+        self.nhwc(node)
+        self._binary(node, "bias_add")
+
+    def op_Sub(self, node):
+        self._binary(node, "sub")
+
+    def op_Mul(self, node):
+        self._binary(node, "mul")
+
+    def op_RealDiv(self, node):
+        self._binary(node, "div")
+
+    op_Div = op_RealDiv
+
+    def op_Maximum(self, node):
+        self._binary(node, "maximum")
+
+    def op_Minimum(self, node):
+        self._binary(node, "minimum")
+
+    def op_Pow(self, node):
+        self._binary(node, "pow")
+
+    def op_SquaredDifference(self, node):
+        self._binary(node, "squared_difference")
+
+    def op_Greater(self, node):
+        self._binary(node, "greater")
+
+    def op_GreaterEqual(self, node):
+        self._binary(node, "greater_equal")
+
+    def op_Less(self, node):
+        self._binary(node, "less")
+
+    op_LessEqual = lambda self, node: self._binary(node, "less_equal")
+    op_Equal = lambda self, node: self._binary(node, "equal")
+    op_NotEqual = lambda self, node: self._binary(node, "not_equal")
+    op_FloorDiv = lambda self, node: self._binary(node, "floor_div")
+    op_FloorMod = lambda self, node: self._binary(node, "mod")
+
+    def op_AddN(self, node):
+        ins = [self.in_var(i) for i in self.data_inputs(node)]
+        acc = ins[0]
+        for v in ins[1:-1]:
+            acc = self.sd.apply("add", acc, v)
+        if len(ins) > 1:
+            self._bind(node, self.sd.apply("add", acc, ins[-1], name=node.name))
+        else:
+            self._bind(node, self.sd.apply("identity", acc, name=node.name))
+
+    def op_Select(self, node):
+        c, x, y = (self.in_var(i) for i in self.data_inputs(node)[:3])
+        self._bind(node, self.sd.apply("where", c, x, y, name=node.name))
+
+    op_SelectV2 = op_Select
+
+    # --- elementwise unary -------------------------------------------
+    def _unary(self, node, sd_op, **attrs):
+        self._bind(
+            node,
+            self.sd.apply(sd_op, self.in_var(self.data_inputs(node)[0]), name=node.name, **attrs),
+        )
+
+    def op_Relu(self, node):
+        self._unary(node, "relu")
+
+    def op_Relu6(self, node):
+        self._unary(node, "relu6")
+
+    def op_Elu(self, node):
+        self._unary(node, "elu")
+
+    def op_Selu(self, node):
+        self._unary(node, "selu")
+
+    def op_LeakyRelu(self, node):
+        self._unary(node, "leaky_relu", alpha=float(self.attr(node, "alpha", 0.2)))
+
+    def op_Sigmoid(self, node):
+        self._unary(node, "sigmoid")
+
+    def op_Tanh(self, node):
+        self._unary(node, "tanh")
+
+    def op_Softplus(self, node):
+        self._unary(node, "softplus")
+
+    def op_Erf(self, node):
+        self._unary(node, "erf")
+
+    def op_Exp(self, node):
+        self._unary(node, "exp")
+
+    def op_Log(self, node):
+        self._unary(node, "log")
+
+    def op_Sqrt(self, node):
+        self._unary(node, "sqrt")
+
+    def op_Rsqrt(self, node):
+        self._unary(node, "rsqrt")
+
+    def op_Square(self, node):
+        self._unary(node, "square")
+
+    def op_Neg(self, node):
+        self._unary(node, "neg")
+
+    def op_Abs(self, node):
+        self._unary(node, "abs")
+
+    def op_Floor(self, node):
+        self._unary(node, "floor")
+
+    def op_Ceil(self, node):
+        self._unary(node, "ceil")
+
+    def op_Sign(self, node):
+        self._unary(node, "sign")
+
+    def op_Sin(self, node):
+        self._unary(node, "sin")
+
+    def op_Cos(self, node):
+        self._unary(node, "cos")
+
+    def op_Reciprocal(self, node):
+        self._unary(node, "reciprocal")
+
+    def op_Cast(self, node):
+        dt = _DTYPES.get(self.attr(node, "DstT"))
+        if dt is None:
+            raise TFImportError(f"{node.name}: unsupported Cast target")
+        self._unary(node, "cast", dtype=np.dtype(dt).name)
+
+    def op_Softmax(self, node):
+        self._unary(node, "softmax", axis=-1)
+
+    def op_LogSoftmax(self, node):
+        self._unary(node, "log_softmax", axis=-1)
+
+    # --- matmul family ------------------------------------------------
+    def op_MatMul(self, node):
+        a_raw, b_raw = self.data_inputs(node)[:2]
+        a, b = self.in_var(a_raw), self.in_var(b_raw)
+        if self.attr(node, "transpose_a", False):
+            a = self.sd.apply("matrix_transpose", a)
+        if self.attr(node, "transpose_b", False):
+            b = self.sd.apply("matrix_transpose", b)
+        self._bind(node, self.sd.apply("matmul", a, b, name=node.name))
+
+    def op_BatchMatMulV2(self, node):
+        a_raw, b_raw = self.data_inputs(node)[:2]
+        a, b = self.in_var(a_raw), self.in_var(b_raw)
+        if self.attr(node, "adj_x", False):
+            a = self.sd.apply("matrix_transpose", a)
+        if self.attr(node, "adj_y", False):
+            b = self.sd.apply("matrix_transpose", b)
+        self._bind(node, self.sd.apply("matmul", a, b, name=node.name))
+
+    op_BatchMatMul = op_BatchMatMulV2
+
+    # --- shape ops (const-folded) ------------------------------------
+    def op_Reshape(self, node):
+        x_raw, shape_raw = self.data_inputs(node)[:2]
+        shape = [int(v) for v in self.static_value(_input_name(shape_raw)[0]).reshape(-1)]
+        self._unary_on(node, x_raw, "reshape", shape=shape)
+
+    def _unary_on(self, node, x_raw, sd_op, **attrs):
+        self._bind(node, self.sd.apply(sd_op, self.in_var(x_raw), name=node.name, **attrs))
+
+    def op_Transpose(self, node):
+        x_raw, perm_raw = self.data_inputs(node)[:2]
+        perm = [int(v) for v in self.static_value(_input_name(perm_raw)[0]).reshape(-1)]
+        self._unary_on(node, x_raw, "transpose", axes=perm)
+
+    def op_ExpandDims(self, node):
+        x_raw, ax_raw = self.data_inputs(node)[:2]
+        axis = int(self.static_value(_input_name(ax_raw)[0]))
+        self._unary_on(node, x_raw, "expand_dims", axis=axis)
+
+    def op_Squeeze(self, node):
+        dims = self.attr(node, "squeeze_dims", []) or None
+        self._unary(node, "squeeze", axis=tuple(dims) if dims else None)
+
+    def op_ConcatV2(self, node):
+        ins = self.data_inputs(node)
+        axis = int(self.static_value(_input_name(ins[-1])[0]))
+        vs = [self.in_var(i) for i in ins[:-1]]
+        self._bind(node, self.sd.apply("concat", *vs, name=node.name, axis=axis))
+
+    def op_Pack(self, node):
+        axis = int(self.attr(node, "axis", 0))
+        vs = [self.in_var(i) for i in self.data_inputs(node)]
+        self._bind(node, self.sd.apply("stack", *vs, name=node.name, axis=axis))
+
+    def op_Pad(self, node):
+        ins = self.data_inputs(node)
+        paddings = [tuple(int(v) for v in row) for row in self.static_value(_input_name(ins[1])[0])]
+        cv = 0.0
+        if len(ins) > 2:  # PadV2 carries constant_values as a third input
+            cv = float(self.static_value(_input_name(ins[2])[0]))
+        self._unary_on(node, ins[0], "pad", paddings=paddings, constant_values=cv)
+
+    op_PadV2 = op_Pad
+
+    def op_Tile(self, node):
+        x_raw, reps_raw = self.data_inputs(node)[:2]
+        reps = [int(v) for v in self.static_value(_input_name(reps_raw)[0]).reshape(-1)]
+        self._unary_on(node, x_raw, "tile", reps=tuple(reps))
+
+    def op_Slice(self, node):
+        x_raw, b_raw, s_raw = self.data_inputs(node)[:3]
+        begin = [int(v) for v in self.static_value(_input_name(b_raw)[0]).reshape(-1)]
+        size = [int(v) for v in self.static_value(_input_name(s_raw)[0]).reshape(-1)]
+        self._unary_on(node, x_raw, "slice", begin=tuple(begin), size=tuple(size))
+
+    def op_GatherV2(self, node):
+        ins = self.data_inputs(node)
+        axis = int(self.static_value(_input_name(ins[2])[0])) if len(ins) > 2 else 0
+        self._bind(
+            node,
+            self.sd.apply("gather", self.in_var(ins[0]), self.in_var(ins[1]),
+                          name=node.name, axis=axis),
+        )
+
+    op_Gather = op_GatherV2
+    op_ResourceGather = op_GatherV2
+
+    def op_OneHot(self, node):
+        ins = self.data_inputs(node)
+        depth = int(self.static_value(_input_name(ins[1])[0]))
+        on = float(self.static_value(_input_name(ins[2])[0])) if len(ins) > 2 else 1.0
+        off = float(self.static_value(_input_name(ins[3])[0])) if len(ins) > 3 else 0.0
+        axis = int(self.attr(node, "axis", -1))
+        self._bind(
+            node,
+            self.sd.apply("one_hot", self.in_var(ins[0]), name=node.name,
+                          depth=depth, on_value=on, off_value=off, axis=axis),
+        )
+
+    # --- reductions ---------------------------------------------------
+    def _reduction(self, node, sd_op):
+        x_raw, ax_raw = self.data_inputs(node)[:2]
+        axes = [int(v) for v in self.static_value(_input_name(ax_raw)[0]).reshape(-1)]
+        keep = bool(self.attr(node, "keep_dims", False))
+        self._unary_on(node, x_raw, sd_op, axis=tuple(axes), keepdims=keep)
+
+    def op_Mean(self, node):
+        self._reduction(node, "mean")
+
+    def op_Sum(self, node):
+        self._reduction(node, "sum")
+
+    def op_Max(self, node):
+        self._reduction(node, "max")
+
+    def op_Min(self, node):
+        self._reduction(node, "min")
+
+    def op_Prod(self, node):
+        self._reduction(node, "prod")
+
+    def op_ArgMax(self, node):
+        x_raw, ax_raw = self.data_inputs(node)[:2]
+        axis = int(self.static_value(_input_name(ax_raw)[0]))
+        self._unary_on(node, x_raw, "argmax", axis=axis)
+
+    # --- nn -----------------------------------------------------------
+    def _conv(self, node, sd_op):
+        self.nhwc(node)
+        strides = self.attr(node, "strides", [1, 1, 1, 1])
+        dil = self.attr(node, "dilations", [1, 1, 1, 1])
+        padding = self.attr(node, "padding", "SAME")
+        if padding not in ("SAME", "VALID"):
+            raise TFImportError(f"{node.name}: padding {padding!r} unsupported")
+        x_raw, w_raw = self.data_inputs(node)[:2]
+        self._bind(
+            node,
+            self.sd.apply(sd_op, self.in_var(x_raw), self.in_var(w_raw),
+                          name=node.name, stride=(int(strides[1]), int(strides[2])),
+                          padding=padding, dilation=(int(dil[1]), int(dil[2]))),
+        )
+
+    def op_Conv2D(self, node):
+        self._conv(node, "conv2d")
+
+    def op_DepthwiseConv2dNative(self, node):
+        self._conv(node, "depthwise_conv2d")
+
+    def _pool(self, node, sd_op):
+        self.nhwc(node)
+        k = self.attr(node, "ksize", [1, 2, 2, 1])
+        s = self.attr(node, "strides", [1, 2, 2, 1])
+        self._unary(node, sd_op, kernel=(int(k[1]), int(k[2])),
+                    stride=(int(s[1]), int(s[2])),
+                    padding=self.attr(node, "padding", "VALID"))
+
+    def op_MaxPool(self, node):
+        self._pool(node, "max_pool2d")
+
+    def op_AvgPool(self, node):
+        self._pool(node, "avg_pool2d")
+
+    def op_FusedBatchNormV3(self, node):
+        # inference form: (x - mean) * rsqrt(var + eps) * gamma + beta
+        ins = self.data_inputs(node)
+        x, gamma, beta, mean, var = (self.in_var(i) for i in ins[:5])
+        eps = float(self.attr(node, "epsilon", 1e-3))
+        sd = self.sd
+        inv = sd.apply("rsqrt", sd.apply("add", var, sd._lift(eps)))
+        scaled = sd.apply("mul", sd.apply("mul", sd.apply("sub", x, mean), inv), gamma)
+        self._bind(node, sd.apply("add", scaled, beta, name=node.name))
+
+    op_FusedBatchNorm = op_FusedBatchNormV3
+    op_FusedBatchNormV2 = op_FusedBatchNormV3
+
+
+def import_graph(path_or_graphdef, trainable: bool = False) -> SameDiff:
+    """Import a frozen TF GraphDef (binary .pb path, bytes, or proto).
+
+    Reference entry: `TFGraphMapper.importGraph(File)` (SURVEY.md §3.3).
+    `trainable=True` promotes frozen float weight tensors to SameDiff
+    variables so the imported graph can be fine-tuned (attach a loss with
+    `sd.set_loss` + `set_training_config`, then `fit`).
+    """
+    gd = path_or_graphdef
+    if isinstance(gd, (str, bytes)) or hasattr(gd, "read"):
+        try:
+            from tensorflow.core.framework import graph_pb2
+        except ImportError as e:  # pragma: no cover
+            raise ImportError(
+                "TF GraphDef import needs the tensorflow protobuf definitions "
+                "(tensorflow is bundled in this environment)"
+            ) from e
+        proto = graph_pb2.GraphDef()
+        if isinstance(gd, str):
+            with open(gd, "rb") as f:
+                proto.ParseFromString(f.read())
+        elif isinstance(gd, bytes):
+            proto.ParseFromString(gd)
+        else:
+            proto.ParseFromString(gd.read())
+        gd = proto
+    return _Importer(gd, trainable=trainable).run()
+
+
+def import_onnx(path) -> SameDiff:
+    """ONNX import — gated: the `onnx` package is not in this environment."""
+    try:
+        import onnx  # noqa: F401
+    except ImportError as e:
+        raise ImportError(
+            "onnx is not installed in this environment; ONNX import is gated. "
+            "TF GraphDef import (import_graph) covers the frozen-graph path."
+        ) from e
+    raise NotImplementedError("ONNX mapping not yet implemented")  # pragma: no cover
+
+
+class TFGraphMapper:
+    """Static façade matching the reference entry-point naming."""
+
+    import_graph = staticmethod(import_graph)

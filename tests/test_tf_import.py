@@ -1,0 +1,307 @@
+"""TF GraphDef import golden tests.
+
+The reference's TF import regression suite runs thousands of tiny frozen
+graphs against TensorFlow-produced golden outputs (SURVEY.md §4.1 "TF
+import regression suite").  TensorFlow is available here, so goldens are
+produced live: build a TF1-style graph of constants, take its GraphDef,
+evaluate with a TF session, import into SameDiff, compare.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+tf1 = tf.compat.v1
+
+from deeplearning4j_tpu.modelimport.tensorflow import (  # noqa: E402
+    TFGraphMapper,
+    TFImportError,
+    import_graph,
+    import_onnx,
+)
+
+
+def golden(graph, feeds, fetch):
+    with tf1.Session(graph=graph) as sess:
+        return sess.run(fetch, feeds)
+
+
+def assert_graph_matches(build_fn, feeds, fetch_name, atol=1e-5):
+    """build_fn constructs ops inside a fresh TF1 graph and returns nothing."""
+    g = tf1.Graph()
+    with g.as_default():
+        build_fn()
+    want = golden(g, {f"{k}:0": v for k, v in feeds.items()}, f"{fetch_name}:0")
+    sd = import_graph(g.as_graph_def())
+    got = sd.output(feeds, fetch_name)
+    np.testing.assert_allclose(np.asarray(got), want, atol=atol, rtol=1e-4)
+    return sd
+
+
+class TestBasicGraphs:
+    def test_mlp(self):
+        rng = np.random.default_rng(0)
+        w1, b1 = rng.normal(size=(4, 8)).astype(np.float32), rng.normal(size=(8,)).astype(np.float32)
+        w2 = rng.normal(size=(8, 3)).astype(np.float32)
+
+        def build():
+            x = tf1.placeholder(tf.float32, [None, 4], name="x")
+            h = tf.nn.relu(tf.nn.bias_add(tf.matmul(x, tf.constant(w1)), tf.constant(b1)))
+            tf.nn.softmax(tf.matmul(h, tf.constant(w2)), name="out")
+
+        assert_graph_matches(build, {"x": rng.normal(size=(5, 4)).astype(np.float32)}, "out")
+
+    def test_conv_pool_net(self):
+        rng = np.random.default_rng(1)
+        k = rng.normal(0, 0.1, size=(3, 3, 2, 4)).astype(np.float32)
+
+        def build():
+            x = tf1.placeholder(tf.float32, [None, 8, 8, 2], name="x")
+            c = tf.nn.conv2d(x, tf.constant(k), strides=[1, 1, 1, 1], padding="SAME")
+            r = tf.nn.relu(c)
+            p = tf.nn.max_pool2d(r, ksize=2, strides=2, padding="VALID")
+            tf.reshape(p, [-1, 4 * 4 * 4], name="out")
+
+        assert_graph_matches(build, {"x": rng.normal(size=(3, 8, 8, 2)).astype(np.float32)}, "out")
+
+    def test_reductions_and_shape_ops(self):
+        rng = np.random.default_rng(2)
+
+        def build():
+            x = tf1.placeholder(tf.float32, [2, 3, 4], name="x")
+            m = tf.reduce_mean(x, axis=[1], keepdims=True)
+            t = tf.transpose(x - m, perm=[0, 2, 1])
+            c = tf.concat([t, t], axis=2)
+            p = tf.pad(c, [[0, 0], [1, 1], [0, 0]])
+            tf.reduce_sum(p, axis=[1, 2], name="out")
+
+        assert_graph_matches(build, {"x": rng.normal(size=(2, 3, 4)).astype(np.float32)}, "out")
+
+    def test_batchnorm_inference(self):
+        rng = np.random.default_rng(3)
+        gamma = rng.normal(1, 0.1, 4).astype(np.float32)
+        beta = rng.normal(0, 0.1, 4).astype(np.float32)
+        mean = rng.normal(0, 0.3, 4).astype(np.float32)
+        var = np.abs(rng.normal(1, 0.1, 4)).astype(np.float32)
+
+        def build():
+            x = tf1.placeholder(tf.float32, [None, 5, 5, 4], name="x")
+            y, _, _ = tf1.nn.fused_batch_norm(
+                x, tf.constant(gamma), tf.constant(beta),
+                tf.constant(mean), tf.constant(var), is_training=False, epsilon=1e-3,
+            )
+            tf.identity(y, name="out")
+
+        assert_graph_matches(build, {"x": rng.normal(size=(2, 5, 5, 4)).astype(np.float32)}, "out", atol=1e-4)
+
+    def test_gather_onehot_cast(self):
+        table = np.arange(20, dtype=np.float32).reshape(10, 2)
+
+        def build():
+            ids = tf1.placeholder(tf.int32, [None], name="ids")
+            e = tf.gather(tf.constant(table), ids)
+            oh = tf.one_hot(ids, 10)
+            tf.concat([e, tf.cast(oh, tf.float32)], axis=1, name="out")
+
+        assert_graph_matches(build, {"ids": np.array([1, 5, 9], np.int32)}, "out")
+
+    def test_select_and_comparisons(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [None], name="x")
+            tf1.where_v2(tf.greater(x, 0.0), x * 2.0, x - 1.0, name="out")
+
+        assert_graph_matches(build, {"x": np.array([-1.0, 0.5, 3.0], np.float32)}, "out")
+
+
+def build_mini_bert_encoder(seq=6, vocab=30, d=8, heads=2):
+    """One transformer encoder block the way BERT's frozen graph spells it:
+    gather embedding, decomposed layer-norm, MHA via batched matmuls,
+    erf-GELU feed-forward, residual adds."""
+    rng = np.random.default_rng(7)
+    f32 = lambda *s: rng.normal(0, 0.08, s).astype(np.float32)
+    emb = tf.constant(f32(vocab, d), name="embeddings")
+    wq, wk, wv, wo = (tf.constant(f32(d, d)) for _ in range(4))
+    w1, w2 = tf.constant(f32(d, 4 * d)), tf.constant(f32(4 * d, d))
+    g1 = tf.constant(np.ones(d, np.float32))
+    b1 = tf.constant(np.zeros(d, np.float32))
+
+    ids = tf1.placeholder(tf.int32, [None, seq], name="input_ids")
+    x = tf.gather(emb, ids)  # (B, T, D)
+
+    def layer_norm(t):
+        mu = tf.reduce_mean(t, axis=[-1], keepdims=True)
+        var = tf.reduce_mean(tf.math.squared_difference(t, mu), axis=[-1], keepdims=True)
+        return (t - mu) * tf.math.rsqrt(var + 1e-6) * g1 + b1
+
+    def split_heads(t):  # (B,T,D) -> (B,H,T,D/H)
+        s = tf.reshape(t, [-1, seq, heads, d // heads])
+        return tf.transpose(s, [0, 2, 1, 3])
+
+    q, k_, v = split_heads(x @ wq), split_heads(x @ wk), split_heads(x @ wv)
+    scores = tf.matmul(q, tf.transpose(k_, [0, 1, 3, 2])) / np.sqrt(d // heads).astype(np.float32)
+    att = tf.matmul(tf.nn.softmax(scores), v)               # (B,H,T,hd)
+    att = tf.reshape(tf.transpose(att, [0, 2, 1, 3]), [-1, seq, d]) @ wo
+    h = layer_norm(x + att)
+
+    def gelu(t):
+        return t * 0.5 * (1.0 + tf.math.erf(t / np.sqrt(2.0).astype(np.float32)))
+
+    ff = gelu(h @ w1) @ w2
+    out = layer_norm(h + ff)
+    tf.identity(out, name="encoder_out")
+
+
+class TestBertPath:
+    def test_mini_bert_encoder_matches_tf(self):
+        g = tf1.Graph()
+        with g.as_default():
+            build_mini_bert_encoder()
+        ids = np.random.default_rng(0).integers(0, 30, size=(2, 6)).astype(np.int32)
+        want = golden(g, {"input_ids:0": ids}, "encoder_out:0")
+        sd = import_graph(g.as_graph_def())
+        got = sd.output({"input_ids": ids}, "encoder_out")
+        np.testing.assert_allclose(np.asarray(got), want, atol=2e-5, rtol=1e-4)
+
+    def test_fine_tune_imported_encoder(self):
+        """BASELINE config 4 shape: import frozen graph, attach a head +
+        loss, fine-tune — loss must decrease and weights must move."""
+        from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        g = tf1.Graph()
+        with g.as_default():
+            build_mini_bert_encoder()
+        sd = import_graph(g.as_graph_def(), trainable=True)
+        assert len(sd.variables()) > 0  # frozen weights became variables
+
+        # classification head over mean-pooled encoder output
+        pooled = sd.apply("mean", sd._vars["encoder_out"], axis=(1,))
+        logits = sd.apply("matmul", pooled, sd.var("head_w", np.random.default_rng(1).normal(0, 0.1, (8, 2)).astype(np.float32)))
+        labels = sd.placeholder("labels")
+        loss = sd.apply("softmax_cross_entropy", logits, labels)
+        sd.set_loss(loss)
+        sd.set_training_config(TrainingConfig(updater=Adam(5e-3)))
+
+        rng = np.random.default_rng(2)
+        ids = rng.integers(0, 30, size=(8, 6)).astype(np.int32)
+        y = np.eye(2, dtype=np.float32)[(ids.sum(axis=1) % 2)]
+        losses = [sd.fit_batch({"input_ids": ids, "labels": y}) for _ in range(30)]
+        assert losses[-1] < losses[0], losses[::10]
+
+
+class TestReviewRegressions:
+    def test_dilated_conv(self):
+        rng = np.random.default_rng(11)
+        k = rng.normal(0, 0.1, size=(3, 3, 1, 2)).astype(np.float32)
+
+        def build():
+            x = tf1.placeholder(tf.float32, [None, 10, 10, 1], name="x")
+            tf.nn.conv2d(x, tf.constant(k), strides=[1, 1, 1, 1],
+                         padding="SAME", dilations=[1, 2, 2, 1], name="out")
+
+        assert_graph_matches(build, {"x": rng.normal(size=(2, 10, 10, 1)).astype(np.float32)}, "out")
+
+    def test_padv2_constant_values(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [2, 2], name="x")
+            tf.pad(x, [[0, 0], [1, 1]], constant_values=-9.5, name="out")
+
+        assert_graph_matches(build, {"x": np.ones((2, 2), np.float32)}, "out")
+
+    def test_onehot_on_off_values(self):
+        def build():
+            ids = tf1.placeholder(tf.int32, [None], name="ids")
+            tf.one_hot(ids, 4, on_value=0.0, off_value=-1e4, name="out")
+
+        assert_graph_matches(build, {"ids": np.array([0, 2], np.int32)}, "out")
+
+    def test_slice_minus_one_size(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [3, 5], name="x")
+            tf.slice(x, [1, 0], [-1, 4], name="out")
+
+        assert_graph_matches(build, {"x": np.arange(15, dtype=np.float32).reshape(3, 5)}, "out")
+
+    def test_fetch_addn_and_fused_bn_directly(self):
+        rng = np.random.default_rng(12)
+        g1v = rng.normal(1, 0.1, 3).astype(np.float32)
+
+        def build():
+            x = tf1.placeholder(tf.float32, [None, 2, 2, 3], name="x")
+            s = tf.add_n([x, x, x], name="triple")
+            y, _, _ = tf1.nn.fused_batch_norm(
+                s, tf.constant(g1v), tf.constant(np.zeros(3, np.float32)),
+                tf.constant(np.zeros(3, np.float32)), tf.constant(np.ones(3, np.float32)),
+                is_training=False, name="bn",
+            )
+
+        g = tf1.Graph()
+        with g.as_default():
+            build()
+        feeds = {"x": np.random.default_rng(0).normal(size=(1, 2, 2, 3)).astype(np.float32)}
+        want_triple = golden(g, {"x:0": feeds["x"]}, "triple:0")
+        want_bn = golden(g, {"x:0": feeds["x"]}, "bn:0")
+        sd = import_graph(g.as_graph_def())
+        np.testing.assert_allclose(np.asarray(sd.output(feeds, "triple")), want_triple, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(sd.output(feeds, "bn")), want_bn, atol=1e-4)
+
+    def test_generated_name_collision_with_tf_names(self):
+        """Graph where TF's auto-naming produces add/add_1/... nodes AFTER a
+        FusedBatchNorm whose decomposition generates adds internally."""
+        rng = np.random.default_rng(13)
+
+        def build():
+            x = tf1.placeholder(tf.float32, [None, 2, 2, 2], name="x")
+            y, _, _ = tf1.nn.fused_batch_norm(
+                x, tf.constant(np.ones(2, np.float32)), tf.constant(np.zeros(2, np.float32)),
+                tf.constant(np.zeros(2, np.float32)), tf.constant(np.ones(2, np.float32)),
+                is_training=False,
+            )
+            a = y + 1.0   # TF names these add, add_1, ...
+            b = a + 2.0
+            c = b + 3.0
+            tf.identity(c, name="out")
+
+        assert_graph_matches(build, {"x": rng.normal(size=(1, 2, 2, 2)).astype(np.float32)}, "out", atol=1e-4)
+
+
+class TestErrorPaths:
+    def test_control_flow_rejected(self):
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [], name="x")
+            tf1.cond(x > 0, lambda: x * 2, lambda: x - 1, name="out")
+        with pytest.raises(TFImportError, match="control-flow"):
+            import_graph(g.as_graph_def())
+
+    def test_unsupported_op_named(self):
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.complex64, [4], name="x")
+            tf1.fft(x, name="out")
+        with pytest.raises(TFImportError, match="FFT"):
+            import_graph(g.as_graph_def())
+
+    def test_dynamic_reshape_rejected(self):
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [None, 4], name="x")
+            s = tf1.placeholder(tf.int32, [2], name="s")
+            tf.reshape(x, s, name="out")
+        with pytest.raises(TFImportError, match="constant"):
+            import_graph(g.as_graph_def())
+
+    def test_onnx_gated(self):
+        with pytest.raises((ImportError, NotImplementedError)):
+            import_onnx("/tmp/nonexistent.onnx")
+
+    def test_facade_from_file(self, tmp_path):
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [None, 2], name="x")
+            tf.identity(x * 2.0, name="out")
+        p = tmp_path / "g.pb"
+        p.write_bytes(g.as_graph_def().SerializeToString())
+        sd = TFGraphMapper.import_graph(str(p))
+        out = sd.output({"x": np.ones((1, 2), np.float32)}, "out")
+        np.testing.assert_allclose(np.asarray(out), [[2.0, 2.0]])
