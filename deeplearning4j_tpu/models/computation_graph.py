@@ -52,17 +52,25 @@ class GraphModel(Model):
 
     # -- construction ------------------------------------------------------
     def _resolve_outputs(self):
-        """(loss, activation, fused) per network output, in declared order."""
+        """(loss, activation, fused, custom_loss_fn) per network output,
+        in declared order.  custom_loss_fn is set for layers that carry
+        their own loss (e.g. Yolo2OutputLayer) and bypasses the enum path."""
+        from deeplearning4j_tpu.nn.activations import Activation
+        from deeplearning4j_tpu.nn.losses import Loss
+
         by_name = {n.name: n for n in self.conf.nodes}
         specs = []
         for out in self.conf.network_outputs:
             layer = by_name[out].layer
+            if layer is not None and hasattr(layer, "compute_loss"):
+                specs.append((Loss.MSE, Activation.IDENTITY, False, layer.compute_loss))
+                continue
             if layer is None or not hasattr(layer, "loss"):
                 raise ValueError(
                     f"network output {out!r} must be an OutputLayer/"
                     "RnnOutputLayer/LossLayer"
                 )
-            specs.append(resolve_output_spec(layer))
+            specs.append(resolve_output_spec(layer) + (None,))
         return specs
 
     def _mask_frozen(self, tx):
@@ -143,13 +151,16 @@ class GraphModel(Model):
                         p, net_state, inputs, training=True, rng=rng
                     )
                     total = jnp.zeros((), jnp.float32)
-                    for (loss, act, fused), oname, lab, m in zip(
+                    for (loss, act, fused, custom), oname, lab, m in zip(
                         self._out_specs,
                         self.conf.network_outputs,
                         labels,
                         lmasks if n_masks else [None] * len(labels),
                     ):
                         out = outs[oname]
+                        if custom is not None:
+                            total = total + custom(out, lab, m)
+                            continue
                         if not fused:
                             out = act(out.astype(jnp.float32))
                         total = total + compute_loss(loss, out, lab, m, from_logits=fused)
@@ -258,7 +269,7 @@ class GraphModel(Model):
                 inputs = dict(zip(self.conf.network_inputs, features))
                 outs, _ = self._forward(params, net_state, inputs, training=False, rng=None)
                 result = []
-                for (loss, act, fused), oname in zip(
+                for (loss, act, fused, custom), oname in zip(
                     self._out_specs, self.conf.network_outputs
                 ):
                     result.append(act(outs[oname].astype(jnp.float32)))
@@ -306,10 +317,13 @@ class GraphModel(Model):
         outs, _ = self._forward(self.params, self.net_state, inputs, training=False, rng=None)
         masks = mds.labels_masks or (None,) * len(mds.labels)
         total = jnp.zeros((), jnp.float32)
-        for (loss, act, fused), oname, lab, m in zip(
+        for (loss, act, fused, custom), oname, lab, m in zip(
             self._out_specs, self.conf.network_outputs, mds.labels, masks
         ):
             out = outs[oname]
+            if custom is not None:
+                total = total + custom(out, jnp.asarray(lab), m)
+                continue
             if not fused:
                 out = act(out.astype(jnp.float32))
             total = total + compute_loss(loss, out, jnp.asarray(lab), m, from_logits=fused)

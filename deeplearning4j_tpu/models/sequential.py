@@ -76,6 +76,12 @@ class SequentialModel(Model):
     # -- construction ------------------------------------------------------
     def _resolve_output(self) -> tuple[Loss, Activation, bool]:
         last = self.conf.layers[-1]
+        # layers with their own loss function (e.g. Yolo2OutputLayer) bypass
+        # the enum-based loss dispatch entirely
+        if hasattr(last, "compute_loss"):
+            self._custom_loss = last.compute_loss
+            return Loss.MSE, Activation.IDENTITY, False
+        self._custom_loss = None
         if not hasattr(last, "loss"):
             raise ValueError(
                 "last layer must be an OutputLayer, RnnOutputLayer or "
@@ -170,15 +176,20 @@ class SequentialModel(Model):
                     else:
                         out, new_state = fwd
                         new_carries = {}
-                    if not self._fused_loss:
-                        out = self._out_activation(out.astype(jnp.float32))
-                    data_loss = compute_loss(
-                        self._loss,
-                        out,
-                        labels,
-                        lmask if has_lmask else None,
-                        from_logits=self._fused_loss,
-                    )
+                    if self._custom_loss is not None:
+                        data_loss = self._custom_loss(
+                            out, labels, lmask if has_lmask else None
+                        )
+                    else:
+                        if not self._fused_loss:
+                            out = self._out_activation(out.astype(jnp.float32))
+                        data_loss = compute_loss(
+                            self._loss,
+                            out,
+                            labels,
+                            lmask if has_lmask else None,
+                            from_logits=self._fused_loss,
+                        )
                     return data_loss + self._reg_loss(p), (new_state, new_carries)
 
                 (loss, (new_state, new_carries)), grads = jax.value_and_grad(
@@ -386,12 +397,15 @@ class SequentialModel(Model):
             rng=None,
             fmask=ds.features_mask,
         )
-        if not self._fused_loss:
-            out = self._out_activation(out.astype(jnp.float32))
-        loss = compute_loss(
-            self._loss, out, jnp.asarray(ds.labels), ds.labels_mask,
-            from_logits=self._fused_loss,
-        )
+        if self._custom_loss is not None:
+            loss = self._custom_loss(out, jnp.asarray(ds.labels), ds.labels_mask)
+        else:
+            if not self._fused_loss:
+                out = self._out_activation(out.astype(jnp.float32))
+            loss = compute_loss(
+                self._loss, out, jnp.asarray(ds.labels), ds.labels_mask,
+                from_logits=self._fused_loss,
+            )
         return float(loss + self._reg_loss(self.params))
 
     def evaluate(self, data, batch_size: int | None = None):

@@ -512,6 +512,32 @@ class GlobalPooling(LayerConfig):
 
 @serde.register
 @dataclasses.dataclass(frozen=True)
+class SpaceToDepth(LayerConfig):
+    """Space-to-depth (the reference's SpaceToDepthLayer; YOLO2's
+    'passthrough' reorg).  (B, H, W, C) -> (B, H/b, W/b, C*b^2)."""
+
+    block: int = 2
+    EXPECTS = "cnn"
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def output_type(self, itype: InputType) -> InputType:
+        h, w, c = itype.shape
+        b = self.block
+        if h % b or w % b:
+            raise ValueError(f"spatial dims ({h},{w}) not divisible by block {b}")
+        return InputType.convolutional(h // b, w // b, c * b * b)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        n, h, w, c = x.shape
+        b = self.block
+        y = x.reshape(n, h // b, b, w // b, b, c)
+        y = jnp.transpose(y, (0, 1, 3, 2, 4, 5)).reshape(n, h // b, w // b, c * b * b)
+        return y, state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
 class ZeroPadding2D(LayerConfig):
     padding: tuple[int, int, int, int] = (1, 1, 1, 1)   # top, bottom, left, right
     EXPECTS = "cnn"

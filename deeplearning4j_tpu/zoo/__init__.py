@@ -1,10 +1,20 @@
 """Model zoo — the `org.deeplearning4j.zoo` role."""
 
 from deeplearning4j_tpu.zoo.zoo_model import ZooModel
+from deeplearning4j_tpu.zoo.alexnet import AlexNet
+from deeplearning4j_tpu.zoo.darknet import Darknet19
+from deeplearning4j_tpu.zoo.inception_resnet import InceptionResNetV1
 from deeplearning4j_tpu.zoo.lenet import LeNet
 from deeplearning4j_tpu.zoo.resnet import ResNet50
 from deeplearning4j_tpu.zoo.simplecnn import SimpleCNN
+from deeplearning4j_tpu.zoo.squeezenet import SqueezeNet
 from deeplearning4j_tpu.zoo.unet import UNet
 from deeplearning4j_tpu.zoo.vgg import VGG16, VGG19
+from deeplearning4j_tpu.zoo.xception import Xception
+from deeplearning4j_tpu.zoo.yolo import YOLO2, TinyYOLO
 
-__all__ = ["ZooModel", "LeNet", "ResNet50", "SimpleCNN", "UNet", "VGG16", "VGG19"]
+__all__ = [
+    "ZooModel", "AlexNet", "Darknet19", "InceptionResNetV1", "LeNet",
+    "ResNet50", "SimpleCNN", "SqueezeNet", "UNet", "VGG16", "VGG19",
+    "Xception", "TinyYOLO", "YOLO2",
+]
