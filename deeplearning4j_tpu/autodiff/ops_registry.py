@@ -225,6 +225,7 @@ OPS: dict[str, callable] = {
     "cos": jnp.cos,
     # TF-import primitives
     "identity": lambda x: x,
+    "stop_gradient": jax.lax.stop_gradient,
     "erf": jax.scipy.special.erf,
     "cast": lambda x, *, dtype: x.astype(dtype),
     "squared_difference": lambda a, b: jnp.square(a - b),

@@ -79,7 +79,12 @@ class TransformProcess:
             args = {k: v for k, v in spec.items() if k != "kind"}
             if not hasattr(b, kind):
                 raise ValueError(f"unknown transform step {kind!r}")
-            getattr(b, kind)(**args)
+            if kind in ("remove_columns", "keep_columns", "reorder_columns"):
+                # these builders are declared (*names); their spec
+                # serializes {"names": [...]} — unpack positionally
+                getattr(b, kind)(*args["names"])
+            else:
+                getattr(b, kind)(**args)
         return b.build()
 
     @staticmethod

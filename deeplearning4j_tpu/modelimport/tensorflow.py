@@ -94,6 +94,7 @@ class _Importer:
         self.trainable = trainable
         self.vars: Dict[str, SDVariable] = {}      # tf node name -> SDVariable
         self.consts: Dict[str, np.ndarray] = {}    # static-value table for attr-feeding
+        self._promoted: Dict[str, SDVariable] = {}  # const node -> its ONE trainable var
 
     # --- static-value resolution ------------------------------------
     def static_value(self, name: str) -> np.ndarray:
@@ -111,17 +112,7 @@ class _Importer:
         if name not in self.vars:
             base, _ = _input_name(raw)
             if base in self.consts and base not in self.vars:
-                value = self.consts[base]
-                # frozen weights become trainable variables on request (the
-                # reference's import-then-fine-tune path, BASELINE config 4)
-                if (
-                    self.trainable
-                    and np.issubdtype(value.dtype, np.floating)
-                    and value.ndim >= 1
-                ):
-                    self.vars[base] = self.sd.var(base, value)
-                else:
-                    self.vars[base] = self.sd.constant(base, value)
+                self.vars[base] = self._const_var(base, self.consts[base])
                 return self.vars[base]
             raise TFImportError(f"input {raw!r} resolves to unknown node {name!r}")
         return self.vars[name]
@@ -184,6 +175,32 @@ class _Importer:
             handler(node)
         return self.sd
 
+    def _const_var(self, name: str, value: np.ndarray, base: str | None = None) -> SDVariable:
+        """Materialize a static value as a graph node, honoring trainable
+        promotion: frozen float weights become SameDiff variables on request
+        (the reference's import-then-fine-tune path, BASELINE config 4).
+        Used by both in_var and op_Identity so the standard frozen-graph
+        pattern Const -> Identity('w/read') -> consumer promotes too.
+
+        `base` is the underlying Const node the value came from; a given
+        Const is promoted to at most ONE trainable variable — if both 'w'
+        and 'w/read' are consumed as tensors, the second becomes an identity
+        view of the first (two independent vars would drift during
+        fine-tune)."""
+        if (
+            self.trainable
+            and np.issubdtype(value.dtype, np.floating)
+            and value.ndim >= 1
+        ):
+            key = base or name
+            prior = self._promoted.get(key)
+            if prior is not None:
+                return self.sd.apply("identity", prior, name=name)
+            v = self.sd.var(name, value)
+            self._promoted[key] = v
+            return v
+        return self.sd.constant(name, value)
+
     def _bind(self, node, var: SDVariable, static: Optional[np.ndarray] = None):
         self.vars[node.name] = var
         if static is not None:
@@ -207,17 +224,30 @@ class _Importer:
         base, _ = _input_name(src)
         if base in self.consts:
             self.consts[node.name] = self.consts[base]
-            # also addressable as a fetchable graph constant (cheap: a value,
-            # not an op)
+            # also addressable as a fetchable graph node (cheap: a value,
+            # not an op); goes through _const_var so trainable promotion
+            # fires for the Const -> Identity('w/read') -> consumer pattern
             if node.name not in self.sd._vars:
-                self.vars[node.name] = self.sd.constant(node.name, self.consts[base])
+                self.vars[node.name] = self._const_var(node.name, self.consts[base], base=base)
         else:
             # a real graph node, so the TF name stays addressable in output()
             self._bind(node, self.sd.apply("identity", self.in_var(src), name=node.name))
 
-    op_StopGradient = op_Identity
-    op_PreventGradient = op_Identity
     op_CheckNumerics = op_Identity
+
+    def op_StopGradient(self, node):
+        """Like Identity but must NEVER promote to trainable — the graph
+        author explicitly froze this tensor (so not aliased to op_Identity)."""
+        src = self.data_inputs(node)[0]
+        base, _ = _input_name(src)
+        if base in self.consts:
+            self.consts[node.name] = self.consts[base]
+            if node.name not in self.sd._vars:
+                self.vars[node.name] = self.sd.constant(node.name, self.consts[base])
+        else:
+            self._bind(node, self.sd.apply("stop_gradient", self.in_var(src), name=node.name))
+
+    op_PreventGradient = op_StopGradient
 
     def op_NoOp(self, node):
         pass
@@ -540,6 +570,15 @@ class _Importer:
 
     def op_FusedBatchNormV3(self, node):
         # inference form: (x - mean) * rsqrt(var + eps) * gamma + beta
+        # NB: TF's op-def default for is_training is True, so a stripped attr
+        # (strip_default_attrs) means training mode — default True here too.
+        if bool(self.attr(node, "is_training", True)):
+            raise TFImportError(
+                f"{node.name}: FusedBatchNorm with is_training=True — the "
+                "mean/var inputs are not populated in training graphs, so the "
+                "import would be silently wrong; re-export a frozen/inference "
+                "graph (e.g. convert_variables_to_constants of an inference fn)"
+            )
         ins = self.data_inputs(node)
         x, gamma, beta, mean, var = (self.in_var(i) for i in ins[:5])
         eps = float(self.attr(node, "epsilon", 1e-3))

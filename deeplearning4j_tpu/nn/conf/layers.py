@@ -47,6 +47,26 @@ def _pair(v) -> tuple[int, int]:
     return (int(v), int(v))
 
 
+def _coerce_enum(v, enum_cls):
+    """Accept an enum member, its value ("relu"), its NAME ("RELU"), or an
+    alias from the enum's optional _ALIASES_ table."""
+    if isinstance(v, enum_cls):
+        return v
+    s = str(v).lower()
+    s = getattr(enum_cls, "_ALIASES_", {}).get(s, s)
+    try:
+        return enum_cls(s)
+    except ValueError:
+        pass
+    try:
+        return enum_cls[str(v).upper()]
+    except KeyError:
+        raise ValueError(
+            f"{v!r} is not a valid {enum_cls.__name__}; "
+            f"options: {[e.value for e in enum_cls]}"
+        ) from None
+
+
 def _dropout(x, rate: float, training: bool, rng):
     """Inverted dropout on the layer input (reference semantics: dropOut
     applies to a layer's input activations)."""
@@ -81,6 +101,25 @@ class LayerConfig:
     # Layers that consume the (B, T) sequence mask declare this; the model
     # threads features_mask into their apply(mask=...) kwarg.
     ACCEPTS_MASK = False
+
+    def __post_init__(self):
+        # User-facing coercions: plain strings are accepted everywhere the
+        # reference accepts an enum (Activation.RELU vs "relu"), and padding
+        # is case-insensitive — "SAME" must not silently diverge from "same"
+        # in output_type's shape math.
+        if self.activation is not None:
+            object.__setattr__(self, "activation", _coerce_enum(self.activation, Activation))
+        if self.weight_init is not None:
+            object.__setattr__(self, "weight_init", _coerce_enum(self.weight_init, WeightInit))
+        pad = getattr(self, "padding", None)
+        if isinstance(pad, str):
+            object.__setattr__(self, "padding", pad.lower())
+        loss = getattr(self, "loss", None)
+        if loss is not None:
+            object.__setattr__(self, "loss", _coerce_enum(loss, Loss))
+        pooling = getattr(self, "pooling", None)
+        if pooling is not None:
+            object.__setattr__(self, "pooling", _coerce_enum(pooling, PoolingType))
 
     def output_type(self, itype: InputType) -> InputType:
         return itype

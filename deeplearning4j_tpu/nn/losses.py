@@ -35,6 +35,22 @@ class Loss(str, enum.Enum):
         return compute(self, preds, labels, mask)
 
 
+# Accepted user-facing spellings beyond value/NAME (Keras-style included);
+# consumed by the config layer's string→enum coercion.
+Loss._ALIASES_ = {
+    "categorical_crossentropy": "mcxent",
+    "softmax_cross_entropy": "mcxent",
+    "sparse_categorical_crossentropy": "sparse_mcxent",
+    "binary_crossentropy": "xent",
+    "negativeloglikelihood": "nll",
+    "mean_squared_error": "mse",
+    "mean_absolute_error": "l1",
+    "mae": "l1",
+    "kl_divergence": "kld",
+    "kullback_leibler_divergence": "kld",
+}
+
+
 def _masked_mean(per_elem: jax.Array, mask) -> jax.Array:
     if mask is None:
         return jnp.mean(per_elem)

@@ -285,3 +285,54 @@ class TestBridge:
 
         model.fit(FlattenIter(), epochs=30)
         assert model.score_value < 0.3
+
+
+class TestAdvisorRegressions:
+    """Round-1 advisor findings (ADVICE.md): from_json must round-trip every
+    serializable step kind, including the (*names)-signature builders."""
+
+    def test_star_names_steps_roundtrip(self):
+        s = (
+            Schema.builder()
+            .add_double("a").add_double("b").add_double("c")
+            .build()
+        )
+        tp = (
+            TransformProcess.builder(s)
+            .reorder_columns("c", "a", "b")
+            .remove_columns("b")
+            .keep_columns("c")
+            .build()
+        )
+        tp2 = TransformProcess.from_json(tp.to_json())
+        recs = [[1.0, 2.0, 3.0], [4.0, 5.0, 6.0]]
+        assert tp2.execute([list(r) for r in recs]) == tp.execute([list(r) for r in recs])
+        assert tp2.final_schema == tp.final_schema
+
+    def test_all_serializable_kinds_roundtrip(self):
+        s = (
+            Schema.builder()
+            .add_double("x").add_double("y")
+            .add_categorical("cat", ["p", "q"])
+            .add_string("raw")
+            .build()
+        )
+        tp = (
+            TransformProcess.builder(s)
+            .rename_column("raw", "txt")
+            .string_to_categorical("txt", ["u", "v"])
+            .categorical_to_integer("txt")
+            .categorical_to_one_hot("cat")
+            .double_math_op("x", "multiply", 2.0)
+            .normalize_min_max("x", 0.0, 10.0)
+            .normalize_standardize("y", 1.0, 2.0)
+            .add_constant_column("k", "double", 7.0)
+            .replace_where("y", "lt", 0.0, 0.0)
+            .filter_rows("x", "gte", 0.0)
+            .remove_columns("k")
+            .build()
+        )
+        tp2 = TransformProcess.from_json(tp.to_json())
+        recs = [[2.0, -1.0, "p", "u"], [8.0, 3.0, "q", "v"]]
+        assert tp2.execute([list(r) for r in recs]) == tp.execute([list(r) for r in recs])
+        assert tp2.final_schema == tp.final_schema

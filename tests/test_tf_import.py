@@ -305,3 +305,84 @@ class TestErrorPaths:
         sd = TFGraphMapper.import_graph(str(p))
         out = sd.output({"x": np.ones((1, 2), np.float32)}, "out")
         np.testing.assert_allclose(np.asarray(out), [[2.0, 2.0]])
+
+
+class TestAdvisorRegressions:
+    """Round-1 advisor findings (ADVICE.md)."""
+
+    def test_trainable_promotion_through_identity_read(self):
+        """Frozen graphs put weights behind Const -> Identity('w/read') ->
+        consumer (the convert_variables_to_constants pattern); trainable=True
+        must still promote them to variables (ADVICE.md high)."""
+        rng = np.random.default_rng(7)
+        w = rng.normal(0, 0.3, size=(4, 3)).astype(np.float32)
+
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [None, 4], name="x")
+            wv = tf1.Variable(w, name="w")
+            tf.matmul(x, wv, name="out")
+            with tf1.Session(graph=g) as sess:
+                sess.run(tf1.global_variables_initializer())
+                frozen = tf1.graph_util.convert_variables_to_constants(
+                    sess, g.as_graph_def(), ["out"]
+                )
+
+        sd = import_graph(frozen, trainable=True)
+        assert len(sd.variables()) > 0, "no weights promoted through Identity read"
+
+        # and they actually train
+        from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+        from deeplearning4j_tpu.nn.updaters import Sgd
+
+        out = sd._vars["out"]
+        loss = sd.apply("mean", sd.apply("square", out))
+        sd.set_loss(loss)
+        sd.set_training_config(TrainingConfig(updater=Sgd(0.5)))
+        before = [sd.get_value(n).copy() for n in sd.variables()]
+        xb = rng.normal(size=(8, 4)).astype(np.float32)
+        for _ in range(3):
+            sd.fit_batch({"x": xb})
+        after = [sd.get_value(n) for n in sd.variables()]
+        moved = any(not np.allclose(b, a) for b, a in zip(before, after))
+        assert moved, "promoted variables did not move during fine-tune"
+
+    def test_fused_batchnorm_training_mode_rejected(self):
+        """Training-mode FusedBatchNorm has unpopulated mean/var inputs; the
+        import must fail loudly, not silently mis-normalize (ADVICE.md low)."""
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [None, 4, 4, 2], name="x")
+            scale = tf.constant(np.ones(2, np.float32))
+            offset = tf.constant(np.zeros(2, np.float32))
+            tf1.nn.fused_batch_norm(x, scale, offset, name="bn", is_training=True)
+        with pytest.raises(TFImportError, match="is_training"):
+            import_graph(g.as_graph_def())
+
+    def test_stop_gradient_const_never_promoted(self):
+        """tf.stop_gradient over a frozen weight stays a constant even with
+        trainable=True (the author explicitly froze it)."""
+        w = np.ones((3, 2), np.float32)
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [None, 3], name="x")
+            frozen_w = tf.stop_gradient(tf.constant(w), name="wf")
+            tf.matmul(x, frozen_w, name="out")
+        sd = import_graph(g.as_graph_def(), trainable=True)
+        assert sd.variables() == [], "stop_gradient'd const was promoted"
+
+    def test_single_promotion_per_const(self):
+        """A Const consumed both directly and through Identity must yield ONE
+        trainable variable, not two drifting copies."""
+        w = np.full((2, 2), 3.0, np.float32)
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [None, 2], name="x")
+            wc = tf.constant(w, name="w")
+            rd = tf.identity(wc, name="w/read")
+            a = tf.matmul(x, rd, name="a")
+            tf.add(a, tf.matmul(x, wc), name="out")
+        sd = import_graph(g.as_graph_def(), trainable=True)
+        assert len(sd.variables()) == 1, sd.variables()
+        got = sd.output({"x": np.ones((1, 2), np.float32)}, "out")
+        np.testing.assert_allclose(np.asarray(got), [[12.0, 12.0]])
