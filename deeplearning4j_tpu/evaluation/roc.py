@@ -14,9 +14,12 @@ from __future__ import annotations
 import numpy as np
 
 
+_trapezoid = getattr(np, "trapezoid", None) or np.trapz  # numpy<2 fallback
+
+
 def _auc_trapezoid(x: np.ndarray, y: np.ndarray) -> float:
     order = np.argsort(x, kind="stable")
-    return float(np.trapz(y[order], x[order]))
+    return float(_trapezoid(y[order], x[order]))
 
 
 class ROC:

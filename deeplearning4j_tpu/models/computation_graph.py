@@ -92,6 +92,13 @@ class GraphModel(Model):
         params, state = {}, {}
         for node in self._topo:
             if node.layer is None:
+                if node.vertex.HAS_PARAMS:
+                    itypes = [self._types[i] for i in node.inputs]
+                    p = node.vertex.init(
+                        self._stream.key(f"init/{node.name}"), itypes
+                    )
+                    if p:
+                        params[node.name] = p
                 continue
             itype = self._layer_itype(node)
             p, s = node.layer.init(self._stream.key(f"init/{node.name}"), itype)
@@ -125,6 +132,11 @@ class GraphModel(Model):
                 y, ns = node.layer.apply(lp, ls, x, training=training, rng=lrng)
                 if ns:
                     new_state[node.name] = ns
+            elif node.vertex.HAS_PARAMS:
+                lrng = jax.random.fold_in(rng, i) if rng is not None else None
+                y = node.vertex.apply(
+                    xs, params=params.get(node.name, {}), training=training, rng=lrng
+                )
             else:
                 y = node.vertex.apply(xs)
             acts[node.name] = y
@@ -243,18 +255,20 @@ class GraphModel(Model):
         n_masks = len(masks) if masks is not None else 0
         step = self._get_step_fn(n_masks)
         from deeplearning4j_tpu.parallel.data_parallel import place_batch
+        from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
 
-        self.params, self.opt_state, self.net_state, loss = step(
-            self.params,
-            self.opt_state,
-            self.net_state,
-            jnp.uint32(self.iteration),
-            tuple(place_batch(self, f) for f in mds.features),
-            tuple(place_batch(self, l, is_label=True) for l in mds.labels),
-            tuple(place_batch(self, m, is_mask=True) for m in masks)
-            if masks is not None
-            else (),
-        )
+        with active_mesh_scope(getattr(self, "_mesh", None)):
+            self.params, self.opt_state, self.net_state, loss = step(
+                self.params,
+                self.opt_state,
+                self.net_state,
+                jnp.uint32(self.iteration),
+                tuple(place_batch(self, f) for f in mds.features),
+                tuple(place_batch(self, l, is_label=True) for l in mds.labels),
+                tuple(place_batch(self, m, is_mask=True) for m in masks)
+                if masks is not None
+                else (),
+            )
         self._last_score = loss
         self.last_batch_size = mds.num_examples
         self.iteration += 1
@@ -288,7 +302,10 @@ class GraphModel(Model):
                 f"graph has {len(self.conf.network_inputs)} inputs "
                 f"{self.conf.network_inputs}, got {len(features)} arrays"
             )
-        outs = self._get_infer_fn()(self.params, self.net_state, tuple(features))
+        from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
+
+        with active_mesh_scope(getattr(self, "_mesh", None)):
+            outs = self._get_infer_fn()(self.params, self.net_state, tuple(features))
         return outs if len(outs) > 1 else outs[0]
 
     def predict(self, *features) -> np.ndarray:

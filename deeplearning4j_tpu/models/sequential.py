@@ -230,23 +230,25 @@ class SequentialModel(Model):
 
     def _run_step(self, batch: DataSet, carries):
         from deeplearning4j_tpu.parallel.data_parallel import place_batch
+        from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
 
         has_lmask = batch.labels_mask is not None
         has_fmask = batch.features_mask is not None
         with_carries = carries is not None
         step = self._get_step_fn(has_lmask, has_fmask, with_carries)
         empty = np.zeros((0,), np.float32)
-        self.params, self.opt_state, self.net_state, loss, new_carries = step(
-            self.params,
-            self.opt_state,
-            self.net_state,
-            jnp.uint32(self.iteration),
-            place_batch(self, batch.features),
-            place_batch(self, batch.labels, is_label=True),
-            place_batch(self, batch.labels_mask, is_mask=True) if has_lmask else empty,
-            place_batch(self, batch.features_mask, is_mask=True) if has_fmask else empty,
-            carries if with_carries else {},
-        )
+        with active_mesh_scope(getattr(self, "_mesh", None)):
+            self.params, self.opt_state, self.net_state, loss, new_carries = step(
+                self.params,
+                self.opt_state,
+                self.net_state,
+                jnp.uint32(self.iteration),
+                place_batch(self, batch.features),
+                place_batch(self, batch.labels, is_label=True),
+                place_batch(self, batch.labels_mask, is_mask=True) if has_lmask else empty,
+                place_batch(self, batch.features_mask, is_mask=True) if has_fmask else empty,
+                carries if with_carries else {},
+            )
         self._last_score = loss
         self.last_batch_size = batch.num_examples
         self.iteration += 1
@@ -313,13 +315,16 @@ class SequentialModel(Model):
         `MultiLayerNetwork.output()`)."""
         if self.params is None:
             self.init()
+        from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
+
         has_fmask = features_mask is not None
-        return self._get_infer_fn(has_fmask)(
-            self.params,
-            self.net_state,
-            features,
-            features_mask if has_fmask else np.zeros((0,), np.float32),
-        )
+        with active_mesh_scope(getattr(self, "_mesh", None)):
+            return self._get_infer_fn(has_fmask)(
+                self.params,
+                self.net_state,
+                features,
+                features_mask if has_fmask else np.zeros((0,), np.float32),
+            )
 
     # -- stateful streaming inference (rnnTimeStep role) -------------------
     def _init_carries(self, batch: int) -> dict:

@@ -32,10 +32,21 @@ class ElementWiseOp(str, enum.Enum):
 
 @dataclasses.dataclass(frozen=True)
 class VertexConfig:
-    """Base graph vertex: pure function of its input tensors."""
+    """Base graph vertex: pure function of its input tensors.
+
+    Vertices with HAS_PARAMS=True additionally implement
+    init(key, itypes) -> params and receive `params=` in apply()
+    (the reference's parameterized GraphVertex pattern, e.g.
+    AttentionVertex).
+    """
+
+    HAS_PARAMS = False
 
     def output_type(self, itypes: list[InputType]) -> InputType:
         raise NotImplementedError
+
+    def init(self, key, itypes: list[InputType]) -> dict:
+        return {}
 
     def apply(self, xs: list, **kwargs):
         raise NotImplementedError
@@ -143,6 +154,168 @@ class L2NormalizeVertex(VertexConfig):
         x = xs[0]
         n = jnp.sqrt(jnp.sum(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True))
         return (x / jnp.maximum(n, self.epsilon).astype(x.dtype)).astype(x.dtype)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class StackVertex(VertexConfig):
+    """Stack inputs along the batch axis (reference StackVertex) — the
+    inverse of UnstackVertex; used for shared-weight multi-branch nets."""
+
+    def output_type(self, itypes):
+        first = itypes[0]
+        for t in itypes[1:]:
+            if t.shape != first.shape:
+                raise ValueError(f"StackVertex shape mismatch: {itypes}")
+        return first
+
+    def apply(self, xs, **kwargs):
+        return jnp.concatenate(xs, axis=0)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class UnstackVertex(VertexConfig):
+    """Slice #from of `stack_size` equal batch chunks (reference
+    UnstackVertex)."""
+
+    index: int = 0
+    stack_size: int = 1
+
+    def output_type(self, itypes):
+        if not (0 <= self.index < self.stack_size):
+            raise ValueError(
+                f"UnstackVertex index {self.index} out of range for "
+                f"stack_size {self.stack_size}"
+            )
+        return itypes[0]
+
+    def apply(self, xs, **kwargs):
+        x = xs[0]
+        if x.shape[0] % self.stack_size:
+            raise ValueError(
+                f"UnstackVertex: batch {x.shape[0]} not divisible by "
+                f"stack_size {self.stack_size}"
+            )
+        n = x.shape[0] // self.stack_size
+        return x[self.index * n : (self.index + 1) * n]
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class ReshapeVertex(VertexConfig):
+    """Reshape to a fixed per-example shape (reference ReshapeVertex);
+    -1 wildcards allowed in the trailing position."""
+
+    shape: tuple[int, ...] = ()
+
+    def output_type(self, itypes):
+        t = itypes[0]
+        s = list(self.shape)
+        if sum(1 for d in s if d == -1) > 1:
+            raise ValueError(f"ReshapeVertex: at most one -1 in {self.shape}")
+        if -1 in s:
+            # resolve the wildcard against the known per-example size
+            fixed = 1
+            for d in s:
+                if d != -1:
+                    fixed *= d
+            if t.flat_size % fixed:
+                raise ValueError(
+                    f"ReshapeVertex: cannot reshape {t.flat_size} elements "
+                    f"into {self.shape}"
+                )
+            s[s.index(-1)] = t.flat_size // fixed
+        if len(s) == 1:
+            return InputType.feed_forward(s[0])
+        if len(s) == 2:
+            return InputType.recurrent(s[1], s[0])
+        if len(s) == 3:
+            return InputType.convolutional(s[0], s[1], s[2])
+        raise ValueError(f"ReshapeVertex: unsupported target shape {s}")
+
+    def apply(self, xs, **kwargs):
+        x = xs[0]
+        return x.reshape((x.shape[0],) + tuple(self.shape))
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class AttentionVertex(VertexConfig):
+    """Multi-head dot-product attention over (queries, keys, values) inputs
+    (the reference's AttentionVertex wrapping the
+    multi_head_dot_product_attention op).  1 input => self-attention;
+    2 inputs => (q, kv); 3 inputs => (q, k, v).  Projections Wq/Wk/Wv/Wo
+    when project_input (recommended).  Carries the same seq_parallel knob
+    as SelfAttentionLayer."""
+
+    n_out: int = 0
+    n_heads: int = 1
+    head_size: Optional[int] = None
+    project_input: bool = True
+    causal: bool = False
+    seq_parallel: str = "none"
+    weight_init: Optional[object] = None
+
+    HAS_PARAMS = True
+
+    def _head_size(self) -> int:
+        if self.head_size is not None:
+            return self.head_size
+        if self.n_out % self.n_heads:
+            raise ValueError(
+                f"n_out {self.n_out} not divisible by n_heads {self.n_heads}"
+            )
+        return self.n_out // self.n_heads
+
+    def output_type(self, itypes):
+        tq = itypes[0]
+        if tq.kind != InputType.KIND_RNN:
+            raise ValueError(f"AttentionVertex expects RNN inputs, got {tq}")
+        if not self.project_input and self.n_out != self.n_heads * self._head_size():
+            # without Wo the activation width IS n_heads*head_size
+            raise ValueError(
+                "project_input=False requires n_out == n_heads*head_size "
+                f"({self.n_heads}*{self._head_size()}), got {self.n_out}"
+            )
+        return InputType.recurrent(self.n_out, tq.shape[0])
+
+    def init(self, key, itypes):
+        from deeplearning4j_tpu.nn.conf.attention import init_qkv_params
+        from deeplearning4j_tpu.nn.weights import WeightInit
+
+        tq = itypes[0]
+        tk = itypes[1] if len(itypes) > 1 else tq
+        tv = itypes[2] if len(itypes) > 2 else tk
+        hd = self.n_heads * self._head_size()
+        if not self.project_input:
+            for t in (tq, tk, tv):
+                if t.size != hd:
+                    raise ValueError(
+                        "project_input=False requires every input size == "
+                        f"n_heads*head_size ({hd}), got {t.size}"
+                    )
+            return {}
+        wi = self.weight_init if self.weight_init is not None else WeightInit.XAVIER
+        if not isinstance(wi, WeightInit):
+            wi = WeightInit(wi)
+        return init_qkv_params(key, wi, tq.size, tk.size, tv.size, hd, self.n_out)
+
+    def apply(self, xs, params=None, **kwargs):
+        from deeplearning4j_tpu.nn.conf.attention import apply_qkv_attention
+
+        xq = xs[0]
+        xk = xs[1] if len(xs) > 1 else xq
+        xv = xs[2] if len(xs) > 2 else xk
+        return apply_qkv_attention(
+            params or {}, xq, xk, xv,
+            n_heads=self.n_heads,
+            head_size=self._head_size(),
+            project_input=self.project_input,
+            causal=self.causal,
+            mask=None,
+            seq_parallel=self.seq_parallel,
+        )
 
 
 @serde.register

@@ -71,6 +71,7 @@ def ring_attention(
     axis: str,
     causal: bool = False,
     mask: jax.Array | None = None,
+    block_size: int | None = 512,
 ) -> jax.Array:
     """Exact attention with KV rotating around the `axis` ring.
 
@@ -78,27 +79,43 @@ def ring_attention(
     q,k,v are the LOCAL (B, T_local, H, D) shards.  Returns the local
     output shard.  mask: local (B, T_local) keep-mask over this shard's
     keys (rotates with KV).
+
+    Blockwise + scan-based: the ring walk is a `lax.scan` over the mesh
+    axis (program size independent of mesh size), and within each held KV
+    shard the logits are materialized one `block_size` chunk at a time via
+    an inner scan — peak logits memory is O(B*H*T_local*block) instead of
+    O(B*H*T_local*T_local).  block_size=None disables inner chunking.
     """
     n = lax.axis_size(axis)
     idx = lax.axis_index(axis)
-    t_local = q.shape[1]
-    d = q.shape[-1]
+    b, t_local, h, d = q.shape
     scale = _scale(d)
 
     q32 = q.astype(jnp.float32)
-    q_off = idx * t_local
+    qi = jnp.arange(t_local) + idx * t_local  # global query positions
 
-    def block(carry, kv_and_src):
+    # inner KV chunk: largest divisor of t_local <= block_size
+    if block_size is None or block_size >= t_local:
+        bs = t_local
+    else:
+        bs = max(s for s in range(1, block_size + 1) if t_local % s == 0)
+    n_blocks = t_local // bs
+
+    has_mask = mask is not None
+    mb0 = mask.astype(jnp.float32) if has_mask else jnp.ones((b, t_local), jnp.float32)
+
+    def process_block(carry, blk):
+        """Online-softmax update (running rowmax m, normalizer l, weighted
+        values o) for one (B, bs, H, D) KV chunk at global key offset k0."""
         o, m, l = carry
-        kb, vb, src, mb = kv_and_src
+        kb, vb, mbk, k0 = blk
         logits = jnp.einsum("bqhd,bkhd->bhqk", q32, kb.astype(jnp.float32)) * scale
         if causal:
-            qi = jnp.arange(t_local) + q_off
-            ki = jnp.arange(t_local) + src * t_local
+            ki = jnp.arange(kb.shape[1]) + k0
             cmask = qi[:, None] >= ki[None, :]
             logits = jnp.where(cmask[None, None], logits, -jnp.inf)
-        if mb is not None:
-            logits = jnp.where(mb[:, None, None, :] > 0, logits, -jnp.inf)
+        if has_mask:
+            logits = jnp.where(mbk[:, None, None, :] > 0, logits, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
         # guard: rows with no unmasked key yet keep m=-inf; exp(-inf - -inf)
         safe_m = jnp.where(jnp.isneginf(m_new), 0.0, m_new)
@@ -111,25 +128,39 @@ def ring_attention(
         )
         return (o_new, m_new, l_new), None
 
-    b, h = q.shape[0], q.shape[2]
-    o = jnp.zeros((b, h, t_local, d), jnp.float32)
-    m = jnp.full((b, h, t_local), -jnp.inf, jnp.float32)
-    l = jnp.zeros((b, h, t_local), jnp.float32)
-
     perm = [(i, (i + 1) % n) for i in range(n)]
-    kb, vb = k, v
-    src = idx
-    mb = mask
-    carry = (o, m, l)
-    # n steps: process local block, then rotate KV (and its mask/source id)
-    for _ in range(n):
-        carry, _ = block(carry, (kb, vb, src, mb))
+
+    def ring_step(carry, j):
+        o, m, l, kb, vb, mbk = carry
+        src = (idx - j) % n  # rank whose KV shard we currently hold
+        k_base = src * t_local
+        if n_blocks == 1:
+            (o, m, l), _ = process_block((o, m, l), (kb, vb, mbk, k_base))
+        else:
+            kc = jnp.moveaxis(kb.reshape(b, n_blocks, bs, h, d), 1, 0)
+            vc = jnp.moveaxis(vb.reshape(b, n_blocks, bs, h, d), 1, 0)
+            mc = jnp.moveaxis(mbk.reshape(b, n_blocks, bs), 1, 0)
+            offs = k_base + jnp.arange(n_blocks) * bs
+            (o, m, l), _ = lax.scan(process_block, (o, m, l), (kc, vc, mc, offs))
+        # rotate KV (and its mask) to the next rank for the following step
         kb = lax.ppermute(kb, axis, perm)
         vb = lax.ppermute(vb, axis, perm)
-        if mb is not None:
-            mb = lax.ppermute(mb, axis, perm)
-        src = lax.ppermute(src, axis, perm)
-    o, m, l = carry
+        if has_mask:
+            mbk = lax.ppermute(mbk, axis, perm)
+        return (o, m, l, kb, vb, mbk), None
+
+    # the accumulators depend on this rank's q, so they VARY over the manual
+    # axis — scan requires carry in/out types (incl. vma) to match
+    if hasattr(lax, "pcast"):
+        _vary = lambda x: lax.pcast(x, (axis,), to="varying")
+    else:  # older jax
+        _vary = lambda x: lax.pvary(x, (axis,))
+    o0 = _vary(jnp.zeros((b, h, t_local, d), jnp.float32))
+    m0 = _vary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32))
+    l0 = _vary(jnp.zeros((b, h, t_local), jnp.float32))
+    (o, m, l, _, _, _), _ = lax.scan(
+        ring_step, (o0, m0, l0, k, v, mb0), jnp.arange(n)
+    )
     l = jnp.maximum(l, 1e-20)
     out = (o / l[..., None]).astype(q.dtype)
     return jnp.einsum("bhqd->bqhd", out)

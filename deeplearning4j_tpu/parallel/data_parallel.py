@@ -50,6 +50,12 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
 
     sp = SEQ_AXIS if SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1 else None
     model._mesh = mesh
+    # drop any step functions compiled before distribution: mesh-dependent
+    # layer lowerings (seq-parallel attention) and shardings are baked in
+    # at trace time
+    model._step_fns.clear()
+    if hasattr(model, "_infer_fn"):
+        model._infer_fn = None
     model._batch_sharding = batch_sharding(mesh, seq_axis=sp)
     # labels/masks may lack the time axis (seq-to-one): shard batch dim only
     # and let GSPMD reshard per-timestep labels if profitable

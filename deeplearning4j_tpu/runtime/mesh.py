@@ -105,3 +105,37 @@ def virtual_cpu_devices(n: int) -> str:
 def single_device_mesh(axis: str = DATA_AXIS) -> Mesh:
     """1-device mesh so sharded code paths run unchanged on one chip."""
     return Mesh(np.asarray(jax.devices()[:1], dtype=object).reshape((1,)), (axis,))
+
+
+# -- active-mesh context ----------------------------------------------------
+# Layer `apply()` functions are traced deep inside a model's jitted step and
+# have a fixed signature; layers whose lowering depends on the mesh (e.g.
+# SelfAttentionLayer with seq_parallel="ring" wrapping its core in shard_map)
+# read the mesh from this trace-time context, which the models set around
+# their compiled-step invocations (distribute() stores the mesh on the model).
+
+_ACTIVE_MESH: Mesh | None = None
+
+
+class active_mesh_scope:
+    """Context manager installing `mesh` as the active mesh for layer
+    tracing.  Reentrant; None is a valid (no-mesh) value."""
+
+    def __init__(self, mesh: Mesh | None):
+        self._mesh = mesh
+        self._prev: Mesh | None = None
+
+    def __enter__(self):
+        global _ACTIVE_MESH
+        self._prev = _ACTIVE_MESH
+        _ACTIVE_MESH = self._mesh
+        return self._mesh
+
+    def __exit__(self, *exc):
+        global _ACTIVE_MESH
+        _ACTIVE_MESH = self._prev
+        return False
+
+
+def active_mesh() -> Mesh | None:
+    return _ACTIVE_MESH

@@ -8,6 +8,8 @@ from deeplearning4j_tpu.zoo.lenet import LeNet
 from deeplearning4j_tpu.zoo.resnet import ResNet50
 from deeplearning4j_tpu.zoo.simplecnn import SimpleCNN
 from deeplearning4j_tpu.zoo.squeezenet import SqueezeNet
+from deeplearning4j_tpu.zoo.textgen import TextGenerationLSTM
+from deeplearning4j_tpu.zoo.transformer import TransformerEncoder
 from deeplearning4j_tpu.zoo.unet import UNet
 from deeplearning4j_tpu.zoo.vgg import VGG16, VGG19
 from deeplearning4j_tpu.zoo.xception import Xception
@@ -15,6 +17,7 @@ from deeplearning4j_tpu.zoo.yolo import YOLO2, TinyYOLO
 
 __all__ = [
     "ZooModel", "AlexNet", "Darknet19", "InceptionResNetV1", "LeNet",
-    "ResNet50", "SimpleCNN", "SqueezeNet", "UNet", "VGG16", "VGG19",
-    "Xception", "TinyYOLO", "YOLO2",
+    "ResNet50", "SimpleCNN", "SqueezeNet", "TextGenerationLSTM",
+    "TransformerEncoder", "UNet", "VGG16", "VGG19", "Xception", "TinyYOLO",
+    "YOLO2",
 ]

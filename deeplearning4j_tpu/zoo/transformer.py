@@ -1,0 +1,86 @@
+"""TransformerEncoder — a DSL-built transformer for the zoo.
+
+The reference has no transformer zoo entry; its attention surface stops at
+`SelfAttentionLayer`/`AttentionVertex` configs (SURVEY.md §5.7).  This model
+makes the TPU build's long-context story concrete: a decoder-style causal LM
+(token embedding + positions + N pre-LN encoder blocks + per-token softmax)
+whose attention blocks carry the `seq_parallel` knob — the SAME config runs
+dense on one chip or ring/Ulysses-sharded over a "seq" mesh axis via
+`distribute(model, ParallelConfig(seq=k))`.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    Embedding,
+    InputType,
+    NeuralNetConfiguration,
+    RnnOutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.attention import (
+    PositionalEncoding,
+    TransformerEncoderBlock,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.updaters import Adam
+from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.zoo.zoo_model import ZooModel
+
+
+class TransformerEncoder(ZooModel):
+    NAME = "transformer_encoder"
+
+    def __init__(
+        self,
+        vocab_size: int = 1000,
+        d_model: int = 128,
+        n_heads: int = 4,
+        n_layers: int = 2,
+        d_ff: int = 0,
+        causal: bool = True,
+        seq_parallel: str = "none",
+        seed: int = 123,
+        learning_rate: float = 3e-4,
+    ):
+        super().__init__(vocab_size, seed)
+        self.vocab_size = vocab_size
+        self.d_model = d_model
+        self.n_heads = n_heads
+        self.n_layers = n_layers
+        self.d_ff = d_ff
+        self.causal = causal
+        self.seq_parallel = seq_parallel
+        self.learning_rate = learning_rate
+
+    def conf(self):
+        b = (
+            NeuralNetConfiguration.builder()
+            .seed(self.seed)
+            .updater(Adam(self.learning_rate))
+            .weight_init(WeightInit.XAVIER)
+            .list()
+            .layer(Embedding(n_in=self.vocab_size, n_out=self.d_model))
+            .layer(PositionalEncoding())
+        )
+        for _ in range(self.n_layers):
+            b.layer(
+                TransformerEncoderBlock(
+                    d_model=self.d_model,
+                    n_heads=self.n_heads,
+                    d_ff=self.d_ff,
+                    causal=self.causal,
+                    seq_parallel=self.seq_parallel,
+                )
+            )
+        return (
+            b.layer(
+                RnnOutputLayer(
+                    n_out=self.vocab_size,
+                    loss=Loss.MCXENT,
+                    activation=Activation.SOFTMAX,
+                )
+            )
+            .set_input_type(InputType.recurrent(1))
+            .build()
+        )
