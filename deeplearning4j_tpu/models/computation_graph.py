@@ -145,7 +145,12 @@ class GraphModel(Model):
     def _reg_loss(self, params):
         return regularization_loss(
             params,
-            [(n.name, n.layer) for n in self.conf.nodes if n.layer is not None],
+            [(n.name, n.layer) for n in self.conf.nodes if n.layer is not None]
+            + [
+                (n.name, n.vertex)
+                for n in self.conf.nodes
+                if n.layer is None and n.vertex.HAS_PARAMS
+            ],
         )
 
     # -- compiled train step ----------------------------------------------

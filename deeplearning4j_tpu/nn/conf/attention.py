@@ -98,6 +98,17 @@ def _attend(q, k, v, *, causal: bool, mask, seq_parallel: str):
     )(q, k, v)
 
 
+def resolve_head_size(n_out: int, n_heads: int, head_size) -> int:
+    """Explicit head_size wins; otherwise n_out must split evenly over
+    heads.  Shared by SelfAttentionLayer / LearnedSelfAttentionLayer /
+    AttentionVertex so head-size semantics can't drift between them."""
+    if head_size is not None:
+        return head_size
+    if n_out % n_heads:
+        raise ValueError(f"n_out {n_out} not divisible by n_heads {n_heads}")
+    return n_out // n_heads
+
+
 def init_qkv_params(key, wi: WeightInit, n_in_q: int, n_in_k: int, n_in_v: int,
                     hd: int, n_out: int) -> dict:
     """Wq/Wk/Wv projections into n_heads*head_size (=hd) + Wo back out —
@@ -157,13 +168,7 @@ class SelfAttentionLayer(LayerConfig):
     REGULARIZED = ("Wq", "Wk", "Wv", "Wo")
 
     def _head_size(self) -> int:
-        if self.head_size is not None:
-            return self.head_size
-        if self.n_out % self.n_heads:
-            raise ValueError(
-                f"n_out {self.n_out} not divisible by n_heads {self.n_heads}"
-            )
-        return self.n_out // self.n_heads
+        return resolve_head_size(self.n_out, self.n_heads, self.head_size)
 
     def output_type(self, itype: InputType) -> InputType:
         if not self.project_input and itype.size != self.n_out:
@@ -220,13 +225,7 @@ class LearnedSelfAttentionLayer(LayerConfig):
     REGULARIZED = ("Wk", "Wv", "Wo", "Q")
 
     def _head_size(self) -> int:
-        if self.head_size is not None:
-            return self.head_size
-        if self.n_out % self.n_heads:
-            raise ValueError(
-                f"n_out {self.n_out} not divisible by n_heads {self.n_heads}"
-            )
-        return self.n_out // self.n_heads
+        return resolve_head_size(self.n_out, self.n_heads, self.head_size)
 
     def output_type(self, itype: InputType) -> InputType:
         return InputType.recurrent(self.n_out, self.n_queries)

@@ -41,6 +41,7 @@ class VertexConfig:
     """
 
     HAS_PARAMS = False
+    REGULARIZED = ()      # class attr, not a field (stays out of serde)
 
     def output_type(self, itypes: list[InputType]) -> InputType:
         raise NotImplementedError
@@ -50,6 +51,15 @@ class VertexConfig:
 
     def apply(self, xs: list, **kwargs):
         raise NotImplementedError
+
+    def regularization_terms(self, lp: dict) -> list:
+        """(l1, l2, array) triples — parameterized vertices participate in
+        the net's l1/l2 penalty exactly like layers do."""
+        l1 = getattr(self, "l1", None) or 0.0
+        l2 = getattr(self, "l2", None) or 0.0
+        if not l1 and not l2:
+            return []
+        return [(l1, l2, lp[p]) for p in self.REGULARIZED if p in lp]
 
 
 @serde.register
@@ -256,17 +266,16 @@ class AttentionVertex(VertexConfig):
     causal: bool = False
     seq_parallel: str = "none"
     weight_init: Optional[object] = None
+    l1: Optional[float] = None
+    l2: Optional[float] = None
 
     HAS_PARAMS = True
+    REGULARIZED = ("Wq", "Wk", "Wv", "Wo")
 
     def _head_size(self) -> int:
-        if self.head_size is not None:
-            return self.head_size
-        if self.n_out % self.n_heads:
-            raise ValueError(
-                f"n_out {self.n_out} not divisible by n_heads {self.n_heads}"
-            )
-        return self.n_out // self.n_heads
+        from deeplearning4j_tpu.nn.conf.attention import resolve_head_size
+
+        return resolve_head_size(self.n_out, self.n_heads, self.head_size)
 
     def output_type(self, itypes):
         tq = itypes[0]
@@ -471,6 +480,18 @@ class GraphBuilder:
         return self
 
     def add_vertex(self, name: str, vertex: VertexConfig, *inputs: str):
+        # global l1/l2 defaults flow into parameterized vertices exactly as
+        # into layers (an AttentionVertex must not silently dodge the
+        # net-wide penalty)
+        if vertex.HAS_PARAMS:
+            updates = {}
+            fields = {f.name for f in dataclasses.fields(vertex)}
+            if "l1" in fields and vertex.l1 is None and self._l1 is not None:
+                updates["l1"] = self._l1
+            if "l2" in fields and vertex.l2 is None and self._l2 is not None:
+                updates["l2"] = self._l2
+            if updates:
+                vertex = dataclasses.replace(vertex, **updates)
         self._nodes.append(GraphNode(name=name, inputs=tuple(inputs), vertex=vertex))
         return self
 

@@ -71,4 +71,8 @@ def place_batch(model, arr, is_mask: bool = False, is_label: bool = False):
         return arr
     if is_mask or is_label:
         sharding = getattr(model, "_label_sharding", sharding)
-    return jax.device_put(arr, sharding)
+    from deeplearning4j_tpu.runtime.distributed import put_global
+
+    # multi-process: each host feeds its LOCAL batch shard (per-host input
+    # pipelines over disjoint data — the RDD-partition role)
+    return put_global(arr, sharding)

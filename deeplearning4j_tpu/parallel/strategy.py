@@ -117,15 +117,22 @@ def param_specs(params, conf, model_axis: str = MODEL_AXIS):
 
 
 def shard_params(params, mesh: Mesh, specs) -> object:
-    """device_put params according to specs (replicate anything unspecced)."""
+    """Place params according to specs (replicate anything unspecced).
+    Multi-process meshes stitch global arrays from identical host copies."""
+    from deeplearning4j_tpu.runtime.distributed import put_global
+
     def place(p, s):
-        return jax.device_put(p, NamedSharding(mesh, s))
+        return put_global(p, NamedSharding(mesh, s), full_value=True)
 
     return jax.tree.map(place, params, specs)
 
 
 def replicate(tree, mesh: Mesh):
-    return jax.device_put(tree, NamedSharding(mesh, P()))
+    from deeplearning4j_tpu.runtime.distributed import put_global
+
+    return jax.tree.map(
+        lambda p: put_global(p, NamedSharding(mesh, P()), full_value=True), tree
+    )
 
 
 def batch_sharding(mesh: Mesh, data_axis: str = DATA_AXIS, seq_axis: str | None = None):

@@ -13,11 +13,19 @@ from deeplearning4j_tpu.runtime.backend import (
     devices,
     platform,
 )
+from deeplearning4j_tpu.runtime.coordinator import (
+    CoordinatorClient,
+    CoordinatorServer,
+)
+from deeplearning4j_tpu.runtime.distributed import DistributedConfig
 from deeplearning4j_tpu.runtime.flags import Environment, environment
 from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh, virtual_cpu_devices
 from deeplearning4j_tpu.runtime.rng import SeedStream
 
 __all__ = [
+    "CoordinatorClient",
+    "CoordinatorServer",
+    "DistributedConfig",
     "Backend",
     "backend",
     "device_count",

@@ -1,0 +1,277 @@
+"""Cluster control plane — membership, heartbeats, elastic restart.
+
+Reference role: the Spark driver + `MeshOrganizer`/`ModelParameterServer`
+pair owns cluster membership: executors handshake in, heartbeats detect
+loss, and the fan-out tree is remodelled on join/leave (SURVEY.md §3.5,
+§5.3).  On TPU the data plane (jax.distributed / GSPMD collectives —
+`runtime.distributed`) fails whole-slice on any host loss, so the
+TPU-native control plane's job is different in mechanism, identical in
+capability: notice the loss fast, tear the generation down, and restart the
+surviving world from the latest checkpoint.
+
+Design: one `CoordinatorServer` (tiny JSON-lines-over-TCP service, stdlib
+only — the gRPC-shaped role without a codegen dependency) plus a
+`CoordinatorClient` per worker process:
+
+  register(worker)   -> blocks until `expected` workers joined, returns
+                        (generation, rank, world) — the membership barrier
+                        that assigns jax.distributed process ids
+  heartbeat(worker)  -> {generation, abort}; abort flips when any member
+                        is evicted (missed heartbeats) or calls fail()
+  report_ckpt(...)   -> single-writer checkpoint registry; survivors learn
+                        the restore point for the next generation
+  set_expected(n)    -> supervisor shrinks/grows the next generation
+
+Worker processes exit on abort (JAX's fail-the-world model); a supervisor
+(`train.elastic.ElasticSupervisor`) respawns the new world.  The
+kill-a-worker pytest in tests/test_distributed.py is the fault-injection
+analog of the reference's dummy/delayed-transport tests (SURVEY.md §4.2).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import socketserver
+import threading
+import time
+from typing import Any, Optional
+
+
+def _free_port(host: str = "127.0.0.1") -> int:
+    """OS-assigned free TCP port (close-then-reuse; fine for local fleets)."""
+    with socket.socket() as s:
+        s.bind((host, 0))
+        return s.getsockname()[1]
+
+
+def _send_json(sock: socket.socket, obj: dict) -> None:
+    sock.sendall((json.dumps(obj) + "\n").encode())
+
+
+def _recv_json(f) -> Optional[dict]:
+    line = f.readline()
+    if not line:
+        return None
+    return json.loads(line)
+
+
+class CoordinatorServer:
+    """Membership + heartbeat + checkpoint-registry service."""
+
+    def __init__(self, expected_workers: int, heartbeat_timeout: float = 10.0,
+                 host: str = "127.0.0.1", port: int = 0):
+        self._lock = threading.Condition()
+        self.expected = expected_workers
+        self.heartbeat_timeout = heartbeat_timeout
+        # generation state
+        self.generation = 0
+        self.members: dict[str, dict[str, Any]] = {}   # id -> {rank, last_hb}
+        self.abort = False
+        self.pending: dict[str, dict[str, Any]] = {}   # joiners for next gen
+        # checkpoint registry: latest wins
+        self.latest_ckpt: Optional[dict[str, Any]] = None
+        self.history: list[dict[str, Any]] = []
+        self._host = host
+        self.jax_coordinator: Optional[str] = None
+        # eviction ledger: who actually failed, per generation (the signal
+        # the supervisor shrinks on — collateral aborts of healthy peers,
+        # which JAX's own coordination service causes by design, are not
+        # evictions)
+        self.evictions: list[dict[str, Any]] = []
+
+        outer = self
+
+        class Handler(socketserver.StreamRequestHandler):
+            def handle(self):
+                try:
+                    req = _recv_json(self.rfile)
+                    if req is None:
+                        return
+                    resp = outer._dispatch(req)
+                    _send_json(self.request, resp)
+                except (ConnectionError, json.JSONDecodeError):
+                    pass
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self._server = Server((host, port), Handler)
+        self.address = f"{host}:{self._server.server_address[1]}"
+        self._threads = [
+            threading.Thread(target=self._server.serve_forever, daemon=True),
+            threading.Thread(target=self._monitor, daemon=True),
+        ]
+        self._stopped = False
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "CoordinatorServer":
+        for t in self._threads:
+            t.start()
+        return self
+
+    def stop(self) -> None:
+        self._stopped = True
+        self._server.shutdown()
+        self._server.server_close()
+
+    # -- request dispatch --------------------------------------------------
+    def _dispatch(self, req: dict) -> dict:
+        op = req.get("op")
+        if op == "register":
+            return self._register(req["worker"], req.get("info") or {})
+        with self._lock:
+            if op == "heartbeat":
+                return self._heartbeat(req["worker"], req.get("step"))
+            if op == "report_ckpt":
+                entry = {"step": int(req["step"]), "path": req["path"],
+                         "generation": self.generation,
+                         "time": time.time()}
+                self.latest_ckpt = entry
+                self.history.append(entry)
+                return {"ok": True}
+            if op == "latest_ckpt":
+                return {"ok": True, "ckpt": self.latest_ckpt}
+            if op == "fail":
+                self._evict(req["worker"], reason=req.get("reason", "fail()"))
+                return {"ok": True}
+            if op == "leave":
+                self.members.pop(req["worker"], None)
+                return {"ok": True}
+            if op == "set_expected":
+                self.expected = int(req["n"])
+                self._lock.notify_all()
+                return {"ok": True}
+            if op == "status":
+                return {
+                    "ok": True,
+                    "generation": self.generation,
+                    "abort": self.abort,
+                    "members": sorted(self.members),
+                    "expected": self.expected,
+                    "ckpt": self.latest_ckpt,
+                    "evictions": list(self.evictions),
+                }
+        return {"ok": False, "error": f"unknown op {op!r}"}
+
+    # -- membership --------------------------------------------------------
+    def _register(self, worker: str, info: dict) -> dict:
+        """Membership barrier: blocks until `expected` workers are pending,
+        then seals a new generation and assigns dense ranks."""
+        with self._lock:
+            self.pending[worker] = {"info": info, "time": time.time()}
+            if len(self.pending) >= self.expected:
+                # seal: pending becomes the new generation's membership
+                self.generation += 1
+                self.abort = False
+                # a fresh jax.distributed coordination-service port per
+                # generation (the data-plane runtime cannot be rejoined on
+                # a stale port after an abort)
+                self.jax_coordinator = f"{self._host}:{_free_port(self._host)}"
+                now = time.time()
+                self.members = {}
+                for rank, wid in enumerate(sorted(self.pending)):
+                    self.members[wid] = {"rank": rank, "last_hb": now,
+                                         "info": self.pending[wid]["info"]}
+                self.pending = {}
+                self._lock.notify_all()
+            else:
+                # wait until a seal consumes our pending entry
+                deadline = time.time() + 120.0
+                while worker in self.pending:
+                    remaining = deadline - time.time()
+                    if remaining <= 0:
+                        self.pending.pop(worker, None)
+                        return {"ok": False, "error": "registration timeout"}
+                    self._lock.wait(timeout=min(remaining, 1.0))
+            if worker not in self.members:
+                return {"ok": False, "error": "evicted during registration"}
+            return {
+                "ok": True,
+                "generation": self.generation,
+                "rank": self.members[worker]["rank"],
+                "world": len(self.members),
+                "members": sorted(self.members),
+                "jax_coordinator": self.jax_coordinator,
+                "ckpt": self.latest_ckpt,
+            }
+
+    def _heartbeat(self, worker: str, step) -> dict:
+        m = self.members.get(worker)
+        if m is None:
+            return {"ok": True, "generation": self.generation, "abort": True,
+                    "evicted": True}
+        m["last_hb"] = time.time()
+        if step is not None:
+            m["step"] = step
+        return {"ok": True, "generation": self.generation, "abort": self.abort}
+
+    def _evict(self, worker: str, reason: str) -> None:
+        if worker in self.members:
+            del self.members[worker]
+            self.abort = True
+            self.evictions.append(
+                {"generation": self.generation, "worker": worker,
+                 "reason": reason, "time": time.time()}
+            )
+            self._lock.notify_all()
+
+    def _monitor(self) -> None:
+        while not self._stopped:
+            time.sleep(min(self.heartbeat_timeout / 4, 0.5))
+            now = time.time()
+            with self._lock:
+                dead = [
+                    wid for wid, m in self.members.items()
+                    if now - m["last_hb"] > self.heartbeat_timeout
+                ]
+                for wid in dead:
+                    self._evict(wid, reason="heartbeat timeout")
+
+
+class CoordinatorClient:
+    """Worker-side stub. Every call is one short-lived TCP round trip —
+    no long-lived connection to leak across fork/exec."""
+
+    def __init__(self, address: str, worker_id: str, timeout: float = 130.0):
+        host, port = address.rsplit(":", 1)
+        self._addr = (host, int(port))
+        self.worker_id = worker_id
+        self.timeout = timeout
+
+    def _rpc(self, obj: dict) -> dict:
+        with socket.create_connection(self._addr, timeout=self.timeout) as s:
+            _send_json(s, obj)
+            resp = _recv_json(s.makefile("r"))
+        if resp is None:
+            raise ConnectionError("coordinator closed connection")
+        return resp
+
+    def register(self, info: dict | None = None) -> dict:
+        r = self._rpc({"op": "register", "worker": self.worker_id, "info": info})
+        if not r.get("ok"):
+            raise RuntimeError(f"register failed: {r.get('error')}")
+        return r
+
+    def heartbeat(self, step: int | None = None) -> dict:
+        return self._rpc({"op": "heartbeat", "worker": self.worker_id, "step": step})
+
+    def report_ckpt(self, step: int, path: str) -> None:
+        self._rpc({"op": "report_ckpt", "worker": self.worker_id,
+                   "step": step, "path": path})
+
+    def latest_ckpt(self) -> Optional[dict]:
+        return self._rpc({"op": "latest_ckpt", "worker": self.worker_id}).get("ckpt")
+
+    def fail(self, reason: str = "") -> None:
+        self._rpc({"op": "fail", "worker": self.worker_id, "reason": reason})
+
+    def leave(self) -> None:
+        self._rpc({"op": "leave", "worker": self.worker_id})
+
+    def status(self) -> dict:
+        return self._rpc({"op": "status", "worker": self.worker_id})
+
+    def set_expected(self, n: int) -> None:
+        self._rpc({"op": "set_expected", "worker": self.worker_id, "n": n})

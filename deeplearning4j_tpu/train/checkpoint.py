@@ -29,9 +29,13 @@ FORMAT_VERSION = 1
 
 
 def _save_npz_pytree(zf: zipfile.ZipFile, name: str, tree) -> None:
+    from deeplearning4j_tpu.runtime.distributed import fetch_global
+
     leaves = jax.tree.leaves(tree)
     buf = io.BytesIO()
-    np.savez(buf, *[np.asarray(x) for x in leaves])
+    # fetch_global: multi-host-sharded leaves are allgathered before the
+    # single-writer save (plain np.asarray for everything addressable)
+    np.savez(buf, *[fetch_global(x) for x in leaves])
     zf.writestr(name, buf.getvalue())
 
 
@@ -51,6 +55,25 @@ def _load_npz_into(zf: zipfile.ZipFile, name: str, tree):
 
 
 class ModelSerializer:
+    @staticmethod
+    def write_model_distributed(model, path: str, save_updater: bool = True) -> None:
+        """Checkpoint in a multi-host world: EVERY process must call this
+        (fetch_global on cross-host-sharded leaves is a collective
+        allgather), but only the chief writes the file.  A chief-only
+        write_model would wedge rank 0 in the allgather while the other
+        ranks run ahead — mismatched collectives hang the slice."""
+        from deeplearning4j_tpu.runtime import distributed
+
+        if distributed.is_chief():
+            ModelSerializer.write_model(model, path, save_updater)
+        else:
+            # participate in the same fetch collectives, discard the bytes
+            for tree in (model.params, model.net_state,
+                         model.opt_state if save_updater else None):
+                if tree is not None:
+                    for leaf in jax.tree.leaves(tree):
+                        distributed.fetch_global(leaf)
+
     @staticmethod
     def write_model(model, path: str, save_updater: bool = True) -> None:
         if model.params is None:

@@ -1,0 +1,254 @@
+"""Elastic multi-host training — abort-and-restore-from-checkpoint.
+
+Reference role: Spark task retry + `MeshOrganizer` tree-remodel on node
+loss (SURVEY.md §5.3).  JAX's data plane fails whole-slice on any host
+loss, so the TPU-native shape is: detect fast (coordinator heartbeats),
+tear the generation down (every surviving worker exits with
+EXIT_MEMBERSHIP_CHANGED), respawn the surviving world, restore from the
+latest checkpoint, continue.  Three pieces:
+
+  ElasticWorkerLoop — runs inside each worker process: register -> bring up
+      jax.distributed with the assigned (rank, world) -> restore latest
+      checkpoint -> distribute -> step loop with heartbeats + single-writer
+      rolling checkpoints.
+  ElasticSupervisor — babysits a fleet of worker subprocesses (the role a
+      per-host agent/k8s plays in production; in tests it is also the fault
+      injector): respawns a shrunken world after a failure, up to min_world.
+  run_elastic_worker() — glue the worker script calls.
+
+Worker processes must be FRESH processes per generation (JAX backends
+cannot re-form a distributed world in-process after an abort) — exactly
+the fail-the-world model the supervisor exists to absorb.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Callable, Optional
+
+EXIT_MEMBERSHIP_CHANGED = 23
+
+
+class _HeartbeatThread(threading.Thread):
+    """Background control-plane heartbeat.
+
+    Runs OFF the training loop so a worker blocked in a collective (its
+    peer died mid-step) still reads as alive to the coordinator — only
+    processes that are actually gone get evicted.  The training loop polls
+    `aborted` between steps.
+    """
+
+    def __init__(self, client, generation: int, interval: float):
+        super().__init__(daemon=True)
+        self.client = client
+        self.generation = generation
+        self.interval = interval
+        self.aborted = threading.Event()
+        self._stop = threading.Event()
+        self.step = 0
+
+    def run(self):
+        while not self._stop.wait(self.interval):
+            try:
+                hb = self.client.heartbeat(step=self.step)
+            except Exception:
+                continue                     # coordinator briefly unreachable
+            if hb.get("abort") or hb.get("evicted") or (
+                hb.get("generation") != self.generation
+            ):
+                self.aborted.set()
+                # KEEP heartbeating: the main thread may be wedged in a
+                # collective whose peer died; going silent here would get
+                # this (alive) worker spuriously evicted too, shrinking the
+                # next generation below the real survivor count
+                if hb.get("evicted"):
+                    return                   # membership already gone
+
+    def stop(self):
+        self._stop.set()
+
+
+class ElasticWorkerLoop:
+    """The in-worker training driver.
+
+    build_model(): -> initialized (un-distributed) model; called only when
+        no checkpoint exists yet.
+    batch_fn(step, rank, world): -> DataSet — this process's LOCAL shard of
+        global step `step` (per-host input pipelines over disjoint data).
+    """
+
+    def __init__(
+        self,
+        client,                      # runtime.coordinator.CoordinatorClient
+        ckpt_dir: str,
+        save_every: int = 5,
+        heartbeat_every: float = 1.0,   # background heartbeat interval, seconds
+        local_device_count: Optional[int] = None,
+        platform: Optional[str] = None,
+        parallel_config=None,
+        jax_heartbeat_timeout_seconds: Optional[int] = None,
+    ):
+        self.client = client
+        self.ckpt_dir = ckpt_dir
+        self.save_every = save_every
+        self.heartbeat_every = heartbeat_every
+        self.local_device_count = local_device_count
+        self.platform = platform
+        self.parallel_config = parallel_config
+        self.jax_heartbeat_timeout_seconds = jax_heartbeat_timeout_seconds
+
+    def _ckpt_path(self, step: int) -> str:
+        return os.path.join(self.ckpt_dir, f"ckpt_{step:08d}.zip")
+
+    def run(
+        self,
+        build_model: Callable[[], object],
+        batch_fn: Callable[[int, int, int], object],
+        total_steps: int,
+        on_step: Optional[Callable[[object, int], None]] = None,
+    ):
+        from deeplearning4j_tpu.parallel import ParallelConfig, distribute
+        from deeplearning4j_tpu.runtime import distributed
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+        reg = self.client.register()
+        self.last_registration = reg
+        rank, world = reg["rank"], reg["world"]
+        generation = reg["generation"]
+
+        distributed.initialize(
+            distributed.DistributedConfig(
+                coordinator_address=reg["jax_coordinator"],
+                num_processes=world,
+                process_id=rank,
+                local_device_count=self.local_device_count,
+                platform=self.platform,
+                heartbeat_timeout_seconds=self.jax_heartbeat_timeout_seconds,
+            )
+        )
+
+        ckpt = reg.get("ckpt") or self.client.latest_ckpt()
+        if ckpt and os.path.exists(ckpt["path"]):
+            model = ModelSerializer.restore(ckpt["path"])
+        else:
+            model = build_model()
+        distribute(model, self.parallel_config or ParallelConfig.data_parallel())
+
+        hb_interval = max(0.2, min(2.0, self.heartbeat_every))
+        hb = _HeartbeatThread(self.client, generation, hb_interval)
+        hb.start()
+
+        start = model.iteration
+        for step in range(start, total_steps):
+            model.fit_batch(batch_fn(step, rank, world))
+            hb.step = step + 1
+            if on_step is not None:
+                on_step(model, step)
+            if hb.aborted.is_set():
+                # membership changed: this generation is dead.  Leave
+                # voluntarily (so the monitor can't post a spurious
+                # eviction for us) and exit WITHOUT atexit handlers —
+                # jax.distributed's shutdown barrier would hang on the
+                # dead peer.  The supervisor respawns the new world.
+                try:
+                    self.client.leave()
+                except Exception:
+                    pass
+                os._exit(EXIT_MEMBERSHIP_CHANGED)
+            if (step + 1) % self.save_every == 0 or step + 1 == total_steps:
+                # ALL ranks enter (cross-host-sharded leaves allgather
+                # inside write_model_distributed); only the chief writes
+                path = self._ckpt_path(step + 1)
+                tmp = path + ".tmp"
+                if rank == 0:
+                    os.makedirs(self.ckpt_dir, exist_ok=True)
+                ModelSerializer.write_model_distributed(model, tmp)
+                if rank == 0:
+                    os.replace(tmp, path)       # atomic publish
+                    self.client.report_ckpt(step + 1, path)
+        hb.stop()
+        self.client.leave()
+        return model
+
+
+class ElasticSupervisor:
+    """Respawn-the-survivors loop around a fleet of worker subprocesses.
+
+    spawn_worker(index, world, generation) -> subprocess.Popen.  Workers
+    exiting 0 are done.  Any other exit ends the generation; the next
+    world size shrinks by the number of workers the COORDINATOR evicted
+    (explicit fail() or missed heartbeats) in that generation.  Exit codes
+    are deliberately not the shrink signal: when one task dies, JAX's own
+    coordination service fatally aborts the healthy peers (fail-the-world),
+    so survivors exit non-zero through no fault of their own.
+    """
+
+    def __init__(
+        self,
+        spawn_worker: Callable[[int, int, int], object],
+        server,                      # runtime.coordinator.CoordinatorServer
+        initial_world: int,
+        min_world: int = 1,
+        max_generations: int = 5,
+    ):
+        self.spawn_worker = spawn_worker
+        self.server = server
+        self.initial_world = initial_world
+        self.min_world = min_world
+        self.max_generations = max_generations
+        self.generations_run = 0
+
+    def run(self, timeout: float = 300.0) -> None:
+        world = self.initial_world
+        deadline = time.time() + timeout
+        for generation in range(1, self.max_generations + 1):
+            if world < self.min_world:
+                raise RuntimeError(
+                    f"elastic world shrank below min_world={self.min_world}"
+                )
+            self.generations_run = generation
+            with self.server._lock:
+                self.server.expected = world
+                # the previous generation's processes are gone: drop their
+                # membership so the heartbeat monitor can't post stale
+                # evictions into the generation about to form
+                self.server.members = {}
+            procs = [self.spawn_worker(i, world, generation) for i in range(world)]
+            rcs = []
+            try:
+                for p in procs:
+                    remaining = max(1.0, deadline - time.time())
+                    rcs.append(p.wait(timeout=remaining))
+            except Exception as exc:
+                # kill the ENTIRE fleet — earlier procs may be wedged in
+                # collectives and later ones were never waited on; leaking
+                # them would keep ports and coordinator membership alive
+                for q in procs:
+                    if q.poll() is None:
+                        q.kill()
+                raise TimeoutError(
+                    f"elastic generation did not finish: {exc}"
+                ) from exc
+            if all(rc == 0 for rc in rcs):
+                return
+
+            def _evicted():
+                with self.server._lock:
+                    return [
+                        e for e in self.server.evictions
+                        if e["generation"] == self.server.generation
+                    ]
+
+            # a worker killed outright (no fail() call) is only discovered
+            # by heartbeat timeout — give the ledger time to settle
+            settle_deadline = time.time() + self.server.heartbeat_timeout + 2
+            evicted = _evicted()
+            while not evicted and time.time() < settle_deadline:
+                time.sleep(0.25)
+                evicted = _evicted()
+            # shrink by actual failures; collateral aborts respawn as-is
+            world -= len(evicted)
+        raise RuntimeError(f"elastic training did not converge in "
+                           f"{self.max_generations} generations")

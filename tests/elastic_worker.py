@@ -1,0 +1,158 @@
+"""Worker-process script for the multi-process distributed tests.
+
+Spawned by tests/test_distributed.py (never imported by pytest itself).
+Modes, selected by DL4JTPU_TEST_MODE:
+
+  dp_parity — join a 2-process world (2 CPU devices each), run FIXED_STEPS
+      data-parallel steps of a deterministic MLP on a deterministic data
+      stream, rank 0 dumps final params to DL4JTPU_TEST_OUT (npz).
+  elastic — ElasticWorkerLoop-driven training with rolling checkpoints; the
+      worker whose DL4JTPU_TEST_VICTIM matches its worker-id fail()s and
+      dies at DL4JTPU_TEST_DIE_AT_STEP in generation 1 (fault injection at
+      a step boundary — the coordinator heartbeat/evict path does the rest).
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# GLOBAL_BATCH divides every world's device count the tests use
+# (2 workers x 2 devices = 4, 3 workers x 2 devices = 6)
+VOCAB_IN, N_OUT, GLOBAL_BATCH, FIXED_STEPS = 12, 4, 24, 6
+
+# read lazily so pytest can import this module for build_model/global_batch
+WORKER_ID = os.environ.get("DL4JTPU_TEST_WORKER_ID", "")
+COORD = os.environ.get("DL4JTPU_TEST_COORD", "")
+OUT = os.environ.get("DL4JTPU_TEST_OUT", "")
+
+
+def build_model():
+    from deeplearning4j_tpu.nn.activations import Activation
+    from deeplearning4j_tpu.nn.conf import (
+        Dense,
+        InputType,
+        NeuralNetConfiguration,
+        OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.losses import Loss
+    from deeplearning4j_tpu.nn.updaters import Sgd
+    from deeplearning4j_tpu.models import SequentialModel
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .updater(Sgd(0.05))
+        .list()
+        .layer(Dense(n_out=16, activation=Activation.TANH))
+        .layer(OutputLayer(n_out=N_OUT, loss=Loss.MCXENT,
+                           activation=Activation.SOFTMAX))
+        .set_input_type(InputType.feed_forward(VOCAB_IN))
+        .build()
+    )
+    return SequentialModel(conf).init()
+
+
+def global_batch(step: int):
+    rng = np.random.default_rng(1000 + step)
+    x = rng.normal(0, 1, (GLOBAL_BATCH, VOCAB_IN)).astype(np.float32)
+    y = np.eye(N_OUT, dtype=np.float32)[rng.integers(0, N_OUT, GLOBAL_BATCH)]
+    return x, y
+
+
+def local_shard(step: int, rank: int, world: int):
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    x, y = global_batch(step)
+    per = GLOBAL_BATCH // world
+    sl = slice(rank * per, (rank + 1) * per)
+    return DataSet(x[sl], y[sl])
+
+
+def main_dp_parity():
+    from deeplearning4j_tpu.parallel import ParallelConfig, distribute
+    from deeplearning4j_tpu.runtime import distributed
+    from deeplearning4j_tpu.runtime.coordinator import CoordinatorClient
+
+    client = CoordinatorClient(COORD, WORKER_ID)
+    reg = client.register()
+    distributed.initialize(
+        distributed.DistributedConfig(
+            coordinator_address=reg["jax_coordinator"],
+            num_processes=reg["world"],
+            process_id=reg["rank"],
+            local_device_count=2,
+            platform="cpu",
+        )
+    )
+    model = build_model()
+    distribute(model, ParallelConfig.data_parallel())
+    for step in range(FIXED_STEPS):
+        model.fit_batch(local_shard(step, reg["rank"], reg["world"]))
+    if reg["rank"] == 0 and OUT:
+        from deeplearning4j_tpu.runtime.distributed import fetch_global
+
+        flat = {
+            f"{l}/{p}": fetch_global(v)
+            for l, sub in model.params.items()
+            for p, v in sub.items()
+        }
+        np.savez(OUT, **flat)
+    client.leave()
+
+
+def main_elastic():
+    from deeplearning4j_tpu.runtime.coordinator import CoordinatorClient
+    from deeplearning4j_tpu.train.elastic import ElasticWorkerLoop
+
+    total_steps = int(os.environ["DL4JTPU_TEST_TOTAL_STEPS"])
+    die_at = int(os.environ.get("DL4JTPU_TEST_DIE_AT_STEP", "-1"))
+    victim = os.environ.get("DL4JTPU_TEST_VICTIM", "")
+    ckpt_dir = os.environ["DL4JTPU_TEST_CKPT_DIR"]
+
+    client = CoordinatorClient(COORD, WORKER_ID)
+    loop = ElasticWorkerLoop(
+        client,
+        ckpt_dir,
+        save_every=2,
+        heartbeat_every=0.5,
+        local_device_count=2,
+        platform="cpu",
+        jax_heartbeat_timeout_seconds=10,   # fast fail-the-world in tests
+    )
+
+    def on_step(model, step):
+        if (
+            WORKER_ID == victim
+            and step + 1 == die_at
+            and loop.last_registration["generation"] == 1
+        ):
+            # fault injection at a step boundary: tell the coordinator,
+            # then die hard (no leave(), no cleanup)
+            client.fail(reason="injected crash")
+            os._exit(1)
+
+    model = loop.run(build_model, local_shard, total_steps, on_step=on_step)
+    if OUT:
+        with open(OUT, "a") as f:
+            f.write(json.dumps({
+                "worker": WORKER_ID,
+                "generation": loop.last_registration["generation"],
+                "world": loop.last_registration["world"],
+                "final_iteration": model.iteration,
+                "score": float(model.score_value),
+            }) + "\n")
+
+
+if __name__ == "__main__":
+    MODE = os.environ["DL4JTPU_TEST_MODE"]
+    if MODE == "dp_parity":
+        main_dp_parity()
+    elif MODE == "elastic":
+        main_elastic()
+    else:
+        raise SystemExit(f"unknown mode {MODE}")

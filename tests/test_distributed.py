@@ -1,0 +1,230 @@
+"""Multi-host layer tests — the "multi-node without a cluster" tier.
+
+Reference analog: Spark local[N] + Aeron loopback + dummy transports
+(SURVEY.md §4.2).  Here: real multi-PROCESS jax.distributed worlds on the
+CPU platform (gloo collectives), spawned as subprocesses; the coordinator
+(membership/heartbeat/ckpt registry) is exercised both as pure unit tests
+and end-to-end through worker fleets, including a kill-one-worker ->
+restore-from-checkpoint elastic generation.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.runtime.coordinator import (
+    CoordinatorClient,
+    CoordinatorServer,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "elastic_worker.py")
+
+
+def spawn(mode, worker_id, coord, out="", extra=None):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)          # workers pick their own device count
+    env.update(
+        DL4JTPU_TEST_MODE=mode,
+        DL4JTPU_TEST_WORKER_ID=worker_id,
+        DL4JTPU_TEST_COORD=coord,
+        DL4JTPU_TEST_OUT=out,
+    )
+    if extra:
+        env.update({k: str(v) for k, v in extra.items()})
+    return subprocess.Popen(
+        [sys.executable, WORKER], env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+    )
+
+
+def wait_all(procs, timeout=240):
+    rcs = []
+    for p in procs:
+        try:
+            rcs.append(p.wait(timeout=timeout))
+        except subprocess.TimeoutExpired:
+            for q in procs:
+                q.kill()
+            raise
+    return rcs
+
+
+def fail_with_logs(procs, rcs, msg):
+    logs = []
+    for i, p in enumerate(procs):
+        out, err = p.communicate()
+        logs.append(f"--- worker {i} rc={rcs[i]}\n{err.decode()[-2000:]}")
+    pytest.fail(msg + "\n" + "\n".join(logs))
+
+
+# -- coordinator unit tests (pure control-plane logic) ----------------------
+
+class TestCoordinator:
+    def test_membership_barrier_and_ranks(self):
+        srv = CoordinatorServer(expected_workers=2, heartbeat_timeout=5).start()
+        try:
+            import threading
+
+            results = {}
+
+            def join(wid):
+                results[wid] = CoordinatorClient(srv.address, wid).register()
+
+            ts = [threading.Thread(target=join, args=(w,)) for w in ("b", "a")]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            assert results["a"]["rank"] == 0       # dense ranks, sorted ids
+            assert results["b"]["rank"] == 1
+            assert results["a"]["world"] == 2
+            assert results["a"]["generation"] == 1
+            assert results["a"]["jax_coordinator"].startswith("127.0.0.1:")
+        finally:
+            srv.stop()
+
+    def test_heartbeat_timeout_evicts_and_aborts(self):
+        srv = CoordinatorServer(expected_workers=1, heartbeat_timeout=0.6).start()
+        try:
+            c = CoordinatorClient(srv.address, "w0")
+            c.register()
+            assert c.heartbeat(step=1)["abort"] is False
+            time.sleep(1.5)                        # miss heartbeats
+            st = c.status()
+            assert st["members"] == []             # evicted
+            hb = c.heartbeat(step=2)
+            assert hb["abort"] and hb.get("evicted")
+        finally:
+            srv.stop()
+
+    def test_explicit_fail_aborts_generation(self):
+        srv = CoordinatorServer(expected_workers=2, heartbeat_timeout=30).start()
+        try:
+            import threading
+
+            a, b = (CoordinatorClient(srv.address, w) for w in ("a", "b"))
+            t = threading.Thread(target=a.register)
+            t.start()
+            b.register()
+            t.join(timeout=10)
+            b.fail("injected")
+            assert a.heartbeat()["abort"] is True
+        finally:
+            srv.stop()
+
+    def test_ckpt_registry_latest_wins(self):
+        srv = CoordinatorServer(expected_workers=1, heartbeat_timeout=30).start()
+        try:
+            c = CoordinatorClient(srv.address, "w0")
+            c.register()
+            c.report_ckpt(2, "/tmp/a.zip")
+            c.report_ckpt(4, "/tmp/b.zip")
+            assert c.latest_ckpt()["step"] == 4
+            assert c.latest_ckpt()["path"] == "/tmp/b.zip"
+        finally:
+            srv.stop()
+
+
+# -- multi-process data-parallel parity -------------------------------------
+
+class TestMultiProcessDP:
+    def test_two_process_dp_matches_single_process(self, tmp_path):
+        """2 worker processes x 2 CPU devices each == one 4-device DP world;
+        final params must match a single-process fit over the same global
+        batch stream (the param-averaging-math-asserted-exactly analog)."""
+        srv = CoordinatorServer(expected_workers=2, heartbeat_timeout=60).start()
+        out = str(tmp_path / "rank0_params.npz")
+        try:
+            procs = [
+                spawn("dp_parity", f"w{i}", srv.address, out=out if i == 0 else "")
+                for i in range(2)
+            ]
+            rcs = wait_all(procs)
+            if any(rc != 0 for rc in rcs):
+                fail_with_logs(procs, rcs, "dp_parity workers failed")
+        finally:
+            srv.stop()
+
+        multi = dict(np.load(out))
+
+        # single-process reference on this pytest process's 8-device CPU mesh
+        sys.path.insert(0, os.path.join(REPO, "tests"))
+        import elastic_worker as ew
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.parallel import ParallelConfig, distribute
+
+        model = ew.build_model()
+        distribute(model, ParallelConfig(data=4),
+                   devices=__import__("jax").devices()[:4])
+        for step in range(ew.FIXED_STEPS):
+            x, y = ew.global_batch(step)
+            model.fit_batch(DataSet(x, y))
+        for lname, sub in model.params.items():
+            for pname, v in sub.items():
+                np.testing.assert_allclose(
+                    multi[f"{lname}/{pname}"], np.asarray(v),
+                    rtol=2e-5, atol=2e-6,
+                    err_msg=f"{lname}/{pname} diverged between multi-process "
+                            "and single-process DP",
+                )
+
+
+# -- elastic: kill one worker, shrink, restore, finish ----------------------
+
+class TestElasticRestore:
+    def test_kill_one_worker_restores_from_ckpt_and_finishes(self, tmp_path):
+        from deeplearning4j_tpu.train.elastic import (
+            EXIT_MEMBERSHIP_CHANGED,
+            ElasticSupervisor,
+        )
+
+        ckpt_dir = str(tmp_path / "ckpts")
+        out = str(tmp_path / "done.jsonl")
+        total_steps = 8
+        srv = CoordinatorServer(expected_workers=3, heartbeat_timeout=60).start()
+
+        spawned = []
+
+        def spawn_worker(i, world, generation):
+            p = spawn(
+                "elastic", f"w{i}", srv.address, out=out,
+                extra={
+                    "DL4JTPU_TEST_TOTAL_STEPS": total_steps,
+                    "DL4JTPU_TEST_CKPT_DIR": ckpt_dir,
+                    "DL4JTPU_TEST_VICTIM": "w2",
+                    "DL4JTPU_TEST_DIE_AT_STEP": 4,
+                },
+            )
+            spawned.append(p)
+            return p
+
+        sup = ElasticSupervisor(
+            spawn_worker, srv, initial_world=3, min_world=2, max_generations=3
+        )
+        try:
+            sup.run(timeout=420)
+        except Exception:
+            rcs = [p.poll() for p in spawned]
+            fail_with_logs(spawned, rcs, "elastic supervisor failed")
+        finally:
+            srv.stop()
+
+        assert sup.generations_run == 2            # gen1 died, gen2 finished
+        lines = [json.loads(l) for l in open(out)]
+        finishers = {l["worker"]: l for l in lines}
+        assert set(finishers) == {"w0", "w1"}      # survivors only
+        for l in finishers.values():
+            assert l["generation"] == 2
+            assert l["world"] == 2                 # shrunken world
+            assert l["final_iteration"] == total_steps
+            assert np.isfinite(l["score"])
+        # the generation-2 restore point was a real checkpoint before the
+        # crash step
+        ckpts = sorted(os.listdir(ckpt_dir))
+        assert any(c.startswith("ckpt_0000000") for c in ckpts)
