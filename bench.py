@@ -166,7 +166,6 @@ def bench_lenet(peak):
     import numpy as np
 
     from deeplearning4j_tpu.data.builtin import MnistDataSetIterator
-    from deeplearning4j_tpu.data.dataset import DataSet
     from deeplearning4j_tpu.zoo.lenet import LeNet
 
     batch = 64 if QUICK else 512

@@ -7,6 +7,7 @@ import jax.numpy as jnp
 import optax
 
 from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf.layers import AUX_LOSS_KEY
 from deeplearning4j_tpu.nn.losses import FUSED_ACTIVATION_LOSSES, Loss
 
 CANONICAL_ACTIVATION = {
@@ -54,6 +55,22 @@ def mask_frozen_tx(tx, frozen_names: set[str]):
         optax.masked(tx, trainable_mask),
         optax.masked(optax.set_to_zero(), frozen_mask),
     )
+
+
+def pop_aux_losses(new_state: dict):
+    """Split layer-emitted auxiliary losses (MoE load balancing etc.) out of
+    the state tree: returns (aux_total, cleaned_state).  Aux entries are
+    training-step byproducts, not persistent state — they must feed the
+    objective, never the carried net_state."""
+    total = jnp.zeros((), jnp.float32)
+    cleaned = {}
+    for lname, ls in new_state.items():
+        if AUX_LOSS_KEY in ls:
+            total = total + ls[AUX_LOSS_KEY]
+            ls = {k: v for k, v in ls.items() if k != AUX_LOSS_KEY}
+        if ls:
+            cleaned[lname] = ls
+    return total, cleaned
 
 
 def regularization_loss(params, named_layers) -> jax.Array:

@@ -20,6 +20,7 @@ from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.models.model import Model
 from deeplearning4j_tpu.models._common import (
     mask_frozen_tx,
+    pop_aux_losses,
     regularization_loss,
     resolve_output_spec,
 )
@@ -181,7 +182,8 @@ class GraphModel(Model):
                         if not fused:
                             out = act(out.astype(jnp.float32))
                         total = total + compute_loss(loss, out, lab, m, from_logits=fused)
-                    return total + self._reg_loss(p), new_state
+                    aux, new_state = pop_aux_losses(new_state)
+                    return total + self._reg_loss(p) + aux, new_state
 
                 (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                     params

@@ -31,6 +31,7 @@ from deeplearning4j_tpu.nn.losses import Loss, compute as compute_loss
 from deeplearning4j_tpu.nn.updaters import with_gradient_clipping
 from deeplearning4j_tpu.models._common import (
     mask_frozen_tx,
+    pop_aux_losses,
     regularization_loss,
     resolve_output_spec,
 )
@@ -119,7 +120,29 @@ class SequentialModel(Model):
             x = x.astype(jnp.bfloat16)
         new_state, new_carries = {}, {}
         mask = fmask
+        plan = self._active_pipeline_plan()
+        skip = set()
+        if plan is not None:
+            skip = set(range(plan.start, plan.end))
         for i, layer in enumerate(self.conf.layers):
+            if i in skip:
+                if i == plan.start:
+                    from deeplearning4j_tpu.parallel.pipeline import (
+                        run_pipelined_segment,
+                    )
+                    from deeplearning4j_tpu.runtime.mesh import PIPE_AXIS, active_mesh
+
+                    if mask is not None:
+                        raise ValueError(
+                            "sequence masks are not supported through a "
+                            "pipelined segment yet; drop the pipe axis or "
+                            "the mask"
+                        )
+                    x = run_pipelined_segment(
+                        plan, params, x, mesh=active_mesh(), axis=PIPE_AXIS,
+                        training=training,
+                    )
+                continue
             if self._flatten_before[i]:
                 x = x.reshape(x.shape[0], -1)
             lp = params.get(layer.name, {})
@@ -148,6 +171,35 @@ class SequentialModel(Model):
         if carries is not None:
             return x, new_state, new_carries
         return x, new_state
+
+    # -- pipeline parallelism ---------------------------------------------
+    def _setup_pipeline(self, mesh, n_micro: int = 0) -> None:
+        """Called by distribute() when the mesh carries a pipe axis: plan
+        which contiguous block run GPipes over it (raises with an
+        actionable message when the stack has no pipelineable segment)."""
+        from deeplearning4j_tpu.parallel.pipeline import plan_sequential_pipeline
+        from deeplearning4j_tpu.runtime.mesh import PIPE_AXIS
+
+        self._pipeline_plan = plan_sequential_pipeline(
+            self.conf.layers, self.params, self._itypes,
+            mesh.shape[PIPE_AXIS], n_micro, net_state=self.net_state,
+        )
+
+    def _active_pipeline_plan(self):
+        """The plan, iff tracing under a mesh whose pipe axis is real."""
+        from deeplearning4j_tpu.runtime.mesh import PIPE_AXIS, active_mesh
+
+        plan = getattr(self, "_pipeline_plan", None)
+        if plan is None:
+            return None
+        mesh = active_mesh()
+        if (
+            mesh is None
+            or PIPE_AXIS not in mesh.axis_names
+            or mesh.shape[PIPE_AXIS] < 2
+        ):
+            return None
+        return plan
 
     def _reg_loss(self, params):
         return regularization_loss(params, [(l.name, l) for l in self.conf.layers])
@@ -190,7 +242,11 @@ class SequentialModel(Model):
                             lmask if has_lmask else None,
                             from_logits=self._fused_loss,
                         )
-                    return data_loss + self._reg_loss(p), (new_state, new_carries)
+                    aux, new_state = pop_aux_losses(new_state)
+                    return (
+                        data_loss + self._reg_loss(p) + aux,
+                        (new_state, new_carries),
+                    )
 
                 (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True

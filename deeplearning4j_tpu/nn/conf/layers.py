@@ -33,6 +33,12 @@ from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.weights import WeightInit
 from deeplearning4j_tpu.utils import serde
 
+# Reserved key in a layer's returned state: an auxiliary loss the compiled
+# training step adds to the objective (MoE load balancing etc.).  Aux
+# entries are popped before state is carried — see models/_common.py
+# pop_aux_losses.
+AUX_LOSS_KEY = "__aux_loss__"
+
 
 class PoolingType(str, enum.Enum):
     MAX = "max"

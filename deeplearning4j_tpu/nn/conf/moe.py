@@ -1,0 +1,80 @@
+"""Mixture-of-Experts layer for the config DSL.
+
+The reference has no MoE at all (SURVEY.md §2.3 "Expert parallel: NO");
+this makes the TPU build's expert parallelism reachable from the model
+DSL: `MoELayer` is a drop-in FFN-shaped layer for sequence models whose
+experts shard over the "expert" mesh axis under
+`distribute(model, ParallelConfig(expert=k))` — GSPMD lowers the dispatch
+einsums of `parallel/expert.py` to all_to_all over ICI.
+
+The Switch-style load-balancing auxiliary loss rides the aux-loss channel:
+apply() emits it under models._common.AUX_LOSS_KEY in the layer state and
+the compiled training step adds it to the objective (inference never pays
+for it).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers import AUX_LOSS_KEY, LayerConfig
+from deeplearning4j_tpu.parallel.expert import MoEConfig, init_moe, moe_apply
+from deeplearning4j_tpu.utils import serde
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class MoELayer(LayerConfig):
+    """Capacity-bounded top-k MoE FFN over a sequence: (B,T,D) -> (B,T,D).
+
+    n_out: d_model (input feature size must match — the layer is a
+    residual-position FFN replacement, not a projection).
+    """
+
+    n_out: int = 0
+    n_experts: int = 8
+    d_hidden: int = 0                    # default 4*n_out
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+    residual: bool = True                # x + MoE(x), the transformer shape
+
+    EXPECTS = "rnn"
+    REGULARIZED = ()                     # expert weights self-regularize via
+                                         # the aux loss; l2 on (E,D,H) tensors
+                                         # is opt-in through explicit l1/l2
+                                         # fields if ever needed
+
+    def _cfg(self) -> MoEConfig:
+        return MoEConfig(
+            n_experts=self.n_experts,
+            d_model=self.n_out,
+            d_hidden=self.d_hidden if self.d_hidden > 0 else 4 * self.n_out,
+            top_k=self.top_k,
+            capacity_factor=self.capacity_factor,
+        )
+
+    def output_type(self, itype: InputType) -> InputType:
+        if itype.kind != InputType.KIND_RNN:
+            raise ValueError(f"MoELayer expects sequence input, got {itype}")
+        if itype.size != self.n_out:
+            raise ValueError(
+                f"MoELayer n_out={self.n_out} must equal the input feature "
+                f"size {itype.size} (FFN-shaped layer)"
+            )
+        return InputType.recurrent(self.n_out, itype.shape[0])
+
+    def init(self, key, itype):
+        return init_moe(key, self._cfg()), {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y, aux = moe_apply(params, x, self._cfg())
+        if self.residual:
+            y = x + y
+        ns = {}
+        if training and self.aux_loss_weight:
+            ns[AUX_LOSS_KEY] = (self.aux_loss_weight * aux).astype(jnp.float32)
+        return y, ns

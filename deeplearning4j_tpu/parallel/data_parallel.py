@@ -28,7 +28,13 @@ from deeplearning4j_tpu.parallel.strategy import (
     replicate,
     shard_params,
 )
-from deeplearning4j_tpu.runtime.mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from deeplearning4j_tpu.runtime.mesh import (
+    DATA_AXIS,
+    EXPERT_AXIS,
+    MODEL_AXIS,
+    PIPE_AXIS,
+    SEQ_AXIS,
+)
 
 
 def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=None):
@@ -40,13 +46,28 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
     mesh = mesh or config.build_mesh(devices)
 
     tp = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
-    if tp:
-        specs = param_specs(model.params, model.conf)
+    ep = EXPERT_AXIS in mesh.axis_names and mesh.shape[EXPERT_AXIS] > 1
+    if tp or ep:
+        specs = param_specs(
+            model.params, model.conf,
+            model_axis=MODEL_AXIS if tp else None,
+            expert_axis=EXPERT_AXIS if ep else None,
+        )
         model.params = shard_params(model.params, mesh, specs)
     else:
         model.params = replicate(model.params, mesh)
     model.net_state = replicate(model.net_state, mesh)
     model.opt_state = replicate(model.opt_state, mesh)
+
+    pp = PIPE_AXIS in mesh.axis_names and mesh.shape[PIPE_AXIS] > 1
+    if pp:
+        if not hasattr(model, "_setup_pipeline"):
+            raise NotImplementedError(
+                f"{type(model).__name__} does not support pipeline "
+                "parallelism; GPipe runs over a SequentialModel's "
+                "repeated-block segment"
+            )
+        model._setup_pipeline(mesh, config.microbatches)
 
     sp = SEQ_AXIS if SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1 else None
     model._mesh = mesh

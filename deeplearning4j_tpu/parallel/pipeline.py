@@ -91,3 +91,143 @@ def split_microbatches(x: jax.Array, n_micro: int) -> jax.Array:
 
 def merge_microbatches(y: jax.Array) -> jax.Array:
     return y.reshape((-1,) + y.shape[2:])
+
+
+# ---------------------------------------------------------------------------
+# Model integration: pipeline a SequentialModel's repeated-block segment
+# ---------------------------------------------------------------------------
+
+import dataclasses as _dataclasses
+
+
+@_dataclasses.dataclass(frozen=True)
+class PipelinePlan:
+    """How a sequential layer stack maps onto the pipe axis.
+
+    The pipelined segment is a contiguous run of IDENTICALLY-configured,
+    shape-preserving, stateless blocks (the transformer-stack shape PP
+    exists for), n_blocks = k stages x m blocks each.  Layers before/after
+    the segment run replicated on every pipe device (embeddings and output
+    heads are cheap relative to the block stack).
+    """
+
+    start: int                 # first layer index in the segment
+    end: int                   # one past the last layer index
+    block_names: tuple[str, ...]
+    block_config: object       # the shared LayerConfig (names differ only)
+    k: int                     # pipeline stages
+    n_micro: int               # microbatches per global batch
+
+
+def plan_sequential_pipeline(layers, params, itypes, k: int,
+                             n_micro: int = 0, net_state=None) -> PipelinePlan:
+    """Choose the pipelined segment of a sequential stack, or raise with an
+    actionable reason.  Requirements per block: identical config (except
+    name), identical param tree (structure+shapes), input type preserved,
+    no dropout (rng is not threaded through the pipeline scan), no state
+    (BatchNorm running stats cannot live inside the ppermute loop)."""
+
+    def strip(cfg):
+        return _dataclasses.replace(cfg, name="")
+
+    def shapes(name):
+        return jax.tree.map(lambda a: (a.shape, str(a.dtype)), params.get(name, {}))
+
+    best = (0, 0)
+    i = 0
+    while i < len(layers):
+        j = i
+        while (
+            j + 1 < len(layers)
+            and type(layers[j + 1]) is type(layers[i])
+            and strip(layers[j + 1]) == strip(layers[i])
+            and shapes(layers[j + 1].name) == shapes(layers[i].name)
+            and itypes[j + 1] == itypes[i]
+        ):
+            j += 1
+        # run is [i, j]; shape-preserving check: next layer's input type
+        # (== run's output type) must equal the run's input type
+        run_ok = j > i and (
+            (j + 1 < len(itypes) and itypes[j + 1] == itypes[i])
+            or j + 1 == len(itypes)
+        )
+        if run_ok and (j + 1 - i) > (best[1] - best[0]):
+            best = (i, j + 1)
+        i = j + 1
+    start, end = best
+    n_blocks = end - start
+    if n_blocks < k:
+        raise ValueError(
+            f"pipeline parallelism over {k} stages needs a contiguous run of "
+            f">= {k} identical shape-preserving layers; longest found is "
+            f"{n_blocks}. Pipeline the repeated-block segment of a "
+            "transformer-style stack, or drop the pipe axis."
+        )
+    if n_blocks % k:
+        raise ValueError(
+            f"pipelined segment has {n_blocks} blocks, not divisible into "
+            f"{k} stages"
+        )
+    seg = layers[start:end]
+    for l in seg:
+        if getattr(l, "dropout_rate", None):
+            raise ValueError(
+                f"layer {l.name!r}: dropout inside the pipelined segment is "
+                "not supported (per-block rng is not threaded through the "
+                "pipeline scan)"
+            )
+        if net_state and net_state.get(l.name):
+            raise ValueError(
+                f"layer {l.name!r}: stateful layers (BatchNorm running "
+                "stats etc.) cannot be pipelined — state updates cannot "
+                "live inside the ppermute schedule"
+            )
+    return PipelinePlan(
+        start=start,
+        end=end,
+        block_names=tuple(l.name for l in seg),
+        block_config=seg[0],
+        k=k,
+        n_micro=n_micro or 2 * k,
+    )
+
+
+def run_pipelined_segment(plan: PipelinePlan, params, x, *, mesh, axis: str,
+                          training: bool):
+    """Execute the planned segment: stack block params, GPipe them over the
+    pipe mesh axis, return the merged activations.
+
+    Block params stay replicated in HBM; the in-jit stack is annotated
+    P(pipe) so each device materializes only its stage's slice after GSPMD
+    partitioning.  Stages are rematerialized (jax.checkpoint) — the GPipe
+    memory model: activations of in-flight microbatches only.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    k, m = plan.k, len(plan.block_names) // plan.k
+    cfg = plan.block_config
+    stacked = jax.tree.map(
+        lambda *xs: jnp.stack(xs), *[params[n] for n in plan.block_names]
+    )
+    stacked = jax.tree.map(lambda a: a.reshape((k, m) + a.shape[1:]), stacked)
+
+    @jax.checkpoint
+    def stage_fn(sp, h):
+        def body(h, p):
+            y, _ = cfg.apply(p, {}, h, training=training, rng=None)
+            return y, None
+        h, _ = lax.scan(body, h, sp)
+        return h
+
+    x_micro = split_microbatches(x, plan.n_micro)
+    out = jax.shard_map(
+        lambda sp, xm: pipeline_apply(
+            stage_fn, jax.tree.map(lambda a: a[0], sp), xm, axis=axis
+        ),
+        mesh=mesh,
+        in_specs=(P(axis), P()),
+        out_specs=P(),
+        axis_names={axis},
+        check_vma=False,
+    )(stacked, x_micro)
+    return merge_microbatches(out)

@@ -42,6 +42,9 @@ class ParallelConfig:
     pipe: int = 1
     seq: int = 1
     expert: int = 1
+    # microbatches per global batch under pipeline parallelism
+    # (0 = auto: 2*pipe, a reasonable bubble amortization)
+    microbatches: int = 0
 
     def mesh_spec(self) -> MeshSpec:
         # the data axis is ALWAYS present (size 1 degrades gracefully) so
@@ -68,11 +71,20 @@ class ParallelConfig:
 
 # -- tensor-parallel partition rules ---------------------------------------
 
-def _spec_for_param(layer_type: str, pname: str, ndim: int, model_axis: str) -> P:
+def _spec_for_param(layer_type: str, pname: str, ndim: int,
+                    model_axis: str | None,
+                    expert_axis: str | None = None) -> P:
     """Megatron-style: shard the OUTPUT-feature dim of weight matrices on
     the model axis; biases and small vectors follow their feature dim;
-    norms replicate."""
+    norms replicate.  MoE expert tensors shard their leading (expert) dim
+    on the expert axis."""
+    if layer_type == "MoELayer":
+        if pname in ("Wi", "Wo") and expert_axis:
+            return P(expert_axis)
+        return P()
     if layer_type in ("BatchNorm", "LayerNorm"):
+        return P()
+    if model_axis is None:
         return P()
     if pname in ("W", "Wx", "Wh", "pointW"):
         # last dim is the output features for dense [in,out], conv HWIO,
@@ -85,12 +97,14 @@ def _spec_for_param(layer_type: str, pname: str, ndim: int, model_axis: str) -> 
     return P()
 
 
-def param_specs(params, conf, model_axis: str = MODEL_AXIS):
+def param_specs(params, conf, model_axis: str | None = MODEL_AXIS,
+                expert_axis: str | None = None):
     """PartitionSpec pytree matching a model's params.
 
     conf: SequentialConfiguration or GraphConfiguration — used to find each
     layer's type.  OutputLayer weights replicate (the logits dim is small
-    and the loss wants it whole).
+    and the loss wants it whole).  model_axis=None: no tensor parallelism
+    (expert_axis may still shard MoE expert tensors).
     """
     layer_types: dict[str, str] = {}
     if hasattr(conf, "layers"):
@@ -108,7 +122,8 @@ def param_specs(params, conf, model_axis: str = MODEL_AXIS):
             specs[lname] = jax.tree.map(lambda _: P(), lp)
             continue
         specs[lname] = {
-            pname: _spec_for_param(ltype, pname, leaf.ndim, model_axis)
+            pname: _spec_for_param(ltype, pname, leaf.ndim, model_axis,
+                                   expert_axis)
             if not isinstance(leaf, dict)
             else jax.tree.map(lambda x: P(), leaf)
             for pname, leaf in lp.items()

@@ -141,6 +141,9 @@ class CoordinatorServer:
                 return {"ok": True}
             if op == "set_expected":
                 self.expected = int(req["n"])
+                # workers may already be waiting in the membership barrier;
+                # a lowered expectation can complete it right now
+                self._maybe_seal()
                 self._lock.notify_all()
                 return {"ok": True}
             if op == "status":
@@ -161,22 +164,7 @@ class CoordinatorServer:
         then seals a new generation and assigns dense ranks."""
         with self._lock:
             self.pending[worker] = {"info": info, "time": time.time()}
-            if len(self.pending) >= self.expected:
-                # seal: pending becomes the new generation's membership
-                self.generation += 1
-                self.abort = False
-                # a fresh jax.distributed coordination-service port per
-                # generation (the data-plane runtime cannot be rejoined on
-                # a stale port after an abort)
-                self.jax_coordinator = f"{self._host}:{_free_port(self._host)}"
-                now = time.time()
-                self.members = {}
-                for rank, wid in enumerate(sorted(self.pending)):
-                    self.members[wid] = {"rank": rank, "last_hb": now,
-                                         "info": self.pending[wid]["info"]}
-                self.pending = {}
-                self._lock.notify_all()
-            else:
+            if not self._maybe_seal():
                 # wait until a seal consumes our pending entry
                 deadline = time.time() + 120.0
                 while worker in self.pending:
@@ -196,6 +184,28 @@ class CoordinatorServer:
                 "jax_coordinator": self.jax_coordinator,
                 "ckpt": self.latest_ckpt,
             }
+
+    def _maybe_seal(self) -> bool:
+        """With the lock held: if enough workers are pending, seal a new
+        generation (assign dense ranks, fresh data-plane port).  Called on
+        every registration AND on set_expected — lowering the expectation
+        must be able to complete a barrier that is already waiting."""
+        if not self.pending or len(self.pending) < self.expected:
+            return False
+        self.generation += 1
+        self.abort = False
+        # a fresh jax.distributed coordination-service port per generation
+        # (the data-plane runtime cannot be rejoined on a stale port after
+        # an abort)
+        self.jax_coordinator = f"{self._host}:{_free_port(self._host)}"
+        now = time.time()
+        self.members = {}
+        for rank, wid in enumerate(sorted(self.pending)):
+            self.members[wid] = {"rank": rank, "last_hb": now,
+                                 "info": self.pending[wid]["info"]}
+        self.pending = {}
+        self._lock.notify_all()
+        return True
 
     def _heartbeat(self, worker: str, step) -> dict:
         m = self.members.get(worker)

@@ -102,6 +102,48 @@ class ElasticWorkerLoop:
     def _ckpt_path(self, step: int) -> str:
         return os.path.join(self.ckpt_dir, f"ckpt_{step:08d}.zip")
 
+    def _restore_or_build(self, build_model, reg, world):
+        """Form a cross-process-consistent starting model.
+
+        The CHIEF decides whether to restore (it wrote the checkpoint, so
+        only its filesystem view is authoritative); the decision and the
+        restored state are broadcast so hosts without a shared filesystem
+        can't diverge into mismatched step ranges or params.
+        """
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+        ckpt = reg.get("ckpt") or self.client.latest_ckpt()
+        if world <= 1:
+            if ckpt and os.path.exists(ckpt["path"]):
+                return ModelSerializer.restore(ckpt["path"])
+            return build_model()
+
+        import numpy as np
+        from jax.experimental import multihost_utils
+
+        from deeplearning4j_tpu.runtime import distributed
+
+        chief = distributed.is_chief()
+        can_restore = bool(chief and ckpt and os.path.exists(ckpt["path"]))
+        flag = multihost_utils.broadcast_one_to_all(np.int32(can_restore))
+        if not int(flag):
+            return build_model()
+        if ckpt and os.path.exists(ckpt["path"]):
+            model = ModelSerializer.restore(ckpt["path"])
+        else:
+            model = build_model()        # structure only; values follow
+        model.params = multihost_utils.broadcast_one_to_all(model.params)
+        model.net_state = multihost_utils.broadcast_one_to_all(model.net_state)
+        if model.opt_state is not None:
+            model.opt_state = multihost_utils.broadcast_one_to_all(model.opt_state)
+        model.iteration = int(
+            multihost_utils.broadcast_one_to_all(np.int32(model.iteration))
+        )
+        model.epoch = int(
+            multihost_utils.broadcast_one_to_all(np.int32(model.epoch))
+        )
+        return model
+
     def run(
         self,
         build_model: Callable[[], object],
@@ -118,6 +160,14 @@ class ElasticWorkerLoop:
         rank, world = reg["rank"], reg["world"]
         generation = reg["generation"]
 
+        # heartbeat from the moment membership exists: jax.distributed
+        # bring-up and checkpoint restore below can take far longer than the
+        # eviction timeout on real models, and a silent bootstrap would get
+        # every healthy worker evicted before its first beat
+        hb_interval = max(0.2, min(2.0, self.heartbeat_every))
+        hb = _HeartbeatThread(self.client, generation, hb_interval)
+        hb.start()
+
         distributed.initialize(
             distributed.DistributedConfig(
                 coordinator_address=reg["jax_coordinator"],
@@ -129,16 +179,8 @@ class ElasticWorkerLoop:
             )
         )
 
-        ckpt = reg.get("ckpt") or self.client.latest_ckpt()
-        if ckpt and os.path.exists(ckpt["path"]):
-            model = ModelSerializer.restore(ckpt["path"])
-        else:
-            model = build_model()
+        model = self._restore_or_build(build_model, reg, world)
         distribute(model, self.parallel_config or ParallelConfig.data_parallel())
-
-        hb_interval = max(0.2, min(2.0, self.heartbeat_every))
-        hb = _HeartbeatThread(self.client, generation, hb_interval)
-        hb.start()
 
         start = model.iteration
         for step in range(start, total_steps):
@@ -212,9 +254,12 @@ class ElasticSupervisor:
             with self.server._lock:
                 self.server.expected = world
                 # the previous generation's processes are gone: drop their
-                # membership so the heartbeat monitor can't post stale
-                # evictions into the generation about to form
+                # membership (no stale heartbeat evictions into the forming
+                # generation) AND their half-finished registrations (a ghost
+                # sealed into the new generation would wedge jax.distributed
+                # waiting for a process that will never come up)
                 self.server.members = {}
+                self.server.pending = {}
             procs = [self.spawn_worker(i, world, generation) for i in range(world)]
             rcs = []
             try:

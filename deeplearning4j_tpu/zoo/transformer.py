@@ -42,6 +42,8 @@ class TransformerEncoder(ZooModel):
         seq_parallel: str = "none",
         seed: int = 123,
         learning_rate: float = 3e-4,
+        moe_experts: int = 0,           # >0: MoE FFN layer after each block
+        moe_top_k: int = 2,
     ):
         super().__init__(vocab_size, seed)
         self.vocab_size = vocab_size
@@ -52,6 +54,8 @@ class TransformerEncoder(ZooModel):
         self.causal = causal
         self.seq_parallel = seq_parallel
         self.learning_rate = learning_rate
+        self.moe_experts = moe_experts
+        self.moe_top_k = moe_top_k
 
     def conf(self):
         b = (
@@ -73,6 +77,16 @@ class TransformerEncoder(ZooModel):
                     seq_parallel=self.seq_parallel,
                 )
             )
+            if self.moe_experts > 0:
+                from deeplearning4j_tpu.nn.conf.moe import MoELayer
+
+                b.layer(
+                    MoELayer(
+                        n_out=self.d_model,
+                        n_experts=self.moe_experts,
+                        top_k=self.moe_top_k,
+                    )
+                )
         return (
             b.layer(
                 RnnOutputLayer(
