@@ -264,7 +264,9 @@ class GraphModel(Model):
         from deeplearning4j_tpu.parallel.data_parallel import place_batch
         from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
 
-        with active_mesh_scope(getattr(self, "_mesh", None)):
+        from deeplearning4j_tpu.runtime.crash import oom_report_scope
+
+        with oom_report_scope(), active_mesh_scope(getattr(self, "_mesh", None)):
             self.params, self.opt_state, self.net_state, loss = step(
                 self.params,
                 self.opt_state,

@@ -292,8 +292,10 @@ class SequentialModel(Model):
         has_fmask = batch.features_mask is not None
         with_carries = carries is not None
         step = self._get_step_fn(has_lmask, has_fmask, with_carries)
+        from deeplearning4j_tpu.runtime.crash import oom_report_scope
+
         empty = np.zeros((0,), np.float32)
-        with active_mesh_scope(getattr(self, "_mesh", None)):
+        with oom_report_scope(), active_mesh_scope(getattr(self, "_mesh", None)):
             self.params, self.opt_state, self.net_state, loss, new_carries = step(
                 self.params,
                 self.opt_state,

@@ -182,6 +182,27 @@ def plan_sequential_pipeline(layers, params, itypes, k: int,
                 "stats etc.) cannot be pipelined — state updates cannot "
                 "live inside the ppermute schedule"
             )
+    # reject blocks that EMIT state/aux during training even when they hold
+    # none at rest (MoELayer's load-balancing aux loss): the stage fn
+    # discards apply()'s state channel, which would silently drop it
+    rep = seg[0]
+    it = itypes[start]
+    if it.kind == "rnn":
+        t = it.shape[0] if it.shape[0] > 0 else 4
+        x_spec = jax.ShapeDtypeStruct((2, t, it.shape[1]), jnp.float32)
+    else:
+        x_spec = jax.ShapeDtypeStruct((2,) + tuple(it.shape), jnp.float32)
+    _, emitted = jax.eval_shape(
+        lambda p, x: rep.apply(p, {}, x, training=True, rng=None),
+        params.get(rep.name, {}), x_spec,
+    )
+    if emitted:
+        raise ValueError(
+            f"layer {rep.name!r} ({type(rep).__name__}) emits state/aux "
+            f"during training ({sorted(emitted)}); the pipeline schedule "
+            "cannot carry it — keep such layers outside the pipelined "
+            "segment"
+        )
     return PipelinePlan(
         start=start,
         end=end,

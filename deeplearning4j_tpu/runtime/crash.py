@@ -1,0 +1,132 @@
+"""HBM OOM crash reporting — the `CrashReportingUtil` role.
+
+The reference's distinctive failure UX (SURVEY.md §5.5): on OOM it writes a
+detailed memory report (workspace sizes, last op) so users can act instead
+of staring at an allocator stack trace.  TPU-native equivalent: on a
+RESOURCE_EXHAUSTED from XLA, write a report with PJRT memory_stats and a
+per-buffer attribution of every live jax.Array (shape/dtype/size/sharding,
+largest first) — the buffers ARE the workspaces here.
+
+Models call `maybe_write_oom_report(exc)` from their fit paths; users can
+also call `write_memory_report(path)` any time.  Report location:
+DL4JTPU_CRASH_DIR (default: cwd), mirroring the reference's
+`crashDumpOutputDirectory`.
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from typing import Optional
+
+ENV_CRASH_DIR = "DL4JTPU_CRASH_DIR"
+
+
+def _live_buffer_table(limit: int = 60) -> tuple[list[str], int]:
+    import jax
+
+    rows = []
+    total = 0
+    try:
+        arrays = jax.live_arrays()
+    except Exception:
+        return ["  <live-array introspection unavailable>"], 0
+    sized = []
+    for a in arrays:
+        try:
+            nbytes = a.size * a.dtype.itemsize
+            sized.append((nbytes, a))
+            total += nbytes
+        except Exception:
+            continue
+    sized.sort(key=lambda t: -t[0])
+    for nbytes, a in sized[:limit]:
+        try:
+            sh = getattr(a, "sharding", None)
+            rows.append(
+                f"  {nbytes/1e6:12.2f} MB  {str(a.dtype):>10}  "
+                f"{str(a.shape):<24} {type(sh).__name__ if sh else ''}"
+            )
+        except Exception:
+            continue
+    if len(sized) > limit:
+        rows.append(f"  ... and {len(sized) - limit} more buffers")
+    return rows, total
+
+
+def write_memory_report(path: Optional[str] = None,
+                        header: str = "") -> str:
+    """Write the device-memory report; returns the file path."""
+    import jax
+
+    if path is None:
+        d = os.environ.get(ENV_CRASH_DIR, ".")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"dl4jtpu-memory-report-{int(time.time())}.txt")
+
+    lines = ["deeplearning4j_tpu device memory report",
+             f"time: {time.strftime('%Y-%m-%d %H:%M:%S')}", ""]
+    if header:
+        lines += [header, ""]
+    for d in jax.local_devices():
+        lines.append(f"device: {d} ({getattr(d, 'device_kind', d.platform)})")
+        try:
+            stats = d.memory_stats() or {}
+        except Exception:
+            stats = {}
+        for k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                  "largest_alloc_size", "num_allocs"):
+            if k in stats:
+                lines.append(f"  {k}: {stats[k]:,}")
+        lines.append("")
+    rows, total = _live_buffer_table()
+    lines.append(f"live jax.Array buffers (largest first; {total/1e6:.1f} MB "
+                 "total attributed):")
+    lines.extend(rows)
+    lines.append("")
+    lines.append("hints: lower the batch size; enable rematerialization "
+                 "(jax.checkpoint) on large blocks; shard params over more "
+                 "chips (ParallelConfig(model=...)); use bf16_compute.")
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path
+
+
+def is_oom_error(exc: BaseException) -> bool:
+    msg = str(exc)
+    return "RESOURCE_EXHAUSTED" in msg or "Out of memory" in msg or (
+        "Allocator" in msg and "OOM" in msg
+    )
+
+
+def maybe_write_oom_report(exc: BaseException) -> Optional[str]:
+    """If exc looks like a device OOM, write the crash report and return its
+    path (models re-raise the original error either way)."""
+    if not is_oom_error(exc):
+        return None
+    try:
+        return write_memory_report(
+            header=f"TRIGGER: {type(exc).__name__}: {str(exc)[:2000]}"
+        )
+    except Exception:
+        return None
+
+
+class oom_report_scope:
+    """Context manager the models wrap their compiled-step invocation in: a
+    device OOM escaping the scope gets the memory report written and a
+    pointer to it chained onto the error."""
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, exc_type, exc, tb):
+        if exc is None:
+            return False
+        report = maybe_write_oom_report(exc)
+        if report:
+            raise RuntimeError(
+                f"device OOM during fit step; memory report written to "
+                f"{report}"
+            ) from exc
+        return False

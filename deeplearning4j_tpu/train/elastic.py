@@ -126,12 +126,13 @@ class ElasticWorkerLoop:
         chief = distributed.is_chief()
         can_restore = bool(chief and ckpt and os.path.exists(ckpt["path"]))
         flag = multihost_utils.broadcast_one_to_all(np.int32(can_restore))
-        if not int(flag):
-            return build_model()
-        if ckpt and os.path.exists(ckpt["path"]):
+        if int(flag) and ckpt and os.path.exists(ckpt["path"]):
             model = ModelSerializer.restore(ckpt["path"])
         else:
             model = build_model()        # structure only; values follow
+        # broadcast the chief's state on BOTH paths: a fresh build with a
+        # non-deterministic init would otherwise silently train a different
+        # model per host under 'replicated' params
         model.params = multihost_utils.broadcast_one_to_all(model.params)
         model.net_state = multihost_utils.broadcast_one_to_all(model.net_state)
         if model.opt_state is not None:
@@ -167,50 +168,53 @@ class ElasticWorkerLoop:
         hb_interval = max(0.2, min(2.0, self.heartbeat_every))
         hb = _HeartbeatThread(self.client, generation, hb_interval)
         hb.start()
-
-        distributed.initialize(
-            distributed.DistributedConfig(
-                coordinator_address=reg["jax_coordinator"],
-                num_processes=world,
-                process_id=rank,
-                local_device_count=self.local_device_count,
-                platform=self.platform,
-                heartbeat_timeout_seconds=self.jax_heartbeat_timeout_seconds,
+        try:
+            distributed.initialize(
+                distributed.DistributedConfig(
+                    coordinator_address=reg["jax_coordinator"],
+                    num_processes=world,
+                    process_id=rank,
+                    local_device_count=self.local_device_count,
+                    platform=self.platform,
+                    heartbeat_timeout_seconds=self.jax_heartbeat_timeout_seconds,
+                )
             )
-        )
 
-        model = self._restore_or_build(build_model, reg, world)
-        distribute(model, self.parallel_config or ParallelConfig.data_parallel())
+            model = self._restore_or_build(build_model, reg, world)
+            distribute(model, self.parallel_config or ParallelConfig.data_parallel())
 
-        start = model.iteration
-        for step in range(start, total_steps):
-            model.fit_batch(batch_fn(step, rank, world))
-            hb.step = step + 1
-            if on_step is not None:
-                on_step(model, step)
-            if hb.aborted.is_set():
-                # membership changed: this generation is dead.  Leave
-                # voluntarily (so the monitor can't post a spurious
-                # eviction for us) and exit WITHOUT atexit handlers —
-                # jax.distributed's shutdown barrier would hang on the
-                # dead peer.  The supervisor respawns the new world.
-                try:
-                    self.client.leave()
-                except Exception:
-                    pass
-                os._exit(EXIT_MEMBERSHIP_CHANGED)
-            if (step + 1) % self.save_every == 0 or step + 1 == total_steps:
-                # ALL ranks enter (cross-host-sharded leaves allgather
-                # inside write_model_distributed); only the chief writes
-                path = self._ckpt_path(step + 1)
-                tmp = path + ".tmp"
-                if rank == 0:
-                    os.makedirs(self.ckpt_dir, exist_ok=True)
-                ModelSerializer.write_model_distributed(model, tmp)
-                if rank == 0:
-                    os.replace(tmp, path)       # atomic publish
-                    self.client.report_ckpt(step + 1, path)
-        hb.stop()
+            start = model.iteration
+            for step in range(start, total_steps):
+                model.fit_batch(batch_fn(step, rank, world))
+                hb.step = step + 1
+                if on_step is not None:
+                    on_step(model, step)
+                if hb.aborted.is_set():
+                    # membership changed: this generation is dead.  Leave
+                    # voluntarily (so the monitor can't post a spurious
+                    # eviction for us) and exit WITHOUT atexit handlers —
+                    # jax.distributed's shutdown barrier would hang on the
+                    # dead peer.  The supervisor respawns the new world.
+                    try:
+                        self.client.leave()
+                    except Exception:
+                        pass
+                    os._exit(EXIT_MEMBERSHIP_CHANGED)
+                if (step + 1) % self.save_every == 0 or step + 1 == total_steps:
+                    # ALL ranks enter (cross-host-sharded leaves allgather
+                    # inside write_model_distributed); only the chief writes
+                    path = self._ckpt_path(step + 1)
+                    tmp = path + ".tmp"
+                    if rank == 0:
+                        os.makedirs(self.ckpt_dir, exist_ok=True)
+                    ModelSerializer.write_model_distributed(model, tmp)
+                    if rank == 0:
+                        os.replace(tmp, path)       # atomic publish
+                        self.client.report_ckpt(step + 1, path)
+        finally:
+            # never leak the heartbeat: a raised bootstrap/step error would
+            # otherwise keep this dead worker "alive" in membership forever
+            hb.stop()
         self.client.leave()
         return model
 

@@ -1,0 +1,35 @@
+"""L7 observability — the reference's UI/stats subsystem, TPU-native.
+
+Reference surface (SURVEY.md §5.5, §2.2 "UI server"): `StatsListener`
+serializes per-iteration training stats into a `StatsStorage` (in-memory or
+file-backed), and `UIServer` renders a dashboard (score chart,
+param/update mean-magnitude ratios, memory).  Same capability here:
+
+    storage = FileStatsStorage("run.jsonl")        # or InMemoryStatsStorage
+    model.set_listeners(StatsListener(storage))
+    server = UIServer.get_instance()
+    server.attach(storage)                         # dashboard on localhost
+
+Plus the TPU-specific pieces the reference's CUDA stack can't have:
+`ProfilerListener` captures jax.profiler traces (TensorBoard/Perfetto) for
+a window of steps, and `runtime.crash` writes an HBM OOM report with
+per-buffer attribution (the CrashReportingUtil role).
+"""
+
+from deeplearning4j_tpu.ui.stats import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    StatsListener,
+    StatsStorage,
+)
+from deeplearning4j_tpu.ui.profiler import ProfilerListener
+from deeplearning4j_tpu.ui.server import UIServer
+
+__all__ = [
+    "StatsListener",
+    "StatsStorage",
+    "InMemoryStatsStorage",
+    "FileStatsStorage",
+    "ProfilerListener",
+    "UIServer",
+]

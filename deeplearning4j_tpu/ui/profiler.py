@@ -1,0 +1,51 @@
+"""ProfilerListener — jax.profiler trace capture on the listener SPI.
+
+Reference role: `OpProfiler` / external nvprof (SURVEY.md §5.1).  On TPU
+the profiler of record is jax.profiler: traces open in TensorBoard's
+profile plugin or Perfetto and show per-op device time, HBM traffic, and
+the compile-vs-run split the reference had no way to see.
+
+Captures iterations [start_iteration, start_iteration + num_iterations) —
+after the warmup steps so XLA compilation doesn't dominate the trace.
+"""
+
+from __future__ import annotations
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+
+class ProfilerListener(TrainingListener):
+    def __init__(self, log_dir: str, start_iteration: int = 10,
+                 num_iterations: int = 5):
+        self.log_dir = log_dir
+        self.start_iteration = start_iteration
+        self.num_iterations = num_iterations
+        self._active = False
+        self.captured = False
+
+    def iteration_done(self, model, iteration, epoch, score):
+        import jax
+
+        if (
+            not self._active
+            and not self.captured
+            and iteration + 1 >= self.start_iteration
+        ):
+            jax.profiler.start_trace(self.log_dir)
+            self._active = True
+            self._until = iteration + 1 + self.num_iterations
+            return
+        if self._active and iteration + 1 >= self._until:
+            # ensure traced work is actually on the timeline before closing
+            jax.block_until_ready(model.params)
+            jax.profiler.stop_trace()
+            self._active = False
+            self.captured = True
+
+    def close(self):
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self.captured = True

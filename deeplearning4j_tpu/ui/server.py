@@ -1,0 +1,165 @@
+"""UIServer — the training dashboard (UIServer/VertxUIServer role).
+
+Reference: `UIServer.getInstance().attach(statsStorage)` serves a browser
+dashboard with the score chart, per-layer update:param ratio chart (THE
+learning-rate diagnostic), and memory — SURVEY.md §2.2 "UI server".  Same
+UX here on a stdlib http.server (no web-framework dependency): canvas
+charts, auto-refresh, JSON API.
+
+    server = UIServer.get_instance()      # lazy singleton, ephemeral port
+    server.attach(storage)
+    print(server.url)                     # http://127.0.0.1:<port>/
+
+JSON API: /api/sessions, /api/stats?session=<id>.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import parse_qs, urlparse
+
+_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu — training</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:24px;background:#fafafa;color:#222}
+ h1{font-size:18px} h2{font-size:14px;margin:18px 0 4px}
+ .row{display:flex;gap:24px;flex-wrap:wrap}
+ canvas{background:#fff;border:1px solid #ddd;border-radius:6px}
+ #meta{color:#666;font-size:12px} select{margin-left:8px}
+ .legend{font-size:11px;color:#555}
+</style></head><body>
+<h1>deeplearning4j_tpu training dashboard
+  <select id="session"></select></h1>
+<div id="meta"></div>
+<div class="row">
+ <div><h2>score</h2><canvas id="score" width="560" height="260"></canvas></div>
+ <div><h2>update : param mean-magnitude ratio (log10)</h2>
+   <canvas id="ratio" width="560" height="260"></canvas>
+   <div class="legend" id="ratioLegend"></div></div>
+ <div><h2>device memory (MiB)</h2><canvas id="mem" width="560" height="260"></canvas></div>
+</div>
+<script>
+const colors=['#2563eb','#dc2626','#16a34a','#9333ea','#ea580c','#0891b2',
+              '#be185d','#65a30d','#7c3aed','#b91c1c'];
+function drawLines(cv, series, labels){
+ const c=cv.getContext('2d'); c.clearRect(0,0,cv.width,cv.height);
+ let all=series.flat().filter(v=>Number.isFinite(v)); if(!all.length) return;
+ let mn=Math.min(...all), mx=Math.max(...all); if(mn===mx){mn-=1;mx+=1}
+ const W=cv.width-50, H=cv.height-30;
+ c.strokeStyle='#999'; c.strokeRect(40,5,W,H);
+ c.fillStyle='#666'; c.font='10px sans-serif';
+ c.fillText(mx.toPrecision(4),2,12); c.fillText(mn.toPrecision(4),2,H);
+ series.forEach((ys,si)=>{
+  c.strokeStyle=colors[si%colors.length]; c.beginPath();
+  ys.forEach((y,i)=>{
+   if(!Number.isFinite(y)) return;
+   const px=40+W*i/Math.max(ys.length-1,1), py=5+H*(1-(y-mn)/(mx-mn));
+   i?c.lineTo(px,py):c.moveTo(px,py);
+  }); c.stroke();
+ });
+}
+async function refresh(){
+ const sess=document.getElementById('session');
+ const sessions=await (await fetch('api/sessions')).json();
+ if(sess.options.length!==sessions.length){
+  sess.innerHTML=sessions.map(s=>`<option>${s}</option>`).join('');
+ }
+ if(!sess.value) return;
+ const recs=await (await fetch('api/stats?session='+sess.value)).json();
+ if(!recs.length) return;
+ const last=recs[recs.length-1];
+ document.getElementById('meta').textContent=
+  `iteration ${last.iteration} · epoch ${last.epoch} · score `
+  +(Number.isFinite(last.score)?last.score.toPrecision(5):'NaN')
+  +(last.samples_per_sec?` · ${Math.round(last.samples_per_sec)} samples/s`:'');
+ drawLines(document.getElementById('score'),[recs.map(r=>r.score)]);
+ const layers=Object.keys(last.update_ratio||{});
+ drawLines(document.getElementById('ratio'),
+  layers.map(l=>recs.map(r=>{
+   const v=(r.update_ratio||{})[l]; return v>0?Math.log10(v):NaN;})));
+ document.getElementById('ratioLegend').innerHTML=
+  layers.map((l,i)=>`<span style="color:${colors[i%colors.length]}">■ ${l}</span>`).join(' ');
+ drawLines(document.getElementById('mem'),
+  [recs.map(r=>r.memory?r.memory.bytes_in_use/1048576:NaN)]);
+}
+setInterval(refresh,2000); refresh();
+</script></body></html>"""
+
+
+class UIServer:
+    """Lazy singleton HTTP dashboard over attached StatsStorage objects."""
+
+    _instance: Optional["UIServer"] = None
+
+    @classmethod
+    def get_instance(cls, port: int = 0) -> "UIServer":
+        if cls._instance is None:
+            cls._instance = cls(port)
+        return cls._instance
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._storages: list = []
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):      # quiet
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                if u.path in ("/", "/index.html"):
+                    body = _PAGE.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif u.path == "/api/sessions":
+                    out = []
+                    for s in outer._storages:
+                        out.extend(s.list_sessions())
+                    self._json(sorted(set(out)))
+                elif u.path == "/api/stats":
+                    sid = parse_qs(u.query).get("session", [""])[0]
+                    recs = []
+                    for s in outer._storages:
+                        recs.extend(s.get_records(sid))
+                    recs.sort(key=lambda r: r.get("iteration", 0))
+                    self._json(recs)
+                else:
+                    self._json({"error": "not found"}, 404)
+
+        self._server = ThreadingHTTPServer((host, port), Handler)
+        self._server.daemon_threads = True
+        self.port = self._server.server_address[1]
+        self.url = f"http://{host}:{self.port}/"
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, daemon=True
+        )
+        self._thread.start()
+
+    def attach(self, storage) -> "UIServer":
+        if storage not in self._storages:
+            self._storages.append(storage)
+        return self
+
+    def detach(self, storage) -> None:
+        if storage in self._storages:
+            self._storages.remove(storage)
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if UIServer._instance is self:
+            UIServer._instance = None

@@ -1,0 +1,196 @@
+"""StatsListener + StatsStorage — training telemetry collection.
+
+Reference roles: `org.deeplearning4j.ui.model.stats.StatsListener` (collects
+score, param/gradient/update mean magnitudes & ratios, memory) and
+`org.deeplearning4j.core.storage.StatsStorage` (`InMemoryStatsStorage`,
+`FileStatsStorage` over MapDB) — SURVEY.md §5.5.
+
+TPU-native differences: stats are computed by ONE jitted reduction over the
+param pytree (scalars only cross the device boundary — no histogram
+downloads from HBM), the update magnitude is derived from a kept device
+copy of the previous params (the compiled step doesn't expose gradients,
+and |Δw|/|w| per iteration is the diagnostic the reference's dashboard is
+actually used for: learning-rate tuning), and device memory comes from
+PJRT's memory_stats().  Storage is jsonl — newline-delimited records any
+tool can tail.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+
+def _finite(v: float):
+    """Non-finite floats become None: json.dumps would emit bare NaN/Infinity
+    (invalid JSON) and the dashboard's fetch().json() would break exactly
+    when training diverges — the moment the dashboard matters most."""
+    import math
+
+    v = float(v)
+    return v if math.isfinite(v) else None
+
+
+class StatsStorage:
+    """Record sink + query API (one 'session' = one training run)."""
+
+    def put_record(self, record: dict) -> None:
+        raise NotImplementedError
+
+    def list_sessions(self) -> list[str]:
+        raise NotImplementedError
+
+    def get_records(self, session_id: str) -> list[dict]:
+        raise NotImplementedError
+
+    def latest(self, session_id: str) -> Optional[dict]:
+        recs = self.get_records(session_id)
+        return recs[-1] if recs else None
+
+
+class InMemoryStatsStorage(StatsStorage):
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._records: dict[str, list[dict]] = {}
+
+    def put_record(self, record: dict) -> None:
+        with self._lock:
+            self._records.setdefault(record["session"], []).append(record)
+
+    def list_sessions(self) -> list[str]:
+        with self._lock:
+            return sorted(self._records)
+
+    def get_records(self, session_id: str) -> list[dict]:
+        with self._lock:
+            return list(self._records.get(session_id, []))
+
+
+class FileStatsStorage(StatsStorage):
+    """Append-only jsonl file; readable while training writes."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self._lock = threading.Lock()
+        os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def put_record(self, record: dict) -> None:
+        line = json.dumps(record)
+        with self._lock, open(self.path, "a") as f:
+            f.write(line + "\n")
+
+    def _read(self) -> list[dict]:
+        if not os.path.exists(self.path):
+            return []
+        with open(self.path) as f:
+            return [json.loads(l) for l in f if l.strip()]
+
+    def list_sessions(self) -> list[str]:
+        return sorted({r["session"] for r in self._read()})
+
+    def get_records(self, session_id: str) -> list[dict]:
+        return [r for r in self._read() if r["session"] == session_id]
+
+
+def device_memory_stats() -> Optional[dict]:
+    """PJRT live/peak HBM numbers for device 0 (None when the backend
+    doesn't report, e.g. CPU)."""
+    import jax
+
+    try:
+        stats = jax.local_devices()[0].memory_stats()
+    except Exception:
+        return None
+    if not stats:
+        return None
+    keep = {
+        k: int(v)
+        for k, v in stats.items()
+        if k in ("bytes_in_use", "peak_bytes_in_use", "bytes_limit",
+                 "largest_alloc_size")
+    }
+    return keep or None
+
+
+class StatsListener(TrainingListener):
+    """Collects per-iteration stats into a StatsStorage.
+
+    track_updates=True keeps a device copy of the previous params to report
+    the mean |Δw|/|w| ratio per layer (costs one extra params-sized buffer
+    in HBM; turn off for memory-tight runs).
+    """
+
+    def __init__(self, storage: StatsStorage, frequency: int = 1,
+                 session_id: Optional[str] = None, track_updates: bool = True):
+        self.storage = storage
+        self.frequency = max(1, frequency)
+        self.session_id = session_id or f"train_{int(time.time())}"
+        self.track_updates = track_updates
+        self._prev_params = None
+        self._stat_fn = None
+        self._last_time = None
+
+    def _build_stat_fn(self):
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def stats(params, prev):
+            mags = {}
+            ratios = {}
+            for lname, sub in params.items():
+                leaves = jax.tree.leaves(sub)
+                total = sum(jnp.sum(jnp.abs(l.astype(jnp.float32))) for l in leaves)
+                count = sum(l.size for l in leaves)
+                mag = total / jnp.maximum(count, 1)
+                mags[lname] = mag
+                if prev is not None:
+                    pleaves = jax.tree.leaves(prev[lname])
+                    dtotal = sum(
+                        jnp.sum(jnp.abs(a.astype(jnp.float32) - b.astype(jnp.float32)))
+                        for a, b in zip(leaves, pleaves)
+                    )
+                    ratios[lname] = (dtotal / jnp.maximum(count, 1)) / jnp.maximum(mag, 1e-12)
+            return mags, ratios
+
+        return stats
+
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency:
+            return
+        import jax
+
+        now = time.time()
+        if self._stat_fn is None:
+            self._stat_fn = self._build_stat_fn()
+        prev = self._prev_params if self.track_updates else None
+        mags, ratios = self._stat_fn(model.params, prev)
+        record = {
+            "session": self.session_id,
+            "time": now,
+            "iteration": int(iteration),
+            "epoch": int(epoch),
+            "score": _finite(score),
+            "param_mean_magnitude": {k: _finite(v) for k, v in mags.items()},
+            "update_ratio": {k: _finite(v) for k, v in ratios.items()},
+        }
+        if self._last_time is not None and getattr(model, "last_batch_size", 0):
+            dt = now - self._last_time
+            if dt > 0:
+                record["samples_per_sec"] = model.last_batch_size * self.frequency / dt
+        self._last_time = now
+        mem = device_memory_stats()
+        if mem:
+            record["memory"] = mem
+        self.storage.put_record(record)
+        if self.track_updates:
+            import jax.numpy as jnp
+
+            # a REAL device copy: the step donates its param buffers, so an
+            # alias would be a deleted array by the next iteration
+            self._prev_params = jax.tree.map(jnp.copy, model.params)

@@ -1,0 +1,172 @@
+"""L7 observability tests — StatsListener -> StatsStorage -> UIServer REST
+round trip (the reference's UI test pattern, SURVEY.md §4.1 "UI tests"),
+profiler trace capture, and the OOM crash report."""
+
+import json
+import os
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.ui import (
+    FileStatsStorage,
+    InMemoryStatsStorage,
+    ProfilerListener,
+    StatsListener,
+    UIServer,
+)
+
+
+def small_model():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(4)
+        .updater(Sgd(0.1))
+        .list()
+        .layer(Dense(n_out=8, activation=Activation.TANH))
+        .layer(OutputLayer(n_out=3, loss=Loss.MCXENT,
+                           activation=Activation.SOFTMAX))
+        .set_input_type(InputType.feed_forward(5))
+        .build()
+    )
+    return SequentialModel(conf).init()
+
+
+def batch(seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(0, 1, (16, 5)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    return DataSet(x, y)
+
+
+class TestStatsListener:
+    def test_records_score_params_and_ratios(self):
+        storage = InMemoryStatsStorage()
+        m = small_model()
+        m.set_listeners(StatsListener(storage, session_id="s1"))
+        for i in range(5):
+            m.fit_batch(batch(i))
+        recs = storage.get_records("s1")
+        assert len(recs) == 5
+        assert recs[0]["iteration"] == 1 and recs[-1]["iteration"] == 5
+        for r in recs:
+            assert np.isfinite(r["score"])
+            assert set(r["param_mean_magnitude"]) == {"layer0", "layer1"} or \
+                len(r["param_mean_magnitude"]) == 2
+        # update ratios appear from the second record on and are positive
+        assert all(v > 0 for v in recs[2]["update_ratio"].values())
+
+    def test_file_storage_roundtrip(self, tmp_path):
+        path = str(tmp_path / "stats.jsonl")
+        storage = FileStatsStorage(path)
+        m = small_model()
+        m.set_listeners(StatsListener(storage, session_id="file_sess"))
+        for i in range(3):
+            m.fit_batch(batch(i))
+        assert storage.list_sessions() == ["file_sess"]
+        recs = storage.get_records("file_sess")
+        assert len(recs) == 3
+        # raw file is valid jsonl
+        lines = [json.loads(l) for l in open(path)]
+        assert len(lines) == 3
+
+    def test_frequency_thins_records(self):
+        storage = InMemoryStatsStorage()
+        m = small_model()
+        m.set_listeners(StatsListener(storage, frequency=3, session_id="s"))
+        for i in range(7):
+            m.fit_batch(batch(i))
+        assert [r["iteration"] for r in storage.get_records("s")] == [3, 6]
+
+
+class TestUIServer:
+    def test_rest_roundtrip(self):
+        storage = InMemoryStatsStorage()
+        m = small_model()
+        m.set_listeners(StatsListener(storage, session_id="ui_sess"))
+        for i in range(4):
+            m.fit_batch(batch(i))
+        server = UIServer(port=0)
+        try:
+            server.attach(storage)
+            with urllib.request.urlopen(server.url + "api/sessions") as r:
+                sessions = json.load(r)
+            assert "ui_sess" in sessions
+            with urllib.request.urlopen(
+                server.url + "api/stats?session=ui_sess"
+            ) as r:
+                recs = json.load(r)
+            assert len(recs) == 4
+            assert recs[0]["iteration"] == 1
+            with urllib.request.urlopen(server.url) as r:
+                page = r.read().decode()
+            assert "dashboard" in page and "canvas" in page
+        finally:
+            server.stop()
+
+    def test_singleton_attach_detach(self):
+        server = UIServer.get_instance()
+        try:
+            s = InMemoryStatsStorage()
+            s.put_record({"session": "x", "iteration": 0, "score": 1.0})
+            server.attach(s)
+            with urllib.request.urlopen(server.url + "api/sessions") as r:
+                assert "x" in json.load(r)
+            server.detach(s)
+            with urllib.request.urlopen(server.url + "api/sessions") as r:
+                assert "x" not in json.load(r)
+        finally:
+            server.stop()
+
+
+class TestProfilerListener:
+    def test_trace_captured(self, tmp_path):
+        d = str(tmp_path / "prof")
+        m = small_model()
+        lst = ProfilerListener(d, start_iteration=2, num_iterations=2)
+        m.set_listeners(lst)
+        for i in range(6):
+            m.fit_batch(batch(i))
+        lst.close()
+        assert lst.captured
+        # jax writes plugins/profile/<run>/ trees with .xplane.pb files
+        found = []
+        for root, _, files in os.walk(d):
+            found.extend(f for f in files if f.endswith((".xplane.pb", ".trace.json.gz", ".pb")))
+        assert found, f"no trace artifacts under {d}"
+
+
+class TestCrashReport:
+    def test_memory_report_contents(self, tmp_path):
+        from deeplearning4j_tpu.runtime.crash import write_memory_report
+
+        m = small_model()
+        m.fit_batch(batch())
+        path = write_memory_report(str(tmp_path / "report.txt"), header="TEST")
+        text = open(path).read()
+        assert "device memory report" in text
+        assert "live jax.Array buffers" in text
+        assert "TEST" in text
+        assert "MB" in text
+
+    def test_oom_detection_and_report(self, tmp_path, monkeypatch):
+        from deeplearning4j_tpu.runtime import crash
+
+        monkeypatch.setenv(crash.ENV_CRASH_DIR, str(tmp_path))
+        err = RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 1TB")
+        path = crash.maybe_write_oom_report(err)
+        assert path and os.path.exists(path)
+        assert "RESOURCE_EXHAUSTED" in open(path).read()
+        assert crash.maybe_write_oom_report(ValueError("shape mismatch")) is None
