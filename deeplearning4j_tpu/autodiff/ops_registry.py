@@ -97,6 +97,14 @@ def _deconv2d(x, w, *, stride=(2, 2), padding="SAME"):
     )
 
 
+def _onnx_slice(x, *, starts, ends, axes):
+    big = 2**31 - 1
+    sl = [slice(None)] * x.ndim
+    for s, e, a in zip(starts, ends, axes):
+        sl[a % x.ndim] = slice(s, None if e >= big else e)
+    return x[tuple(sl)]
+
+
 def _batch_norm(x, mean, var, gamma, beta, *, epsilon=1e-5):
     return (x - mean) * jax.lax.rsqrt(var + epsilon) * gamma + beta
 
@@ -179,6 +187,13 @@ OPS: dict[str, callable] = {
     "tensordot": lambda a, b, *, axes=2: jnp.tensordot(a, b, axes=axes),
     # shape
     "reshape": lambda x, *, shape: jnp.reshape(x, shape),
+    # ONNX Reshape semantics: 0 = copy the input's dim at that position
+    "onnx_reshape": lambda x, *, shape: jnp.reshape(
+        x, tuple(x.shape[i] if s == 0 else s for i, s in enumerate(shape))
+    ),
+    # ONNX Slice semantics: negative starts/ends/axes count from the end
+    # (Python's exact slicing rules); INT64_MAX-ish ends mean "to the end"
+    "onnx_slice": _onnx_slice,
     "concat": lambda *xs, axis=-1: jnp.concatenate(xs, axis=axis),
     "stack": lambda *xs, axis=0: jnp.stack(xs, axis=axis),
     "squeeze": lambda x, *, axis: jnp.squeeze(x, axis=axis),

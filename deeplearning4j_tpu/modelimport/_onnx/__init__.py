@@ -1,0 +1,1 @@
+"""Vendored minimal ONNX protobuf codec (see onnx_subset.proto)."""

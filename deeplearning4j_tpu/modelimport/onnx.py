@@ -1,0 +1,600 @@
+"""ONNX import into compiled SameDiff graphs.
+
+Reference role: `samediff-import-onnx` (SURVEY.md §2.2 "TF/ONNX import") —
+per-op mapping of an ONNX GraphProto into the autodiff graph, alongside the
+TF frozen-GraphDef importer in `modelimport/tensorflow.py`.
+
+No dependency on the `onnx` package: the proto codec is generated from a
+hand-transcribed subset of the public ONNX schema (identical field numbers
+— see `_onnx/onnx_subset.proto`), parsed by the protobuf runtime; unknown
+fields in real files are skipped by protobuf semantics.
+
+Layout note: ONNX is NCHW; this framework's conv/pool ops are NHWC (the
+TPU-fast layout).  Mappers transpose at conv/pool boundaries — XLA cancels
+adjacent transposes between consecutive conv ops, so imported CNNs pay for
+the layout change once at the edges, not per layer.
+
+Opset coverage targets the MLP/CNN/BERT-block surface (matmul/gemm chains,
+conv/pool/batchnorm stacks, attention blocks decomposed to
+MatMul/Transpose/Reshape/Softmax/LayerNormalization/Erf-gelu).  Unmapped
+ops raise ONNXImportError naming the op.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+
+
+class ONNXImportError(ValueError):
+    pass
+
+
+def _pb2():
+    from deeplearning4j_tpu.modelimport._onnx import onnx_subset_pb2
+
+    return onnx_subset_pb2
+
+
+# TensorProto.DataType -> (numpy dtype, typed-field name)
+_DTYPES = {
+    1: (np.float32, "float_data"),
+    2: (np.uint8, "int32_data"),
+    3: (np.int8, "int32_data"),
+    6: (np.int32, "int32_data"),
+    7: (np.int64, "int64_data"),
+    9: (np.bool_, "int32_data"),
+    11: (np.float64, "double_data"),
+    13: (np.uint64, "uint64_data"),
+}
+
+
+def tensor_to_np(t) -> np.ndarray:
+    dims = tuple(t.dims)
+    if t.data_type not in _DTYPES:
+        raise ONNXImportError(
+            f"tensor {t.name!r}: unsupported ONNX data_type {t.data_type}"
+        )
+    dtype, field = _DTYPES[t.data_type]
+    if t.raw_data:
+        arr = np.frombuffer(t.raw_data, dtype=np.dtype(dtype).newbyteorder("<"))
+    else:
+        arr = np.asarray(list(getattr(t, field)), dtype=dtype)
+    return arr.astype(dtype).reshape(dims)
+
+
+def _attrs(node) -> dict:
+    out = {}
+    for a in node.attribute:
+        if a.type == 1:          # FLOAT
+            out[a.name] = float(a.f)
+        elif a.type == 2:        # INT
+            out[a.name] = int(a.i)
+        elif a.type == 3:        # STRING
+            out[a.name] = a.s.decode()
+        elif a.type == 4:        # TENSOR
+            out[a.name] = tensor_to_np(a.t)
+        elif a.type == 6:        # FLOATS
+            out[a.name] = [float(v) for v in a.floats]
+        elif a.type == 7:        # INTS
+            out[a.name] = [int(v) for v in a.ints]
+        elif a.type == 8:        # STRINGS
+            out[a.name] = [s.decode() for s in a.strings]
+        else:
+            raise ONNXImportError(
+                f"node {node.name!r}: unsupported attribute type {a.type} "
+                f"for {a.name!r}"
+            )
+    return out
+
+
+_NCHW_TO_NHWC = (0, 2, 3, 1)
+_NHWC_TO_NCHW = (0, 3, 1, 2)
+
+
+class _Importer:
+    def __init__(self, model, trainable: bool = False):
+        self.model = model
+        self.g = model.graph
+        self.sd = SameDiff()
+        self.trainable = trainable
+        self.vars: Dict[str, SDVariable] = {}
+        self.consts: Dict[str, np.ndarray] = {}
+        self._promoted: Dict[int, SDVariable] = {}   # id(array) -> its var
+
+    # -- value resolution --------------------------------------------------
+    def _const_var(self, name: str, value: np.ndarray) -> SDVariable:
+        if (
+            self.trainable
+            and np.issubdtype(value.dtype, np.floating)
+            and value.ndim >= 1
+        ):
+            # one var per underlying tensor: an initializer aliased through
+            # Identity (tied weights) must not become two independently
+            # trained copies that drift apart (mirrors the TF importer's
+            # _promoted map)
+            key = id(value)
+            if key not in self._promoted:
+                self._promoted[key] = self.sd.var(name, value.astype(np.float32))
+            return self._promoted[key]
+        return self.sd.constant(name, value)
+
+    def in_var(self, name: str) -> SDVariable:
+        if name not in self.vars:
+            if name in self.consts:
+                self.vars[name] = self._const_var(name, self.consts[name])
+            else:
+                raise ONNXImportError(f"input {name!r} resolves to no value")
+        return self.vars[name]
+
+    def static_value(self, name: str) -> np.ndarray:
+        if name not in self.consts:
+            raise ONNXImportError(
+                f"input {name!r} must be a compile-time constant (dynamic "
+                "shapes/indices do not compile to a static XLA program)"
+            )
+        return self.consts[name]
+
+    def _opt_static(self, node, idx, default=None):
+        """Optional constant input #idx (ONNX optionals are ''/missing)."""
+        if len(node.input) <= idx or not node.input[idx]:
+            return default
+        return self.static_value(node.input[idx])
+
+    # -- driver ------------------------------------------------------------
+    def run(self) -> SameDiff:
+        for init in self.g.initializer:
+            self.consts[init.name] = tensor_to_np(init)
+        init_names = set(self.consts)
+        for vi in self.g.input:
+            if vi.name in init_names:
+                continue
+            shape = None
+            tt = vi.type.tensor_type
+            if tt.shape.dim:
+                shape = tuple(
+                    d.dim_value if d.WhichOneof("value") == "dim_value" else None
+                    for d in tt.shape.dim
+                )
+            self.vars[vi.name] = self.sd.placeholder(vi.name, shape=shape)
+        for node in self.g.node:           # ONNX graphs are topo-sorted
+            fn = getattr(self, f"op_{node.op_type}", None)
+            if fn is None:
+                raise ONNXImportError(
+                    f"unmapped ONNX op {node.op_type!r} (node {node.name!r})"
+                )
+            fn(node)
+        # const-folded outputs (Constant / Identity-of-initializer) live in
+        # self.consts; in_var materializes them so they count as produced
+        missing = []
+        for o in self.g.output:
+            if o.name in self.vars or o.name in self.consts:
+                self.in_var(o.name)
+            else:
+                missing.append(o.name)
+        if missing:
+            raise ONNXImportError(f"graph outputs never produced: {missing}")
+        # aliased outputs (Identity/Gemm/BatchNorm compositions) may carry a
+        # different internal var name; pin the declared output name so
+        # sd.output(..., <onnx name>) resolves
+        for o in self.g.output:
+            v = self.vars[o.name]
+            if v.name != o.name:
+                self.vars[o.name] = self.sd.apply("identity", v, name=o.name)
+        self.sd.onnx_outputs = [o.name for o in self.g.output]
+        return self.sd
+
+    def _emit(self, node, op: str, *inputs: SDVariable, **attrs) -> SDVariable:
+        out = self.sd.apply(op, *inputs, name=node.output[0], **attrs)
+        self.vars[node.output[0]] = out
+        return out
+
+    def _alias(self, node, var: SDVariable) -> None:
+        self.vars[node.output[0]] = var
+
+    # -- constants / structure ---------------------------------------------
+    def op_Constant(self, node):
+        a = _attrs(node)
+        if "value" not in a:
+            raise ONNXImportError(f"Constant {node.name!r}: only 'value' supported")
+        self.consts[node.output[0]] = np.asarray(a["value"])
+
+    def op_Identity(self, node):
+        if node.input[0] in self.consts:
+            self.consts[node.output[0]] = self.consts[node.input[0]]
+        else:
+            self._alias(node, self.in_var(node.input[0]))
+
+    def op_Cast(self, node):
+        to = _attrs(node).get("to", 1)
+        if to not in _DTYPES:
+            raise ONNXImportError(
+                f"Cast to ONNX data_type {to} is not mapped"
+            )
+        np_dtype = _DTYPES[to][0]
+        if node.input[0] in self.consts:
+            self.consts[node.output[0]] = self.consts[node.input[0]].astype(np_dtype)
+            return
+        self._emit(node, "cast", self.in_var(node.input[0]),
+                   dtype=np.dtype(np_dtype).name)
+
+    def op_Dropout(self, node):            # inference: identity
+        self._alias(node, self.in_var(node.input[0]))
+
+    def op_Reshape(self, node):
+        shape = [int(s) for s in self.static_value(node.input[1])]
+        # onnx_reshape implements ONNX's 0-means-copy-input-dim semantics
+        self._emit(node, "onnx_reshape", self.in_var(node.input[0]), shape=shape)
+
+    def op_Flatten(self, node):
+        axis = _attrs(node).get("axis", 1)
+        if axis != 1:
+            raise ONNXImportError(f"Flatten axis={axis} unsupported (axis=1 only)")
+        self._emit(node, "onnx_reshape", self.in_var(node.input[0]), shape=[0, -1])
+
+    def op_Transpose(self, node):
+        perm = _attrs(node).get("perm")
+        self._emit(node, "transpose", self.in_var(node.input[0]),
+                   axes=[int(p) for p in perm] if perm else None)
+
+    def op_Squeeze(self, node):
+        axes = self._opt_static(node, 1)
+        if axes is None:
+            axes = _attrs(node).get("axes")
+        if axes is None:
+            raise ONNXImportError("Squeeze without axes unsupported")
+        self._emit(node, "squeeze", self.in_var(node.input[0]),
+                   axis=tuple(int(a) for a in np.atleast_1d(axes)))
+
+    def op_Unsqueeze(self, node):
+        axes = self._opt_static(node, 1)
+        if axes is None:
+            axes = _attrs(node).get("axes")
+        v = self.in_var(node.input[0])
+        for a in sorted(int(x) for x in np.atleast_1d(axes)):
+            v = self.sd.apply("expand_dims", v, axis=a)
+        self._alias(node, v)
+
+    def op_Concat(self, node):
+        axis = _attrs(node).get("axis", 0)
+        self._emit(node, "concat", *[self.in_var(i) for i in node.input],
+                   axis=int(axis))
+
+    def op_Gather(self, node):
+        axis = _attrs(node).get("axis", 0)
+        self._emit(node, "gather", self.in_var(node.input[0]),
+                   self.in_var(node.input[1]), axis=int(axis))
+
+    def op_Slice(self, node):
+        starts = [int(v) for v in self.static_value(node.input[1])]
+        ends = [int(v) for v in self.static_value(node.input[2])]
+        axes = self._opt_static(node, 3)
+        steps = self._opt_static(node, 4)
+        if steps is not None and any(int(s) != 1 for s in np.atleast_1d(steps)):
+            raise ONNXImportError("Slice with step != 1 unsupported")
+        if axes is None:
+            axes = list(range(len(starts)))
+        # onnx_slice keeps ONNX's negative starts/ends/axes semantics intact
+        # (clamping included) — mapping onto begin/size here would get the
+        # negative cases wrong
+        self._emit(node, "onnx_slice", self.in_var(node.input[0]),
+                   starts=starts, ends=ends,
+                   axes=[int(a) for a in np.atleast_1d(axes)])
+
+    def op_Pad(self, node):
+        mode = _attrs(node).get("mode", "constant")
+        if mode != "constant":
+            raise ONNXImportError(f"Pad mode {mode!r} unsupported")
+        pads = [int(v) for v in self.static_value(node.input[1])]
+        value = self._opt_static(node, 2, default=np.float32(0.0))
+        half = len(pads) // 2
+        paddings = [[pads[i], pads[half + i]] for i in range(half)]
+        self._emit(node, "pad", self.in_var(node.input[0]),
+                   paddings=paddings, constant_values=float(value))
+
+    def op_Tile(self, node):
+        reps = [int(v) for v in self.static_value(node.input[1])]
+        self._emit(node, "tile", self.in_var(node.input[0]), reps=reps)
+
+    # -- elementwise math ---------------------------------------------------
+    def _binop(self, node, op):
+        self._emit(node, op, self.in_var(node.input[0]), self.in_var(node.input[1]))
+
+    def op_Add(self, node):
+        self._binop(node, "add")
+
+    def op_Sub(self, node):
+        self._binop(node, "sub")
+
+    def op_Mul(self, node):
+        self._binop(node, "mul")
+
+    def op_Div(self, node):
+        self._binop(node, "div")
+
+    def op_Pow(self, node):
+        self._binop(node, "pow")
+
+    def op_Min(self, node):
+        if len(node.input) != 2:
+            raise ONNXImportError("Min supports exactly 2 inputs")
+        self._binop(node, "minimum")
+
+    def op_Max(self, node):
+        if len(node.input) != 2:
+            raise ONNXImportError("Max supports exactly 2 inputs")
+        self._binop(node, "maximum")
+
+    def op_Equal(self, node):
+        self._binop(node, "equal")
+
+    def op_Greater(self, node):
+        self._binop(node, "greater")
+
+    def op_Less(self, node):
+        self._binop(node, "less")
+
+    def op_Where(self, node):
+        self._emit(node, "where", self.in_var(node.input[0]),
+                   self.in_var(node.input[1]), self.in_var(node.input[2]))
+
+    def _unop(self, node, op, **attrs):
+        self._emit(node, op, self.in_var(node.input[0]), **attrs)
+
+    def op_Neg(self, node):
+        self._unop(node, "neg")
+
+    def op_Abs(self, node):
+        self._unop(node, "abs")
+
+    def op_Exp(self, node):
+        self._unop(node, "exp")
+
+    def op_Log(self, node):
+        self._unop(node, "log")
+
+    def op_Sqrt(self, node):
+        self._unop(node, "sqrt")
+
+    def op_Erf(self, node):
+        self._unop(node, "erf")
+
+    def op_Reciprocal(self, node):
+        self._unop(node, "reciprocal")
+
+    def op_Clip(self, node):
+        lo = self._opt_static(node, 1)
+        hi = self._opt_static(node, 2)
+        a = _attrs(node)
+        lo = a.get("min") if lo is None else lo
+        hi = a.get("max") if hi is None else hi
+        self._emit(node, "clip", self.in_var(node.input[0]),
+                   lo=float(-np.inf if lo is None else lo),
+                   hi=float(np.inf if hi is None else hi))
+
+    # -- activations --------------------------------------------------------
+    def op_Relu(self, node):
+        self._unop(node, "relu")
+
+    def op_LeakyRelu(self, node):
+        self._unop(node, "leaky_relu",
+                   alpha=_attrs(node).get("alpha", 0.01))
+
+    def op_Sigmoid(self, node):
+        self._unop(node, "sigmoid")
+
+    def op_Tanh(self, node):
+        self._unop(node, "tanh")
+
+    def op_Elu(self, node):
+        self._unop(node, "elu")
+
+    def op_Softplus(self, node):
+        self._unop(node, "softplus")
+
+    def op_Gelu(self, node):
+        self._unop(node, "gelu")
+
+    def op_Softmax(self, node):
+        axis = _attrs(node).get("axis", -1)
+        self._unop(node, "softmax", axis=int(axis))
+
+    def op_LogSoftmax(self, node):
+        axis = _attrs(node).get("axis", -1)
+        self._unop(node, "log_softmax", axis=int(axis))
+
+    # -- reductions ---------------------------------------------------------
+    def _reduce(self, node, op):
+        a = _attrs(node)
+        axes = a.get("axes")
+        if axes is None and len(node.input) > 1 and node.input[1]:
+            axes = [int(v) for v in self.static_value(node.input[1])]
+        keepdims = bool(a.get("keepdims", 1))
+        self._emit(node, op, self.in_var(node.input[0]),
+                   axis=[int(x) for x in axes] if axes is not None else None,
+                   keepdims=keepdims)
+
+    def op_ReduceMean(self, node):
+        self._reduce(node, "mean")
+
+    def op_ReduceSum(self, node):
+        self._reduce(node, "sum")
+
+    def op_ReduceMax(self, node):
+        self._reduce(node, "max")
+
+    def op_ReduceMin(self, node):
+        self._reduce(node, "min")
+
+    # -- linear algebra -----------------------------------------------------
+    def op_MatMul(self, node):
+        self._binop(node, "matmul")
+
+    def op_Gemm(self, node):
+        a = _attrs(node)
+        alpha, beta = a.get("alpha", 1.0), a.get("beta", 1.0)
+        A, B = self.in_var(node.input[0]), self.in_var(node.input[1])
+        if a.get("transA"):
+            A = self.sd.apply("transpose", A, axes=None)
+        if a.get("transB"):
+            B = self.sd.apply("transpose", B, axes=None)
+        y = self.sd.apply("matmul", A, B)
+        if alpha != 1.0:
+            y = y * float(alpha)
+        if len(node.input) > 2 and node.input[2]:
+            C = self.in_var(node.input[2])
+            y = y + (C * float(beta) if beta != 1.0 else C)
+        self._alias(node, y)
+
+    # -- conv / pool / norm (NCHW -> NHWC at the boundary) -------------------
+    @staticmethod
+    def _conv_padding(attrs, spatial: int):
+        auto = attrs.get("auto_pad", "NOTSET")
+        if auto == "SAME_UPPER":
+            return "SAME"
+        if auto == "SAME_LOWER":
+            # XLA's "SAME" is SAME_UPPER; with odd total pad the extra pixel
+            # lands on the wrong side — silently shifted outputs
+            raise ONNXImportError(
+                "auto_pad=SAME_LOWER is not mapped (re-export with explicit "
+                "pads or SAME_UPPER)"
+            )
+        if auto == "VALID":
+            return "VALID"
+        pads = attrs.get("pads")
+        if not pads or not any(pads):
+            return "VALID"
+        return [[int(pads[i]), int(pads[spatial + i])] for i in range(spatial)]
+
+    def op_Conv(self, node):
+        a = _attrs(node)
+        group = a.get("group", 1)
+        stride = [int(s) for s in a.get("strides", [1, 1])]
+        dilation = [int(d) for d in a.get("dilations", [1, 1])]
+        if len(stride) != 2:
+            raise ONNXImportError("only 2-D Conv is mapped")
+        x = self.sd.apply("transpose", self.in_var(node.input[0]),
+                          axes=list(_NCHW_TO_NHWC))
+        w = self.in_var(node.input[1])          # (O, I/g, kH, kW)
+        padding = self._conv_padding(a, 2)
+        if group == 1:
+            w = self.sd.apply("transpose", w, axes=[2, 3, 1, 0])   # HWIO
+            y = self.sd.apply("conv2d", x, w, stride=stride,
+                              padding=padding, dilation=dilation)
+        else:
+            wv = self.consts.get(node.input[1])
+            c_in = wv.shape[0] if wv is not None else None
+            if wv is None or not (group == c_in and wv.shape[1] == 1):
+                raise ONNXImportError(
+                    "grouped Conv is mapped only for depthwise (group == "
+                    "channels, 1 channel per group, constant weights)"
+                )
+            # (C, 1, kH, kW) -> (kH, kW, C, 1) depthwise layout
+            w = self.sd.apply("transpose", w, axes=[2, 3, 0, 1])
+            y = self.sd.apply("depthwise_conv2d", x, w, stride=stride,
+                              padding=padding, dilation=dilation)
+        if len(node.input) > 2 and node.input[2]:
+            y = y + self.in_var(node.input[2])   # bias broadcasts on last dim
+        self._emit_nchw(node, y)
+
+    def _emit_nchw(self, node, y_nhwc):
+        y = self.sd.apply("transpose", y_nhwc, axes=list(_NHWC_TO_NCHW),
+                          name=node.output[0])
+        self.vars[node.output[0]] = y
+
+    def _pool(self, node, op):
+        a = _attrs(node)
+        if a.get("ceil_mode"):
+            raise ONNXImportError(
+                f"{node.op_type} with ceil_mode=1 is not mapped (floor-mode "
+                "window shapes only)"
+            )
+        if any(int(d) != 1 for d in a.get("dilations", [])):
+            raise ONNXImportError(f"{node.op_type} with dilations is not mapped")
+        kernel = [int(k) for k in a["kernel_shape"]]
+        stride = [int(s) for s in a.get("strides", kernel)]
+        padding = self._conv_padding(a, 2)
+        if isinstance(padding, list):
+            if op == "avg_pool2d" and not a.get("count_include_pad", 0):
+                raise ONNXImportError(
+                    "AveragePool with explicit pads and count_include_pad=0 "
+                    "is not mapped (re-export with count_include_pad=1 or "
+                    "auto_pad)"
+                )
+            padding = [[0, 0]] + padding + [[0, 0]]     # NHWC window dims
+        x = self.sd.apply("transpose", self.in_var(node.input[0]),
+                          axes=list(_NCHW_TO_NHWC))
+        y = self.sd.apply(op, x, kernel=kernel, stride=stride, padding=padding)
+        self._emit_nchw(node, y)
+
+    def op_MaxPool(self, node):
+        if len(node.output) > 1:
+            raise ONNXImportError("MaxPool with Indices output unsupported")
+        self._pool(node, "max_pool2d")
+
+    def op_AveragePool(self, node):
+        self._pool(node, "avg_pool2d")
+
+    def op_GlobalAveragePool(self, node):
+        self._emit(node, "mean", self.in_var(node.input[0]),
+                   axis=[2, 3], keepdims=True)
+
+    def op_BatchNormalization(self, node):
+        a = _attrs(node)
+        if a.get("training_mode"):
+            raise ONNXImportError(
+                "BatchNormalization with training_mode=1: re-export an "
+                "inference graph"
+            )
+        if len(node.output) > 1:
+            raise ONNXImportError(
+                "BatchNormalization with training outputs unsupported"
+            )
+        eps = a.get("epsilon", 1e-5)
+        x, gamma, beta, mean, var = (self.in_var(i) for i in node.input[:5])
+        # per-channel stats broadcast over NCHW: reshape to (C, 1, 1)
+        def chan(v):
+            return self.sd.apply("reshape", v, shape=[-1, 1, 1])
+        y = (x - chan(mean)) * self.sd.apply("rsqrt", chan(var) + float(eps))
+        y = y * chan(gamma) + chan(beta)
+        self._alias(node, y)
+
+    def op_LayerNormalization(self, node):
+        a = _attrs(node)
+        axis = a.get("axis", -1)
+        if axis not in (-1,):
+            raise ONNXImportError("LayerNormalization only mapped for axis=-1")
+        eps = a.get("epsilon", 1e-5)
+        x = self.in_var(node.input[0])
+        scale = self.in_var(node.input[1])
+        if len(node.input) > 2 and node.input[2]:
+            bias = self.in_var(node.input[2])
+        else:
+            bias = self.sd.constant(
+                f"{node.output[0]}/zero_bias", np.float32(0.0)
+            )
+        self._emit(node, "layer_norm", x, scale, bias, epsilon=float(eps))
+
+
+def import_onnx(path_or_bytes, trainable: bool = False) -> SameDiff:
+    """Import an ONNX model (path, bytes, or parsed ModelProto) into a
+    compiled SameDiff graph.
+
+    Output names are recorded on the returned graph as `sd.onnx_outputs`;
+    run with `sd.output({input: value}, *sd.onnx_outputs)`.
+    `trainable=True` promotes float initializers to variables for
+    fine-tuning (mirrors the TF importer's promotion).
+    """
+    pb = _pb2()
+    m = path_or_bytes
+    if isinstance(m, str):
+        with open(m, "rb") as f:
+            m = f.read()
+    if isinstance(m, bytes):
+        proto = pb.ModelProto()
+        proto.ParseFromString(m)
+        m = proto
+    return _Importer(m, trainable=trainable).run()

@@ -620,16 +620,12 @@ def import_graph(path_or_graphdef, trainable: bool = False) -> SameDiff:
     return _Importer(gd, trainable=trainable).run()
 
 
-def import_onnx(path) -> SameDiff:
-    """ONNX import — gated: the `onnx` package is not in this environment."""
-    try:
-        import onnx  # noqa: F401
-    except ImportError as e:
-        raise ImportError(
-            "onnx is not installed in this environment; ONNX import is gated. "
-            "TF GraphDef import (import_graph) covers the frozen-graph path."
-        ) from e
-    raise NotImplementedError("ONNX mapping not yet implemented")  # pragma: no cover
+def import_onnx(path, trainable: bool = False) -> SameDiff:
+    """ONNX import — delegates to modelimport.onnx (self-contained protobuf
+    codec; needs no `onnx` package).  See that module for opset coverage."""
+    from deeplearning4j_tpu.modelimport.onnx import import_onnx as _imp
+
+    return _imp(path, trainable=trainable)
 
 
 class TFGraphMapper:

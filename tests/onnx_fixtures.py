@@ -1,0 +1,98 @@
+"""Tiny onnx.helper-equivalent for building test fixtures.
+
+Builds real serialized ONNX ModelProto bytes via the vendored protobuf
+codec — the same bytes `onnx.save` would produce for this schema subset —
+so the importer is exercised end-to-end from wire format up.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from deeplearning4j_tpu.modelimport._onnx import onnx_subset_pb2 as pb
+
+_NP_TO_ONNX = {
+    np.dtype(np.float32): 1,
+    np.dtype(np.int32): 6,
+    np.dtype(np.int64): 7,
+    np.dtype(np.bool_): 9,
+    np.dtype(np.float64): 11,
+}
+
+
+def make_tensor(name: str, arr: np.ndarray) -> "pb.TensorProto":
+    arr = np.asarray(arr)
+    t = pb.TensorProto()
+    t.name = name
+    t.dims.extend(arr.shape)
+    t.data_type = _NP_TO_ONNX[arr.dtype]
+    t.raw_data = arr.astype(arr.dtype.newbyteorder("<")).tobytes()
+    return t
+
+
+def _set_attr(node, name, value):
+    a = node.attribute.add()
+    a.name = name
+    if isinstance(value, bool):
+        a.type, a.i = 2, int(value)
+    elif isinstance(value, int):
+        a.type, a.i = 2, value
+    elif isinstance(value, float):
+        a.type, a.f = 1, value
+    elif isinstance(value, str):
+        a.type, a.s = 3, value.encode()
+    elif isinstance(value, np.ndarray):
+        a.type = 4
+        a.t.CopyFrom(make_tensor(name, value))
+    elif isinstance(value, (list, tuple)):
+        if all(isinstance(v, int) for v in value):
+            a.type = 7
+            a.ints.extend(value)
+        elif all(isinstance(v, float) for v in value):
+            a.type = 6
+            a.floats.extend(value)
+        else:
+            raise TypeError(f"mixed list attr {name}")
+    else:
+        raise TypeError(f"attr {name}: {type(value)}")
+
+
+def make_node(op_type, inputs, outputs, name="", **attrs):
+    n = pb.NodeProto()
+    n.op_type = op_type
+    n.input.extend(inputs)
+    n.output.extend(outputs)
+    n.name = name or f"{op_type}_{outputs[0]}"
+    for k, v in attrs.items():
+        _set_attr(n, k, v)
+    return n
+
+
+def make_model(nodes, inputs, outputs, initializers=None,
+               opset: int = 17) -> bytes:
+    """inputs/outputs: [(name, shape)]; initializers: {name: ndarray}.
+    Returns serialized ModelProto bytes."""
+    m = pb.ModelProto()
+    m.ir_version = 8
+    op = m.opset_import.add()
+    op.domain = ""
+    op.version = opset
+    g = m.graph
+    g.name = "test_graph"
+    for n in nodes:
+        g.node.add().CopyFrom(n)
+    for name, arr in (initializers or {}).items():
+        g.initializer.add().CopyFrom(make_tensor(name, np.asarray(arr)))
+    for name, shape in inputs:
+        vi = g.input.add()
+        vi.name = name
+        vi.type.tensor_type.elem_type = 1
+        for s in shape:
+            d = vi.type.tensor_type.shape.dim.add()
+            d.dim_value = s
+    for item in outputs:
+        name = item if isinstance(item, str) else item[0]
+        vi = g.output.add()
+        vi.name = name
+        vi.type.tensor_type.elem_type = 1
+    return m.SerializeToString()

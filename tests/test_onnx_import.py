@@ -1,0 +1,308 @@
+"""ONNX import tests — golden-file pattern (SURVEY.md §4.1 "TF import
+regression suite" applied to ONNX): build real serialized .onnx bytes,
+import into SameDiff, execute, and compare against goldens computed with
+torch (NCHW-native — an independent implementation, which cross-checks the
+importer's NCHW->NHWC boundary handling) or numpy."""
+
+import numpy as np
+import pytest
+import torch
+import torch.nn.functional as F
+
+from onnx_fixtures import make_model, make_node
+from deeplearning4j_tpu.modelimport.onnx import ONNXImportError, import_onnx
+
+RNG = np.random.default_rng(7)
+
+
+def run(sd, feeds):
+    outs = sd.output(feeds, *sd.onnx_outputs)
+    if len(sd.onnx_outputs) == 1:
+        return [np.asarray(outs)]
+    return [np.asarray(o) for o in outs]
+
+
+class TestMLP:
+    def test_gemm_relu_softmax_matches_numpy(self):
+        W1 = RNG.normal(0, 0.5, (4, 8)).astype(np.float32)
+        b1 = RNG.normal(0, 0.1, (8,)).astype(np.float32)
+        W2 = RNG.normal(0, 0.5, (8, 3)).astype(np.float32)
+        b2 = RNG.normal(0, 0.1, (3,)).astype(np.float32)
+        model = make_model(
+            [
+                make_node("Gemm", ["x", "W1", "b1"], ["h"]),
+                make_node("Relu", ["h"], ["hr"]),
+                make_node("Gemm", ["hr", "W2", "b2"], ["logits"]),
+                make_node("Softmax", ["logits"], ["probs"], axis=-1),
+            ],
+            inputs=[("x", (2, 4))],
+            outputs=["probs"],
+            initializers={"W1": W1, "b1": b1, "W2": W2, "b2": b2},
+        )
+        sd = import_onnx(model)
+        x = RNG.normal(0, 1, (2, 4)).astype(np.float32)
+        (probs,) = run(sd, {"x": x})
+        h = np.maximum(x @ W1 + b1, 0)
+        logits = h @ W2 + b2
+        e = np.exp(logits - logits.max(-1, keepdims=True))
+        np.testing.assert_allclose(probs, e / e.sum(-1, keepdims=True),
+                                   rtol=1e-5, atol=1e-6)
+
+    def test_gemm_transB_alpha_beta(self):
+        A = RNG.normal(0, 1, (3, 4)).astype(np.float32)
+        Wt = RNG.normal(0, 1, (5, 4)).astype(np.float32)   # transB layout
+        C = RNG.normal(0, 1, (5,)).astype(np.float32)
+        model = make_model(
+            [make_node("Gemm", ["x", "W", "C"], ["y"],
+                       alpha=2.0, beta=0.5, transB=1)],
+            inputs=[("x", (3, 4))], outputs=["y"],
+            initializers={"W": Wt, "C": C},
+        )
+        (y,) = run(import_onnx(model), {"x": A})
+        np.testing.assert_allclose(y, 2.0 * (A @ Wt.T) + 0.5 * C,
+                                   rtol=1e-5, atol=1e-5)
+
+
+class TestCNN:
+    def test_conv_pool_flatten_matches_torch(self):
+        x = RNG.normal(0, 1, (2, 3, 8, 8)).astype(np.float32)
+        W = RNG.normal(0, 0.3, (6, 3, 3, 3)).astype(np.float32)  # OIHW
+        b = RNG.normal(0, 0.1, (6,)).astype(np.float32)
+        Wd = RNG.normal(0, 0.3, (6 * 2 * 2, 4)).astype(np.float32)
+        model = make_model(
+            [
+                make_node("Conv", ["x", "W", "b"], ["c"],
+                          kernel_shape=[3, 3], strides=[1, 1],
+                          pads=[1, 1, 1, 1]),
+                make_node("Relu", ["c"], ["cr"]),
+                make_node("MaxPool", ["cr"], ["p"],
+                          kernel_shape=[2, 2], strides=[2, 2]),
+                make_node("AveragePool", ["p"], ["a"],
+                          kernel_shape=[2, 2], strides=[2, 2]),
+                make_node("Flatten", ["a"], ["f"]),
+                make_node("MatMul", ["f", "Wd"], ["y"]),
+            ],
+            inputs=[("x", (2, 3, 8, 8))], outputs=["y"],
+            initializers={"W": W, "b": b, "Wd": Wd},
+        )
+        (y,) = run(import_onnx(model), {"x": x})
+
+        t = torch.from_numpy
+        c = F.relu(F.conv2d(t(x), t(W), t(b), stride=1, padding=1))
+        p = F.max_pool2d(c, 2, 2)
+        a = F.avg_pool2d(p, 2, 2)
+        expected = a.flatten(1).numpy() @ Wd
+        np.testing.assert_allclose(y, expected, rtol=1e-4, atol=1e-5)
+
+    def test_batchnorm_global_pool_matches_torch(self):
+        x = RNG.normal(0, 1, (2, 4, 6, 6)).astype(np.float32)
+        gamma = RNG.normal(1, 0.1, (4,)).astype(np.float32)
+        beta = RNG.normal(0, 0.1, (4,)).astype(np.float32)
+        mean = RNG.normal(0, 0.5, (4,)).astype(np.float32)
+        var = RNG.uniform(0.5, 2.0, (4,)).astype(np.float32)
+        model = make_model(
+            [
+                make_node("BatchNormalization",
+                          ["x", "gamma", "beta", "mean", "var"], ["bn"],
+                          epsilon=1e-5),
+                make_node("GlobalAveragePool", ["bn"], ["g"]),
+            ],
+            inputs=[("x", (2, 4, 6, 6))], outputs=["g"],
+            initializers={"gamma": gamma, "beta": beta,
+                          "mean": mean, "var": var},
+        )
+        (g,) = run(import_onnx(model), {"x": x})
+        bn = F.batch_norm(torch.from_numpy(x), torch.from_numpy(mean),
+                          torch.from_numpy(var), torch.from_numpy(gamma),
+                          torch.from_numpy(beta), training=False, eps=1e-5)
+        expected = bn.mean(dim=(2, 3), keepdim=True).numpy()
+        np.testing.assert_allclose(g, expected, rtol=1e-4, atol=1e-5)
+
+    def test_depthwise_conv_matches_torch(self):
+        x = RNG.normal(0, 1, (1, 4, 6, 6)).astype(np.float32)
+        W = RNG.normal(0, 0.3, (4, 1, 3, 3)).astype(np.float32)
+        model = make_model(
+            [make_node("Conv", ["x", "W"], ["y"], kernel_shape=[3, 3],
+                       strides=[1, 1], pads=[1, 1, 1, 1], group=4)],
+            inputs=[("x", (1, 4, 6, 6))], outputs=["y"],
+            initializers={"W": W},
+        )
+        (y,) = run(import_onnx(model), {"x": x})
+        expected = F.conv2d(torch.from_numpy(x), torch.from_numpy(W),
+                            stride=1, padding=1, groups=4).numpy()
+        np.testing.assert_allclose(y, expected, rtol=1e-4, atol=1e-5)
+
+
+class TestTransformerBlock:
+    def test_decomposed_attention_block_matches_torch(self):
+        """Single-head self-attention + LayerNorm + Erf-GELU FFN — the
+        BERT-block decomposition torch exporters emit."""
+        B, T, D = 2, 5, 8
+        x = RNG.normal(0, 1, (B, T, D)).astype(np.float32)
+        Wq, Wk, Wv, Wo = (RNG.normal(0, 0.4, (D, D)).astype(np.float32)
+                          for _ in range(4))
+        g1 = RNG.normal(1, 0.1, (D,)).astype(np.float32)
+        b1 = RNG.normal(0, 0.1, (D,)).astype(np.float32)
+        W1 = RNG.normal(0, 0.4, (D, 2 * D)).astype(np.float32)
+        W2 = RNG.normal(0, 0.4, (2 * D, D)).astype(np.float32)
+        scale = np.float32(1.0 / np.sqrt(D))
+        half, one = np.float32(0.5), np.float32(1.0)
+        isqrt2 = np.float32(1.0 / np.sqrt(2.0))
+
+        nodes = [
+            make_node("MatMul", ["x", "Wq"], ["q"]),
+            make_node("MatMul", ["x", "Wk"], ["k"]),
+            make_node("MatMul", ["x", "Wv"], ["v"]),
+            make_node("Transpose", ["k"], ["kT"], perm=[0, 2, 1]),
+            make_node("MatMul", ["q", "kT"], ["scores"]),
+            make_node("Mul", ["scores", "scale"], ["scaled"]),
+            make_node("Softmax", ["scaled"], ["attn"], axis=-1),
+            make_node("MatMul", ["attn", "v"], ["ctx"]),
+            make_node("MatMul", ["ctx", "Wo"], ["proj"]),
+            make_node("Add", ["x", "proj"], ["res1"]),
+            make_node("LayerNormalization", ["res1", "g1", "b1"], ["ln1"],
+                      epsilon=1e-5, axis=-1),
+            # Erf-GELU: 0.5 * h * (1 + erf(h / sqrt(2)))
+            make_node("MatMul", ["ln1", "W1"], ["h"]),
+            make_node("Mul", ["h", "isqrt2"], ["hs"]),
+            make_node("Erf", ["hs"], ["eh"]),
+            make_node("Add", ["eh", "one"], ["e1"]),
+            make_node("Mul", ["h", "e1"], ["he"]),
+            make_node("Mul", ["he", "half"], ["gelu"]),
+            make_node("MatMul", ["gelu", "W2"], ["ffn"]),
+            make_node("Add", ["ln1", "ffn"], ["out"]),
+        ]
+        model = make_model(
+            nodes, inputs=[("x", (B, T, D))], outputs=["out"],
+            initializers={"Wq": Wq, "Wk": Wk, "Wv": Wv, "Wo": Wo,
+                          "g1": g1, "b1": b1, "W1": W1, "W2": W2,
+                          "scale": scale, "half": half, "one": one,
+                          "isqrt2": isqrt2},
+        )
+        (out,) = run(import_onnx(model), {"x": x})
+
+        tx = torch.from_numpy(x)
+        q, k, v = tx @ torch.from_numpy(Wq), tx @ torch.from_numpy(Wk), tx @ torch.from_numpy(Wv)
+        attn = torch.softmax(q @ k.transpose(1, 2) * float(scale), dim=-1)
+        res1 = tx + (attn @ v) @ torch.from_numpy(Wo)
+        ln1 = F.layer_norm(res1, (D,), torch.from_numpy(g1),
+                           torch.from_numpy(b1), eps=1e-5)
+        h = ln1 @ torch.from_numpy(W1)
+        gelu = 0.5 * h * (1 + torch.erf(h / np.sqrt(2.0)))
+        expected = (ln1 + gelu @ torch.from_numpy(W2)).numpy()
+        np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+class TestImportSemantics:
+    def _mlp_bytes(self):
+        W = RNG.normal(0, 0.5, (4, 3)).astype(np.float32)
+        return make_model(
+            [make_node("MatMul", ["x", "W"], ["y"])],
+            inputs=[("x", (2, 4))], outputs=["y"],
+            initializers={"W": W},
+        ), W
+
+    def test_path_and_bytes_entry(self, tmp_path):
+        data, W = self._mlp_bytes()
+        p = tmp_path / "m.onnx"
+        p.write_bytes(data)
+        x = RNG.normal(0, 1, (2, 4)).astype(np.float32)
+        (y1,) = run(import_onnx(str(p)), {"x": x})
+        (y2,) = run(import_onnx(data), {"x": x})
+        np.testing.assert_allclose(y1, x @ W, rtol=1e-5, atol=1e-6)
+        np.testing.assert_allclose(y1, y2)
+
+    def test_facade_entry_point(self):
+        from deeplearning4j_tpu.modelimport.tensorflow import import_onnx as f
+
+        data, W = self._mlp_bytes()
+        sd = f(data)
+        assert sd.onnx_outputs == ["y"]
+
+    def test_trainable_promotes_float_initializers(self):
+        data, W = self._mlp_bytes()
+        sd = import_onnx(data, trainable=True)
+        assert "W" in sd.variables()
+
+    def test_unmapped_op_raises_with_name(self):
+        data = make_model(
+            [make_node("STFT", ["x"], ["y"])],
+            inputs=[("x", (2, 4))], outputs=["y"],
+        )
+        with pytest.raises(ONNXImportError, match="STFT"):
+            import_onnx(data)
+
+    def test_dynamic_reshape_raises(self):
+        data = make_model(
+            [
+                make_node("Relu", ["x"], ["shape_src"]),
+                make_node("Reshape", ["x", "shape_src"], ["y"]),
+            ],
+            inputs=[("x", (2, 4))], outputs=["y"],
+        )
+        with pytest.raises(ONNXImportError, match="compile-time constant"):
+            import_onnx(data)
+
+    def test_slice_negative_ends_and_axes(self):
+        data = make_model(
+            [make_node("Slice", ["x", "starts", "ends", "axes"], ["y"])],
+            inputs=[("x", (2, 5))], outputs=["y"],
+            initializers={"starts": np.asarray([1], np.int64),
+                          "ends": np.asarray([-1], np.int64),
+                          "axes": np.asarray([-1], np.int64)},
+        )
+        x = np.arange(10, dtype=np.float32).reshape(2, 5)
+        (y,) = run(import_onnx(data), {"x": x})
+        np.testing.assert_allclose(y, x[:, 1:-1])     # NOT x[:, 1:]
+
+    def test_tied_weights_promote_to_one_var(self):
+        W = RNG.normal(0, 0.5, (4, 4)).astype(np.float32)
+        data = make_model(
+            [
+                make_node("Identity", ["W"], ["W2"]),
+                make_node("MatMul", ["x", "W"], ["h"]),
+                make_node("MatMul", ["h", "W2"], ["y"]),
+            ],
+            inputs=[("x", (2, 4))], outputs=["y"],
+            initializers={"W": W},
+        )
+        sd = import_onnx(data, trainable=True)
+        assert len(sd.variables()) == 1        # tied, not drifting copies
+
+    def test_constant_graph_output_allowed(self):
+        data = make_model(
+            [make_node("Constant", [], ["c"],
+                       value=np.asarray([1.0, 2.0], np.float32)),
+             make_node("Relu", ["x"], ["r"])],
+            inputs=[("x", (2,))], outputs=["r", "c"],
+        )
+        sd = import_onnx(data)
+        r, c = run(sd, {"x": np.asarray([-1.0, 3.0], np.float32)})
+        np.testing.assert_allclose(c, [1.0, 2.0])
+
+    def test_ceil_mode_and_same_lower_raise(self):
+        pool = make_model(
+            [make_node("MaxPool", ["x"], ["y"], kernel_shape=[3, 3],
+                       strides=[2, 2], ceil_mode=1)],
+            inputs=[("x", (1, 1, 7, 7))], outputs=["y"],
+        )
+        with pytest.raises(ONNXImportError, match="ceil_mode"):
+            import_onnx(pool)
+        conv = make_model(
+            [make_node("Conv", ["x", "W"], ["y"], kernel_shape=[2, 2],
+                       auto_pad="SAME_LOWER")],
+            inputs=[("x", (1, 1, 4, 4))], outputs=["y"],
+            initializers={"W": RNG.normal(0, 1, (1, 1, 2, 2)).astype(np.float32)},
+        )
+        with pytest.raises(ONNXImportError, match="SAME_LOWER"):
+            import_onnx(conv)
+
+    def test_onnx_reshape_zero_copies_dim(self):
+        data = make_model(
+            [make_node("Reshape", ["x", "shape"], ["y"])],
+            inputs=[("x", (2, 3, 4))], outputs=["y"],
+            initializers={"shape": np.asarray([0, 12], np.int64)},
+        )
+        x = RNG.normal(0, 1, (2, 3, 4)).astype(np.float32)
+        (y,) = run(import_onnx(data), {"x": x})
+        assert y.shape == (2, 12)

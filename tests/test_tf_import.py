@@ -291,8 +291,10 @@ class TestErrorPaths:
         with pytest.raises(TFImportError, match="constant"):
             import_graph(g.as_graph_def())
 
-    def test_onnx_gated(self):
-        with pytest.raises((ImportError, NotImplementedError)):
+    def test_onnx_facade_delegates(self):
+        # ONNX import is real now (modelimport/onnx.py); the facade passes
+        # through — a missing file surfaces as the OS error
+        with pytest.raises(FileNotFoundError):
             import_onnx("/tmp/nonexistent.onnx")
 
     def test_facade_from_file(self, tmp_path):
