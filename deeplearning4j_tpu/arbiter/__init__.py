@@ -1,0 +1,55 @@
+"""Hyperparameter optimization — the Arbiter role.
+
+Reference: `arbiter-core` / `arbiter-deeplearning4j` (SURVEY.md §2.2
+"Arbiter (HPO)"): `ParameterSpace<T>` hyperparameter spaces, random and
+grid candidate generators, an `OptimizationRunner` that trains/scores each
+candidate and persists results.
+
+TPU-native shape: the reference reflects over its config-builder tree
+(`MultiLayerSpace`); here a candidate is a plain dict sampled from named
+ParameterSpaces and the user's `model_factory(candidate)` builds the model
+with the framework's ordinary builder DSL — no reflection layer, same
+capability:
+
+    spaces = {"lr": ContinuousParameterSpace(1e-4, 1e-1, log=True),
+              "hidden": DiscreteParameterSpace(16, 32, 64)}
+    runner = OptimizationRunner(
+        RandomSearchGenerator(spaces, seed=1),
+        model_factory=build,                 # dict -> initialized model
+        fitter=lambda m: m.fit(train_iter, epochs=3),
+        scorer=DataSetLossScoreFunction(val_data),
+        max_candidates=16)
+    best = runner.execute().best()
+"""
+
+from deeplearning4j_tpu.arbiter.spaces import (
+    BooleanParameterSpace,
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    FixedValue,
+    IntegerParameterSpace,
+    ParameterSpace,
+)
+from deeplearning4j_tpu.arbiter.runner import (
+    DataSetLossScoreFunction,
+    EvaluationScoreFunction,
+    GridSearchGenerator,
+    OptimizationResult,
+    OptimizationRunner,
+    RandomSearchGenerator,
+)
+
+__all__ = [
+    "ParameterSpace",
+    "ContinuousParameterSpace",
+    "DiscreteParameterSpace",
+    "IntegerParameterSpace",
+    "BooleanParameterSpace",
+    "FixedValue",
+    "RandomSearchGenerator",
+    "GridSearchGenerator",
+    "OptimizationRunner",
+    "OptimizationResult",
+    "DataSetLossScoreFunction",
+    "EvaluationScoreFunction",
+]

@@ -1,0 +1,199 @@
+"""Candidate generation + the optimization runner — `OptimizationRunner`,
+`RandomSearchGenerator`, `GridSearchCandidateGenerator`, score-function
+roles from arbiter-core."""
+
+from __future__ import annotations
+
+import dataclasses
+import itertools
+import json
+import os
+import time
+from typing import Any, Callable, Iterator, Optional
+
+import numpy as np
+
+
+class CandidateGenerator:
+    def candidates(self) -> Iterator[dict]:
+        raise NotImplementedError
+
+
+class RandomSearchGenerator(CandidateGenerator):
+    """Independent draws from each space (unbounded stream — the runner's
+    max_candidates terminates it)."""
+
+    def __init__(self, spaces: dict, seed: int = 0):
+        self.spaces = dict(spaces)
+        self.seed = seed
+
+    def candidates(self):
+        rng = np.random.default_rng(self.seed)
+        while True:
+            yield {k: s.sample(rng) for k, s in self.spaces.items()}
+
+
+class GridSearchGenerator(CandidateGenerator):
+    """Cartesian product over per-space grids (continuous spaces discretized
+    into `discretization` points)."""
+
+    def __init__(self, spaces: dict, discretization: int = 4):
+        self.spaces = dict(spaces)
+        self.discretization = discretization
+
+    def candidates(self):
+        keys = list(self.spaces)
+        grids = [self.spaces[k].grid_values(self.discretization) for k in keys]
+        for combo in itertools.product(*grids):
+            yield dict(zip(keys, combo))
+
+
+# -- score functions ---------------------------------------------------------
+
+class DataSetLossScoreFunction:
+    """Model loss on a held-out set: lower is better (minimize=True)."""
+
+    minimize = True
+
+    def __init__(self, data):
+        self.data = data
+
+    def __call__(self, model) -> float:
+        return float(model.score(self.data))
+
+
+class EvaluationScoreFunction:
+    """Classification metric on a held-out set: higher is better."""
+
+    minimize = False
+
+    def __init__(self, data, metric: str = "accuracy"):
+        self.data = data
+        self.metric = metric
+
+    def __call__(self, model) -> float:
+        ev = model.evaluate(self.data)
+        return float(getattr(ev, self.metric)())
+
+
+# -- runner ------------------------------------------------------------------
+
+@dataclasses.dataclass
+class OptimizationResult:
+    index: int
+    candidate: dict
+    score: float
+    wall_s: float
+    error: Optional[str] = None
+    model_path: Optional[str] = None
+
+
+class OptimizationRunner:
+    """Train and score each candidate; keep the best; persist everything.
+
+    model_factory(candidate) -> initialized model
+    fitter(model, candidate) or fitter(model) -> trains it
+    scorer(model) -> float, with .minimize declaring the direction
+    results_path: jsonl file appended per candidate (crash-safe progress)
+    save_best_dir: the best model is serialized there (best_model.zip)
+    """
+
+    def __init__(
+        self,
+        generator: CandidateGenerator,
+        model_factory: Callable[[dict], Any],
+        scorer,
+        fitter: Callable = None,
+        max_candidates: int = 16,
+        max_runtime_s: Optional[float] = None,
+        results_path: Optional[str] = None,
+        save_best_dir: Optional[str] = None,
+    ):
+        self.generator = generator
+        self.model_factory = model_factory
+        self.scorer = scorer
+        self.fitter = fitter or (lambda model: None)
+        self.max_candidates = max_candidates
+        self.max_runtime_s = max_runtime_s
+        self.results_path = results_path
+        self.save_best_dir = save_best_dir
+        self.results: list[OptimizationResult] = []
+
+    @property
+    def minimize(self) -> bool:
+        return getattr(self.scorer, "minimize", True)
+
+    def _fit(self, model, candidate):
+        # arity decided by signature inspection, NOT try/except TypeError —
+        # a TypeError raised inside the fitter must surface, not trigger a
+        # confusing second (partial re-)training call
+        import inspect
+
+        try:
+            n_params = len(inspect.signature(self.fitter).parameters)
+        except (TypeError, ValueError):
+            n_params = 1
+        if n_params >= 2:
+            return self.fitter(model, candidate)
+        return self.fitter(model)
+
+    def _persist(self, result: OptimizationResult) -> None:
+        if not self.results_path:
+            return
+        d = os.path.dirname(os.path.abspath(self.results_path))
+        os.makedirs(d, exist_ok=True)
+        with open(self.results_path, "a") as f:
+            f.write(json.dumps(dataclasses.asdict(result)) + "\n")
+
+    def execute(self) -> "OptimizationRunner":
+        t_start = time.time()
+        best: Optional[OptimizationResult] = None
+        for i, candidate in enumerate(self.generator.candidates()):
+            if i >= self.max_candidates:
+                break
+            if self.max_runtime_s and time.time() - t_start > self.max_runtime_s:
+                break
+            t0 = time.time()
+            try:
+                model = self.model_factory(candidate)
+                self._fit(model, candidate)
+                score = float(self.scorer(model))
+                result = OptimizationResult(
+                    index=i, candidate=candidate, score=score,
+                    wall_s=round(time.time() - t0, 3),
+                )
+            except Exception as exc:
+                result = OptimizationResult(
+                    index=i, candidate=candidate, score=float("nan"),
+                    wall_s=round(time.time() - t0, 3),
+                    error=f"{type(exc).__name__}: {exc}",
+                )
+                model = None
+            self.results.append(result)
+            if model is not None and np.isfinite(result.score):
+                better = best is None or (
+                    result.score < best.score
+                    if self.minimize
+                    else result.score > best.score
+                )
+                if better:
+                    best = result
+                    if self.save_best_dir:
+                        os.makedirs(self.save_best_dir, exist_ok=True)
+                        path = os.path.join(self.save_best_dir, "best_model.zip")
+                        from deeplearning4j_tpu.train.checkpoint import (
+                            ModelSerializer,
+                        )
+
+                        tmp = path + ".tmp"
+                        ModelSerializer.write_model(model, tmp)
+                        os.replace(tmp, path)
+                        result.model_path = path
+            # persist AFTER model_path is set so the jsonl records which
+            # candidate produced best_model.zip
+            self._persist(result)
+        self._best = best
+        return self
+
+    def best(self) -> Optional[OptimizationResult]:
+        return getattr(self, "_best", None)

@@ -1,0 +1,99 @@
+"""ParameterSpace hierarchy — `org.deeplearning4j.arbiter.optimize.api.
+ParameterSpace` and its standard impls (continuous/discrete/integer)."""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+
+
+class ParameterSpace:
+    """A named dimension of the search space."""
+
+    def sample(self, rng: np.random.Generator):
+        raise NotImplementedError
+
+    def grid_values(self, discretization: int) -> list:
+        """Finite value list for grid search."""
+        raise NotImplementedError
+
+
+@dataclasses.dataclass(frozen=True)
+class ContinuousParameterSpace(ParameterSpace):
+    """Uniform (or log-uniform — the right prior for learning rates) float
+    range [lo, hi]."""
+
+    lo: float
+    hi: float
+    log: bool = False
+
+    def __post_init__(self):
+        if not (self.hi > self.lo):
+            raise ValueError(f"need hi > lo, got [{self.lo}, {self.hi}]")
+        if self.log and self.lo <= 0:
+            raise ValueError("log-uniform needs lo > 0")
+
+    def sample(self, rng):
+        if self.log:
+            return float(np.exp(rng.uniform(np.log(self.lo), np.log(self.hi))))
+        return float(rng.uniform(self.lo, self.hi))
+
+    def grid_values(self, discretization):
+        if self.log:
+            return [
+                float(v)
+                for v in np.exp(
+                    np.linspace(np.log(self.lo), np.log(self.hi), discretization)
+                )
+            ]
+        return [float(v) for v in np.linspace(self.lo, self.hi, discretization)]
+
+
+@dataclasses.dataclass(frozen=True)
+class DiscreteParameterSpace(ParameterSpace):
+    values: tuple
+
+    def __init__(self, *values):
+        object.__setattr__(self, "values", tuple(values))
+        if not self.values:
+            raise ValueError("DiscreteParameterSpace needs at least one value")
+
+    def sample(self, rng):
+        return self.values[int(rng.integers(0, len(self.values)))]
+
+    def grid_values(self, discretization):
+        return list(self.values)
+
+
+@dataclasses.dataclass(frozen=True)
+class IntegerParameterSpace(ParameterSpace):
+    lo: int
+    hi: int            # inclusive
+
+    def sample(self, rng):
+        return int(rng.integers(self.lo, self.hi + 1))
+
+    def grid_values(self, discretization):
+        n = self.hi - self.lo + 1
+        if n <= discretization:
+            return list(range(self.lo, self.hi + 1))
+        return [
+            int(round(v)) for v in np.linspace(self.lo, self.hi, discretization)
+        ]
+
+
+def BooleanParameterSpace() -> DiscreteParameterSpace:
+    return DiscreteParameterSpace(False, True)
+
+
+@dataclasses.dataclass(frozen=True)
+class FixedValue(ParameterSpace):
+    value: Any
+
+    def sample(self, rng):
+        return self.value
+
+    def grid_values(self, discretization):
+        return [self.value]

@@ -470,8 +470,265 @@ def import_keras_model(path: str) -> SequentialModel:
         return model
 
 
+# --- functional (branching) graphs -> GraphModel ----------------------------
+
+_MERGE_CLASSES = {
+    "Add": "add",
+    "Subtract": "subtract",
+    "Multiply": "product",
+    "Average": "average",
+    "Maximum": "max",
+}
+
+
+def _parse_inbound(ld: dict) -> List[str]:
+    """Input layer names for a functional-graph layer (first call node).
+    Handles both the Keras-2 nested-list dialect and the Keras-3
+    keras_history dialect."""
+    inbound = ld.get("inbound_nodes", [])
+    if not inbound:
+        return []
+    node = inbound[0]
+    names: List[str] = []
+    if isinstance(node, dict):          # keras3
+        def walk(o):
+            if isinstance(o, dict):
+                hist = o.get("config", {}).get("keras_history")
+                if hist:
+                    names.append(hist[0])
+                else:
+                    for v in o.values():
+                        walk(v)
+            elif isinstance(o, (list, tuple)):
+                for v in o:
+                    walk(v)
+
+        walk(node.get("args", []))
+    else:                               # keras2: [[name, node_idx, t_idx, {}]..]
+        for entry in node:
+            names.append(entry[0])
+    return names
+
+
+def _out_names(cfg: dict, key: str) -> List[str]:
+    """config['input_layers'/'output_layers'] in either dialect."""
+    raw = cfg.get(key, [])
+    if raw and not isinstance(raw[0], list):
+        raw = [raw]
+    return [r[0] for r in raw]
+
+
+def import_keras_graph(path: str):
+    """Import a (possibly branching, multi-input/multi-output) Keras
+    Functional HDF5 model into a `GraphModel`.
+
+    Reference: `KerasModelImport.importKerasModelAndWeights` →
+    ComputationGraph (SURVEY.md §2.2 "Keras import").
+    """
+    import h5py
+
+    from deeplearning4j_tpu.models.computation_graph import GraphModel
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ElementWiseOp,
+        ElementWiseVertex,
+        GraphBuilder,
+        MergeVertex,
+    )
+
+    with h5py.File(path, "r") as f:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            raise KerasImportError(f"{path}: no model_config attribute")
+        model_cfg = json.loads(raw.decode() if isinstance(raw, bytes) else raw)
+        if model_cfg["class_name"] not in ("Functional", "Model"):
+            raise KerasImportError(
+                f"import_keras_graph expects a Functional model, got "
+                f"{model_cfg['class_name']!r} (use import_keras_model for "
+                "Sequential)"
+            )
+        cfg = model_cfg["config"]
+        layers = cfg["layers"]
+        training_cfg = None
+        raw_t = f.attrs.get("training_config")
+        if raw_t is not None:
+            training_cfg = json.loads(
+                raw_t.decode() if isinstance(raw_t, bytes) else raw_t
+            )
+
+        graph_inputs = _out_names(cfg, "input_layers")
+        graph_outputs = _out_names(cfg, "output_layers")
+
+        b = GraphBuilder().updater(Adam(1e-3))
+        alias: Dict[str, str] = {}       # structural no-op name -> source
+
+        def resolve(n: str) -> str:
+            while n in alias:
+                n = alias[n]
+            return n
+
+        input_types: Dict[str, InputType] = {}
+        confs: Dict[str, Any] = {}
+        bn_axes: Dict[str, int] = {}
+        for ld in layers:
+            cls, lcfg = ld["class_name"], ld.get("config", {})
+            name = lcfg.get("name") or ld.get("name")
+            if len(ld.get("inbound_nodes", [])) > 1:
+                raise KerasImportError(
+                    f"layer {name!r} is called more than once (shared layer); "
+                    "shared-layer topology is not imported"
+                )
+            inputs = [resolve(n) for n in _parse_inbound(ld)]
+            if cls == "InputLayer":
+                shape = _input_shape(lcfg)
+                if shape is None:
+                    raise KerasImportError(f"InputLayer {name!r} has no shape")
+                input_types[name] = _itype_from_shape(shape)
+                continue
+            if cls in _MERGE_CLASSES:
+                b.add_vertex(
+                    name, ElementWiseVertex(op=ElementWiseOp(_MERGE_CLASSES[cls])),
+                    *inputs,
+                )
+                continue
+            if cls == "Concatenate":
+                axis = lcfg.get("axis", -1)
+                if axis not in (-1, None):
+                    # a positive axis naming the trailing dim is equivalent
+                    shapes = lcfg.get("build_config", {}).get("input_shape") or []
+                    rank = len(shapes[0]) if shapes and shapes[0] else None
+                    if rank is None or axis != rank - 1:
+                        raise KerasImportError(
+                            f"Concatenate {name!r}: only trailing-axis "
+                            f"(channels_last) concat imports, got axis={axis}"
+                        )
+                b.add_vertex(name, MergeVertex(), *inputs)
+                continue
+            if cls not in _LAYER_MAPPERS:
+                raise KerasImportError(f"unsupported Keras layer {cls!r} ({name})")
+            mapped = _LAYER_MAPPERS[cls](lcfg, name)
+            if mapped is None:           # Flatten etc.: structural no-op
+                if len(inputs) != 1:
+                    raise KerasImportError(
+                        f"structural layer {name!r} must have exactly 1 input"
+                    )
+                alias[name] = inputs[0]
+                continue
+            if len(inputs) != 1:
+                raise KerasImportError(
+                    f"layer {name!r} ({cls}) takes 1 input, got {inputs}"
+                )
+            confs[name] = mapped
+            if cls == "BatchNormalization":
+                bn_axes[name] = _bn_axis(lcfg)
+            b.add_layer(name, mapped, *inputs)
+
+        # output heads: promote a Dense tail to OutputLayer, else add a
+        # LossLayer node per declared output
+        out_nodes: List[str] = []
+        for oname in graph_outputs:
+            oname = resolve(oname)
+            lc = confs.get(oname)
+            if isinstance(lc, Dense) and not isinstance(lc, OutputLayer):
+                act = lc.activation or Activation.IDENTITY
+                loss = _infer_loss(training_cfg, act)
+                promoted = OutputLayer(
+                    name=lc.name, n_out=lc.n_out, has_bias=lc.has_bias,
+                    activation=act, loss=loss,
+                )
+                confs[oname] = promoted
+                import dataclasses as _dc
+
+                b._nodes = [
+                    _dc.replace(n, layer=promoted) if n.name == oname else n
+                    for n in b._nodes
+                ]
+                out_nodes.append(oname)
+            else:
+                act = Activation.IDENTITY
+                loss = _infer_loss(training_cfg, act)
+                head = f"{oname}_loss"
+                b.add_layer(head, LossLayer(name=head, loss=loss,
+                                            activation=act), oname)
+                out_nodes.append(head)
+
+        b.add_inputs(*graph_inputs)
+        # order types by the model's declared input order, NOT layer-list
+        # (creation) order — Model([in2, in1], ...) serializes them reversed
+        try:
+            b.set_input_types(*[input_types[n] for n in graph_inputs])
+        except KeyError as e:
+            raise KerasImportError(f"declared input {e} has no InputLayer")
+        b.set_outputs(*out_nodes)
+        model = GraphModel(b.build()).init()
+
+        # BatchNorm axis check (same contract as the sequential path): our
+        # BatchNorm normalizes the trailing axis only
+        for node in model._topo:
+            ax = bn_axes.get(node.name)
+            if ax is not None:
+                itype = model._layer_itype(node)
+                rank = _TENSOR_RANK.get(itype.kind, 2)
+                if ax not in (-1, rank - 1):
+                    raise KerasImportError(
+                        f"BatchNormalization {node.name!r} has axis={ax} but "
+                        f"input rank {rank}: only trailing-axis "
+                        "(channels_last) BN imports"
+                    )
+
+        # weights
+        params = dict(model.params)
+        state = dict(model.net_state)
+        wroot = f["model_weights"] if "model_weights" in f else f
+        loaded = set()
+        for gname in wroot:
+            if gname not in confs:
+                continue
+            weights = _collect_layer_weights(wroot[gname])
+            if weights:
+                _apply_weights(confs[gname], weights, params, state)
+                loaded.add(gname)
+        for name, lc in confs.items():
+            if name in model.params and name not in loaded:
+                raise KerasImportError(
+                    f"no weights found in H5 for parameterized layer {name!r}"
+                )
+        for lname, lp in model.params.items():
+            for pname, arr in lp.items():
+                got, want = np.shape(params[lname][pname]), np.shape(arr)
+                if got != want:
+                    raise KerasImportError(
+                        f"weight shape mismatch for {lname}/{pname}: H5 has "
+                        f"{got}, architecture needs {want}"
+                    )
+        model.params = params
+        model.net_state = state
+        model.opt_state = model._tx.init(params)
+        return model
+
+
 class KerasModelImport:
     """Static façade matching the reference entry-point naming."""
 
     import_keras_sequential_model_and_weights = staticmethod(import_keras_model)
-    import_keras_model_and_weights = staticmethod(import_keras_model)
+    # the reference entry accepts both kinds: Functional -> GraphModel,
+    # Sequential -> SequentialModel
+    import_keras_model_and_weights = staticmethod(
+        lambda path: import_keras_auto(path)
+    )
+
+
+def import_keras_auto(path: str):
+    """Dispatch on the saved model class: Functional/Model -> GraphModel,
+    Sequential -> SequentialModel (importKerasModelAndWeights accepts both)."""
+    import h5py
+
+    with h5py.File(path, "r") as f:
+        raw = f.attrs.get("model_config")
+        if raw is None:
+            raise KerasImportError(f"{path}: no model_config attribute")
+        cls = json.loads(raw.decode() if isinstance(raw, bytes) else raw)[
+            "class_name"
+        ]
+    if cls in ("Functional", "Model"):
+        return import_keras_graph(path)
+    return import_keras_model(path)

@@ -2,14 +2,12 @@
 
 Each zoo entry builds a ready-to-train model config for a named
 architecture.  The reference's initPretrained() downloads checked-summed
-weights; with no network, pretrained loading resolves from a local
-directory ($DL4J_TPU_PRETRAINED_DIR) of ModelSerializer zips instead.
+weights; with no network, pretrained loading resolves from the local
+checksummed registry ($DL4JTPU_PRETRAINED_DIR — see zoo/pretrained.py)
+of ModelSerializer zips instead.
 """
 
 from __future__ import annotations
-
-import os
-from pathlib import Path
 
 
 class ZooModel:
@@ -35,15 +33,17 @@ class ZooModel:
             return GraphModel(conf).init()
         return SequentialModel(conf).init()
 
-    def init_pretrained(self):
-        """Load pretrained weights from the local pretrained directory."""
-        root = Path(os.environ.get("DL4J_TPU_PRETRAINED_DIR", "~/.dl4j_tpu/models")).expanduser()
-        path = root / f"{self.NAME}.zip"
-        if not path.exists():
-            raise FileNotFoundError(
-                f"no pretrained weights for {self.NAME} at {path} "
-                "(no-network environment: place ModelSerializer zips there)"
-            )
-        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+    def init_pretrained(self, pretrained_type: str = "default",
+                        path: str | None = None):
+        """Load pretrained weights (ZooModel.initPretrained(PretrainedType)).
 
+        Resolution order: explicit `path` (a ModelSerializer zip), else the
+        checksummed local registry (zoo/pretrained.py) keyed by
+        (NAME, pretrained_type).
+        """
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+        from deeplearning4j_tpu.zoo.pretrained import PretrainedRegistry
+
+        if path is None:
+            path = PretrainedRegistry().resolve(self.NAME, pretrained_type)
         return ModelSerializer.restore(str(path))

@@ -28,7 +28,10 @@ def save_h5(model, tmp_path, name="m.h5"):
 
 def assert_outputs_match(kmodel, ours, x, atol=1e-4):
     want = np.asarray(kmodel(x, training=False))
-    got = np.asarray(ours.output(x.astype(np.float32)))
+    got = ours.output(x.astype(np.float32))
+    if isinstance(got, tuple):          # GraphModel returns one per output
+        (got,) = got
+    got = np.asarray(got)
     np.testing.assert_allclose(got, want, atol=atol, rtol=1e-3)
 
 
@@ -135,7 +138,7 @@ class TestFunctionalImport:
         x = np.random.default_rng(7).normal(size=(4, 10)).astype(np.float32)
         assert_outputs_match(km, ours, x)
 
-    def test_branching_rejected_clearly(self, tmp_path):
+    def test_branching_rejected_by_sequential_entry(self, tmp_path):
         inp = keras.layers.Input((6,))
         a = keras.layers.Dense(4)(inp)
         b = keras.layers.Dense(4)(inp)
@@ -143,6 +146,121 @@ class TestFunctionalImport:
         km = keras.Model(inp, out)
         with pytest.raises(KerasImportError, match="[Bb]ranching|Add"):
             import_keras_model(save_h5(km, tmp_path))
+
+
+class TestBranchingFunctionalImport:
+    """Branching graphs -> GraphModel (the ComputationGraph-returning
+    reference entry, now real)."""
+
+    def test_residual_add_branch(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+
+        inp = keras.layers.Input((12,))
+        h = keras.layers.Dense(12, activation="tanh")(inp)
+        res = keras.layers.Add()([inp, h])
+        out = keras.layers.Dense(3, activation="softmax")(res)
+        km = keras.Model(inp, out)
+        km.compile(loss="categorical_crossentropy", optimizer="adam")
+        ours = import_keras_graph(save_h5(km, tmp_path))
+        x = np.random.default_rng(1).normal(size=(6, 12)).astype(np.float32)
+        assert_outputs_match(km, ours, x)
+
+    def test_two_branch_concat_cnn(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+
+        inp = keras.layers.Input((8, 8, 3))
+        a = keras.layers.Conv2D(4, 3, padding="same", activation="relu")(inp)
+        b = keras.layers.Conv2D(4, 1, padding="same")(inp)
+        m = keras.layers.Concatenate()([a, b])
+        p = keras.layers.GlobalAveragePooling2D()(m)
+        out = keras.layers.Dense(2, activation="softmax")(p)
+        km = keras.Model(inp, out)
+        ours = import_keras_graph(save_h5(km, tmp_path))
+        x = np.random.default_rng(2).normal(size=(3, 8, 8, 3)).astype(np.float32)
+        assert_outputs_match(km, ours, x)
+
+    def test_multi_input_model(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+
+        in1 = keras.layers.Input((5,))
+        in2 = keras.layers.Input((7,))
+        h1 = keras.layers.Dense(6, activation="relu")(in1)
+        h2 = keras.layers.Dense(6, activation="relu")(in2)
+        m = keras.layers.Concatenate()([h1, h2])
+        out = keras.layers.Dense(2)(m)
+        km = keras.Model([in1, in2], out)
+        ours = import_keras_graph(save_h5(km, tmp_path))
+        rng = np.random.default_rng(3)
+        x1 = rng.normal(size=(4, 5)).astype(np.float32)
+        x2 = rng.normal(size=(4, 7)).astype(np.float32)
+        want = np.asarray(km([x1, x2], training=False))
+        got = ours.output(x1, x2)
+        if isinstance(got, tuple):
+            (got,) = got
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-3)
+
+    def test_reversed_declared_input_order(self, tmp_path):
+        """Model([in2, in1], ...) serializes layers in creation order but
+        input_layers in declared order — types must follow the latter."""
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+
+        in1 = keras.layers.Input((5,))
+        in2 = keras.layers.Input((7,))
+        h1 = keras.layers.Dense(4)(in1)
+        h2 = keras.layers.Dense(4)(in2)
+        m = keras.layers.Concatenate()([h1, h2])
+        out = keras.layers.Dense(2)(m)
+        km = keras.Model([in2, in1], out)       # reversed declaration
+        ours = import_keras_graph(save_h5(km, tmp_path))
+        rng = np.random.default_rng(5)
+        x2 = rng.normal(size=(3, 7)).astype(np.float32)
+        x1 = rng.normal(size=(3, 5)).astype(np.float32)
+        want = np.asarray(km([x2, x1], training=False))
+        got = ours.output(x2, x1)
+        if isinstance(got, tuple):
+            (got,) = got
+        np.testing.assert_allclose(np.asarray(got), want, atol=1e-4, rtol=1e-3)
+
+    def test_facade_dispatches_both_kinds(self, tmp_path):
+        seq = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(2, activation="softmax"),
+        ])
+        ours_seq = KerasModelImport.import_keras_model_and_weights(
+            save_h5(seq, tmp_path, "seq.h5")
+        )
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.models.computation_graph import GraphModel
+
+        assert isinstance(ours_seq, SequentialModel)
+        inp = keras.layers.Input((4,))
+        out = keras.layers.Add()([keras.layers.Dense(4)(inp),
+                                  keras.layers.Dense(4)(inp)])
+        km = keras.Model(inp, out)
+        ours_g = KerasModelImport.import_keras_model_and_weights(
+            save_h5(km, tmp_path, "fun.h5")
+        )
+        assert isinstance(ours_g, GraphModel)
+
+    def test_imported_graph_trains(self, tmp_path):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+
+        inp = keras.layers.Input((6,))
+        h = keras.layers.Dense(8, activation="tanh")(inp)
+        res = keras.layers.Add()([h, keras.layers.Dense(8)(inp)])
+        out = keras.layers.Dense(3, activation="softmax")(res)
+        km = keras.Model(inp, out)
+        km.compile(loss="categorical_crossentropy", optimizer="adam")
+        ours = import_keras_graph(save_h5(km, tmp_path))
+        rng = np.random.default_rng(4)
+        x = rng.normal(size=(32, 6)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 32)]
+        first = None
+        for _ in range(20):
+            ours.fit_batch(DataSet(x, y))
+            first = first if first is not None else ours.score_value
+        assert ours.score_value < first
 
 
 class TestReviewRegressions:

@@ -1,0 +1,68 @@
+"""Pretrained registry tests — init_pretrained + checksummed local
+registry (ZooModel.initPretrained/PretrainedType roles)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.zoo.lenet import LeNet
+from deeplearning4j_tpu.zoo.pretrained import (
+    ChecksumMismatchError,
+    ENV_PRETRAINED_DIR,
+    PretrainedRegistry,
+)
+
+
+@pytest.fixture
+def registry(tmp_path, monkeypatch):
+    monkeypatch.setenv(ENV_PRETRAINED_DIR, str(tmp_path / "models"))
+    return PretrainedRegistry()
+
+
+def trained_lenet_zip(tmp_path):
+    m = LeNet(num_classes=3, height=12, width=12).init_model()
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 12, 12, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    m.fit_batch(DataSet(x, y))
+    p = str(tmp_path / "weights.zip")
+    m.save(p)
+    return m, p, x
+
+
+class TestRegistry:
+    def test_register_resolve_init_pretrained_roundtrip(self, registry, tmp_path):
+        m, p, x = trained_lenet_zip(tmp_path)
+        entry = registry.register("lenet", "mnist", p)
+        assert len(entry["sha256"]) == 64
+        loaded = LeNet(num_classes=3, height=12, width=12).init_pretrained("mnist")
+        np.testing.assert_allclose(
+            np.asarray(m.output(x)), np.asarray(loaded.output(x)),
+            rtol=1e-5, atol=1e-6,
+        )
+        assert registry.available("lenet") == {"mnist": entry}
+
+    def test_corruption_detected(self, registry, tmp_path):
+        _, p, _ = trained_lenet_zip(tmp_path)
+        registry.register("lenet", "mnist", p)
+        # corrupt the registered copy
+        target = registry.root / "lenet_mnist.zip"
+        data = bytearray(target.read_bytes())
+        data[100] ^= 0xFF
+        target.write_bytes(bytes(data))
+        with pytest.raises(ChecksumMismatchError, match="sha256"):
+            registry.resolve("lenet", "mnist")
+
+    def test_missing_registration_names_alternatives(self, registry, tmp_path):
+        _, p, _ = trained_lenet_zip(tmp_path)
+        registry.register("lenet", "mnist", p)
+        with pytest.raises(FileNotFoundError, match="mnist"):
+            registry.resolve("lenet", "imagenet")
+
+    def test_explicit_path_bypasses_registry(self, registry, tmp_path):
+        m, p, x = trained_lenet_zip(tmp_path)
+        loaded = LeNet(num_classes=3, height=12, width=12).init_pretrained(path=p)
+        np.testing.assert_allclose(
+            np.asarray(m.output(x)), np.asarray(loaded.output(x)),
+            rtol=1e-5, atol=1e-6,
+        )
