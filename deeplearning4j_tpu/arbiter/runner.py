@@ -82,7 +82,7 @@ class EvaluationScoreFunction:
 class OptimizationResult:
     index: int
     candidate: dict
-    score: float
+    score: Optional[float]         # None when the candidate errored
     wall_s: float
     error: Optional[str] = None
     model_path: Optional[str] = None
@@ -163,14 +163,20 @@ class OptimizationRunner:
                     wall_s=round(time.time() - t0, 3),
                 )
             except Exception as exc:
+                # score None (not NaN): json.dumps would emit a bare NaN
+                # token, invalid JSON for non-Python jsonl consumers
                 result = OptimizationResult(
-                    index=i, candidate=candidate, score=float("nan"),
+                    index=i, candidate=candidate, score=None,
                     wall_s=round(time.time() - t0, 3),
                     error=f"{type(exc).__name__}: {exc}",
                 )
                 model = None
             self.results.append(result)
-            if model is not None and np.isfinite(result.score):
+            if (
+                model is not None
+                and result.score is not None
+                and np.isfinite(result.score)
+            ):
                 better = best is None or (
                     result.score < best.score
                     if self.minimize

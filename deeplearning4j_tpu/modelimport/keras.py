@@ -328,11 +328,16 @@ def _layer_list(model_cfg: dict) -> List[dict]:
     raise KerasImportError(f"unsupported Keras model class {cls!r}")
 
 
-def _infer_loss(training_cfg: Optional[dict], last_act: Activation) -> Loss:
+def _infer_loss(training_cfg: Optional[dict], last_act: Activation,
+                output_name: Optional[str] = None) -> Loss:
     if training_cfg:
         loss = training_cfg.get("loss")
         if isinstance(loss, dict):
-            loss = next(iter(loss.values()))
+            # multi-output models key the loss dict by output layer name
+            if output_name is not None and output_name in loss:
+                loss = loss[output_name]
+            else:
+                loss = next(iter(loss.values()))
         if isinstance(loss, dict):  # serialized loss object
             loss = loss.get("config", {}).get("name") or loss.get("class_name")
         if isinstance(loss, str):
@@ -433,41 +438,43 @@ def import_keras_model(path: str) -> SequentialModel:
                         f"rank {rank}: only trailing-axis (channels_last) BN imports"
                     )
 
-        params = dict(model.params)
-        state = dict(model.net_state)
-        wroot = f["model_weights"] if "model_weights" in f else f
-        by_name = {c.name: c for c in confs}
-        loaded = set()
-        for gname in wroot:
-            if gname not in by_name:
-                continue
-            weights = _collect_layer_weights(wroot[gname])
-            if weights:
-                _apply_weights(by_name[gname], weights, params, state)
-                loaded.add(gname)
-
-        # every parameterized layer must have received weights, at the
-        # initialized shapes — silently keeping random init would "import"
-        # a model that predicts garbage.
-        for conf in confs:
-            if conf.name in model.params and conf.name not in loaded:
-                raise KerasImportError(
-                    f"no weights found in H5 for parameterized layer {conf.name!r} "
-                    f"(groups present: {sorted(wroot)})"
-                )
-        for lname, lp in model.params.items():
-            for pname, arr in lp.items():
-                got = np.shape(params[lname][pname])
-                want = np.shape(arr)
-                if got != want:
-                    raise KerasImportError(
-                        f"weight shape mismatch for {lname}/{pname}: "
-                        f"H5 has {got}, architecture needs {want}"
-                    )
-        model.params = params
-        model.net_state = state
-        model.opt_state = model._tx.init(params)
+        _load_and_validate_weights(f, {c.name: c for c in confs}, model)
         return model
+
+
+def _load_and_validate_weights(f, name_to_conf: Dict[str, Any], model) -> None:
+    """Write H5 weight groups into the initialized model, enforcing that
+    every parameterized layer received weights at the initialized shapes —
+    silently keeping random init would "import" a model that predicts
+    garbage.  Shared by the Sequential and Functional entry points."""
+    params = dict(model.params)
+    state = dict(model.net_state)
+    wroot = f["model_weights"] if "model_weights" in f else f
+    loaded = set()
+    for gname in wroot:
+        if gname not in name_to_conf:
+            continue
+        weights = _collect_layer_weights(wroot[gname])
+        if weights:
+            _apply_weights(name_to_conf[gname], weights, params, state)
+            loaded.add(gname)
+    for name in name_to_conf:
+        if name in model.params and name not in loaded:
+            raise KerasImportError(
+                f"no weights found in H5 for parameterized layer {name!r} "
+                f"(groups present: {sorted(wroot)})"
+            )
+    for lname, lp in model.params.items():
+        for pname, arr in lp.items():
+            got, want = np.shape(params[lname][pname]), np.shape(arr)
+            if got != want:
+                raise KerasImportError(
+                    f"weight shape mismatch for {lname}/{pname}: "
+                    f"H5 has {got}, architecture needs {want}"
+                )
+    model.params = params
+    model.net_state = state
+    model.opt_state = model._tx.init(params)
 
 
 # --- functional (branching) graphs -> GraphModel ----------------------------
@@ -591,17 +598,15 @@ def import_keras_graph(path: str):
                 )
                 continue
             if cls == "Concatenate":
+                # positive axes are validated against the input rank at
+                # graph build time (H5 dialects don't reliably carry
+                # shapes); only the trailing axis is concat-able
                 axis = lcfg.get("axis", -1)
-                if axis not in (-1, None):
-                    # a positive axis naming the trailing dim is equivalent
-                    shapes = lcfg.get("build_config", {}).get("input_shape") or []
-                    rank = len(shapes[0]) if shapes and shapes[0] else None
-                    if rank is None or axis != rank - 1:
-                        raise KerasImportError(
-                            f"Concatenate {name!r}: only trailing-axis "
-                            f"(channels_last) concat imports, got axis={axis}"
-                        )
-                b.add_vertex(name, MergeVertex(), *inputs)
+                b.add_vertex(
+                    name,
+                    MergeVertex(declared_axis=-1 if axis is None else int(axis)),
+                    *inputs,
+                )
                 continue
             if cls not in _LAYER_MAPPERS:
                 raise KerasImportError(f"unsupported Keras layer {cls!r} ({name})")
@@ -623,29 +628,25 @@ def import_keras_graph(path: str):
             b.add_layer(name, mapped, *inputs)
 
         # output heads: promote a Dense tail to OutputLayer, else add a
-        # LossLayer node per declared output
+        # LossLayer node per declared output (losses keyed by output name
+        # in multi-output training configs)
         out_nodes: List[str] = []
         for oname in graph_outputs:
             oname = resolve(oname)
             lc = confs.get(oname)
             if isinstance(lc, Dense) and not isinstance(lc, OutputLayer):
                 act = lc.activation or Activation.IDENTITY
-                loss = _infer_loss(training_cfg, act)
+                loss = _infer_loss(training_cfg, act, output_name=oname)
                 promoted = OutputLayer(
                     name=lc.name, n_out=lc.n_out, has_bias=lc.has_bias,
                     activation=act, loss=loss,
                 )
                 confs[oname] = promoted
-                import dataclasses as _dc
-
-                b._nodes = [
-                    _dc.replace(n, layer=promoted) if n.name == oname else n
-                    for n in b._nodes
-                ]
+                b.replace_layer(oname, promoted)
                 out_nodes.append(oname)
             else:
                 act = Activation.IDENTITY
-                loss = _infer_loss(training_cfg, act)
+                loss = _infer_loss(training_cfg, act, output_name=oname)
                 head = f"{oname}_loss"
                 b.add_layer(head, LossLayer(name=head, loss=loss,
                                             activation=act), oname)
@@ -675,34 +676,7 @@ def import_keras_graph(path: str):
                         "(channels_last) BN imports"
                     )
 
-        # weights
-        params = dict(model.params)
-        state = dict(model.net_state)
-        wroot = f["model_weights"] if "model_weights" in f else f
-        loaded = set()
-        for gname in wroot:
-            if gname not in confs:
-                continue
-            weights = _collect_layer_weights(wroot[gname])
-            if weights:
-                _apply_weights(confs[gname], weights, params, state)
-                loaded.add(gname)
-        for name, lc in confs.items():
-            if name in model.params and name not in loaded:
-                raise KerasImportError(
-                    f"no weights found in H5 for parameterized layer {name!r}"
-                )
-        for lname, lp in model.params.items():
-            for pname, arr in lp.items():
-                got, want = np.shape(params[lname][pname]), np.shape(arr)
-                if got != want:
-                    raise KerasImportError(
-                        f"weight shape mismatch for {lname}/{pname}: H5 has "
-                        f"{got}, architecture needs {want}"
-                    )
-        model.params = params
-        model.net_state = state
-        model.opt_state = model._tx.init(params)
+        _load_and_validate_weights(f, confs, model)
         return model
 
 

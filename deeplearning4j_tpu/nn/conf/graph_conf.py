@@ -65,10 +65,33 @@ class VertexConfig:
 @serde.register
 @dataclasses.dataclass(frozen=True)
 class MergeVertex(VertexConfig):
-    """Concatenate along the feature (last) axis."""
+    """Concatenate along the feature (last) axis.
+
+    axis=-1 is the only concat this vertex performs; a non-negative
+    `declared_axis` (e.g. carried over from an imported config that spelled
+    the trailing axis positionally) is VALIDATED against the input rank at
+    type-inference time and rejected if it isn't the trailing axis.
+    """
+
+    declared_axis: int = -1
+
+    _RANK = {
+        InputType.KIND_FF: 2,
+        InputType.KIND_RNN: 3,
+        InputType.KIND_CNN: 4,
+        InputType.KIND_CNN3D: 5,
+    }
 
     def output_type(self, itypes):
         first = itypes[0]
+        if self.declared_axis >= 0:
+            rank = self._RANK.get(first.kind, 2)
+            if self.declared_axis != rank - 1:
+                raise ValueError(
+                    f"MergeVertex concatenates the trailing axis only; "
+                    f"declared axis {self.declared_axis} on rank-{rank} "
+                    "input is not the trailing axis"
+                )
         if first.kind == InputType.KIND_FF:
             return InputType.feed_forward(sum(t.size for t in itypes))
         if first.kind == InputType.KIND_CNN:
@@ -497,6 +520,17 @@ class GraphBuilder:
 
     def set_outputs(self, *names: str):
         self._outputs = tuple(names)
+        return self
+
+    def replace_layer(self, name: str, layer: LayerConfig):
+        """Swap the layer config of an existing node (e.g. promoting a
+        Dense tail to an OutputLayer during model import)."""
+        if not any(n.name == name for n in self._nodes):
+            raise ValueError(f"no node named {name!r}")
+        self._nodes = [
+            dataclasses.replace(n, layer=layer) if n.name == name else n
+            for n in self._nodes
+        ]
         return self
 
     def updater(self, u: Updater):

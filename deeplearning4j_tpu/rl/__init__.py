@@ -1,0 +1,40 @@
+"""Reinforcement learning — the RL4J role.
+
+Reference: `rl4j-core` (SURVEY.md §2.2 "RL4J"): Q-learning
+(`QLearningDiscrete`, double/dueling DQN), actor-critic (A3C/A2C),
+policies, experience replay, MDP abstractions and environment bindings.
+
+TPU-native shape: networks are built from the framework's own layer
+configs (pure init/apply), and each algorithm owns ONE jitted update step
+(TD loss or actor-critic loss, gradients, optimizer) — the whole learning
+step is a single XLA program, like the supervised models' compiled fit.
+Environments are in-process numpy MDPs (`CartPole`, `GridWorld`) — the
+gym/malmo bindings role without a network dependency.
+
+    from deeplearning4j_tpu.rl import DQN, CartPole
+    agent = DQN(obs_dim=4, n_actions=2, hidden=(64, 64), double=True)
+    history = agent.train(CartPole(), episodes=150)
+    action = agent.play(obs)                      # greedy policy
+"""
+
+from deeplearning4j_tpu.rl.mdp import MDP, CartPole, GridWorld
+from deeplearning4j_tpu.rl.replay import ExperienceReplay
+from deeplearning4j_tpu.rl.policy import (
+    BoltzmannPolicy,
+    EpsilonGreedyPolicy,
+    GreedyPolicy,
+)
+from deeplearning4j_tpu.rl.dqn import DQN
+from deeplearning4j_tpu.rl.a2c import A2C
+
+__all__ = [
+    "MDP",
+    "CartPole",
+    "GridWorld",
+    "ExperienceReplay",
+    "EpsilonGreedyPolicy",
+    "GreedyPolicy",
+    "BoltzmannPolicy",
+    "DQN",
+    "A2C",
+]

@@ -1,0 +1,137 @@
+"""Advantage actor-critic — the A3C/A2C role
+(`org.deeplearning4j.rl4j.learning.async.a3c`).
+
+Synchronous single-worker A2C (the reference's async-across-JVM-threads
+design is an artifact of op-at-a-time execution; with a compiled update
+step, batching n-step rollouts into one program is strictly better on
+TPU).  Shared torso, policy + value heads, n-step returns, entropy bonus,
+one jitted update per rollout.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.rl.dqn import _build_torso, _torso_apply
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.runtime.rng import SeedStream
+
+
+class A2C:
+    def __init__(
+        self,
+        obs_dim: int,
+        n_actions: int,
+        hidden: tuple[int, ...] = (64,),
+        gamma: float = 0.99,
+        lr: float = 7e-4,
+        rollout_steps: int = 32,
+        value_coef: float = 0.5,
+        entropy_coef: float = 0.01,
+        seed: int = 0,
+    ):
+        self.obs_dim, self.n_actions = obs_dim, n_actions
+        self.gamma = gamma
+        self.rollout_steps = rollout_steps
+        self.value_coef = value_coef
+        self.entropy_coef = entropy_coef
+        self._np_rng = np.random.default_rng(seed)
+
+        stream = SeedStream(seed)
+        self.layers, torso = _build_torso(obs_dim, hidden, stream.key("torso"))
+        d = hidden[-1] if hidden else obs_dim
+        kp, kv = jax.random.split(stream.key("heads"))
+        self.params = {
+            "torso": torso,
+            "pi": {"W": jax.random.normal(kp, (d, n_actions)) * 0.01,
+                   "b": jnp.zeros((n_actions,))},
+            "v": {"W": jax.random.normal(kv, (d, 1)) * (1 / np.sqrt(d)),
+                  "b": jnp.zeros((1,))},
+        }
+        self._tx = optax.adam(lr)
+        self.opt_state = self._tx.init(self.params)
+        self._fwd = jax.jit(self._forward)
+        self._update = self._make_update()
+
+    def _forward(self, params, obs):
+        h = _torso_apply(self.layers, params["torso"], obs)
+        logits = h @ params["pi"]["W"] + params["pi"]["b"]
+        value = (h @ params["v"]["W"] + params["v"]["b"])[..., 0]
+        return logits, value
+
+    def _make_update(self):
+        @jax.jit
+        def update(params, opt_state, obs, actions, returns):
+            def loss_fn(p):
+                logits, values = self._forward(p, obs)
+                logp = jax.nn.log_softmax(logits)
+                picked = jnp.take_along_axis(
+                    logp, actions[:, None].astype(jnp.int32), axis=-1
+                )[:, 0]
+                adv = jax.lax.stop_gradient(returns - values)
+                policy_loss = -jnp.mean(picked * adv)
+                value_loss = jnp.mean((returns - values) ** 2)
+                entropy = -jnp.mean(
+                    jnp.sum(jnp.exp(logp) * logp, axis=-1)
+                )
+                return (
+                    policy_loss
+                    + self.value_coef * value_loss
+                    - self.entropy_coef * entropy
+                )
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state, loss
+
+        return update
+
+    def act(self, obs: np.ndarray) -> int:
+        logits, _ = self._fwd(self.params, obs[None])
+        p = np.asarray(jax.nn.softmax(logits))[0]
+        return int(self._np_rng.choice(self.n_actions, p=p))
+
+    def play(self, obs: np.ndarray) -> int:
+        logits, _ = self._fwd(self.params, obs[None])
+        return int(np.argmax(np.asarray(logits)[0]))
+
+    def train(self, mdp: MDP, total_steps: int = 20000) -> list[float]:
+        """Returns completed-episode returns in order of completion."""
+        history: list[float] = []
+        obs = mdp.reset()
+        ep_return = 0.0
+        steps_done = 0
+        while steps_done < total_steps:
+            obs_buf, act_buf, rew_buf, done_buf = [], [], [], []
+            for _ in range(self.rollout_steps):
+                action = self.act(obs)
+                next_obs, reward, done, _ = mdp.step(action)
+                obs_buf.append(obs)
+                act_buf.append(action)
+                rew_buf.append(reward)
+                done_buf.append(done)
+                ep_return += reward
+                steps_done += 1
+                if done:
+                    history.append(ep_return)
+                    ep_return = 0.0
+                    obs = mdp.reset()
+                else:
+                    obs = next_obs
+            # n-step returns bootstrapped from the value head
+            _, bootstrap = self._fwd(self.params, obs[None])
+            ret = float(bootstrap[0])
+            returns = np.zeros(len(rew_buf), np.float32)
+            for i in reversed(range(len(rew_buf))):
+                ret = rew_buf[i] + self.gamma * ret * (1.0 - float(done_buf[i]))
+                returns[i] = ret
+            self.params, self.opt_state, _ = self._update(
+                self.params, self.opt_state,
+                np.asarray(obs_buf, np.float32),
+                np.asarray(act_buf, np.int32),
+                returns,
+            )
+        return history

@@ -1,0 +1,173 @@
+"""DQN — `QLearningDiscrete` (+ double/dueling variants) role.
+
+The torso reuses the framework's Dense layer configs (pure init/apply);
+the TD update — forward on both online and target params, double-DQN
+action selection, Huber TD loss, gradients, Adam — is ONE jitted XLA
+program per step (the reference interprets this op-by-op through the
+executioner; SURVEY.md §3.1's op-at-a-time overhead is exactly what the
+compiled step removes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf.layers import Dense
+from deeplearning4j_tpu.rl.mdp import MDP
+from deeplearning4j_tpu.rl.policy import EpsilonGreedyPolicy
+from deeplearning4j_tpu.rl.replay import ExperienceReplay
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.runtime.rng import SeedStream
+
+
+def _build_torso(obs_dim: int, hidden: tuple[int, ...], key) -> tuple[list, dict]:
+    layers, params = [], {}
+    itype = InputType.feed_forward(obs_dim)
+    for i, h in enumerate(hidden):
+        cfg = Dense(name=f"h{i}", n_out=h, activation=Activation.RELU)
+        p, _ = cfg.init(jax.random.fold_in(key, i), itype)
+        layers.append(cfg)
+        params[cfg.name] = p
+        itype = cfg.output_type(itype)
+    return layers, params
+
+
+def _torso_apply(layers, params, x):
+    for cfg in layers:
+        x, _ = cfg.apply(params[cfg.name], {}, x)
+    return x
+
+
+class DQN:
+    def __init__(
+        self,
+        obs_dim: int,
+        n_actions: int,
+        hidden: tuple[int, ...] = (64, 64),
+        gamma: float = 0.99,
+        lr: float = 1e-3,
+        batch_size: int = 64,
+        replay_capacity: int = 20000,
+        target_update_every: int = 200,
+        double: bool = True,
+        dueling: bool = False,
+        policy: EpsilonGreedyPolicy | None = None,
+        seed: int = 0,
+    ):
+        self.obs_dim, self.n_actions = obs_dim, n_actions
+        self.gamma = gamma
+        self.batch_size = batch_size
+        self.target_update_every = target_update_every
+        self.double = double
+        self.dueling = dueling
+        self.policy = policy or EpsilonGreedyPolicy()
+        self._np_rng = np.random.default_rng(seed)
+
+        stream = SeedStream(seed)
+        self.layers, torso = _build_torso(obs_dim, hidden, stream.key("torso"))
+        d = hidden[-1] if hidden else obs_dim
+        k = stream.key("heads")
+        if dueling:
+            k1, k2 = jax.random.split(k)
+            heads = {
+                "value": {"W": jax.random.normal(k1, (d, 1)) * (1 / np.sqrt(d)),
+                          "b": jnp.zeros((1,))},
+                "adv": {"W": jax.random.normal(k2, (d, n_actions)) * (1 / np.sqrt(d)),
+                        "b": jnp.zeros((n_actions,))},
+            }
+        else:
+            heads = {
+                "q": {"W": jax.random.normal(k, (d, n_actions)) * (1 / np.sqrt(d)),
+                      "b": jnp.zeros((n_actions,))},
+            }
+        self.params = {"torso": torso, "heads": heads}
+        self.target_params = jax.tree.map(jnp.copy, self.params)
+        self._tx = optax.adam(lr)
+        self.opt_state = self._tx.init(self.params)
+        self.replay = ExperienceReplay(replay_capacity, obs_dim, seed)
+        self.global_step = 0
+        self._update = self._make_update()
+        self._qfn = jax.jit(self._q_values)
+
+    # -- pure functions ----------------------------------------------------
+    def _q_values(self, params, obs):
+        h = _torso_apply(self.layers, params["torso"], obs)
+        heads = params["heads"]
+        if self.dueling:
+            v = h @ heads["value"]["W"] + heads["value"]["b"]
+            a = h @ heads["adv"]["W"] + heads["adv"]["b"]
+            return v + a - jnp.mean(a, axis=-1, keepdims=True)
+        return h @ heads["q"]["W"] + heads["q"]["b"]
+
+    def _make_update(self):
+        @jax.jit
+        def update(params, target_params, opt_state, obs, actions, rewards,
+                   next_obs, dones):
+            if self.double:
+                next_online = self._q_values(params, next_obs)
+                next_actions = jnp.argmax(next_online, axis=-1)
+                next_q_all = self._q_values(target_params, next_obs)
+                next_q = jnp.take_along_axis(
+                    next_q_all, next_actions[:, None], axis=-1
+                )[:, 0]
+            else:
+                next_q = jnp.max(
+                    self._q_values(target_params, next_obs), axis=-1
+                )
+            targets = rewards + self.gamma * (1.0 - dones) * next_q
+            targets = jax.lax.stop_gradient(targets)
+
+            def loss_fn(p):
+                q = self._q_values(p, obs)
+                picked = jnp.take_along_axis(
+                    q, actions[:, None].astype(jnp.int32), axis=-1
+                )[:, 0]
+                return jnp.mean(optax.huber_loss(picked, targets))
+
+            loss, grads = jax.value_and_grad(loss_fn)(params)
+            updates, opt_state = self._tx.update(grads, opt_state, params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        return update
+
+    # -- interaction -------------------------------------------------------
+    def act(self, obs: np.ndarray) -> int:
+        q = np.asarray(self._qfn(self.params, obs[None]))[0]
+        return self.policy.select(q, self._np_rng, self.global_step)
+
+    def play(self, obs: np.ndarray) -> int:
+        """Greedy action (the trained Policy role)."""
+        return int(np.argmax(np.asarray(self._qfn(self.params, obs[None]))[0]))
+
+    def train(self, mdp: MDP, episodes: int = 100,
+              warmup_steps: int = 500) -> list[float]:
+        """Returns per-episode undiscounted returns."""
+        history = []
+        for _ in range(episodes):
+            obs = mdp.reset()
+            ep_return, done = 0.0, False
+            while not done:
+                action = self.act(obs)
+                next_obs, reward, done, _ = mdp.step(action)
+                self.replay.add(obs, action, reward, next_obs, done)
+                obs = next_obs
+                ep_return += reward
+                self.global_step += 1
+                if len(self.replay) >= max(warmup_steps, self.batch_size):
+                    batch = self.replay.sample(self.batch_size)
+                    self.params, self.opt_state, _ = self._update(
+                        self.params, self.target_params, self.opt_state, *batch
+                    )
+                    if self.global_step % self.target_update_every == 0:
+                        self.target_params = jax.tree.map(
+                            jnp.copy, self.params
+                        )
+            history.append(ep_return)
+        return history

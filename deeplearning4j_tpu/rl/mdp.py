@@ -1,0 +1,120 @@
+"""MDP abstraction + built-in environments.
+
+Reference roles: `org.deeplearning4j.rl4j.mdp.MDP` and the gym/malmo/ale
+environment bindings.  No network here, so the classic control tasks are
+implemented directly (same dynamics the gym classics use) — everything an
+RL algorithm needs to be tested end-to-end in-process.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class MDP:
+    """reset() -> obs; step(action) -> (obs, reward, done, info)."""
+
+    obs_dim: int
+    n_actions: int
+
+    def reset(self, seed=None) -> np.ndarray:
+        raise NotImplementedError
+
+    def step(self, action: int):
+        raise NotImplementedError
+
+
+class CartPole(MDP):
+    """Cart-pole balancing (the classic control dynamics: Barto, Sutton &
+    Anderson 1983 — the same task gym's CartPole-v1 wraps).  Reward +1 per
+    step; episode ends on |x| > 2.4, |theta| > 12deg, or max_steps."""
+
+    obs_dim = 4
+    n_actions = 2
+
+    GRAVITY = 9.8
+    M_CART, M_POLE = 1.0, 0.1
+    L_HALF = 0.5                    # half pole length
+    FORCE = 10.0
+    DT = 0.02
+    THETA_LIMIT = 12 * np.pi / 180
+    X_LIMIT = 2.4
+
+    def __init__(self, max_steps: int = 500, seed: int = 0):
+        self.max_steps = max_steps
+        self._rng = np.random.default_rng(seed)
+        self._state = None
+        self._t = 0
+
+    def reset(self, seed=None):
+        if seed is not None:
+            self._rng = np.random.default_rng(seed)
+        self._state = self._rng.uniform(-0.05, 0.05, 4)
+        self._t = 0
+        return self._state.astype(np.float32)
+
+    def step(self, action: int):
+        x, x_dot, th, th_dot = self._state
+        force = self.FORCE if action == 1 else -self.FORCE
+        total_m = self.M_CART + self.M_POLE
+        pole_ml = self.M_POLE * self.L_HALF
+        cos_t, sin_t = np.cos(th), np.sin(th)
+        temp = (force + pole_ml * th_dot**2 * sin_t) / total_m
+        th_acc = (self.GRAVITY * sin_t - cos_t * temp) / (
+            self.L_HALF * (4.0 / 3.0 - self.M_POLE * cos_t**2 / total_m)
+        )
+        x_acc = temp - pole_ml * th_acc * cos_t / total_m
+        x += self.DT * x_dot
+        x_dot += self.DT * x_acc
+        th += self.DT * th_dot
+        th_dot += self.DT * th_acc
+        self._state = np.array([x, x_dot, th, th_dot])
+        self._t += 1
+        done = (
+            abs(x) > self.X_LIMIT
+            or abs(th) > self.THETA_LIMIT
+            or self._t >= self.max_steps
+        )
+        return self._state.astype(np.float32), 1.0, bool(done), {}
+
+
+class GridWorld(MDP):
+    """Deterministic n x n grid: start top-left, goal bottom-right,
+    actions (up, down, left, right), -0.01 per step, +1 at the goal.
+    Observation: one-hot cell index.  Optimal return is known in closed
+    form — the convergence oracle for the DQN test."""
+
+    n_actions = 4
+
+    def __init__(self, n: int = 4, max_steps: int = 100):
+        self.n = n
+        self.obs_dim = n * n
+        self.max_steps = max_steps
+        self._pos = (0, 0)
+        self._t = 0
+
+    def _obs(self):
+        v = np.zeros(self.obs_dim, np.float32)
+        v[self._pos[0] * self.n + self._pos[1]] = 1.0
+        return v
+
+    def reset(self, seed=None):
+        self._pos, self._t = (0, 0), 0
+        return self._obs()
+
+    def step(self, action: int):
+        r, c = self._pos
+        dr, dc = [(-1, 0), (1, 0), (0, -1), (0, 1)][action]
+        self._pos = (
+            min(max(r + dr, 0), self.n - 1),
+            min(max(c + dc, 0), self.n - 1),
+        )
+        self._t += 1
+        at_goal = self._pos == (self.n - 1, self.n - 1)
+        reward = 1.0 if at_goal else -0.01
+        done = at_goal or self._t >= self.max_steps
+        return self._obs(), reward, bool(done), {}
+
+    def optimal_return(self) -> float:
+        steps = 2 * (self.n - 1)
+        return 1.0 - 0.01 * (steps - 1)

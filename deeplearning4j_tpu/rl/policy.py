@@ -1,0 +1,41 @@
+"""Action-selection policies — `org.deeplearning4j.rl4j.policy` role
+(EpsGreedy, Policy, BoltzmannQ)."""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class GreedyPolicy:
+    def select(self, q_values: np.ndarray, rng, step: int) -> int:
+        return int(np.argmax(q_values))
+
+
+class EpsilonGreedyPolicy:
+    """Linearly annealed epsilon-greedy (the EpsGreedy role)."""
+
+    def __init__(self, eps_start: float = 1.0, eps_end: float = 0.05,
+                 anneal_steps: int = 5000):
+        self.eps_start = eps_start
+        self.eps_end = eps_end
+        self.anneal_steps = max(1, anneal_steps)
+
+    def epsilon(self, step: int) -> float:
+        frac = min(1.0, step / self.anneal_steps)
+        return self.eps_start + frac * (self.eps_end - self.eps_start)
+
+    def select(self, q_values: np.ndarray, rng, step: int) -> int:
+        if rng.random() < self.epsilon(step):
+            return int(rng.integers(0, q_values.shape[-1]))
+        return int(np.argmax(q_values))
+
+
+class BoltzmannPolicy:
+    def __init__(self, temperature: float = 1.0):
+        self.temperature = temperature
+
+    def select(self, q_values: np.ndarray, rng, step: int) -> int:
+        z = q_values / max(self.temperature, 1e-8)
+        z = z - z.max()
+        p = np.exp(z) / np.exp(z).sum()
+        return int(rng.choice(len(p), p=p))

@@ -1,0 +1,46 @@
+"""Experience replay — `org.deeplearning4j.rl4j.experience` role.
+
+Circular numpy buffers with uniform sampling; stores (s, a, r, s', done)
+transitions.  Host-side on purpose: collection is sequential/interactive;
+only the SAMPLED batch crosses to the device inside the jitted update.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+class ExperienceReplay:
+    def __init__(self, capacity: int, obs_dim: int, seed: int = 0):
+        self.capacity = capacity
+        self.obs = np.zeros((capacity, obs_dim), np.float32)
+        self.next_obs = np.zeros((capacity, obs_dim), np.float32)
+        self.actions = np.zeros((capacity,), np.int32)
+        self.rewards = np.zeros((capacity,), np.float32)
+        self.dones = np.zeros((capacity,), np.float32)
+        self._rng = np.random.default_rng(seed)
+        self._next = 0
+        self.size = 0
+
+    def add(self, obs, action, reward, next_obs, done) -> None:
+        i = self._next
+        self.obs[i] = obs
+        self.actions[i] = action
+        self.rewards[i] = reward
+        self.next_obs[i] = next_obs
+        self.dones[i] = float(done)
+        self._next = (i + 1) % self.capacity
+        self.size = min(self.size + 1, self.capacity)
+
+    def sample(self, batch_size: int):
+        idx = self._rng.integers(0, self.size, batch_size)
+        return (
+            self.obs[idx],
+            self.actions[idx],
+            self.rewards[idx],
+            self.next_obs[idx],
+            self.dones[idx],
+        )
+
+    def __len__(self) -> int:
+        return self.size

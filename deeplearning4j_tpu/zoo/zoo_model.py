@@ -45,5 +45,14 @@ class ZooModel:
         from deeplearning4j_tpu.zoo.pretrained import PretrainedRegistry
 
         if path is None:
-            path = PretrainedRegistry().resolve(self.NAME, pretrained_type)
+            registry = PretrainedRegistry()
+            try:
+                path = registry.resolve(self.NAME, pretrained_type)
+            except FileNotFoundError:
+                # pre-registry layout: a bare {NAME}.zip in the pretrained
+                # dir (no checksum index) — keep those setups working
+                legacy = registry.root / f"{self.NAME}.zip"
+                if not legacy.exists():
+                    raise
+                path = str(legacy)
         return ModelSerializer.restore(str(path))

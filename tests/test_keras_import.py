@@ -242,6 +242,49 @@ class TestBranchingFunctionalImport:
         )
         assert isinstance(ours_g, GraphModel)
 
+    def test_concatenate_explicit_trailing_axis(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+
+        inp = keras.layers.Input((8, 8, 3))
+        a = keras.layers.Conv2D(2, 1)(inp)
+        b2 = keras.layers.Conv2D(2, 1)(inp)
+        m = keras.layers.Concatenate(axis=3)([a, b2])    # == axis=-1 on NHWC
+        p = keras.layers.GlobalAveragePooling2D()(m)
+        out = keras.layers.Dense(2)(p)
+        km = keras.Model(inp, out)
+        ours = import_keras_graph(save_h5(km, tmp_path))
+        x = np.random.default_rng(8).normal(size=(2, 8, 8, 3)).astype(np.float32)
+        assert_outputs_match(km, ours, x)
+
+    def test_concatenate_non_trailing_axis_rejected(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+
+        inp = keras.layers.Input((8, 8, 3))
+        a = keras.layers.Conv2D(2, 1)(inp)
+        b2 = keras.layers.Conv2D(2, 1)(inp)
+        m = keras.layers.Concatenate(axis=1)([a, b2])    # height concat
+        out = keras.layers.Dense(2)(keras.layers.Flatten()(m))
+        km = keras.Model(inp, out)
+        with pytest.raises(ValueError, match="trailing axis"):
+            import_keras_graph(save_h5(km, tmp_path))
+
+    def test_multi_output_losses_keyed_by_name(self, tmp_path):
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+        from deeplearning4j_tpu.nn.losses import Loss
+
+        inp = keras.layers.Input((6,))
+        h = keras.layers.Dense(8, activation="relu")(inp)
+        out_a = keras.layers.Dense(1, name="reg_head")(h)
+        out_b = keras.layers.Dense(3, activation="softmax", name="cls_head")(h)
+        km = keras.Model(inp, [out_a, out_b])
+        km.compile(optimizer="adam",
+                   loss={"reg_head": "mse",
+                         "cls_head": "categorical_crossentropy"})
+        ours = import_keras_graph(save_h5(km, tmp_path))
+        by_name = {n.name: n for n in ours.conf.nodes}
+        assert by_name["reg_head"].layer.loss == Loss.MSE
+        assert by_name["cls_head"].layer.loss == Loss.MCXENT
+
     def test_imported_graph_trains(self, tmp_path):
         from deeplearning4j_tpu.data.dataset import DataSet
         from deeplearning4j_tpu.modelimport.keras import import_keras_graph

@@ -1,0 +1,230 @@
+"""Tests for the 1-D/3-D conv family, croppings, and PReLU (layer-breadth
+parity: Convolution1D/3D, Subsampling1D/3D, Cropping1D/2D/3D, PReLULayer).
+Forward shapes, value semantics, gradient checks, serde round-trips, and
+end-to-end trainability through the DSL."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.validation import gradient_check
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    Conv1D,
+    GlobalPooling,
+    Conv3D,
+    Cropping1D,
+    Cropping2D,
+    Cropping3D,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    PReLU,
+    Subsampling1D,
+    Subsampling3D,
+)
+from deeplearning4j_tpu.nn.conf.layers import PoolingType
+
+KEY = jax.random.key(0)
+RNG = np.random.default_rng(5)
+
+
+def run_layer(layer, itype, x):
+    params, state = layer.init(KEY, itype)
+    y, _ = layer.apply(params, state, jnp.asarray(x))
+    expected = layer.output_type(itype)
+    assert y.shape == (x.shape[0], *expected.shape), (
+        f"{type(layer).__name__}: got {y.shape}, expected batch+{expected.shape}"
+    )
+    return y, params
+
+
+class TestConv1D:
+    def test_shapes_same_and_valid(self):
+        x = RNG.normal(0, 1, (2, 10, 3)).astype(np.float32)
+        it = InputType.recurrent(3, 10)
+        run_layer(Conv1D(n_out=5, kernel=3, padding="same"), it, x)
+        y, _ = run_layer(Conv1D(n_out=5, kernel=3, padding="valid"), it, x)
+        assert y.shape == (2, 8, 5)
+        y, _ = run_layer(Conv1D(n_out=4, kernel=3, stride=2, padding="same"), it, x)
+        assert y.shape == (2, 5, 4)
+
+    def test_matches_manual_kernel1(self):
+        x = RNG.normal(0, 1, (2, 6, 3)).astype(np.float32)
+        layer = Conv1D(n_out=4, kernel=1, has_bias=False,
+                       activation=Activation.IDENTITY)
+        y, params = run_layer(layer, InputType.recurrent(3, 6), x)
+        np.testing.assert_allclose(
+            np.asarray(y), x @ np.asarray(params["W"])[0], rtol=1e-5, atol=1e-5
+        )
+
+    def test_gradient(self):
+        x = jnp.asarray(RNG.normal(0, 1, (2, 6, 3)).astype(np.float32))
+        layer = Conv1D(n_out=4, kernel=3, activation=Activation.TANH)
+        params, _ = layer.init(KEY, InputType.recurrent(3, 6))
+        res = gradient_check(
+            lambda p: jnp.sum(layer.apply(p, {}, x)[0] ** 2), params
+        )
+        assert res, res.failures
+
+
+class TestConv3D:
+    def test_shapes(self):
+        x = RNG.normal(0, 1, (2, 4, 6, 6, 2)).astype(np.float32)
+        it = InputType.convolutional3d(4, 6, 6, 2)
+        y, _ = run_layer(Conv3D(n_out=3, kernel=(3, 3, 3), padding="same"), it, x)
+        assert y.shape == (2, 4, 6, 6, 3)
+        y, _ = run_layer(Conv3D(n_out=3, kernel=(3, 3, 3), padding="valid"), it, x)
+        assert y.shape == (2, 2, 4, 4, 3)
+
+    def test_gradient(self):
+        x = jnp.asarray(RNG.normal(0, 1, (1, 3, 4, 4, 2)).astype(np.float32))
+        layer = Conv3D(n_out=2, kernel=(2, 2, 2), activation=Activation.TANH)
+        params, _ = layer.init(KEY, InputType.convolutional3d(3, 4, 4, 2))
+        res = gradient_check(
+            lambda p: jnp.sum(layer.apply(p, {}, x)[0] ** 2), params
+        )
+        assert res, res.failures
+
+
+class TestPooling:
+    def test_subsampling1d_max_and_avg(self):
+        x = np.arange(12, dtype=np.float32).reshape(1, 6, 2)
+        it = InputType.recurrent(2, 6)
+        y, _ = run_layer(Subsampling1D(kernel=2, stride=2), it, x)
+        np.testing.assert_allclose(np.asarray(y)[0, :, 0], [2, 6, 10])
+        y, _ = run_layer(
+            Subsampling1D(kernel=2, stride=2, pooling=PoolingType.AVG), it, x
+        )
+        np.testing.assert_allclose(np.asarray(y)[0, :, 0], [1, 5, 9])
+
+    def test_subsampling3d(self):
+        x = RNG.normal(0, 1, (2, 4, 4, 4, 3)).astype(np.float32)
+        it = InputType.convolutional3d(4, 4, 4, 3)
+        y, _ = run_layer(Subsampling3D(kernel=(2, 2, 2), stride=(2, 2, 2)), it, x)
+        assert y.shape == (2, 2, 2, 2, 3)
+        # max pooling really takes the max
+        assert np.asarray(y)[0, 0, 0, 0, 0] == x[0, :2, :2, :2, 0].max()
+
+
+class TestCroppings:
+    def test_cropping1d(self):
+        x = np.arange(10, dtype=np.float32).reshape(1, 5, 2)
+        y, _ = run_layer(Cropping1D(cropping=(1, 2)), InputType.recurrent(2, 5), x)
+        np.testing.assert_allclose(np.asarray(y), x[:, 1:3, :])
+
+    def test_cropping2d_forms(self):
+        x = RNG.normal(0, 1, (1, 8, 8, 2)).astype(np.float32)
+        it = InputType.convolutional(8, 8, 2)
+        y, _ = run_layer(Cropping2D(cropping=2), it, x)
+        np.testing.assert_allclose(np.asarray(y), x[:, 2:6, 2:6, :])
+        y, _ = run_layer(Cropping2D(cropping=(1, 2)), it, x)
+        np.testing.assert_allclose(np.asarray(y), x[:, 1:7, 2:6, :])
+        y, _ = run_layer(Cropping2D(cropping=((1, 0), (0, 3))), it, x)
+        np.testing.assert_allclose(np.asarray(y), x[:, 1:, :5, :])
+
+    def test_cropping3d(self):
+        x = RNG.normal(0, 1, (1, 6, 6, 6, 1)).astype(np.float32)
+        it = InputType.convolutional3d(6, 6, 6, 1)
+        y, _ = run_layer(Cropping3D(cropping=1), it, x)
+        np.testing.assert_allclose(np.asarray(y), x[:, 1:5, 1:5, 1:5, :])
+
+
+class TestPReLU:
+    def test_values_and_learnable_slope(self):
+        x = np.array([[-2.0, 3.0], [-1.0, -4.0]], np.float32)
+        layer = PReLU(alpha_init=0.1)
+        params, _ = layer.init(KEY, InputType.feed_forward(2))
+        y, _ = layer.apply(params, {}, jnp.asarray(x))
+        np.testing.assert_allclose(
+            np.asarray(y), [[-0.2, 3.0], [-0.1, -0.4]], rtol=1e-6
+        )
+        res = gradient_check(
+            lambda p: jnp.sum(layer.apply(p, {}, jnp.asarray(x))[0] ** 2), params
+        )
+        assert res, res.failures
+
+    def test_per_channel_cnn_alpha(self):
+        layer = PReLU()
+        params, _ = layer.init(KEY, InputType.convolutional(4, 4, 3))
+        assert params["alpha"].shape == (3,)
+
+
+class TestEndToEnd:
+    def test_conv1d_stack_trains(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn.losses import Loss
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(2)
+            .updater(Adam(5e-3))
+            .list()
+            .layer(Conv1D(n_out=8, kernel=3, activation=Activation.RELU))
+            .layer(Subsampling1D(kernel=2, stride=2))
+            .layer(Cropping1D(cropping=(1, 0)))
+            .layer(PReLU())
+            .layer(GlobalPooling())
+            .layer(OutputLayer(n_out=2, loss=Loss.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(3, 12))
+            .build()
+        )
+        m = SequentialModel(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (32, 12, 3)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.sum((1, 2)) > 0).astype(int)]
+        first = None
+        for _ in range(30):
+            m.fit_batch(DataSet(x, y))
+            first = first if first is not None else m.score_value
+        assert m.score_value < first
+
+    def test_conv3d_stack_trains(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn.losses import Loss
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(2)
+            .updater(Adam(5e-3))
+            .list()
+            .layer(Conv3D(n_out=4, kernel=(3, 3, 3), activation=Activation.RELU))
+            .layer(Subsampling3D(kernel=(2, 2, 2), stride=(2, 2, 2)))
+            .layer(OutputLayer(n_out=2, loss=Loss.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.convolutional3d(4, 6, 6, 1))
+            .build()
+        )
+        m = SequentialModel(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, 1, (16, 4, 6, 6, 1)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[(x.mean((1, 2, 3, 4)) > 0).astype(int)]
+        first = None
+        for _ in range(25):
+            m.fit_batch(DataSet(x, y))
+            first = first if first is not None else m.score_value
+        assert m.score_value < first
+
+    def test_serde_roundtrip(self):
+        from deeplearning4j_tpu.nn.losses import Loss
+
+        conf = (
+            NeuralNetConfiguration.builder()
+            .list()
+            .layer(Conv1D(n_out=4, kernel=5, stride=2, dilation=2))
+            .layer(Cropping1D(cropping=(2, 1)))
+            .layer(PReLU(alpha_init=0.3))
+            .layer(OutputLayer(n_out=2, loss=Loss.MCXENT))
+            .set_input_type(InputType.recurrent(3, 20))
+            .build()
+        )
+        back = type(conf).from_json(conf.to_json())
+        assert back.layers[0] == conf.layers[0]
+        assert back.layers[1].cropping == (2, 1)
+        assert back.layers[2].alpha_init == 0.3

@@ -59,6 +59,18 @@ class TestRegistry:
         with pytest.raises(FileNotFoundError, match="mnist"):
             registry.resolve("lenet", "imagenet")
 
+    def test_legacy_bare_zip_layout_still_loads(self, registry, tmp_path):
+        m, p, x = trained_lenet_zip(tmp_path)
+        registry.root.mkdir(parents=True, exist_ok=True)
+        import shutil
+
+        shutil.copyfile(p, registry.root / "lenet.zip")   # pre-registry layout
+        loaded = LeNet(num_classes=3, height=12, width=12).init_pretrained()
+        np.testing.assert_allclose(
+            np.asarray(m.output(x)), np.asarray(loaded.output(x)),
+            rtol=1e-5, atol=1e-6,
+        )
+
     def test_explicit_path_bypasses_registry(self, registry, tmp_path):
         m, p, x = trained_lenet_zip(tmp_path)
         loaded = LeNet(num_classes=3, height=12, width=12).init_pretrained(path=p)

@@ -1,0 +1,109 @@
+"""RL4J-role tests: envs, replay, policies, DQN convergence on GridWorld
+(closed-form optimal return as the oracle), A2C improvement on CartPole."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.rl import (
+    A2C,
+    BoltzmannPolicy,
+    CartPole,
+    DQN,
+    EpsilonGreedyPolicy,
+    ExperienceReplay,
+    GreedyPolicy,
+    GridWorld,
+)
+
+
+class TestEnvs:
+    def test_cartpole_dynamics_and_termination(self):
+        env = CartPole(max_steps=500, seed=1)
+        obs = env.reset()
+        assert obs.shape == (4,)
+        done, steps = False, 0
+        while not done and steps < 600:
+            obs, r, done, _ = env.step(steps % 2)
+            assert r == 1.0
+            steps += 1
+        assert done and steps <= 500
+
+    def test_gridworld_optimal_path(self):
+        env = GridWorld(n=4)
+        env.reset()
+        total = 0.0
+        for a in [1, 1, 1, 3, 3, 3]:          # down x3, right x3
+            _, r, done, _ = env.step(a)
+            total += r
+        assert done
+        np.testing.assert_allclose(total, env.optimal_return())
+
+
+class TestReplay:
+    def test_circular_overwrite_and_sample(self):
+        rp = ExperienceReplay(capacity=8, obs_dim=3, seed=0)
+        for i in range(12):
+            rp.add(np.full(3, i), i % 4, float(i), np.full(3, i + 1), False)
+        assert len(rp) == 8
+        # oldest entries (0..3) were overwritten
+        assert rp.obs.min() >= 4
+        obs, actions, rewards, next_obs, dones = rp.sample(16)
+        assert obs.shape == (16, 3) and rewards.min() >= 4.0
+
+
+class TestPolicies:
+    def test_epsilon_anneals(self):
+        p = EpsilonGreedyPolicy(1.0, 0.1, anneal_steps=100)
+        assert p.epsilon(0) == 1.0
+        assert abs(p.epsilon(50) - 0.55) < 1e-9
+        assert abs(p.epsilon(1000) - 0.1) < 1e-9
+
+    def test_greedy_and_boltzmann(self):
+        q = np.array([0.1, 2.0, -1.0])
+        rng = np.random.default_rng(0)
+        assert GreedyPolicy().select(q, rng, 0) == 1
+        picks = [
+            BoltzmannPolicy(0.5).select(q, rng, 0) for _ in range(200)
+        ]
+        assert np.bincount(picks, minlength=3).argmax() == 1
+
+
+class TestDQN:
+    def test_dqn_learns_gridworld(self):
+        env = GridWorld(n=3, max_steps=40)
+        agent = DQN(
+            obs_dim=env.obs_dim, n_actions=4, hidden=(32,),
+            gamma=0.95, lr=5e-3, batch_size=32, target_update_every=100,
+            policy=EpsilonGreedyPolicy(1.0, 0.05, anneal_steps=1500),
+            seed=3,
+        )
+        agent.train(env, episodes=120, warmup_steps=200)
+        # greedy rollout reaches the goal near-optimally
+        obs = env.reset()
+        total, done, steps = 0.0, False, 0
+        while not done and steps < 40:
+            obs, r, done, _ = env.step(agent.play(obs))
+            total += r
+            steps += 1
+        assert done and total > env.optimal_return() - 0.1
+
+    def test_dueling_double_variants_run(self):
+        env = GridWorld(n=3, max_steps=20)
+        for double, dueling in ((False, False), (True, True)):
+            agent = DQN(env.obs_dim, 4, hidden=(16,), double=double,
+                        dueling=dueling, seed=1)
+            hist = agent.train(env, episodes=3, warmup_steps=32)
+            assert len(hist) == 3 and all(np.isfinite(h) for h in hist)
+
+
+class TestA2C:
+    def test_a2c_improves_cartpole(self):
+        env = CartPole(max_steps=200, seed=5)
+        agent = A2C(obs_dim=4, n_actions=2, hidden=(64,), lr=1e-3,
+                    rollout_steps=32, seed=7)
+        hist = agent.train(env, total_steps=15000)
+        assert len(hist) >= 10
+        early = np.mean(hist[:10])
+        late = np.mean(hist[-10:])
+        assert late > early * 2, (early, late)
+        assert late > 45, (early, late)
