@@ -84,9 +84,14 @@ class MergeVertex(VertexConfig):
 
     def output_type(self, itypes):
         first = itypes[0]
-        if self.declared_axis >= 0:
+        if self.declared_axis != -1:
             rank = self._RANK.get(first.kind, 2)
-            if self.declared_axis != rank - 1:
+            norm = (
+                self.declared_axis
+                if self.declared_axis >= 0
+                else rank + self.declared_axis
+            )
+            if norm != rank - 1:
                 raise ValueError(
                     f"MergeVertex concatenates the trailing axis only; "
                     f"declared axis {self.declared_axis} on rank-{rank} "

@@ -136,20 +136,32 @@ class Conv3D(LayerConfig):
         return self._act()(y), state
 
 
-def _pool_nd(x, kind: PoolingType, window, strides, padding: str):
+def _pool_nd(x, kind: PoolingType, window, strides, padding: str,
+             pnorm: float = 2.0):
+    """All four reference pooling kinds (mirrors the 2D Subsampling)."""
     dims = (1, *window, 1)
     strd = (1, *strides, 1)
     pad = padding.upper()
     if kind == PoolingType.MAX:
         return lax.reduce_window(x, -jnp.inf, lax.max, dims, strd, pad)
-    s = lax.reduce_window(x, 0.0, lax.add, dims, strd, pad)
-    if pad == "SAME":
-        cnt = lax.reduce_window(jnp.ones_like(x), 0.0, lax.add, dims, strd, pad)
-        return s / cnt
-    denom = 1
-    for w in window:
-        denom *= w
-    return s / denom
+    if kind == PoolingType.SUM:
+        return lax.reduce_window(x, 0.0, lax.add, dims, strd, pad)
+    if kind == PoolingType.AVG:
+        s = lax.reduce_window(x, 0.0, lax.add, dims, strd, pad)
+        if pad == "SAME":
+            cnt = lax.reduce_window(
+                jnp.ones_like(x), 0.0, lax.add, dims, strd, pad
+            )
+            return s / cnt
+        denom = 1
+        for w in window:
+            denom *= w
+        return s / denom
+    if kind == PoolingType.PNORM:
+        p = float(pnorm)
+        s = lax.reduce_window(jnp.abs(x) ** p, 0.0, lax.add, dims, strd, pad)
+        return s ** (1.0 / p)
+    raise ValueError(f"unhandled pooling {kind}")
 
 
 @serde.register
@@ -161,6 +173,7 @@ class Subsampling1D(LayerConfig):
     stride: int = 2
     padding: str = "valid"
     pooling: PoolingType = PoolingType.MAX
+    pnorm: float = 2.0
 
     EXPECTS = "rnn"
     HAS_PARAMS = False
@@ -172,7 +185,7 @@ class Subsampling1D(LayerConfig):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         return _pool_nd(x, self.pooling, (self.kernel,), (self.stride,),
-                        self.padding), state
+                        self.padding, self.pnorm), state
 
 
 @serde.register
@@ -184,6 +197,7 @@ class Subsampling3D(LayerConfig):
     stride: tuple[int, int, int] = (2, 2, 2)
     padding: str = "valid"
     pooling: PoolingType = PoolingType.MAX
+    pnorm: float = 2.0
 
     EXPECTS = "cnn3d"
     HAS_PARAMS = False
@@ -201,7 +215,7 @@ class Subsampling3D(LayerConfig):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         return _pool_nd(x, self.pooling, _triple(self.kernel),
-                        _triple(self.stride), self.padding), state
+                        _triple(self.stride), self.padding, self.pnorm), state
 
 
 def _crop2(v) -> tuple[int, int]:

@@ -45,7 +45,28 @@ def mha(
 
     q_offset/kv_offset: global position offsets (used by ring attention for
     cross-shard causal masking); scalars or traced ints.
+
+    On TPU, unmasked offset-free calls with tileable sequence lengths
+    dispatch to the Pallas flash kernel (ops/flash_attention.py) — O(block)
+    memory instead of the O(Tq*Tk) logits tensor.
     """
+    if (
+        isinstance(q_offset, int)
+        and q_offset == 0
+        and isinstance(kv_offset, int)
+        and kv_offset == 0
+    ):
+        from deeplearning4j_tpu.ops.flash_attention import (
+            flash_attention,
+            flash_eligible,
+        )
+
+        if flash_eligible(q, k, mask):
+            from deeplearning4j_tpu.runtime.backend import backend
+
+            return flash_attention(
+                q, k, v, causal=causal, interpret=not backend().is_tpu
+            )
     d = q.shape[-1]
     logits = jnp.einsum("bqhd,bkhd->bhqk", q, k) * _scale(d)
     logits = logits.astype(jnp.float32)

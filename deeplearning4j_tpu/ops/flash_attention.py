@@ -1,0 +1,254 @@
+"""Flash attention — a Pallas TPU kernel for the dense attention core.
+
+Role: the cuDNN-fused-attention tier the reference reaches through
+`platform/cudnn` helpers (SURVEY.md §2.1 "Platform-accelerated impls"),
+built TPU-native instead: a FlashAttention-2-style forward kernel
+(`pl.pallas_call`) that streams KV blocks through VMEM with online-softmax
+accumulation — O(block) memory instead of the O(T^2) logits tensor — plus
+a blockwise `lax.scan` backward (recompute-from-logsumexp, the standard
+flash backward math) wired up with `jax.custom_vjp`.
+
+`mha()` in ops/attention.py dispatches here automatically on TPU for
+unmasked shapes that tile cleanly (sequence divisible by the block size);
+everything else keeps the fused-XLA dense path.  Force the choice with
+DL4JTPU_FLASH=1/0.  CPU tests run the same kernel with interpret=True.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+ENV_FLASH = "DL4JTPU_FLASH"
+
+_NEG_INF = -1e30        # large-negative instead of -inf: keeps exp() exact
+                        # zero without generating nan via inf-inf
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref,
+                acc_ref, m_ref, l_ref, *, n_k: int, block_k: int,
+                causal: bool, sm_scale: float, mxu_dtype):
+    """Grid (BH, n_q, n_k): one KV block per program; the online-softmax
+    accumulators live in VMEM scratch, persisting across the (sequential)
+    innermost KV dimension — VMEM stays O(block) at any sequence length."""
+    qi = pl.program_id(1)
+    kj = pl.program_id(2)
+    bq = q_ref.shape[1]
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+
+    # causal: a KV block strictly above the diagonal contributes nothing —
+    # skip its compute entirely (the classic ~2x flash-causal win)
+    needed = (
+        kj * block_k <= qi * bq + (bq - 1) if causal else kj >= 0
+    )
+
+    @pl.when(needed)
+    def _block():
+        # mxu_dtype=bf16 (TPU default): the same matmul precision the
+        # dense XLA path uses, ~4x the f32 MXU throughput; softmax
+        # statistics and accumulation stay f32.  f32 for exact tests.
+        q = (q_ref[0].astype(jnp.float32) * sm_scale).astype(mxu_dtype)
+        k = k_ref[0].astype(mxu_dtype)
+        v = v_ref[0].astype(mxu_dtype)
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        if causal:
+            qpos = qi * bq + lax.broadcasted_iota(jnp.int32, s.shape, 0)
+            kpos = kj * block_k + lax.broadcasted_iota(jnp.int32, s.shape, 1)
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        m_prev, l_prev = m_ref[...], l_ref[...]
+        m_new = jnp.maximum(m_prev, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = alpha * l_prev + jnp.sum(p, axis=-1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p.astype(mxu_dtype), v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        acc_ref[...] = acc_ref[...] * alpha + pv
+        m_ref[...] = m_new
+        l_ref[...] = l_new
+
+    @pl.when(kj == n_k - 1)
+    def _finish():
+        o_ref[0] = (acc_ref[...] / l_ref[...]).astype(o_ref.dtype)
+        # logsumexp residual for the backward recompute, broadcast over 8
+        # sublanes — Mosaic requires trailing block dims of (8k, 128k)
+        lse = (m_ref[...] + jnp.log(l_ref[...]))[:, 0]
+        lse_ref[0, 0] = jnp.broadcast_to(lse[None, :], (8, bq))
+
+
+def _flash_fwd_bhtd(q, k, v, *, causal: bool, block_q: int, block_k: int,
+                    interpret: bool, mxu_f32: bool):
+    """(BH, T, D) inputs -> (out, lse)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t_q, d = q.shape
+    t_k = k.shape[1]
+    sm_scale = 1.0 / (d**0.5)
+    n_q, n_k = t_q // block_q, t_k // block_k
+    kernel = functools.partial(
+        _fwd_kernel, n_k=n_k, block_k=block_k, causal=causal,
+        sm_scale=sm_scale,
+        mxu_dtype=jnp.float32 if mxu_f32 else jnp.bfloat16,
+    )
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        )
+    out, lse8 = pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, j, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
+            pl.BlockSpec((1, 1, 8, block_q), lambda b, i, j: (b, i, 0, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t_q, d), q.dtype),
+            jax.ShapeDtypeStruct((bh, n_q, 8, block_q), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),      # acc
+            pltpu.VMEM((block_q, 1), jnp.float32),      # running max
+            pltpu.VMEM((block_q, 1), jnp.float32),      # running denom
+        ],
+        interpret=interpret,
+        **kwargs,
+    )(q, k, v)
+    return out, lse8[:, :, 0, :].reshape(bh, t_q)
+
+
+def _flash_bwd_bhtd(q, k, v, o, lse, g, *, causal: bool, block_k: int):
+    """Blockwise flash backward (recompute from lse), O(block) memory.
+
+    Standard FlashAttention backward math:
+        P_ij = exp(q_i k_j^T * scale - lse_i)
+        dV  += P^T g ;  dP = g V^T ;  dS = P * (dP - rowsum(g*o))
+        dQ  += dS K * scale ;  dK += dS^T Q * scale
+    Implemented as a lax.scan over KV blocks in plain jnp — every term is
+    an MXU matmul, XLA schedules it well, and nothing O(T^2) is ever
+    materialized.
+    """
+    d = q.shape[-1]
+    sm_scale = 1.0 / (d**0.5)
+    qf = q.astype(jnp.float32) * sm_scale
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    gf = g.astype(jnp.float32)
+    delta = jnp.sum(gf * o.astype(jnp.float32), axis=-1)       # (BH, Tq)
+    t_k = k.shape[1]
+    n_k = t_k // block_k
+    t_q = q.shape[1]
+
+    def body(carry, j):
+        dq = carry
+        ks = lax.dynamic_slice_in_dim(kf, j * block_k, block_k, axis=1)
+        vs = lax.dynamic_slice_in_dim(vf, j * block_k, block_k, axis=1)
+        s = jnp.einsum("bqd,bkd->bqk", qf, ks)
+        if causal:
+            qpos = jnp.arange(t_q)[:, None]
+            kpos = j * block_k + jnp.arange(block_k)[None, :]
+            s = jnp.where(qpos >= kpos, s, _NEG_INF)
+        p = jnp.exp(s - lse[:, :, None])                       # (BH, Tq, bk)
+        dv_j = jnp.einsum("bqk,bqd->bkd", p, gf)
+        dp = jnp.einsum("bqd,bkd->bqk", gf, vs)
+        ds = p * (dp - delta[:, :, None])
+        dq = dq + jnp.einsum("bqk,bkd->bqd", ds, ks) * sm_scale
+        dk_j = jnp.einsum("bqk,bqd->bkd", ds, qf)   # qf already carries scale
+        return dq, (dk_j, dv_j)
+
+    dq0 = jnp.zeros_like(qf)
+    dq, (dk_blocks, dv_blocks) = lax.scan(body, dq0, jnp.arange(n_k))
+    dk = jnp.moveaxis(dk_blocks, 0, 1).reshape(k.shape)
+    dv = jnp.moveaxis(dv_blocks, 0, 1).reshape(v.shape)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def _flash_core(q, k, v, causal, block_q, block_k, interpret, mxu_f32):
+    out, _ = _flash_fwd_bhtd(q, k, v, causal=causal, block_q=block_q,
+                             block_k=block_k, interpret=interpret,
+                             mxu_f32=mxu_f32)
+    return out
+
+
+def _flash_core_fwd(q, k, v, causal, block_q, block_k, interpret, mxu_f32):
+    out, lse = _flash_fwd_bhtd(q, k, v, causal=causal, block_q=block_q,
+                               block_k=block_k, interpret=interpret,
+                               mxu_f32=mxu_f32)
+    return out, (q, k, v, out, lse)
+
+
+def _flash_core_bwd(causal, block_q, block_k, interpret, mxu_f32, res, g):
+    q, k, v, out, lse = res
+    return _flash_bwd_bhtd(q, k, v, out, lse, g, causal=causal,
+                           block_k=block_k)
+
+
+_flash_core.defvjp(_flash_core_fwd, _flash_core_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = False,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K,
+                    interpret: bool = False,
+                    mxu_f32: bool = False) -> jax.Array:
+    """FlashAttention over (B, T, H, D) tensors (same contract as mha()
+    minus masks).  Sequence lengths must divide the block sizes.
+    mxu_f32=True runs the in-kernel matmuls in full f32 (exactness tests);
+    the default bf16-input/f32-accumulate matches the dense TPU path."""
+    b, t_q, h, d = q.shape
+    t_k = k.shape[1]
+    qr = q.transpose(0, 2, 1, 3).reshape(b * h, t_q, d)
+    kr = k.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    vr = v.transpose(0, 2, 1, 3).reshape(b * h, t_k, d)
+    out = _flash_core(qr, kr, vr, causal, min(block_q, t_q),
+                      min(block_k, t_k), interpret, mxu_f32)
+    return out.reshape(b, h, t_q, d).transpose(0, 2, 1, 3)
+
+
+def flash_eligible(q, k, mask, *, block_q: int = DEFAULT_BLOCK_Q,
+                   block_k: int = DEFAULT_BLOCK_K) -> bool:
+    """Can the flash kernel serve this mha() call?
+
+    DL4JTPU_FLASH=1 forces it (CPU runs interpret mode — tests), =0
+    disables; default: TPU only, no key mask, block-tileable sequence
+    lengths, and sequences long enough that the O(T^2) materialization
+    actually hurts.
+    """
+    env = os.environ.get(ENV_FLASH, "").strip()
+    if env == "0":
+        return False
+    if mask is not None:
+        return False
+    t_q, t_k = q.shape[1], k.shape[1]
+    bq, bk = min(block_q, t_q), min(block_k, t_k)
+    tileable = t_q % bq == 0 and t_k % bk == 0
+    if env == "1":
+        return tileable
+    from deeplearning4j_tpu.runtime.backend import backend
+
+    # default threshold: flash's win is the MEMORY ceiling (no O(Tq*Tk)
+    # logits tensor), and that starts to matter around 4k tokens; below
+    # that XLA's fused dense attention is at least as fast on one chip
+    return tileable and backend().is_tpu and t_q >= 4096 and t_k >= 4096

@@ -49,10 +49,17 @@ class ZooModel:
             try:
                 path = registry.resolve(self.NAME, pretrained_type)
             except FileNotFoundError:
-                # pre-registry layout: a bare {NAME}.zip in the pretrained
-                # dir (no checksum index) — keep those setups working
+                # pre-registry layout: a bare {NAME}.zip (no checksum index).
+                # Only for the default type, and only when this model has NO
+                # registry entries — a typed request or a corrupted-registry
+                # miss must surface, not silently serve whatever zip is lying
+                # around
                 legacy = registry.root / f"{self.NAME}.zip"
-                if not legacy.exists():
+                if (
+                    pretrained_type != "default"
+                    or registry.available(self.NAME)
+                    or not legacy.exists()
+                ):
                     raise
                 path = str(legacy)
         return ModelSerializer.restore(str(path))

@@ -137,8 +137,11 @@ class TestRunner:
         assert len(runner.results) == 6
         best = runner.best()
         # maximizing: best really is the max over finite candidate scores
+        # (score is None for errored candidates)
         assert best.score >= max(
-            r.score for r in runner.results if np.isfinite(r.score)
+            r.score
+            for r in runner.results
+            if r.score is not None and np.isfinite(r.score)
         )
         assert best.score > 1.0 / 3.0           # beats chance on 3 classes
 

@@ -1,0 +1,135 @@
+"""Pallas flash-attention tests (interpret mode on the CPU platform):
+forward/gradient parity vs the dense reference, dispatch gating, and the
+DSL attention layer riding the kernel."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.attention import mha
+from deeplearning4j_tpu.ops import flash_attention as fa
+
+RNG = np.random.default_rng(3)
+
+
+def qkv(b=2, t=256, h=2, d=64, dtype=np.float32):
+    def one():
+        return jnp.asarray(RNG.normal(0, 1, (b, t, h, d)).astype(dtype))
+
+    return one(), one(), one()
+
+
+class TestForwardParity:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_dense(self, causal):
+        q, k, v = qkv()
+        dense = mha(q, k, v, causal=causal)
+        flash = fa.flash_attention(q, k, v, causal=causal, interpret=True, mxu_f32=True)
+        np.testing.assert_allclose(
+            np.asarray(flash), np.asarray(dense), rtol=2e-4, atol=2e-5
+        )
+
+    def test_cross_attention_lengths(self):
+        q, _, _ = qkv(t=128)
+        _, k, v = qkv(t=384)
+        dense = mha(q, k, v)
+        flash = fa.flash_attention(q, k, v, interpret=True, mxu_f32=True)
+        np.testing.assert_allclose(
+            np.asarray(flash), np.asarray(dense), rtol=2e-4, atol=2e-5
+        )
+
+    def test_small_sequence_uses_whole_block(self):
+        q, k, v = qkv(t=64)
+        dense = mha(q, k, v, causal=True)
+        flash = fa.flash_attention(q, k, v, causal=True, interpret=True, mxu_f32=True)
+        np.testing.assert_allclose(
+            np.asarray(flash), np.asarray(dense), rtol=2e-4, atol=2e-5
+        )
+
+
+class TestBf16Default:
+    def test_bf16_kernel_within_bf16_tolerance(self):
+        q, k, v = qkv(t=256)
+        dense = mha(q, k, v, causal=True)
+        flash = fa.flash_attention(q, k, v, causal=True, interpret=True)
+        np.testing.assert_allclose(
+            np.asarray(flash), np.asarray(dense), rtol=3e-2, atol=3e-2
+        )
+
+
+class TestGradientParity:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_grads_match_dense(self, causal):
+        q, k, v = qkv(b=1, t=128, h=2, d=32)
+
+        def loss_flash(q, k, v):
+            return jnp.sum(
+                fa.flash_attention(q, k, v, causal=causal, interpret=True,
+                                   mxu_f32=True) ** 2
+            )
+
+        def loss_dense(q, k, v):
+            return jnp.sum(mha(q, k, v, causal=causal) ** 2)
+
+        gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+        gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gf, gd):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=3e-3, atol=3e-4
+            )
+
+
+class TestDispatch:
+    def test_eligibility_rules(self, monkeypatch):
+        q, k, v = qkv(t=256)
+        monkeypatch.delenv(fa.ENV_FLASH, raising=False)
+        # CPU default: not eligible (TPU-only heuristic)
+        assert not fa.flash_eligible(q, k, None)
+        monkeypatch.setenv(fa.ENV_FLASH, "1")
+        assert fa.flash_eligible(q, k, None)
+        assert not fa.flash_eligible(q, k, jnp.ones((2, 256)))   # masked
+        monkeypatch.setenv(fa.ENV_FLASH, "0")
+        assert not fa.flash_eligible(q, k, None)
+
+    def test_mha_routes_to_flash_when_forced(self, monkeypatch):
+        calls = {}
+        orig = fa.flash_attention
+
+        def spy(*args, **kw):
+            calls["hit"] = True
+            return orig(*args, **kw)
+
+        monkeypatch.setattr(fa, "flash_attention", spy)
+        monkeypatch.setenv(fa.ENV_FLASH, "1")
+        q, k, v = qkv(t=256)
+        out = mha(q, k, v, causal=True)
+        assert calls.get("hit")
+        monkeypatch.setenv(fa.ENV_FLASH, "0")
+        dense = mha(q, k, v, causal=True)
+        # forced path runs the bf16-MXU default kernel
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(dense), rtol=3e-2, atol=3e-2
+        )
+
+    def test_attention_layer_rides_flash(self, monkeypatch):
+        from deeplearning4j_tpu.nn.conf.attention import SelfAttentionLayer
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+
+        calls = {}
+        orig = fa.flash_attention
+
+        def spy(*args, **kw):
+            calls["hit"] = True
+            return orig(*args, **kw)
+
+        monkeypatch.setattr(fa, "flash_attention", spy)
+        monkeypatch.setenv(fa.ENV_FLASH, "1")
+        layer = SelfAttentionLayer(n_out=32, n_heads=2, causal=True)
+        itype = InputType.recurrent(32, 256)
+        params, _ = layer.init(jax.random.key(0), itype)
+        x = jnp.asarray(RNG.normal(0, 1, (2, 256, 32)).astype(np.float32))
+        y, _ = layer.apply(params, {}, x)
+        assert calls.get("hit")
+        assert np.all(np.isfinite(np.asarray(y)))

@@ -228,3 +228,30 @@ class TestEndToEnd:
         assert back.layers[0] == conf.layers[0]
         assert back.layers[1].cropping == (2, 1)
         assert back.layers[2].alpha_init == 0.3
+
+class TestReviewRegressions:
+    def test_sum_and_pnorm_pooling_1d(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 4, 2)
+        it = InputType.recurrent(2, 4)
+        y, _ = run_layer(
+            Subsampling1D(kernel=2, stride=2, pooling=PoolingType.SUM), it, x
+        )
+        np.testing.assert_allclose(np.asarray(y)[0, :, 0], [2.0, 10.0])
+        y, _ = run_layer(
+            Subsampling1D(kernel=2, stride=2, pooling=PoolingType.PNORM,
+                          pnorm=2.0), it, x
+        )
+        np.testing.assert_allclose(
+            np.asarray(y)[0, :, 0],
+            [np.sqrt(0 + 4), np.sqrt(16 + 36)], rtol=1e-6,
+        )
+
+    def test_merge_vertex_negative_non_trailing_axis_rejected(self):
+        from deeplearning4j_tpu.nn.conf.graph_conf import MergeVertex
+
+        cnn = InputType.convolutional(4, 4, 2)
+        with pytest.raises(ValueError, match="trailing axis"):
+            MergeVertex(declared_axis=-2).output_type([cnn, cnn])
+        # -1 and rank-1 both fine
+        MergeVertex(declared_axis=-1).output_type([cnn, cnn])
+        MergeVertex(declared_axis=3).output_type([cnn, cnn])
