@@ -46,12 +46,35 @@ def _data_dirs() -> list[Path]:
 
 
 def _read_idx(path: Path) -> np.ndarray:
+    if path.suffix != ".gz":
+        # native decoder (runtime/native.py) when built — the DataVec-role
+        # native hot path; ungzipped files only
+        from deeplearning4j_tpu.runtime import native
+
+        if native.available():
+            try:
+                return native.idx_read_u8(str(path))
+            except (IOError, RuntimeError):
+                pass                      # fall back to the numpy path
     opener = gzip.open if path.suffix == ".gz" else open
     with opener(path, "rb") as f:
         magic = struct.unpack(">I", f.read(4))[0]
         ndim = magic & 0xFF
         shape = struct.unpack(f">{ndim}I", f.read(4 * ndim))
         return np.frombuffer(f.read(), dtype=np.uint8).reshape(shape)
+
+
+def _u8_scale(x: np.ndarray, scale: float = 1.0 / 255.0,
+              shift: float = 0.0) -> np.ndarray:
+    """uint8 -> float32 * scale + shift, natively when built."""
+    from deeplearning4j_tpu.runtime import native
+
+    if x.dtype == np.uint8 and native.available():
+        try:
+            return native.u8_to_f32_scaled(x, scale, shift)
+        except (IOError, RuntimeError):
+            pass
+    return x.astype(np.float32) * scale + shift
 
 
 def _find_mnist() -> tuple[np.ndarray, np.ndarray, np.ndarray, np.ndarray] | None:
@@ -138,7 +161,7 @@ class MnistDataSetIterator(DataSetIterator):
             xi, yi, xt, yt = found
             x, y = (xi, yi) if train else (xt, yt)
             self.is_synthetic = False
-            x = (x.astype(np.float32) / 255.0)[..., None]
+            x = _u8_scale(x)[..., None]
             y = y.astype(np.int64)
         else:
             default_n = 60000 if train else 10000

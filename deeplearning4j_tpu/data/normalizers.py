@@ -136,7 +136,20 @@ class ImagePreProcessingScaler(Normalizer):
         return self
 
     def transform(self, ds: DataSet) -> DataSet:
-        f = ds.features.astype(np.float32) / 255.0 * (self.hi - self.lo) + self.lo
+        scale = (self.hi - self.lo) / 255.0
+        x = np.asarray(ds.features)
+        if x.dtype == np.uint8:
+            # native hot path (runtime/native.py) when built
+            from deeplearning4j_tpu.runtime import native
+
+            if native.available():
+                try:
+                    f = native.u8_to_f32_scaled(x, scale, self.lo)
+                    return DataSet(f, ds.labels, ds.features_mask,
+                                   ds.labels_mask)
+                except (IOError, RuntimeError):
+                    pass
+        f = x.astype(np.float32) * scale + self.lo
         return DataSet(f, ds.labels, ds.features_mask, ds.labels_mask)
 
     def revert_features(self, features):

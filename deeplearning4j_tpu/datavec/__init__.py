@@ -13,6 +13,7 @@ host ETL with device steps.
 """
 
 from deeplearning4j_tpu.datavec.records import (
+    load_numeric_csv,
     RecordReader,
     CollectionRecordReader,
     CSVRecordReader,
@@ -24,6 +25,7 @@ from deeplearning4j_tpu.datavec.transform import TransformProcess
 from deeplearning4j_tpu.datavec.bridge import RecordReaderDataSetIterator
 
 __all__ = [
+    "load_numeric_csv",
     "RecordReader",
     "CollectionRecordReader",
     "CSVRecordReader",

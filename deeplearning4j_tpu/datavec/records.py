@@ -202,3 +202,24 @@ class ImageRecordReader(RecordReader):
         label_idx = {name: i for i, name in enumerate(self.labels)}
         for p in self._files:
             yield [self._decode(p), label_idx[p.parent.name]]
+
+
+def load_numeric_csv(path, delimiter: str = ",", skip_lines: int = 0) -> "np.ndarray":
+    """Bulk-load an all-numeric CSV as a float32 matrix.
+
+    The DataVec-role native fast path: parses in C++
+    (native/dl4jtpu_io.cpp, multithreaded) when the library is built,
+    otherwise numpy.  Use this instead of iterating CSVRecordReader when
+    the file is purely numeric and large.
+    """
+    import numpy as np
+
+    from deeplearning4j_tpu.runtime import native
+
+    if native.available():
+        try:
+            return native.csv_read_f32(str(path), delimiter, skip_lines)
+        except (IOError, RuntimeError):
+            pass
+    return np.loadtxt(path, delimiter=delimiter, skiprows=skip_lines,
+                      dtype=np.float32, ndmin=2)

@@ -1,0 +1,308 @@
+// dl4jtpu_io — native data-loading runtime for the host side of the TPU
+// framework.
+//
+// Role: the reference keeps its ETL hot paths native (libnd4j buffer
+// routines + JavaCV/OpenCV decoders behind DataVec — SURVEY.md §2.2
+// "DataVec"); the TPU build's equivalent is this small C++ library behind
+// ctypes (runtime/native.py): multithreaded CSV -> float32 matrices, IDX
+// (MNIST-family) decoding, and uint8 -> float32 scale/shift batch
+// conversion.  The device math all lives in XLA; this tier exists so the
+// input pipeline can feed it at memory bandwidth instead of Python-object
+// speed.
+//
+// Build: `make` in this directory (g++ -O3 -shared -fPIC -pthread).
+// Pure C ABI — no Python.h, no external deps.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <cstring>
+#include <cmath>
+#include <string>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// ---------------------------------------------------------------------------
+// memory
+// ---------------------------------------------------------------------------
+
+void dl4jtpu_free(void* p) { std::free(p); }
+
+// ---------------------------------------------------------------------------
+// CSV -> float32 row-major matrix
+// ---------------------------------------------------------------------------
+
+namespace {
+
+static const double kPow10[] = {
+    1e0,  1e1,  1e2,  1e3,  1e4,  1e5,  1e6,  1e7,  1e8,  1e9,
+    1e10, 1e11, 1e12, 1e13, 1e14, 1e15, 1e16, 1e17, 1e18};
+
+// hand-rolled float parser ([-]ddd[.ddd][e[+-]dd]): integer-accumulation
+// based, ~5-10x strtof (no locale machinery).  Falls back to strtof for
+// pathological exponents/overlong mantissas.
+static inline float parse_f32(const char* p, const char* end,
+                              const char** out_next) {
+  const char* start = p;
+  bool neg = false;
+  if (p < end && (*p == '-' || *p == '+')) {
+    neg = (*p == '-');
+    p++;
+  }
+  uint64_t mant = 0;
+  int digits = 0, frac = 0;
+  while (p < end && *p >= '0' && *p <= '9') {
+    mant = mant * 10 + (*p - '0');
+    digits++;
+    p++;
+  }
+  if (p < end && *p == '.') {
+    p++;
+    while (p < end && *p >= '0' && *p <= '9') {
+      mant = mant * 10 + (*p - '0');
+      digits++;
+      frac++;
+      p++;
+    }
+  }
+  int exp10 = 0;
+  if (p < end && (*p == 'e' || *p == 'E')) {
+    p++;
+    bool eneg = false;
+    if (p < end && (*p == '-' || *p == '+')) {
+      eneg = (*p == '-');
+      p++;
+    }
+    while (p < end && *p >= '0' && *p <= '9') {
+      exp10 = exp10 * 10 + (*p - '0');
+      p++;
+    }
+    if (eneg) exp10 = -exp10;
+  }
+  if (digits == 0 || digits > 18) {      // nan/inf/overlong: defer to libc
+    char* next = nullptr;
+    float v = std::strtof(start, &next);
+    *out_next = (next == start) ? start : next;
+    if (next == start) v = 0.0f;
+    return v;
+  }
+  int e = exp10 - frac;
+  double v = static_cast<double>(mant);
+  if (e > 0) {
+    v = (e <= 18) ? v * kPow10[e] : v * std::pow(10.0, e);
+  } else if (e < 0) {
+    v = (-e <= 18) ? v / kPow10[-e] : v / std::pow(10.0, -e);
+  }
+  *out_next = p;
+  return static_cast<float>(neg ? -v : v);
+}
+
+// parse one line of exactly `cols` floats; returns cols on success,
+// -1 on a malformed field (empty/non-numeric — numpy raises there too),
+// cols+1 when the row has extra fields (ragged), or the short count.
+static long parse_line(const char* p, const char* end, char delim,
+                       float* out, long cols) {
+  long c = 0;
+  while (p < end && c < cols) {
+    while (p < end && (*p == ' ' || *p == '\t') && *p != delim) p++;
+    const char* next = p;
+    out[c++] = parse_f32(p, end, &next);
+    if (next == p) return -1;          // field did not parse as a number
+    p = next;
+    while (p < end && (*p == ' ' || *p == '\t') && *p != delim) p++;
+    if (p < end && *p != delim && *p != '\n' && *p != '\r') {
+      return -1;                       // trailing junk inside the field
+    }
+    while (p < end && *p != delim && *p != '\n') p++;
+    if (p < end && *p == delim) p++;
+    else break;                        // end of line
+  }
+  if (c == cols && p < end && *p != '\n') {
+    // more data after the last expected field -> ragged (extra columns)
+    return cols + 1;
+  }
+  return c;
+}
+
+struct Slice {
+  const char* begin;
+  const char* end;
+  long row0;
+};
+
+}  // namespace
+
+// Parse a CSV file of numbers into a newly-malloc'd float32 row-major
+// matrix.  Lines are split across n_threads workers.  Returns 0 on
+// success; negative error codes otherwise.
+int dl4jtpu_csv_read_f32(const char* path, char delim, long skip_rows,
+                         float** out_data, long* out_rows, long* out_cols,
+                         int n_threads) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  std::fseek(f, 0, SEEK_END);
+  long size = std::ftell(f);
+  std::fseek(f, 0, SEEK_SET);
+  char* buf = static_cast<char*>(std::malloc(size + 1));
+  if (!buf) {
+    std::fclose(f);
+    return -2;
+  }
+  if (std::fread(buf, 1, size, f) != static_cast<size_t>(size)) {
+    std::free(buf);
+    std::fclose(f);
+    return -3;
+  }
+  std::fclose(f);
+  buf[size] = '\n';
+
+  // index line starts
+  std::vector<const char*> lines;
+  lines.reserve(size / 16);
+  const char* end = buf + size;
+  const char* p = buf;
+  while (p < end) {
+    const char* nl = static_cast<const char*>(std::memchr(p, '\n', end - p));
+    if (!nl) nl = end;
+    if (nl > p) lines.push_back(p);          // skip empty lines
+    p = nl + 1;
+  }
+  if (static_cast<long>(lines.size()) <= skip_rows) {
+    std::free(buf);
+    return -4;
+  }
+  lines.erase(lines.begin(), lines.begin() + skip_rows);
+  long rows = static_cast<long>(lines.size());
+
+  // column count from the first data line
+  long cols = 1;
+  {
+    const char* q = lines[0];
+    while (q < end && *q != '\n') {
+      if (*q == delim) cols++;
+      q++;
+    }
+  }
+
+  float* data = static_cast<float*>(std::malloc(sizeof(float) * rows * cols));
+  if (!data) {
+    std::free(buf);
+    return -2;
+  }
+
+  int nt = n_threads > 0 ? n_threads : 1;
+  if (nt > rows) nt = static_cast<int>(rows);
+  std::vector<std::thread> workers;
+  std::vector<long> bad(nt, -1);
+  long chunk = (rows + nt - 1) / nt;
+  for (int t = 0; t < nt; t++) {
+    long r0 = t * chunk;
+    long r1 = std::min(rows, r0 + chunk);
+    if (r0 >= r1) break;
+    workers.emplace_back([&, r0, r1, t]() {
+      for (long r = r0; r < r1; r++) {
+        const char* lp = lines[r];
+        const char* le = static_cast<const char*>(
+            std::memchr(lp, '\n', end - lp));
+        if (!le) le = end;
+        long got = parse_line(lp, le, delim, data + r * cols, cols);
+        if (got != cols && bad[t] < 0) bad[t] = r;
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  std::free(buf);
+  for (int t = 0; t < nt; t++) {
+    if (bad[t] >= 0) {
+      std::free(data);
+      return -5;                           // ragged row
+    }
+  }
+  *out_data = data;
+  *out_rows = rows;
+  *out_cols = cols;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// IDX (MNIST-family) decoding
+// ---------------------------------------------------------------------------
+
+// Decode an IDX file of unsigned bytes (magic 0x0000 08 <ndim>).
+// dims_out receives up to 4 dims; returns 0 on success.
+int dl4jtpu_idx_read_u8(const char* path, uint8_t** out_data, int* out_ndim,
+                        long dims_out[4]) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return -1;
+  uint8_t hdr[4];
+  if (std::fread(hdr, 1, 4, f) != 4 || hdr[0] != 0 || hdr[1] != 0 ||
+      hdr[2] != 0x08) {
+    std::fclose(f);
+    return -6;                             // not a u8 IDX file
+  }
+  int ndim = hdr[3];
+  if (ndim < 1 || ndim > 4) {
+    std::fclose(f);
+    return -6;
+  }
+  long total = 1;
+  const long kMaxTotal = 1L << 38;       // 256 GB sanity cap
+  for (int i = 0; i < ndim; i++) {
+    uint8_t d[4];
+    if (std::fread(d, 1, 4, f) != 4) {
+      std::fclose(f);
+      return -3;
+    }
+    dims_out[i] = (static_cast<long>(d[0]) << 24) | (d[1] << 16) |
+                  (d[2] << 8) | d[3];
+    // overflow/corruption guard: file-supplied dims must stay sane
+    if (dims_out[i] <= 0 || dims_out[i] > kMaxTotal / total) {
+      std::fclose(f);
+      return -6;
+    }
+    total *= dims_out[i];
+  }
+  uint8_t* data = static_cast<uint8_t*>(std::malloc(total));
+  if (!data) {
+    std::fclose(f);
+    return -2;
+  }
+  if (std::fread(data, 1, total, f) != static_cast<size_t>(total)) {
+    std::free(data);
+    std::fclose(f);
+    return -3;
+  }
+  std::fclose(f);
+  *out_data = data;
+  *out_ndim = ndim;
+  return 0;
+}
+
+// ---------------------------------------------------------------------------
+// uint8 -> float32 scale/shift (image normalization hot path)
+// ---------------------------------------------------------------------------
+
+void dl4jtpu_u8_to_f32_scaled(const uint8_t* src, float* dst, long n,
+                              float scale, float shift, int n_threads) {
+  int nt = n_threads > 0 ? n_threads : 1;
+  long chunk = (n + nt - 1) / nt;
+  std::vector<std::thread> workers;
+  for (int t = 0; t < nt; t++) {
+    long i0 = t * chunk;
+    long i1 = std::min(n, i0 + chunk);
+    if (i0 >= i1) break;
+    workers.emplace_back([src, dst, i0, i1, scale, shift]() {
+      for (long i = i0; i < i1; i++) {
+        dst[i] = static_cast<float>(src[i]) * scale + shift;
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+}
+
+// library identity / version for the ctypes loader
+const char* dl4jtpu_io_version() { return "dl4jtpu_io 1.0"; }
+
+}  // extern "C"

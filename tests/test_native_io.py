@@ -1,0 +1,146 @@
+"""Native IO runtime tests (native/dl4jtpu_io.cpp via runtime/native.py).
+
+Builds the shared library on first use (g++ is part of the supported
+toolchain); every test asserts parity against the numpy reference path.
+"""
+
+import struct
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.runtime import native
+
+pytestmark = pytest.mark.skipif(
+    not native.available(), reason="native IO library not built (no g++?)"
+)
+
+RNG = np.random.default_rng(9)
+
+
+class TestCsv:
+    def test_parity_with_numpy(self, tmp_path):
+        m = RNG.normal(0, 100, (300, 5)).astype(np.float32)
+        p = tmp_path / "m.csv"
+        np.savetxt(p, m, delimiter=",", fmt="%.6f", header="a,b,c,d,e")
+        ours = native.csv_read_f32(str(p), skip_rows=1)
+        ref = np.loadtxt(p, delimiter=",", skiprows=1, dtype=np.float32)
+        assert ours.shape == (300, 5)
+        np.testing.assert_allclose(ours, ref, atol=1e-3, rtol=1e-5)
+
+    def test_other_delimiter_and_ints(self, tmp_path):
+        p = tmp_path / "m.csv"
+        p.write_text("1;2;3\n4;5;6\n")
+        ours = native.csv_read_f32(str(p), delimiter=";")
+        np.testing.assert_allclose(ours, [[1, 2, 3], [4, 5, 6]])
+
+    def test_ragged_rows_rejected(self, tmp_path):
+        p = tmp_path / "bad.csv"
+        p.write_text("1,2,3\n4,5\n")
+        with pytest.raises(IOError, match="rc="):
+            native.csv_read_f32(str(p))
+
+    def test_missing_file(self):
+        with pytest.raises(IOError):
+            native.csv_read_f32("/nonexistent/x.csv")
+
+    def test_load_numeric_csv_facade(self, tmp_path):
+        from deeplearning4j_tpu.datavec import load_numeric_csv
+
+        m = RNG.normal(0, 1, (50, 3)).astype(np.float32)
+        p = tmp_path / "m.csv"
+        np.savetxt(p, m, delimiter=",", fmt="%.6f")
+        got = load_numeric_csv(p)
+        np.testing.assert_allclose(got, m, atol=1e-5)
+
+
+class TestIdx:
+    def _write_idx(self, path, arr):
+        with open(path, "wb") as f:
+            f.write(struct.pack(">BBBB", 0, 0, 8, arr.ndim))
+            for d in arr.shape:
+                f.write(struct.pack(">i", d))
+            f.write(arr.tobytes())
+
+    def test_roundtrip_3d(self, tmp_path):
+        imgs = RNG.integers(0, 256, (7, 9, 11)).astype(np.uint8)
+        p = tmp_path / "imgs.idx"
+        self._write_idx(p, imgs)
+        got = native.idx_read_u8(str(p))
+        assert got.shape == imgs.shape
+        np.testing.assert_array_equal(got, imgs)
+
+    def test_roundtrip_1d_labels(self, tmp_path):
+        labels = RNG.integers(0, 10, (64,)).astype(np.uint8)
+        p = tmp_path / "labels.idx"
+        self._write_idx(p, labels)
+        np.testing.assert_array_equal(native.idx_read_u8(str(p)), labels)
+
+    def test_builtin_reader_uses_native(self, tmp_path):
+        from deeplearning4j_tpu.data.builtin import _read_idx
+
+        imgs = RNG.integers(0, 256, (3, 4, 4)).astype(np.uint8)
+        p = tmp_path / "x.idx"
+        self._write_idx(p, imgs)
+        np.testing.assert_array_equal(_read_idx(p), imgs)
+
+    def test_bad_magic_rejected(self, tmp_path):
+        p = tmp_path / "bad.idx"
+        p.write_bytes(b"\x00\x00\x0d\x03" + b"\x00" * 16)
+        with pytest.raises(IOError):
+            native.idx_read_u8(str(p))
+
+
+class TestU8ToF32:
+    def test_scale_shift_parity(self):
+        x = RNG.integers(0, 256, (4, 28, 28, 1)).astype(np.uint8)
+        y = native.u8_to_f32_scaled(x, 1.0 / 255.0, -0.5)
+        ref = x.astype(np.float32) / 255.0 - 0.5
+        assert y.shape == x.shape and y.dtype == np.float32
+        np.testing.assert_allclose(y, ref, atol=1e-6)
+
+
+class TestReviewRegressions:
+    def test_extra_columns_rejected(self, tmp_path):
+        p = tmp_path / "wide.csv"
+        p.write_text("1,2\n3,4,5\n")
+        with pytest.raises(IOError, match="rc="):
+            native.csv_read_f32(str(p))
+
+    def test_non_numeric_and_empty_fields_rejected(self, tmp_path):
+        p = tmp_path / "na.csv"
+        p.write_text("1,NA,3\n4,5,6\n")
+        with pytest.raises(IOError, match="rc="):
+            native.csv_read_f32(str(p))
+        p2 = tmp_path / "empty.csv"
+        p2.write_text("1,,3\n")
+        with pytest.raises(IOError, match="rc="):
+            native.csv_read_f32(str(p2))
+
+    def test_nan_inf_accepted_like_numpy(self, tmp_path):
+        p = tmp_path / "naninf.csv"
+        p.write_text("1,nan,inf\n2,-inf,3\n")
+        got = native.csv_read_f32(str(p))
+        assert np.isnan(got[0, 1]) and np.isinf(got[0, 2])
+        assert got[1, 1] == -np.inf
+
+    def test_corrupt_idx_dims_rejected_not_segfault(self, tmp_path):
+        import struct
+
+        p = tmp_path / "huge.idx"
+        with open(p, "wb") as f:
+            f.write(struct.pack(">BBBB", 0, 0, 8, 4))
+            for _ in range(4):
+                f.write(struct.pack(">i", 65536))
+        with pytest.raises(IOError):
+            native.idx_read_u8(str(p))
+
+    def test_u8_scaler_wired_into_normalizer(self):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.normalizers import ImagePreProcessingScaler
+
+        x = RNG.integers(0, 256, (4, 8, 8, 1)).astype(np.uint8)
+        y = np.zeros((4, 2), np.float32)
+        out = ImagePreProcessingScaler(-1.0, 1.0).transform(DataSet(x, y))
+        ref = x.astype(np.float32) / 255.0 * 2.0 - 1.0
+        np.testing.assert_allclose(out.features, ref, atol=1e-5)
