@@ -238,6 +238,63 @@ OPS: dict[str, callable] = {
     "softplus": jax.nn.softplus,
     "sin": jnp.sin,
     "cos": jnp.cos,
+    # trig / hyperbolic family
+    "tan": jnp.tan,
+    "asin": jnp.arcsin,
+    "acos": jnp.arccos,
+    "atan": jnp.arctan,
+    "sinh": jnp.sinh,
+    "cosh": jnp.cosh,
+    "asinh": jnp.arcsinh,
+    "acosh": jnp.arccosh,
+    "atanh": jnp.arctanh,
+    # rounding / checks
+    "round": jnp.round,
+    "trunc": jnp.trunc,
+    "is_nan": lambda x: jnp.isnan(x).astype(jnp.float32),
+    "is_inf": lambda x: jnp.isinf(x).astype(jnp.float32),
+    "is_finite": lambda x: jnp.isfinite(x).astype(jnp.float32),
+    "log1p": jnp.log1p,
+    "expm1": jnp.expm1,
+    "erfc": jax.scipy.special.erfc,
+    "cube": lambda x: x * x * x,
+    "softsign": jax.nn.soft_sign,
+    "hard_sigmoid": jax.nn.hard_sigmoid,
+    "hard_tanh": lambda x: jnp.clip(x, -1.0, 1.0),
+    "rationaltanh": lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0),
+    "logsumexp": lambda x, *, axis=None, keepdims=False: (
+        jax.scipy.special.logsumexp(x, axis=_ax(axis), keepdims=keepdims)
+    ),
+    "cumprod": lambda x, *, axis=0: jnp.cumprod(x, axis=axis),
+    # ordering / selection
+    "sort": lambda x, *, axis=-1, descending=False: (
+        -jnp.sort(-x, axis=axis) if descending else jnp.sort(x, axis=axis)
+    ),
+    "argsort": lambda x, *, axis=-1: jnp.argsort(x, axis=axis),
+    "top_k_values": lambda x, *, k: jax.lax.top_k(x, k)[0],
+    "top_k_indices": lambda x, *, k: jax.lax.top_k(x, k)[1],
+    # segment reductions (static num_segments for XLA shapes)
+    "segment_sum": lambda x, ids, *, num_segments: jax.ops.segment_sum(
+        x, ids.astype(jnp.int32), num_segments=num_segments
+    ),
+    "segment_max": lambda x, ids, *, num_segments: jax.ops.segment_max(
+        x, ids.astype(jnp.int32), num_segments=num_segments
+    ),
+    "segment_min": lambda x, ids, *, num_segments: jax.ops.segment_min(
+        x, ids.astype(jnp.int32), num_segments=num_segments
+    ),
+    "segment_mean": lambda x, ids, *, num_segments: (
+        jax.ops.segment_sum(x, ids.astype(jnp.int32), num_segments=num_segments)
+        / jnp.maximum(
+            jax.ops.segment_sum(
+                jnp.ones_like(x), ids.astype(jnp.int32),
+                num_segments=num_segments,
+            ),
+            1.0,
+        )
+    ),
+    "reverse": lambda x, *, axis: jnp.flip(x, axis=axis),
+    "roll": lambda x, *, shift, axis: jnp.roll(x, shift, axis=axis),
     # TF-import primitives
     "identity": lambda x: x,
     "stop_gradient": jax.lax.stop_gradient,

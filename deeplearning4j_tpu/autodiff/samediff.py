@@ -140,6 +140,11 @@ _MATH_OPS = (
     "tensordot", "reshape", "concat", "stack", "squeeze", "expand_dims",
     "gather", "one_hot", "tile", "pad", "sum", "mean", "max", "min", "prod",
     "var", "std", "argmax", "argmin", "norm2", "cumsum", "sin", "cos",
+    "tan", "asin", "acos", "atan", "sinh", "cosh", "asinh", "acosh",
+    "atanh", "round", "trunc", "is_nan", "is_inf", "is_finite", "log1p",
+    "expm1", "erf", "erfc", "cube", "logsumexp", "cumprod", "sort",
+    "argsort", "top_k_values", "top_k_indices", "segment_sum",
+    "segment_max", "segment_min", "segment_mean", "reverse", "roll",
 )
 _CNN_OPS = (
     "conv1d", "conv2d", "conv3d", "depthwise_conv2d", "deconv2d",

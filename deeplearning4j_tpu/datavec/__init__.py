@@ -23,9 +23,19 @@ from deeplearning4j_tpu.datavec.records import (
 from deeplearning4j_tpu.datavec.schema import Schema, ColumnType
 from deeplearning4j_tpu.datavec.transform import TransformProcess
 from deeplearning4j_tpu.datavec.bridge import RecordReaderDataSetIterator
+from deeplearning4j_tpu.datavec.join_reduce import (
+    Join,
+    JoinType,
+    Reducer,
+    ReduceOp,
+)
 
 __all__ = [
     "load_numeric_csv",
+    "Join",
+    "JoinType",
+    "Reducer",
+    "ReduceOp",
     "RecordReader",
     "CollectionRecordReader",
     "CSVRecordReader",

@@ -30,6 +30,10 @@ class Loss(str, enum.Enum):
     POISSON = "poisson"
     COSINE_PROXIMITY = "cosine_proximity"
     KL_DIVERGENCE = "kld"
+    MAPE = "mape"                        # mean absolute percentage error
+    MSLE = "msle"                        # mean squared logarithmic error
+    WASSERSTEIN = "wasserstein"          # critic loss (labels +-1)
+    RECONSTRUCTION_CROSSENTROPY = "reconstruction_xent"
 
     def __call__(self, preds, labels, mask=None):
         return compute(self, preds, labels, mask)
@@ -48,6 +52,8 @@ Loss._ALIASES_ = {
     "mae": "l1",
     "kl_divergence": "kld",
     "kullback_leibler_divergence": "kld",
+    "mean_absolute_percentage_error": "mape",
+    "mean_squared_logarithmic_error": "msle",
 }
 
 
@@ -137,4 +143,27 @@ def compute(
         p = jnp.maximum(labels, 1e-12)
         q = jnp.maximum(preds, 1e-12)
         return _masked_mean(jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=-1), mask)
+    if loss is Loss.MAPE:
+        per = jnp.mean(
+            100.0 * jnp.abs((labels - preds) /
+                            jnp.maximum(jnp.abs(labels), 1e-7)),
+            axis=-1,
+        )
+        return _masked_mean(per, mask)
+    if loss is Loss.MSLE:
+        per = jnp.mean(
+            (jnp.log1p(jnp.maximum(labels, 0.0))
+             - jnp.log1p(jnp.maximum(preds, 0.0))) ** 2,
+            axis=-1,
+        )
+        return _masked_mean(per, mask)
+    if loss is Loss.WASSERSTEIN:
+        # critic objective: labels are +1 (real) / -1 (generated)
+        return _masked_mean(jnp.mean(-labels * preds, axis=-1), mask)
+    if loss is Loss.RECONSTRUCTION_CROSSENTROPY:
+        p = jnp.clip(preds, 1e-7, 1 - 1e-7)
+        per = -jnp.sum(
+            labels * jnp.log(p) + (1 - labels) * jnp.log1p(-p), axis=-1
+        )
+        return _masked_mean(per, mask)
     raise ValueError(f"unhandled loss {loss}")

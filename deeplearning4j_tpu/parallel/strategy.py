@@ -128,7 +128,59 @@ def param_specs(params, conf, model_axis: str | None = MODEL_AXIS,
             else jax.tree.map(lambda x: P(), leaf)
             for pname, leaf in lp.items()
         }
+
+    if model_axis is not None:
+        _warn_unsharded_params(params, specs, layer_types)
     return specs
+
+
+# layer types whose params are replicated under TP by an explicit policy
+# (norms/heads/small slopes by design; attention and MoE because their
+# sharding rides other mesh axes — seq and expert — not "model")
+_TP_REPLICATE_OK = {
+    "BatchNorm", "LayerNorm", "OutputLayer", "RnnOutputLayer", "Embedding",
+    "PReLU", "MoELayer", "SeparableConv2D",
+    "SelfAttentionLayer", "LearnedSelfAttentionLayer",
+    "TransformerEncoderBlock", "AttentionVertex",
+}
+
+
+def _warn_unsharded_params(params, specs, layer_types) -> None:
+    """The partition rules are name-based; a new layer whose weight isn't
+    named like the known ones would silently lose tensor parallelism.
+    Surface that instead of quietly replicating a large matrix.  Nested
+    param dicts are walked too — they replicate wholesale."""
+    import warnings
+
+    suspicious = []
+    for lname, lp in params.items():
+        if layer_types.get(lname, "") in _TP_REPLICATE_OK:
+            continue
+        for pname, leaf in lp.items():
+            if isinstance(leaf, dict):
+                for sub in jax.tree.leaves(leaf):
+                    if getattr(sub, "ndim", 0) >= 2 and sub.size >= 4096:
+                        suspicious.append(
+                            f"{lname}/{pname}/...{tuple(sub.shape)}"
+                        )
+                        break
+                continue
+            spec = specs[lname][pname]
+            if (
+                spec == P()
+                and getattr(leaf, "ndim", 0) >= 2
+                and leaf.size >= 4096
+            ):
+                suspicious.append(f"{lname}/{pname}{tuple(leaf.shape)}")
+    if suspicious:
+        warnings.warn(
+            "tensor parallelism is active but these sizable parameters "
+            f"matched no partition rule and will be REPLICATED: "
+            f"{suspicious}. If they belong to a custom layer, name the "
+            "weights like the built-ins (W/Wx/Wh/pointW/b) or extend "
+            "parallel/strategy.py's rules.",
+            stacklevel=3,
+        )
 
 
 def shard_params(params, mesh: Mesh, specs) -> object:

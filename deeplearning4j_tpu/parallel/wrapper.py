@@ -43,16 +43,48 @@ class ParallelWrapper:
 
 
 class ParallelInference:
-    """Batched inference facade (the reference's request-coalescing
-    InferenceWorker becomes: pad to a device-divisible batch, run the
-    sharded forward, slice the answer)."""
+    """Multi-device serving with request coalescing — the reference's
+    `ParallelInference` + `BatchedInferenceObservable` roles (SURVEY.md
+    §3.6).
 
-    def __init__(self, model, config: ParallelConfig | None = None, devices=None):
+    mode="batched" (the reference's default): callers block while a worker
+    thread coalesces concurrent requests up to `batch_limit` rows into one
+    sharded forward, then scatters each caller its slice — concurrency
+    turns into batch size, which is exactly what the MXU wants.
+    mode="instant": each call runs its own (padded) forward.
+    """
+
+    INSTANT = "instant"
+    BATCHED = "batched"
+
+    def __init__(self, model, config: ParallelConfig | None = None,
+                 devices=None, mode: str = "batched", batch_limit: int = 32,
+                 coalesce_window_ms: float = 2.0):
+        import queue
+        import threading
+
         self.model = model
         distribute(model, config or ParallelConfig.data_parallel(), devices)
         self._n = int(np.prod(list(model._mesh.shape.values())))
+        if mode not in (self.INSTANT, self.BATCHED):
+            raise ValueError(f"mode must be instant|batched, got {mode!r}")
+        self.mode = mode
+        self.batch_limit = batch_limit
+        self.coalesce_window_ms = coalesce_window_ms
+        self._queue: "queue.Queue" = queue.Queue()
+        self._stop = threading.Event()
+        self._worker = None        # started lazily on the first batched call
+        self._lock = threading.Lock()
 
-    def output(self, features: np.ndarray) -> np.ndarray:
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.shutdown()
+        return False
+
+    # -- direct path -------------------------------------------------------
+    def _forward_padded(self, features: np.ndarray) -> np.ndarray:
         b = features.shape[0]
         pad = (-b) % self._n
         if pad:
@@ -61,3 +93,127 @@ class ParallelInference:
             )
         out = np.asarray(self.model.output(features))
         return out[:b]
+
+    # -- batched path ------------------------------------------------------
+    def _ensure_worker(self):
+        import threading
+        import weakref
+
+        with self._lock:
+            if self._worker is not None and self._worker.is_alive():
+                return
+            if self._stop.is_set():
+                raise RuntimeError("ParallelInference was shut down")
+            # the worker holds only a weakref: dropping the instance without
+            # shutdown() lets the thread exit instead of pinning the model
+            self._worker = threading.Thread(
+                target=_serve_loop, args=(weakref.ref(self),), daemon=True
+            )
+            self._worker.start()
+
+    def _process(self, first) -> None:
+        """Coalesce + run one batch; EVERY pending caller is answered even
+        when assembly itself fails (a malformed request must not wedge the
+        others, or kill the worker silently)."""
+        import queue
+        import time
+
+        pending = [first]
+        try:
+            rows = first[0].shape[0]
+            deadline = time.monotonic() + self.coalesce_window_ms / 1000.0
+            while rows < self.batch_limit:
+                remaining = deadline - time.monotonic()
+                if remaining <= 0:
+                    break
+                try:
+                    req = self._queue.get(timeout=remaining)
+                except queue.Empty:
+                    break
+                pending.append(req)
+                rows += req[0].shape[0]
+            batch = np.concatenate([r[0] for r in pending], axis=0)
+            out = self._forward_padded(batch)
+            i = 0
+            for feats, slot, done in pending:
+                n = feats.shape[0]
+                slot["result"] = out[i : i + n]
+                i += n
+                done.set()
+        except Exception as exc:              # deliver failure to ALL callers
+            for _, slot, done in pending:
+                if not done.is_set():
+                    slot["error"] = exc
+                    done.set()
+
+    def _drain(self, exc: Exception) -> None:
+        import queue
+
+        while True:
+            try:
+                _, slot, done = self._queue.get_nowait()
+            except queue.Empty:
+                return
+            slot["error"] = exc
+            done.set()
+
+    def output(self, features: np.ndarray) -> np.ndarray:
+        features = np.asarray(features)
+        if self.mode == self.INSTANT:
+            return self._forward_padded(features)
+        if self._stop.is_set():
+            raise RuntimeError("ParallelInference was shut down")
+        self._ensure_worker()
+        import threading
+
+        slot: dict = {}
+        done = threading.Event()
+        self._queue.put((features, slot, done))
+        while not done.wait(timeout=0.5):
+            # liveness: a dead worker (shutdown race, crash) must surface
+            # as an error, not an infinite hang
+            if self._stop.is_set() or not self._worker.is_alive():
+                if done.is_set():
+                    break
+                raise RuntimeError(
+                    "ParallelInference worker exited while the request "
+                    "was pending (shut down concurrently?)"
+                )
+        if "error" in slot:
+            raise slot["error"]
+        return slot["result"]
+
+    def shutdown(self) -> None:
+        self._stop.set()
+        if self._worker is not None:
+            self._worker.join(timeout=2)
+        self._drain(RuntimeError("ParallelInference was shut down"))
+
+
+def _serve_loop(ref) -> None:
+    """Worker loop, bound to the owner only via weakref (module-level so no
+    bound-method strong ref keeps the instance alive)."""
+    import queue
+
+    while True:
+        self = ref()
+        if self is None:
+            return
+        stop, q = self._stop, self._queue
+        if stop.is_set():
+            self._drain(RuntimeError("ParallelInference was shut down"))
+            return
+        del self                               # release across the block
+        try:
+            first = q.get(timeout=0.1)
+        except queue.Empty:
+            continue
+        self = ref()
+        if self is None or self._stop.is_set():
+            exc = RuntimeError("ParallelInference was shut down")
+            first[1]["error"] = exc
+            first[2].set()
+            if self is not None:
+                self._drain(exc)
+            return
+        self._process(first)

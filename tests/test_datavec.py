@@ -336,3 +336,71 @@ class TestAdvisorRegressions:
         recs = [[2.0, -1.0, "p", "u"], [8.0, 3.0, "q", "v"]]
         assert tp2.execute([list(r) for r in recs]) == tp.execute([list(r) for r in recs])
         assert tp2.final_schema == tp.final_schema
+
+
+class TestJoinReduce:
+    """Join + Reducer roles (previously a DataVec parity gap)."""
+
+    def _schemas(self):
+        from deeplearning4j_tpu.datavec import Schema
+
+        left = (Schema.builder().add_integer("id").add_string("name").build())
+        right = (Schema.builder().add_integer("id").add_double("score").build())
+        return left, right
+
+    def test_inner_and_left_outer_join(self):
+        from deeplearning4j_tpu.datavec import Join
+
+        left_s, right_s = self._schemas()
+        left = [[1, "a"], [2, "b"], [3, "c"]]
+        right = [[1, 0.5], [1, 0.7], [3, 0.9]]
+        j = Join("inner", left_s, right_s, "id")
+        assert j.output_schema().column_names() == ["id", "name", "score"]
+        got = j.execute(left, right)
+        assert got == [[1, "a", 0.5], [1, "a", 0.7], [3, "c", 0.9]]
+
+        lo = Join("left_outer", left_s, right_s, "id").execute(left, right)
+        assert [2, "b", None] in lo and len(lo) == 4
+
+    def test_full_outer_join(self):
+        from deeplearning4j_tpu.datavec import Join
+
+        left_s, right_s = self._schemas()
+        got = Join("full_outer", left_s, right_s, "id").execute(
+            [[1, "a"]], [[2, 0.3]]
+        )
+        assert [1, "a", None] in got and [2, None, 0.3] in got
+
+    def test_reducer_groupby(self):
+        from deeplearning4j_tpu.datavec import Reducer, Schema
+
+        schema = (Schema.builder().add_string("city").add_double("sales")
+                  .add_integer("n").build())
+        records = [
+            ["ab", 10.0, 1], ["ab", 20.0, 2], ["cd", 5.0, 3],
+        ]
+        r = (Reducer.builder(schema, "city")
+             .sum("sales").mean("sales").count("n").max("n").build())
+        assert r.output_schema().column_names() == [
+            "city", "sum(sales)", "mean(sales)", "count(n)", "max(n)",
+        ]
+        out = r.execute(records)
+        assert out == [["ab", 30.0, 15.0, 2, 2.0], ["cd", 5.0, 5.0, 1, 3.0]]
+
+    def test_reducer_rejects_non_numeric_agg(self):
+        from deeplearning4j_tpu.datavec import Reducer, Schema
+
+        schema = Schema.builder().add_string("k").add_string("v").build()
+        with pytest.raises(ValueError, match="numeric"):
+            Reducer.builder(schema, "k").sum("v").build()
+
+    def test_reducer_stdev_and_first_last(self):
+        from deeplearning4j_tpu.datavec import Reducer, Schema
+        import math
+
+        schema = Schema.builder().add_string("k").add_double("x").build()
+        r = (Reducer.builder(schema, "k").stdev("x").first("x").last("x")
+             .build())
+        out = r.execute([["g", 1.0], ["g", 3.0], ["g", 5.0]])
+        assert abs(out[0][1] - 2.0) < 1e-9        # sample stdev of 1,3,5
+        assert out[0][2] == 1.0 and out[0][3] == 5.0

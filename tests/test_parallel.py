@@ -274,3 +274,180 @@ def test_seq_axis_with_seq_to_one_labels():
     y = np.eye(2, dtype=np.float32)[np.arange(8) % 2]
     model.fit_batch(DataSet(x, y))
     assert np.isfinite(model.score_value)
+
+
+class TestParallelInferenceBatched:
+    def _model(self):
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn.activations import Activation
+        from deeplearning4j_tpu.nn.conf import (
+            Dense, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.losses import Loss
+
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(5)
+            .list()
+            .layer(Dense(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3, loss=Loss.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build()
+        )
+        return SequentialModel(conf).init()
+
+    def test_concurrent_requests_coalesce_and_match_direct(self):
+        import threading
+
+        from deeplearning4j_tpu.parallel.wrapper import ParallelInference
+
+        model = self._model()
+        rng = np.random.default_rng(0)
+        ref_model = self._model()            # same seed -> same params
+        pi = ParallelInference(model, mode="batched", batch_limit=64,
+                               coalesce_window_ms=20.0)
+        try:
+            forwards = {"n": 0}
+            orig = pi._forward_padded
+
+            def counting(f):
+                forwards["n"] += 1
+                return orig(f)
+
+            pi._forward_padded = counting
+            inputs = [rng.normal(0, 1, (3, 4)).astype(np.float32)
+                      for _ in range(8)]
+            results = [None] * 8
+
+            def call(i):
+                results[i] = pi.output(inputs[i])
+
+            threads = [threading.Thread(target=call, args=(i,))
+                       for i in range(8)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=60)
+            for i in range(8):
+                want = np.asarray(ref_model.output(inputs[i]))
+                np.testing.assert_allclose(results[i], want,
+                                           rtol=1e-5, atol=1e-6)
+            # coalescing: strictly fewer forwards than requests
+            assert forwards["n"] < 8, forwards
+        finally:
+            pi.shutdown()
+
+    def test_instant_mode_and_padding(self):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelInference
+
+        model = self._model()
+        pi = ParallelInference(model, mode="instant")
+        out = pi.output(np.zeros((5, 4), np.float32))   # 5 % 8 devices != 0
+        assert out.shape == (5, 3)
+
+    def test_worker_error_propagates(self):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelInference
+
+        model = self._model()
+        pi = ParallelInference(model, mode="batched")
+        try:
+            with pytest.raises(Exception):
+                pi.output(np.zeros((2, 999), np.float32))   # wrong width
+        finally:
+            pi.shutdown()
+
+
+class TestTPUnshardedWarning:
+    def test_unrecognized_large_param_warns(self):
+        import warnings as w
+
+        from deeplearning4j_tpu.parallel.strategy import param_specs
+
+        params = {"custom": {"kernel_matrix": jnp.zeros((128, 64))}}
+
+        class FakeConf:
+            layers = []
+
+        conf = FakeConf()
+        conf.layers = [type("L", (), {"name": "custom"})()]
+        with w.catch_warnings(record=True) as caught:
+            w.simplefilter("always")
+            param_specs(params, conf)
+        assert any("REPLICATED" in str(c.message) for c in caught)
+
+
+class TestParallelInferenceLifecycle:
+    def _pi(self, **kw):
+        from deeplearning4j_tpu.parallel.wrapper import ParallelInference
+        import tests  # noqa: F401
+
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn.activations import Activation
+        from deeplearning4j_tpu.nn.conf import (
+            Dense, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.losses import Loss
+
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(5)
+            .list()
+            .layer(Dense(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=3, loss=Loss.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build()
+        )
+        return ParallelInference(SequentialModel(conf).init(), **kw)
+
+    def test_mismatched_widths_error_both_callers_no_hang(self):
+        import threading
+
+        pi = self._pi(mode="batched", coalesce_window_ms=50.0)
+        try:
+            outcomes = {}
+
+            def call(name, width):
+                try:
+                    outcomes[name] = pi.output(
+                        np.zeros((2, width), np.float32)
+                    )
+                except Exception as e:
+                    outcomes[name] = e
+
+            ts = [threading.Thread(target=call, args=("a", 4)),
+                  threading.Thread(target=call, args=("b", 5))]
+            for t in ts:
+                t.start()
+            for t in ts:
+                t.join(timeout=30)
+            assert all(not t.is_alive() for t in ts), "caller hung"
+            # at least the malformed one errored; neither hangs
+            assert any(isinstance(v, Exception) for v in outcomes.values())
+        finally:
+            pi.shutdown()
+
+    def test_output_after_shutdown_raises(self):
+        pi = self._pi(mode="batched")
+        pi.output(np.zeros((2, 4), np.float32))
+        pi.shutdown()
+        with pytest.raises(RuntimeError, match="shut down"):
+            pi.output(np.zeros((2, 4), np.float32))
+
+    def test_context_manager(self):
+        with self._pi(mode="batched") as pi:
+            out = pi.output(np.zeros((3, 4), np.float32))
+            assert out.shape == (3, 3)
+
+    def test_dropped_instance_lets_worker_exit(self):
+        import gc
+        import threading
+
+        pi = self._pi(mode="batched")
+        pi.output(np.zeros((2, 4), np.float32))
+        worker = pi._worker
+        del pi
+        gc.collect()
+        worker.join(timeout=5)
+        assert not worker.is_alive(), "worker thread leaked after GC"

@@ -217,3 +217,62 @@ class TestValidationHarness:
         )
         errors = OpValidation.validate(tc)
         assert errors and "mismatch" in errors[0]
+
+
+class TestRegistryBreadth:
+    """New op families: trig/hyperbolic, rounding, segments, ordering."""
+
+    def test_trig_and_checks(self):
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        v = np.array([0.1, 0.5, -0.3], np.float32)
+        for op, ref in [
+            ("tan", np.tan), ("asin", np.arcsin), ("atan", np.arctan),
+            ("sinh", np.sinh), ("cosh", np.cosh), ("atanh", np.arctanh),
+            ("log1p", np.log1p), ("expm1", np.expm1),
+        ]:
+            y = sd.math.__getattr__(op)(x, name=f"y_{op}")
+            got = np.asarray(sd.output({"x": v}, y.name))
+            np.testing.assert_allclose(got, ref(v), rtol=1e-5, atol=1e-6,
+                                       err_msg=op)
+        y = sd.math.is_nan(x, name="nanchk")
+        got = np.asarray(sd.output({"x": np.array([1.0, np.nan], np.float32)},
+                                   "nanchk"))
+        np.testing.assert_allclose(got, [0.0, 1.0])
+
+    def test_segment_and_ordering(self):
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        ids = sd.constant("ids", np.array([0, 0, 1, 2], np.int32))
+        s = sd.math.segment_sum(x, ids, num_segments=3, name="seg")
+        got = np.asarray(sd.output(
+            {"x": np.array([1.0, 2.0, 3.0, 4.0], np.float32)}, "seg"))
+        np.testing.assert_allclose(got, [3.0, 3.0, 4.0])
+
+        sd2 = SameDiff()
+        x2 = sd2.placeholder("x")
+        top = sd2.math.top_k_values(x2, k=2, name="top")
+        got = np.asarray(sd2.output(
+            {"x": np.array([[3.0, 1.0, 9.0]], np.float32)}, "top"))
+        np.testing.assert_allclose(got, [[9.0, 3.0]])
+
+        srt = sd2.math.sort(x2, descending=True, name="srt")
+        got = np.asarray(sd2.output(
+            {"x": np.array([[3.0, 1.0, 9.0]], np.float32)}, "srt"))
+        np.testing.assert_allclose(got, [[9.0, 3.0, 1.0]])
+
+    def test_new_losses(self):
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.losses import Loss, compute
+
+        preds = jnp.asarray([[2.0, 4.0]])
+        labels = jnp.asarray([[1.0, 5.0]])
+        mape = float(compute(Loss.MAPE, preds, labels))
+        np.testing.assert_allclose(mape, (100.0 + 20.0) / 2, rtol=1e-5)
+        msle = float(compute(Loss.MSLE, preds, labels))
+        ref = np.mean((np.log1p([1.0, 5.0]) - np.log1p([2.0, 4.0])) ** 2)
+        np.testing.assert_allclose(msle, ref, rtol=1e-5)
+        w = float(compute(Loss.WASSERSTEIN, preds,
+                          jnp.asarray([[1.0, -1.0]])))
+        np.testing.assert_allclose(w, (-2.0 + 4.0) / 2, rtol=1e-5)
