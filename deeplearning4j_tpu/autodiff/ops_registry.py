@@ -105,6 +105,18 @@ def _onnx_slice(x, *, starts, ends, axes):
     return x[tuple(sl)]
 
 
+def _rationaltanh(x):
+    from deeplearning4j_tpu.nn.activations import _rational_tanh
+
+    return _rational_tanh(x)
+
+
+def _mhdpa(q, k, v, *, causal=False):
+    from deeplearning4j_tpu.ops.attention import mha
+
+    return mha(q, k, v, causal=causal)
+
+
 def _batch_norm(x, mean, var, gamma, beta, *, epsilon=1e-5):
     return (x - mean) * jax.lax.rsqrt(var + epsilon) * gamma + beta
 
@@ -261,7 +273,9 @@ OPS: dict[str, callable] = {
     "softsign": jax.nn.soft_sign,
     "hard_sigmoid": jax.nn.hard_sigmoid,
     "hard_tanh": lambda x: jnp.clip(x, -1.0, 1.0),
-    "rationaltanh": lambda x: 1.7159 * jnp.tanh(2.0 * x / 3.0),
+    # the DSL activation's exact rational-polynomial form (a graph op and a
+    # layer activation with the same name must not disagree)
+    "rationaltanh": _rationaltanh,
     "logsumexp": lambda x, *, axis=None, keepdims=False: (
         jax.scipy.special.logsumexp(x, axis=_ax(axis), keepdims=keepdims)
     ),
@@ -311,6 +325,9 @@ OPS: dict[str, callable] = {
     "floor_div": lambda a, b: jnp.floor_divide(a, b),
     "mod": jnp.mod,
     "atan2": jnp.arctan2,
+    # attention — the reference's multi_head_dot_product_attention custom op
+    # (q,k,v: (B,T,H,D); flash-dispatched on TPU for long sequences)
+    "multi_head_dot_product_attention": _mhdpa,
     # nn composite
     "conv2d": _conv2d,
     "max_pool2d": _max_pool2d,

@@ -128,6 +128,8 @@ _NN_OPS = (
     "relu", "relu6", "leaky_relu", "elu", "selu", "gelu", "silu", "sigmoid",
     "tanh", "softmax", "log_softmax", "softplus", "conv2d", "max_pool2d",
     "avg_pool2d", "layer_norm", "bias_add", "dropout", "one_hot",
+    "multi_head_dot_product_attention", "softsign", "hard_sigmoid",
+    "hard_tanh", "rationaltanh",
 )
 _LOSS_OPS = (
     "softmax_cross_entropy", "sparse_softmax_cross_entropy",

@@ -52,6 +52,7 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
             model.params, model.conf,
             model_axis=MODEL_AXIS if tp else None,
             expert_axis=EXPERT_AXIS if ep else None,
+            warn_unsharded=tp,
         )
         model.params = shard_params(model.params, mesh, specs)
     else:

@@ -98,7 +98,8 @@ def _spec_for_param(layer_type: str, pname: str, ndim: int,
 
 
 def param_specs(params, conf, model_axis: str | None = MODEL_AXIS,
-                expert_axis: str | None = None):
+                expert_axis: str | None = None,
+                warn_unsharded: bool = False):
     """PartitionSpec pytree matching a model's params.
 
     conf: SequentialConfiguration or GraphConfiguration — used to find each
@@ -129,7 +130,10 @@ def param_specs(params, conf, model_axis: str | None = MODEL_AXIS,
             for pname, leaf in lp.items()
         }
 
-    if model_axis is not None:
+    # warn only when the caller says TP is genuinely active (distribute()
+    # does) — a user inspecting specs in a DP-only setup must not be told
+    # "tensor parallelism is active"
+    if warn_unsharded and model_axis is not None:
         _warn_unsharded_params(params, specs, layer_types)
     return specs
 

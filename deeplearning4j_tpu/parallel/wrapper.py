@@ -140,11 +140,18 @@ class ParallelInference:
                 slot["result"] = out[i : i + n]
                 i += n
                 done.set()
-        except Exception as exc:              # deliver failure to ALL callers
-            for _, slot, done in pending:
-                if not done.is_set():
+        except Exception:
+            # the coalesced batch failed (often ONE malformed request):
+            # retry each caller individually so a stranger's bad shapes
+            # don't poison the valid requests that shared the window
+            for feats, slot, done in pending:
+                if done.is_set():
+                    continue
+                try:
+                    slot["result"] = self._forward_padded(feats)
+                except Exception as exc:
                     slot["error"] = exc
-                    done.set()
+                done.set()
 
     def _drain(self, exc: Exception) -> None:
         import queue
@@ -171,9 +178,12 @@ class ParallelInference:
         self._queue.put((features, slot, done))
         while not done.wait(timeout=0.5):
             # liveness: a dead worker (shutdown race, crash) must surface
-            # as an error, not an infinite hang
+            # as an error, not an infinite hang.  Let an in-flight batch
+            # finish first — shutdown() joins the worker, so a request the
+            # worker is actively computing still completes.
             if self._stop.is_set() or not self._worker.is_alive():
-                if done.is_set():
+                self._worker.join(timeout=10)
+                if done.wait(timeout=0.1):
                     break
                 raise RuntimeError(
                     "ParallelInference worker exited while the request "

@@ -83,7 +83,12 @@ class ModelSerializer:
                 "configuration.json",
                 json.dumps(
                     {
-                        "model_class": type(model).__name__,
+                        # snapshots (async checkpointing) carry the real
+                        # model class for restore dispatch
+                        "model_class": getattr(
+                            model, "_serialize_class_name",
+                            type(model).__name__,
+                        ),
                         "conf": serde.to_jsonable(model.conf),
                     },
                     indent=2,

@@ -9,6 +9,7 @@ reports both the including- and excluding-warmup rates.
 from __future__ import annotations
 
 import logging
+import os
 import time
 
 log = logging.getLogger("deeplearning4j_tpu")
@@ -165,6 +166,32 @@ class EvaluativeListener(TrainingListener):
             self._evaluate(model)
 
 
+class _HostSnapshot:
+    """Host copies of a model's serializable state, taken on the training
+    thread BEFORE the next step donates the buffers away.  Quacks enough
+    like a model for ModelSerializer.write_model."""
+
+    def __init__(self, model):
+        import jax
+
+        from deeplearning4j_tpu.runtime.distributed import fetch_global
+
+        self.params = jax.tree.map(fetch_global, model.params)
+        self.net_state = jax.tree.map(fetch_global, model.net_state)
+        self.opt_state = (
+            jax.tree.map(fetch_global, model.opt_state)
+            if model.opt_state is not None else None
+        )
+        self.conf = model.conf
+        self.iteration = model.iteration
+        self.epoch = model.epoch
+        self._serialize_class_name = type(model).__name__
+
+
+def _host_snapshot(model) -> _HostSnapshot:
+    return _HostSnapshot(model)
+
+
 class CheckpointListener(TrainingListener):
     """Rolling checkpoints (`CheckpointListener` role): save the model every
     N iterations or epochs into `directory` with a `checkpoint.txt` index;
@@ -172,7 +199,7 @@ class CheckpointListener(TrainingListener):
 
     def __init__(self, directory: str, save_every_n_iterations: int | None = None,
                  save_every_n_epochs: int | None = None, keep_last: int | None = None,
-                 keep_every: int = 1):
+                 keep_every: int = 1, async_save: bool = False):
         import os
 
         if (save_every_n_iterations is None) == (save_every_n_epochs is None):
@@ -182,6 +209,12 @@ class CheckpointListener(TrainingListener):
         self.every_epochs = save_every_n_epochs
         self.keep_last = keep_last
         self.keep_every = max(1, keep_every)
+        # async_save: the device->host snapshot happens on the training
+        # thread (donated buffers would be dead by the next step), but
+        # serialization/deflate/disk-write move to a background thread —
+        # the orbax-style overlap the reference lacks (SURVEY.md §5.4)
+        self.async_save = async_save
+        self._pending = None
         self._saved: list[tuple[int, str]] = []  # (checkpoint number, path)
         self._num = 0
         os.makedirs(directory, exist_ok=True)
@@ -195,11 +228,51 @@ class CheckpointListener(TrainingListener):
         import os
 
         path = os.path.join(self.directory, f"checkpoint_{self._num}_Model.zip")
-        model.save(path)
-        self._saved.append((self._num, path))
-        with open(self._index_path(), "a") as f:
-            f.write(f"{self._num},{iteration},{epoch},{time.time():.0f},{os.path.basename(path)}\n")
+        num = self._num
         self._num += 1
+        if not self.async_save:
+            model.save(path)
+            self._finish(num, path, iteration, epoch)
+            return
+        import threading
+
+        self.flush()                       # one in-flight save at a time
+        snap = _host_snapshot(model)
+
+        def writer():
+            from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+            try:
+                # tmp + rename: a process killed mid-write leaves no
+                # truncated zip behind, and the index only ever names
+                # fully-published files
+                tmp = path + ".tmp"
+                ModelSerializer.write_model(snap, tmp)
+                os.replace(tmp, path)
+                self._finish(num, path, iteration, epoch)
+            except BaseException as exc:   # surfaced by the next flush()
+                self._pending_error = exc
+
+        self._pending = threading.Thread(target=writer, daemon=True)
+        self._pending.start()
+
+    def flush(self) -> None:
+        """Wait for any in-flight async save to land; a failed background
+        save raises HERE rather than vanishing into the daemon thread."""
+        if self._pending is not None:
+            self._pending.join()
+            self._pending = None
+        err = getattr(self, "_pending_error", None)
+        if err is not None:
+            self._pending_error = None
+            raise RuntimeError(f"async checkpoint save failed: {err}") from err
+
+    def _finish(self, num: int, path: str, iteration: int, epoch: int) -> None:
+        import os
+
+        self._saved.append((num, path))
+        with open(self._index_path(), "a") as f:
+            f.write(f"{num},{iteration},{epoch},{time.time():.0f},{os.path.basename(path)}\n")
         if self.keep_last is not None:
             removable = [
                 (n, p) for (n, p) in self._saved[: -self.keep_last]

@@ -298,3 +298,46 @@ class TestListeners:
         model.set_listeners(lst)
         model.fit(NumpyDataSetIterator(x, y, batch_size=32), epochs=1)
         assert lst.remaining_seconds() >= 0
+
+
+class TestAsyncCheckpoint:
+    def test_async_save_restores_identically(self, tmp_path):
+        import numpy as np
+
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn.activations import Activation
+        from deeplearning4j_tpu.nn.conf import (
+            Dense, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.losses import Loss
+        from deeplearning4j_tpu.train import CheckpointListener
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(9)
+            .list()
+            .layer(Dense(n_out=8, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, loss=Loss.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build()
+        )
+        m = SequentialModel(conf).init()
+        ck = CheckpointListener(str(tmp_path), save_every_n_iterations=2,
+                                async_save=True)
+        m.set_listeners(ck)
+        rng = np.random.default_rng(0)
+        x = rng.normal(0, 1, (16, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        for _ in range(6):
+            m.fit_batch(DataSet(x, y))
+        ck.flush()
+        last = CheckpointListener.last_checkpoint(str(tmp_path))
+        restored = ModelSerializer.restore(last) if isinstance(last, str) else last
+        out_a = np.asarray(m.output(x))
+        # the LAST checkpoint was written at iteration 6 == current state
+        out_b = np.asarray(restored.output(x))
+        np.testing.assert_allclose(out_a, out_b, rtol=1e-5, atol=1e-6)
+        assert restored.iteration == 6
