@@ -13,6 +13,8 @@ host ETL with device steps.
 """
 
 from deeplearning4j_tpu.datavec.records import (
+    CSVSequenceRecordReader,
+    JDBCRecordReader,
     load_numeric_csv,
     RecordReader,
     CollectionRecordReader,
@@ -32,6 +34,8 @@ from deeplearning4j_tpu.datavec.join_reduce import (
 
 __all__ = [
     "load_numeric_csv",
+    "JDBCRecordReader",
+    "CSVSequenceRecordReader",
     "Join",
     "JoinType",
     "Reducer",

@@ -223,3 +223,95 @@ def load_numeric_csv(path, delimiter: str = ",", skip_lines: int = 0) -> "np.nda
             pass
     return np.loadtxt(path, delimiter=delimiter, skiprows=skip_lines,
                       dtype=np.float32, ndmin=2)
+
+
+class JDBCRecordReader(RecordReader):
+    """SQL-backed records — the `org.datavec.jdbc.records.reader.impl.
+    JDBCRecordReader` role.  Python's DB-API replaces JDBC: pass any
+    DB-API connection (sqlite3 ships in the stdlib) or a sqlite path, plus
+    the query.  Each row becomes one record; parameters are bound
+    server-side (no string splicing).
+
+        rr = JDBCRecordReader("data.db", "SELECT f1, f2, label FROM train")
+    """
+
+    def __init__(self, conn_or_path, query: str, parameters: tuple = ()):
+        if isinstance(conn_or_path, (str, os.PathLike)):
+            import sqlite3
+
+            # check_same_thread=False: AsyncDataSetIterator consumes readers
+            # from a producer thread; access is serialized per pass anyway
+            self._conn = sqlite3.connect(
+                str(conn_or_path), check_same_thread=False
+            )
+            self._owns = True
+        else:
+            self._conn = conn_or_path
+            self._owns = False
+        self.query = query
+        self.parameters = tuple(parameters)
+
+    def __iter__(self):
+        cur = self._conn.cursor()
+        try:
+            cur.execute(self.query, self.parameters)
+            for row in cur:
+                yield list(row)
+        finally:
+            cur.close()
+
+    def column_names(self) -> list[str]:
+        cur = self._conn.cursor()
+        try:
+            cur.execute(self.query, self.parameters)
+            return [d[0] for d in cur.description]
+        finally:
+            cur.close()
+
+    def close(self) -> None:
+        if self._owns:
+            self._conn.close()
+
+
+class CSVSequenceRecordReader(RecordReader):
+    """Per-file sequences — the `CSVSequenceRecordReader` role: each CSV
+    file under `directory` (sorted by name) is ONE sequence; every line is
+    a timestep record.  Iterating yields sequences (list of records);
+    `sequence_lengths()` exposes the ragged lengths for masking.
+    """
+
+    def __init__(self, directory: str | os.PathLike, skip_lines: int = 0,
+                 delimiter: str = ",", glob: str = "*.csv"):
+        self.directory = Path(directory)
+        self.skip_lines = skip_lines
+        self.delimiter = delimiter
+        self.glob = glob
+        self._paths = sorted(self.directory.glob(glob))
+        if not self._paths:
+            raise FileNotFoundError(
+                f"no files matching {glob!r} under {self.directory}"
+            )
+        self._lengths: list[int] | None = None
+
+    def __iter__(self):
+        lengths = []
+        for p in self._paths:
+            reader = CSVRecordReader(p, skip_lines=self.skip_lines,
+                                     delimiter=self.delimiter)
+            seq = list(reader)
+            lengths.append(len(seq))
+            yield seq
+        self._lengths = lengths
+
+    def num_sequences(self) -> int:
+        return len(self._paths)
+
+    def sequence_lengths(self) -> list[int]:
+        """Ragged per-sequence lengths (cached — computing them must not
+        cost a second full parse of every file)."""
+        if self._lengths is None:
+            self._lengths = [
+                sum(1 for _ in open(p)) - self.skip_lines
+                for p in self._paths
+            ]
+        return self._lengths

@@ -132,7 +132,21 @@ class ParallelInference:
                     break
                 pending.append(req)
                 rows += req[0].shape[0]
-            batch = np.concatenate([r[0] for r in pending], axis=0)
+            try:
+                batch = np.concatenate([r[0] for r in pending], axis=0)
+            except Exception:
+                # ASSEMBLY failed — one malformed request must not poison
+                # the valid ones that shared its window: run each caller
+                # individually (model-level failures below are broadcast
+                # instead; re-running them N times would just repeat the
+                # same failure serially)
+                for feats, slot, done in pending:
+                    try:
+                        slot["result"] = self._forward_padded(feats)
+                    except Exception as exc:
+                        slot["error"] = exc
+                    done.set()
+                return
             out = self._forward_padded(batch)
             i = 0
             for feats, slot, done in pending:
@@ -140,18 +154,11 @@ class ParallelInference:
                 slot["result"] = out[i : i + n]
                 i += n
                 done.set()
-        except Exception:
-            # the coalesced batch failed (often ONE malformed request):
-            # retry each caller individually so a stranger's bad shapes
-            # don't poison the valid requests that shared the window
-            for feats, slot, done in pending:
-                if done.is_set():
-                    continue
-                try:
-                    slot["result"] = self._forward_padded(feats)
-                except Exception as exc:
+        except Exception as exc:              # model-wide failure: broadcast
+            for _, slot, done in pending:
+                if not done.is_set():
                     slot["error"] = exc
-                done.set()
+                    done.set()
 
     def _drain(self, exc: Exception) -> None:
         import queue
@@ -182,7 +189,13 @@ class ParallelInference:
             # finish first — shutdown() joins the worker, so a request the
             # worker is actively computing still completes.
             if self._stop.is_set() or not self._worker.is_alive():
-                self._worker.join(timeout=10)
+                # an in-flight batch may legitimately run for minutes
+                # (first-call XLA compile) — wait for the worker to finish
+                # rather than declaring a live computation lost
+                while self._worker.is_alive():
+                    self._worker.join(timeout=1)
+                    if done.is_set():
+                        break
                 if done.wait(timeout=0.1):
                     break
                 raise RuntimeError(

@@ -200,8 +200,6 @@ class CheckpointListener(TrainingListener):
     def __init__(self, directory: str, save_every_n_iterations: int | None = None,
                  save_every_n_epochs: int | None = None, keep_last: int | None = None,
                  keep_every: int = 1, async_save: bool = False):
-        import os
-
         if (save_every_n_iterations is None) == (save_every_n_epochs is None):
             raise ValueError("set exactly one of save_every_n_iterations / save_every_n_epochs")
         self.directory = directory
@@ -220,13 +218,9 @@ class CheckpointListener(TrainingListener):
         os.makedirs(directory, exist_ok=True)
 
     def _index_path(self) -> str:
-        import os
-
         return os.path.join(self.directory, "checkpoint.txt")
 
     def _save(self, model, iteration: int, epoch: int) -> None:
-        import os
-
         path = os.path.join(self.directory, f"checkpoint_{self._num}_Model.zip")
         num = self._num
         self._num += 1
@@ -268,8 +262,6 @@ class CheckpointListener(TrainingListener):
             raise RuntimeError(f"async checkpoint save failed: {err}") from err
 
     def _finish(self, num: int, path: str, iteration: int, epoch: int) -> None:
-        import os
-
         self._saved.append((num, path))
         with open(self._index_path(), "a") as f:
             f.write(f"{num},{iteration},{epoch},{time.time():.0f},{os.path.basename(path)}\n")
@@ -290,6 +282,16 @@ class CheckpointListener(TrainingListener):
     def on_epoch_end(self, model, epoch):
         if self.every_epochs and (epoch + 1) % self.every_epochs == 0:
             self._save(model, model.iteration, epoch)
+        # fit() ends with the last epoch's on_epoch_end: landing any
+        # in-flight async save here means end-of-training never silently
+        # drops the final checkpoint (and surfaces background failures)
+        self.flush()
+
+    def __del__(self):
+        try:
+            self.flush()
+        except Exception:
+            pass
 
     # -- static loaders (reference parity: lastCheckpoint(dir) etc.) -------
     @staticmethod

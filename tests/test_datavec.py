@@ -404,3 +404,39 @@ class TestJoinReduce:
         out = r.execute([["g", 1.0], ["g", 3.0], ["g", 5.0]])
         assert abs(out[0][1] - 2.0) < 1e-9        # sample stdev of 1,3,5
         assert out[0][2] == 1.0 and out[0][3] == 5.0
+
+
+class TestJDBCAndSequenceReaders:
+    def test_jdbc_reader_sqlite(self, tmp_path):
+        import sqlite3
+
+        from deeplearning4j_tpu.datavec import JDBCRecordReader
+
+        db = str(tmp_path / "d.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE t (f1 REAL, f2 REAL, label INTEGER)")
+        conn.executemany("INSERT INTO t VALUES (?,?,?)",
+                         [(0.1, 1.0, 0), (0.2, 2.0, 1), (0.3, 3.0, 0)])
+        conn.commit()
+        conn.close()
+        rr = JDBCRecordReader(db, "SELECT f1, f2, label FROM t WHERE f2 >= ?",
+                              (2.0,))
+        assert rr.column_names() == ["f1", "f2", "label"]
+        recs = list(rr)
+        assert recs == [[0.2, 2.0, 1], [0.3, 3.0, 0]]
+        # reset semantics + stepwise API
+        rr.reset()
+        assert rr.has_next() and rr.next_record() == [0.2, 2.0, 1]
+        rr.close()
+
+    def test_csv_sequence_reader(self, tmp_path):
+        from deeplearning4j_tpu.datavec import CSVSequenceRecordReader
+
+        (tmp_path / "a.csv").write_text("1,2\n3,4\n5,6\n")
+        (tmp_path / "b.csv").write_text("7,8\n")
+        rr = CSVSequenceRecordReader(tmp_path)
+        seqs = list(rr)
+        assert rr.num_sequences() == 2
+        assert seqs[0] == [[1, 2], [3, 4], [5, 6]]
+        assert seqs[1] == [[7, 8]]
+        assert rr.sequence_lengths() == [3, 1]
