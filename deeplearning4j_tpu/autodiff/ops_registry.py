@@ -167,6 +167,254 @@ def _rgb_to_grayscale(x):
     return jnp.sum(x * w, axis=-1, keepdims=True)
 
 
+def _moments(x, *, axis=None, keepdims=False):
+    """Stacked [mean, variance] (reference's moments op returns both)."""
+    return jnp.stack(
+        [jnp.mean(x, axis=_ax(axis), keepdims=keepdims),
+         jnp.var(x, axis=_ax(axis), keepdims=keepdims)]
+    )
+
+
+def _entropy(x, *, axis=None):
+    p = jnp.clip(x, 1e-12, 1.0)
+    return -jnp.sum(p * jnp.log(p), axis=_ax(axis))
+
+
+def _reverse_sequence(x, lengths, *, seq_axis=1, batch_axis=0):
+    """Reverse the first `lengths[b]` elements of each row along seq_axis
+    (reference reverse_sequence / TF ReverseSequence)."""
+    T = x.shape[seq_axis]
+    idx = jnp.arange(T)
+    lengths = lengths.astype(jnp.int32)
+
+    def one(row, n):
+        rev = jnp.where(idx < n, n - 1 - idx, idx)
+        return jnp.take(row, rev, axis=seq_axis - 1 if seq_axis > batch_axis else seq_axis)
+
+    return jax.vmap(one, in_axes=(batch_axis, 0), out_axes=batch_axis)(x, lengths)
+
+
+def _sequence_mask(lengths, *, maxlen):
+    return (
+        jnp.arange(maxlen)[None, :] < lengths.astype(jnp.int32)[..., None]
+    ).astype(jnp.float32)
+
+
+def _scatter(op_name):
+    def fn(ref, indices, updates):
+        at = jnp.asarray(ref).at[jnp.asarray(indices).astype(jnp.int32)]
+        return getattr(at, op_name)(updates)
+
+    return fn
+
+
+def _gather_nd(x, indices):
+    idx = jnp.asarray(indices).astype(jnp.int32)
+    return jnp.asarray(x)[tuple(jnp.moveaxis(idx, -1, 0))]
+
+
+def _scatter_nd(indices, updates, *, shape):
+    idx = indices.astype(jnp.int32)
+    return jnp.zeros(tuple(shape), updates.dtype).at[
+        tuple(jnp.moveaxis(idx, -1, 0))
+    ].add(updates)
+
+
+def _rand(kind):
+    def fn(*, shape, seed=0, **kw):
+        key = jax.random.key(seed)
+        if kind == "normal":
+            return kw.get("mean", 0.0) + kw.get("std", 1.0) * jax.random.normal(
+                key, tuple(shape)
+            )
+        if kind == "uniform":
+            return jax.random.uniform(
+                key, tuple(shape), minval=kw.get("minval", 0.0),
+                maxval=kw.get("maxval", 1.0),
+            )
+        if kind == "bernoulli":
+            return jax.random.bernoulli(key, kw.get("p", 0.5), tuple(shape)).astype(
+                jnp.float32
+            )
+        if kind == "exponential":
+            return jax.random.exponential(key, tuple(shape)) / kw.get("rate", 1.0)
+        raise ValueError(kind)
+
+    return fn
+
+
+def _matrix_band_part(x, *, lower, upper):
+    m, n = x.shape[-2], x.shape[-1]
+    i = jnp.arange(m)[:, None]
+    j = jnp.arange(n)[None, :]
+    keep = jnp.ones((m, n), bool)
+    if lower >= 0:
+        keep &= (i - j) <= lower
+    if upper >= 0:
+        keep &= (j - i) <= upper
+    return jnp.where(keep, x, jnp.zeros((), x.dtype))
+
+
+def _matrix_set_diag(x, diag):
+    x, diag = jnp.asarray(x), jnp.asarray(diag)
+    m, n = x.shape[-2], x.shape[-1]
+    idx = jnp.arange(min(m, n))
+    return x.at[..., idx, idx].set(diag[..., : min(m, n)])
+
+
+def _matrix_diag(diag):
+    diag = jnp.asarray(diag)
+    k = diag.shape[-1]
+    out = jnp.zeros(diag.shape[:-1] + (k, k), diag.dtype)
+    idx = jnp.arange(k)
+    return out.at[..., idx, idx].set(diag)
+
+
+def _rgb_to_hsv(x):
+    r, g, b = x[..., 0], x[..., 1], x[..., 2]
+    mx = jnp.maximum(jnp.maximum(r, g), b)
+    mn = jnp.minimum(jnp.minimum(r, g), b)
+    diff = mx - mn
+    safe = jnp.where(diff == 0, 1.0, diff)
+    h = jnp.where(
+        mx == r, (g - b) / safe % 6.0,
+        jnp.where(mx == g, (b - r) / safe + 2.0, (r - g) / safe + 4.0),
+    ) / 6.0
+    h = jnp.where(diff == 0, 0.0, h)
+    s = jnp.where(mx == 0, 0.0, diff / jnp.where(mx == 0, 1.0, mx))
+    return jnp.stack([h, s, mx], axis=-1)
+
+
+def _hsv_to_rgb(x):
+    h, s, v = x[..., 0] * 6.0, x[..., 1], x[..., 2]
+    i = jnp.floor(h)
+    f = h - i
+    p, q, t = v * (1 - s), v * (1 - s * f), v * (1 - s * (1 - f))
+    i = i.astype(jnp.int32) % 6
+    r = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5], [v, q, p, p, t, v])
+    g = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5], [t, v, v, q, p, p])
+    b = jnp.select([i == 0, i == 1, i == 2, i == 3, i == 4, i == 5], [p, p, t, v, v, q])
+    return jnp.stack([r, g, b], axis=-1)
+
+
+def _adjust_hue(x, *, delta):
+    hsv = _rgb_to_hsv(x)
+    return _hsv_to_rgb(hsv.at[..., 0].set((hsv[..., 0] + delta) % 1.0))
+
+
+def _adjust_saturation(x, *, factor):
+    hsv = _rgb_to_hsv(x)
+    return _hsv_to_rgb(hsv.at[..., 1].set(jnp.clip(hsv[..., 1] * factor, 0.0, 1.0)))
+
+
+def _crop_and_resize(img, boxes, box_ind, *, crop_size):
+    """Bilinear crop-and-resize from normalized (y1,x1,y2,x2) boxes
+    (reference CropAndResize declarable op / TF semantics)."""
+    img = jnp.asarray(img)
+    H, W = img.shape[1], img.shape[2]
+    ch, cw = crop_size
+
+    def sample(image, ys, xs):
+        y0 = jnp.clip(jnp.floor(ys).astype(jnp.int32), 0, H - 1)
+        x0 = jnp.clip(jnp.floor(xs).astype(jnp.int32), 0, W - 1)
+        y1 = jnp.clip(y0 + 1, 0, H - 1)
+        x1 = jnp.clip(x0 + 1, 0, W - 1)
+        wy = (ys - y0)[:, None, None]
+        wx = (xs - x0)[None, :, None]
+        g = lambda yy, xx: image[yy][:, xx]
+        return (
+            g(y0, x0) * (1 - wy) * (1 - wx)
+            + g(y0, x1) * (1 - wy) * wx
+            + g(y1, x0) * wy * (1 - wx)
+            + g(y1, x1) * wy * wx
+        )
+
+    def one(box, bi):
+        y1, x1, y2, x2 = box[0], box[1], box[2], box[3]
+        ys = y1 * (H - 1) + (y2 - y1) * (H - 1) * jnp.linspace(0.0, 1.0, ch)
+        xs = x1 * (W - 1) + (x2 - x1) * (W - 1) * jnp.linspace(0.0, 1.0, cw)
+        return sample(img[bi], ys, xs)
+
+    return jax.vmap(one)(boxes, box_ind.astype(jnp.int32))
+
+
+def _iou(a, b):
+    """IoU of two (4,) boxes y1,x1,y2,x2."""
+    yy1 = jnp.maximum(a[0], b[0])
+    xx1 = jnp.maximum(a[1], b[1])
+    yy2 = jnp.minimum(a[2], b[2])
+    xx2 = jnp.minimum(a[3], b[3])
+    inter = jnp.maximum(yy2 - yy1, 0) * jnp.maximum(xx2 - xx1, 0)
+    area = lambda z: jnp.maximum(z[2] - z[0], 0) * jnp.maximum(z[3] - z[1], 0)
+    union = area(a) + area(b) - inter
+    return jnp.where(union > 0, inter / union, 0.0)
+
+
+def _non_max_suppression(boxes, scores, *, max_output_size, iou_threshold=0.5,
+                         score_threshold=-jnp.inf):
+    """Greedy NMS with a STATIC output size (padded with -1) — the
+    data-dependent-shape reference op recast for XLA: a lax.fori_loop
+    picks the best remaining box `max_output_size` times."""
+    boxes, scores = jnp.asarray(boxes), jnp.asarray(scores)
+    n = boxes.shape[0]
+    alive = scores > score_threshold
+
+    def body(i, st):
+        sel, alive = st
+        masked = jnp.where(alive, scores, -jnp.inf)
+        best = jnp.argmax(masked)
+        ok = masked[best] > -jnp.inf
+        sel = sel.at[i].set(jnp.where(ok, best, -1))
+        ious = jax.vmap(lambda b: _iou(boxes[best], b))(boxes)
+        alive = alive & (ious <= iou_threshold) & (jnp.arange(n) != best)
+        alive = jnp.where(ok, alive, jnp.zeros_like(alive))
+        return sel, alive
+
+    sel0 = jnp.full((max_output_size,), -1, jnp.int32)
+    sel, _ = jax.lax.fori_loop(0, max_output_size, body, (sel0, alive))
+    return sel
+
+
+def _space_to_batch(x, *, block, paddings=((0, 0), (0, 0))):
+    x = jnp.pad(x, ((0, 0), tuple(paddings[0]), tuple(paddings[1]), (0, 0)))
+    n, h, w, c = x.shape
+    x = x.reshape(n, h // block, block, w // block, block, c)
+    return x.transpose(2, 4, 0, 1, 3, 5).reshape(
+        n * block * block, h // block, w // block, c
+    )
+
+
+def _batch_to_space(x, *, block, crops=((0, 0), (0, 0))):
+    nb, h, w, c = x.shape
+    n = nb // (block * block)
+    x = x.reshape(block, block, n, h, w, c).transpose(2, 3, 0, 4, 1, 5)
+    x = x.reshape(n, h * block, w * block, c)
+    (ct, cb), (cl, cr) = crops
+    return x[:, ct : x.shape[1] - cb or None, cl : x.shape[2] - cr or None, :]
+
+
+def _confusion_matrix(labels, preds, *, num_classes):
+    idx = labels.astype(jnp.int32) * num_classes + preds.astype(jnp.int32)
+    return jnp.bincount(idx, length=num_classes * num_classes).reshape(
+        num_classes, num_classes
+    ).astype(jnp.float32)
+
+
+def _percentile(x, *, q, axis=None):
+    return jnp.percentile(x, q, axis=_ax(axis))
+
+
+def _standardize(x, *, axis=-1, epsilon=1e-5):
+    mean = jnp.mean(x, axis=_ax(axis), keepdims=True)
+    var = jnp.var(x, axis=_ax(axis), keepdims=True)
+    return (x - mean) * jax.lax.rsqrt(var + epsilon)
+
+
+def _clip_by_norm(x, *, clip_norm, axis=None):
+    n = jnp.sqrt(jnp.sum(jnp.square(x), axis=_ax(axis), keepdims=True))
+    return jnp.where(n > clip_norm, x * clip_norm / jnp.maximum(n, 1e-12), x)
+
+
 OPS: dict[str, callable] = {
     # elementwise arithmetic
     "add": jnp.add,
@@ -394,7 +642,132 @@ OPS: dict[str, callable] = {
     "bitwise_not": lambda a: jnp.bitwise_not(a.astype(jnp.int32)),
     "left_shift": lambda a, *, bits: jnp.left_shift(a.astype(jnp.int32), bits),
     "right_shift": lambda a, *, bits: jnp.right_shift(a.astype(jnp.int32), bits),
+    # reduce3 family (reference legacy_ops reduce3: pairwise distances)
+    "dot": lambda a, b, *, axis=None: jnp.sum(a * b, axis=_ax(axis)),
+    "cosine_similarity": lambda a, b, *, axis=-1: jnp.sum(a * b, axis=_ax(axis))
+    / jnp.maximum(
+        jnp.linalg.norm(a, axis=_ax(axis)) * jnp.linalg.norm(b, axis=_ax(axis)),
+        1e-12,
+    ),
+    "cosine_distance": lambda a, b, *, axis=-1: 1.0
+    - OPS["cosine_similarity"](a, b, axis=axis),
+    "euclidean_distance": lambda a, b, *, axis=None: jnp.sqrt(
+        jnp.sum(jnp.square(a - b), axis=_ax(axis))
+    ),
+    "manhattan_distance": lambda a, b, *, axis=None: jnp.sum(
+        jnp.abs(a - b), axis=_ax(axis)
+    ),
+    "hamming_distance": lambda a, b, *, axis=None: jnp.sum(
+        (a != b).astype(jnp.float32), axis=_ax(axis)
+    ),
+    "jaccard_distance": lambda a, b, *, axis=None: 1.0
+    - jnp.sum(jnp.minimum(a, b), axis=_ax(axis))
+    / jnp.maximum(jnp.sum(jnp.maximum(a, b), axis=_ax(axis)), 1e-12),
+    # reduction breadth (reference reduce float/same families)
+    "norm1": lambda x, *, axis=None, keepdims=False: jnp.sum(
+        jnp.abs(x), axis=_ax(axis), keepdims=keepdims
+    ),
+    "norm_max": lambda x, *, axis=None, keepdims=False: jnp.max(
+        jnp.abs(x), axis=_ax(axis), keepdims=keepdims
+    ),
+    "squared_norm": lambda x, *, axis=None, keepdims=False: jnp.sum(
+        jnp.square(x), axis=_ax(axis), keepdims=keepdims
+    ),
+    "count_nonzero": lambda x, *, axis=None: jnp.sum(
+        (x != 0).astype(jnp.float32), axis=_ax(axis)
+    ),
+    "count_zero": lambda x, *, axis=None: jnp.sum(
+        (x == 0).astype(jnp.float32), axis=_ax(axis)
+    ),
+    "amean": lambda x, *, axis=None: jnp.mean(jnp.abs(x), axis=_ax(axis)),
+    "amax": lambda x, *, axis=None: jnp.max(jnp.abs(x), axis=_ax(axis)),
+    "amin": lambda x, *, axis=None: jnp.min(jnp.abs(x), axis=_ax(axis)),
+    "entropy": _entropy,
+    "shannon_entropy": lambda x, *, axis=None: _entropy(x, axis=axis) / jnp.log(2.0),
+    "log_entropy": lambda x, *, axis=None: jnp.log(
+        jnp.maximum(_entropy(x, axis=axis), 1e-12)
+    ),
+    "moments": _moments,
+    "percentile": _percentile,
+    "median": lambda x, *, axis=None: jnp.median(x, axis=_ax(axis)),
+    # indexreduce family
+    "iamax": lambda x, *, axis=-1: jnp.argmax(jnp.abs(x), axis=axis),
+    "iamin": lambda x, *, axis=-1: jnp.argmin(jnp.abs(x), axis=axis),
+    # -1 when no element matches (reference index-accumulation semantics)
+    "first_index_nonzero": lambda x, *, axis=-1: jnp.where(
+        jnp.any(x != 0, axis=axis),
+        jnp.argmax((x != 0).astype(jnp.int32), axis=axis),
+        -1,
+    ),
+    "last_index_nonzero": lambda x, *, axis=-1: jnp.where(
+        jnp.any(x != 0, axis=axis),
+        x.shape[axis]
+        - 1
+        - jnp.argmax(jnp.flip((x != 0).astype(jnp.int32), axis=axis), axis=axis),
+        -1,
+    ),
+    # scatter family (reference scatter_add/upd/max/min declarable ops)
+    "scatter_add": _scatter("add"),
+    "scatter_sub": lambda ref, idx, upd: _scatter("add")(ref, idx, -upd),
+    "scatter_mul": _scatter("multiply"),
+    "scatter_update": _scatter("set"),
+    "scatter_max": _scatter("max"),
+    "scatter_min": _scatter("min"),
+    "gather_nd": _gather_nd,
+    "scatter_nd": _scatter_nd,
+    # random family (seed is a static attr -> deterministic, jit-safe)
+    "random_normal": _rand("normal"),
+    "random_uniform": _rand("uniform"),
+    "random_bernoulli": _rand("bernoulli"),
+    "random_exponential": _rand("exponential"),
+    # creation
+    "zeros_like": jnp.zeros_like,
+    "ones_like": jnp.ones_like,
+    "full_like": lambda x, *, value: jnp.full_like(x, value),
+    "eye": lambda *, n, m=None: jnp.eye(n, m),
+    "linspace": lambda *, start, stop, num: jnp.linspace(start, stop, num),
+    "range": lambda *, start, limit, delta=1: jnp.arange(start, limit, delta,
+                                                         dtype=jnp.float32),
+    "fill": lambda *, shape, value: jnp.full(tuple(shape), value, jnp.float32),
+    # sequence ops
+    "reverse_sequence": _reverse_sequence,
+    "sequence_mask": _sequence_mask,
+    # matrix structure
+    "matrix_band_part": _matrix_band_part,
+    "matrix_diag": _matrix_diag,
+    "matrix_set_diag": _matrix_set_diag,
+    # image breadth
+    "rgb_to_hsv": _rgb_to_hsv,
+    "hsv_to_rgb": _hsv_to_rgb,
+    "adjust_hue": _adjust_hue,
+    "adjust_saturation": _adjust_saturation,
+    "crop_and_resize": _crop_and_resize,
+    "non_max_suppression": _non_max_suppression,
+    "space_to_batch": _space_to_batch,
+    "batch_to_space": _batch_to_space,
+    # nn / misc breadth
+    "prelu": lambda x, alpha: jnp.where(x >= 0, x, alpha * x),
+    "thresholded_relu": lambda x, *, theta=1.0: jnp.where(x > theta, x, 0.0),
+    "log_sigmoid": jax.nn.log_sigmoid,
+    "mish": lambda x: x * jnp.tanh(jax.nn.softplus(x)),
+    "swish": jax.nn.silu,
+    "standardize": _standardize,
+    "clip_by_norm": _clip_by_norm,
+    "xw_plus_b": lambda x, w, b: x @ w + b,
+    "confusion_matrix": _confusion_matrix,
+    # special math (reference transform-strict family)
+    "lgamma": jax.scipy.special.gammaln,
+    "digamma": jax.scipy.special.digamma,
+    "igamma": jax.scipy.special.gammainc,
+    "igammac": jax.scipy.special.gammaincc,
+    "zeta": jax.scipy.special.zeta,
+    "polygamma": lambda x, *, n: jax.scipy.special.polygamma(n, x),
+    "betainc": jax.scipy.special.betainc,
+    "truncate_div": lambda a, b: jnp.trunc(a / b),
+    "floor_mod": jnp.mod,
 }
+
+OPS["extract_image_patches"] = OPS["im2col"]
 
 
 def _ax(axis):

@@ -129,7 +129,8 @@ _NN_OPS = (
     "tanh", "softmax", "log_softmax", "softplus", "conv2d", "max_pool2d",
     "avg_pool2d", "layer_norm", "bias_add", "dropout", "one_hot",
     "multi_head_dot_product_attention", "softsign", "hard_sigmoid",
-    "hard_tanh", "rationaltanh",
+    "hard_tanh", "rationaltanh", "prelu", "thresholded_relu", "log_sigmoid",
+    "mish", "swish", "standardize", "xw_plus_b",
 )
 _LOSS_OPS = (
     "softmax_cross_entropy", "sparse_softmax_cross_entropy",
@@ -147,6 +148,24 @@ _MATH_OPS = (
     "expm1", "erf", "erfc", "cube", "logsumexp", "cumprod", "sort",
     "argsort", "top_k_values", "top_k_indices", "segment_sum",
     "segment_max", "segment_min", "segment_mean", "reverse", "roll",
+    # reduce3 / distance family
+    "dot", "cosine_similarity", "cosine_distance", "euclidean_distance",
+    "manhattan_distance", "hamming_distance", "jaccard_distance",
+    # reduction breadth + index reductions
+    "norm1", "norm_max", "squared_norm", "count_nonzero", "count_zero",
+    "amean", "amax", "amin", "entropy", "shannon_entropy", "log_entropy",
+    "moments", "percentile", "median", "iamax", "iamin",
+    "first_index_nonzero", "last_index_nonzero",
+    # scatter/gather breadth
+    "scatter_add", "scatter_sub", "scatter_mul", "scatter_update",
+    "scatter_max", "scatter_min", "gather_nd", "scatter_nd",
+    # creation / sequence
+    "zeros_like", "ones_like", "full_like", "eye", "linspace", "range",
+    "fill", "reverse_sequence", "sequence_mask",
+    # special math
+    "lgamma", "digamma", "igamma", "igammac", "zeta", "polygamma",
+    "betainc", "truncate_div", "floor_mod", "clip_by_norm",
+    "confusion_matrix",
 )
 _CNN_OPS = (
     "conv1d", "conv2d", "conv3d", "depthwise_conv2d", "deconv2d",
@@ -157,15 +176,23 @@ _RNN_OPS = ("lstm_cell", "gru_cell")
 _IMAGE_OPS = (
     "resize", "crop", "flip_lr", "flip_ud", "adjust_brightness",
     "adjust_contrast", "rgb_to_grayscale", "normalize_image",
+    "rgb_to_hsv", "hsv_to_rgb", "adjust_hue", "adjust_saturation",
+    "crop_and_resize", "non_max_suppression", "extract_image_patches",
+    "space_to_batch", "batch_to_space",
 )
 _LINALG_OPS = (
     "matmul", "inv", "det", "cholesky", "solve", "svd", "qr", "matrix_trace",
     "diag", "diag_part", "matrix_transpose", "lstsq", "triu", "tril",
-    "tensordot", "einsum",
+    "tensordot", "einsum", "matrix_band_part", "matrix_diag",
+    "matrix_set_diag",
 )
 _BITWISE_OPS = (
     "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
     "left_shift", "right_shift",
+)
+_RANDOM_OPS = (
+    "random_normal", "random_uniform", "random_bernoulli",
+    "random_exponential",
 )
 
 
@@ -200,6 +227,7 @@ class SameDiff:
         self.image = _Namespace(self, _IMAGE_OPS)
         self.linalg = _Namespace(self, _LINALG_OPS)
         self.bitwise = _Namespace(self, _BITWISE_OPS)
+        self.random = _Namespace(self, _RANDOM_OPS)
 
     # -- graph construction ------------------------------------------------
     def _fresh(self, base: str) -> str:

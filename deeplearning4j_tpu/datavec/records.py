@@ -261,12 +261,21 @@ class JDBCRecordReader(RecordReader):
             cur.close()
 
     def column_names(self) -> list[str]:
-        cur = self._conn.cursor()
-        try:
-            cur.execute(self.query, self.parameters)
-            return [d[0] for d in cur.description]
-        finally:
-            cur.close()
+        if getattr(self, "_columns", None) is None:
+            cur = self._conn.cursor()
+            try:
+                # LIMIT 0 wrapper: cursor.description is populated without
+                # the server executing the full (possibly expensive) query
+                try:
+                    cur.execute(
+                        f"SELECT * FROM ({self.query}) LIMIT 0", self.parameters
+                    )
+                except Exception:
+                    cur.execute(self.query, self.parameters)
+                self._columns = [d[0] for d in cur.description]
+            finally:
+                cur.close()
+        return self._columns
 
     def close(self) -> None:
         if self._owns:
@@ -308,10 +317,18 @@ class CSVSequenceRecordReader(RecordReader):
 
     def sequence_lengths(self) -> list[int]:
         """Ragged per-sequence lengths (cached — computing them must not
-        cost a second full parse of every file)."""
+        cost a second full parse of every file).  Counts exactly what
+        iteration yields: blank rows are skipped, skip_lines only eats
+        real leading rows."""
         if self._lengths is None:
-            self._lengths = [
-                sum(1 for _ in open(p)) - self.skip_lines
-                for p in self._paths
-            ]
+            lengths = []
+            for p in self._paths:
+                with open(p, newline="") as f:
+                    n = sum(
+                        1
+                        for i, row in enumerate(csv.reader(f, delimiter=self.delimiter))
+                        if i >= self.skip_lines and row
+                    )
+                lengths.append(n)
+            self._lengths = lengths
         return self._lengths

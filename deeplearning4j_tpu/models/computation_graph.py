@@ -238,6 +238,10 @@ class GraphModel(Model):
             self.epoch += 1
             if hasattr(iterator, "reset"):
                 iterator.reset()
+        for lst in self.listeners:
+            # getattr: on_fit_end is newer than the SPI — tolerate
+            # duck-typed listeners written against the original three hooks
+            getattr(lst, "on_fit_end", lambda m: None)(self)
 
     def fit_batch(self, batch) -> None:
         if self.params is None:

@@ -275,6 +275,10 @@ class SequentialModel(Model):
                 lst.on_epoch_end(self, self.epoch)
             self.epoch += 1
             iterator.reset()
+        for lst in self.listeners:
+            # getattr: on_fit_end is newer than the SPI — tolerate
+            # duck-typed listeners written against the original three hooks
+            getattr(lst, "on_fit_end", lambda m: None)(self)
 
     def fit_batch(self, batch: DataSet) -> None:
         if self.params is None:
@@ -347,6 +351,88 @@ class SequentialModel(Model):
                 None if batch.labels_mask is None else batch.labels_mask[:, sl],
             )
             carries = self._run_step(window, carries=carries)
+
+    # -- layerwise unsupervised pretraining --------------------------------
+    def pretrain(self, data, epochs: int = 1, batch_size: int | None = None) -> None:
+        """Greedy layerwise unsupervised pretraining (reference
+        MultiLayerNetwork.pretrain(DataSetIterator)): every PRETRAINABLE
+        layer (AutoEncoder / VariationalAutoencoder) is trained in stack
+        order on the features only."""
+        for i, layer in enumerate(self.conf.layers):
+            if getattr(layer, "PRETRAINABLE", False):
+                self.pretrain_layer(i, data, epochs=epochs, batch_size=batch_size)
+
+    def pretrain_layer(
+        self, index: int, data, epochs: int = 1, batch_size: int | None = None
+    ) -> float:
+        """Unsupervised pretraining of one layer (reference
+        MultiLayerNetwork.pretrainLayer): the frozen prefix runs in
+        inference mode, then (prefix-forward -> pretrain_loss -> grad ->
+        updater) for THIS layer's params compiles into one donated-buffer
+        XLA step.  Returns the last pretrain loss."""
+        if self.params is None:
+            self.init()
+        layer = self.conf.layers[index]
+        if not getattr(layer, "PRETRAINABLE", False):
+            raise ValueError(
+                f"layer {index} ({type(layer).__name__}) is not pretrainable; "
+                "only AutoEncoder/VariationalAutoencoder layers support "
+                "unsupervised pretraining"
+            )
+        tx = with_gradient_clipping(
+            self.conf.updater.to_optax(self.conf.steps_per_epoch),
+            self.conf.gradient_clip_value,
+            self.conf.gradient_clip_norm,
+        )
+        opt_state = tx.init(self.params[layer.name])
+        frozen_params = {
+            k: v for k, v in self.params.items() if k != layer.name
+        }
+
+        @partial(jax.jit, donate_argnums=(0, 1))
+        def pstep(lp, opt_state, frozen, step_i, features):
+            rng = SeedStream.fold(self._stream.root, step_i)
+
+            def loss_fn(lp):
+                x = self._prefix_forward(frozen, features, index)
+                return layer.pretrain_loss(lp, jax.lax.stop_gradient(x), rng)
+
+            loss, grads = jax.value_and_grad(loss_fn)(lp)
+            updates, opt_state = tx.update(grads, opt_state, lp)
+            lp = jax.tree.map(lambda p, u: p + u.astype(p.dtype), lp, updates)
+            return lp, opt_state, loss
+
+        iterator = _as_iterator(data, batch_size)
+        lp = self.params.pop(layer.name)
+        loss = float("nan")
+        step_i = 0
+        try:
+            for _ in range(epochs):
+                for batch in iterator:
+                    lp, opt_state, loss = pstep(
+                        lp, opt_state, frozen_params, jnp.uint32(step_i),
+                        jnp.asarray(batch.features),
+                    )
+                    step_i += 1
+                iterator.reset()
+        finally:
+            self.params[layer.name] = lp
+        return float(loss)
+
+    def _prefix_forward(self, params, x, stop: int):
+        """Inference-mode forward through layers [0, stop) — the pretrain
+        prefix.  Pure/traced; BN etc. use stored state without updating."""
+        if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(jnp.bfloat16)
+        for i, layer in enumerate(self.conf.layers[:stop]):
+            if self._flatten_before[i]:
+                x = x.reshape(x.shape[0], -1)
+            lp = params.get(layer.name, {})
+            ls = self.net_state.get(layer.name, {})
+            x, _ = layer.apply(lp, ls, x, training=False, rng=None)
+        if self._flatten_before[stop]:
+            x = x.reshape(x.shape[0], -1)
+        return x.astype(jnp.float32)
 
     # -- inference ---------------------------------------------------------
     def _get_infer_fn(self, has_fmask: bool = False):

@@ -1,0 +1,283 @@
+"""Unsupervised-pretraining layers: AutoEncoder + VariationalAutoencoder.
+
+Reference roles (SURVEY.md §2.2 "Early stopping / transfer learning /
+pretraining" — "VAE & pretrain layer support"):
+  - org.deeplearning4j.nn.conf.layers.AutoEncoder [U] — denoising
+    autoencoder with tied decoder weights (BasePretrainNetwork family).
+  - org.deeplearning4j.nn.conf.layers.variational.VariationalAutoencoder
+    [U] — multi-layer encoder/decoder VAE with a pluggable reconstruction
+    distribution, pretrained on the ELBO.
+
+TPU-native design: the reference gives each pretrain layer its own
+backprop implementation driven by MultiLayerNetwork.pretrainLayer()'s
+op-at-a-time loop.  Here a pretrainable layer declares ONE extra pure
+function, `pretrain_loss(params, x, rng) -> scalar`, and the model
+compiles (prefix-forward -> pretrain_loss -> grad -> updater) into a
+single donated-buffer XLA step per layer (models/sequential.py
+pretrain_layer()).  The supervised `apply()` path is the encoder only,
+so a pretrained stack drops straight into fine-tuning.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf.input_type import InputType
+from deeplearning4j_tpu.nn.conf.layers import LayerConfig, _dropout
+from deeplearning4j_tpu.nn.losses import Loss, compute as compute_loss
+from deeplearning4j_tpu.utils import serde
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class AutoEncoder(LayerConfig):
+    """Denoising autoencoder with tied decoder weights.
+
+    Supervised forward = encoder only: act(x @ W + b).  `pretrain_loss`
+    corrupts the input (masking noise with probability
+    `corruption_level`), encodes, decodes through the TIED transpose
+    weight plus a visible bias, and scores reconstruction with `loss`
+    (reference default: reconstruction cross-entropy for unit-interval
+    data; MSE otherwise).  An optional KL sparsity penalty pulls mean
+    hidden activation toward `sparsity` (reference's sparsity field).
+    """
+
+    n_out: int = 0
+    corruption_level: float = 0.3
+    sparsity: float = 0.0
+    sparsity_beta: float = 0.0
+    loss: Loss = Loss.MSE
+
+    EXPECTS = "ff"
+    PRETRAINABLE = True
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, itype):
+        n_in = itype.size
+        kw, = jax.random.split(key, 1)
+        w = self._winit().init(kw, (n_in, self.n_out), fan_in=n_in, fan_out=self.n_out)
+        return {
+            "W": w,
+            "b": jnp.zeros((self.n_out,), jnp.float32),
+            "vb": jnp.zeros((n_in,), jnp.float32),
+        }, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        y = x @ params["W"].astype(x.dtype) + params["b"].astype(x.dtype)
+        return self._act(Activation.SIGMOID)(y), state
+
+    def _decode(self, params, h):
+        """Tied-weight decoder: h @ W^T + vb."""
+        return h @ params["W"].astype(h.dtype).T + params["vb"].astype(h.dtype)
+
+    def pretrain_loss(self, params, x, rng) -> jax.Array:
+        x = x.astype(jnp.float32)
+        if self.corruption_level > 0.0 and rng is not None:
+            keep = jax.random.bernoulli(rng, 1.0 - self.corruption_level, x.shape)
+            x_in = jnp.where(keep, x, 0.0)
+        else:
+            x_in = x
+        h = self._act(Activation.SIGMOID)(
+            x_in @ params["W"] + params["b"]
+        )
+        recon = self._decode(params, h)
+        if self.loss in (Loss.XENT, Loss.RECONSTRUCTION_CROSSENTROPY):
+            loss = compute_loss(Loss.XENT, recon, x, None, from_logits=True)
+        else:
+            loss = compute_loss(self.loss, recon, x, None, from_logits=False)
+        if self.sparsity_beta > 0.0:
+            rho, rho_hat = self.sparsity, jnp.clip(jnp.mean(h, axis=0), 1e-6, 1 - 1e-6)
+            kl = rho * jnp.log(rho / rho_hat) + (1 - rho) * jnp.log(
+                (1 - rho) / (1 - rho_hat)
+            )
+            loss = loss + self.sparsity_beta * jnp.sum(kl)
+        return loss
+
+    def reconstruction_error(self, params, x) -> jax.Array:
+        """Per-example reconstruction error (reference
+        AutoEncoder score / anomaly-detection usage)."""
+        x = x.astype(jnp.float32)
+        h = self._act(Activation.SIGMOID)(x @ params["W"] + params["b"])
+        recon = self._decode(params, h)
+        if self.loss in (Loss.XENT, Loss.RECONSTRUCTION_CROSSENTROPY):
+            p = jax.nn.sigmoid(recon)
+            return -jnp.sum(
+                x * jnp.log(jnp.clip(p, 1e-7, 1.0))
+                + (1 - x) * jnp.log(jnp.clip(1 - p, 1e-7, 1.0)),
+                axis=-1,
+            )
+        return jnp.sum((recon - x) ** 2, axis=-1)
+
+
+def _mlp_init(key, sizes, winit):
+    params = {}
+    keys = jax.random.split(key, max(len(sizes) - 1, 1))
+    for i in range(len(sizes) - 1):
+        n_in, n_out = sizes[i], sizes[i + 1]
+        params[f"W{i}"] = winit.init(keys[i], (n_in, n_out), fan_in=n_in, fan_out=n_out)
+        params[f"b{i}"] = jnp.zeros((n_out,), jnp.float32)
+    return params
+
+
+def _mlp_apply(params, x, act, n_layers):
+    for i in range(n_layers):
+        x = act(x @ params[f"W{i}"].astype(x.dtype) + params[f"b{i}"].astype(x.dtype))
+    return x
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class VariationalAutoencoder(LayerConfig):
+    """Variational autoencoder pretrained on the ELBO.
+
+    `n_out` is the latent size; `encoder_layer_sizes` /
+    `decoder_layer_sizes` are the hidden MLP stacks (reference's
+    encoderLayerSizes/decoderLayerSizes).  `reconstruction_distribution`
+    is "gaussian" (learned diagonal variance) or "bernoulli" (sigmoid
+    logits), the reference's pluggable ReconstructionDistribution.
+    `num_samples` Monte-Carlo samples estimate the reconstruction term.
+
+    Supervised forward = mean of q(z|x) with `pzx_activation` applied
+    (the reference feeds the posterior mean into downstream layers).
+    """
+
+    n_out: int = 0
+    encoder_layer_sizes: tuple = (100,)
+    decoder_layer_sizes: tuple = (100,)
+    reconstruction_distribution: str = "gaussian"
+    num_samples: int = 1
+    pzx_activation: Optional[Activation] = None
+
+    EXPECTS = "ff"
+    PRETRAINABLE = True
+    REGULARIZED = ()
+
+    def __post_init__(self):
+        super().__post_init__()
+        object.__setattr__(self, "encoder_layer_sizes",
+                           tuple(int(s) for s in self.encoder_layer_sizes))
+        object.__setattr__(self, "decoder_layer_sizes",
+                           tuple(int(s) for s in self.decoder_layer_sizes))
+        if self.pzx_activation is not None:
+            from deeplearning4j_tpu.nn.conf.layers import _coerce_enum
+
+            object.__setattr__(
+                self, "pzx_activation", _coerce_enum(self.pzx_activation, Activation)
+            )
+        if self.reconstruction_distribution not in ("gaussian", "bernoulli"):
+            raise ValueError(
+                "reconstruction_distribution must be 'gaussian' or 'bernoulli', "
+                f"got {self.reconstruction_distribution!r}"
+            )
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out)
+
+    def init(self, key, itype):
+        n_in = itype.size
+        winit = self._winit()
+        k_enc, k_mu, k_lv, k_dec, k_out = jax.random.split(key, 5)
+        enc_sizes = (n_in,) + self.encoder_layer_sizes
+        dec_sizes = (self.n_out,) + self.decoder_layer_sizes
+        e_last, d_last = enc_sizes[-1], dec_sizes[-1]
+        params = {
+            "enc": _mlp_init(k_enc, enc_sizes, winit),
+            "W_mu": winit.init(k_mu, (e_last, self.n_out)),
+            "b_mu": jnp.zeros((self.n_out,), jnp.float32),
+            "W_lv": winit.init(k_lv, (e_last, self.n_out)),
+            "b_lv": jnp.zeros((self.n_out,), jnp.float32),
+            "dec": _mlp_init(k_dec, dec_sizes, winit),
+            "W_out": winit.init(k_out, (d_last, n_in)),
+            "b_out": jnp.zeros((n_in,), jnp.float32),
+        }
+        if self.reconstruction_distribution == "gaussian":
+            params["W_out_lv"] = winit.init(k_out, (d_last, n_in))
+            params["b_out_lv"] = jnp.zeros((n_in,), jnp.float32)
+        return params, {}
+
+    # -- pieces ------------------------------------------------------------
+    def _posterior(self, params, x):
+        h = _mlp_apply(params["enc"], x, self._act(Activation.RELU),
+                       len(self.encoder_layer_sizes))
+        mu = h @ params["W_mu"].astype(h.dtype) + params["b_mu"].astype(h.dtype)
+        logvar = h @ params["W_lv"].astype(h.dtype) + params["b_lv"].astype(h.dtype)
+        return mu, logvar
+
+    def _decode(self, params, z):
+        h = _mlp_apply(params["dec"], z, self._act(Activation.RELU),
+                       len(self.decoder_layer_sizes))
+        out = h @ params["W_out"].astype(h.dtype) + params["b_out"].astype(h.dtype)
+        if self.reconstruction_distribution == "gaussian":
+            out_lv = (
+                h @ params["W_out_lv"].astype(h.dtype)
+                + params["b_out_lv"].astype(h.dtype)
+            )
+            return out, out_lv
+        return out, None
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        mu, _ = self._posterior(params, x)
+        act = self.pzx_activation if self.pzx_activation is not None else Activation.IDENTITY
+        return act(mu), state
+
+    def _recon_log_prob(self, params, z, x):
+        """log p(x|z), summed over features — per example."""
+        mean, logvar = self._decode(params, z)
+        if self.reconstruction_distribution == "bernoulli":
+            logp = x * jax.nn.log_sigmoid(mean) + (1 - x) * jax.nn.log_sigmoid(-mean)
+            return jnp.sum(logp, axis=-1)
+        logvar = jnp.clip(logvar, -10.0, 10.0)
+        return -0.5 * jnp.sum(
+            logvar + jnp.log(2 * jnp.pi) + (x - mean) ** 2 / jnp.exp(logvar),
+            axis=-1,
+        )
+
+    def pretrain_loss(self, params, x, rng) -> jax.Array:
+        """Negative ELBO, averaged over the batch."""
+        x = x.astype(jnp.float32)
+        mu, logvar = self._posterior(params, x)
+        logvar = jnp.clip(logvar, -10.0, 10.0)
+        kl = -0.5 * jnp.sum(1 + logvar - mu**2 - jnp.exp(logvar), axis=-1)
+        recon = 0.0
+        for s in range(max(self.num_samples, 1)):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            recon = recon + self._recon_log_prob(params, z, x)
+        recon = recon / max(self.num_samples, 1)
+        return jnp.mean(kl - recon)
+
+    def reconstruction_log_probability(self, params, x, rng, num_samples=None):
+        """Importance-sampled estimate of log p(x) per example (reference
+        VariationalAutoencoder.reconstructionLogProbability)."""
+        x = jnp.asarray(x, jnp.float32)
+        n = int(num_samples or self.num_samples or 1)
+        mu, logvar = self._posterior(params, x)
+        logvar = jnp.clip(logvar, -10.0, 10.0)
+        ws = []
+        for s in range(n):
+            eps = jax.random.normal(jax.random.fold_in(rng, s), mu.shape, mu.dtype)
+            z = mu + jnp.exp(0.5 * logvar) * eps
+            log_pxz = self._recon_log_prob(params, z, x)
+            log_pz = -0.5 * jnp.sum(z**2 + jnp.log(2 * jnp.pi), axis=-1)
+            log_qzx = -0.5 * jnp.sum(
+                logvar + jnp.log(2 * jnp.pi) + eps**2, axis=-1
+            )
+            ws.append(log_pxz + log_pz - log_qzx)
+        return jax.nn.logsumexp(jnp.stack(ws), axis=0) - jnp.log(float(n))
+
+    def generate(self, params, z):
+        """Decode latents to the data space (reference
+        generateAtMeanGivenZ)."""
+        mean, _ = self._decode(params, jnp.asarray(z, jnp.float32))
+        if self.reconstruction_distribution == "bernoulli":
+            return jax.nn.sigmoid(mean)
+        return mean

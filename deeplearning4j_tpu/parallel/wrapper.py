@@ -147,7 +147,22 @@ class ParallelInference:
                         slot["error"] = exc
                     done.set()
                 return
-            out = self._forward_padded(batch)
+            try:
+                out = self._forward_padded(batch)
+            except Exception:
+                # the COALESCED forward failed; one bad request can still be
+                # the cause (e.g. dtype promotion let concatenate succeed) —
+                # isolate per caller so valid requests sharing the window
+                # are not poisoned
+                if len(pending) == 1:
+                    raise
+                for feats, slot, done in pending:
+                    try:
+                        slot["result"] = self._forward_padded(feats)
+                    except Exception as exc:
+                        slot["error"] = exc
+                    done.set()
+                return
             i = 0
             for feats, slot, done in pending:
                 n = feats.shape[0]

@@ -322,6 +322,9 @@ class EarlyStoppingTrainer:
             def on_epoch_start(self, model, epoch):
                 pass
 
+            def on_fit_end(self, model):
+                pass
+
             def on_epoch_end(self, model, epoch):
                 pass
 

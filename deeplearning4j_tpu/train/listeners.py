@@ -25,6 +25,10 @@ class TrainingListener:
     def on_epoch_end(self, model, epoch: int) -> None:
         pass
 
+    def on_fit_end(self, model) -> None:
+        """Called once when a fit() call returns (all epochs done)."""
+        pass
+
 
 class ScoreIterationListener(TrainingListener):
     def __init__(self, print_every: int = 10):
@@ -282,9 +286,12 @@ class CheckpointListener(TrainingListener):
     def on_epoch_end(self, model, epoch):
         if self.every_epochs and (epoch + 1) % self.every_epochs == 0:
             self._save(model, model.iteration, epoch)
-        # fit() ends with the last epoch's on_epoch_end: landing any
-        # in-flight async save here means end-of-training never silently
-        # drops the final checkpoint (and surfaces background failures)
+
+    def on_fit_end(self, model):
+        # landing the in-flight async save when fit() returns means
+        # end-of-training never silently drops the final checkpoint (and
+        # surfaces background failures); DURING training only _save's own
+        # one-in-flight join runs, so epoch N's write overlaps epoch N+1
         self.flush()
 
     def __del__(self):

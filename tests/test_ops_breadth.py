@@ -1,0 +1,197 @@
+"""Breadth tests for the expanded op registry — the reference's
+declarable-op families (reduce3 distances, summary stats, index
+reductions, scatter, random, sequence, image, special math)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.ops_registry import OPS, get_op
+
+
+def _np(x):
+    return np.asarray(x)
+
+
+def test_reduce3_distances():
+    a = np.array([1.0, 0.0, 0.0], np.float32)
+    b = np.array([0.0, 1.0, 0.0], np.float32)
+    assert _np(OPS["cosine_similarity"](a, b)) == pytest.approx(0.0, abs=1e-6)
+    assert _np(OPS["cosine_distance"](a, b)) == pytest.approx(1.0, abs=1e-6)
+    assert _np(OPS["euclidean_distance"](a, b)) == pytest.approx(np.sqrt(2), abs=1e-6)
+    assert _np(OPS["manhattan_distance"](a, b)) == pytest.approx(2.0)
+    assert _np(OPS["hamming_distance"](a, b)) == pytest.approx(2.0)
+    assert _np(OPS["dot"](a, a)) == pytest.approx(1.0)
+    # jaccard on non-negative vectors: 1 - min/max
+    assert _np(OPS["jaccard_distance"](a, a)) == pytest.approx(0.0, abs=1e-6)
+
+
+def test_reduction_breadth():
+    x = np.array([[-1.0, 0.0, 2.0], [3.0, -4.0, 0.0]], np.float32)
+    assert _np(OPS["norm1"](x)) == pytest.approx(10.0)
+    assert _np(OPS["norm_max"](x)) == pytest.approx(4.0)
+    assert _np(OPS["squared_norm"](x)) == pytest.approx(1 + 4 + 9 + 16)
+    assert _np(OPS["count_nonzero"](x)) == pytest.approx(4.0)
+    assert _np(OPS["count_zero"](x)) == pytest.approx(2.0)
+    assert _np(OPS["amax"](x)) == pytest.approx(4.0)
+    assert _np(OPS["amin"](x)) == pytest.approx(0.0)
+    m = _np(OPS["moments"](x))
+    assert m[0] == pytest.approx(x.mean())
+    assert m[1] == pytest.approx(x.var())
+    p = np.array([0.5, 0.5], np.float32)
+    assert _np(OPS["entropy"](p)) == pytest.approx(np.log(2), abs=1e-6)
+    assert _np(OPS["shannon_entropy"](p)) == pytest.approx(1.0, abs=1e-6)
+    assert _np(OPS["median"](np.array([1.0, 3.0, 2.0]))) == pytest.approx(2.0)
+    assert _np(OPS["percentile"](np.arange(101.0), q=50)) == pytest.approx(50.0)
+
+
+def test_index_reductions():
+    x = np.array([1.0, -5.0, 3.0, 0.0], np.float32)
+    assert int(_np(OPS["iamax"](x))) == 1
+    assert int(_np(OPS["iamin"](x))) == 3
+    y = np.array([0.0, 0.0, 7.0, 0.0, 2.0], np.float32)
+    assert int(_np(OPS["first_index_nonzero"](y))) == 2
+    assert int(_np(OPS["last_index_nonzero"](y))) == 4
+    z = np.zeros(5, np.float32)
+    assert int(_np(OPS["first_index_nonzero"](z))) == -1
+    assert int(_np(OPS["last_index_nonzero"](z))) == -1
+
+
+def test_scatter_family():
+    ref = np.zeros((4, 2), np.float32)
+    idx = np.array([1, 3, 1])
+    upd = np.ones((3, 2), np.float32)
+    out = _np(OPS["scatter_add"](ref, idx, upd))
+    assert out[1].tolist() == [2.0, 2.0] and out[3].tolist() == [1.0, 1.0]
+    out = _np(OPS["scatter_update"](ref + 5.0, idx, upd))
+    assert out[1].tolist() == [1.0, 1.0] and out[0].tolist() == [5.0, 5.0]
+    out = _np(OPS["scatter_max"](ref + 0.5, np.array([0]), np.array([[9.0, 0.0]])))
+    assert out[0].tolist() == [9.0, 0.5]
+
+
+def test_gather_scatter_nd():
+    x = np.arange(12, dtype=np.float32).reshape(3, 4)
+    idx = np.array([[0, 1], [2, 3]])
+    assert _np(OPS["gather_nd"](x, idx)).tolist() == [1.0, 11.0]
+    out = _np(OPS["scatter_nd"](idx, np.array([5.0, 7.0], np.float32), shape=(3, 4)))
+    assert out[0, 1] == 5.0 and out[2, 3] == 7.0 and out.sum() == 12.0
+
+
+def test_random_family_deterministic():
+    a = _np(OPS["random_normal"](shape=(64,), seed=3, mean=1.0, std=2.0))
+    b = _np(OPS["random_normal"](shape=(64,), seed=3, mean=1.0, std=2.0))
+    np.testing.assert_array_equal(a, b)
+    u = _np(OPS["random_uniform"](shape=(256,), seed=1, minval=2.0, maxval=3.0))
+    assert u.min() >= 2.0 and u.max() <= 3.0
+    bern = _np(OPS["random_bernoulli"](shape=(1000,), seed=0, p=0.25))
+    assert 0.15 < bern.mean() < 0.35
+
+
+def test_creation_and_sequence_ops():
+    assert _np(OPS["eye"](n=3)).trace() == 3.0
+    assert _np(OPS["linspace"](start=0.0, stop=1.0, num=5)).tolist() == [
+        0.0, 0.25, 0.5, 0.75, 1.0]
+    assert _np(OPS["range"](start=0, limit=6, delta=2)).tolist() == [0.0, 2.0, 4.0]
+    assert _np(OPS["fill"](shape=(2, 2), value=7.0)).sum() == 28.0
+    mask = _np(OPS["sequence_mask"](np.array([1, 3]), maxlen=4))
+    assert mask.tolist() == [[1, 0, 0, 0], [1, 1, 1, 0]]
+    x = np.arange(8, dtype=np.float32).reshape(2, 4)
+    rev = _np(OPS["reverse_sequence"](x, np.array([2, 4])))
+    assert rev[0].tolist() == [1.0, 0.0, 2.0, 3.0]
+    assert rev[1].tolist() == [7.0, 6.0, 5.0, 4.0]
+
+
+def test_matrix_structure_ops():
+    x = np.arange(9, dtype=np.float32).reshape(3, 3)
+    band = _np(OPS["matrix_band_part"](x, lower=0, upper=0))
+    assert band.sum() == x.trace()
+    d = _np(OPS["matrix_diag"](np.array([1.0, 2.0])))
+    assert d.tolist() == [[1.0, 0.0], [0.0, 2.0]]
+    s = _np(OPS["matrix_set_diag"](np.zeros((2, 2), np.float32), np.array([3.0, 4.0])))
+    assert s[0, 0] == 3.0 and s[1, 1] == 4.0
+
+
+def test_hsv_round_trip_and_adjust():
+    rng = np.random.default_rng(0)
+    img = rng.uniform(0, 1, (2, 4, 4, 3)).astype(np.float32)
+    back = _np(OPS["hsv_to_rgb"](OPS["rgb_to_hsv"](img)))
+    np.testing.assert_allclose(back, img, atol=1e-5)
+    sat = _np(OPS["adjust_saturation"](img, factor=0.0))
+    # zero saturation -> grayscale: channels equal
+    np.testing.assert_allclose(sat[..., 0], sat[..., 1], atol=1e-5)
+    hue = _np(OPS["adjust_hue"](img, delta=1.0))   # full rotation = identity
+    np.testing.assert_allclose(hue, img, atol=1e-4)
+
+
+def test_crop_and_resize():
+    img = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+    boxes = np.array([[0.0, 0.0, 1.0, 1.0]], np.float32)     # whole image
+    out = _np(OPS["crop_and_resize"](img, boxes, np.array([0]), crop_size=(4, 4)))
+    np.testing.assert_allclose(out, img, atol=1e-5)
+    half = np.array([[0.0, 0.0, 0.0, 1.0]], np.float32)      # top row only
+    out = _np(OPS["crop_and_resize"](img, half, np.array([0]), crop_size=(1, 4)))
+    np.testing.assert_allclose(out[0, 0, :, 0], [0, 1, 2, 3], atol=1e-5)
+
+
+def test_non_max_suppression():
+    boxes = np.array(
+        [[0, 0, 1, 1], [0, 0, 1.05, 1.05], [2, 2, 3, 3]], np.float32
+    )
+    scores = np.array([0.9, 0.8, 0.7], np.float32)
+    sel = _np(OPS["non_max_suppression"](boxes, scores, max_output_size=3,
+                                         iou_threshold=0.5))
+    assert sel.tolist() == [0, 2, -1]
+
+
+def test_space_batch_round_trip():
+    x = np.random.default_rng(1).normal(size=(2, 4, 4, 3)).astype(np.float32)
+    s = OPS["space_to_batch"](x, block=2)
+    assert s.shape == (8, 2, 2, 3)
+    back = _np(OPS["batch_to_space"](s, block=2))
+    np.testing.assert_allclose(back, x, atol=1e-6)
+
+
+def test_confusion_matrix_and_misc():
+    cm = _np(OPS["confusion_matrix"](np.array([0, 1, 1]), np.array([0, 0, 1]),
+                                     num_classes=2))
+    assert cm.tolist() == [[1.0, 0.0], [1.0, 1.0]]
+    x = np.array([-2.0, 0.5, 3.0], np.float32)
+    assert _np(OPS["thresholded_relu"](x, theta=1.0)).tolist() == [0.0, 0.0, 3.0]
+    alpha = np.array([0.1], np.float32)
+    np.testing.assert_allclose(
+        _np(OPS["prelu"](x, alpha)), [-0.2, 0.5, 3.0], atol=1e-6
+    )
+    clipped = _np(OPS["clip_by_norm"](np.array([3.0, 4.0]), clip_norm=1.0))
+    assert np.linalg.norm(clipped) == pytest.approx(1.0, abs=1e-5)
+    st = _np(OPS["standardize"](np.array([[1.0, 2.0, 3.0]], np.float32)))
+    assert st.mean() == pytest.approx(0.0, abs=1e-5)
+
+
+def test_special_math():
+    import scipy.special as sp
+
+    x = np.array([0.5, 1.5, 3.0])
+    np.testing.assert_allclose(_np(OPS["lgamma"](x)), sp.gammaln(x), atol=1e-5)
+    np.testing.assert_allclose(_np(OPS["digamma"](x)), sp.psi(x), atol=1e-5)
+    np.testing.assert_allclose(
+        _np(OPS["igamma"](np.array(2.0), x)), sp.gammainc(2.0, x), atol=1e-5
+    )
+    assert _np(OPS["truncate_div"](np.array(7.0), np.array(2.0))) == 3.0
+
+
+def test_samediff_namespace_exposure():
+    from deeplearning4j_tpu.autodiff import SameDiff
+
+    sd = SameDiff()
+    a = sd.var("a", np.array([3.0, 4.0], np.float32))
+    b = sd.var("b", np.array([1.0, 0.0], np.float32))
+    d = sd.math.euclidean_distance(a, b)
+    assert float(d.eval()) == pytest.approx(np.sqrt(4 + 16))
+    r = sd.random.random_normal(shape=(4,), seed=1)
+    assert r.eval().shape == (4,)
+    m = sd.linalg.matrix_diag(a)
+    assert m.eval().shape == (2, 2)
+
+
+def test_get_op_unknown_raises():
+    with pytest.raises(KeyError):
+        get_op("definitely_not_an_op")

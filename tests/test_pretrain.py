@@ -1,0 +1,146 @@
+"""AutoEncoder / VariationalAutoencoder layerwise pretraining.
+
+Reference contract: MultiLayerNetwork.pretrain()/pretrainLayer() train
+BasePretrainNetwork layers (AutoEncoder, VariationalAutoencoder)
+unsupervised on features; the supervised forward then uses the encoder.
+"""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn import Adam
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    AutoEncoder,
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    VariationalAutoencoder,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+
+
+def blobs(n=256, d=8, seed=0):
+    rng = np.random.default_rng(seed)
+    cls = rng.integers(0, 2, n)
+    centers = np.stack([np.full(d, -1.0), np.full(d, 1.0)])
+    x = centers[cls] + rng.normal(0, 0.4, (n, d))
+    return x.astype(np.float32), np.eye(2, dtype=np.float32)[cls]
+
+
+def _conf(pretrain_layer, d=8):
+    return (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .updater(Adam(1e-2))
+        .list()
+        .layer(pretrain_layer)
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.feed_forward(d))
+        .build()
+    )
+
+
+def test_autoencoder_pretrain_reduces_loss():
+    x, _ = blobs()
+    ae = AutoEncoder(n_out=4, corruption_level=0.2, loss=Loss.MSE)
+    model = SequentialModel(_conf(ae)).init()
+    lp0 = model.params[model.conf.layers[0].name]
+    import jax
+
+    rng = jax.random.key(0)
+    before = float(ae.pretrain_loss(jax.tree.map(lambda a: a, lp0), x, rng))
+    model.pretrain_layer(0, (x, x[:, :2]), epochs=30, batch_size=128)
+    after = float(
+        ae.pretrain_loss(model.params[model.conf.layers[0].name], x, rng)
+    )
+    assert after < before * 0.7, (before, after)
+
+
+def test_autoencoder_reconstruction_error_separates_anomalies():
+    x, _ = blobs(n=128)
+    ae = AutoEncoder(n_out=4, corruption_level=0.0, loss=Loss.MSE)
+    model = SequentialModel(_conf(ae)).init()
+    model.pretrain_layer(0, (x, x[:, :2]), epochs=40, batch_size=128)
+    lp = model.params[model.conf.layers[0].name]
+    err_in = np.asarray(ae.reconstruction_error(lp, x)).mean()
+    anomalies = np.random.default_rng(3).normal(0, 4.0, (64, 8)).astype(np.float32)
+    err_out = np.asarray(ae.reconstruction_error(lp, anomalies)).mean()
+    assert err_out > err_in * 2, (err_in, err_out)
+
+
+def test_pretrain_then_finetune_end_to_end():
+    x, y = blobs()
+    ae = AutoEncoder(n_out=4, corruption_level=0.1)
+    model = SequentialModel(_conf(ae)).init()
+    model.pretrain((x, y), epochs=10, batch_size=128)
+    model.fit((x, y), epochs=20, batch_size=128)
+    acc = model.evaluate(DataSet(x, y)).accuracy()
+    assert acc > 0.9, acc
+
+
+@pytest.mark.parametrize("dist", ["gaussian", "bernoulli"])
+def test_vae_pretrain_elbo_improves(dist):
+    x, _ = blobs(d=6)
+    if dist == "bernoulli":
+        x = (x > 0).astype(np.float32)   # binarize for bernoulli likelihood
+    vae = VariationalAutoencoder(
+        n_out=3, encoder_layer_sizes=(16,), decoder_layer_sizes=(16,),
+        reconstruction_distribution=dist, num_samples=2,
+    )
+    model = SequentialModel(_conf(vae, d=6)).init()
+    import jax
+
+    rng = jax.random.key(1)
+    name = model.conf.layers[0].name
+    before = float(vae.pretrain_loss(model.params[name], x, rng))
+    model.pretrain_layer(0, (x, x[:, :2]), epochs=30, batch_size=128)
+    after = float(vae.pretrain_loss(model.params[name], x, rng))
+    assert after < before, (before, after)
+
+
+def test_vae_generate_and_log_prob_shapes():
+    import jax
+
+    x, _ = blobs(n=32, d=6)
+    vae = VariationalAutoencoder(
+        n_out=3, encoder_layer_sizes=(8,), decoder_layer_sizes=(8,),
+    )
+    model = SequentialModel(_conf(vae, d=6)).init()
+    name = model.conf.layers[0].name
+    lp = model.params[name]
+    z = np.random.default_rng(0).normal(size=(5, 3)).astype(np.float32)
+    out = vae.generate(lp, z)
+    assert out.shape == (5, 6)
+    logp = vae.reconstruction_log_probability(lp, x, jax.random.key(2), num_samples=3)
+    assert logp.shape == (32,)
+    assert np.all(np.isfinite(np.asarray(logp)))
+
+
+def test_vae_supervised_forward_is_latent_mean():
+    x, y = blobs(d=6)
+    vae = VariationalAutoencoder(
+        n_out=3, encoder_layer_sizes=(8,), decoder_layer_sizes=(8,),
+    )
+    model = SequentialModel(_conf(vae, d=6)).init()
+    out = model.output(x[:4])
+    assert out.shape == (4, 2)   # through the output layer
+    model.fit((x, y), epochs=5, batch_size=128)   # supervised training works too
+    assert np.isfinite(model.score_value)
+
+
+def test_pretrain_serde_round_trip():
+    from deeplearning4j_tpu.utils import serde
+
+    ae = AutoEncoder(n_out=4, corruption_level=0.25, sparsity=0.05,
+                     sparsity_beta=0.1, loss=Loss.RECONSTRUCTION_CROSSENTROPY)
+    vae = VariationalAutoencoder(
+        n_out=3, encoder_layer_sizes=(16, 8), decoder_layer_sizes=(8, 16),
+        reconstruction_distribution="bernoulli", num_samples=4,
+    )
+    for layer in (ae, vae):
+        back = serde.loads(serde.dumps(layer))
+        assert back == layer, (layer, back)
