@@ -22,6 +22,14 @@ from deeplearning4j_tpu.datavec.records import (
     LineRecordReader,
     ImageRecordReader,
 )
+from deeplearning4j_tpu.datavec.audio import (
+    SpectrogramRecordReader,
+    VideoRecordReader,
+    WavFileRecordReader,
+    read_wav,
+    spectrogram,
+    write_wav,
+)
 from deeplearning4j_tpu.datavec.schema import Schema, ColumnType
 from deeplearning4j_tpu.datavec.transform import TransformProcess
 from deeplearning4j_tpu.datavec.bridge import RecordReaderDataSetIterator
@@ -49,4 +57,10 @@ __all__ = [
     "ColumnType",
     "TransformProcess",
     "RecordReaderDataSetIterator",
+    "WavFileRecordReader",
+    "SpectrogramRecordReader",
+    "VideoRecordReader",
+    "read_wav",
+    "write_wav",
+    "spectrogram",
 ]

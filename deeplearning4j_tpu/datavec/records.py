@@ -265,12 +265,22 @@ class JDBCRecordReader(RecordReader):
             cur = self._conn.cursor()
             try:
                 # LIMIT 0 wrapper: cursor.description is populated without
-                # the server executing the full (possibly expensive) query
+                # the server executing the full (possibly expensive) query.
+                # Subquery alias is mandatory on PostgreSQL.
                 try:
                     cur.execute(
-                        f"SELECT * FROM ({self.query}) LIMIT 0", self.parameters
+                        f"SELECT * FROM ({self.query}) AS _cols LIMIT 0",
+                        self.parameters,
                     )
                 except Exception:
+                    # a failed statement can abort an open transaction
+                    # (PostgreSQL): roll back before the plain fallback
+                    try:
+                        self._conn.rollback()
+                    except Exception:
+                        pass
+                    cur.close()
+                    cur = self._conn.cursor()
                     cur.execute(self.query, self.parameters)
                 self._columns = [d[0] for d in cur.description]
             finally:

@@ -230,6 +230,23 @@ _LAYER_MAPPERS: Dict[str, Callable] = {
 }
 
 
+def register_keras_layer(class_name: str, mapper: Callable) -> None:
+    """Custom-layer registry (the reference's
+    KerasLayer.registerCustomLayer role): `mapper(config_dict, name) ->
+    LayerConfig | None` teaches the importer a Keras class it doesn't
+    know.  Returning None imports the layer as a structural no-op.
+    Registration is global; re-registering a name overrides it (including
+    built-ins, matching the reference's override semantics)."""
+    if not callable(mapper):
+        raise TypeError(f"mapper for {class_name!r} must be callable")
+    _LAYER_MAPPERS[class_name] = mapper
+
+
+def registered_keras_layers() -> tuple:
+    """Names the importer currently understands (diagnostics)."""
+    return tuple(sorted(_LAYER_MAPPERS))
+
+
 def _pair2d(v):
     # keras ZeroPadding2D padding int | (h,w) | ((t,b),(l,r)) → our (t,b,l,r)
     if isinstance(v, int):
@@ -387,7 +404,10 @@ def import_keras_model(path: str) -> SequentialModel:
             if shape is not None and input_type is None:
                 input_type = _itype_from_shape(shape)
             if cls not in _LAYER_MAPPERS:
-                raise KerasImportError(f"unsupported Keras layer {cls!r} ({name})")
+                raise KerasImportError(
+                    f"unsupported Keras layer {cls!r} ({name}); teach the "
+                    "importer with register_keras_layer(class_name, mapper)"
+                )
             mapped = _LAYER_MAPPERS[cls](cfg, name)
             if mapped is not None:
                 confs.append(mapped)
@@ -609,7 +629,10 @@ def import_keras_graph(path: str):
                 )
                 continue
             if cls not in _LAYER_MAPPERS:
-                raise KerasImportError(f"unsupported Keras layer {cls!r} ({name})")
+                raise KerasImportError(
+                    f"unsupported Keras layer {cls!r} ({name}); teach the "
+                    "importer with register_keras_layer(class_name, mapper)"
+                )
             mapped = _LAYER_MAPPERS[cls](lcfg, name)
             if mapped is None:           # Flatten etc.: structural no-op
                 if len(inputs) != 1:

@@ -262,6 +262,130 @@ class SequentialModel(Model):
             self._step_fns[key] = step
         return self._step_fns[key]
 
+    # -- compressed-gradient DP step (int8 allreduce over the data axis) ---
+    def _setup_grad_compression(self, mesh) -> None:
+        """Called by distribute(ParallelConfig(grad_compression="int8")):
+        switch fit() to the shard_map step that exchanges gradients as
+        error-feedback int8 (parallel/compression.py).  The residual
+        carries one slot per data shard (leading dim sharded on the data
+        axis)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        from deeplearning4j_tpu.runtime.mesh import DATA_AXIS
+
+        n = mesh.shape[DATA_AXIS]
+        if n < 2:
+            return
+        self._grad_compression = "int8"
+        self._grad_residual = jax.device_put(
+            jax.tree.map(
+                lambda p: jnp.zeros((n,) + p.shape, p.dtype), self.params
+            ),
+            NamedSharding(mesh, P(DATA_AXIS)),
+        )
+        self._step_fns.clear()
+
+    def _get_step_fn_compressed(self, has_lmask: bool, has_fmask: bool):
+        key = ("train_q", has_lmask, has_fmask)
+        if key not in self._step_fns:
+            from jax.sharding import PartitionSpec as P
+            from deeplearning4j_tpu.parallel.compression import (
+                quantized_allreduce_tree,
+            )
+            from deeplearning4j_tpu.runtime.mesh import DATA_AXIS
+
+            mesh = self._mesh
+
+            def shard_body(params, opt_state, net_state, resid, step_i,
+                           features, labels, lmask, fmask):
+                rng = SeedStream.fold(self._stream.root, step_i)
+                # per-shard dropout streams (each shard sees different data)
+                rng = jax.random.fold_in(rng, jax.lax.axis_index(DATA_AXIS))
+
+                def loss_fn(p):
+                    out, new_state = self._forward(
+                        p, net_state, features, training=True, rng=rng,
+                        fmask=fmask if has_fmask else None,
+                    )
+                    if self._custom_loss is not None:
+                        data_loss = self._custom_loss(
+                            out, labels, lmask if has_lmask else None
+                        )
+                    else:
+                        if not self._fused_loss:
+                            out = self._out_activation(out.astype(jnp.float32))
+                        data_loss = compute_loss(
+                            self._loss, out, labels,
+                            lmask if has_lmask else None,
+                            from_logits=self._fused_loss,
+                        )
+                    aux, new_state = pop_aux_losses(new_state)
+                    return data_loss + self._reg_loss(p) + aux, new_state
+
+                (loss, new_state), grads = jax.value_and_grad(
+                    loss_fn, has_aux=True
+                )(params)
+                resid_local = jax.tree.map(lambda a: a[0], resid)
+                grads, resid_local = quantized_allreduce_tree(
+                    grads, resid_local, axis=DATA_AXIS,
+                    key=jax.random.fold_in(rng, 0x51),
+                )
+                loss = jax.lax.pmean(loss, DATA_AXIS)
+                new_state = jax.tree.map(
+                    lambda a: jax.lax.pmean(a, DATA_AXIS), new_state
+                )
+                updates, new_opt = self._tx.update(grads, opt_state, params)
+                params = jax.tree.map(
+                    lambda p, u: p + u.astype(p.dtype), params, updates
+                )
+                merged = {**net_state, **new_state}
+                resid = jax.tree.map(lambda a: a[None], resid_local)
+                return params, new_opt, merged, resid, loss
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
+            def step(params, opt_state, net_state, resid, step_i,
+                     features, labels, lmask, fmask):
+                return jax.shard_map(
+                    shard_body,
+                    mesh=mesh,
+                    in_specs=(P(), P(), P(), P(DATA_AXIS), P(),
+                              P(DATA_AXIS), P(DATA_AXIS),
+                              P(DATA_AXIS) if has_lmask else P(),
+                              P(DATA_AXIS) if has_fmask else P()),
+                    out_specs=(P(), P(), P(), P(DATA_AXIS), P()),
+                    check_vma=False,
+                )(params, opt_state, net_state, resid, step_i,
+                  features, labels, lmask, fmask)
+
+            self._step_fns[key] = step
+        return self._step_fns[key]
+
+    def _run_step_compressed(self, batch: DataSet):
+        from deeplearning4j_tpu.parallel.data_parallel import place_batch
+        from deeplearning4j_tpu.runtime.crash import oom_report_scope
+        from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
+
+        has_lmask = batch.labels_mask is not None
+        has_fmask = batch.features_mask is not None
+        step = self._get_step_fn_compressed(has_lmask, has_fmask)
+        empty = np.zeros((0,), np.float32)
+        with oom_report_scope(), active_mesh_scope(self._mesh):
+            (self.params, self.opt_state, self.net_state,
+             self._grad_residual, loss) = step(
+                self.params,
+                self.opt_state,
+                self.net_state,
+                self._grad_residual,
+                jnp.uint32(self.iteration),
+                place_batch(self, batch.features),
+                place_batch(self, batch.labels, is_label=True),
+                place_batch(self, batch.labels_mask, is_mask=True) if has_lmask else empty,
+                place_batch(self, batch.features_mask, is_mask=True) if has_fmask else empty,
+            )
+        self._last_score = loss
+        self.last_batch_size = batch.num_examples
+        self.iteration += 1
+        self._dispatch_iteration(loss)
+
     def fit(self, data, epochs: int = 1, batch_size: int | None = None) -> None:
         if self.params is None:
             self.init()
@@ -283,6 +407,15 @@ class SequentialModel(Model):
     def fit_batch(self, batch: DataSet) -> None:
         if self.params is None:
             self.init()
+        if getattr(self, "_grad_compression", None):
+            if self.conf.backprop_type == "tbptt" and self.conf.tbptt_length > 0:
+                raise ValueError(
+                    "grad_compression does not compose with TBPTT "
+                    "(per-window carries cross the compressed-sync "
+                    "boundary); use standard backprop or drop compression"
+                )
+            self._run_step_compressed(batch)
+            return
         if self.conf.backprop_type == "tbptt" and self.conf.tbptt_length > 0:
             self._fit_batch_tbptt(batch)
             return

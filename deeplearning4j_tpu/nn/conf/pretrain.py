@@ -184,7 +184,7 @@ class VariationalAutoencoder(LayerConfig):
     def init(self, key, itype):
         n_in = itype.size
         winit = self._winit()
-        k_enc, k_mu, k_lv, k_dec, k_out = jax.random.split(key, 5)
+        k_enc, k_mu, k_lv, k_dec, k_out, k_out_lv = jax.random.split(key, 6)
         enc_sizes = (n_in,) + self.encoder_layer_sizes
         dec_sizes = (self.n_out,) + self.decoder_layer_sizes
         e_last, d_last = enc_sizes[-1], dec_sizes[-1]
@@ -199,7 +199,7 @@ class VariationalAutoencoder(LayerConfig):
             "b_out": jnp.zeros((n_in,), jnp.float32),
         }
         if self.reconstruction_distribution == "gaussian":
-            params["W_out_lv"] = winit.init(k_out, (d_last, n_in))
+            params["W_out_lv"] = winit.init(k_out_lv, (d_last, n_in))
             params["b_out_lv"] = jnp.zeros((n_in,), jnp.float32)
         return params, {}
 

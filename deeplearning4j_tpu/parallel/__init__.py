@@ -7,6 +7,10 @@ pipeline, sequence/context (ring attention, Ulysses) and expert parallel.
 """
 
 from deeplearning4j_tpu.parallel.data_parallel import distribute
+from deeplearning4j_tpu.parallel.pipeline import (
+    pipeline_apply,
+    pipeline_train_1f1b,
+)
 from deeplearning4j_tpu.parallel.strategy import ParallelConfig, param_specs
 from deeplearning4j_tpu.parallel.wrapper import ParallelInference, ParallelWrapper
 
@@ -16,4 +20,6 @@ __all__ = [
     "param_specs",
     "ParallelWrapper",
     "ParallelInference",
+    "pipeline_apply",
+    "pipeline_train_1f1b",
 ]

@@ -70,6 +70,32 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
             )
         model._setup_pipeline(mesh, config.microbatches)
 
+    if config.grad_compression not in ("none", "int8"):
+        raise ValueError(
+            f"unknown grad_compression {config.grad_compression!r}; "
+            "options: 'none', 'int8'"
+        )
+    # re-distribution must not inherit stale compression state (a prior
+    # distribute() with compression would otherwise keep quantizing, with
+    # a residual shaped for the OLD mesh)
+    if getattr(model, "_grad_compression", None):
+        model._grad_compression = None
+        model._grad_residual = None
+    if config.grad_compression != "none":
+        sp_on = SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1
+        if tp or ep or pp or sp_on:
+            raise ValueError(
+                "grad_compression composes with pure data parallelism only "
+                "(the reference's compression was DP-only too); drop the "
+                "model/pipe/seq/expert axes or the compression"
+            )
+        if not hasattr(model, "_setup_grad_compression"):
+            raise NotImplementedError(
+                f"{type(model).__name__} does not support compressed-"
+                "gradient training"
+            )
+        model._setup_grad_compression(mesh)
+
     sp = SEQ_AXIS if SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1 else None
     model._mesh = mesh
     # drop any step functions compiled before distribution: mesh-dependent

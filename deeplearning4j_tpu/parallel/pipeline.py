@@ -81,6 +81,121 @@ def pipeline_apply(
     return outputs
 
 
+def pipeline_train_1f1b(
+    stage_fn: Callable,
+    stage_params,
+    x_micro: jax.Array,
+    loss_grad_fn: Callable,
+    *,
+    axis: str,
+):
+    """One-forward-one-backward (1F1B) training schedule in a single scan.
+
+    GPipe (`pipeline_apply` + jax.grad) lets XLA transpose the forward
+    scan, which stashes one stage-input per microbatch — O(n_micro)
+    activation memory per device.  1F1B interleaves each microbatch's
+    backward as soon as the last stage finishes its forward, so a stage
+    input only lives for the ticks its backward takes to arrive: the
+    stash here is a static ring of 2*n_stages-1 slots, O(n_stages) —
+    microbatch count no longer affects activation memory, which is what
+    makes deep-pipeline long-batch training fit in HBM.
+
+    Schedule (stage s, microbatch m, k stages):
+      forward  of m on s at tick  m + s
+      loss+∂   of m on k-1 at tick m + k - 1  (fwd then bwd, same tick)
+      backward of m on s at tick  m + 2(k-1) - s
+    Total ticks: n_micro + 2k - 2.  Both the +1 (activations) and -1
+    (cotangents) ppermute rings run every tick; each device does at most
+    one forward and one backward compute per tick — the 1F1B steady state.
+
+    Args:
+      stage_fn(params, h) -> h' — the stage transform (shape-preserving).
+      stage_params — the LOCAL stage's params (sharded by shard_map).
+      x_micro — (n_micro, B_micro, ...) microbatches (stage 0 feeds them).
+      loss_grad_fn(y, m) -> (loss_m, dL/dy) — evaluated on the LAST
+        stage's output for microbatch index m (close over labels).
+    Returns (mean_loss, stage_grads, dx_micro): loss averaged over
+    microbatches (same on all devices), the LOCAL stage's param
+    gradients (sum over microbatches), and dL/dx per microbatch
+    (valid on every device via psum — feeds backprop of layers before
+    the segment).
+    """
+    n_stages = lax.axis_size(axis)
+    stage = lax.axis_index(axis)
+    n_micro = x_micro.shape[0]
+    total = n_micro + 2 * n_stages - 2
+    stash_n = 2 * n_stages - 1
+    fwd_perm = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    bwd_perm = [((i + 1) % n_stages, i) for i in range(n_stages)]
+
+    buf_shape = x_micro.shape[1:]
+    zero_buf = jnp.zeros(buf_shape, x_micro.dtype)
+    carry = dict(
+        fwd=zero_buf,                                  # activation arriving
+        bwd=zero_buf,                                  # cotangent arriving
+        stash=jnp.zeros((stash_n,) + buf_shape, x_micro.dtype),
+        grads=jax.tree.map(jnp.zeros_like, stage_params),
+        loss=jnp.zeros((), jnp.float32),
+        dx=jnp.zeros((n_micro,) + buf_shape, x_micro.dtype),
+    )
+
+    def tick(c, t):
+        # ---- forward: microbatch m_f = t - stage ----
+        m_f = t - stage
+        fwd_valid = (m_f >= 0) & (m_f < n_micro)
+        feed = x_micro[jnp.clip(m_f, 0, n_micro - 1)]
+        h_in = jnp.where(stage == 0, feed, c["fwd"])
+        stash = lax.dynamic_update_index_in_dim(
+            c["stash"], jnp.where(fwd_valid, h_in, 0.0),
+            jnp.clip(m_f, 0, n_micro - 1) % stash_n, axis=0,
+        )
+        stash = jnp.where(fwd_valid, stash, c["stash"])
+        y = stage_fn(stage_params, h_in)
+
+        # ---- last stage: loss + seed cotangent, same tick ----
+        loss_m, g_seed = loss_grad_fn(y, jnp.clip(m_f, 0, n_micro - 1))
+        is_last = stage == n_stages - 1
+        seed_now = is_last & fwd_valid
+        loss = c["loss"] + jnp.where(seed_now, loss_m, 0.0)
+
+        # ---- backward: microbatch m_b = t - 2(k-1) + stage ----
+        m_b = t - 2 * (n_stages - 1) + stage
+        bwd_valid = (m_b >= 0) & (m_b < n_micro)
+        g_in = jnp.where(seed_now, g_seed.astype(x_micro.dtype), c["bwd"])
+        h_saved = stash[jnp.clip(m_b, 0, n_micro - 1) % stash_n]
+        _, vjp = jax.vjp(stage_fn, stage_params, h_saved)
+        dp, dh = vjp(g_in)
+        live = jnp.where(bwd_valid, 1.0, 0.0).astype(x_micro.dtype)
+        grads = jax.tree.map(
+            lambda a, d: a + d.astype(a.dtype) * live, c["grads"], dp
+        )
+        # stage 0's dh is dL/dx for microbatch m_b
+        dx = lax.dynamic_update_index_in_dim(
+            c["dx"],
+            jnp.where((stage == 0) & bwd_valid, dh, 0.0),
+            jnp.clip(m_b, 0, n_micro - 1),
+            axis=0,
+        )
+
+        return dict(
+            fwd=lax.ppermute(y, axis, fwd_perm),
+            bwd=lax.ppermute(dh * live, axis, bwd_perm),
+            stash=stash,
+            grads=grads,
+            loss=loss,
+            dx=dx,
+        ), None
+
+    c, _ = lax.scan(tick, carry, jnp.arange(total))
+    mean_loss = lax.psum(
+        jnp.where(stage == n_stages - 1, c["loss"], 0.0), axis
+    ) / n_micro
+    # objective is the MEAN over microbatches: scale both grad outputs
+    dx_micro = lax.psum(c["dx"], axis) / n_micro
+    grads = jax.tree.map(lambda a: a / n_micro, c["grads"])
+    return mean_loss, grads, dx_micro
+
+
 def split_microbatches(x: jax.Array, n_micro: int) -> jax.Array:
     """(B, ...) -> (n_micro, B/n_micro, ...)."""
     b = x.shape[0]

@@ -45,6 +45,10 @@ class ParallelConfig:
     # microbatches per global batch under pipeline parallelism
     # (0 = auto: 2*pipe, a reasonable bubble amortization)
     microbatches: int = 0
+    # "int8": error-feedback quantized gradient allreduce on the data
+    # axis (the DCN-bandwidth play; see parallel/compression.py).
+    # "none": full-precision GSPMD AllReduce (always right over ICI).
+    grad_compression: str = "none"
 
     def mesh_spec(self) -> MeshSpec:
         # the data axis is ALWAYS present (size 1 degrades gracefully) so

@@ -1,0 +1,214 @@
+"""int8 quantized gradient allreduce with error feedback — the
+gradient-compression role over the data axis."""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from deeplearning4j_tpu.parallel.compression import (
+    quantized_allreduce_tree,
+    quantized_psum,
+    zeros_residual,
+)
+from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh
+
+N = 4
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(MeshSpec.of(data=N), jax.devices()[:N])
+
+
+def _psum_mean(mesh, x_shards, key_seed=0):
+    f = jax.jit(
+        jax.shard_map(
+            lambda x: quantized_psum(
+                x[0], axis="data", key=jax.random.key(key_seed)
+            )[0][None],
+            mesh=mesh, in_specs=P("data"), out_specs=P("data"),
+            check_vma=False,
+        )
+    )
+    return np.asarray(f(x_shards))
+
+
+def test_quantized_psum_approximates_mean(mesh):
+    rng = np.random.default_rng(0)
+    shards = jnp.asarray(rng.normal(0, 1, (N, 64)).astype(np.float32))
+    out = _psum_mean(mesh, shards)
+    exact = np.asarray(shards).mean(axis=0)
+    # every shard got the same answer
+    for i in range(1, N):
+        np.testing.assert_array_equal(out[i], out[0])
+    # int8 lattice error: |err| <= N * scale/2-ish; scale ~= absmax/127
+    tol = np.abs(np.asarray(shards)).max() / 127.0 * 1.5
+    np.testing.assert_allclose(out[0], exact, atol=tol)
+
+
+def test_quantization_unbiased(mesh):
+    """Stochastic rounding: the mean over many keys converges to the
+    exact value (bias would wreck error feedback)."""
+    rng = np.random.default_rng(1)
+    shards = jnp.asarray(rng.normal(0, 1, (N, 32)).astype(np.float32))
+    exact = np.asarray(shards).mean(axis=0)
+    acc = np.zeros(32, np.float64)
+    reps = 200
+    for s in range(reps):
+        acc += _psum_mean(mesh, shards, key_seed=s)[0]
+    np.testing.assert_allclose(acc / reps, exact, atol=2e-3)
+
+
+def test_error_feedback_residual_bounded(mesh):
+    """Residual = exactly what quantization dropped this round."""
+    rng = np.random.default_rng(2)
+    g = jnp.asarray(rng.normal(0, 1, (N, 16)).astype(np.float32))
+
+    def body(g, r):
+        synced, new_r = quantized_allreduce_tree(
+            {"w": g[0]}, {"w": r[0]}, axis="data", key=jax.random.key(7)
+        )
+        return synced["w"][None], new_r["w"][None]
+
+    f = jax.jit(jax.shard_map(
+        body, mesh=mesh, in_specs=(P("data"), P("data")),
+        out_specs=(P("data"), P("data")), check_vma=False,
+    ))
+    synced, resid = f(g, jnp.zeros_like(g))
+    scale = np.abs(np.asarray(g)).max() / 127.0
+    assert np.abs(np.asarray(resid)).max() <= scale + 1e-6
+
+
+def test_compressed_sgd_matches_exact_convergence(mesh):
+    """Least-squares by DP SGD: int8+error-feedback reaches the same
+    optimum as exact f32 allreduce."""
+    rng = np.random.default_rng(3)
+    d = 8
+    w_true = rng.normal(0, 1, d).astype(np.float32)
+    X = rng.normal(0, 1, (N * 32, d)).astype(np.float32)
+    y = X @ w_true
+    Xs = jnp.asarray(X.reshape(N, 32, d))
+    ys = jnp.asarray(y.reshape(N, 32))
+
+    def run(compressed: bool, steps=300, lr=0.05):
+        def body(w, r, xb, yb, key):
+            g = jax.grad(
+                lambda w: jnp.mean((xb[0] @ w - yb[0]) ** 2)
+            )(w)
+            if compressed:
+                synced, new_r = quantized_allreduce_tree(
+                    {"w": g}, {"w": r[0]}, axis="data", key=key[0]
+                )
+                return w - lr * synced["w"], new_r["w"][None]
+            return w - lr * jax.lax.pmean(g, "data"), r
+
+        f = jax.jit(jax.shard_map(
+            body, mesh=mesh,
+            in_specs=(P(), P("data"), P("data"), P("data"), P("data")),
+            out_specs=(P(), P("data")), check_vma=False,
+        ))
+        w = jnp.zeros(d, jnp.float32)
+        r = jnp.zeros((N, d), jnp.float32)
+        for s in range(steps):
+            keys = jax.random.split(jax.random.key(s), N)
+            w, r = f(w, r, Xs, ys, keys)
+        return np.asarray(w)
+
+    w_exact = run(False)
+    w_q = run(True)
+    np.testing.assert_allclose(w_exact, w_true, atol=1e-3)
+    np.testing.assert_allclose(w_q, w_true, atol=5e-3)
+
+
+class TestModelIntegration:
+    def _model(self, seed=9):
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn import Sgd
+        from deeplearning4j_tpu.nn.activations import Activation
+        from deeplearning4j_tpu.nn.conf import (
+            Dense, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.losses import Loss
+
+        conf = (
+            NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.1))
+            .list()
+            .layer(Dense(n_out=16, activation=Activation.TANH))
+            .layer(OutputLayer(n_out=2, loss=Loss.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(4))
+            .build()
+        )
+        return SequentialModel(conf).init()
+
+    def _data(self, n=256):
+        from deeplearning4j_tpu.data import DataSet
+
+        rng = np.random.default_rng(4)
+        cls = rng.integers(0, 2, n)
+        x = rng.normal(0, 0.5, (n, 4)).astype(np.float32) + cls[:, None]
+        return DataSet(x, np.eye(2, dtype=np.float32)[cls])
+
+    def test_compressed_fit_learns(self, mesh):
+        from deeplearning4j_tpu.parallel import ParallelConfig, distribute
+
+        model = self._model()
+        distribute(model, ParallelConfig(data=N, grad_compression="int8"),
+                   devices=jax.devices()[:N])
+        ds = self._data()
+        model.fit(ds, epochs=30, batch_size=64)
+        acc = model.evaluate(ds).accuracy()
+        assert acc > 0.95, acc
+
+    def test_compressed_tracks_exact(self, mesh):
+        from deeplearning4j_tpu.parallel import ParallelConfig, distribute
+
+        ds = self._data()
+        exact = self._model()
+        distribute(exact, ParallelConfig(data=N), devices=jax.devices()[:N])
+        exact.fit(ds, epochs=10, batch_size=64)
+
+        comp = self._model()
+        distribute(comp, ParallelConfig(data=N, grad_compression="int8"),
+                   devices=jax.devices()[:N])
+        comp.fit(ds, epochs=10, batch_size=64)
+        # same data order + error feedback: scores stay close
+        assert abs(exact.score_value - comp.score_value) < 0.05, (
+            exact.score_value, comp.score_value,
+        )
+
+    def test_compression_rejects_tensor_parallel(self):
+        from deeplearning4j_tpu.parallel import ParallelConfig, distribute
+
+        model = self._model()
+        with pytest.raises(ValueError, match="pure data parallelism"):
+            distribute(
+                model,
+                ParallelConfig(data=2, model=2, grad_compression="int8"),
+                devices=jax.devices()[:4],
+            )
+
+    def test_unknown_compression_rejected(self):
+        from deeplearning4j_tpu.parallel import ParallelConfig, distribute
+
+        model = self._model()
+        with pytest.raises(ValueError, match="unknown grad_compression"):
+            distribute(model, ParallelConfig(grad_compression="fp4"))
+
+    def test_redistribute_clears_compression(self, mesh):
+        """distribute() without compression after a compressed distribute()
+        must drop the quantized path and its stale residual."""
+        from deeplearning4j_tpu.parallel import ParallelConfig, distribute
+
+        model = self._model()
+        distribute(model, ParallelConfig(data=N, grad_compression="int8"),
+                   devices=jax.devices()[:N])
+        assert getattr(model, "_grad_compression", None) == "int8"
+        distribute(model, ParallelConfig(data=2), devices=jax.devices()[:2])
+        assert getattr(model, "_grad_compression", None) is None
+        model.fit(self._data(), epochs=1, batch_size=64)   # exact path runs
+        assert np.isfinite(model.score_value)

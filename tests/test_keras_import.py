@@ -355,3 +355,69 @@ class TestErrorPaths:
         km.save_weights(p)
         with pytest.raises(KerasImportError, match="model_config"):
             import_keras_model(p)
+
+
+class TestCustomLayerRegistry:
+    def test_register_custom_layer_maps_and_imports(self, tmp_path):
+        """A Keras Lambda-style custom class the importer doesn't know is
+        taught via register_keras_layer (the reference's
+        KerasLayer.registerCustomLayer role)."""
+        from deeplearning4j_tpu.modelimport.keras import (
+            register_keras_layer,
+            registered_keras_layers,
+        )
+        from deeplearning4j_tpu.nn.activations import Activation
+        from deeplearning4j_tpu.nn.conf import ActivationLayer
+
+        @keras.utils.register_keras_serializable(package="test")
+        class DoubleRelu(keras.layers.Layer):
+            def call(self, x):
+                return tf.nn.relu(x) * 2.0
+
+        km = keras.Sequential(
+            [
+                keras.layers.Input((4,)),
+                keras.layers.Dense(6, activation="linear"),
+                DoubleRelu(),
+                keras.layers.Dense(2, activation="softmax"),
+            ]
+        )
+        km.compile(loss="categorical_crossentropy", optimizer="adam")
+        path = save_h5(km, tmp_path)
+
+        with pytest.raises(KerasImportError, match="register_keras_layer"):
+            import_keras_model(path)
+
+        import dataclasses
+        from deeplearning4j_tpu.nn.conf.layers import LayerConfig
+        from deeplearning4j_tpu.utils import serde
+        import jax.numpy as jnp
+
+        @serde.register
+        @dataclasses.dataclass(frozen=True)
+        class DoubleReluLayer(LayerConfig):
+            HAS_PARAMS = False
+            REGULARIZED = ()
+
+            def apply(self, params, state, x, *, training=False, rng=None):
+                return jnp.maximum(x, 0.0) * 2.0, state
+
+        # keras serializes registered custom classes as "package>Class"
+        register_keras_layer(
+            "test>DoubleRelu", lambda cfg, name: DoubleReluLayer(name=name)
+        )
+        try:
+            assert "test>DoubleRelu" in registered_keras_layers()
+            ours = import_keras_model(path)
+            x = np.random.default_rng(1).normal(size=(5, 4)).astype(np.float32)
+            assert_outputs_match(km, ours, x)
+        finally:
+            from deeplearning4j_tpu.modelimport.keras import _LAYER_MAPPERS
+
+            _LAYER_MAPPERS.pop("test>DoubleRelu", None)
+
+    def test_register_rejects_non_callable(self):
+        from deeplearning4j_tpu.modelimport.keras import register_keras_layer
+
+        with pytest.raises(TypeError):
+            register_keras_layer("X", "not-a-function")

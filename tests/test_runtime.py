@@ -40,3 +40,36 @@ def test_seed_stream_deterministic():
     np.testing.assert_array_equal(np.asarray(ka), np.asarray(kb))
     kc = jax.random.normal(a.key("layer1"), (4,))
     assert not np.allclose(np.asarray(ka), np.asarray(kc))
+
+
+class TestDonationGuard:
+    """SURVEY §5.2 donation-after-use guard: fit_batch donates the param/
+    opt-state buffers into the compiled step; a stale reference held from
+    before the step must fail LOUDLY (the PJRT deleted-buffer guard), not
+    read garbage."""
+
+    def test_stale_params_reference_raises_after_step(self):
+        import numpy as np
+        import jax
+        import pytest as _pytest
+
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn.conf import (
+            Dense, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+
+        conf = (
+            NeuralNetConfiguration.builder().list()
+            .layer(Dense(n_out=4)).layer(OutputLayer(n_out=2))
+            .set_input_type(InputType.feed_forward(3)).build()
+        )
+        m = SequentialModel(conf).init()
+        stale = jax.tree.leaves(m.params)[0]
+        x = np.zeros((8, 3), np.float32)
+        y = np.eye(2, dtype=np.float32)[np.zeros(8, int)]
+        m.fit_batch(DataSet(x, y))
+        with _pytest.raises(RuntimeError, match="deleted|donated"):
+            np.asarray(stale)
+        # the LIVE handle still works — only the donated buffer is dead
+        assert np.isfinite(np.asarray(jax.tree.leaves(m.params)[0])).all()
