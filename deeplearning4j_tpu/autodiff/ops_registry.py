@@ -22,8 +22,10 @@ def _conv2d(x, w, *, stride=(1, 1), padding="SAME", dilation=(1, 1)):
 
 
 def _max_pool2d(x, *, kernel=(2, 2), stride=(2, 2), padding="VALID"):
+    from deeplearning4j_tpu.runtime.backend import maxpool_fusion_barrier
+
     return jax.lax.reduce_window(
-        x, -jnp.inf, jax.lax.max,
+        maxpool_fusion_barrier(x), -jnp.inf, jax.lax.max,
         (1, *kernel, 1), (1, *stride, 1), padding,
     )
 
@@ -410,6 +412,18 @@ def _standardize(x, *, axis=-1, epsilon=1e-5):
     return (x - mean) * jax.lax.rsqrt(var + epsilon)
 
 
+def _lrn(x, *, size=5, alpha=1e-4, beta=0.75, bias=2.0):
+    """Local response normalization across the TRAILING (channel) axis
+    (channels-last; the ONNX/reference op normalizes across C)."""
+    sq = jnp.square(x)
+    half = size // 2
+    pad = [(0, 0)] * (x.ndim - 1) + [(half, size - 1 - half)]
+    cs = jnp.cumsum(jnp.pad(sq, pad), axis=-1)
+    cs = jnp.pad(cs, [(0, 0)] * (x.ndim - 1) + [(1, 0)])
+    win = cs[..., size:] - cs[..., :-size]
+    return x / (bias + (alpha / size) * win) ** beta
+
+
 def _clip_by_norm(x, *, clip_norm, axis=None):
     n = jnp.sqrt(jnp.sum(jnp.square(x), axis=_ax(axis), keepdims=True))
     return jnp.where(n > clip_norm, x * clip_norm / jnp.maximum(n, 1e-12), x)
@@ -745,6 +759,8 @@ OPS: dict[str, callable] = {
     "non_max_suppression": _non_max_suppression,
     "space_to_batch": _space_to_batch,
     "batch_to_space": _batch_to_space,
+    "broadcast_to": lambda x, *, shape: jnp.broadcast_to(x, tuple(shape)),
+    "lrn": _lrn,
     # nn / misc breadth
     "prelu": lambda x, alpha: jnp.where(x >= 0, x, alpha * x),
     "thresholded_relu": lambda x, *, theta=1.0: jnp.where(x > theta, x, 0.0),

@@ -135,9 +135,6 @@ class WavFileRecordReader(RecordReader):
 
     def initialize(self, root: str | os.PathLike) -> "WavFileRecordReader":
         root = Path(root)
-        gated = sorted(
-            p for p in root.rglob("*") if p.suffix.lower() in _GATED_EXTS
-        )
         # one case-normalized walk: no duplicates on case-insensitive
         # filesystems, no misses on mixed-case extensions
         self._files = sorted(
@@ -145,6 +142,9 @@ class WavFileRecordReader(RecordReader):
             if p.is_file() and p.suffix.lower() == ".wav"
         )
         if not self._files:
+            gated = sorted(
+                p for p in root.rglob("*") if p.suffix.lower() in _GATED_EXTS
+            )
             if gated:
                 raise ValueError(
                     f"only compressed audio ({gated[0].suffix}, ...) found "

@@ -579,6 +579,358 @@ class _Importer:
         self._emit(node, "layer_norm", x, scale, bias, epsilon=float(eps))
 
 
+    # -- opset breadth: elementwise / trig ----------------------------------
+    def op_Floor(self, node):
+        self._unop(node, "floor")
+
+    def op_Ceil(self, node):
+        self._unop(node, "ceil")
+
+    def op_Round(self, node):
+        self._unop(node, "round")
+
+    def op_Sign(self, node):
+        self._unop(node, "sign")
+
+    def op_Sin(self, node):
+        self._unop(node, "sin")
+
+    def op_Cos(self, node):
+        self._unop(node, "cos")
+
+    def op_Tan(self, node):
+        self._unop(node, "tan")
+
+    def op_Asin(self, node):
+        self._unop(node, "asin")
+
+    def op_Acos(self, node):
+        self._unop(node, "acos")
+
+    def op_Atan(self, node):
+        self._unop(node, "atan")
+
+    def op_Sinh(self, node):
+        self._unop(node, "sinh")
+
+    def op_Cosh(self, node):
+        self._unop(node, "cosh")
+
+    def op_Asinh(self, node):
+        self._unop(node, "asinh")
+
+    def op_Acosh(self, node):
+        self._unop(node, "acosh")
+
+    def op_Atanh(self, node):
+        self._unop(node, "atanh")
+
+    def op_HardSigmoid(self, node):
+        a = _attrs(node)
+        alpha, beta = a.get("alpha", 0.2), a.get("beta", 0.5)
+        x = self.in_var(node.input[0])
+        self._alias(node, self.sd.apply(
+            "clip", x * float(alpha) + float(beta), lo=0.0, hi=1.0
+        ))
+
+    def op_HardSwish(self, node):
+        x = self.in_var(node.input[0])
+        gate = self.sd.apply("clip", x * (1.0 / 6.0) + 0.5, lo=0.0, hi=1.0)
+        self._alias(node, x * gate)
+
+    def op_PRelu(self, node):
+        self._emit(node, "prelu", self.in_var(node.input[0]),
+                   self.in_var(node.input[1]))
+
+    def op_Selu(self, node):
+        a = _attrs(node)
+        # jax.nn.selu IS the ONNX default parameterization
+        if abs(a.get("alpha", 1.6732632) - 1.6732632) > 1e-4 or abs(
+            a.get("gamma", 1.0507010) - 1.0507010
+        ) > 1e-4:
+            raise ONNXImportError("Selu with non-default alpha/gamma unmapped")
+        self._unop(node, "selu")
+
+    def op_Mish(self, node):
+        self._unop(node, "mish")
+
+    def op_Softsign(self, node):
+        self._unop(node, "softsign")
+
+    def op_ThresholdedRelu(self, node):
+        self._unop(node, "thresholded_relu",
+                   theta=float(_attrs(node).get("alpha", 1.0)))
+
+    def op_Not(self, node):
+        self._unop(node, "logical_not")
+
+    def op_And(self, node):
+        self._binop(node, "logical_and")
+
+    def op_Or(self, node):
+        self._binop(node, "logical_or")
+
+    def op_Xor(self, node):
+        x = self.in_var(node.input[0])
+        y = self.in_var(node.input[1])
+        self._alias(node, self.sd.apply("not_equal", x, y))
+
+    def op_Mod(self, node):
+        op = "truncate_div" if _attrs(node).get("fmod") else None
+        x, y = self.in_var(node.input[0]), self.in_var(node.input[1])
+        if op:   # fmod: x - trunc(x/y)*y
+            self._alias(node, x - self.sd.apply("truncate_div", x, y) * y)
+        else:
+            self._emit(node, "floor_mod", x, y)
+
+    def op_GreaterOrEqual(self, node):
+        self._binop(node, "greater_equal")
+
+    def op_LessOrEqual(self, node):
+        self._binop(node, "less_equal")
+
+    def op_Sum(self, node):
+        y = self.in_var(node.input[0])
+        for n in node.input[1:]:
+            y = y + self.in_var(n)
+        self._alias(node, y)
+
+    def op_Mean(self, node):
+        y = self.in_var(node.input[0])
+        for n in node.input[1:]:
+            y = y + self.in_var(n)
+        self._alias(node, y * (1.0 / len(node.input)))
+
+    # -- opset breadth: reductions / indices --------------------------------
+    def op_ReduceProd(self, node):
+        self._reduce(node, "prod")
+
+    def op_ReduceL1(self, node):
+        self._reduce(node, "norm1")
+
+    def op_ReduceL2(self, node):
+        a = _attrs(node)
+        axes = a.get("axes")
+        if axes is None and len(node.input) > 1 and node.input[1]:
+            axes = [int(v) for v in self.static_value(node.input[1])]
+        keepdims = bool(a.get("keepdims", 1))
+        sq = self.sd.apply(
+            "squared_norm", self.in_var(node.input[0]),
+            axis=[int(x) for x in axes] if axes is not None else None,
+            keepdims=keepdims,
+        )
+        self._alias(node, self.sd.apply("sqrt", sq))
+
+    def op_ReduceLogSumExp(self, node):
+        self._reduce(node, "logsumexp")
+
+    def _argreduce(self, node, op):
+        a = _attrs(node)
+        axis = int(a.get("axis", 0))
+        y = self.sd.apply(op, self.in_var(node.input[0]), axis=axis)
+        if a.get("keepdims", 1):
+            y = self.sd.apply("expand_dims", y, axis=axis)
+        self._alias(node, self.sd.apply("cast", y, dtype="int32"))
+
+    def op_ArgMax(self, node):
+        if _attrs(node).get("select_last_index"):
+            raise ONNXImportError("ArgMax select_last_index unmapped")
+        self._argreduce(node, "argmax")
+
+    def op_ArgMin(self, node):
+        if _attrs(node).get("select_last_index"):
+            raise ONNXImportError("ArgMin select_last_index unmapped")
+        self._argreduce(node, "argmin")
+
+    def op_CumSum(self, node):
+        a = _attrs(node)
+        if a.get("exclusive") or a.get("reverse"):
+            raise ONNXImportError("CumSum exclusive/reverse unmapped")
+        axis = int(self.static_value(node.input[1]))
+        self._emit(node, "cumsum", self.in_var(node.input[0]), axis=axis)
+
+    def op_Einsum(self, node):
+        eq = _attrs(node)["equation"]
+        eq = eq.decode() if isinstance(eq, bytes) else eq
+        self._emit(node, "einsum", *[self.in_var(n) for n in node.input],
+                   equation=eq)
+
+    def op_TopK(self, node):
+        a = _attrs(node)
+        if not a.get("largest", 1) or not a.get("sorted", 1):
+            raise ONNXImportError("TopK smallest/unsorted unmapped")
+        if int(a.get("axis", -1)) not in (-1,):
+            raise ONNXImportError("TopK mapped for axis=-1 only")
+        k = int(np.asarray(self.static_value(node.input[1])).reshape(-1)[0])
+        x = self.in_var(node.input[0])
+        self.vars[node.output[0]] = self.sd.apply(
+            "top_k_values", x, name=node.output[0], k=k
+        )
+        if len(node.output) > 1 and node.output[1]:
+            self.vars[node.output[1]] = self.sd.apply(
+                "top_k_indices", x, name=node.output[1], k=k
+            )
+
+    # -- opset breadth: shape / structure -----------------------------------
+    def op_Expand(self, node):
+        shape = [int(s) for s in self.static_value(node.input[1])]
+        self._emit(node, "broadcast_to", self.in_var(node.input[0]),
+                   shape=shape)
+
+    def op_ConstantOfShape(self, node):
+        a = _attrs(node)
+        shape = [int(s) for s in self.static_value(node.input[0])]
+        value = a.get("value")
+        fill = float(np.asarray(value).reshape(-1)[0]) if value is not None else 0.0
+        self.consts[node.output[0]] = np.full(shape, fill, np.float32)
+
+    def op_Range(self, node):
+        start = float(self.static_value(node.input[0]))
+        limit = float(self.static_value(node.input[1]))
+        delta = float(self.static_value(node.input[2]))
+        self.consts[node.output[0]] = np.arange(start, limit, delta,
+                                                dtype=np.float32)
+
+    def op_Split(self, node):
+        a = _attrs(node)
+        axis = int(a.get("axis", 0))
+        x = self.in_var(node.input[0])
+        splits = a.get("split")
+        if splits is None and len(node.input) > 1 and node.input[1]:
+            splits = [int(v) for v in self.static_value(node.input[1])]
+        if splits is None:
+            raise ONNXImportError(
+                "Split without explicit sizes needs static shape inference; "
+                "re-export with the split attribute/input"
+            )
+        begin = 0
+        for out_name, size in zip(node.output, splits):
+            sl = self.sd.apply(
+                "onnx_slice", x, name=out_name,
+                starts=[begin], ends=[begin + int(size)], axes=[axis],
+            )
+            self.vars[out_name] = sl
+            begin += int(size)
+
+    # -- opset breadth: conv/norm/image extras ------------------------------
+    def op_GlobalMaxPool(self, node):
+        self._emit(node, "max", self.in_var(node.input[0]),
+                   axis=[2, 3], keepdims=True)
+
+    def op_LRN(self, node):
+        a = _attrs(node)
+        x = self.sd.apply("transpose", self.in_var(node.input[0]),
+                          axes=list(_NCHW_TO_NHWC))
+        y = self.sd.apply(
+            "lrn", x,
+            size=int(a.get("size", 5)),
+            alpha=float(a.get("alpha", 1e-4)),
+            beta=float(a.get("beta", 0.75)),
+            bias=float(a.get("bias", 1.0)),
+        )
+        self._emit_nchw(node, y)
+
+    def op_InstanceNormalization(self, node):
+        eps = float(_attrs(node).get("epsilon", 1e-5))
+        x = self.in_var(node.input[0])
+        scale, bias = self.in_var(node.input[1]), self.in_var(node.input[2])
+
+        def chan(v):
+            return self.sd.apply("reshape", v, shape=[-1, 1, 1])
+
+        mean = self.sd.apply("mean", x, axis=[2, 3], keepdims=True)
+        var = self.sd.apply("var", x, axis=[2, 3], keepdims=True)
+        y = (x - mean) * self.sd.apply("rsqrt", var + eps)
+        self._alias(node, y * chan(scale) + chan(bias))
+
+    def op_Resize(self, node):
+        a = _attrs(node)
+        mode = a.get("mode", b"nearest")
+        mode = mode.decode() if isinstance(mode, bytes) else mode
+        ctm = a.get("coordinate_transformation_mode", b"half_pixel")
+        ctm = ctm.decode() if isinstance(ctm, bytes) else ctm
+        if ctm not in ("half_pixel", "asymmetric", "pytorch_half_pixel"):
+            raise ONNXImportError(
+                f"Resize coordinate_transformation_mode={ctm!r} unmapped"
+            )
+        if ctm == "asymmetric" and mode != "nearest":
+            # jax.image.resize is half-pixel; asymmetric linear/cubic would
+            # be silently pixel-shifted.  asymmetric NEAREST is accepted
+            # because it agrees with half-pixel at the integer upscale
+            # factors it is exported for (UNet/YOLO upsampling).
+            raise ONNXImportError(
+                "Resize coordinate_transformation_mode='asymmetric' is "
+                "mapped for mode='nearest' only"
+            )
+        method = {"nearest": "nearest", "linear": "bilinear",
+                  "cubic": "bicubic"}.get(mode)
+        if method is None:
+            raise ONNXImportError(f"Resize mode {mode!r} unmapped")
+        sizes = self._opt_static(node, 3)
+        if sizes is None:
+            raise ONNXImportError(
+                "Resize is mapped for static `sizes` input only; re-export "
+                "with explicit sizes instead of scales"
+            )
+        out_h, out_w = int(sizes[2]), int(sizes[3])
+        x = self.sd.apply("transpose", self.in_var(node.input[0]),
+                          axes=list(_NCHW_TO_NHWC))
+        y = self.sd.apply("resize", x, size=[out_h, out_w], method=method)
+        self._emit_nchw(node, y)
+
+    def op_ConvTranspose(self, node):
+        a = _attrs(node)
+        if a.get("group", 1) != 1:
+            raise ONNXImportError("grouped ConvTranspose unmapped")
+        if any(int(p) for p in a.get("output_padding", [])):
+            raise ONNXImportError("ConvTranspose output_padding unmapped")
+        stride = [int(s) for s in a.get("strides", [1, 1])]
+        if len(stride) != 2:
+            raise ONNXImportError("only 2-D ConvTranspose is mapped")
+        auto = a.get("auto_pad", "NOTSET")
+        auto = auto.decode() if isinstance(auto, bytes) else auto
+        pads = a.get("pads")
+        if auto == "SAME_UPPER" or (auto in ("NOTSET", "") and not pads):
+            padding = "SAME" if auto == "SAME_UPPER" else "VALID"
+        else:
+            raise ONNXImportError(
+                "ConvTranspose with explicit pads unmapped (re-export with "
+                "auto_pad)"
+            )
+        x = self.sd.apply("transpose", self.in_var(node.input[0]),
+                          axes=list(_NCHW_TO_NHWC))
+        # (I, O, kH, kW) -> (kH, kW, I, O), spatially FLIPPED: ONNX/torch
+        # ConvTranspose is the conv gradient (180-degree-rotated kernel),
+        # while lax.conv_transpose without transpose_kernel correlates
+        w = self.sd.apply("transpose", self.in_var(node.input[1]),
+                          axes=[2, 3, 0, 1])
+        w = self.sd.apply("reverse", w, axis=[0, 1])
+        y = self.sd.apply("deconv2d", x, w, stride=stride, padding=padding)
+        if len(node.input) > 2 and node.input[2]:
+            y = y + self.in_var(node.input[2])
+        self._emit_nchw(node, y)
+
+    def op_DepthToSpace(self, node):
+        a = _attrs(node)
+        mode = a.get("mode", "DCR")
+        if mode != "DCR":
+            raise ONNXImportError(
+                "DepthToSpace mapped for the default DCR mode only (the "
+                "registry depth_to_space decomposes channels depth-major)"
+            )
+        x = self.sd.apply("transpose", self.in_var(node.input[0]),
+                          axes=list(_NCHW_TO_NHWC))
+        y = self.sd.apply("depth_to_space", x, block=int(a["blocksize"]))
+        self._emit_nchw(node, y)
+
+    def op_SpaceToDepth(self, node):
+        x = self.sd.apply("transpose", self.in_var(node.input[0]),
+                          axes=list(_NCHW_TO_NHWC))
+        y = self.sd.apply("space_to_depth", x,
+                          block=int(_attrs(node)["blocksize"]))
+        self._emit_nchw(node, y)
+
+
 def import_onnx(path_or_bytes, trainable: bool = False) -> SameDiff:
     """Import an ONNX model (path, bytes, or parsed ModelProto) into a
     compiled SameDiff graph.

@@ -63,6 +63,14 @@ class GraphModel(Model):
         specs = []
         for out in self.conf.network_outputs:
             layer = by_name[out].layer
+            if layer is not None and hasattr(layer, "compute_loss_with_params"):
+                # params-aware custom loss (CenterLossOutputLayer): loss
+                # sites call spec[3] as fn(node_params, out, labels, mask)
+                specs.append((
+                    Loss.MSE, Activation.IDENTITY, False,
+                    ("with_params", out, layer.compute_loss_with_params),
+                ))
+                continue
             if layer is not None and hasattr(layer, "compute_loss"):
                 specs.append((Loss.MSE, Activation.IDENTITY, False, layer.compute_loss))
                 continue
@@ -177,7 +185,11 @@ class GraphModel(Model):
                     ):
                         out = outs[oname]
                         if custom is not None:
-                            total = total + custom(out, lab, m)
+                            if isinstance(custom, tuple):
+                                _, node, fn = custom
+                                total = total + fn(p.get(node, {}), out, lab, m)
+                            else:
+                                total = total + custom(out, lab, m)
                             continue
                         if not fused:
                             out = act(out.astype(jnp.float32))
@@ -287,6 +299,111 @@ class GraphModel(Model):
         self.iteration += 1
         self._dispatch_iteration(loss)
 
+    # -- layerwise unsupervised pretraining --------------------------------
+    def pretrain(self, data, epochs: int = 1) -> None:
+        """Greedy layerwise pretraining in topological order (reference
+        ComputationGraph.pretrain(DataSetIterator))."""
+        for node in self._topo:
+            if node.layer is not None and getattr(node.layer, "PRETRAINABLE", False):
+                self.pretrain_layer(node.name, data, epochs=epochs)
+
+    def pretrain_layer(self, name: str, data, epochs: int = 1) -> float:
+        """Unsupervised pretraining of one named layer node (reference
+        ComputationGraph.pretrainLayer(layerName, iter)): ancestors run
+        in inference mode, (prefix -> pretrain_loss -> grad -> updater)
+        for this node's params is one donated-buffer XLA step."""
+        if self.params is None:
+            self.init()
+        by_name = {n.name: n for n in self.conf.nodes}
+        if name not in by_name:
+            raise KeyError(f"no layer node named {name!r}")
+        node = by_name[name]
+        layer = node.layer
+        if layer is None or not getattr(layer, "PRETRAINABLE", False):
+            raise ValueError(
+                f"node {name!r} is not pretrainable; only AutoEncoder/"
+                "VariationalAutoencoder layers support unsupervised "
+                "pretraining"
+            )
+        tx = with_gradient_clipping(
+            self.conf.updater.to_optax(self.conf.steps_per_epoch),
+            self.conf.gradient_clip_value,
+            self.conf.gradient_clip_norm,
+        )
+        opt_state = tx.init(self.params[name])
+        frozen = {k: v for k, v in self.params.items() if k != name}
+
+        def prefix(fparams, features):
+            """Inference-mode topo walk up to `name`'s input activation."""
+            acts = {}
+            for iname, x in zip(self.conf.network_inputs, features):
+                if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
+                    x = x.astype(jnp.bfloat16)
+                acts[iname] = x
+            for nd in self._topo:
+                if nd.name == name:
+                    break
+                xs = [acts[n] for n in nd.inputs]
+                if nd.layer is not None:
+                    x = xs[0]
+                    if self._flatten[nd.name]:
+                        x = x.reshape(x.shape[0], -1)
+                    y, _ = nd.layer.apply(
+                        fparams.get(nd.name, {}),
+                        self.net_state.get(nd.name, {}),
+                        x, training=False, rng=None,
+                    )
+                elif nd.vertex.HAS_PARAMS:
+                    y = nd.vertex.apply(
+                        xs, params=fparams.get(nd.name, {}),
+                        training=False, rng=None,
+                    )
+                else:
+                    y = nd.vertex.apply(xs)
+                acts[nd.name] = y
+            x = acts[node.inputs[0]]
+            if self._flatten[name]:
+                x = x.reshape(x.shape[0], -1)
+            return x.astype(jnp.float32)
+
+        from functools import partial as _partial
+
+        @_partial(jax.jit, donate_argnums=(0, 1))
+        def pstep(lp, opt_state, fparams, step_i, features):
+            rng = SeedStream.fold(self._stream.root, step_i)
+
+            def loss_fn(lp):
+                x = prefix(fparams, features)
+                return layer.pretrain_loss(lp, jax.lax.stop_gradient(x), rng)
+
+            loss, grads = jax.value_and_grad(loss_fn)(lp)
+            updates, opt_state = tx.update(grads, opt_state, lp)
+            lp = jax.tree.map(lambda p, u: p + u.astype(p.dtype), lp, updates)
+            return lp, opt_state, loss
+
+        lp = self.params.pop(name)
+        loss = float("nan")
+        step_i = 0
+        iterator = self._as_batches(data, None)
+        if epochs > 1 and not hasattr(iterator, "reset"):
+            # a plain generator would be exhausted after epoch 1 and the
+            # remaining epochs would silently run zero steps
+            iterator = list(iterator)
+        try:
+            for _ in range(epochs):
+                for batch in iterator:
+                    mds = self._as_mds(batch)
+                    feats = tuple(jnp.asarray(f) for f in mds.features)
+                    lp, opt_state, loss = pstep(
+                        lp, opt_state, frozen, jnp.uint32(step_i), feats
+                    )
+                    step_i += 1
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+        finally:
+            self.params[name] = lp
+        return float(loss)
+
     # -- inference ---------------------------------------------------------
     def _get_infer_fn(self):
         if self._infer_fn is None:
@@ -331,10 +448,18 @@ class GraphModel(Model):
 
         iterator = self._as_batches(data)
         ev = Evaluation()
+        by_name = {n.name: n for n in self.conf.nodes}
+        out_layer = by_name[self.conf.network_outputs[output_index]].layer
         for batch in iterator:
             mds = self._as_mds(batch)
             out = self.output(*mds.features)
             arr = out[output_index] if isinstance(out, tuple) else out
+            if out_layer is not None and hasattr(out_layer, "evaluation_output"):
+                # custom heads: extract class probabilities from the raw
+                # apply() output (see SequentialModel.evaluate)
+                arr = out_layer.evaluation_output(
+                    self.params.get(out_layer.name, {}), arr
+                )
             mask = None
             if mds.labels_masks is not None:
                 mask = mds.labels_masks[output_index]
@@ -352,7 +477,13 @@ class GraphModel(Model):
         ):
             out = outs[oname]
             if custom is not None:
-                total = total + custom(out, jnp.asarray(lab), m)
+                if isinstance(custom, tuple):
+                    _, node, fn = custom
+                    total = total + fn(
+                        self.params.get(node, {}), out, jnp.asarray(lab), m
+                    )
+                else:
+                    total = total + custom(out, jnp.asarray(lab), m)
                 continue
             if not fused:
                 out = act(out.astype(jnp.float32))

@@ -78,7 +78,13 @@ class SequentialModel(Model):
     def _resolve_output(self) -> tuple[Loss, Activation, bool]:
         last = self.conf.layers[-1]
         # layers with their own loss function (e.g. Yolo2OutputLayer) bypass
-        # the enum-based loss dispatch entirely
+        # the enum-based loss dispatch entirely; _with_params variants
+        # (CenterLossOutputLayer) additionally see their own param dict
+        self._custom_loss_layer = None
+        if hasattr(last, "compute_loss_with_params"):
+            self._custom_loss = last.compute_loss_with_params
+            self._custom_loss_layer = last.name
+            return Loss.MSE, Activation.IDENTITY, False
         if hasattr(last, "compute_loss"):
             self._custom_loss = last.compute_loss
             return Loss.MSE, Activation.IDENTITY, False
@@ -204,6 +210,13 @@ class SequentialModel(Model):
     def _reg_loss(self, params):
         return regularization_loss(params, [(l.name, l) for l in self.conf.layers])
 
+    def _data_loss_custom(self, p, out, labels, lmask):
+        if self._custom_loss_layer is not None:
+            return self._custom_loss(
+                p.get(self._custom_loss_layer, {}), out, labels, lmask
+            )
+        return self._custom_loss(out, labels, lmask)
+
     # -- compiled train step ----------------------------------------------
     def _get_step_fn(self, has_lmask: bool, has_fmask: bool, with_carries: bool):
         key = ("train", has_lmask, has_fmask, with_carries)
@@ -229,8 +242,8 @@ class SequentialModel(Model):
                         out, new_state = fwd
                         new_carries = {}
                     if self._custom_loss is not None:
-                        data_loss = self._custom_loss(
-                            out, labels, lmask if has_lmask else None
+                        data_loss = self._data_loss_custom(
+                            p, out, labels, lmask if has_lmask else None
                         )
                     else:
                         if not self._fused_loss:
@@ -307,8 +320,8 @@ class SequentialModel(Model):
                         fmask=fmask if has_fmask else None,
                     )
                     if self._custom_loss is not None:
-                        data_loss = self._custom_loss(
-                            out, labels, lmask if has_lmask else None
+                        data_loss = self._data_loss_custom(
+                            p, out, labels, lmask if has_lmask else None
                         )
                     else:
                         if not self._fused_loss:
@@ -680,7 +693,9 @@ class SequentialModel(Model):
             fmask=ds.features_mask,
         )
         if self._custom_loss is not None:
-            loss = self._custom_loss(out, jnp.asarray(ds.labels), ds.labels_mask)
+            loss = self._data_loss_custom(
+                self.params, out, jnp.asarray(ds.labels), ds.labels_mask
+            )
         else:
             if not self._fused_loss:
                 out = self._out_activation(out.astype(jnp.float32))
@@ -695,9 +710,23 @@ class SequentialModel(Model):
 
         iterator = _as_iterator(data, batch_size)
         ev = Evaluation()
+        last = self.conf.layers[-1]
         for batch in iterator:
-            probs = np.asarray(self.output(batch.features, batch.features_mask))
-            ev.eval(batch.labels, probs, mask=batch.labels_mask)
+            probs = self.output(batch.features, batch.features_mask)
+            if hasattr(last, "evaluation_output"):
+                # custom heads (CenterLoss concat, ChunkedSoftmax hidden
+                # states) need their logits extracted — a raw argmax over
+                # apply()'s output would be garbage
+                probs = last.evaluation_output(
+                    self.params.get(last.name, {}), probs
+                )
+            labels = batch.labels
+            if np.ndim(labels) >= 1 and np.asarray(probs).shape[-1] != np.asarray(labels).shape[-1]:
+                # int class ids (the chunked head's label form)
+                labels = np.eye(np.asarray(probs).shape[-1], dtype=np.float32)[
+                    np.asarray(labels).astype(int)
+                ]
+            ev.eval(labels, np.asarray(probs), mask=batch.labels_mask)
         return ev
 
     # -- serialization helpers --------------------------------------------

@@ -490,7 +490,11 @@ class Subsampling(LayerConfig):
         strides = (1, sh, sw, 1)
         pad = self.padding.upper()
         if self.pooling is PoolingType.MAX:
-            y = lax.reduce_window(x, -jnp.inf, lax.max, dims, strides, pad)
+            from deeplearning4j_tpu.runtime.backend import maxpool_fusion_barrier
+
+            y = lax.reduce_window(
+                maxpool_fusion_barrier(x), -jnp.inf, lax.max, dims, strides, pad
+            )
         elif self.pooling is PoolingType.SUM:
             y = lax.reduce_window(x, 0.0, lax.add, dims, strides, pad)
         elif self.pooling is PoolingType.AVG:
@@ -711,3 +715,143 @@ class LocalResponseNormalization(LayerConfig):
         s = sum(windows)
         y = x.astype(jnp.float32) / (self.k + self.alpha * s) ** self.beta
         return y.astype(x.dtype), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class CenterLossOutputLayer(LayerConfig):
+    """Softmax + center loss output (reference
+    org.deeplearning4j.nn.conf.layers.CenterLossOutputLayer [U], the
+    FaceNetNN4Small2 training head): pulls each example's embedding
+    toward its class center while the cross-entropy separates classes.
+
+    TPU-native design: the class centers are ordinary trainable params
+    inside the compiled step — the center term's gradient wrt `centers`
+    IS the center update (scaled by `alpha` against the main loss), so
+    no out-of-graph bookkeeping exists.  `apply()` emits
+    `concat([logits, embedding])`; use `split_output()` to separate
+    them (the embedding half is the face-recognition feature vector).
+    """
+
+    n_out: int = 0            # number of classes
+    alpha: float = 0.1        # center learning-rate multiplier
+    lambda_coeff: float = 2e-4  # weight of the center-distance term
+    has_bias: bool = True
+
+    EXPECTS = "ff"
+
+    def output_type(self, itype: InputType) -> InputType:
+        return InputType.feed_forward(self.n_out + itype.size)
+
+    def init(self, key, itype):
+        n_in = itype.size
+        w = self._winit().init(key, (n_in, self.n_out), fan_in=n_in,
+                               fan_out=self.n_out)
+        params = {"W": w, "centers": jnp.zeros((self.n_out, n_in), jnp.float32)}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        logits = x @ params["W"].astype(x.dtype)
+        if self.has_bias:
+            logits = logits + params["b"].astype(x.dtype)
+        return jnp.concatenate([logits, x], axis=-1), state
+
+    def split_output(self, out):
+        """(logits, embedding) halves of apply()'s concatenated output."""
+        return out[..., : self.n_out], out[..., self.n_out :]
+
+    def evaluation_output(self, lp, out):
+        """Class probabilities for Evaluation (argmax over the raw concat
+        output would land in the embedding half)."""
+        logits, _ = self.split_output(out)
+        return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+    def compute_loss_with_params(self, lp, preds, labels, mask=None):
+        logits, emb = self.split_output(preds.astype(jnp.float32))
+        labels = labels.astype(jnp.float32)
+        logp = jax.nn.log_softmax(logits, axis=-1)
+        per = -jnp.sum(labels * logp, axis=-1)
+        # class center per example; alpha scales the gradient that flows
+        # into the centers (the reference's center update rate)
+        centers = lp["centers"]
+        centers = (
+            centers * self.alpha + jax.lax.stop_gradient(centers) * (1 - self.alpha)
+        )
+        c = labels @ centers.astype(jnp.float32)
+        center_term = 0.5 * jnp.sum((emb - c) ** 2, axis=-1)
+        per = per + self.lambda_coeff * center_term
+        if mask is not None:
+            m = mask.astype(jnp.float32)
+            return jnp.sum(per * m) / jnp.maximum(jnp.sum(m), 1.0)
+        return jnp.mean(per)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class ChunkedSoftmaxOutputLayer(LayerConfig):
+    """LM output head whose softmax cross-entropy streams the vocab in
+    chunks (ops/chunked_xent.py) — the (N, vocab) logits tensor, the
+    largest activation in a large-vocab training step, never
+    materializes.  No reference counterpart (the reference always
+    buffers dense logits through LossMCXENT); this is TPU HBM headroom
+    the dense path cannot offer.
+
+    `apply()` passes hidden states through UNPROJECTED; the loss owns
+    the (n_in, vocab) projection.  Labels may be int class ids
+    ((B,) / (B,T), the memory-sane form) or one-hot (converted via
+    argmax).  For inference, `logits(params, h)` materializes the
+    projection densely (generation usually wants top-k of one step,
+    not a training batch of logits).
+    """
+
+    n_out: int = 0          # vocab size
+    chunk: int = 8192
+    has_bias: bool = True
+
+    EXPECTS = "any"
+
+    def output_type(self, itype: InputType) -> InputType:
+        return itype            # hidden states pass through; loss projects
+
+    def init(self, key, itype):
+        n_in = itype.size
+        w = self._winit().init(key, (n_in, self.n_out), fan_in=n_in,
+                               fan_out=self.n_out)
+        params = {"W": w}
+        if self.has_bias:
+            params["b"] = jnp.zeros((self.n_out,), jnp.float32)
+        return params, {}
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return _dropout(x, self.dropout_rate or 0.0, training, rng), state
+
+    def logits(self, params, h):
+        """Dense projection for inference/generation."""
+        y = h @ params["W"].astype(h.dtype)
+        if self.has_bias:
+            y = y + params["b"].astype(h.dtype)
+        return y
+
+    def evaluation_output(self, lp, out):
+        """Class probabilities for Evaluation: project the hidden states
+        densely (evaluate() batches are inference-sized)."""
+        return jax.nn.softmax(self.logits(lp, out).astype(jnp.float32), axis=-1)
+
+    def compute_loss_with_params(self, lp, preds, labels, mask=None):
+        from deeplearning4j_tpu.ops.chunked_xent import chunked_softmax_xent
+
+        d = preds.shape[-1]
+        h = preds.reshape(-1, d)
+        labels = jnp.asarray(labels)
+        if labels.ndim >= 2 and labels.shape[-1] == self.n_out:
+            labels = jnp.argmax(labels, axis=-1)        # one-hot fallback
+        ids = labels.reshape(-1).astype(jnp.int32)
+        if mask is not None:
+            w = jnp.asarray(mask).reshape(-1).astype(jnp.float32)
+        else:
+            w = jnp.ones((h.shape[0],), jnp.float32)
+        b = lp.get("b", jnp.zeros((self.n_out,), jnp.float32))
+        return chunked_softmax_xent(h, lp["W"], b, ids, w, self.chunk)

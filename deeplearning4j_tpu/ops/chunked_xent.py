@@ -1,0 +1,121 @@
+"""Chunked large-vocab softmax cross-entropy — logits never materialize.
+
+The LM-head loss `xent(h @ W + b, y)` materializes (N, V) logits twice
+(forward + cotangent); at BERT/GPT vocab sizes that is the single
+largest activation in the whole training step (batch 32 x seq 128 x
+30522 x 4B ≈ 500 MB f32).  This op streams the vocab in chunks with an
+online-softmax accumulator — peak extra memory is O(N x chunk) — and a
+custom VJP that recomputes each chunk's logits in the backward (the
+flash-attention trade: FLOPs for HBM).  Matmuls stay (N, D) x (D, chunk)
+— full MXU tiles.
+
+The reference computes this loss dense through LossMCXENT after a full
+logits buffer (SURVEY.md §2.2 updaters/loss stack); chunking is a
+capability the reference does not have at any vocab size.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+_NEG = -1e30
+
+
+def _pad_vocab(W, b, chunk):
+    V = W.shape[1]
+    pad = (-V) % chunk
+    if pad:
+        W = jnp.pad(W, ((0, 0), (0, pad)))
+        b = jnp.pad(b, (0, pad), constant_values=_NEG)  # exp(-1e30) == 0
+    return W, b, V + pad
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(5,))
+def chunked_softmax_xent(h, W, b, labels, weights, chunk: int = 8192):
+    """Weighted mean token cross-entropy of softmax(h @ W + b).
+
+    h: (N, D) f32/bf16 hidden states; W: (D, V); b: (V,);
+    labels: (N,) int class ids; weights: (N,) per-token weights (pass
+    ones for a plain mean; zeros mask tokens out).  Returns the scalar
+    weighted-mean loss.
+    """
+    loss, _ = _fwd(h, W, b, labels, weights, chunk)
+    return loss
+
+
+def _fwd(h, W, b, labels, weights, chunk):
+    h32 = h.astype(jnp.float32)
+    Wp, bp, Vp = _pad_vocab(W.astype(jnp.float32), b.astype(jnp.float32), chunk)
+    N, D = h32.shape
+    labels = labels.astype(jnp.int32)
+    starts = jnp.arange(0, Vp, chunk)
+
+    def body(carry, c):
+        m, s, ly = carry
+        Wc = lax.dynamic_slice(Wp, (0, c), (D, chunk))
+        bc = lax.dynamic_slice(bp, (c,), (chunk,))
+        logits = h32 @ Wc + bc                        # (N, chunk)
+        m_new = jnp.maximum(m, jnp.max(logits, axis=-1))
+        s = s * jnp.exp(m - m_new) + jnp.sum(
+            jnp.exp(logits - m_new[:, None]), axis=-1
+        )
+        idx = labels - c
+        in_c = (idx >= 0) & (idx < chunk)
+        picked = jnp.take_along_axis(
+            logits, jnp.clip(idx, 0, chunk - 1)[:, None], axis=-1
+        )[:, 0]
+        ly = ly + jnp.where(in_c, picked, 0.0)
+        return (m_new, s, ly), None
+
+    init = (jnp.full((N,), _NEG, jnp.float32), jnp.zeros((N,), jnp.float32),
+            jnp.zeros((N,), jnp.float32))
+    (m, s, ly), _ = lax.scan(body, init, starts)
+    w = weights.astype(jnp.float32)
+    wsum = jnp.maximum(jnp.sum(w), 1.0)
+    loss = jnp.sum(w * (m + jnp.log(s) - ly)) / wsum
+    return loss, (h, W, b, labels, m + jnp.log(s), w, wsum)
+
+
+def _bwd(chunk, res, g):
+    h, W, b, labels, logz, w, wsum = res
+    h32 = h.astype(jnp.float32)
+    Wp, bp, Vp = _pad_vocab(W.astype(jnp.float32), b.astype(jnp.float32), chunk)
+    N, D = h32.shape
+    V = W.shape[1]
+    scale = (g * w / wsum)[:, None]
+    starts = jnp.arange(0, Vp, chunk)
+
+    def body(carry, c):
+        dh, dW, db = carry
+        Wc = lax.dynamic_slice(Wp, (0, c), (D, chunk))
+        bc = lax.dynamic_slice(bp, (c,), (chunk,))
+        logits = h32 @ Wc + bc
+        p = jnp.exp(logits - logz[:, None])           # softmax slice
+        idx = labels - c
+        onehot = (idx[:, None] == jnp.arange(chunk)[None, :]).astype(jnp.float32)
+        d = (p - onehot) * scale                       # (N, chunk)
+        dh = dh + d @ Wc.T
+        dW = lax.dynamic_update_slice(dW, h32.T @ d, (0, c))
+        db = lax.dynamic_update_slice(db, jnp.sum(d, axis=0), (c,))
+        return (dh, dW, db), None
+
+    init = (
+        jnp.zeros((N, D), jnp.float32),
+        jnp.zeros((D, Vp), jnp.float32),
+        jnp.zeros((Vp,), jnp.float32),
+    )
+    (dh, dW, db), _ = lax.scan(body, init, starts)
+    return (
+        dh.astype(h.dtype),
+        dW[:, :V].astype(W.dtype),
+        db[:V].astype(b.dtype),
+        None,
+        None,
+    )
+
+
+chunked_softmax_xent.defvjp(_fwd, _bwd)

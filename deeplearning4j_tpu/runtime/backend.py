@@ -62,3 +62,19 @@ def device_count() -> int:
 
 def platform() -> str:
     return backend().platform
+
+
+def maxpool_fusion_barrier(x):
+    """XLA:TPU workaround for a backward-pass mis-fusion: when a jitted
+    program computes (producer -> reduce_window max), the compiler can
+    fuse the pool's select-and-scatter transpose into the producer's
+    transpose and emit NaN gradients (observed on the experimental axon
+    TPU platform with conv 7x7/s2 SAME -> maxpool 3x3/s2 SAME; the same
+    math split across two jits, or run eagerly, is finite — see
+    tests/test_review_regressions.py).  An optimization barrier before
+    the pool keeps the two patterns in separate fusions.  No-op off TPU,
+    where the fusion is correct and the barrier would only inhibit it.
+    """
+    if backend().is_tpu:
+        return jax.lax.optimization_barrier(x)
+    return x

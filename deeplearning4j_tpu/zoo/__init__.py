@@ -3,6 +3,8 @@
 from deeplearning4j_tpu.zoo.zoo_model import ZooModel
 from deeplearning4j_tpu.zoo.alexnet import AlexNet
 from deeplearning4j_tpu.zoo.darknet import Darknet19
+from deeplearning4j_tpu.zoo.facenet import FaceNetNN4Small2
+from deeplearning4j_tpu.zoo.nasnet import NASNet
 from deeplearning4j_tpu.zoo.inception_resnet import InceptionResNetV1
 from deeplearning4j_tpu.zoo.lenet import LeNet
 from deeplearning4j_tpu.zoo.resnet import ResNet50
@@ -19,5 +21,5 @@ __all__ = [
     "ZooModel", "AlexNet", "Darknet19", "InceptionResNetV1", "LeNet",
     "ResNet50", "SimpleCNN", "SqueezeNet", "TextGenerationLSTM",
     "TransformerEncoder", "UNet", "VGG16", "VGG19", "Xception", "TinyYOLO",
-    "YOLO2",
+    "YOLO2", "NASNet", "FaceNetNN4Small2",
 ]

@@ -44,6 +44,8 @@ class TransformerEncoder(ZooModel):
         learning_rate: float = 3e-4,
         moe_experts: int = 0,           # >0: MoE FFN layer after each block
         moe_top_k: int = 2,
+        chunked_vocab_loss: bool = False,  # stream the vocab-xent in chunks
+        vocab_chunk: int = 8192,
     ):
         super().__init__(vocab_size, seed)
         self.vocab_size = vocab_size
@@ -56,6 +58,8 @@ class TransformerEncoder(ZooModel):
         self.learning_rate = learning_rate
         self.moe_experts = moe_experts
         self.moe_top_k = moe_top_k
+        self.chunked_vocab_loss = chunked_vocab_loss
+        self.vocab_chunk = vocab_chunk
 
     def conf(self):
         b = (
@@ -87,14 +91,22 @@ class TransformerEncoder(ZooModel):
                         top_k=self.moe_top_k,
                     )
                 )
-        return (
-            b.layer(
-                RnnOutputLayer(
-                    n_out=self.vocab_size,
-                    loss=Loss.MCXENT,
-                    activation=Activation.SOFTMAX,
-                )
+        if self.chunked_vocab_loss:
+            # logits never materialize: the head streams vocab chunks
+            # through the loss (ops/chunked_xent.py)
+            from deeplearning4j_tpu.nn.conf import ChunkedSoftmaxOutputLayer
+
+            head = ChunkedSoftmaxOutputLayer(
+                n_out=self.vocab_size, chunk=self.vocab_chunk
             )
+        else:
+            head = RnnOutputLayer(
+                n_out=self.vocab_size,
+                loss=Loss.MCXENT,
+                activation=Activation.SOFTMAX,
+            )
+        return (
+            b.layer(head)
             .set_input_type(InputType.recurrent(1))
             .build()
         )

@@ -6,8 +6,9 @@ importer's NCHW->NHWC boundary handling) or numpy."""
 
 import numpy as np
 import pytest
-import torch
-import torch.nn.functional as F
+
+torch = pytest.importorskip("torch")
+import torch.nn.functional as F  # noqa: E402
 
 from onnx_fixtures import make_model, make_node
 from deeplearning4j_tpu.modelimport.onnx import ONNXImportError, import_onnx
@@ -306,3 +307,182 @@ class TestImportSemantics:
         x = RNG.normal(0, 1, (2, 3, 4)).astype(np.float32)
         (y,) = run(import_onnx(data), {"x": x})
         assert y.shape == (2, 12)
+
+
+class TestOpsetBreadth:
+    def test_elementwise_trig_chain(self):
+        x = RNG.normal(0, 1, (3, 4)).astype(np.float32)
+        m = make_model(
+            [
+                make_node("Sin", ["x"], ["s"]),
+                make_node("Cos", ["x"], ["c"]),
+                make_node("Add", ["s", "c"], ["sc"]),
+                make_node("Floor", ["sc"], ["f"]),
+                make_node("Sign", ["f"], ["y"]),
+            ],
+            inputs=[("x", x.shape)], outputs=["y"],
+        )
+        (got,) = run(import_onnx(m), {"x": x})
+        np.testing.assert_allclose(
+            got, np.sign(np.floor(np.sin(x) + np.cos(x))), atol=1e-6
+        )
+
+    def test_hardsigmoid_hardswish_prelu(self):
+        x = RNG.normal(0, 2, (4, 5)).astype(np.float32)
+        slope = np.full((5,), 0.1, np.float32)
+        m = make_model(
+            [
+                make_node("HardSigmoid", ["x"], ["hs"], alpha=0.2, beta=0.5),
+                make_node("HardSwish", ["x"], ["hw"]),
+                make_node("PRelu", ["x", "slope"], ["pr"]),
+            ],
+            inputs=[("x", x.shape)], outputs=["hs", "hw", "pr"],
+            initializers={"slope": slope},
+        )
+        hs, hw, pr = run(import_onnx(m), {"x": x})
+        np.testing.assert_allclose(
+            hs, np.clip(0.2 * x + 0.5, 0, 1), atol=1e-6)
+        np.testing.assert_allclose(
+            hw, np.asarray(torch.nn.functional.hardswish(torch.tensor(x))),
+            atol=1e-5)
+        np.testing.assert_allclose(
+            pr, np.where(x >= 0, x, 0.1 * x), atol=1e-6)
+
+    def test_reductions_and_argmax(self):
+        x = RNG.normal(0, 1, (3, 6)).astype(np.float32)
+        m = make_model(
+            [
+                make_node("ReduceL2", ["x"], ["l2"], axes=[1], keepdims=0),
+                make_node("ReduceProd", ["x"], ["pr"], axes=[1], keepdims=0),
+                make_node("ReduceLogSumExp", ["x"], ["lse"], axes=[1],
+                          keepdims=0),
+                make_node("ArgMax", ["x"], ["am"], axis=1, keepdims=0),
+            ],
+            inputs=[("x", x.shape)], outputs=["l2", "pr", "lse", "am"],
+        )
+        l2, pr, lse, am = run(import_onnx(m), {"x": x})
+        np.testing.assert_allclose(l2, np.linalg.norm(x, axis=1), atol=1e-5)
+        np.testing.assert_allclose(pr, np.prod(x, axis=1), rtol=1e-5)
+        np.testing.assert_allclose(
+            lse, np.log(np.exp(x).sum(axis=1)), atol=1e-5)
+        np.testing.assert_array_equal(am, x.argmax(axis=1))
+
+    def test_split_expand_range_constantofshape(self):
+        x = RNG.normal(0, 1, (2, 6)).astype(np.float32)
+        m = make_model(
+            [
+                make_node("Split", ["x"], ["a", "b"], axis=1, split=[2, 4]),
+                make_node("Expand", ["a", "eshape"], ["e"]),
+                make_node("Range", ["r0", "r1", "r2"], ["rg"]),
+                make_node("ConstantOfShape", ["cshape"], ["cf"],
+                          value=np.array([3.0], np.float32)),
+            ],
+            inputs=[("x", x.shape)], outputs=["e", "b", "rg", "cf"],
+            initializers={
+                "eshape": np.array([2, 2, 2], np.int64),
+                "r0": np.array(0.0, np.float32),
+                "r1": np.array(5.0, np.float32),
+                "r2": np.array(2.0, np.float32),
+                "cshape": np.array([2, 3], np.int64),
+            },
+        )
+        e, b, rg, cf = run(import_onnx(m), {"x": x})
+        np.testing.assert_allclose(b, x[:, 2:], atol=1e-6)
+        assert e.shape == (2, 2, 2)
+        np.testing.assert_allclose(rg, [0.0, 2.0, 4.0])
+        np.testing.assert_allclose(cf, np.full((2, 3), 3.0))
+
+    def test_lrn_matches_torch(self):
+        x = RNG.normal(0, 1, (2, 8, 5, 5)).astype(np.float32)
+        m = make_model(
+            [make_node("LRN", ["x"], ["y"], size=3, alpha=2e-4, beta=0.75,
+                       bias=1.5)],
+            inputs=[("x", x.shape)], outputs=["y"],
+        )
+        (got,) = run(import_onnx(m), {"x": x})
+        want = torch.nn.LocalResponseNorm(3, alpha=2e-4, beta=0.75, k=1.5)(
+            torch.tensor(x)
+        ).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+    def test_instance_norm_matches_torch(self):
+        x = RNG.normal(0, 1, (2, 4, 6, 6)).astype(np.float32)
+        scale = RNG.normal(1, 0.2, (4,)).astype(np.float32)
+        bias = RNG.normal(0, 0.2, (4,)).astype(np.float32)
+        m = make_model(
+            [make_node("InstanceNormalization", ["x", "s", "b"], ["y"],
+                       epsilon=1e-5)],
+            inputs=[("x", x.shape)], outputs=["y"],
+            initializers={"s": scale, "b": bias},
+        )
+        (got,) = run(import_onnx(m), {"x": x})
+        want = F.instance_norm(
+            torch.tensor(x), weight=torch.tensor(scale),
+            bias=torch.tensor(bias), eps=1e-5,
+        ).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_conv_transpose_matches_torch(self):
+        x = RNG.normal(0, 1, (1, 3, 5, 5)).astype(np.float32)
+        w = RNG.normal(0, 0.3, (3, 4, 2, 2)).astype(np.float32)  # (I,O,kH,kW)
+        m = make_model(
+            [make_node("ConvTranspose", ["x", "w"], ["y"], strides=[2, 2])],
+            inputs=[("x", x.shape)], outputs=["y"],
+            initializers={"w": w},
+        )
+        (got,) = run(import_onnx(m), {"x": x})
+        want = F.conv_transpose2d(
+            torch.tensor(x), torch.tensor(w), stride=2
+        ).numpy()
+        np.testing.assert_allclose(got, want, atol=1e-4)
+
+    def test_resize_nearest_and_topk(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 1, 4, 4)
+        m = make_model(
+            [
+                make_node("Resize", ["x", "", "", "sizes"], ["y"],
+                          mode="nearest",
+                          coordinate_transformation_mode="asymmetric"),
+            ],
+            inputs=[("x", x.shape)], outputs=["y"],
+            initializers={"sizes": np.array([1, 1, 8, 8], np.int64)},
+        )
+        (got,) = run(import_onnx(m), {"x": x})
+        want = F.interpolate(torch.tensor(x), size=(8, 8), mode="nearest").numpy()
+        np.testing.assert_allclose(got, want, atol=1e-5)
+
+        t = RNG.normal(0, 1, (3, 7)).astype(np.float32)
+        m2 = make_model(
+            [make_node("TopK", ["t", "k"], ["v", "i"], axis=-1)],
+            inputs=[("t", t.shape)], outputs=["v", "i"],
+            initializers={"k": np.array([3], np.int64)},
+        )
+        v, i = run(import_onnx(m2), {"t": t})
+        tv, ti = torch.topk(torch.tensor(t), 3, dim=-1)
+        np.testing.assert_allclose(v, tv.numpy(), atol=1e-6)
+        np.testing.assert_array_equal(i, ti.numpy())
+
+    def test_logical_and_mod(self):
+        a = np.array([1.0, 0.0, 1.0], np.float32)
+        b = np.array([1.0, 1.0, 0.0], np.float32)
+        x = np.array([7.0, -7.0, 5.0], np.float32)
+        y = np.array([3.0, 3.0, 2.0], np.float32)
+        m = make_model(
+            [
+                make_node("And", ["a", "b"], ["and_"]),
+                make_node("Xor", ["a", "b"], ["xor_"]),
+                make_node("Mod", ["x", "y"], ["fm"], fmod=1),
+                make_node("Mod", ["x", "y"], ["im"]),
+                make_node("GreaterOrEqual", ["x", "y"], ["ge"]),
+            ],
+            inputs=[("a", a.shape), ("b", b.shape), ("x", x.shape),
+                    ("y", y.shape)],
+            outputs=["and_", "xor_", "fm", "im", "ge"],
+        )
+        and_, xor_, fm, im, ge = run(
+            import_onnx(m), {"a": a, "b": b, "x": x, "y": y})
+        np.testing.assert_allclose(and_, [1.0, 0.0, 0.0])
+        np.testing.assert_allclose(xor_, [0.0, 1.0, 1.0])
+        np.testing.assert_allclose(fm, np.fmod(x, y), atol=1e-6)
+        np.testing.assert_allclose(im, np.mod(x, y), atol=1e-6)
+        np.testing.assert_allclose(ge, (x >= y).astype(np.float32))

@@ -144,3 +144,56 @@ def test_pretrain_serde_round_trip():
     for layer in (ae, vae):
         back = serde.loads(serde.dumps(layer))
         assert back == layer, (layer, back)
+
+
+def test_graph_model_pretrain_layer():
+    """ComputationGraph.pretrainLayer parity: a VAE node inside a DAG is
+    pretrained on its inference-mode ancestor activations."""
+    import numpy as np
+    from deeplearning4j_tpu.data.dataset import MultiDataSet
+    from deeplearning4j_tpu.models.computation_graph import GraphModel
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+    from deeplearning4j_tpu.nn.updaters import Adam as AdamUp
+
+    x, y = blobs(d=6)
+    g = (
+        GraphBuilder().seed(4).updater(AdamUp(1e-2))
+        .add_inputs("in")
+        .set_input_types(InputType.feed_forward(6))
+    )
+    g.add_layer("ae", AutoEncoder(n_out=4, corruption_level=0.1), "in")
+    g.add_layer("out", OutputLayer(n_out=2, loss=Loss.MCXENT,
+                                   activation=Activation.SOFTMAX), "ae")
+    g.set_outputs("out")
+    model = GraphModel(g.build()).init()
+    ae = model.conf.nodes[1].layer if model.conf.nodes[1].name == "ae" else None
+    ae = ae or next(n.layer for n in model.conf.nodes if n.name == "ae")
+    import jax
+
+    before = float(ae.pretrain_loss(model.params["ae"], x, jax.random.key(0)))
+    mds = MultiDataSet((x,), (y,))
+    model.pretrain(mds, epochs=25)
+    after = float(ae.pretrain_loss(model.params["ae"], x, jax.random.key(0)))
+    assert after < before, (before, after)
+    model.fit(mds, epochs=10)
+    assert np.isfinite(model.score_value)
+
+
+def test_graph_model_pretrain_rejects_non_pretrainable():
+    import pytest as _pytest
+    from deeplearning4j_tpu.models.computation_graph import GraphModel
+    from deeplearning4j_tpu.nn.conf import Dense
+    from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+
+    g = (
+        GraphBuilder().add_inputs("in")
+        .set_input_types(InputType.feed_forward(4))
+    )
+    g.add_layer("d", Dense(n_out=3), "in")
+    g.add_layer("out", OutputLayer(n_out=2), "d")
+    g.set_outputs("out")
+    model = GraphModel(g.build()).init()
+    with _pytest.raises(ValueError, match="not pretrainable"):
+        model.pretrain_layer("d", None)
+    with _pytest.raises(KeyError):
+        model.pretrain_layer("missing", None)

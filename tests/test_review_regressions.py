@@ -144,3 +144,38 @@ def test_async_iterator_full_consumption_matches_base():
     assert len(got) == len(want)
     for g, w in zip(got, want):
         np.testing.assert_array_equal(np.asarray(g), w)
+
+
+class TestMaxpoolFusionBarrier:
+    def test_conv_maxpool_backward_finite_jitted(self):
+        """Regression for an XLA:TPU backward mis-fusion: jitted
+        grad(conv 7x7/s2 SAME -> maxpool 3x3/s2 SAME) emitted NaN on the
+        axon TPU platform while the unfused computation was finite.  The
+        maxpool input now passes through an optimization barrier on TPU
+        (runtime/backend.py maxpool_fusion_barrier).  On CPU this checks
+        the barrier is a no-op and grads stay finite."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from deeplearning4j_tpu.nn.conf.layers import (
+            Conv2D, PoolingType, Subsampling,
+        )
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+
+        conv = Conv2D(name="c", n_out=16, kernel=(7, 7), stride=(2, 2),
+                      padding="same", has_bias=False)
+        pool = Subsampling(pooling=PoolingType.MAX, kernel=(3, 3),
+                           stride=(2, 2), padding="same")
+        cp, _ = conv.init(jax.random.key(0), InputType.convolutional(32, 32, 3))
+        x = jnp.asarray(
+            np.random.default_rng(0).normal(0, 1, (4, 32, 32, 3)).astype(np.float32)
+        )
+
+        def f(cp):
+            y, _ = conv.apply(cp, {}, x, training=False, rng=None)
+            y, _ = pool.apply({}, {}, y, training=False, rng=None)
+            return jnp.sum(y.astype(jnp.float32) ** 2)
+
+        g = jax.jit(jax.grad(f))(cp)
+        assert np.isfinite(np.asarray(g["W"], np.float32)).all()

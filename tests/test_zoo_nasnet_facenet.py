@@ -1,0 +1,171 @@
+"""NASNet + FaceNetNN4Small2 zoo models and the center-loss head."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import MultiDataSet
+from deeplearning4j_tpu.zoo import FaceNetNN4Small2, NASNet
+
+
+def _img_batch(n, h, w, c=3, seed=0):
+    return np.random.default_rng(seed).normal(0, 1, (n, h, w, c)).astype(np.float32)
+
+
+class TestNASNet:
+    def test_builds_and_forward_shape(self):
+        m = NASNet(num_classes=10, height=32, width=32,
+                   cells_per_stack=1, cell_filters=8, stem_filters=8).init_model()
+        out = m.output(_img_batch(2, 32, 32))
+        assert out.shape == (2, 10)
+        probs = np.asarray(out)
+        np.testing.assert_allclose(probs.sum(axis=1), 1.0, atol=1e-4)
+
+    def test_train_step_finite(self):
+        m = NASNet(num_classes=5, height=32, width=32,
+                   cells_per_stack=1, cell_filters=8, stem_filters=8).init_model()
+        x = _img_batch(4, 32, 32)
+        y = np.eye(5, dtype=np.float32)[np.arange(4) % 5]
+        m.fit_batch(MultiDataSet((x,), (y,)))
+        assert np.isfinite(m.score_value)
+
+    def test_filter_progression_doubles_on_reduction(self):
+        m = NASNet(num_classes=3, height=32, width=32,
+                   cells_per_stack=1, cell_filters=8, stem_filters=8)
+        conf = m.conf()
+        by_name = {n.name: n for n in conf.nodes}
+        # reduction-cell separables carry 2x / 4x the base filters
+        assert by_name["s0_red_x1a_s1"].layer.n_out == 16
+        assert by_name["s1_red_x1a_s1"].layer.n_out == 32
+
+
+class TestFaceNet:
+    def test_builds_and_embedding_is_l2_normalized(self):
+        m = FaceNetNN4Small2(num_classes=8, height=64, width=64,
+                             embedding_size=32).init_model()
+        out = m.output(_img_batch(3, 64, 64, seed=1))
+        out = np.asarray(out)
+        assert out.shape == (3, 8 + 32)     # [logits, embedding]
+        emb = out[:, 8:]
+        np.testing.assert_allclose(
+            np.linalg.norm(emb, axis=1), 1.0, atol=1e-3
+        )
+
+    def test_center_loss_training_reduces_loss(self):
+        m = FaceNetNN4Small2(num_classes=4, height=64, width=64,
+                             embedding_size=16, learning_rate=3e-3).init_model()
+        rng = np.random.default_rng(2)
+        cls = np.arange(8) % 4
+        x = _img_batch(8, 64, 64, seed=3) + cls[:, None, None, None]
+        y = np.eye(4, dtype=np.float32)[cls]
+        scores = []
+        for _ in range(12):
+            m.fit_batch(MultiDataSet((x,), (y,)))
+            scores.append(m.score_value)
+        assert scores[-1] < scores[0], scores
+
+
+class TestCenterLossLayerUnit:
+    def test_center_gradient_pulls_centers_toward_embeddings(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf import CenterLossOutputLayer, InputType
+
+        layer = CenterLossOutputLayer(n_out=2, alpha=1.0, lambda_coeff=1.0)
+        params, _ = layer.init(jax.random.key(0), InputType.feed_forward(3))
+        x = jnp.asarray([[1.0, 0.0, 0.0], [0.0, 1.0, 0.0]], jnp.float32)
+        labels = jnp.eye(2, dtype=jnp.float32)
+        out, _ = layer.apply(params, {}, x)
+
+        g = jax.grad(
+            lambda lp: layer.compute_loss_with_params(lp, out, labels)
+        )(params)
+        # center term: d/dc 0.5||e - c||^2 = (c - e); centers start at 0,
+        # so the gradient points AWAY from each class's embedding
+        np.testing.assert_allclose(
+            np.asarray(g["centers"]), -np.asarray(x) / 2, atol=1e-6
+        )
+
+    def test_alpha_scales_center_gradient_only(self):
+        import jax
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf import CenterLossOutputLayer, InputType
+
+        x = jnp.asarray([[1.0, 2.0]], jnp.float32)
+        labels = jnp.asarray([[1.0, 0.0]], jnp.float32)
+        grads = {}
+        for alpha in (1.0, 0.25):
+            layer = CenterLossOutputLayer(n_out=2, alpha=alpha, lambda_coeff=1.0)
+            params, _ = layer.init(jax.random.key(1), InputType.feed_forward(2))
+            out, _ = layer.apply(params, {}, x)
+            g = jax.grad(
+                lambda lp: layer.compute_loss_with_params(lp, out, labels)
+            )(params)
+            grads[alpha] = (np.asarray(g["centers"]), np.asarray(g["W"]))
+        np.testing.assert_allclose(
+            grads[0.25][0], grads[1.0][0] * 0.25, atol=1e-6
+        )
+        np.testing.assert_allclose(grads[0.25][1], grads[1.0][1], atol=1e-6)
+
+    def test_sequential_model_center_loss_end_to_end(self):
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn import Adam
+        from deeplearning4j_tpu.nn.activations import Activation
+        from deeplearning4j_tpu.nn.conf import (
+            CenterLossOutputLayer, Dense, InputType, NeuralNetConfiguration,
+        )
+
+        rng = np.random.default_rng(5)
+        cls = rng.integers(0, 2, 128)
+        x = (rng.normal(0, 0.4, (128, 4)) + cls[:, None] * 2).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[cls]
+        conf = (
+            NeuralNetConfiguration.builder().seed(6).updater(Adam(5e-3))
+            .list()
+            .layer(Dense(n_out=8, activation=Activation.RELU))
+            .layer(CenterLossOutputLayer(n_out=2, lambda_coeff=1e-3))
+            .set_input_type(InputType.feed_forward(4))
+            .build()
+        )
+        m = SequentialModel(conf).init()
+        m.fit((x, y), epochs=30, batch_size=64)
+        out = np.asarray(m.output(x))
+        layer = conf.layers[-1]
+        logits, emb = layer.split_output(out)
+        acc = (logits.argmax(axis=1) == cls).mean()
+        assert acc > 0.95, acc
+        # intra-class embedding scatter < inter-class center distance
+        c0, c1 = emb[cls == 0].mean(0), emb[cls == 1].mean(0)
+        intra = max(emb[cls == 0].std(), emb[cls == 1].std())
+        assert np.linalg.norm(c0 - c1) > intra
+
+
+def test_center_loss_evaluate_uses_logits_half():
+    """evaluate() on a center-loss model must argmax the logits half of
+    the concatenated output, not the raw concat."""
+    from deeplearning4j_tpu.data import DataSet
+    from deeplearning4j_tpu.models import SequentialModel
+    from deeplearning4j_tpu.nn import Adam
+    from deeplearning4j_tpu.nn.activations import Activation
+    from deeplearning4j_tpu.nn.conf import (
+        CenterLossOutputLayer, Dense, InputType, NeuralNetConfiguration,
+    )
+
+    rng = np.random.default_rng(7)
+    cls = rng.integers(0, 2, 128)
+    x = (rng.normal(0, 0.4, (128, 4)) + cls[:, None] * 2).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[cls]
+    conf = (
+        NeuralNetConfiguration.builder().seed(8).updater(Adam(5e-3))
+        .list()
+        .layer(Dense(n_out=8, activation=Activation.RELU))
+        .layer(CenterLossOutputLayer(n_out=2, lambda_coeff=1e-3))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    m.fit((x, y), epochs=25, batch_size=64)
+    acc = m.evaluate(DataSet(x, y)).accuracy()
+    assert acc > 0.95, acc
