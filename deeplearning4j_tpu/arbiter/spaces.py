@@ -56,6 +56,11 @@ class DiscreteParameterSpace(ParameterSpace):
     values: tuple
 
     def __init__(self, *values):
+        # accept both call shapes: (a, b, c) and ([a, b, c]) — a single
+        # sequence argument is unpacked; otherwise the candidate would
+        # silently BE the list (never what a search means)
+        if len(values) == 1 and isinstance(values[0], (list, tuple)):
+            values = tuple(values[0])
         object.__setattr__(self, "values", tuple(values))
         if not self.values:
             raise ValueError("DiscreteParameterSpace needs at least one value")

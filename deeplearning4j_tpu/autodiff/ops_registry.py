@@ -416,7 +416,9 @@ def _lrn(x, *, size=5, alpha=1e-4, beta=0.75, bias=2.0):
     """Local response normalization across the TRAILING (channel) axis
     (channels-last; the ONNX/reference op normalizes across C)."""
     sq = jnp.square(x)
-    half = size // 2
+    # ONNX window: [c - floor((size-1)/2), c + ceil((size-1)/2)] — the
+    # extra element of an even window goes RIGHT
+    half = (size - 1) // 2
     pad = [(0, 0)] * (x.ndim - 1) + [(half, size - 1 - half)]
     cs = jnp.cumsum(jnp.pad(sq, pad), axis=-1)
     cs = jnp.pad(cs, [(0, 0)] * (x.ndim - 1) + [(1, 0)])

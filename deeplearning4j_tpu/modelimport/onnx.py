@@ -890,12 +890,15 @@ class _Importer:
         auto = a.get("auto_pad", "NOTSET")
         auto = auto.decode() if isinstance(auto, bytes) else auto
         pads = a.get("pads")
-        if auto == "SAME_UPPER" or (auto in ("NOTSET", "") and not pads):
-            padding = "SAME" if auto == "SAME_UPPER" else "VALID"
+        # torch.onnx emits pads=[0,0,0,0] for padding=0 — that IS VALID
+        if auto == "SAME_UPPER":
+            padding = "SAME"
+        elif auto in ("NOTSET", "") and (not pads or not any(pads)):
+            padding = "VALID"
         else:
             raise ONNXImportError(
-                "ConvTranspose with explicit pads unmapped (re-export with "
-                "auto_pad)"
+                "ConvTranspose with nonzero explicit pads unmapped "
+                "(re-export with auto_pad)"
             )
         x = self.sd.apply("transpose", self.in_var(node.input[0]),
                           axes=list(_NCHW_TO_NHWC))

@@ -721,11 +721,15 @@ class SequentialModel(Model):
                     self.params.get(last.name, {}), probs
                 )
             labels = batch.labels
-            if np.ndim(labels) >= 1 and np.asarray(probs).shape[-1] != np.asarray(labels).shape[-1]:
-                # int class ids (the chunked head's label form)
-                labels = np.eye(np.asarray(probs).shape[-1], dtype=np.float32)[
-                    np.asarray(labels).astype(int)
-                ]
+            parr = np.asarray(probs)
+            if np.ndim(labels) >= 1 and parr.shape[-1] != np.asarray(labels).shape[-1]:
+                # int class ids (the chunked head's label form); build the
+                # one-hot batch directly — np.eye(vocab) would be a
+                # vocab^2 identity for exactly the large-vocab case
+                ids = np.asarray(labels).astype(np.int64)
+                onehot = np.zeros(ids.shape + (parr.shape[-1],), np.float32)
+                np.put_along_axis(onehot, ids[..., None], 1.0, axis=-1)
+                labels = onehot
             ev.eval(labels, np.asarray(probs), mask=batch.labels_mask)
         return ev
 

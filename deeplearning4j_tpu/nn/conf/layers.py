@@ -846,8 +846,18 @@ class ChunkedSoftmaxOutputLayer(LayerConfig):
         d = preds.shape[-1]
         h = preds.reshape(-1, d)
         labels = jnp.asarray(labels)
-        if labels.ndim >= 2 and labels.shape[-1] == self.n_out:
-            labels = jnp.argmax(labels, axis=-1)        # one-hot fallback
+        # disambiguate by ELEMENT COUNT, not trailing-dim match: when the
+        # sequence length equals the vocab size, (B, T) int ids would
+        # otherwise be misread as (B, V) one-hot
+        if labels.size == h.shape[0] * self.n_out:
+            labels = jnp.argmax(
+                labels.reshape(h.shape[0], self.n_out), axis=-1
+            )                                            # one-hot fallback
+        elif labels.size != h.shape[0]:
+            raise ValueError(
+                f"labels with {labels.size} elements fit neither int ids "
+                f"({h.shape[0]}) nor one-hot ({h.shape[0]}x{self.n_out})"
+            )
         ids = labels.reshape(-1).astype(jnp.int32)
         if mask is not None:
             w = jnp.asarray(mask).reshape(-1).astype(jnp.float32)

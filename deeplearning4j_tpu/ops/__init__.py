@@ -4,5 +4,22 @@ Where the reference enumerates ~500 declarable ops executed one JNI call at
 a time (SURVEY.md §2.1), here ops are pure jax functions meant to be traced
 into larger computations.  jnp/lax already cover the op surface; this
 package holds the ops worth owning: fused attention (incl. ring/Ulysses in
-parallel/), and op-validation utilities used by the test corpus.
+parallel/), Pallas flash attention, chunked large-vocab cross-entropy,
+KV-cache generation, and op-validation utilities used by the test corpus.
 """
+
+__all__ = ["chunked_softmax_xent", "generate"]
+
+
+def __getattr__(name):
+    # lazy: generation imports nn.conf.attention, which imports
+    # ops.attention — eager re-exports here would close that cycle
+    if name == "chunked_softmax_xent":
+        from deeplearning4j_tpu.ops.chunked_xent import chunked_softmax_xent
+
+        return chunked_softmax_xent
+    if name == "generate":
+        from deeplearning4j_tpu.ops.generation import generate
+
+        return generate
+    raise AttributeError(name)

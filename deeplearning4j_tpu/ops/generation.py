@@ -1,0 +1,255 @@
+"""KV-cache autoregressive decoding for DSL-built transformer stacks.
+
+The reference's only generation story is RNN `rnnTimeStep` streaming; a
+transformer decoded that way recomputes full-sequence attention per token
+(O(T^2) per step).  Here `generate()` introspects a SequentialModel built
+as [Embedding, PositionalEncoding, TransformerEncoderBlock*, head],
+prefllls per-block K/V caches from the prompt in ONE dense forward, then
+decodes with a `lax.scan` whose body attends one query row against the
+cache — O(T) per step, static shapes throughout, the whole decode loop a
+single compiled XLA program.  Greedy, temperature, and top-k sampling.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from deeplearning4j_tpu.nn.conf.attention import (
+    PositionalEncoding,
+    TransformerEncoderBlock,
+)
+from deeplearning4j_tpu.nn.conf.layers import (
+    ChunkedSoftmaxOutputLayer,
+    Embedding,
+    LayerConfig,
+)
+from deeplearning4j_tpu.nn.conf.recurrent import RnnOutputLayer
+
+
+def _plan(model):
+    """Validate the stack shape and return (embed, pos, blocks, head) with
+    their layer names."""
+    layers = list(model.conf.layers)
+    if not layers or not isinstance(layers[0], Embedding):
+        raise ValueError("generate() needs an Embedding first layer")
+    embed = layers[0]
+    i = 1
+    pos = None
+    if i < len(layers) and isinstance(layers[i], PositionalEncoding):
+        pos = layers[i]
+        i += 1
+    blocks = []
+    while i < len(layers) and isinstance(layers[i], TransformerEncoderBlock):
+        blocks.append(layers[i])
+        i += 1
+    if i != len(layers) - 1:
+        raise ValueError(
+            "generate() supports [Embedding, PositionalEncoding?, "
+            "TransformerEncoderBlock*, head] stacks; layer "
+            f"{type(layers[i]).__name__} at position {i} is not supported"
+        )
+    head = layers[-1]
+    if not isinstance(head, (RnnOutputLayer, ChunkedSoftmaxOutputLayer)):
+        raise ValueError(
+            f"unsupported head {type(head).__name__}; need RnnOutputLayer "
+            "or ChunkedSoftmaxOutputLayer"
+        )
+    for b in blocks:
+        if not b.causal:
+            raise ValueError(
+                "generate() requires causal blocks (bidirectional attention "
+                "cannot decode autoregressively)"
+            )
+    return embed, pos, blocks, head
+
+
+def _pe_row(pos_layer, lp, t, d):
+    """Positional-encoding row for (traced) position t."""
+    if pos_layer is None:
+        return jnp.zeros((d,), jnp.float32)
+    if pos_layer.learned:
+        return lp["P"][t].astype(jnp.float32)
+    div = jnp.exp(
+        jnp.arange(0, d, 2, dtype=jnp.float32) * (-jnp.log(10000.0) / d)
+    )
+    tf = t.astype(jnp.float32)
+    row = jnp.zeros((d,), jnp.float32)
+    row = row.at[0::2].set(jnp.sin(tf * div))
+    row = row.at[1::2].set(jnp.cos(tf * div[: d // 2]))
+    return row
+
+
+def _ln(lp, x):
+    mean = jnp.mean(x, axis=-1, keepdims=True)
+    var = jnp.var(x, axis=-1, keepdims=True)
+    y = (x - mean) * lax.rsqrt(var + 1e-5)
+    return y * lp["gamma"].astype(x.dtype) + lp["beta"].astype(x.dtype)
+
+
+def _block_prefill(cfg, lp, x, mask):
+    """Dense block forward on the prompt that ALSO returns the K/V it
+    computed (cache seed).  x: (B, T, D)."""
+    b, t, d = x.shape
+    h_, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+    ap = lp["attn"]
+    hh = _ln(lp["ln1"], x)
+    q = (hh @ ap["Wq"].astype(x.dtype)).reshape(b, t, h_, dh)
+    k = (hh @ ap["Wk"].astype(x.dtype)).reshape(b, t, h_, dh)
+    v = (hh @ ap["Wv"].astype(x.dtype)).reshape(b, t, h_, dh)
+    from deeplearning4j_tpu.ops.attention import mha
+
+    out = mha(q, k, v, causal=True, mask=mask)
+    x = x + out.reshape(b, t, h_ * dh) @ ap["Wo"].astype(x.dtype)
+    hh = _ln(lp["ln2"], x)
+    hh = cfg.ffn_activation(hh @ lp["W1"].astype(x.dtype) + lp["b1"].astype(x.dtype))
+    x = x + (hh @ lp["W2"].astype(x.dtype) + lp["b2"].astype(x.dtype))
+    return x, k, v
+
+
+def _block_step(cfg, lp, x_t, k_cache, v_cache, pos):
+    """One-token block step against the cache.  x_t: (B, D);
+    caches: (B, L, H, Dh); pos: scalar current position."""
+    b, d = x_t.shape
+    h_, dh = cfg.n_heads, cfg.d_model // cfg.n_heads
+    L = k_cache.shape[1]
+    ap = lp["attn"]
+    hh = _ln(lp["ln1"], x_t)
+    q = (hh @ ap["Wq"].astype(x_t.dtype)).reshape(b, h_, dh)
+    k_t = (hh @ ap["Wk"].astype(x_t.dtype)).reshape(b, h_, dh)
+    v_t = (hh @ ap["Wv"].astype(x_t.dtype)).reshape(b, h_, dh)
+    k_cache = lax.dynamic_update_index_in_dim(k_cache, k_t, pos, axis=1)
+    v_cache = lax.dynamic_update_index_in_dim(v_cache, v_t, pos, axis=1)
+    scores = jnp.einsum("bhd,blhd->bhl", q.astype(jnp.float32),
+                        k_cache.astype(jnp.float32)) / np.sqrt(dh)
+    live = jnp.arange(L)[None, None, :] <= pos
+    scores = jnp.where(live, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhl,blhd->bhd", p, v_cache.astype(jnp.float32))
+    out = out.reshape(b, h_ * dh).astype(x_t.dtype)
+    x_t = x_t + out @ ap["Wo"].astype(x_t.dtype)
+    hh = _ln(lp["ln2"], x_t)
+    hh = cfg.ffn_activation(hh @ lp["W1"].astype(x_t.dtype) + lp["b1"].astype(x_t.dtype))
+    x_t = x_t + (hh @ lp["W2"].astype(x_t.dtype) + lp["b2"].astype(x_t.dtype))
+    return x_t, k_cache, v_cache
+
+
+def _head_logits(head, lp, h):
+    """h: (..., D) -> (..., vocab) logits."""
+    if isinstance(head, ChunkedSoftmaxOutputLayer):
+        return head.logits(lp, h)
+    y = h @ lp["W"].astype(h.dtype)
+    if head.has_bias:
+        y = y + lp["b"].astype(h.dtype)
+    return y
+
+
+def _sample(logits, *, temperature, top_k, rng):
+    logits = logits.astype(jnp.float32)
+    if temperature <= 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    logits = logits / temperature
+    if top_k > 0:
+        kth = lax.top_k(logits, top_k)[0][..., -1:]
+        logits = jnp.where(logits < kth, -jnp.inf, logits)
+    return jax.random.categorical(rng, logits, axis=-1).astype(jnp.int32)
+
+
+def generate(model, prompt_ids, max_new_tokens: int, *,
+             temperature: float = 0.0, top_k: int = 0, seed: int = 0):
+    """Decode `max_new_tokens` continuations of `prompt_ids` (B, T_p) int.
+
+    Returns (B, T_p + max_new_tokens) int32 — prompt followed by the
+    generated tokens.  temperature=0 is greedy; top_k>0 restricts
+    sampling to the k most likely tokens.  The decode loop is one
+    compiled scan; recompilation happens per (prompt length,
+    max_new_tokens) shape pair.
+    """
+    if model.params is None:
+        model.init()
+    embed, pos, blocks, head = _plan(model)
+    prompt = jnp.asarray(prompt_ids).astype(jnp.int32)
+    if prompt.ndim == 1:
+        prompt = prompt[None, :]
+    if max_new_tokens <= 0:
+        return prompt
+    if pos is not None and pos.learned:
+        total = prompt.shape[1] + max_new_tokens
+        if total > pos.max_length:
+            # the dense forward raises for over-length sequences; silent
+            # index clamping here would reuse the last PE row instead
+            raise ValueError(
+                f"prompt + max_new_tokens = {total} exceeds the learned "
+                f"PositionalEncoding max_length {pos.max_length}"
+            )
+    key = ("generate", int(max_new_tokens), float(temperature), int(top_k))
+    cache = getattr(model, "_gen_fns", None)
+    if cache is None:
+        cache = model._gen_fns = {}
+    if key not in cache:
+        cache[key] = _generate_jit(
+            model, embed, pos, tuple(blocks), head,
+            int(max_new_tokens), float(temperature), int(top_k),
+        )
+    return cache[key](model.params, prompt, jax.random.key(seed))
+
+
+def _generate_jit(model, embed, pos, blocks, head, max_new, temperature, top_k):
+    names = [l.name for l in model.conf.layers]
+    embed_name, head_name = names[0], names[-1]
+    block_names = [l.name for l in model.conf.layers
+                   if isinstance(l, TransformerEncoderBlock)]
+    pos_name = pos.name if pos is not None else None
+    d = embed.n_out
+
+    @jax.jit
+    def run(params, prompt, rng):
+        b, t_p = prompt.shape
+        L = t_p + max_new
+        dt = jnp.bfloat16 if model._bf16 else jnp.float32
+        E = params[embed_name]["W"].astype(dt)
+
+        # ---- prefill: dense forward over the prompt, caches out ----
+        x = E[prompt]                                   # (B, T_p, D)
+        if pos is not None:
+            pe = jnp.stack(
+                [_pe_row(pos, params.get(pos_name, {}), jnp.asarray(i), d)
+                 for i in range(t_p)]
+            )
+            x = x + pe.astype(dt)
+        caches = []
+        for cfg, nm in zip(blocks, block_names):
+            x, k, v = _block_prefill(cfg, params[nm], x, None)
+            k_c = jnp.zeros((b, L) + k.shape[2:], k.dtype)
+            v_c = jnp.zeros((b, L) + v.shape[2:], v.dtype)
+            caches.append((
+                lax.dynamic_update_slice(k_c, k, (0, 0, 0, 0)),
+                lax.dynamic_update_slice(v_c, v, (0, 0, 0, 0)),
+            ))
+        logits = _head_logits(head, params[head_name], x[:, -1])
+        first = _sample(logits, temperature=temperature, top_k=top_k,
+                        rng=jax.random.fold_in(rng, 0))
+
+        # ---- decode loop: one token per tick against the caches ----
+        def tick(carry, i):
+            tok, caches = carry
+            t = t_p + i                                  # position of tok
+            x_t = E[tok] + _pe_row(pos, params.get(pos_name, {}), t, d).astype(dt)
+            new_caches = []
+            for cfg, nm, (k_c, v_c) in zip(blocks, block_names, caches):
+                x_t, k_c, v_c = _block_step(cfg, params[nm], x_t, k_c, v_c, t)
+                new_caches.append((k_c, v_c))
+            logits = _head_logits(head, params[head_name], x_t)
+            nxt = _sample(logits, temperature=temperature, top_k=top_k,
+                          rng=jax.random.fold_in(rng, i + 1))
+            return (nxt, tuple(new_caches)), tok
+
+        (last, _), toks = lax.scan(
+            tick, (first, tuple(caches)), jnp.arange(max_new - 1)
+        ) if max_new > 1 else ((first, None), jnp.zeros((0, b), jnp.int32))
+        gen = jnp.concatenate([jnp.swapaxes(toks, 0, 1), last[:, None]], axis=1)
+        return jnp.concatenate([prompt, gen], axis=1)
+
+    return run

@@ -1,0 +1,123 @@
+"""Preemption-aware checkpointing — the TPU-world failure-detection piece
+of SURVEY §5.3.
+
+Cloud TPU VMs receive SIGTERM with a short grace period before
+preemption; the reference's Spark story leans on task retry, but a
+TPU-native framework must save state INSIDE the doomed process.
+`PreemptionHandler` installs signal handlers that set a flag; the
+training loop (via its listener hook, called between steps — never
+mid-XLA-program) notices the flag at the next iteration boundary, writes
+a final checkpoint, notifies the coordinator (so elastic restore can
+pick it up), and optionally raises to stop the loop cleanly.
+
+    handler = PreemptionHandler(ShardedCheckpointer("/ckpts/run"))
+    model.set_listeners(handler.listener(), ...)
+    model.fit(data, epochs=...)     # SIGTERM -> checkpoint -> PreemptionError
+"""
+
+from __future__ import annotations
+
+import logging
+import signal
+import threading
+from typing import Optional
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class PreemptionError(RuntimeError):
+    """Raised by the listener after the preemption checkpoint landed."""
+
+
+class PreemptionHandler:
+    """Signal-flag + checkpoint-on-next-step-boundary.
+
+    checkpointer: anything with save(model) + wait() (ShardedCheckpointer)
+    or save-like callable via `on_preempt`.  The signal handler itself
+    only sets a flag — async-signal-safe by construction; all real work
+    happens on the training thread at the next iteration boundary.
+    """
+
+    def __init__(self, checkpointer=None, *, signals=(signal.SIGTERM,),
+                 coordinator=None, raise_after_save: bool = True,
+                 on_preempt=None):
+        self.checkpointer = checkpointer
+        self.coordinator = coordinator
+        self.raise_after_save = raise_after_save
+        self.on_preempt = on_preempt
+        self._flag = threading.Event()
+        self._signals = tuple(signals)
+        self._prev: dict = {}
+        self._installed = False
+
+    # -- signal plumbing ---------------------------------------------------
+    def install(self) -> "PreemptionHandler":
+        if self._installed:
+            return self
+        for sig in self._signals:
+            self._prev[sig] = signal.signal(sig, self._on_signal)
+        self._installed = True
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            signal.signal(sig, prev)
+        self._prev.clear()
+        self._installed = False
+
+    def _on_signal(self, signum, frame):
+        log.warning("signal %s received: checkpointing at next step boundary",
+                    signum)
+        self._flag.set()
+
+    @property
+    def preempted(self) -> bool:
+        return self._flag.is_set()
+
+    def trigger(self) -> None:
+        """Programmatic preemption (tests / external watchers)."""
+        self._flag.set()
+
+    # -- training-loop side ------------------------------------------------
+    def check(self, model) -> bool:
+        """Call between steps: if preempted, save + notify; returns True
+        (or raises PreemptionError when raise_after_save)."""
+        if not self._flag.is_set():
+            return False
+        if self.on_preempt is not None:
+            self.on_preempt(model)
+        if self.checkpointer is not None:
+            step = self.checkpointer.save(model)
+            self.checkpointer.wait()
+            log.warning("preemption checkpoint saved at step %s", step)
+        if self.coordinator is not None:
+            try:
+                self.coordinator.report_preemption()
+            except Exception:   # notification is best-effort by design
+                log.exception("coordinator preemption notification failed")
+        if self.raise_after_save:
+            raise PreemptionError("preempted; checkpoint saved")
+        return True
+
+    def listener(self) -> "PreemptionListener":
+        self.install()
+        return PreemptionListener(self)
+
+
+class PreemptionListener:
+    """TrainingListener adapter: checks the flag after every iteration."""
+
+    def __init__(self, handler: PreemptionHandler):
+        self.handler = handler
+
+    def iteration_done(self, model, iteration, epoch, score):
+        self.handler.check(model)
+
+    def on_epoch_start(self, model, epoch):
+        pass
+
+    def on_epoch_end(self, model, epoch):
+        pass
+
+    def on_fit_end(self, model):
+        pass

@@ -1,0 +1,87 @@
+"""Hyperparameter search with the Arbiter-role API.
+
+Random search over learning rate (log-uniform — the right prior) and
+hidden width; the runner trains/scores each candidate, appends crash-safe
+jsonl progress, and serializes the best model.
+
+Run:  python examples/hpo_search.py          (EXAMPLE_QUICK=1 to smoke)
+"""
+
+import os
+import tempfile
+
+import numpy as np
+
+from deeplearning4j_tpu.arbiter import (
+    ContinuousParameterSpace,
+    DiscreteParameterSpace,
+    EvaluationScoreFunction,
+    OptimizationRunner,
+    RandomSearchGenerator,
+)
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn import Adam
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+
+QUICK = os.environ.get("EXAMPLE_QUICK", "") not in ("", "0")
+
+
+def data(n=512, seed=0):
+    rng = np.random.default_rng(seed)
+    cls = rng.integers(0, 2, n)
+    x = (rng.normal(0, 0.6, (n, 8)) + cls[:, None]).astype(np.float32)
+    return DataSet(x, np.eye(2, dtype=np.float32)[cls])
+
+
+def main():
+    train, val = data(seed=0), data(seed=1)
+
+    def model_factory(cand: dict) -> SequentialModel:
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(7)
+            .updater(Adam(cand["lr"]))
+            .list()
+            .layer(Dense(n_out=int(cand["hidden"]), activation=Activation.RELU))
+            .layer(OutputLayer(n_out=2, loss=Loss.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build()
+        )
+        return SequentialModel(conf).init()
+
+    out_dir = tempfile.mkdtemp()
+    runner = OptimizationRunner(
+        RandomSearchGenerator(
+            {
+                "lr": ContinuousParameterSpace(1e-4, 1e-1, log=True),
+                "hidden": DiscreteParameterSpace([8, 32, 64]),
+            },
+            seed=3,
+        ),
+        model_factory,
+        EvaluationScoreFunction(val, metric="accuracy"),
+        fitter=lambda model: model.fit(
+            train, epochs=3 if QUICK else 15, batch_size=64
+        ),
+        max_candidates=3 if QUICK else 12,
+        results_path=os.path.join(out_dir, "hpo.jsonl"),
+        save_best_dir=out_dir,
+    ).execute()
+
+    best = runner.best()
+    print("best candidate:", best.candidate, "accuracy:", best.score)
+    print("results:", os.path.join(out_dir, "hpo.jsonl"))
+    return best.score
+
+
+if __name__ == "__main__":
+    main()

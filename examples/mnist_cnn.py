@@ -1,0 +1,66 @@
+"""LeNet-style CNN on MNIST — the canonical first example (the
+reference's LenetMnistExample role).
+
+Run:  python examples/mnist_cnn.py
+Set EXAMPLE_QUICK=1 for a seconds-long smoke run (used by the tests).
+"""
+
+import os
+
+from deeplearning4j_tpu.data.builtin import MnistDataSetIterator
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn import Adam
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    Conv2D,
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+    PoolingType,
+    Subsampling,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.train import PerformanceListener, ScoreIterationListener
+
+QUICK = os.environ.get("EXAMPLE_QUICK", "") not in ("", "0")
+
+
+def build_model() -> SequentialModel:
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(123)
+        .updater(Adam(1e-3))
+        .activation(Activation.RELU)
+        .list()
+        .layer(Conv2D(n_out=20, kernel=(5, 5)))
+        .layer(Subsampling(pooling=PoolingType.MAX, kernel=(2, 2), stride=(2, 2)))
+        .layer(Conv2D(n_out=50, kernel=(5, 5)))
+        .layer(Subsampling(pooling=PoolingType.MAX, kernel=(2, 2), stride=(2, 2)))
+        .layer(Dense(n_out=500))
+        .layer(OutputLayer(n_out=10, loss=Loss.MCXENT,
+                           activation=Activation.SOFTMAX))
+        .set_input_type(InputType.convolutional(28, 28, 1))
+        .build()
+    )
+    return SequentialModel(conf).init()
+
+
+def main() -> float:
+    n_train = 2000 if QUICK else 60000
+    epochs = 1 if QUICK else 3
+    train = MnistDataSetIterator(batch_size=128, train=True, num_examples=n_train)
+    test = MnistDataSetIterator(batch_size=512, train=False,
+                                num_examples=1000 if QUICK else 10000)
+    model = build_model()
+    model.set_listeners(ScoreIterationListener(20), PerformanceListener(20))
+    model.fit(train, epochs=epochs)
+    acc = model.evaluate(test).accuracy()
+    print(f"test accuracy: {acc:.4f}")
+    model.save("/tmp/mnist_cnn.zip")
+    print("saved to /tmp/mnist_cnn.zip")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

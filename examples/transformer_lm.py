@@ -1,0 +1,59 @@
+"""Character-level transformer LM: chunked-vocab loss + KV-cache sampling.
+
+Trains a small causal transformer on synthetic "abab..." grammar text,
+then generates continuations with the KV-cache decoder.
+
+Run:  python examples/transformer_lm.py        (EXAMPLE_QUICK=1 to smoke)
+"""
+
+import os
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.ops.generation import generate
+from deeplearning4j_tpu.zoo.transformer import TransformerEncoder
+
+QUICK = os.environ.get("EXAMPLE_QUICK", "") not in ("", "0")
+
+VOCAB = 16
+ALPHABET = "abcdefghijklmnop"
+
+
+def corpus(n_seqs=512, seq_len=32, seed=0):
+    """Deterministic cyclic grammar: token (i+1) follows token i."""
+    rng = np.random.default_rng(seed)
+    starts = rng.integers(0, VOCAB, n_seqs)
+    ids = (starts[:, None] + np.arange(seq_len)[None, :]) % VOCAB
+    return ids
+
+
+def main() -> float:
+    steps = 30 if QUICK else 300
+    ids = corpus(128 if QUICK else 512)
+    x = ids.astype(np.float32)
+    y = np.roll(ids, -1, axis=1).astype(np.float32)   # int next-token ids
+
+    model = TransformerEncoder(
+        vocab_size=VOCAB, d_model=32, n_heads=4, n_layers=2, causal=True,
+        chunked_vocab_loss=True, vocab_chunk=8, learning_rate=3e-3, seed=7,
+    ).init_model()
+    ds = DataSet(x, y)
+    for step in range(steps):
+        model.fit_batch(ds)
+        if step % 50 == 0:
+            print(f"step {step}: loss {model.score_value:.4f}")
+
+    prompt = corpus(2, 8, seed=9)
+    out = np.asarray(generate(model, prompt, 12, temperature=0.0))
+    for row in out:
+        print("generated:", "".join(ALPHABET[t] for t in row))
+    # the grammar is deterministic: continuation quality is measurable
+    want = (out[:, 7][:, None] + 1 + np.arange(12)[None, :]) % VOCAB
+    acc = float((out[:, 8:] == want).mean())
+    print(f"continuation accuracy: {acc:.3f}")
+    return acc
+
+
+if __name__ == "__main__":
+    main()

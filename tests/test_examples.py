@@ -1,0 +1,37 @@
+"""Every example under examples/ runs end-to-end in quick mode and
+reaches a sane outcome — the examples ARE the user-facing contract."""
+
+import os
+import runpy
+
+import pytest
+
+EXAMPLES = os.path.join(os.path.dirname(__file__), "..", "examples")
+
+
+def _run(name, monkeypatch):
+    monkeypatch.setenv("EXAMPLE_QUICK", "1")
+    path = os.path.join(EXAMPLES, name)
+    mod = runpy.run_path(path, run_name="not_main")
+    return mod["main"]()
+
+
+def test_mnist_cnn_example(monkeypatch):
+    assert _run("mnist_cnn.py", monkeypatch) > 0.8
+
+
+def test_transformer_lm_example(monkeypatch):
+    # runs end-to-end incl. generate
+    assert _run("transformer_lm.py", monkeypatch) >= 0.0
+
+
+def test_multichip_parallel_example(monkeypatch):
+    assert _run("multichip_parallel.py", monkeypatch) > 0.8
+
+
+def test_hpo_search_example(monkeypatch):
+    assert _run("hpo_search.py", monkeypatch) > 0.5
+
+
+def test_audio_classify_example(monkeypatch):
+    assert _run("audio_classify.py", monkeypatch) > 0.9

@@ -1,0 +1,91 @@
+"""Preemption-aware checkpointing (§5.3 failure detection on TPU)."""
+
+import os
+import signal
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn import Adam
+from deeplearning4j_tpu.nn.conf import (
+    Dense, InputType, NeuralNetConfiguration, OutputLayer,
+)
+from deeplearning4j_tpu.train.preemption import (
+    PreemptionError,
+    PreemptionHandler,
+)
+from deeplearning4j_tpu.train.sharded_checkpoint import ShardedCheckpointer
+
+
+def _model():
+    conf = (
+        NeuralNetConfiguration.builder().seed(1).updater(Adam(1e-2))
+        .list()
+        .layer(Dense(n_out=8))
+        .layer(OutputLayer(n_out=2))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    return SequentialModel(conf).init()
+
+
+def _data():
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (64, 4)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 64)]
+    return DataSet(x, y)
+
+
+def test_trigger_saves_and_raises(tmp_path):
+    m = _model()
+    ckpt = ShardedCheckpointer(str(tmp_path / "p1"), async_save=False)
+    handler = PreemptionHandler(ckpt)
+    m.set_listeners(handler.listener())
+    handler.trigger()
+    with pytest.raises(PreemptionError):
+        m.fit(_data(), epochs=5, batch_size=32)
+    assert m.iteration >= 1                      # at least one step ran
+    steps = ckpt.all_steps()
+    assert steps, "no preemption checkpoint written"
+    m2 = ckpt.restore_model(steps[-1])
+    assert m2.iteration == steps[-1]
+    handler.uninstall()
+    ckpt.close()
+
+
+def test_real_signal_sets_flag_and_checkpoint_lands(tmp_path):
+    m = _model()
+    ckpt = ShardedCheckpointer(str(tmp_path / "p2"), async_save=False)
+    handler = PreemptionHandler(ckpt, signals=(signal.SIGUSR1,))
+    m.set_listeners(handler.listener())
+    ds = _data()
+    m.fit_batch(ds)                               # warm up / one clean step
+    os.kill(os.getpid(), signal.SIGUSR1)
+    assert handler.preempted
+    with pytest.raises(PreemptionError):
+        m.fit_batch(ds)
+    assert ckpt.all_steps()
+    handler.uninstall()
+    ckpt.close()
+
+
+def test_no_raise_mode_continues(tmp_path):
+    saves = []
+    m = _model()
+    handler = PreemptionHandler(raise_after_save=False,
+                                on_preempt=lambda model: saves.append(model.iteration))
+    m.set_listeners(handler.listener())
+    handler.trigger()
+    m.fit(_data(), epochs=1, batch_size=32)       # runs to completion
+    assert saves and saves[0] >= 0
+    handler.uninstall()
+
+
+def test_uninstall_restores_previous_handler():
+    prev = signal.getsignal(signal.SIGUSR2)
+    h = PreemptionHandler(signals=(signal.SIGUSR2,)).install()
+    assert signal.getsignal(signal.SIGUSR2) == h._on_signal
+    h.uninstall()
+    assert signal.getsignal(signal.SIGUSR2) == prev

@@ -1,0 +1,121 @@
+"""Orbax-backed sharded/async checkpointing (§5.4's distributed variant)."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from deeplearning4j_tpu.data import DataSet
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn import Adam
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.parallel import ParallelConfig, distribute
+from deeplearning4j_tpu.train.sharded_checkpoint import (
+    ShardedCheckpointer,
+    ShardedCheckpointListener,
+)
+
+
+def _model(seed=3):
+    conf = (
+        NeuralNetConfiguration.builder().seed(seed).updater(Adam(1e-2))
+        .list()
+        .layer(Dense(n_out=16, activation=Activation.RELU))
+        .layer(OutputLayer(n_out=2, loss=Loss.MCXENT,
+                           activation=Activation.SOFTMAX))
+        .set_input_type(InputType.feed_forward(4))
+        .build()
+    )
+    return SequentialModel(conf).init()
+
+
+def _data(n=128, seed=0):
+    rng = np.random.default_rng(seed)
+    cls = rng.integers(0, 2, n)
+    x = (rng.normal(0, 0.5, (n, 4)) + cls[:, None]).astype(np.float32)
+    return DataSet(x, np.eye(2, dtype=np.float32)[cls])
+
+
+def _trees_equal(a, b):
+    for x, y in zip(jax.tree.leaves(a), jax.tree.leaves(b)):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+def test_save_restore_round_trip(tmp_path):
+    m = _model()
+    m.fit(_data(), epochs=3, batch_size=64)
+    ckpt = ShardedCheckpointer(str(tmp_path / "c1"))
+    step = ckpt.save(m)
+    ckpt.wait()
+    assert ckpt.all_steps() == [step]
+
+    m2 = ckpt.restore_model()
+    _trees_equal(m.params, m2.params)
+    _trees_equal(m.opt_state, m2.opt_state)
+    assert m2.iteration == m.iteration and m2.epoch == m.epoch
+    ds = _data(seed=9)
+    np.testing.assert_allclose(
+        np.asarray(m.output(ds.features)), np.asarray(m2.output(ds.features)),
+        atol=1e-6,
+    )
+    # training continues from the restored updater state
+    m2.fit(ds, epochs=1, batch_size=64)
+    assert np.isfinite(m2.score_value)
+    ckpt.close()
+
+
+def test_restore_into_preserves_sharding(tmp_path):
+    devs = jax.devices()[:4]
+    m = _model()
+    distribute(m, ParallelConfig(data=4), devices=devs)
+    m.fit(_data(), epochs=2, batch_size=64)
+    ckpt = ShardedCheckpointer(str(tmp_path / "c2"))
+    ckpt.save(m)
+    ckpt.wait()
+
+    m2 = _model()
+    distribute(m2, ParallelConfig(data=4), devices=devs)
+    ckpt.restore_into(m2)
+    _trees_equal(m.params, m2.params)
+    # leaves landed with the distributed sharding, not host-replicated
+    leaf = jax.tree.leaves(m2.params)[0]
+    want = jax.tree.leaves(m.params)[0].sharding
+    assert leaf.sharding.is_equivalent_to(want, leaf.ndim)
+    ckpt.close()
+
+
+def test_retention_max_to_keep(tmp_path):
+    m = _model()
+    ckpt = ShardedCheckpointer(str(tmp_path / "c3"), max_to_keep=2,
+                               async_save=False)
+    for step in (1, 2, 3, 4):
+        ckpt.save(m, step=step)
+    ckpt.wait()
+    assert ckpt.all_steps() == [3, 4]
+    assert ckpt.latest_step() == 4
+    ckpt.close()
+
+
+def test_listener_saves_during_fit(tmp_path):
+    m = _model()
+    lst = ShardedCheckpointListener(str(tmp_path / "c4"),
+                                    save_every_n_epochs=1, max_to_keep=None)
+    m.set_listeners(lst)
+    m.fit(_data(), epochs=3, batch_size=64)
+    assert len(lst.ckpt.all_steps()) == 3
+    m2 = lst.ckpt.restore_model()
+    _trees_equal(m.params, m2.params)
+    lst.ckpt.close()
+
+
+def test_restore_missing_raises(tmp_path):
+    ckpt = ShardedCheckpointer(str(tmp_path / "c5"))
+    with pytest.raises(FileNotFoundError):
+        ckpt.restore_model()
