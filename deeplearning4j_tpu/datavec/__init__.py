@@ -15,7 +15,10 @@ host ETL with device steps.
 from deeplearning4j_tpu.datavec.records import (
     CSVSequenceRecordReader,
     JDBCRecordReader,
+    balanced_path_filter,
     load_numeric_csv,
+    pattern_label_generator,
+    random_path_filter,
     RecordReader,
     CollectionRecordReader,
     CSVRecordReader,
@@ -63,4 +66,7 @@ __all__ = [
     "read_wav",
     "write_wav",
     "spectrogram",
+    "pattern_label_generator",
+    "random_path_filter",
+    "balanced_path_filter",
 ]

@@ -153,9 +153,18 @@ class ImageRecordReader(RecordReader):
         channels: int = 3,
         *,
         shuffle_seed: Optional[int] = None,
+        label_generator=None,
+        path_filter=None,
     ):
+        """label_generator: Path -> label string (default: parent dir —
+        the ParentPathLabelGenerator behavior; see
+        pattern_label_generator for the filename-pattern variant).
+        path_filter: list[Path] -> list[Path] applied before shuffling
+        (random_path_filter / balanced_path_filter roles)."""
         self.height, self.width, self.channels = height, width, channels
         self._shuffle_seed = shuffle_seed
+        self._label_of = label_generator or (lambda p: p.parent.name)
+        self._path_filter = path_filter
         self._files: List[Path] = []
         self.labels: List[str] = []
 
@@ -166,7 +175,11 @@ class ImageRecordReader(RecordReader):
         )
         if not self._files:
             raise FileNotFoundError(f"no images under {root}")
-        self.labels = sorted({p.parent.name for p in self._files})
+        if self._path_filter is not None:
+            self._files = list(self._path_filter(self._files))
+            if not self._files:
+                raise FileNotFoundError("path_filter removed every image")
+        self.labels = sorted({self._label_of(p) for p in self._files})
         if self._shuffle_seed is not None:
             random.Random(self._shuffle_seed).shuffle(self._files)
         return self
@@ -201,7 +214,57 @@ class ImageRecordReader(RecordReader):
     def __iter__(self):
         label_idx = {name: i for i, name in enumerate(self.labels)}
         for p in self._files:
-            yield [self._decode(p), label_idx[p.parent.name]]
+            yield [self._decode(p), label_idx[self._label_of(p)]]
+
+
+def pattern_label_generator(delimiter: str = "_", position: int = 0):
+    """Label from a filename segment (PatternPathLabelGenerator role):
+    'cat_001.png' with delimiter '_' position 0 -> 'cat'."""
+
+    def gen(p: Path) -> str:
+        parts = p.stem.split(delimiter)
+        if position >= len(parts):
+            raise ValueError(
+                f"{p.name!r} has no segment {position} splitting on "
+                f"{delimiter!r}"
+            )
+        return parts[position]
+
+    return gen
+
+
+def random_path_filter(seed: int, max_paths: int):
+    """Random subsample of at most max_paths files (RandomPathFilter)."""
+
+    def filt(paths: List[Path]) -> List[Path]:
+        paths = list(paths)
+        if len(paths) <= max_paths:
+            return paths
+        return random.Random(seed).sample(paths, max_paths)
+
+    return filt
+
+
+def balanced_path_filter(seed: int, max_per_class: int, label_generator=None):
+    """At most max_per_class files per label, randomly chosen
+    (BalancedPathFilter): guards against class imbalance from lopsided
+    directory trees."""
+    label_of = label_generator or (lambda p: p.parent.name)
+
+    def filt(paths: List[Path]) -> List[Path]:
+        by_label: dict = {}
+        for p in paths:
+            by_label.setdefault(label_of(p), []).append(p)
+        rng = random.Random(seed)
+        out: List[Path] = []
+        for label in sorted(by_label):
+            group = by_label[label]
+            if len(group) > max_per_class:
+                group = rng.sample(group, max_per_class)
+            out.extend(group)
+        return out
+
+    return filt
 
 
 def load_numeric_csv(path, delimiter: str = ",", skip_lines: int = 0) -> "np.ndarray":

@@ -893,7 +893,7 @@ class _Importer:
         # torch.onnx emits pads=[0,0,0,0] for padding=0 — that IS VALID
         if auto == "SAME_UPPER":
             padding = "SAME"
-        elif auto in ("NOTSET", "") and (not pads or not any(pads)):
+        elif auto in ("NOTSET", "", "VALID") and (not pads or not any(pads)):
             padding = "VALID"
         else:
             raise ONNXImportError(

@@ -336,3 +336,73 @@ class PReLU(LayerConfig):
     def apply(self, params, state, x, *, training=False, rng=None):
         a = params["alpha"].astype(x.dtype)
         return jnp.where(x >= 0, x, a * x), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class Upsampling1D(LayerConfig):
+    """Nearest-neighbor upsampling along the time axis (Upsampling1D
+    role): (B, T, C) -> (B, T*size, C)."""
+
+    size: int = 2
+    EXPECTS = "rnn"
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def output_type(self, itype: InputType) -> InputType:
+        t = itype.shape[0]
+        return InputType.recurrent(itype.size, t if t < 0 else t * self.size)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        return jnp.repeat(x, self.size, axis=1), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class Upsampling3D(LayerConfig):
+    """Nearest-neighbor volumetric upsampling (Upsampling3D role):
+    (B, D, H, W, C) -> each spatial dim repeated by its factor."""
+
+    size: tuple = (2, 2, 2)
+    EXPECTS = "cnn3d"
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def __post_init__(self):
+        super().__post_init__()
+        s = self.size
+        if isinstance(s, int):
+            s = (s, s, s)
+        object.__setattr__(self, "size", tuple(int(v) for v in s))
+
+    def output_type(self, itype: InputType) -> InputType:
+        d, h, w, c = itype.shape
+        sd, sh, sw = self.size
+        return InputType.convolutional3d(d * sd, h * sh, w * sw, c)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        sd, sh, sw = self.size
+        y = jnp.repeat(x, sd, axis=1)
+        y = jnp.repeat(y, sh, axis=2)
+        return jnp.repeat(y, sw, axis=3), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class MaskZeroLayer(LayerConfig):
+    """Zero out padded timesteps (MaskZeroLayer role): activations at
+    mask==0 positions become `mask_value` so downstream layers never see
+    padding garbage.  The reference wraps an inner layer; here masking is
+    its own stack element (the wrapped layer simply precedes it)."""
+
+    mask_value: float = 0.0
+    EXPECTS = "rnn"
+    HAS_PARAMS = False
+    ACCEPTS_MASK = True
+    REGULARIZED = ()
+
+    def apply(self, params, state, x, *, training=False, rng=None, mask=None):
+        if mask is None:
+            return x, state
+        keep = mask.astype(x.dtype)[:, :, None]
+        return x * keep + (1.0 - keep) * self.mask_value, state

@@ -67,7 +67,9 @@ def _plan(model):
 
 
 def _pe_row(pos_layer, lp, t, d):
-    """Positional-encoding row for (traced) position t."""
+    """Positional-encoding row for ONE (traced) position t — the decode
+    tick's O(1) counterpart of PositionalEncoding.apply; keep the
+    sinusoidal formula in sync with attention.py."""
     if pos_layer is None:
         return jnp.zeros((d,), jnp.float32)
     if pos_layer.learned:
@@ -212,13 +214,12 @@ def _generate_jit(model, embed, pos, blocks, head, max_new, temperature, top_k):
         E = params[embed_name]["W"].astype(dt)
 
         # ---- prefill: dense forward over the prompt, caches out ----
-        x = E[prompt]                                   # (B, T_p, D)
+        # embed through the LAYER's semantics (its activation included)
+        x = embed._act()(E[prompt])                     # (B, T_p, D)
         if pos is not None:
-            pe = jnp.stack(
-                [_pe_row(pos, params.get(pos_name, {}), jnp.asarray(i), d)
-                 for i in range(t_p)]
-            )
-            x = x + pe.astype(dt)
+            # reuse the layer's own vectorized encoding — a per-position
+            # Python loop would unroll O(T_p) ops into the trace
+            x, _ = pos.apply(params.get(pos_name, {}), {}, x)
         caches = []
         for cfg, nm in zip(blocks, block_names):
             x, k, v = _block_prefill(cfg, params[nm], x, None)
@@ -236,7 +237,9 @@ def _generate_jit(model, embed, pos, blocks, head, max_new, temperature, top_k):
         def tick(carry, i):
             tok, caches = carry
             t = t_p + i                                  # position of tok
-            x_t = E[tok] + _pe_row(pos, params.get(pos_name, {}), t, d).astype(dt)
+            x_t = embed._act()(E[tok]) + _pe_row(
+                pos, params.get(pos_name, {}), t, d
+            ).astype(dt)
             new_caches = []
             for cfg, nm, (k_c, v_c) in zip(blocks, block_names, caches):
                 x_t, k_c, v_c = _block_step(cfg, params[nm], x_t, k_c, v_c, t)

@@ -84,6 +84,9 @@ class PreemptionHandler:
         (or raises PreemptionError when raise_after_save)."""
         if not self._flag.is_set():
             return False
+        # handled once: without clearing, raise_after_save=False would
+        # re-checkpoint on EVERY remaining step
+        self._flag.clear()
         if self.on_preempt is not None:
             self.on_preempt(model)
         if self.checkpointer is not None:

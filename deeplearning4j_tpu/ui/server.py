@@ -32,7 +32,8 @@ _PAGE = """<!doctype html>
  .legend{font-size:11px;color:#555}
 </style></head><body>
 <h1>deeplearning4j_tpu training dashboard
-  <select id="session"></select></h1>
+  <select id="session"></select>
+  <a href="hpo" style="font-size:12px;margin-left:16px">HPO results →</a></h1>
 <div id="meta"></div>
 <div class="row">
  <div><h2>score</h2><canvas id="score" width="560" height="260"></canvas></div>
@@ -89,8 +90,57 @@ setInterval(refresh,2000); refresh();
 </script></body></html>"""
 
 
+_HPO_PAGE = """<!doctype html>
+<html><head><meta charset="utf-8"><title>deeplearning4j_tpu — HPO</title>
+<style>
+ body{font-family:system-ui,sans-serif;margin:24px;background:#fafafa;color:#222}
+ h1{font-size:18px} canvas{background:#fff;border:1px solid #ddd;border-radius:6px}
+ table{border-collapse:collapse;font-size:12px;margin-top:16px}
+ td,th{border:1px solid #ddd;padding:4px 8px;text-align:left}
+ tr.best{background:#dcfce7} .err{color:#b91c1c}
+</style></head><body>
+<h1>hyperparameter search <a href="./" style="font-size:12px;margin-left:16px">← training</a></h1>
+<canvas id="scores" width="720" height="240"></canvas>
+<div id="table"></div>
+<script>
+async function refresh(){
+ const rs=await (await fetch('api/hpo')).json();
+ if(!rs.length){document.getElementById('table').textContent='no results yet';return}
+ const ok=rs.filter(r=>r.score!=null);
+ const best=ok.length?ok.reduce((a,b)=>b.score>a.score?b:a):null;
+ const cv=document.getElementById('scores'), c=cv.getContext('2d');
+ c.clearRect(0,0,cv.width,cv.height);
+ if(ok.length){
+  const ys=ok.map(r=>r.score), mn=Math.min(...ys), mx=Math.max(...ys);
+  const W=cv.width-50,H=cv.height-30;
+  c.strokeStyle='#999';c.strokeRect(40,5,W,H);
+  c.fillStyle='#666';c.font='10px sans-serif';
+  c.fillText(mx.toPrecision(4),2,12);c.fillText(mn.toPrecision(4),2,H);
+  ok.forEach(r=>{
+   const px=40+W*r.index/Math.max(rs.length-1,1);
+   const py=5+H*(1-(r.score-mn)/Math.max(mx-mn,1e-12));
+   c.fillStyle=best&&r.index===best.index?'#16a34a':'#2563eb';
+   c.beginPath();c.arc(px,py,4,0,7);c.fill();
+  });
+ }
+ const keys=[...new Set(rs.flatMap(r=>Object.keys(r.candidate||{})))];
+ document.getElementById('table').innerHTML=
+  '<table><tr><th>#</th>'+keys.map(k=>`<th>${k}</th>`).join('')
+  +'<th>score</th><th>wall s</th><th></th></tr>'
+  +rs.map(r=>`<tr${best&&r.index===best.index?' class="best"':''}><td>${r.index}</td>`
+   +keys.map(k=>{const v=(r.candidate||{})[k];
+     return `<td>${typeof v==='number'?v.toPrecision(4):v??''}</td>`}).join('')
+   +`<td>${r.score==null?'':r.score.toPrecision(5)}</td><td>${r.wall_s??''}</td>`
+   +`<td class="err">${r.error??''}</td></tr>`).join('')+'</table>';
+}
+setInterval(refresh,3000); refresh();
+</script></body></html>"""
+
+
 class UIServer:
-    """Lazy singleton HTTP dashboard over attached StatsStorage objects."""
+    """Lazy singleton HTTP dashboard over attached StatsStorage objects
+    and (via attach_hpo) Arbiter jsonl result files — the reference UI's
+    training + Arbiter tabs."""
 
     _instance: Optional["UIServer"] = None
 
@@ -102,6 +152,7 @@ class UIServer:
 
     def __init__(self, port: int = 0, host: str = "127.0.0.1"):
         self._storages: list = []
+        self._hpo_paths: list = []
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -137,6 +188,15 @@ class UIServer:
                         recs.extend(s.get_records(sid))
                     recs.sort(key=lambda r: r.get("iteration", 0))
                     self._json(recs)
+                elif u.path in ("/hpo", "/hpo.html"):
+                    body = _HPO_PAGE.encode()
+                    self.send_response(200)
+                    self.send_header("Content-Type", "text/html")
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif u.path == "/api/hpo":
+                    self._json(outer._hpo_results())
                 else:
                     self._json({"error": "not found"}, 404)
 
@@ -153,6 +213,33 @@ class UIServer:
         if storage not in self._storages:
             self._storages.append(storage)
         return self
+
+    def attach_hpo(self, results_path: str) -> "UIServer":
+        """Attach an OptimizationRunner results_path (jsonl); the /hpo tab
+        re-reads it on every refresh so a live search streams in."""
+        if results_path not in self._hpo_paths:
+            self._hpo_paths.append(results_path)
+        return self
+
+    def _hpo_results(self) -> list:
+        out = []
+        for path in self._hpo_paths:
+            try:
+                with open(path) as f:
+                    for line in f:
+                        line = line.strip()
+                        if not line:
+                            continue
+                        try:
+                            out.append(json.loads(line))
+                        except json.JSONDecodeError:
+                            # a live search may be mid-append on the last
+                            # line; skip it this refresh
+                            continue
+            except FileNotFoundError:
+                continue
+        out.sort(key=lambda r: r.get("index", 0))
+        return out
 
     def detach(self, storage) -> None:
         if storage in self._storages:

@@ -440,3 +440,70 @@ class TestJDBCAndSequenceReaders:
         assert seqs[0] == [[1, 2], [3, 4], [5, 6]]
         assert seqs[1] == [[7, 8]]
         assert rr.sequence_lengths() == [3, 1]
+
+
+class TestLabelGeneratorsAndPathFilters:
+    """ParentPath/PatternPath label generators + Random/Balanced path
+    filters (the reference's ImageRecordReader companions)."""
+
+    @pytest.fixture
+    def flat_tree(self, tmp_path):
+        import numpy as np
+
+        d = tmp_path / "all"
+        d.mkdir()
+        for cls, n in (("cat", 5), ("dog", 2)):
+            for i in range(n):
+                np.save(d / f"{cls}_{i}.npy",
+                        np.full((4, 4), float(i), np.float32))
+        # rename .npy -> keep (ImageRecordReader reads .npy directly)
+        return tmp_path
+
+    def test_pattern_label_generator(self, flat_tree):
+        from deeplearning4j_tpu.datavec import (
+            ImageRecordReader, pattern_label_generator,
+        )
+
+        rr = ImageRecordReader(
+            4, 4, 1, label_generator=pattern_label_generator("_", 0)
+        ).initialize(flat_tree)
+        assert rr.labels == ["cat", "dog"]
+        recs = list(rr)
+        assert len(recs) == 7
+        assert {r[1] for r in recs} == {0, 1}
+
+    def test_balanced_path_filter(self, flat_tree):
+        from deeplearning4j_tpu.datavec import (
+            ImageRecordReader, balanced_path_filter, pattern_label_generator,
+        )
+
+        gen = pattern_label_generator("_", 0)
+        rr = ImageRecordReader(
+            4, 4, 1, label_generator=gen,
+            path_filter=balanced_path_filter(0, 2, label_generator=gen),
+        ).initialize(flat_tree)
+        recs = list(rr)
+        assert len(recs) == 4               # 2 per class
+        labels = [r[1] for r in recs]
+        assert labels.count(0) == 2 and labels.count(1) == 2
+
+    def test_random_path_filter(self, flat_tree):
+        from deeplearning4j_tpu.datavec import (
+            ImageRecordReader, pattern_label_generator, random_path_filter,
+        )
+
+        rr = ImageRecordReader(
+            4, 4, 1, label_generator=pattern_label_generator("_", 0),
+            path_filter=random_path_filter(1, 3),
+        ).initialize(flat_tree)
+        assert len(list(rr)) == 3
+
+    def test_pattern_generator_bad_position_raises(self, flat_tree):
+        from deeplearning4j_tpu.datavec import (
+            ImageRecordReader, pattern_label_generator,
+        )
+
+        with pytest.raises(ValueError, match="segment"):
+            ImageRecordReader(
+                4, 4, 1, label_generator=pattern_label_generator("_", 5)
+            ).initialize(flat_tree)

@@ -96,3 +96,34 @@ def test_unsupported_stack_rejected():
     )
     with pytest.raises(ValueError, match="Embedding"):
         generate(SequentialModel(conf).init(), np.arange(3)[None, :], 2)
+
+
+def test_embedding_activation_respected():
+    """A builder-level default activation lands on the Embedding layer;
+    generate() must run it like the dense forward does (regression)."""
+    from deeplearning4j_tpu.nn.activations import Activation
+    from deeplearning4j_tpu.nn.conf import (
+        Embedding, InputType, NeuralNetConfiguration,
+    )
+    from deeplearning4j_tpu.nn.conf.attention import (
+        PositionalEncoding, TransformerEncoderBlock,
+    )
+    from deeplearning4j_tpu.nn.conf.recurrent import RnnOutputLayer
+    from deeplearning4j_tpu.models import SequentialModel
+
+    conf = (
+        NeuralNetConfiguration.builder().seed(2)
+        .activation(Activation.TANH)        # global default -> Embedding too
+        .list()
+        .layer(Embedding(n_in=VOCAB, n_out=D))
+        .layer(PositionalEncoding())
+        .layer(TransformerEncoderBlock(d_model=D, n_heads=2, causal=True))
+        .layer(RnnOutputLayer(n_out=VOCAB))
+        .set_input_type(InputType.recurrent(1))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    prompt = np.arange(5)[None, :]
+    out = np.asarray(generate(m, prompt, 3, temperature=0.0))
+    probs = np.asarray(m.output(prompt.astype(np.float32)))
+    assert out[0, 5] == probs[0, -1].argmax()

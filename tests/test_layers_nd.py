@@ -255,3 +255,63 @@ class TestReviewRegressions:
         # -1 and rank-1 both fine
         MergeVertex(declared_axis=-1).output_type([cnn, cnn])
         MergeVertex(declared_axis=3).output_type([cnn, cnn])
+
+
+class TestUpsamplingAndMask:
+    def test_upsampling1d_shapes_and_values(self):
+        from deeplearning4j_tpu.nn.conf import InputType, Upsampling1D
+
+        layer = Upsampling1D(size=3)
+        it = InputType.recurrent(2, 4)
+        assert layer.output_type(it).shape == (12, 2)
+        x = np.arange(8, dtype=np.float32).reshape(1, 4, 2)
+        y, _ = layer.apply({}, {}, x)
+        assert y.shape == (1, 12, 2)
+        np.testing.assert_array_equal(np.asarray(y)[0, :3, 0], [0, 0, 0])
+
+    def test_upsampling3d_shapes(self):
+        from deeplearning4j_tpu.nn.conf import InputType, Upsampling3D
+
+        layer = Upsampling3D(size=(2, 1, 2))
+        it = InputType.convolutional3d(2, 3, 4, 5)
+        assert layer.output_type(it).shape == (4, 3, 8, 5)
+        x = np.ones((1, 2, 3, 4, 5), np.float32)
+        y, _ = layer.apply({}, {}, x)
+        assert y.shape == (1, 4, 3, 8, 5)
+
+    def test_mask_zero_layer(self):
+        from deeplearning4j_tpu.nn.conf import MaskZeroLayer
+
+        layer = MaskZeroLayer()
+        x = np.ones((2, 3, 4), np.float32)
+        mask = np.array([[1, 1, 0], [1, 0, 0]], np.float32)
+        y, _ = layer.apply({}, {}, x, mask=mask)
+        y = np.asarray(y)
+        assert y[0, 2].sum() == 0 and y[1, 1].sum() == 0
+        assert y[0, 0].sum() == 4
+        # no mask = passthrough
+        y2, _ = layer.apply({}, {}, x, mask=None)
+        np.testing.assert_array_equal(np.asarray(y2), x)
+
+    def test_mask_zero_in_model(self):
+        from deeplearning4j_tpu.data import DataSet
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, MaskZeroLayer, NeuralNetConfiguration,
+        )
+        from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+
+        conf = (
+            NeuralNetConfiguration.builder().seed(3).list()
+            .layer(MaskZeroLayer())
+            .layer(LSTM(n_out=8))
+            .layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(4))
+            .build()
+        )
+        m = SequentialModel(conf).init()
+        x = np.random.default_rng(0).normal(0, 1, (2, 5, 4)).astype(np.float32)
+        y = np.zeros((2, 5, 2), np.float32); y[..., 0] = 1
+        fmask = np.array([[1, 1, 1, 0, 0], [1, 1, 1, 1, 1]], np.float32)
+        m.fit_batch(DataSet(x, y, features_mask=fmask, labels_mask=fmask))
+        assert np.isfinite(m.score_value)

@@ -195,3 +195,53 @@ def test_samediff_namespace_exposure():
 def test_get_op_unknown_raises():
     with pytest.raises(KeyError):
         get_op("definitely_not_an_op")
+
+
+class TestNewOpGradients:
+    """Finite-difference gradient checks for the differentiable additions
+    (the OpValidation harness applied to the breadth ops)."""
+
+    @pytest.mark.parametrize("name,args,attrs", [
+        ("prelu", (np.array([-2.0, 0.5, 3.0], np.float32),
+                   np.array([0.2], np.float32)), {}),
+        ("mish", (np.array([-1.0, 0.3, 2.0], np.float32),), {}),
+        ("log_sigmoid", (np.array([-1.0, 0.3, 2.0], np.float32),), {}),
+        ("thresholded_relu", (np.array([-1.0, 0.5, 2.0], np.float32),),
+         {"theta": 0.4}),
+        ("standardize", (np.array([[1.0, 2.0, 4.0]], np.float32),), {}),
+        ("clip_by_norm", (np.array([3.0, 4.0], np.float32),),
+         {"clip_norm": 1.0}),
+        ("cosine_similarity", (np.array([1.0, 2.0, 0.5], np.float32),
+                               np.array([0.3, -1.0, 2.0], np.float32)), {}),
+        ("euclidean_distance", (np.array([1.0, 2.0], np.float32),
+                                np.array([0.0, -1.0], np.float32)), {}),
+        ("lrn", (np.random.default_rng(0).normal(
+            0, 1, (2, 3, 3, 8)).astype(np.float32),), {"size": 3}),
+        ("matrix_set_diag", (np.ones((3, 3), np.float32),
+                             np.array([1.0, 2.0, 3.0], np.float32)), {}),
+    ])
+    def test_gradient_matches_finite_difference(self, name, args, attrs):
+        import jax
+        import jax.numpy as jnp
+
+        fn = OPS[name]
+
+        def loss(*xs):
+            return jnp.sum(fn(*xs, **attrs) ** 2)
+
+        grads = jax.grad(loss, argnums=tuple(range(len(args))))(*args)
+        eps = 1e-3
+        for ai, (a, g) in enumerate(zip(args, grads)):
+            flat = a.reshape(-1)
+            gflat = np.asarray(g).reshape(-1)
+            for i in range(min(flat.size, 6)):
+                bump = np.zeros_like(flat)
+                bump[i] = eps
+                args_p = list(args)
+                args_m = list(args)
+                args_p[ai] = (flat + bump).reshape(a.shape)
+                args_m[ai] = (flat - bump).reshape(a.shape)
+                fd = (float(loss(*args_p)) - float(loss(*args_m))) / (2 * eps)
+                assert abs(fd - gflat[i]) < 2e-2 * max(1.0, abs(fd)), (
+                    name, ai, i, fd, gflat[i],
+                )

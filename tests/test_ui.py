@@ -170,3 +170,40 @@ class TestCrashReport:
         assert path and os.path.exists(path)
         assert "RESOURCE_EXHAUSTED" in open(path).read()
         assert crash.maybe_write_oom_report(ValueError("shape mismatch")) is None
+
+
+class TestHpoTab:
+    def test_hpo_page_and_api(self, tmp_path):
+        import json
+        import urllib.request
+
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        path = tmp_path / "hpo.jsonl"
+        rows = [
+            {"index": 0, "candidate": {"lr": 0.01}, "score": 0.7, "wall_s": 1.0},
+            {"index": 1, "candidate": {"lr": 0.1}, "score": None, "wall_s": 0.5,
+             "error": "Diverged"},
+            {"index": 2, "candidate": {"lr": 0.03}, "score": 0.9, "wall_s": 1.1},
+        ]
+        path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+        server = UIServer(port=0)
+        try:
+            server.attach_hpo(str(path))
+            page = urllib.request.urlopen(server.url + "hpo").read().decode()
+            assert "hyperparameter search" in page
+            got = json.loads(
+                urllib.request.urlopen(server.url + "api/hpo").read()
+            )
+            assert [r["index"] for r in got] == [0, 1, 2]
+            assert got[2]["score"] == 0.9
+            # a file that appears later streams in (live search)
+            rows.append({"index": 3, "candidate": {"lr": 0.05}, "score": 0.95,
+                         "wall_s": 0.9})
+            path.write_text("\n".join(json.dumps(r) for r in rows) + "\n")
+            got = json.loads(
+                urllib.request.urlopen(server.url + "api/hpo").read()
+            )
+            assert len(got) == 4
+        finally:
+            server.stop()
