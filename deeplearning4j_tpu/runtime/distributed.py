@@ -193,3 +193,49 @@ def fetch_global(arr):
     from jax.experimental import multihost_utils
 
     return np.asarray(multihost_utils.process_allgather(arr, tiled=True))
+
+
+from deeplearning4j_tpu.data.iterator import DataSetIterator as _DataSetIterator
+
+
+class DistributedDataSetIterator(_DataSetIterator):
+    """Rank-strided view of a DataSetIterator: process k of N yields
+    batches k, N+k, 2N+k, ... — the RDD-partition role for multi-host
+    input pipelines (each host reads DISJOINT data; `put_global` then
+    assembles the global batch from per-host shards).
+
+    A ragged tail (total batches not divisible by world size) is DROPPED
+    on every rank: each fit_batch is a cross-host collective, so unequal
+    per-host step counts would wedge the slice on the last step.
+
+    Wrap the SAME underlying iterator construction on every host:
+
+        it = DistributedDataSetIterator(CsvIterator(...))
+        model.fit(it)            # each host consumes its stride
+    """
+
+    def __init__(self, inner, rank: int | None = None,
+                 world_size: int | None = None):
+        self.inner = inner
+        self.rank = process_index() if rank is None else rank
+        self.world = process_count() if world_size is None else world_size
+        if not (0 <= self.rank < self.world):
+            raise ValueError(f"rank {self.rank} outside world {self.world}")
+
+    @property
+    def batch_size(self):
+        return getattr(self.inner, "batch_size", None)
+
+    def __iter__(self):
+        # yield only from COMPLETE stride groups so every rank sees the
+        # same step count (works for streaming inners of unknown length)
+        group = []
+        for batch in self.inner:
+            group.append(batch)
+            if len(group) == self.world:
+                yield group[self.rank]
+                group = []
+
+    def reset(self) -> None:
+        if hasattr(self.inner, "reset"):
+            self.inner.reset()

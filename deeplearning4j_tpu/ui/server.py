@@ -123,15 +123,16 @@ async function refresh(){
    c.beginPath();c.arc(px,py,4,0,7);c.fill();
   });
  }
+ const esc=s=>String(s).replace(/&/g,'&amp;').replace(/</g,'&lt;').replace(/>/g,'&gt;');
  const keys=[...new Set(rs.flatMap(r=>Object.keys(r.candidate||{})))];
  document.getElementById('table').innerHTML=
-  '<table><tr><th>#</th>'+keys.map(k=>`<th>${k}</th>`).join('')
+  '<table><tr><th>#</th>'+keys.map(k=>`<th>${esc(k)}</th>`).join('')
   +'<th>score</th><th>wall s</th><th></th></tr>'
   +rs.map(r=>`<tr${best&&r.index===best.index?' class="best"':''}><td>${r.index}</td>`
    +keys.map(k=>{const v=(r.candidate||{})[k];
-     return `<td>${typeof v==='number'?v.toPrecision(4):v??''}</td>`}).join('')
+     return `<td>${typeof v==='number'?v.toPrecision(4):esc(v??'')}</td>`}).join('')
    +`<td>${r.score==null?'':r.score.toPrecision(5)}</td><td>${r.wall_s??''}</td>`
-   +`<td class="err">${r.error??''}</td></tr>`).join('')+'</table>';
+   +`<td class="err">${esc(r.error??'')}</td></tr>`).join('')+'</table>';
 }
 setInterval(refresh,3000); refresh();
 </script></body></html>"""
