@@ -12,8 +12,16 @@ from deeplearning4j_tpu.nlp.word2vec import Word2Vec
 from deeplearning4j_tpu.nlp.glove import Glove
 from deeplearning4j_tpu.nlp.paragraph_vectors import ParagraphVectors
 from deeplearning4j_tpu.nlp.serializer import WordVectorSerializer
+from deeplearning4j_tpu.nlp.wordpiece import (
+    BasicTokenizer,
+    BertIterator,
+    BertWordPieceTokenizer,
+)
 
 __all__ = [
+    "BasicTokenizer",
+    "BertIterator",
+    "BertWordPieceTokenizer",
     "DefaultTokenizer",
     "DefaultTokenizerFactory",
     "NGramTokenizerFactory",

@@ -237,5 +237,11 @@ class DistributedDataSetIterator(_DataSetIterator):
                 group = []
 
     def reset(self) -> None:
-        if hasattr(self.inner, "reset"):
-            self.inner.reset()
+        if not hasattr(self.inner, "reset"):
+            # a one-shot generator would silently yield ZERO batches on
+            # every later epoch; fail like the base contract does
+            raise NotImplementedError(
+                f"{type(self.inner).__name__} has no reset(); wrap a "
+                "resettable DataSetIterator (or a list) for multi-epoch use"
+            )
+        self.inner.reset()

@@ -1,0 +1,138 @@
+"""BertWordPieceTokenizer + BertIterator (the BERT fine-tune input
+pipeline, BASELINE config 4's front end)."""
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.nlp import BertIterator, BertWordPieceTokenizer
+
+VOCAB = {t: i for i, t in enumerate([
+    "[PAD]", "[UNK]", "[CLS]", "[SEP]",
+    "the", "quick", "brown", "fox", "jump", "##ed", "##s", "over", "dog",
+    "un", "##believ", "##able", ",", ".",
+])}
+
+
+@pytest.fixture
+def tok():
+    return BertWordPieceTokenizer(VOCAB)
+
+
+def test_wordpiece_greedy_longest_match(tok):
+    assert tok.tokenize("unbelievable") == ["un", "##believ", "##able"]
+    assert tok.tokenize("jumped") == ["jump", "##ed"]
+    assert tok.tokenize("jumps") == ["jump", "##s"]
+
+
+def test_basic_tokenizer_punct_and_case(tok):
+    assert tok.tokenize("The quick, brown FOX.") == [
+        "the", "quick", ",", "brown", "fox", "."]
+
+
+def test_unknown_word_maps_to_unk(tok):
+    assert tok.tokenize("zebra") == ["[UNK]"]
+
+
+def test_vocab_txt_round_trip(tmp_path, tok):
+    path = tmp_path / "vocab.txt"
+    ordered = sorted(VOCAB, key=VOCAB.get)
+    path.write_text("\n".join(ordered) + "\n")
+    tok2 = BertWordPieceTokenizer(str(path))
+    assert tok2.vocab == VOCAB
+    assert tok2.tokenize("unbelievable") == tok.tokenize("unbelievable")
+
+
+def test_encode_special_tokens_and_padding(tok):
+    ids, mask, seg = tok.encode("the fox", max_len=8)
+    assert ids[0] == VOCAB["[CLS]"]
+    assert ids[3] == VOCAB["[SEP]"]
+    assert mask.tolist() == [1, 1, 1, 1, 0, 0, 0, 0]
+    assert ids[4:].tolist() == [0, 0, 0, 0]          # [PAD]
+
+
+def test_encode_pair_segments_and_truncation(tok):
+    ids, mask, seg = tok.encode("the quick brown fox", "the dog", max_len=10)
+    # [CLS] a... [SEP] b... [SEP]
+    assert int(mask.sum()) <= 10
+    sep = VOCAB["[SEP]"]
+    sep_positions = [i for i, v in enumerate(ids.tolist()) if v == sep]
+    assert len(sep_positions) == 2
+    assert seg[sep_positions[0] + 1] == 1            # pair segment
+    # longest-first truncation keeps both segments
+    long_a = "the quick brown fox jumped over the dog " * 3
+    ids2, mask2, seg2 = tok.encode(long_a, "the dog", max_len=12)
+    assert int(mask2.sum()) == 12
+    assert seg2.max() == 1
+
+
+def test_bert_iterator_shapes_and_static_batches(tok):
+    sents = ["the quick brown fox", "the dog", "unbelievable", "fox jumps",
+             "the fox ."]
+    it = BertIterator(tok, sents, [0, 1, 0, 1, 0], num_classes=2,
+                      batch_size=2, max_len=12)
+    batches = list(it)
+    assert len(batches) == 3
+    for b in batches:
+        assert b.features.shape == (2, 12)           # static, tail padded
+        assert b.features_mask.shape == (2, 12)
+        assert b.labels.shape == (2, 2)
+    # tail batch: second example masked out of the loss
+    assert batches[-1].labels_mask.tolist() == [1.0, 0.0]
+
+
+def test_bert_iterator_finetunes_a_transformer(tok):
+    """End-to-end: WordPiece -> BertIterator -> DSL transformer classify."""
+    from deeplearning4j_tpu.models import SequentialModel
+    from deeplearning4j_tpu.nn import Adam
+    from deeplearning4j_tpu.nn.activations import Activation
+    from deeplearning4j_tpu.nn.conf import (
+        Embedding, InputType, NeuralNetConfiguration, OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.conf.attention import (
+        PositionalEncoding, TransformerEncoderBlock,
+    )
+    from deeplearning4j_tpu.nn.conf.recurrent import LastTimeStep  # noqa: F401
+    from deeplearning4j_tpu.nn.conf import GlobalPooling, PoolingType
+
+    # separable toy task: class 0 sentences mention "fox", class 1 "dog"
+    sents = (["the quick brown fox", "fox jumps over", "the fox ."] * 4
+             + ["the dog", "over the dog .", "dog jumps"] * 4)
+    labels = [0, 0, 0] * 4 + [1, 1, 1] * 4
+    it = BertIterator(tok, sents, labels, num_classes=2, batch_size=8,
+                      max_len=10)
+    conf = (
+        NeuralNetConfiguration.builder().seed(3).updater(Adam(5e-3))
+        .list()
+        .layer(Embedding(n_in=len(VOCAB), n_out=16))
+        .layer(PositionalEncoding())
+        .layer(TransformerEncoderBlock(d_model=16, n_heads=2))
+        .layer(GlobalPooling(pooling=PoolingType.AVG))
+        .layer(OutputLayer(n_out=2, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(1, 10))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    m.fit(it, epochs=30)
+    correct = 0
+    for b in it:
+        probs = np.asarray(m.output(b.features, b.features_mask))
+        keep = b.labels_mask > 0
+        correct += int((probs[keep].argmax(1) == b.labels[keep].argmax(1)).sum())
+    assert correct / len(sents) > 0.9
+
+
+def test_encode_max_len_too_small_raises(tok):
+    with pytest.raises(ValueError, match="no room"):
+        tok.encode("the", max_len=2)
+    with pytest.raises(ValueError, match="no room"):
+        tok.encode("the fox", "the dog", max_len=4)
+
+
+def test_bert_iterator_caches_encoding(tok):
+    it = BertIterator(tok, ["the fox", "the dog"], [0, 1], num_classes=2,
+                      batch_size=2, max_len=8)
+    list(it)
+    cached = it._encoded
+    assert cached is not None
+    list(it)
+    assert it._encoded is cached          # second epoch reused the cache
