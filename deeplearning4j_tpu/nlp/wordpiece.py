@@ -85,7 +85,7 @@ class BertWordPieceTokenizer:
             f = open(path_or_file, encoding="utf-8")
             close = True
         try:
-            return {line.rstrip("\n"): i for i, line in enumerate(f)}
+            return {line.rstrip("\r\n"): i for i, line in enumerate(f)}
         finally:
             if close:
                 f.close()
@@ -167,6 +167,8 @@ class BertIterator(DataSetIterator):
                  pairs: Optional[Sequence] = None):
         if len(sentences) != len(labels):
             raise ValueError("sentences and labels must align")
+        if pairs is not None and len(pairs) != len(sentences):
+            raise ValueError("pairs must align with sentences")
         self.tokenizer = tokenizer
         self.sentences = list(sentences)
         self.labels = list(labels)
@@ -174,7 +176,7 @@ class BertIterator(DataSetIterator):
         self.num_classes = num_classes
         self._batch_size = batch_size
         self.max_len = max_len
-        self._encoded = None         # (ids, mask) cached across epochs
+        self._encoded = None         # (ids, mask, segments) cached across epochs
 
     @property
     def batch_size(self) -> int:
@@ -188,17 +190,25 @@ class BertIterator(DataSetIterator):
             n = len(self.sentences)
             ids = np.zeros((n, self.max_len), np.float32)
             mask = np.zeros((n, self.max_len), np.float32)
+            segs = np.zeros((n, self.max_len), np.int32)
             for j in range(n):
                 pair = self.pairs[j] if self.pairs else None
-                i, m, _ = self.tokenizer.encode(
+                i, m, sg = self.tokenizer.encode(
                     self.sentences[j], pair, max_len=self.max_len
                 )
-                ids[j], mask[j] = i, m
-            self._encoded = (ids, mask)
+                ids[j], mask[j], segs[j] = i, m, sg
+            self._encoded = (ids, mask, segs)
         return self._encoded
 
+    def segment_ids(self):
+        """(N, max_len) int32 token-type ids aligned with iteration order.
+        NOTE: the DSL's Embedding layer has no token-type channel yet, so
+        pair inputs train on the [SEP]-delimited sequence alone; consume
+        these ids from a custom layer/graph input if segments matter."""
+        return self._encode_all()[2]
+
     def __iter__(self):
-        all_ids, all_mask = self._encode_all()
+        all_ids, all_mask, _ = self._encode_all()
         n = len(self.sentences)
         bs = self._batch_size
         for lo in range(0, n, bs):

@@ -192,3 +192,64 @@ def non_max_suppression(
         iou = inter / np.maximum(areas[i] + areas[order[1:]] - inter, 1e-9)
         order = order[1:][iou <= iou_threshold]
     return keep
+
+
+@dataclasses.dataclass(frozen=True)
+class DetectedObject:
+    """One detection in grid units (the reference's DetectedObject)."""
+
+    class_index: int
+    confidence: float
+    center_x: float
+    center_y: float
+    width: float
+    height: float
+
+    def top_left(self) -> tuple[float, float]:
+        return (self.center_x - self.width / 2, self.center_y - self.height / 2)
+
+    def bottom_right(self) -> tuple[float, float]:
+        return (self.center_x + self.width / 2, self.center_y + self.height / 2)
+
+
+def get_predicted_objects(
+    layer: "Yolo2OutputLayer",
+    preds,
+    *,
+    score_threshold: float = 0.3,
+    iou_threshold: float = 0.45,
+    max_out: int = 50,
+) -> list[list[DetectedObject]]:
+    """Decode + threshold + NMS into DetectedObject lists, one per image
+    (YoloUtils.getPredictedObjects role: the full raw-grid -> detections
+    path).  Score = objectness * best class probability."""
+    d = layer.decode(preds)
+    xy = np.asarray(d["xy"], np.float32)
+    wh = np.asarray(d["wh"], np.float32)
+    conf = np.asarray(d["conf"], np.float32)
+    cls_p = np.asarray(d["class_probs"], np.float32)
+    out = []
+    for b in range(xy.shape[0]):
+        boxes = np.concatenate(
+            [xy[b].reshape(-1, 2), wh[b].reshape(-1, 2)], axis=1
+        )
+        c = conf[b].reshape(-1)
+        p = cls_p[b].reshape(-1, cls_p.shape[-1])
+        best = p.argmax(axis=1)
+        scores = c * p.max(axis=1)
+        keep = non_max_suppression(
+            boxes, scores, iou_threshold=iou_threshold,
+            score_threshold=score_threshold, max_out=max_out,
+        )
+        out.append([
+            DetectedObject(
+                class_index=int(best[i]),
+                confidence=float(scores[i]),
+                center_x=float(boxes[i, 0]),
+                center_y=float(boxes[i, 1]),
+                width=float(boxes[i, 2]),
+                height=float(boxes[i, 3]),
+            )
+            for i in keep
+        ])
+    return out

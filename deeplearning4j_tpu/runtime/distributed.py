@@ -219,6 +219,7 @@ class DistributedDataSetIterator(_DataSetIterator):
         self.inner = inner
         self.rank = process_index() if rank is None else rank
         self.world = process_count() if world_size is None else world_size
+        self._exhausted = False
         if not (0 <= self.rank < self.world):
             raise ValueError(f"rank {self.rank} outside world {self.world}")
 
@@ -227,6 +228,14 @@ class DistributedDataSetIterator(_DataSetIterator):
         return getattr(self.inner, "batch_size", None)
 
     def __iter__(self):
+        # a one-shot inner can serve exactly ONE pass; starting a second
+        # would silently yield zero batches (fit() would spin through the
+        # remaining epochs training on nothing)
+        if self._exhausted and not hasattr(self.inner, "reset"):
+            raise NotImplementedError(
+                f"{type(self.inner).__name__} has no reset(); wrap a "
+                "resettable DataSetIterator (or a list) for multi-epoch use"
+            )
         # yield only from COMPLETE stride groups so every rank sees the
         # same step count (works for streaming inners of unknown length)
         group = []
@@ -235,13 +244,11 @@ class DistributedDataSetIterator(_DataSetIterator):
             if len(group) == self.world:
                 yield group[self.rank]
                 group = []
+        self._exhausted = True
 
     def reset(self) -> None:
-        if not hasattr(self.inner, "reset"):
-            # a one-shot generator would silently yield ZERO batches on
-            # every later epoch; fail like the base contract does
-            raise NotImplementedError(
-                f"{type(self.inner).__name__} has no reset(); wrap a "
-                "resettable DataSetIterator (or a list) for multi-epoch use"
-            )
-        self.inner.reset()
+        # fit() resets after EVERY epoch incl. the last; only an actual
+        # second pass over a reset-less inner is an error (see __iter__)
+        if hasattr(self.inner, "reset"):
+            self.inner.reset()
+            self._exhausted = False

@@ -175,3 +175,53 @@ class TestYoloZooConfigs:
         m = YOLO2(num_classes=3, height=128, width=128).init_model()
         out = m.output(np.zeros((1, 128, 128, 3), np.float32))
         assert np.asarray(out).shape == (1, 4, 4, 40)
+
+
+class TestGetPredictedObjects:
+    """YoloUtils.getPredictedObjects role: raw grid -> DetectedObject
+    lists through decode + threshold + NMS."""
+
+    def test_synthetic_grid_detections(self):
+        from deeplearning4j_tpu.nn.conf.objdetect import (
+            Yolo2OutputLayer, get_predicted_objects,
+        )
+
+        layer = Yolo2OutputLayer(anchors=((1.0, 1.0), (2.0, 2.0)), num_classes=3)
+        H = W = 4
+        A, C = 2, 3
+        raw = np.full((1, H, W, A * (5 + C)), -6.0, np.float32)  # all quiet
+        # light up cell (1,2) anchor 0: high conf, class 2
+        base = 0 * (5 + C)
+        raw[0, 1, 2, base + 4] = 6.0                # objectness
+        raw[0, 1, 2, base + 5 + 2] = 8.0            # class 2 logit
+        # and a second object at (3,0) anchor 1, class 0
+        base = 1 * (5 + C)
+        raw[0, 3, 0, base + 4] = 6.0
+        raw[0, 3, 0, base + 5 + 0] = 8.0
+        dets = get_predicted_objects(layer, raw, score_threshold=0.5)
+        assert len(dets) == 1
+        found = {(d.class_index, round(d.center_x - 0.5), round(d.center_y - 0.5))
+                 for d in dets[0]}
+        assert (2, 2, 1) in found
+        assert (0, 0, 3) in found
+        assert len(dets[0]) == 2
+        for d in dets[0]:
+            tlx, tly = d.top_left()
+            brx, bry = d.bottom_right()
+            assert brx > tlx and bry > tly
+
+    def test_nms_suppresses_duplicates(self):
+        from deeplearning4j_tpu.nn.conf.objdetect import (
+            Yolo2OutputLayer, get_predicted_objects,
+        )
+
+        # two anchors of the SAME size on the same cell -> same box twice
+        layer = Yolo2OutputLayer(anchors=((1.5, 1.5), (1.5, 1.5)), num_classes=2)
+        C = 2
+        raw = np.full((1, 3, 3, 2 * (5 + C)), -6.0, np.float32)
+        for a in range(2):
+            base = a * (5 + C)
+            raw[0, 1, 1, base + 4] = 6.0
+            raw[0, 1, 1, base + 5] = 8.0
+        dets = get_predicted_objects(layer, raw, score_threshold=0.5)
+        assert len(dets[0]) == 1            # duplicate suppressed
