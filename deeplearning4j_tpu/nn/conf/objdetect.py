@@ -237,19 +237,26 @@ def get_predicted_objects(
         p = cls_p[b].reshape(-1, cls_p.shape[-1])
         best = p.argmax(axis=1)
         scores = c * p.max(axis=1)
-        keep = non_max_suppression(
-            boxes, scores, iou_threshold=iou_threshold,
-            score_threshold=score_threshold, max_out=max_out,
-        )
-        out.append([
-            DetectedObject(
-                class_index=int(best[i]),
-                confidence=float(scores[i]),
-                center_x=float(boxes[i, 0]),
-                center_y=float(boxes[i, 1]),
-                width=float(boxes[i, 2]),
-                height=float(boxes[i, 3]),
+        # PER-CLASS NMS (reference YoloUtils semantics): overlapping
+        # objects of DIFFERENT classes must not suppress each other
+        dets = []
+        for cls_idx in np.unique(best[scores >= score_threshold]):
+            sel = np.flatnonzero(best == cls_idx)
+            keep = non_max_suppression(
+                boxes[sel], scores[sel], iou_threshold=iou_threshold,
+                score_threshold=score_threshold, max_out=max_out,
             )
-            for i in keep
-        ])
+            dets.extend(
+                DetectedObject(
+                    class_index=int(cls_idx),
+                    confidence=float(scores[i]),
+                    center_x=float(boxes[i, 0]),
+                    center_y=float(boxes[i, 1]),
+                    width=float(boxes[i, 2]),
+                    height=float(boxes[i, 3]),
+                )
+                for i in sel[keep]
+            )
+        dets.sort(key=lambda d: -d.confidence)
+        out.append(dets[:max_out])
     return out

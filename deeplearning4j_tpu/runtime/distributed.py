@@ -219,7 +219,7 @@ class DistributedDataSetIterator(_DataSetIterator):
         self.inner = inner
         self.rank = process_index() if rank is None else rank
         self.world = process_count() if world_size is None else world_size
-        self._exhausted = False
+        self._consumed = False
         if not (0 <= self.rank < self.world):
             raise ValueError(f"rank {self.rank} outside world {self.world}")
 
@@ -227,15 +227,21 @@ class DistributedDataSetIterator(_DataSetIterator):
     def batch_size(self):
         return getattr(self.inner, "batch_size", None)
 
+    def _one_shot(self) -> bool:
+        """True when the inner can serve exactly one pass (a generator:
+        its own iterator, no reset)."""
+        return not hasattr(self.inner, "reset") and iter(self.inner) is self.inner
+
     def __iter__(self):
-        # a one-shot inner can serve exactly ONE pass; starting a second
-        # would silently yield zero batches (fit() would spin through the
-        # remaining epochs training on nothing)
-        if self._exhausted and not hasattr(self.inner, "reset"):
+        # a one-shot inner serves exactly ONE (possibly partial) pass;
+        # starting a second would silently yield zero batches — or worse,
+        # resume mid-stream after a partial pass
+        if self._consumed and self._one_shot():
             raise NotImplementedError(
-                f"{type(self.inner).__name__} has no reset(); wrap a "
-                "resettable DataSetIterator (or a list) for multi-epoch use"
+                f"{type(self.inner).__name__} is a one-shot iterator; wrap "
+                "a resettable DataSetIterator (or a list) for multi-epoch use"
             )
+        self._consumed = True          # armed at START: partial passes count
         # yield only from COMPLETE stride groups so every rank sees the
         # same step count (works for streaming inners of unknown length)
         group = []
@@ -244,11 +250,12 @@ class DistributedDataSetIterator(_DataSetIterator):
             if len(group) == self.world:
                 yield group[self.rank]
                 group = []
-        self._exhausted = True
 
     def reset(self) -> None:
         # fit() resets after EVERY epoch incl. the last; only an actual
-        # second pass over a reset-less inner is an error (see __iter__)
+        # second pass over a ONE-SHOT inner is an error (see __iter__)
         if hasattr(self.inner, "reset"):
             self.inner.reset()
-            self._exhausted = False
+            self._consumed = False
+        elif not self._one_shot():     # re-iterable (e.g. a list)
+            self._consumed = False

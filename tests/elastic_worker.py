@@ -105,6 +105,53 @@ def main_dp_parity():
     client.leave()
 
 
+def main_sharded_ckpt():
+    """Multi-host sharded checkpointing: every process saves its own
+    shards via orbax, then restores into a fresh distributed model and
+    checks parity — no allgather anywhere."""
+    from deeplearning4j_tpu.parallel import ParallelConfig, distribute
+    from deeplearning4j_tpu.runtime import distributed
+    from deeplearning4j_tpu.runtime.coordinator import CoordinatorClient
+    from deeplearning4j_tpu.train.sharded_checkpoint import ShardedCheckpointer
+
+    ckpt_dir = os.environ["DL4JTPU_TEST_CKPT_DIR"]
+    client = CoordinatorClient(COORD, WORKER_ID)
+    reg = client.register()
+    distributed.initialize(
+        distributed.DistributedConfig(
+            coordinator_address=reg["jax_coordinator"],
+            num_processes=reg["world"],
+            process_id=reg["rank"],
+            local_device_count=2,
+            platform="cpu",
+        )
+    )
+    model = build_model()
+    distribute(model, ParallelConfig.data_parallel())
+    for step in range(FIXED_STEPS):
+        model.fit_batch(local_shard(step, reg["rank"], reg["world"]))
+    ckpt = ShardedCheckpointer(ckpt_dir, async_save=False)
+    ckpt.save(model)
+    ckpt.wait()
+
+    fresh = build_model()
+    distribute(fresh, ParallelConfig.data_parallel())
+    ckpt.restore_into(fresh)
+    from deeplearning4j_tpu.runtime.distributed import fetch_global
+
+    for name, sub in model.params.items():
+        for pn, v in sub.items():
+            a = fetch_global(v)
+            b = fetch_global(fresh.params[name][pn])
+            np.testing.assert_array_equal(a, b)
+    assert fresh.iteration == model.iteration
+    if reg["rank"] == 0 and OUT:
+        with open(OUT, "w") as f:
+            json.dump({"ok": True, "steps": ckpt.all_steps()}, f)
+    ckpt.close()
+    client.leave()
+
+
 def main_elastic():
     from deeplearning4j_tpu.runtime.coordinator import CoordinatorClient
     from deeplearning4j_tpu.train.elastic import ElasticWorkerLoop
@@ -152,6 +199,8 @@ if __name__ == "__main__":
     MODE = os.environ["DL4JTPU_TEST_MODE"]
     if MODE == "dp_parity":
         main_dp_parity()
+    elif MODE == "sharded_ckpt":
+        main_sharded_ckpt()
     elif MODE == "elastic":
         main_elastic()
     else:

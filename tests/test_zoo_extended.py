@@ -225,3 +225,19 @@ class TestGetPredictedObjects:
             raw[0, 1, 1, base + 5] = 8.0
         dets = get_predicted_objects(layer, raw, score_threshold=0.5)
         assert len(dets[0]) == 1            # duplicate suppressed
+
+    def test_different_classes_not_cross_suppressed(self):
+        from deeplearning4j_tpu.nn.conf.objdetect import (
+            Yolo2OutputLayer, get_predicted_objects,
+        )
+
+        # same-size anchors at the same cell, each voting a DIFFERENT class
+        layer = Yolo2OutputLayer(anchors=((1.5, 1.5), (1.5, 1.5)), num_classes=2)
+        C = 2
+        raw = np.full((1, 3, 3, 2 * (5 + C)), -6.0, np.float32)
+        for a, cls in ((0, 0), (1, 1)):
+            base = a * (5 + C)
+            raw[0, 1, 1, base + 4] = 6.0
+            raw[0, 1, 1, base + 5 + cls] = 8.0
+        dets = get_predicted_objects(layer, raw, score_threshold=0.5)
+        assert {d.class_index for d in dets[0]} == {0, 1}   # both survive
