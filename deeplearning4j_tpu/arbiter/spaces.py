@@ -57,9 +57,11 @@ class DiscreteParameterSpace(ParameterSpace):
 
     def __init__(self, *values):
         # accept both call shapes: (a, b, c) and ([a, b, c]) — a single
-        # sequence argument is unpacked; otherwise the candidate would
-        # silently BE the list (never what a search means)
-        if len(values) == 1 and isinstance(values[0], (list, tuple)):
+        # LIST argument is unpacked; otherwise the candidate would silently
+        # BE the list (never what a search means).  A lone tuple is NOT
+        # unpacked: DiscreteParameterSpace((3, 3)) legitimately means one
+        # kernel-size candidate — write [(3, 3)] or [3, 3] to disambiguate
+        if len(values) == 1 and isinstance(values[0], list):
             values = tuple(values[0])
         object.__setattr__(self, "values", tuple(values))
         if not self.values:

@@ -16,6 +16,7 @@ values as npz — the .fb flatbuffers role.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import io
 import json
 import zipfile
@@ -199,11 +200,18 @@ _RANDOM_OPS = (
 @serde.register
 @dataclasses.dataclass(frozen=True)
 class TrainingConfig:
-    """The reference's org.nd4j.autodiff.samediff.TrainingConfig."""
+    """The reference's org.nd4j.autodiff.samediff.TrainingConfig.
+
+    bf16_compute: cast floating activations (values + placeholders) to
+    bfloat16 inside the compiled step while keeping f32 master weights and
+    f32 gradients/updater state — the TPU mixed-precision recipe the
+    layer-DSL models use by default.  Off by default to preserve exact-f32
+    semantics for imported graphs."""
 
     updater: Updater = dataclasses.field(default_factory=Sgd)
     l2: float = 0.0
     loss_variable: str = ""
+    bf16_compute: bool = False
 
 
 class SameDiff:
@@ -459,10 +467,15 @@ class SameDiff:
         # compiled step/grad closures capture tx/l2/loss_var — drop them
         self._compiled.clear()
 
-    def fit_batch(self, placeholders: dict[str, Any]) -> float:
+    def fit_batch(self, placeholders: dict[str, Any], sync: bool = True):
         """One training step: whole graph + grad + updater in one compiled
         computation (the TrainingSession.trainingIteration role, minus the
-        per-op JNI crossings)."""
+        per-op JNI crossings).  Trainable values and optimizer state are
+        DONATED — the step updates them in place in HBM.
+
+        sync=True (default) returns the loss as a Python float, which
+        blocks on the device; sync=False returns the device scalar so
+        back-to-back steps pipeline (read it later to observe the loss)."""
         if self._training_config is None:
             raise ValueError("call set_training_config() first")
         if self._loss_var is None:
@@ -475,15 +488,26 @@ class SameDiff:
         key = ("fit", ph_names, self._loss_var)
         if key not in self._compiled:
             l2 = self._training_config.l2
+            bf16 = self._training_config.bf16_compute
 
-            @jax.jit
-            def step(values, opt_state, ph, rng):
-                train = {n: values[n] for n in sorted(self._trainable)}
-                frozen = {k: v for k, v in values.items() if k not in self._trainable}
+            @functools.partial(jax.jit, donate_argnums=(0, 1))
+            def step(train, opt_state, frozen, ph, rng):
+                def cast(env):
+                    if not bf16:
+                        return env
+                    return {
+                        k: (
+                            v.astype(jnp.bfloat16)
+                            if jnp.issubdtype(v.dtype, jnp.floating)
+                            else v
+                        )
+                        for k, v in env.items()
+                    }
 
                 def loss_fn(train):
-                    env = {**frozen, **train, **ph}
+                    env = cast({**frozen, **train, **ph})
                     (loss,) = self._execute(env, (self._loss_var,), rng=rng)
+                    loss = loss.astype(jnp.float32)
                     if l2:
                         for v in train.values():
                             loss = loss + 0.5 * l2 * jnp.sum(jnp.square(v))
@@ -497,11 +521,14 @@ class SameDiff:
             self._compiled[key] = step
         ph = {k: jnp.asarray(v) for k, v in placeholders.items()}
         rng = self._stream.next()
+        frozen = {
+            k: v for k, v in self._values.items() if k not in self._trainable
+        }
         new_train, self._opt_state, loss = self._compiled[key](
-            self._values, self._opt_state, ph, rng
+            trainable, self._opt_state, frozen, ph, rng
         )
         self._values.update(new_train)
-        return float(loss)
+        return float(loss) if sync else loss
 
     def fit(self, batches, epochs: int = 1) -> list[float]:
         if epochs > 1 and not isinstance(batches, (list, tuple)):

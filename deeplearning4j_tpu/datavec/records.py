@@ -321,7 +321,13 @@ class JDBCRecordReader(RecordReader):
             for row in cur:
                 yield list(row)
         finally:
-            cur.close()
+            # a partially-consumed generator may be finalized AFTER the
+            # connection was closed (GeneratorExit at GC time); closing a
+            # cursor on a closed connection raises in sqlite3
+            try:
+                cur.close()
+            except Exception:
+                pass
 
     def column_names(self) -> list[str]:
         if getattr(self, "_columns", None) is None:

@@ -41,7 +41,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     Subsampling,
     ZeroPadding2D,
 )
-from deeplearning4j_tpu.nn.conf.recurrent import LSTM
+from deeplearning4j_tpu.nn.conf.recurrent import LSTM, LastTimeStep
 from deeplearning4j_tpu.nn.losses import Loss
 from deeplearning4j_tpu.nn.updaters import Adam
 
@@ -199,12 +199,17 @@ _TENSOR_RANK = {InputType.KIND_FF: 2, InputType.KIND_RNN: 3, InputType.KIND_CNN:
 def _map_lstm(cfg, name):
     if _act(cfg.get("activation", "tanh")) != Activation.TANH:
         raise KerasImportError("LSTM import supports tanh cell activation only")
-    return LSTM(
+    lstm = LSTM(
         name=name,
         n_out=int(cfg["units"]),
         gate_activation=_act(cfg.get("recurrent_activation", "sigmoid")),
         forget_gate_bias=1.0 if cfg.get("unit_forget_bias", True) else 0.0,
     )
+    if cfg.get("return_sequences", False):
+        return lstm
+    # Keras default return_sequences=False emits ONLY the final timestep;
+    # mappers may return a chain, so append the collapse explicitly
+    return [lstm, LastTimeStep(name=f"{name}__last")]
 
 
 _LAYER_MAPPERS: Dict[str, Callable] = {
@@ -409,10 +414,12 @@ def import_keras_model(path: str) -> SequentialModel:
                     "importer with register_keras_layer(class_name, mapper)"
                 )
             mapped = _LAYER_MAPPERS[cls](cfg, name)
-            if mapped is not None:
-                confs.append(mapped)
-                if cls == "BatchNormalization":
-                    bn_axes[mapped.name] = _bn_axis(cfg)
+            chain = mapped if isinstance(mapped, (list, tuple)) else (mapped,)
+            for m in chain:
+                if m is not None:
+                    confs.append(m)
+            if cls == "BatchNormalization" and chain[0] is not None:
+                bn_axes[chain[0].name] = _bn_axis(cfg)
         if input_type is None:
             raise KerasImportError("no input shape found in model config")
         if not confs:
@@ -645,10 +652,21 @@ def import_keras_graph(path: str):
                 raise KerasImportError(
                     f"layer {name!r} ({cls}) takes 1 input, got {inputs}"
                 )
-            confs[name] = mapped
+            chain = list(mapped) if isinstance(mapped, (list, tuple)) else [mapped]
+            confs[name] = chain[0]
             if cls == "BatchNormalization":
                 bn_axes[name] = _bn_axis(lcfg)
-            b.add_layer(name, mapped, *inputs)
+            b.add_layer(name, chain[0], *inputs)
+            prev = name
+            for i, extra in enumerate(chain[1:], 1):
+                en = f"{name}__post{i}"
+                b.add_layer(en, extra, prev)
+                confs[en] = extra
+                prev = en
+            if prev != name:
+                # downstream references to the Keras layer name must see
+                # the END of the chain (e.g. the LastTimeStep collapse)
+                alias[name] = prev
 
         # output heads: promote a Dense tail to OutputLayer, else add a
         # LossLayer node per declared output (losses keyed by output name

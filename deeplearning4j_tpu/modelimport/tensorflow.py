@@ -415,6 +415,15 @@ class _Importer:
             b = self.sd.apply("matrix_transpose", b)
         self._bind(node, self.sd.apply("matmul", a, b, name=node.name))
 
+    def op_Einsum(self, node):
+        # modern TF exports tf.einsum as a single Einsum node (N inputs +
+        # an equation attr) rather than lowering to matmul chains
+        eq = self.attr(node, "equation")
+        ins = [self.in_var(i) for i in self.data_inputs(node)]
+        self._bind(
+            node, self.sd.apply("einsum", *ins, name=node.name, equation=eq)
+        )
+
     def op_BatchMatMulV2(self, node):
         a_raw, b_raw = self.data_inputs(node)[:2]
         a, b = self.in_var(a_raw), self.in_var(b_raw)
@@ -601,14 +610,12 @@ def import_graph(path_or_graphdef, trainable: bool = False) -> SameDiff:
     """
     gd = path_or_graphdef
     if isinstance(gd, (str, bytes)) or hasattr(gd, "read"):
-        try:
-            from tensorflow.core.framework import graph_pb2
-        except ImportError as e:  # pragma: no cover
-            raise ImportError(
-                "TF GraphDef import needs the tensorflow protobuf definitions "
-                "(tensorflow is bundled in this environment)"
-            ) from e
-        proto = graph_pb2.GraphDef()
+        # self-contained wire codec (modelimport/_tf) — frozen .pb files
+        # import WITHOUT a tensorflow installation, mirroring the ONNX
+        # importer's approach
+        from deeplearning4j_tpu.modelimport._tf import tf_graph_subset_pb2
+
+        proto = tf_graph_subset_pb2.GraphDef()
         if isinstance(gd, str):
             with open(gd, "rb") as f:
                 proto.ParseFromString(f.read())

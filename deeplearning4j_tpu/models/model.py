@@ -49,8 +49,14 @@ class Model:
 
     @property
     def score_value(self) -> float:
-        """Last training loss (reference `Model.score()`); device-syncs."""
-        return float(self._last_score) if self._last_score is not None else float("nan")
+        """Last training loss (reference `Model.score()`); device-syncs.
+        A non-scalar score (the TBPTT step returns all window losses as one
+        array to avoid a device round-trip per window) reads as its final
+        entry."""
+        if self._last_score is None:
+            return float("nan")
+        s = np.asarray(self._last_score)
+        return float(s.ravel()[-1]) if s.ndim else float(s)
 
     # -- persistence (implemented in train.checkpoint) ---------------------
     def save(self, path: str, save_updater: bool = True) -> None:

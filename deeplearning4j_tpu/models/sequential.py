@@ -73,6 +73,34 @@ class SequentialModel(Model):
         self._tx = self._mask_frozen(self._tx)
         self._stream = SeedStream(conf.seed)
         self._step_fns: dict[Any, Any] = {}
+        self._rnn_runs = self._find_rnn_runs()
+
+    def _find_rnn_runs(self) -> dict[int, int]:
+        """Maximal runs (start index -> length) of >=2 consecutive
+        recurrent layers that can execute as ONE fused time scan: no
+        dropout on non-first members (fused stacks apply only the first
+        layer's dropout) and no flatten boundary inside the run."""
+        from deeplearning4j_tpu.nn.conf.recurrent import RecurrentLayerConfig
+
+        runs: dict[int, int] = {}
+        layers = self.conf.layers
+        i = 0
+        while i < len(layers):
+            if not isinstance(layers[i], RecurrentLayerConfig):
+                i += 1
+                continue
+            j = i + 1
+            while (
+                j < len(layers)
+                and isinstance(layers[j], RecurrentLayerConfig)
+                and not layers[j].dropout_rate
+                and not self._flatten_before[j]
+            ):
+                j += 1
+            if j - i >= 2:
+                runs[i] = j - i
+            i = j
+        return runs
 
     # -- construction ------------------------------------------------------
     def _resolve_output(self) -> tuple[Loss, Activation, bool]:
@@ -130,7 +158,10 @@ class SequentialModel(Model):
         skip = set()
         if plan is not None:
             skip = set(range(plan.start, plan.end))
+        fuse_until = -1
         for i, layer in enumerate(self.conf.layers):
+            if i < fuse_until:
+                continue
             if i in skip:
                 if i == plan.start:
                     from deeplearning4j_tpu.parallel.pipeline import (
@@ -151,6 +182,29 @@ class SequentialModel(Model):
                 continue
             if self._flatten_before[i]:
                 x = x.reshape(x.shape[0], -1)
+            run = self._rnn_runs.get(i, 0)
+            if run >= 2 and not any((i + k) in skip for k in range(run)):
+                from deeplearning4j_tpu.nn.conf.recurrent import fused_rnn_scan
+
+                lys = self.conf.layers[i : i + run]
+                cs = []
+                for l in lys:
+                    c = carries.get(l.name) if carries is not None else None
+                    cs.append(c if c is not None else l.init_carry(x.shape[0], x.dtype))
+                x, fins = fused_rnn_scan(
+                    lys,
+                    [params.get(l.name, {}) for l in lys],
+                    x,
+                    cs,
+                    mask,
+                    training=training,
+                    rng=jax.random.fold_in(rng, i) if rng is not None else None,
+                )
+                if carries is not None:
+                    for l, fc in zip(lys, fins):
+                        new_carries[l.name] = fc
+                fuse_until = i + run
+                continue
             lp = params.get(layer.name, {})
             ls = net_state.get(layer.name, {})
             lrng = jax.random.fold_in(rng, i) if rng is not None else None
@@ -178,18 +232,214 @@ class SequentialModel(Model):
             return x, new_state, new_carries
         return x, new_state
 
+    def _forward_range(self, params, net_state, x, lo: int, hi: int, *,
+                       training: bool, rng):
+        """Forward of layers [lo, hi) only — the pre/post-segment pieces of
+        the 1F1B pipeline step (no masks/carries: the pipelined path
+        rejects them before tracing).  bf16 cast applies at the network
+        entry (lo == 0)."""
+        if lo == 0 and self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
+            x = x.astype(jnp.bfloat16)
+        new_state = {}
+        for i in range(lo, hi):
+            layer = self.conf.layers[i]
+            if self._flatten_before[i]:
+                x = x.reshape(x.shape[0], -1)
+            lrng = jax.random.fold_in(rng, i) if rng is not None else None
+            x, ns = layer.apply(
+                params.get(layer.name, {}), net_state.get(layer.name, {}),
+                x, training=training, rng=lrng,
+            )
+            if ns:
+                new_state[layer.name] = ns
+        return x, new_state
+
+    def _get_step_fn_1f1b(self):
+        """The 1F1B pipeline training step: pre-segment vjp + interleaved-
+        backward pipeline over the segment + post-segment (head) grads
+        accumulated on the last stage — one compiled program.
+
+        vs GPipe (run_pipelined_segment under jax.grad): identical math,
+        but the activation stash is a static 2*pipe-1 ring instead of
+        O(n_micro), so microbatch count no longer affects HBM.
+        Limitations (documented): no masks/TBPTT, and state/aux emitted by
+        POST-segment layers inside the per-microbatch loss is discarded
+        (plan_sequential_pipeline already keeps such layers out of the
+        segment itself)."""
+        key = ("train_1f1b",)
+        if key not in self._step_fns:
+            from jax.sharding import PartitionSpec as P
+            from deeplearning4j_tpu.parallel.pipeline import (
+                pipeline_train_1f1b,
+                split_microbatches,
+            )
+            from deeplearning4j_tpu.runtime.mesh import PIPE_AXIS as _PA
+
+            plan = self._pipeline_plan
+            mesh = self._mesh
+            n_layers = len(self.conf.layers)
+            k, m = plan.k, len(plan.block_names) // plan.k
+            cfg = plan.block_config
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def step(params, opt_state, net_state, step_i, features, labels):
+                rng = SeedStream.fold(self._stream.root, step_i)
+                p_pre = {
+                    n: params[n]
+                    for n in (l.name for l in self.conf.layers[: plan.start])
+                    if n in params
+                }
+                p_post = {
+                    n: params[n]
+                    for n in (l.name for l in self.conf.layers[plan.end:])
+                    if n in params
+                }
+
+                # ---- pre-segment forward; vjp saved for the pipeline's dx
+                def f_pre(pp, x):
+                    return self._forward_range(
+                        pp, net_state, x, 0, plan.start, training=True, rng=rng
+                    )
+
+                x1, vjp_pre, st_pre = jax.vjp(f_pre, p_pre, features,
+                                              has_aux=True)
+
+                # ---- segment params stacked (k, m, ...), stage-major
+                stacked = jax.tree.map(
+                    lambda *xs: jnp.stack(xs),
+                    *[params[n] for n in plan.block_names],
+                )
+                stacked = jax.tree.map(
+                    lambda a: a.reshape((k, m) + a.shape[1:]), stacked
+                )
+
+                @jax.checkpoint
+                def stage_fn(sp, h):
+                    def body(h, p):
+                        y, _ = cfg.apply(p, {}, h, training=True, rng=None)
+                        return y, None
+                    h, _ = jax.lax.scan(body, h, sp)
+                    return h
+
+                x_micro = split_microbatches(x1, plan.n_micro)
+                labels_micro = split_microbatches(labels, plan.n_micro)
+
+                def inner(sp, xm, lm):
+                    sp_local = jax.tree.map(lambda a: a[0], sp)
+
+                    def loss_grad(y, mi):
+                        lbl = lm[mi]
+
+                        def post_loss(pp, yy):
+                            out, _ = self._forward_range(
+                                pp, net_state, yy, plan.end, n_layers,
+                                training=True, rng=rng,
+                            )
+                            if self._custom_loss is not None:
+                                return self._data_loss_custom(
+                                    {**pp}, out, lbl, None
+                                )
+                            if not self._fused_loss:
+                                out = self._out_activation(
+                                    out.astype(jnp.float32)
+                                )
+                            return compute_loss(
+                                self._loss, out, lbl, None,
+                                from_logits=self._fused_loss,
+                            )
+
+                        loss_m, (dpost, dy) = jax.value_and_grad(
+                            post_loss, argnums=(0, 1)
+                        )(p_post, y)
+                        return loss_m, dy, dpost
+
+                    return pipeline_train_1f1b(
+                        stage_fn, sp_local, xm, loss_grad,
+                        axis=_PA,
+                    )
+
+                loss, seg_grads, dx_micro, post_grads = jax.shard_map(
+                    inner,
+                    mesh=mesh,
+                    in_specs=(P(_PA), P(), P()),
+                    out_specs=(P(), P(_PA), P(), P()),
+                    axis_names={_PA},
+                    check_vma=False,
+                )(stacked, x_micro, labels_micro)
+
+                # ---- assemble the full gradient tree
+                dx = dx_micro.reshape((-1,) + dx_micro.shape[2:])
+                pre_grads, _dfeat = vjp_pre(dx)
+                # shard_map returned (k*m, ...) leaves in block order
+                grads = dict(pre_grads)
+                for bi, name in enumerate(plan.block_names):
+                    grads[name] = jax.tree.map(lambda a, _b=bi: a[_b], seg_grads)
+                grads.update(post_grads)
+                # regularization is param-local; add its gradient directly
+                reg_grads = jax.grad(self._reg_loss)(params)
+                grads = jax.tree.map(
+                    lambda g, r: g + r.astype(g.dtype), grads, reg_grads
+                )
+                loss = loss + self._reg_loss(params)
+
+                updates, opt_state = self._tx.update(grads, opt_state, params)
+                params = jax.tree.map(
+                    lambda p, u: p + u.astype(p.dtype), params, updates
+                )
+                merged_state = {**net_state, **st_pre}
+                return params, opt_state, merged_state, loss
+
+            self._step_fns[key] = step
+        return self._step_fns[key]
+
+    def _run_step_1f1b(self, batch: DataSet) -> None:
+        from deeplearning4j_tpu.parallel.data_parallel import place_batch
+        from deeplearning4j_tpu.runtime.crash import oom_report_scope
+        from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
+
+        if batch.labels_mask is not None or batch.features_mask is not None:
+            raise ValueError(
+                "masks are not supported through the 1f1b pipeline schedule; "
+                "drop the masks or use schedule='gpipe' without masks"
+            )
+        step = self._get_step_fn_1f1b()
+        with oom_report_scope(), active_mesh_scope(self._mesh):
+            self.params, self.opt_state, self.net_state, loss = step(
+                self.params,
+                self.opt_state,
+                self.net_state,
+                jnp.uint32(self.iteration),
+                place_batch(self, batch.features),
+                place_batch(self, batch.labels, is_label=True),
+            )
+        self._last_score = loss
+        self.last_batch_size = batch.num_examples
+        self.iteration += 1
+        self._dispatch_iteration(loss)
+
     # -- pipeline parallelism ---------------------------------------------
-    def _setup_pipeline(self, mesh, n_micro: int = 0) -> None:
+    def _setup_pipeline(self, mesh, n_micro: int = 0,
+                        schedule: str = "gpipe") -> None:
         """Called by distribute() when the mesh carries a pipe axis: plan
-        which contiguous block run GPipes over it (raises with an
-        actionable message when the stack has no pipelineable segment)."""
+        which contiguous block run pipelines over it (raises with an
+        actionable message when the stack has no pipelineable segment).
+        schedule: "gpipe" runs inside the ordinary compiled step via
+        _forward; "1f1b" swaps fit() onto a dedicated step whose backward
+        is interleaved into the pipeline (O(pipe) activation stash)."""
         from deeplearning4j_tpu.parallel.pipeline import plan_sequential_pipeline
         from deeplearning4j_tpu.runtime.mesh import PIPE_AXIS
 
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"unknown pipeline schedule {schedule!r}; "
+                "options: 'gpipe', '1f1b'"
+            )
         self._pipeline_plan = plan_sequential_pipeline(
             self.conf.layers, self.params, self._itypes,
             mesh.shape[PIPE_AXIS], n_micro, net_state=self.net_state,
         )
+        self._pipeline_schedule = schedule
+        self._step_fns.clear()
 
     def _active_pipeline_plan(self):
         """The plan, iff tracing under a mesh whose pipe axis is real."""
@@ -271,6 +521,110 @@ class SequentialModel(Model):
                 # carry unchanged state subtrees forward
                 merged_state = {**net_state, **new_state}
                 return params, opt_state, merged_state, loss, new_carries
+
+            self._step_fns[key] = step
+        return self._step_fns[key]
+
+    def _get_step_fn_tbptt(self, has_lmask: bool, has_fmask: bool):
+        """Whole-batch TBPTT as ONE compiled XLA program: a lax.scan over
+        the time windows, each scan iteration doing grad + updater for its
+        window with RNN carries (values only) flowing to the next.  The
+        reference runs one fit per window from Java; a per-window jit
+        dispatch on a tunneled chip costs more than the window's compute
+        (measured ~4ms dispatch vs ~1.4ms compute at BASELINE config 3),
+        so the window loop belongs inside the program."""
+        key = ("train_tbptt", has_lmask, has_fmask)
+        if key not in self._step_fns:
+            from deeplearning4j_tpu.nn.conf.recurrent import (
+                RecurrentLayerConfig,
+            )
+
+            L = self.conf.tbptt_length
+            rnn_layers = [
+                l for l in self.conf.layers
+                if isinstance(l, RecurrentLayerConfig)
+            ]
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def step(params, opt_state, net_state, step_i, features,
+                     labels, lmask, fmask):
+                # window + carry setup live INSIDE the program: on a
+                # tunneled chip every un-jitted host dispatch costs more
+                # than a whole window's compute
+                B, T = features.shape[0], features.shape[1]
+                W = T // L
+                cdtype = (
+                    jnp.bfloat16
+                    if self._bf16 and jnp.issubdtype(features.dtype, jnp.floating)
+                    else features.dtype
+                )
+                carries = {
+                    l.name: l.init_carry(B, cdtype) for l in rnn_layers
+                }
+
+                def windowed(a):
+                    a = a[:, : W * L].reshape((B, W, L) + a.shape[2:])
+                    return jnp.moveaxis(a, 1, 0)
+
+                features_w = windowed(features)
+                labels_w = windowed(labels)
+                lmask_w = windowed(lmask) if has_lmask else jnp.zeros((W, 0))
+                fmask_w = windowed(fmask) if has_fmask else jnp.zeros((W, 0))
+
+                def window(carry, inp):
+                    params, opt_state, net_state, carries, si = carry
+                    feats, labs, lm, fm = inp
+                    rng = SeedStream.fold(self._stream.root, si)
+
+                    def loss_fn(p):
+                        out, new_state, new_carries = self._forward(
+                            p,
+                            net_state,
+                            feats,
+                            training=True,
+                            rng=rng,
+                            fmask=fm if has_fmask else None,
+                            carries=carries,
+                        )
+                        if self._custom_loss is not None:
+                            data_loss = self._data_loss_custom(
+                                p, out, labs, lm if has_lmask else None
+                            )
+                        else:
+                            if not self._fused_loss:
+                                out = self._out_activation(out.astype(jnp.float32))
+                            data_loss = compute_loss(
+                                self._loss,
+                                out,
+                                labs,
+                                lm if has_lmask else None,
+                                from_logits=self._fused_loss,
+                            )
+                        aux, new_state = pop_aux_losses(new_state)
+                        return (
+                            data_loss + self._reg_loss(p) + aux,
+                            (new_state, new_carries),
+                        )
+
+                    (loss, (new_state, new_carries)), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(params)
+                    updates, opt_state = self._tx.update(grads, opt_state, params)
+                    params = jax.tree.map(
+                        lambda p, u: (p + u.astype(p.dtype)), params, updates
+                    )
+                    merged_state = {**net_state, **new_state}
+                    return (
+                        (params, opt_state, merged_state, new_carries, si + 1),
+                        loss,
+                    )
+
+                (params, opt_state, net_state, carries, si), losses = jax.lax.scan(
+                    window,
+                    (params, opt_state, net_state, carries, step_i),
+                    (features_w, labels_w, lmask_w, fmask_w),
+                )
+                return params, opt_state, net_state, losses, carries, si
 
             self._step_fns[key] = step
         return self._step_fns[key]
@@ -429,6 +783,17 @@ class SequentialModel(Model):
                 )
             self._run_step_compressed(batch)
             return
+        if (
+            getattr(self, "_pipeline_schedule", "gpipe") == "1f1b"
+            and getattr(self, "_pipeline_plan", None) is not None
+            and getattr(self, "_mesh", None) is not None
+        ):
+            # NOT _active_pipeline_plan(): that checks the ambient mesh
+            # scope, which only exists INSIDE a running step — at routing
+            # time it would always be None and 1F1B would silently fall
+            # back to GPipe
+            self._run_step_1f1b(batch)
+            return
         if self.conf.backprop_type == "tbptt" and self.conf.tbptt_length > 0:
             self._fit_batch_tbptt(batch)
             return
@@ -487,16 +852,74 @@ class SequentialModel(Model):
                 "TBPTT needs per-timestep labels with a (B, T, ...) time "
                 f"axis matching features; got {batch.labels.shape} for T={T}"
             )
-        carries: dict = {}
-        for t0 in range(0, T, L):
-            sl = slice(t0, min(t0 + L, T))
-            window = DataSet(
-                batch.features[:, sl],
-                batch.labels[:, sl],
-                None if batch.features_mask is None else batch.features_mask[:, sl],
-                None if batch.labels_mask is None else batch.labels_mask[:, sl],
+        W, rem = divmod(T, L)
+        if (
+            not getattr(self, "_tbptt_scan", True)
+            or getattr(self, "_batch_sharding", None) is not None
+            or W < 2
+        ):
+            # distributed models keep the per-window path (place_batch
+            # shards axis 0; the scanned layout's leading axis is windows)
+            carries: dict = {}
+            for t0 in range(0, T, L):
+                sl = slice(t0, min(t0 + L, T))
+                window = DataSet(
+                    batch.features[:, sl],
+                    batch.labels[:, sl],
+                    None if batch.features_mask is None else batch.features_mask[:, sl],
+                    None if batch.labels_mask is None else batch.labels_mask[:, sl],
+                )
+                carries = self._run_step(window, carries=carries)
+            return
+
+        from deeplearning4j_tpu.runtime.crash import oom_report_scope
+
+        has_lmask = batch.labels_mask is not None
+        has_fmask = batch.features_mask is not None
+        step = self._get_step_fn_tbptt(has_lmask, has_fmask)
+        # device-resident step counter + cached empty: a tunneled chip pays
+        # milliseconds per host->device transfer, so per-call traffic is
+        # held to the batch handles alone
+        if getattr(self, "_tbptt_iter_dev", None) is None:
+            self._tbptt_iter_dev = jax.device_put(np.uint32(self.iteration))
+            self._empty_dev = jax.device_put(np.zeros((0,), np.float32))
+        with oom_report_scope():
+            (self.params, self.opt_state, self.net_state, losses,
+             carries, self._tbptt_iter_dev) = step(
+                self.params,
+                self.opt_state,
+                self.net_state,
+                self._tbptt_iter_dev,
+                batch.features,
+                batch.labels,
+                batch.labels_mask if has_lmask else self._empty_dev,
+                batch.features_mask if has_fmask else self._empty_dev,
             )
-            carries = self._run_step(window, carries=carries)
+        self.last_batch_size = batch.num_examples
+        # (W,) device array; score_value reads the final window's loss
+        self._last_score = losses
+        self.iteration += W
+        if self.listeners:
+            # one D2H transfer for all window losses, then per-window
+            # listener dispatch with host scalars
+            host_losses = np.asarray(losses)
+            self.iteration -= W
+            for w in range(W):
+                self._last_score = host_losses[w]
+                self.iteration += 1
+                self._dispatch_iteration(host_losses[w])
+        if rem:
+            tail = slice(W * L, T)
+            window = DataSet(
+                batch.features[:, tail],
+                batch.labels[:, tail],
+                None if batch.features_mask is None else batch.features_mask[:, tail],
+                None if batch.labels_mask is None else batch.labels_mask[:, tail],
+            )
+            self._run_step(window, carries=carries)
+            # the tail step advanced self.iteration outside the device
+            # counter; resync on the next batch
+            self._tbptt_iter_dev = None
 
     # -- layerwise unsupervised pretraining --------------------------------
     def pretrain(self, data, epochs: int = 1, batch_size: int | None = None) -> None:
@@ -722,12 +1145,20 @@ class SequentialModel(Model):
                 )
             labels = batch.labels
             parr = np.asarray(probs)
-            if np.ndim(labels) >= 1 and parr.shape[-1] != np.asarray(labels).shape[-1]:
-                # int class ids (the chunked head's label form); build the
-                # one-hot batch directly — np.eye(vocab) would be a
-                # vocab^2 identity for exactly the large-vocab case
-                ids = np.asarray(labels).astype(np.int64)
-                onehot = np.zeros(ids.shape + (parr.shape[-1],), np.float32)
+            larr = np.asarray(labels)
+            n_out = parr.shape[-1]
+            # int class ids (the chunked head's label form) are detected by
+            # ELEMENT COUNT — one label per prediction position — exactly
+            # as ChunkedSoftmaxOutputLayer's loss does; a trailing-dim
+            # comparison would misread (B,T) ids as one-hot whenever
+            # T == n_out
+            if larr.ndim >= 1 and n_out > 1 and larr.size * n_out == parr.size:
+                ids = larr.astype(np.int64)
+                if ids.ndim == parr.ndim and ids.shape[-1] == 1:
+                    ids = ids[..., 0]
+                # build the one-hot batch directly — np.eye(vocab) would be
+                # a vocab^2 identity for exactly the large-vocab case
+                onehot = np.zeros(ids.shape + (n_out,), np.float32)
                 np.put_along_axis(onehot, ids[..., None], 1.0, axis=-1)
                 labels = onehot
             ev.eval(labels, np.asarray(probs), mask=batch.labels_mask)

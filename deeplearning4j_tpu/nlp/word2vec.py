@@ -52,6 +52,47 @@ def _ns_step(syn0, syn1neg, center, pos, negs, lr):
     return syn0, syn1neg, loss
 
 
+def _make_ns_step_dp(mesh):
+    """Data-parallel negative-sampling step — the role of the reference's
+    distributed Word2Vec (SparkWord2Vec trains word vectors through the
+    parameter server; SURVEY.md §2.2 "NLP").  TPU-native version: pair
+    batches shard over the mesh's data axis, each shard computes its
+    scatter-add delta against the replicated tables, deltas AllReduce via
+    psum — exact synchronous SGD, no server."""
+    from jax.sharding import PartitionSpec as P
+
+    def body(syn0, syn1neg, center, pos, negs, lr):
+        v = syn0[center]
+        targets = jnp.concatenate([pos[:, None], negs], axis=1)
+        labels = jnp.concatenate(
+            [jnp.ones((pos.shape[0], 1)), jnp.zeros(negs.shape)], axis=1
+        )
+        u = syn1neg[targets]
+        logits = jnp.einsum("bd,bkd->bk", v, u)
+        g = (jax.nn.sigmoid(logits) - labels)
+        grad_v = jnp.einsum("bk,bkd->bd", g, u)
+        grad_u = g[..., None] * v[:, None, :]
+        d0 = jnp.zeros_like(syn0).at[center].add(-lr * grad_v)
+        d1 = jnp.zeros_like(syn1neg).at[targets.reshape(-1)].add(
+            -lr * grad_u.reshape(-1, grad_u.shape[-1])
+        )
+        d0 = jax.lax.psum(d0, "data")
+        d1 = jax.lax.psum(d1, "data")
+        loss = jnp.mean(
+            jnp.log1p(jnp.exp(-jnp.where(labels > 0, logits, -logits)))
+        )
+        return syn0 + d0, syn1neg + d1, jax.lax.pmean(loss, "data")
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(), P("data"), P("data"), P("data"), P()),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1))
+
+
 @functools.partial(jax.jit, donate_argnums=(0, 1))
 def _hs_step(syn0, syn1, center, codes, points, mask, lr):
     """Hierarchical-softmax SGD step: codes/points/mask are the padded
@@ -92,6 +133,9 @@ class Word2Vec:
         self.subsample = kw.get("sampling", 1e-3)
         self.seed = kw.get("seed", 42)
         self.batch_size = kw.get("batch_size", 2048)
+        # >1: shard pair batches over that many devices (the reference's
+        # SparkWord2Vec/workers role, realized as synchronous SPMD)
+        self.workers_ = kw.get("workers", 1)
         self.tokenizer_factory = kw.get("tokenizer_factory") or self._default_tf()
         self.vocab: VocabCache | None = None
         self.syn0: np.ndarray | None = None
@@ -120,6 +164,7 @@ class Word2Vec:
                 "seed": "seed",
                 "batch_size": "batch_size",
                 "tokenizer_factory": "tokenizer_factory",
+                "workers": "workers",
             }
             if name in key_map:
                 def setter(v):
@@ -171,6 +216,31 @@ class Word2Vec:
         use_hs = self.negative == 0
         if use_hs:
             codes_m, points_m, mask_m = self.vocab.huffman_matrices()
+        ns_step = _ns_step
+        if self.workers_ > 1 and not use_hs:
+            devs = jax.devices()
+            if len(devs) < self.workers_:
+                raise ValueError(
+                    f"workers={self.workers_} but only {len(devs)} devices "
+                    "visible; distributed Word2Vec shards pair batches over "
+                    "devices"
+                )
+            if self.batch_size % self.workers_:
+                raise ValueError(
+                    f"batch_size {self.batch_size} must divide evenly over "
+                    f"workers={self.workers_}"
+                )
+            from jax.sharding import Mesh
+
+            ns_step = _make_ns_step_dp(
+                Mesh(np.array(devs[: self.workers_]), ("data",))
+            )
+        elif self.workers_ > 1:
+            raise ValueError(
+                "distributed Word2Vec requires negative sampling "
+                "(negative_sample > 0); hierarchical softmax stays "
+                "single-device"
+            )
         total_steps = 0
         planned = max(1, self.epochs_ * sum(len(e) for e in enc))
         seen = 0
@@ -178,14 +248,25 @@ class Word2Vec:
         for _ in range(self.epochs_):
             centers, contexts = self._generate_pairs(enc, keep, rng)
             # pad to a batch multiple (wrap-around) so every step hits the
-            # same compiled executable — ragged final batches would recompile
-            if len(centers) > self.batch_size and len(centers) % self.batch_size:
-                short = self.batch_size - len(centers) % self.batch_size
-                centers = np.concatenate([centers, centers[:short]])
-                contexts = np.concatenate([contexts, contexts[:short]])
-            for i in range(0, len(centers), self.batch_size):
-                c = centers[i : i + self.batch_size]
-                o = contexts[i : i + self.batch_size]
+            # same compiled executable — ragged final batches would
+            # recompile, and the workers>1 shard_map step needs a
+            # devices-divisible batch.  A corpus SMALLER than batch_size
+            # shrinks the batch instead of tiling pairs up to batch_size
+            # (tiling would multiply every pair's gradient, inflating the
+            # effective learning rate ~batch/len times).
+            bs = self.batch_size
+            if len(centers) < bs:
+                bs = max(
+                    self.workers_,
+                    len(centers) - len(centers) % self.workers_,
+                )
+            if len(centers) % bs:
+                n = len(centers) + bs - len(centers) % bs
+                centers = np.resize(centers, n)
+                contexts = np.resize(contexts, n)
+            for i in range(0, len(centers), bs):
+                c = centers[i : i + bs]
+                o = contexts[i : i + bs]
                 # lr decays linearly with progress; passed as a traced scalar
                 # so every step reuses ONE compiled executable
                 lr = jnp.float32(max(self.min_lr, self.lr * (1.0 - seen / planned)))
@@ -197,7 +278,7 @@ class Word2Vec:
                     )
                 else:
                     negs = rng.choice(v, size=(len(c), self.negative), p=ns_probs).astype(np.int32)
-                    syn0j, syn_outj, _ = _ns_step(
+                    syn0j, syn_outj, _ = ns_step(
                         syn0j, syn_outj, jnp.asarray(c), jnp.asarray(o),
                         jnp.asarray(negs), lr,
                     )

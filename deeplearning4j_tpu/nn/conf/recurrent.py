@@ -32,8 +32,16 @@ from deeplearning4j_tpu.utils import serde
 
 class RecurrentLayerConfig(LayerConfig):
     """Base for layers with a time-carry.  Subclasses implement
-    init_carry(batch, dtype) and apply_with_carry(...); plain apply()
-    starts from a zero carry and discards the final one."""
+    init_carry(batch, dtype), input_projection / project_step (the hoisted
+    input matmul) and cell_step (one recurrence step); apply_with_carry
+    scans cell_step over time, and plain apply() starts from a zero carry
+    and discards the final one.
+
+    The cell/projection split exists so STACKS of recurrent layers can run
+    in ONE lax.scan (`fused_rnn_scan`): the sequential chain is the TPU
+    bottleneck (each scan step is latency-, not FLOP-bound), so halving
+    the number of scanned steps by interleaving layer cells beats running
+    one scan per layer."""
 
     EXPECTS = "rnn"
     REGULARIZED = ("Wx", "Wh")
@@ -44,8 +52,41 @@ class RecurrentLayerConfig(LayerConfig):
     def init_carry(self, batch: int, dtype):
         raise NotImplementedError
 
-    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
+    def _cast(self, params, dtype):
+        return {k: v.astype(dtype) for k, v in params.items()}
+
+    def input_projection(self, cp, x):
+        """Hoisted input matmul for the whole sequence: (B,T,F)->(B,T,G)."""
+        return x @ cp["Wx"] + cp["b"]
+
+    def project_step(self, cp, h):
+        """Per-step input matmul (for fused stacks): (B,F)->(B,G)."""
+        return h @ cp["Wx"] + cp["b"]
+
+    def cell_step(self, cp, carry, zin, mt):
+        """One recurrence step. zin: projected input (B,G); mt: (B,1) mask.
+        Returns (new_carry, output (B,H))."""
         raise NotImplementedError
+
+    def fused_cell_step(self, cp, carry, h_below, mt):
+        """One step fed by the RAW lower-layer output (fused stacks).
+        Default: project then step (2 matmuls).  Cells whose input and
+        recurrent projections are structurally additive (LSTM, SimpleRnn)
+        override this with ONE [x;h] @ [Wx;Wh] matmul — the scan chain's
+        wall time tracks the number of sequential matmuls, so halving it
+        matters more than the matmul's size."""
+        return self.cell_step(cp, carry, self.project_step(cp, h_below), mt)
+
+    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        cp = self._cast(params, x.dtype)
+        xproj = self.input_projection(cp, x)
+
+        def cell(c, inp):
+            xt, mt = inp
+            return self.cell_step(cp, c, xt, mt)
+
+        return _scan_time_major(cell, carry, xproj, mask)
 
     ACCEPTS_MASK = True
 
@@ -57,14 +98,56 @@ class RecurrentLayerConfig(LayerConfig):
         return y, state
 
 
+def fused_rnn_scan(layers, params_list, x, carries, mask, *, training=False,
+                   rng=None):
+    """Run a STACK of recurrent layers in ONE lax.scan over time.
+
+    Layer k>0's input projection cannot be hoisted (its input is layer
+    k-1's output at the same step), so it runs per step — the same matmul
+    size as the recurrent term.  What the fusion buys is the sequential
+    chain: one scanned step per timestep instead of one per (timestep x
+    layer), and on TPU the scan chain is latency-bound, not FLOP-bound.
+
+    Dropout: only the FIRST layer's dropout is applied (to the full
+    sequence, pre-hoist); callers must not fuse across a layer with
+    dropout.  Returns (ys from the last layer, [final_carry per layer])."""
+    x = _dropout(x, layers[0].dropout_rate or 0.0, training, rng)
+    cps = [l._cast(p, x.dtype) for l, p in zip(layers, params_list)]
+    xproj = layers[0].input_projection(cps[0], x)
+    # non-first layers with additive projections get a combined [Wx;Wh]
+    # so their per-step input+recurrent matmuls collapse into one
+    for cp in cps[1:]:
+        if "Wx" in cp and "Wh" in cp:
+            cp["WxWh"] = jnp.concatenate([cp["Wx"], cp["Wh"]], axis=0)
+
+    def cell(cs, inp):
+        xt, mt = inp
+        new_cs = []
+        h = None
+        for k, (layer, cp) in enumerate(zip(layers, cps)):
+            if k == 0:
+                ck, h = layer.cell_step(cp, cs[k], xt, mt)
+            else:
+                ck, h = layer.fused_cell_step(cp, cs[k], h, mt)
+            new_cs.append(ck)
+        return tuple(new_cs), h
+
+    ys, finals = _scan_time_major(cell, tuple(carries), xproj, mask)
+    return ys, list(finals)
+
+
 def _scan_time_major(cell, carry, x, mask):
-    """x: (B,T,...) -> scan over T. Returns (ys (B,T,H), final_carry)."""
+    """x: (B,T,...) -> scan over T. Returns (ys (B,T,H), final_carry).
+
+    mask=None is passed through as a STATIC None so cells skip the three
+    per-step blend ops entirely — on TPU the scan chain is launch-bound
+    and unmasked training (the common case) shouldn't pay for masking."""
     xt = jnp.swapaxes(x, 0, 1)  # (T, B, ...)
     if mask is not None:
         mt = jnp.swapaxes(mask.astype(x.dtype), 0, 1)[..., None]  # (T, B, 1)
+        carry, ys = lax.scan(cell, carry, (xt, mt))
     else:
-        mt = jnp.ones((xt.shape[0], xt.shape[1], 1), x.dtype)
-    carry, ys = lax.scan(cell, carry, (xt, mt))
+        carry, ys = lax.scan(lambda c, xt_: cell(c, (xt_, None)), carry, xt)
     return jnp.swapaxes(ys, 0, 1), carry
 
 
@@ -100,32 +183,30 @@ class LSTM(RecurrentLayerConfig):
             jnp.zeros((batch, self.n_out), dtype),
         )
 
-    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
-        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+    def _gates(self, cp, z, carry, mt):
+        (h, cstate) = carry
         n_out = self.n_out
         act = self._act(Activation.TANH)
         gate_act = self.gate_activation
-        wx = params["Wx"].astype(x.dtype)
-        wh = params["Wh"].astype(x.dtype)
-        b = params["b"].astype(x.dtype)
-        # hoist the input projection out of the scan: one big MXU matmul
-        xproj = x @ wx + b  # (B, T, 4H)
+        i = gate_act(z[..., :n_out])
+        f = gate_act(z[..., n_out : 2 * n_out])
+        g = act(z[..., 2 * n_out : 3 * n_out])
+        o = gate_act(z[..., 3 * n_out :])
+        c_new = f * cstate + i * g
+        h_new = o * act(c_new)
+        if mt is None:
+            return (h_new, c_new), h_new
+        c_new = mt * c_new + (1 - mt) * cstate
+        h_new = mt * h_new + (1 - mt) * h
+        return (h_new, c_new), h_new * mt
 
-        def cell(c, inp):
-            (h, cstate) = c
-            xt, mt = inp
-            z = xt + h @ wh
-            i = gate_act(z[..., :n_out])
-            f = gate_act(z[..., n_out : 2 * n_out])
-            g = act(z[..., 2 * n_out : 3 * n_out])
-            o = gate_act(z[..., 3 * n_out :])
-            c_new = f * cstate + i * g
-            h_new = o * act(c_new)
-            c_new = mt * c_new + (1 - mt) * cstate
-            h_new = mt * h_new + (1 - mt) * h
-            return (h_new, c_new), h_new * mt
+    def cell_step(self, cp, carry, zin, mt):
+        z = zin + carry[0] @ cp["Wh"]
+        return self._gates(cp, z, carry, mt)
 
-        return _scan_time_major(cell, carry, xproj, mask)
+    def fused_cell_step(self, cp, carry, h_below, mt):
+        z = jnp.concatenate([h_below, carry[0]], axis=-1) @ cp["WxWh"] + cp["b"]
+        return self._gates(cp, z, carry, mt)
 
 
 @serde.register
@@ -142,34 +223,22 @@ class GravesLSTM(LSTM):
         params["pO"] = jnp.zeros((self.n_out,), jnp.float32)
         return params, state
 
-    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
-        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+    def _gates(self, cp, z, carry, mt):
+        (h, cstate) = carry
         n_out = self.n_out
         act = self._act(Activation.TANH)
         gate_act = self.gate_activation
-        wx = params["Wx"].astype(x.dtype)
-        wh = params["Wh"].astype(x.dtype)
-        b = params["b"].astype(x.dtype)
-        pI = params["pI"].astype(x.dtype)
-        pF = params["pF"].astype(x.dtype)
-        pO = params["pO"].astype(x.dtype)
-        xproj = x @ wx + b
-
-        def cell(c, inp):
-            (h, cstate) = c
-            xt, mt = inp
-            z = xt + h @ wh
-            i = gate_act(z[..., :n_out] + pI * cstate)
-            f = gate_act(z[..., n_out : 2 * n_out] + pF * cstate)
-            g = act(z[..., 2 * n_out : 3 * n_out])
-            c_new = f * cstate + i * g
-            o = gate_act(z[..., 3 * n_out :] + pO * c_new)
-            h_new = o * act(c_new)
-            c_new = mt * c_new + (1 - mt) * cstate
-            h_new = mt * h_new + (1 - mt) * h
-            return (h_new, c_new), h_new * mt
-
-        return _scan_time_major(cell, carry, xproj, mask)
+        i = gate_act(z[..., :n_out] + cp["pI"] * cstate)
+        f = gate_act(z[..., n_out : 2 * n_out] + cp["pF"] * cstate)
+        g = act(z[..., 2 * n_out : 3 * n_out])
+        c_new = f * cstate + i * g
+        o = gate_act(z[..., 3 * n_out :] + cp["pO"] * c_new)
+        h_new = o * act(c_new)
+        if mt is None:
+            return (h_new, c_new), h_new
+        c_new = mt * c_new + (1 - mt) * cstate
+        h_new = mt * h_new + (1 - mt) * h
+        return (h_new, c_new), h_new * mt
 
 
 @serde.register
@@ -192,27 +261,19 @@ class GRU(RecurrentLayerConfig):
     def init_carry(self, batch, dtype):
         return (jnp.zeros((batch, self.n_out), dtype),)
 
-    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
-        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+    def cell_step(self, cp, carry, zin, mt):
+        (h,) = carry
         n_out = self.n_out
         act = self._act(Activation.TANH)
-        wx = params["Wx"].astype(x.dtype)
-        wh = params["Wh"].astype(x.dtype)
-        b = params["b"].astype(x.dtype)
-        xproj = x @ wx + b
-
-        def cell(c, inp):
-            (h,) = c
-            xt, mt = inp
-            hz = h @ wh
-            r = jax.nn.sigmoid(xt[..., :n_out] + hz[..., :n_out])
-            z = jax.nn.sigmoid(xt[..., n_out : 2 * n_out] + hz[..., n_out : 2 * n_out])
-            n = act(xt[..., 2 * n_out :] + r * hz[..., 2 * n_out :])
-            h_new = (1 - z) * n + z * h
-            h_new = mt * h_new + (1 - mt) * h
-            return (h_new,), h_new * mt
-
-        return _scan_time_major(cell, carry, xproj, mask)
+        hz = h @ cp["Wh"]
+        r = jax.nn.sigmoid(zin[..., :n_out] + hz[..., :n_out])
+        z = jax.nn.sigmoid(zin[..., n_out : 2 * n_out] + hz[..., n_out : 2 * n_out])
+        n = act(zin[..., 2 * n_out :] + r * hz[..., 2 * n_out :])
+        h_new = (1 - z) * n + z * h
+        if mt is None:
+            return (h_new,), h_new
+        h_new = mt * h_new + (1 - mt) * h
+        return (h_new,), h_new * mt
 
 
 @serde.register
@@ -235,22 +296,24 @@ class SimpleRnn(RecurrentLayerConfig):
     def init_carry(self, batch, dtype):
         return (jnp.zeros((batch, self.n_out), dtype),)
 
-    def apply_with_carry(self, params, x, carry, *, mask=None, training=False, rng=None):
-        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+    def cell_step(self, cp, carry, zin, mt):
+        (h,) = carry
         act = self._act(Activation.TANH)
-        wx = params["Wx"].astype(x.dtype)
-        wh = params["Wh"].astype(x.dtype)
-        b = params["b"].astype(x.dtype)
-        xproj = x @ wx + b
+        h_new = act(zin + h @ cp["Wh"])
+        if mt is None:
+            return (h_new,), h_new
+        h_new = mt * h_new + (1 - mt) * h
+        return (h_new,), h_new * mt
 
-        def cell(c, inp):
-            (h,) = c
-            xt, mt = inp
-            h_new = act(xt + h @ wh)
-            h_new = mt * h_new + (1 - mt) * h
-            return (h_new,), h_new * mt
-
-        return _scan_time_major(cell, carry, xproj, mask)
+    def fused_cell_step(self, cp, carry, h_below, mt):
+        (h,) = carry
+        act = self._act(Activation.TANH)
+        z = jnp.concatenate([h_below, h], axis=-1) @ cp["WxWh"] + cp["b"]
+        h_new = act(z)
+        if mt is None:
+            return (h_new,), h_new
+        h_new = mt * h_new + (1 - mt) * h
+        return (h_new,), h_new * mt
 
 
 @serde.register

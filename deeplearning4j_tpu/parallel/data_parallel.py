@@ -68,7 +68,7 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
                 "parallelism; GPipe runs over a SequentialModel's "
                 "repeated-block segment"
             )
-        model._setup_pipeline(mesh, config.microbatches)
+        model._setup_pipeline(mesh, config.microbatches, config.schedule)
 
     if config.grad_compression not in ("none", "int8"):
         raise ValueError(

@@ -112,13 +112,18 @@ def pipeline_train_1f1b(
       stage_fn(params, h) -> h' — the stage transform (shape-preserving).
       stage_params — the LOCAL stage's params (sharded by shard_map).
       x_micro — (n_micro, B_micro, ...) microbatches (stage 0 feeds them).
-      loss_grad_fn(y, m) -> (loss_m, dL/dy) — evaluated on the LAST
-        stage's output for microbatch index m (close over labels).
-    Returns (mean_loss, stage_grads, dx_micro): loss averaged over
-    microbatches (same on all devices), the LOCAL stage's param
-    gradients (sum over microbatches), and dL/dx per microbatch
-    (valid on every device via psum — feeds backprop of layers before
-    the segment).
+      loss_grad_fn(y, m) -> (loss_m, dL/dy[, extra_grads]) — evaluated on
+        the LAST stage's output for microbatch index m (close over
+        labels).  The optional third element is a pytree of additional
+        gradients (e.g. the post-segment head's param grads when the loss
+        runs through layers after the pipelined segment); it is summed
+        over microbatches on the last stage and psum-replicated.
+    Returns (mean_loss, stage_grads, dx_micro[, extra_grads]): loss
+    averaged over microbatches (same on all devices), the LOCAL stage's
+    param gradients (sum over microbatches), dL/dx per microbatch (valid
+    on every device via psum — feeds backprop of layers before the
+    segment), and — iff loss_grad_fn returns a third element — the
+    accumulated extra grads, averaged over microbatches.
     """
     n_stages = lax.axis_size(axis)
     stage = lax.axis_index(axis)
@@ -130,6 +135,11 @@ def pipeline_train_1f1b(
 
     buf_shape = x_micro.shape[1:]
     zero_buf = jnp.zeros(buf_shape, x_micro.dtype)
+    # does loss_grad_fn carry extra (post-segment) grads?
+    probe = jax.eval_shape(
+        lambda y: loss_grad_fn(y, 0), jax.ShapeDtypeStruct(buf_shape, x_micro.dtype)
+    )
+    has_extra = len(probe) == 3
     carry = dict(
         fwd=zero_buf,                                  # activation arriving
         bwd=zero_buf,                                  # cotangent arriving
@@ -137,6 +147,10 @@ def pipeline_train_1f1b(
         grads=jax.tree.map(jnp.zeros_like, stage_params),
         loss=jnp.zeros((), jnp.float32),
         dx=jnp.zeros((n_micro,) + buf_shape, x_micro.dtype),
+        extra=(
+            jax.tree.map(lambda s: jnp.zeros(s.shape, s.dtype), probe[2])
+            if has_extra else ()
+        ),
     )
 
     def tick(c, t):
@@ -153,10 +167,17 @@ def pipeline_train_1f1b(
         y = stage_fn(stage_params, h_in)
 
         # ---- last stage: loss + seed cotangent, same tick ----
-        loss_m, g_seed = loss_grad_fn(y, jnp.clip(m_f, 0, n_micro - 1))
+        lg = loss_grad_fn(y, jnp.clip(m_f, 0, n_micro - 1))
+        loss_m, g_seed = lg[0], lg[1]
         is_last = stage == n_stages - 1
         seed_now = is_last & fwd_valid
         loss = c["loss"] + jnp.where(seed_now, loss_m, 0.0)
+        extra = c["extra"]
+        if has_extra:
+            live_e = jnp.where(seed_now, 1.0, 0.0)
+            extra = jax.tree.map(
+                lambda a, d: a + d.astype(a.dtype) * live_e, extra, lg[2]
+            )
 
         # ---- backward: microbatch m_b = t - 2(k-1) + stage ----
         m_b = t - 2 * (n_stages - 1) + stage
@@ -184,6 +205,7 @@ def pipeline_train_1f1b(
             grads=grads,
             loss=loss,
             dx=dx,
+            extra=extra,
         ), None
 
     c, _ = lax.scan(tick, carry, jnp.arange(total))
@@ -193,6 +215,12 @@ def pipeline_train_1f1b(
     # objective is the MEAN over microbatches: scale both grad outputs
     dx_micro = lax.psum(c["dx"], axis) / n_micro
     grads = jax.tree.map(lambda a: a / n_micro, c["grads"])
+    if has_extra:
+        # accumulated on the last stage only; replicate and average
+        extra = jax.tree.map(
+            lambda a: lax.psum(a, axis) / n_micro, c["extra"]
+        )
+        return mean_loss, grads, dx_micro, extra
     return mean_loss, grads, dx_micro
 
 

@@ -45,6 +45,11 @@ class ParallelConfig:
     # microbatches per global batch under pipeline parallelism
     # (0 = auto: 2*pipe, a reasonable bubble amortization)
     microbatches: int = 0
+    # pipeline schedule: "gpipe" (all-forward-then-all-backward; XLA
+    # transposes the forward scan, so activation stash is O(n_micro)) or
+    # "1f1b" (interleaved backward; stash is a static O(pipe) ring —
+    # microbatch count no longer affects activation memory)
+    schedule: str = "gpipe"
     # "int8": error-feedback quantized gradient allreduce on the data
     # axis (the DCN-bandwidth play; see parallel/compression.py).
     # "none": full-precision GSPMD AllReduce (always right over ICI).

@@ -19,6 +19,7 @@ per-buffer attribution (the CrashReportingUtil role).
 from deeplearning4j_tpu.ui.stats import (
     FileStatsStorage,
     InMemoryStatsStorage,
+    RemoteStatsStorageRouter,
     StatsListener,
     StatsStorage,
 )
@@ -30,6 +31,7 @@ __all__ = [
     "StatsStorage",
     "InMemoryStatsStorage",
     "FileStatsStorage",
+    "RemoteStatsStorageRouter",
     "ProfilerListener",
     "UIServer",
 ]

@@ -21,6 +21,8 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Optional
 from urllib.parse import parse_qs, urlparse
 
+from deeplearning4j_tpu.ui.stats import InMemoryStatsStorage
+
 _PAGE = """<!doctype html>
 <html><head><meta charset="utf-8"><title>deeplearning4j_tpu — training</title>
 <style>
@@ -131,7 +133,7 @@ async function refresh(){
   +rs.map(r=>`<tr${best&&r.index===best.index?' class="best"':''}><td>${r.index}</td>`
    +keys.map(k=>{const v=(r.candidate||{})[k];
      return `<td>${typeof v==='number'?v.toPrecision(4):esc(v??'')}</td>`}).join('')
-   +`<td>${r.score==null?'':r.score.toPrecision(5)}</td><td>${r.wall_s??''}</td>`
+   +`<td>${Number.isFinite(r.score)?r.score.toPrecision(5):esc(r.score??'')}</td><td>${esc(r.wall_s??'')}</td>`
    +`<td class="err">${esc(r.error??'')}</td></tr>`).join('')+'</table>';
 }
 setInterval(refresh,3000); refresh();
@@ -201,6 +203,30 @@ class UIServer:
                 else:
                     self._json({"error": "not found"}, 404)
 
+            def do_POST(self):
+                u = urlparse(self.path)
+                if u.path != "/api/stats":
+                    self._json({"error": "not found"}, 404)
+                    return
+                # remote stats ingestion (RemoteUIStatsStorageRouter role):
+                # workers POST their records; the chief's dashboard then
+                # sees every rank's session
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"null")
+                except (ValueError, json.JSONDecodeError):
+                    self._json({"error": "bad json"}, 400)
+                    return
+                records = payload if isinstance(payload, list) else [payload]
+                accepted = 0
+                for rec in records:
+                    if isinstance(rec, dict) and "session" in rec:
+                        outer._remote_sink.put_record(rec)
+                        accepted += 1
+                self._json({"ok": accepted})
+
+        self._remote_sink = InMemoryStatsStorage()
+        self._storages.append(self._remote_sink)
         self._server = ThreadingHTTPServer((host, port), Handler)
         self._server.daemon_threads = True
         self.port = self._server.server_address[1]

@@ -71,6 +71,71 @@ class InMemoryStatsStorage(StatsStorage):
             return list(self._records.get(session_id, []))
 
 
+class RemoteStatsStorageRouter(StatsStorage):
+    """Ship records to a central UIServer over HTTP — the reference's
+    `RemoteUIStatsStorageRouter` role (SURVEY.md §5.5): in a multi-host
+    run each worker attaches this router pointed at the chief's dashboard
+    URL, so one UI sees every rank.
+
+    Fire-and-forget: put_record enqueues and a daemon thread POSTs to
+    /api/stats; a slow or unreachable chief drops records (counted in
+    .dropped) rather than stalling the training loop."""
+
+    def __init__(self, url: str, max_queue: int = 4096, timeout: float = 3.0):
+        import queue
+
+        self.url = url.rstrip("/") + "/api/stats"
+        self.dropped = 0
+        self._timeout = timeout
+        self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
+        self._thread = threading.Thread(target=self._drain, daemon=True)
+        self._thread.start()
+
+    def put_record(self, record: dict) -> None:
+        import queue
+
+        try:
+            self._q.put_nowait(record)
+        except queue.Full:
+            self.dropped += 1
+
+    def _drain(self) -> None:
+        import urllib.request
+
+        while True:
+            rec = self._q.get()
+            if rec is None:
+                self._q.task_done()
+                return
+            try:
+                req = urllib.request.Request(
+                    self.url,
+                    data=json.dumps(rec).encode(),
+                    headers={"Content-Type": "application/json"},
+                    method="POST",
+                )
+                urllib.request.urlopen(req, timeout=self._timeout).read()
+            except Exception:
+                self.dropped += 1
+            finally:
+                self._q.task_done()
+
+    def flush(self) -> None:
+        """Block until every queued record has been attempted."""
+        self._q.join()
+
+    def close(self) -> None:
+        self._q.put(None)
+        self._thread.join(timeout=5)
+
+    # reads happen on the chief; the router is write-only
+    def list_sessions(self) -> list[str]:
+        return []
+
+    def get_records(self, session_id: str) -> list[dict]:
+        return []
+
+
 class FileStatsStorage(StatsStorage):
     """Append-only jsonl file; readable while training writes."""
 

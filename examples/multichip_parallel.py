@@ -17,8 +17,20 @@ os.environ["XLA_FLAGS"] = (
 
 import jax          # noqa: E402
 
-if jax.default_backend() != "cpu" and len(jax.devices()) < 8:
+# Pick the platform BEFORE anything initializes a backend — calling
+# jax.default_backend()/jax.devices() first would lock the platform in and
+# make this update a silent no-op.  The 8-device mesh exists only on the
+# virtual CPU platform, so the example defaults to CPU; set
+# EXAMPLE_FORCE_TPU=1 on a real >=8-chip slice.
+if os.environ.get("EXAMPLE_FORCE_TPU", "") in ("", "0"):
     jax.config.update("jax_platforms", "cpu")
+
+if len(jax.devices()) < 8:
+    raise SystemExit(
+        f"need 8 devices for the mesh, have {len(jax.devices())} "
+        f"{jax.default_backend()} device(s); unset EXAMPLE_FORCE_TPU to "
+        "run on the virtual CPU mesh"
+    )
 
 import numpy as np  # noqa: E402
 

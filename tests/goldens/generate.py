@@ -1,0 +1,204 @@
+"""Regenerate the committed import-golden corpus.
+
+The reference checks in frozen TF graphs + golden outputs so import is
+regression-tested WITHOUT TensorFlow at test time (SURVEY.md §4.1 "TF
+import regression suite", §4.2).  Same scheme here:
+
+  tf/<name>.pb + tf/<name>_io.npz   frozen GraphDef + {input arrays,
+                                    golden outputs computed by REAL TF}
+  keras/<name>.h5 + <name>_io.npz   legacy-HDF5 Keras model + goldens
+                                    computed by REAL tf.keras
+
+tests/test_import_goldens.py consumes these with no tensorflow import;
+this script (which DOES need tensorflow) is only run to regenerate:
+
+    python tests/goldens/generate.py
+"""
+
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import tensorflow as tf  # noqa: E402
+
+tf1 = tf.compat.v1
+keras = tf.keras
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def save_tf(name, build_fn, feeds, fetches):
+    """build_fn populates a fresh TF1 graph; feeds: {placeholder: arr}."""
+    g = tf1.Graph()
+    with g.as_default():
+        build_fn()
+    with tf1.Session(graph=g) as sess:
+        outs = sess.run([f + ":0" for f in fetches],
+                        {k + ":0": v for k, v in feeds.items()})
+    os.makedirs(os.path.join(HERE, "tf"), exist_ok=True)
+    with open(os.path.join(HERE, "tf", f"{name}.pb"), "wb") as f:
+        f.write(g.as_graph_def().SerializeToString())
+    np.savez(
+        os.path.join(HERE, "tf", f"{name}_io.npz"),
+        **{f"in_{k}": v for k, v in feeds.items()},
+        **{f"out_{n}": o for n, o in zip(fetches, outs)},
+    )
+    print(f"tf/{name}.pb: {len(fetches)} golden output(s)")
+
+
+def gen_tf():
+    rng = np.random.default_rng(0)
+
+    w1 = rng.normal(size=(6, 16)).astype(np.float32)
+    b1 = rng.normal(size=(16,)).astype(np.float32)
+    w2 = rng.normal(size=(16, 4)).astype(np.float32)
+
+    def mlp():
+        x = tf1.placeholder(tf.float32, [None, 6], name="x")
+        h = tf.nn.relu(tf.nn.bias_add(tf.matmul(x, tf.constant(w1)), tf.constant(b1)))
+        tf.nn.softmax(tf.matmul(h, tf.constant(w2)), name="out")
+
+    save_tf("mlp", mlp, {"x": rng.normal(size=(5, 6)).astype(np.float32)}, ["out"])
+
+    k1 = rng.normal(0, 0.1, size=(3, 3, 2, 4)).astype(np.float32)
+    k2 = rng.normal(0, 0.1, size=(3, 3, 4, 8)).astype(np.float32)
+
+    def conv_pool():
+        x = tf1.placeholder(tf.float32, [None, 8, 8, 2], name="x")
+        c = tf.nn.relu(tf.nn.conv2d(x, tf.constant(k1), [1, 1, 1, 1], "SAME"))
+        p = tf.nn.max_pool2d(c, 2, 2, "VALID")
+        c2 = tf.nn.conv2d(p, tf.constant(k2), [1, 2, 2, 1], "SAME")
+        tf.reduce_mean(c2, axis=[1, 2], name="out")
+
+    save_tf("conv_pool", conv_pool,
+            {"x": rng.normal(size=(3, 8, 8, 2)).astype(np.float32)}, ["out"])
+
+    g_, b_, mu_, var_ = (rng.normal(size=(5,)).astype(np.float32),
+                         rng.normal(size=(5,)).astype(np.float32),
+                         rng.normal(size=(5,)).astype(np.float32),
+                         rng.uniform(0.5, 2, size=(5,)).astype(np.float32))
+
+    def fused_bn():
+        x = tf1.placeholder(tf.float32, [None, 4, 4, 5], name="x")
+        y, _, _ = tf.compat.v1.nn.fused_batch_norm(
+            x, tf.constant(g_), tf.constant(b_), tf.constant(mu_),
+            tf.constant(var_), epsilon=1e-3, is_training=False,
+        )
+        tf.identity(y, name="out")
+
+    save_tf("fused_bn", fused_bn,
+            {"x": rng.normal(size=(2, 4, 4, 5)).astype(np.float32)}, ["out"])
+
+    wq = rng.normal(0, 0.2, size=(8, 8)).astype(np.float32)
+    wk = rng.normal(0, 0.2, size=(8, 8)).astype(np.float32)
+    wv = rng.normal(0, 0.2, size=(8, 8)).astype(np.float32)
+
+    def attention():
+        x = tf1.placeholder(tf.float32, [2, 6, 8], name="x")
+        q = tf.einsum("btd,de->bte", x, tf.constant(wq))  # einsum lowers to BatchMatMul chains
+        k = tf.einsum("btd,de->bte", x, tf.constant(wk))
+        v = tf.einsum("btd,de->bte", x, tf.constant(wv))
+        s = tf.nn.softmax(tf.matmul(q, k, transpose_b=True) / np.float32(np.sqrt(8.0)))
+        tf.identity(tf.matmul(s, v), name="out")
+
+    save_tf("attention", attention,
+            {"x": rng.normal(size=(2, 6, 8)).astype(np.float32)}, ["out"])
+
+    def gelu_ln():
+        x = tf1.placeholder(tf.float32, [None, 10], name="x")
+        h = 0.5 * x * (1.0 + tf.math.erf(x / np.float32(np.sqrt(2.0))))
+        mu = tf.reduce_mean(h, axis=-1, keepdims=True)
+        var = tf.reduce_mean(tf.math.squared_difference(h, mu), -1, keepdims=True)
+        tf.identity((h - mu) * tf.math.rsqrt(var + 1e-6), name="out")
+
+    save_tf("gelu_ln", gelu_ln,
+            {"x": rng.normal(size=(7, 10)).astype(np.float32)}, ["out"])
+
+    emb = rng.normal(0, 0.1, size=(20, 6)).astype(np.float32)
+
+    def embedding_reduce():
+        ids = tf1.placeholder(tf.int32, [None, 5], name="ids")
+        e = tf.gather(tf.constant(emb), ids)
+        s = tf.transpose(e, [0, 2, 1])
+        tf.reshape(tf.reduce_max(s, axis=-1), [-1, 6], name="out")
+
+    save_tf("embedding_reduce", embedding_reduce,
+            {"ids": rng.integers(0, 20, (4, 5)).astype(np.int32)}, ["out"])
+
+    # the synthesized frozen mini-BERT from the self-contained WRITER,
+    # golden computed by REAL TF — proves writer bytes are genuine TF graphs
+    from deeplearning4j_tpu.modelimport._tf.synthetic import (
+        build_bert_classifier_graphdef,
+    )
+
+    raw = build_bert_classifier_graphdef(
+        vocab=50, d_model=16, n_layers=2, n_heads=2, seq_len=8, batch=3,
+        n_classes=4, seed=1,
+    )
+    gd = tf1.GraphDef()
+    gd.ParseFromString(raw)
+    g = tf1.Graph()
+    with g.as_default():
+        tf1.import_graph_def(gd, name="")
+    ids = rng.integers(0, 50, (3, 8)).astype(np.int32)
+    with tf1.Session(graph=g) as sess:
+        want = sess.run("logits:0", {"ids:0": ids})
+    with open(os.path.join(HERE, "tf", "mini_bert_synth.pb"), "wb") as f:
+        f.write(raw)
+    np.savez(os.path.join(HERE, "tf", "mini_bert_synth_io.npz"),
+             in_ids=ids, out_logits=want)
+    print("tf/mini_bert_synth.pb (writer bytes, TF-executed golden)")
+
+
+def save_keras(name, model, x):
+    os.makedirs(os.path.join(HERE, "keras"), exist_ok=True)
+    p = os.path.join(HERE, "keras", f"{name}.h5")
+    model.save(p)
+    out = np.asarray(model(x, training=False))
+    np.savez(os.path.join(HERE, "keras", f"{name}_io.npz"), in_x=x, out_y=out)
+    print(f"keras/{name}.h5")
+
+
+def gen_keras():
+    rng = np.random.default_rng(1)
+
+    m = keras.Sequential([
+        keras.layers.Input((7,)),
+        keras.layers.Dense(12, activation="relu"),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    save_keras("mlp", m, rng.normal(size=(5, 7)).astype(np.float32))
+
+    m = keras.Sequential([
+        keras.layers.Input((10, 10, 3)),
+        keras.layers.Conv2D(6, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling2D(),
+        keras.layers.BatchNormalization(),
+        keras.layers.Flatten(),
+        keras.layers.Dense(4, activation="softmax"),
+    ])
+    save_keras("cnn", m, rng.normal(size=(2, 10, 10, 3)).astype(np.float32))
+
+    m = keras.Sequential([
+        keras.layers.Input((6, 5)),
+        keras.layers.LSTM(8, return_sequences=True),
+        keras.layers.LSTM(4),
+        keras.layers.Dense(2, activation="sigmoid"),
+    ])
+    save_keras("lstm", m, rng.normal(size=(3, 6, 5)).astype(np.float32))
+
+    inp = keras.layers.Input((9,))
+    a = keras.layers.Dense(8, activation="tanh")(inp)
+    b = keras.layers.Dense(8, activation="relu")(inp)
+    merged = keras.layers.concatenate([a, b])
+    out = keras.layers.Dense(3)(merged)
+    m = keras.Model(inp, out)
+    save_keras("functional_branching", m, rng.normal(size=(4, 9)).astype(np.float32))
+
+
+if __name__ == "__main__":
+    gen_tf()
+    gen_keras()
+    print("done; commit tests/goldens/{tf,keras}/*")

@@ -1,0 +1,137 @@
+"""Regenerate the serialized-model regression corpus.
+
+The reference keeps old-version model zips in its test resources and
+asserts they still load with identical outputs (SURVEY.md §4.1
+"regression tests loading serialized models from old versions", §4.2).
+Same contract here: these artifacts are COMMITTED and must keep loading —
+a serde change that breaks them breaks every user's saved model.  Only
+regenerate when the format changes INTENTIONALLY, and say so in the
+commit message.
+
+    python tests/regression_artifacts/generate.py
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")  # deterministic, device-free
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+
+
+def gen_mln():
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models import SequentialModel
+    from deeplearning4j_tpu.nn import Adam
+    from deeplearning4j_tpu.nn.activations import Activation
+    from deeplearning4j_tpu.nn.conf import (
+        BatchNorm,
+        Conv2D,
+        Dense,
+        InputType,
+        NeuralNetConfiguration,
+        OutputLayer,
+        PoolingType,
+        Subsampling,
+    )
+    from deeplearning4j_tpu.nn.losses import Loss
+
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .updater(Adam(1e-3))
+        .list()
+        .layer(Conv2D(n_out=4, kernel=(3, 3), activation=Activation.RELU))
+        .layer(Subsampling(kernel=(2, 2), stride=(2, 2), pooling=PoolingType.MAX))
+        .layer(BatchNorm())
+        .layer(Dense(n_out=16, activation=Activation.TANH))
+        .layer(OutputLayer(n_out=3, loss=Loss.MCXENT, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.convolutional(8, 8, 1))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (16, 8, 8, 1)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 16)]
+    for _ in range(3):
+        m.fit_batch(DataSet(x, y))
+    m.save(os.path.join(HERE, "mln_cnn.zip"))
+    probe = x[:4]
+    np.savez(os.path.join(HERE, "mln_cnn_io.npz"),
+             in_x=probe, out_y=np.asarray(m.output(probe)))
+    print("mln_cnn.zip")
+
+
+def gen_cg():
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.models.computation_graph import GraphModel
+    from deeplearning4j_tpu.nn.activations import Activation
+    from deeplearning4j_tpu.nn.conf import Dense, InputType, OutputLayer
+    from deeplearning4j_tpu.nn.conf.graph_conf import (
+        ElementWiseOp,
+        ElementWiseVertex,
+        GraphBuilder,
+    )
+    from deeplearning4j_tpu.nn.losses import Loss
+
+    conf = (
+        GraphBuilder()
+        .seed(8)
+        .add_inputs("in")
+        .set_input_types(InputType.feed_forward(6))
+        .add_layer("a", Dense(n_out=8, activation=Activation.RELU), "in")
+        .add_layer("c", Dense(n_out=8, activation=Activation.TANH), "in")
+        .add_vertex("sum", ElementWiseVertex(op=ElementWiseOp.ADD), "a", "c")
+        .add_layer("out", OutputLayer(n_out=2, loss=Loss.MCXENT,
+                                      activation=Activation.SOFTMAX), "sum")
+        .set_outputs("out")
+        .build()
+    )
+    m = GraphModel(conf).init()
+    rng = np.random.default_rng(1)
+    x = rng.normal(0, 1, (12, 6)).astype(np.float32)
+    y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 12)]
+    for _ in range(3):
+        m.fit_batch(DataSet(x, y))
+    m.save(os.path.join(HERE, "cg_branching.zip"))
+    probe = x[:4]
+    out = m.output(probe)
+    out = out[0] if isinstance(out, (tuple, list)) else out
+    np.savez(os.path.join(HERE, "cg_branching_io.npz"),
+             in_x=probe, out_y=np.asarray(out))
+    print("cg_branching.zip")
+
+
+def gen_samediff():
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    rng = np.random.default_rng(2)
+    sd = SameDiff(seed=5)
+    x = sd.placeholder("x")
+    w = sd.var("w", rng.normal(0, 0.3, (5, 4)).astype(np.float32))
+    b = sd.var("b", np.zeros(4, np.float32))
+    h = sd.apply("tanh", (x @ w) + b)
+    sd.apply("softmax", h, name="out")
+    path = os.path.join(HERE, "samediff_mlp.sd.zip")
+    sd.save(path)
+    probe = rng.normal(0, 1, (3, 5)).astype(np.float32)
+    np.savez(os.path.join(HERE, "samediff_mlp_io.npz"),
+             in_x=probe, out_y=np.asarray(sd.output({"x": probe}, "out")))
+    print("samediff_mlp.sd.zip")
+
+
+if __name__ == "__main__":
+    gen_mln()
+    gen_cg()
+    gen_samediff()
+    meta = {"format_version": "round-3", "note": "regenerate ONLY on intentional format changes"}
+    with open(os.path.join(HERE, "manifest.json"), "w") as f:
+        json.dump(meta, f, indent=1)
+    print("done")

@@ -1,0 +1,33 @@
+"""bench.py --scaling must stay runnable ahead of multi-chip hardware
+(BASELINE row 5 readiness): the full DP-scaling sweep, efficiency table and
+input-pipeline overlap check run on a virtual CPU mesh."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_scaling_bench_runs_on_cpu_mesh():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["BENCH_SCALING_DEVICES"] = "2"
+    env["JAX_PLATFORMS"] = ""  # bench decides; avoid conftest leakage
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--scaling"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["platform"] == "cpu"
+    assert [r["devices"] for r in out["rows"]] == [1, 2]
+    for r in out["rows"]:
+        assert r["samples_per_sec"] > 0
+        assert "efficiency" in r and "per_chip" in r
+    assert out["rows"][0]["efficiency"] == 1.0
+    ip = out["input_pipeline"]
+    assert ip["async_feed_samples_per_sec"] > 0
+    assert isinstance(ip["feed_covers_step"], bool)
+    assert os.path.exists(os.path.join(REPO, "BENCH_SCALING.json"))

@@ -429,6 +429,31 @@ class TestJDBCAndSequenceReaders:
         assert rr.has_next() and rr.next_record() == [0.2, 2.0, 1]
         rr.close()
 
+    def test_jdbc_partial_iterator_gc_after_close(self, tmp_path):
+        """A partially-consumed row generator finalized AFTER close() must
+        not raise (sqlite3 'Cannot operate on a closed database' from the
+        generator's cleanup)."""
+        import gc
+        import sqlite3
+        import warnings
+
+        from deeplearning4j_tpu.datavec import JDBCRecordReader
+
+        db = str(tmp_path / "d.db")
+        conn = sqlite3.connect(db)
+        conn.execute("CREATE TABLE t (x REAL)")
+        conn.executemany("INSERT INTO t VALUES (?)", [(float(i),) for i in range(10)])
+        conn.commit()
+        conn.close()
+        rr = JDBCRecordReader(db, "SELECT x FROM t")
+        it = iter(rr)
+        assert next(it) == [0.0]
+        rr.close()
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            del it
+            gc.collect()
+
     def test_csv_sequence_reader(self, tmp_path):
         from deeplearning4j_tpu.datavec import CSVSequenceRecordReader
 

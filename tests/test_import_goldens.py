@@ -1,0 +1,86 @@
+"""Committed-fixture import regression tests — NO tensorflow required.
+
+The reference regression-tests TF/Keras import against checked-in frozen
+graphs + goldens so the import surface stays covered on hosts without the
+source framework (SURVEY.md §4.1, §4.2).  Fixtures live in tests/goldens/
+(regenerate with `python tests/goldens/generate.py` in a TF-capable env);
+the live-TF suites (test_tf_import.py, test_keras_import.py) remain the
+generation-time cross-checks.
+"""
+
+import glob
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.modelimport.keras import import_keras_auto
+from deeplearning4j_tpu.modelimport.tensorflow import import_graph
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+TF_DIR = os.path.join(HERE, "goldens", "tf")
+KERAS_DIR = os.path.join(HERE, "goldens", "keras")
+
+
+def _cases(d, ext):
+    return sorted(
+        os.path.splitext(os.path.basename(p))[0]
+        for p in glob.glob(os.path.join(d, f"*{ext}"))
+    )
+
+
+TF_CASES = _cases(TF_DIR, ".pb")
+KERAS_CASES = _cases(KERAS_DIR, ".h5")
+
+
+def test_corpus_exists():
+    assert len(TF_CASES) >= 6, TF_CASES
+    assert len(KERAS_CASES) >= 4, KERAS_CASES
+
+
+@pytest.mark.parametrize("name", TF_CASES)
+def test_tf_golden(name):
+    sd = import_graph(os.path.join(TF_DIR, f"{name}.pb"))
+    io = np.load(os.path.join(TF_DIR, f"{name}_io.npz"))
+    feeds = {k[3:]: io[k] for k in io.files if k.startswith("in_")}
+    for k in io.files:
+        if not k.startswith("out_"):
+            continue
+        got = np.asarray(sd.output(feeds, k[4:]))
+        np.testing.assert_allclose(
+            got, io[k], atol=2e-4, rtol=1e-3,
+            err_msg=f"goldens/tf/{name} output {k[4:]} drifted",
+        )
+
+
+@pytest.mark.parametrize("name", KERAS_CASES)
+def test_keras_golden(name):
+    model = import_keras_auto(os.path.join(KERAS_DIR, f"{name}.h5"))
+    io = np.load(os.path.join(KERAS_DIR, f"{name}_io.npz"))
+    got = model.output(io["in_x"].astype(np.float32))
+    if isinstance(got, tuple):
+        (got,) = got
+    np.testing.assert_allclose(
+        np.asarray(got), io["out_y"], atol=2e-4, rtol=1e-3,
+        err_msg=f"goldens/keras/{name} drifted",
+    )
+
+
+def test_mini_bert_synth_trainable_finetunes():
+    """The committed writer-produced frozen graph (whose golden was
+    executed by real TF at generation time) fine-tunes end to end —
+    BASELINE config 4's import-then-train path in miniature."""
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    sd = import_graph(os.path.join(TF_DIR, "mini_bert_synth.pb"),
+                      trainable=True)
+    io = np.load(os.path.join(TF_DIR, "mini_bert_synth_io.npz"))
+    ids = io["in_ids"]
+    labels = sd.placeholder("labels")
+    loss = sd.loss.softmax_cross_entropy(sd["logits"], labels, name="loss")
+    sd.set_loss(loss)
+    sd.set_training_config(TrainingConfig(updater=Adam(1e-3)))
+    y = np.eye(4, dtype=np.float32)[[0, 1, 2]]
+    losses = [sd.fit_batch({"ids": ids, "labels": y}) for _ in range(30)]
+    assert losses[-1] < losses[0], losses[:3] + losses[-3:]

@@ -107,6 +107,47 @@ class TestWord2Vec:
         animal_hits = len(set(near) & {"dog", "pet", "fur", "paw"})
         assert animal_hits >= 2, near
 
+    def test_distributed_matches_single_device(self):
+        """workers=4 shards pair batches over the CPU mesh (the reference's
+        SparkWord2Vec/param-server role as synchronous SPMD); resulting
+        vectors must match the single-device run to float tolerance."""
+
+        def build(workers):
+            return (
+                Word2Vec.builder()
+                .min_word_frequency(1)
+                .layer_size(16)
+                .window_size(3)
+                .negative_sample(5)
+                .epochs(4)
+                .seed(1)
+                # smaller than the corpus's pair count so BOTH runs use
+                # identical full batches (the small-corpus shrink path
+                # rounds to a workers multiple, which would differ)
+                .batch_size(64)
+                .workers(workers)
+                .build()
+            )
+
+        single, dist = build(1), build(4)
+        single.fit(_synthetic_corpus())
+        dist.fit(_synthetic_corpus())
+        np.testing.assert_allclose(
+            dist.syn0, single.syn0, rtol=2e-3, atol=2e-4,
+            err_msg="distributed Word2Vec diverged from single-device",
+        )
+        assert dist.similarity("cat", "dog") > dist.similarity("cat", "road")
+
+    def test_distributed_rejects_hs_and_bad_batch(self):
+        w = (Word2Vec.builder().min_word_frequency(1).negative_sample(0)
+             .workers(2).build())
+        with pytest.raises(ValueError, match="negative sampling"):
+            w.fit(_synthetic_corpus())
+        w = (Word2Vec.builder().min_word_frequency(1).negative_sample(5)
+             .workers(3).batch_size(256).build())
+        with pytest.raises(ValueError, match="divide evenly"):
+            w.fit(_synthetic_corpus())
+
     def test_cbow_runs(self):
         w2v = (
             Word2Vec.builder().min_word_frequency(1).layer_size(8)

@@ -156,6 +156,145 @@ def test_tbptt_trains_and_matches_window_count():
     assert acc > 0.9, f"tbptt next-token acc {acc}"
 
 
+def test_tbptt_scan_matches_per_window_path():
+    """The fused lax.scan-over-windows TBPTT step must produce the SAME
+    params/score as the legacy one-jit-call-per-window path (values-only
+    carry flow, per-window optimizer updates)."""
+    V, T = 4, 24
+    rng = np.random.default_rng(7)
+    starts = rng.integers(0, V, 32)
+    seqs = (starts[:, None] + np.arange(T + 1)[None, :]) % V
+    x = np.eye(V, dtype=np.float32)[seqs[:, :-1]]
+    y = np.eye(V, dtype=np.float32)[seqs[:, 1:]]
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(11)
+            .updater(Adam(5e-3))
+            .list()
+            .layer(GravesLSTM(n_out=10, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=V, loss=Loss.MCXENT,
+                                  activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(V))
+            .tbptt(8)
+            .build()
+        )
+        return SequentialModel(conf).init()
+
+    m_scan, m_loop = build(), build()
+    m_loop._tbptt_scan = False
+    for _ in range(3):
+        m_scan.fit_batch(DataSet(x, y))
+        m_loop.fit_batch(DataSet(x, y))
+    assert m_scan.iteration == m_loop.iteration == 9
+    np.testing.assert_allclose(
+        float(m_scan.score_value), float(m_loop.score_value), rtol=1e-5
+    )
+    for lname, lp in m_loop.params.items():
+        for pname, pv in lp.items():
+            np.testing.assert_allclose(
+                np.asarray(m_scan.params[lname][pname]), np.asarray(pv),
+                rtol=2e-4, atol=2e-5,
+                err_msg=f"{lname}/{pname} diverged between TBPTT paths",
+            )
+
+
+def test_fused_rnn_stack_matches_per_layer():
+    """A stack of consecutive recurrent layers runs as ONE fused time scan;
+    output/training must match the layer-by-layer scans exactly."""
+    rng = np.random.default_rng(9)
+    x = rng.normal(0, 1, (8, 12, 5)).astype(np.float32)
+    fmask = (np.arange(12)[None, :] < rng.integers(4, 13, 8)[:, None]).astype(
+        np.float32
+    )
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(21)
+            .updater(Adam(1e-2))
+            .list()
+            .layer(GravesLSTM(n_out=7, activation=Activation.TANH))
+            .layer(GRU(n_out=6))
+            .layer(SimpleRnn(n_out=5))
+            .layer(LastTimeStep())
+            .layer(OutputLayer(n_out=3, loss=Loss.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(5))
+            .build()
+        )
+        return SequentialModel(conf).init()
+
+    m_fused, m_plain = build(), build()
+    assert m_fused._rnn_runs == {0: 3}
+    m_plain._rnn_runs = {}
+
+    np.testing.assert_allclose(
+        np.asarray(m_fused.output(x, fmask)),
+        np.asarray(m_plain.output(x, fmask)),
+        rtol=1e-6, atol=1e-6,
+    )
+    for _ in range(3):
+        m_fused.fit_batch(DataSet(x, y, features_mask=fmask))
+        m_plain.fit_batch(DataSet(x, y, features_mask=fmask))
+    for lname, lp in m_plain.params.items():
+        for pname, pv in lp.items():
+            np.testing.assert_allclose(
+                np.asarray(m_fused.params[lname][pname]), np.asarray(pv),
+                rtol=1e-4, atol=1e-6,
+                err_msg=f"{lname}/{pname} diverged fused vs per-layer",
+            )
+
+
+def test_rnn_run_detection_respects_dropout():
+    """Dropout on a non-first stack member blocks fusion at that boundary
+    (fused scans apply only the first layer's dropout)."""
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(22)
+        .updater(Adam(1e-2))
+        .list()
+        .layer(LSTM(n_out=6, activation=Activation.TANH))
+        .layer(LSTM(n_out=6, activation=Activation.TANH, dropout_rate=0.5))
+        .layer(LSTM(n_out=6, activation=Activation.TANH))
+        .layer(RnnOutputLayer(n_out=3, loss=Loss.MCXENT,
+                              activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(4))
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    # layer1 has dropout -> run [0] stops there; [1,2] fuse as a pair
+    assert m._rnn_runs == {1: 2}
+
+
+def test_tbptt_scan_remainder_window():
+    """T not divisible by tbptt length: full windows run in the scan, the
+    tail window in a follow-up step; iteration counts every window."""
+    V, T = 4, 21  # windows of 8 -> 2 full + tail of 5
+    rng = np.random.default_rng(8)
+    ids = rng.integers(0, V, (16, T + 1))
+    x = np.eye(V, dtype=np.float32)[ids[:, :-1]]
+    y = np.eye(V, dtype=np.float32)[ids[:, 1:]]
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(12)
+        .updater(Adam(5e-3))
+        .list()
+        .layer(LSTM(n_out=8, activation=Activation.TANH))
+        .layer(RnnOutputLayer(n_out=V, loss=Loss.MCXENT,
+                              activation=Activation.SOFTMAX))
+        .set_input_type(InputType.recurrent(V))
+        .tbptt(8)
+        .build()
+    )
+    m = SequentialModel(conf).init()
+    m.fit_batch(DataSet(x, y))
+    assert m.iteration == 3
+    assert np.isfinite(float(m.score_value))
+
+
 def test_bidirectional_shapes_and_training():
     conf = (
         NeuralNetConfiguration.builder()

@@ -1,0 +1,62 @@
+"""Serialized-model format stability — committed zips must load forever.
+
+The reference's test resources carry model zips from old versions and
+assert they still restore with identical outputs (SURVEY.md §4.1); a serde
+refactor that breaks these breaks every user's saved model.  If one of
+these tests fails, the fix is to make the LOADER accept the old format —
+regenerating the artifact is only correct for an intentional,
+version-bumped format change (see regression_artifacts/generate.py).
+"""
+
+import os
+
+import numpy as np
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+ART = os.path.join(HERE, "regression_artifacts")
+
+
+def _io(name):
+    z = np.load(os.path.join(ART, name))
+    return z["in_x"], z["out_y"]
+
+
+def test_mln_zip_loads_with_output_parity():
+    from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+    m = ModelSerializer.restore(os.path.join(ART, "mln_cnn.zip"))
+    x, want = _io("mln_cnn_io.npz")
+    np.testing.assert_allclose(
+        np.asarray(m.output(x)), want, rtol=1e-5, atol=1e-6,
+        err_msg="saved MultiLayerNetwork zip no longer restores identically",
+    )
+    # the restored model must also keep TRAINING (updater state round-trip)
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]
+    m.fit_batch(DataSet(x, y))
+    assert np.isfinite(float(m.score_value))
+
+
+def test_cg_zip_loads_with_output_parity():
+    from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+    m = ModelSerializer.restore(os.path.join(ART, "cg_branching.zip"))
+    x, want = _io("cg_branching_io.npz")
+    out = m.output(x)
+    out = out[0] if isinstance(out, (tuple, list)) else out
+    np.testing.assert_allclose(
+        np.asarray(out), want, rtol=1e-5, atol=1e-6,
+        err_msg="saved ComputationGraph zip no longer restores identically",
+    )
+
+
+def test_samediff_zip_loads_with_output_parity():
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    sd = SameDiff.load(os.path.join(ART, "samediff_mlp.sd.zip"))
+    x, want = _io("samediff_mlp_io.npz")
+    np.testing.assert_allclose(
+        np.asarray(sd.output({"x": x}, "out")), want, rtol=1e-5, atol=1e-6,
+        err_msg="saved SameDiff zip no longer restores identically",
+    )

@@ -116,6 +116,54 @@ class TestUIServer:
         finally:
             server.stop()
 
+    def test_remote_stats_routing(self):
+        """Workers route records to the chief's UIServer over HTTP
+        (RemoteUIStatsStorageRouter role); the chief dashboard then lists
+        every rank's session."""
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter
+
+        server = UIServer(port=0)
+        routers = []
+        try:
+            for rank in range(3):
+                router = RemoteStatsStorageRouter(server.url)
+                routers.append(router)
+                m = small_model()
+                m.set_listeners(
+                    StatsListener(router, session_id=f"rank{rank}")
+                )
+                for i in range(2):
+                    m.fit_batch(batch(i))
+            for router in routers:
+                router.flush()
+                assert router.dropped == 0
+            with urllib.request.urlopen(server.url + "api/sessions") as r:
+                sessions = json.load(r)
+            assert {"rank0", "rank1", "rank2"} <= set(sessions)
+            with urllib.request.urlopen(
+                server.url + "api/stats?session=rank1"
+            ) as r:
+                recs = json.load(r)
+            assert len(recs) == 2 and recs[0]["score"] is not None
+        finally:
+            for router in routers:
+                router.close()
+            server.stop()
+
+    def test_remote_router_unreachable_chief_drops_not_blocks(self):
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter
+
+        router = RemoteStatsStorageRouter(
+            "http://127.0.0.1:9", timeout=0.2  # port 9: discard, never up
+        )
+        try:
+            for i in range(5):
+                router.put_record({"session": "s", "iteration": i})
+            router.flush()
+            assert router.dropped == 5
+        finally:
+            router.close()
+
     def test_singleton_attach_detach(self):
         server = UIServer.get_instance()
         try:
