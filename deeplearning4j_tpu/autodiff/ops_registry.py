@@ -240,9 +240,108 @@ def _rand(kind):
             )
         if kind == "exponential":
             return jax.random.exponential(key, tuple(shape)) / kw.get("rate", 1.0)
+        if kind == "gamma":
+            return jax.random.gamma(key, kw.get("alpha", 1.0), tuple(shape)) / kw.get(
+                "beta", 1.0
+            )
+        if kind == "poisson":
+            return jax.random.poisson(key, kw.get("lam", 1.0), tuple(shape)).astype(
+                jnp.float32
+            )
+        if kind == "truncated_normal":
+            return kw.get("mean", 0.0) + kw.get("std", 1.0) * jax.random.truncated_normal(
+                key, -2.0, 2.0, tuple(shape)
+            )
         raise ValueError(kind)
 
     return fn
+
+
+def _random_shuffle(x, *, seed=0, axis=0):
+    return jax.random.permutation(jax.random.key(seed), x, axis=axis)
+
+
+# -- signal / audio family (the reference's audio declarable ops) -----------
+
+def _frame(x, *, frame_length, frame_step):
+    """Overlapping frames over the LAST axis: (..., T) ->
+    (..., n_frames, frame_length); tail samples that don't fill a frame
+    are dropped (TF signal.frame pad_end=False semantics)."""
+    T = x.shape[-1]
+    n = 1 + (T - frame_length) // frame_step
+    idx = (
+        jnp.arange(n)[:, None] * frame_step + jnp.arange(frame_length)[None, :]
+    )
+    return x[..., idx]
+
+
+def _stft(x, *, frame_length, frame_step, fft_length=None, window="hann"):
+    """Short-time Fourier transform over the last axis -> complex
+    (..., n_frames, fft_length//2 + 1).  Periodic (TF-semantics) window."""
+    fft_length = fft_length or frame_length
+    frames = _frame(x, frame_length=frame_length, frame_step=frame_step)
+    w = _window(window, frame_length, x.dtype)
+    return jnp.fft.rfft(frames * w, n=fft_length, axis=-1)
+
+
+def _istft(s, *, frame_length, frame_step, fft_length=None, window="hann"):
+    """Inverse STFT by windowed overlap-add with COLA normalization.
+    The window name validates exactly like _stft's — a silent rectangular
+    fallback would desynchronize the analysis and synthesis windows."""
+    fft_length = fft_length or frame_length
+    frames = jnp.fft.irfft(s, n=fft_length, axis=-1)[..., :frame_length]
+    w = _window(window, frame_length, frames.dtype)
+    n_frames = s.shape[-2]
+    T = frame_length + (n_frames - 1) * frame_step
+    idx = (
+        jnp.arange(n_frames)[:, None] * frame_step
+        + jnp.arange(frame_length)[None, :]
+    ).reshape(-1)
+    flat = (frames * w).reshape(s.shape[:-2] + (-1,))
+    out = jnp.zeros(s.shape[:-2] + (T,), flat.dtype).at[..., idx].add(flat)
+    norm = jnp.zeros((T,), flat.dtype).at[idx].add(jnp.tile(w * w, n_frames))
+    return out / jnp.maximum(norm, 1e-12)
+
+
+def _window(kind, length, dtype=jnp.float32, periodic=True):
+    """TF-semantics windows: tf.signal.*_window defaults to PERIODIC
+    (denominator N), unlike numpy's symmetric (N-1) forms — goldens
+    against TF graphs depend on this."""
+    n = jnp.arange(length, dtype=jnp.float32)
+    d = float(length if periodic else max(length - 1, 1))
+    if kind == "hann":
+        w = 0.5 - 0.5 * jnp.cos(2.0 * jnp.pi * n / d)
+    elif kind == "hamming":
+        w = 0.54 - 0.46 * jnp.cos(2.0 * jnp.pi * n / d)
+    elif kind == "blackman":
+        w = (
+            0.42
+            - 0.5 * jnp.cos(2.0 * jnp.pi * n / d)
+            + 0.08 * jnp.cos(4.0 * jnp.pi * n / d)
+        )
+    elif kind in (None, "none"):
+        w = jnp.ones((length,), jnp.float32)
+    else:
+        raise ValueError(f"unknown window {kind!r}")
+    return w.astype(dtype)
+
+
+def _histogram_fixed_width(x, *, lo, hi, nbins):
+    edges = jnp.linspace(lo, hi, nbins + 1)
+    b = jnp.clip(jnp.searchsorted(edges, x.reshape(-1), side="right") - 1, 0, nbins - 1)
+    return jnp.zeros((nbins,), jnp.int32).at[b].add(1)
+
+
+def _huber_loss(pred, target, *, delta=1.0):
+    d = jnp.abs(pred - target)
+    return jnp.mean(jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta)))
+
+
+def _kl_divergence(p, q):
+    """KL(p || q) for distributions on the last axis (stable at p=0)."""
+    p = jnp.clip(p, 1e-12, 1.0)
+    q = jnp.clip(q, 1e-12, 1.0)
+    return jnp.mean(jnp.sum(p * (jnp.log(p) - jnp.log(q)), axis=-1))
 
 
 def _matrix_band_part(x, *, lower, upper):
@@ -783,9 +882,108 @@ OPS: dict[str, callable] = {
     "betainc": jax.scipy.special.betainc,
     "truncate_div": lambda a, b: jnp.trunc(a / b),
     "floor_mod": jnp.mod,
+    # signal / audio family (reference audio declarable ops); periodic=True
+    # matches tf.signal defaults (goldens vs TF graphs depend on it)
+    "hann_window": lambda *, length, periodic=True: _window(
+        "hann", length, periodic=periodic
+    ),
+    "hamming_window": lambda *, length, periodic=True: _window(
+        "hamming", length, periodic=periodic
+    ),
+    "blackman_window": lambda *, length, periodic=True: _window(
+        "blackman", length, periodic=periodic
+    ),
+    "frame": _frame,
+    "stft": _stft,
+    "istft": _istft,
+    "fft": lambda x, *, n=None: jnp.fft.fft(x, n=n, axis=-1),
+    "ifft": lambda x, *, n=None: jnp.fft.ifft(x, n=n, axis=-1),
+    "rfft": lambda x, *, n=None: jnp.fft.rfft(x, n=n, axis=-1),
+    "irfft": lambda x, *, n=None: jnp.fft.irfft(x, n=n, axis=-1),
+    "fft2": lambda x: jnp.fft.fft2(x),
+    "ifft2": lambda x: jnp.fft.ifft2(x),
+    "real": jnp.real,
+    "imag": jnp.imag,
+    "complex_abs": lambda x: jnp.abs(x),
+    "angle": jnp.angle,
+    # exotic reductions tail
+    "all": lambda x, *, axis=None, keepdims=False: jnp.all(
+        x != 0, axis=_ax(axis), keepdims=keepdims
+    ).astype(jnp.float32),
+    "any": lambda x, *, axis=None, keepdims=False: jnp.any(
+        x != 0, axis=_ax(axis), keepdims=keepdims
+    ).astype(jnp.float32),
+    "cumulative_logsumexp": lambda x, *, axis=-1: jax.lax.cumlogsumexp(
+        x, axis=axis % x.ndim
+    ),
+    "segment_prod": lambda x, ids, *, num_segments: jax.ops.segment_prod(
+        x, ids.astype(jnp.int32), num_segments
+    ),
+    # set / bucketing ops (static output sizes: XLA needs them)
+    "unique_with_pad": lambda x, *, size, fill=0: jnp.unique(
+        x, size=size, fill_value=fill
+    ),
+    "bincount": lambda x, *, length: jnp.bincount(
+        x.astype(jnp.int32).reshape(-1), length=length
+    ),
+    "searchsorted": lambda sorted_seq, values, *, side="left": jnp.searchsorted(
+        sorted_seq, values, side=side
+    ),
+    "invert_permutation": lambda x: jnp.argsort(x.astype(jnp.int32)),
+    "histogram_fixed_width": _histogram_fixed_width,
+    "nan_to_num": lambda x, *, nan=0.0, posinf=None, neginf=None: jnp.nan_to_num(
+        x, nan=nan, posinf=posinf, neginf=neginf
+    ),
+    # linalg tail
+    "eigh_values": lambda x: jnp.linalg.eigvalsh(x),
+    "eigh_vectors": lambda x: jnp.linalg.eigh(x)[1],
+    "logdet": lambda x: jnp.linalg.slogdet(x)[1],
+    "slogdet_sign": lambda x: jnp.linalg.slogdet(x)[0],
+    "pinv": jnp.linalg.pinv,
+    "triangular_solve": lambda a, b, *, lower=True: (
+        jax.scipy.linalg.solve_triangular(a, b, lower=lower)
+    ),
+    "matrix_power": lambda x, *, n: jnp.linalg.matrix_power(x, n),
+    "kron": jnp.kron,
+    "matrix_rank": lambda x: jnp.linalg.matrix_rank(x).astype(jnp.float32),
+    "expm": jax.scipy.linalg.expm,
+    # loss-function tail (reference ILossFunction family)
+    "huber_loss": _huber_loss,
+    "hinge_loss": lambda pred, target: jnp.mean(
+        jnp.maximum(0.0, 1.0 - target * pred)
+    ),
+    "log_loss": lambda pred, target: -jnp.mean(
+        target * jnp.log(jnp.clip(pred, 1e-7, 1.0))
+        + (1.0 - target) * jnp.log(jnp.clip(1.0 - pred, 1e-7, 1.0))
+    ),
+    "absolute_difference": lambda pred, target: jnp.mean(jnp.abs(pred - target)),
+    "poisson_loss": lambda pred, target: jnp.mean(
+        pred - target * jnp.log(jnp.clip(pred, 1e-7, None))
+    ),
+    "kl_divergence": _kl_divergence,
+    "cosine_proximity_loss": lambda pred, target: -jnp.mean(
+        jnp.sum(pred * target, -1)
+        / jnp.maximum(
+            jnp.linalg.norm(pred, axis=-1) * jnp.linalg.norm(target, axis=-1),
+            1e-12,
+        )
+    ),
+    # random tail
+    "random_gamma": _rand("gamma"),
+    "random_poisson": _rand("poisson"),
+    "random_truncated_normal": _rand("truncated_normal"),
+    "random_shuffle": _random_shuffle,
+    # activation tail
+    "hard_swish": jax.nn.hard_swish,
+    "celu": lambda x, *, alpha=1.0: jax.nn.celu(x, alpha),
+    "glu": lambda x, *, axis=-1: jax.nn.glu(x, axis=axis),
 }
 
 OPS["extract_image_patches"] = OPS["im2col"]
+# jax.ops.segment_* are unsorted-safe (indices_are_sorted=False default),
+# so TF's unsorted_segment_* names are pure aliases — one implementation
+for _k in ("sum", "max", "min", "mean", "prod"):
+    OPS[f"unsorted_segment_{_k}"] = OPS[f"segment_{_k}"]
 
 
 def _ax(axis):

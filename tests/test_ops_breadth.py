@@ -245,3 +245,173 @@ class TestNewOpGradients:
                 assert abs(fd - gflat[i]) < 2e-2 * max(1.0, abs(fd)), (
                     name, ai, i, fd, gflat[i],
                 )
+
+
+class TestSignalFamily:
+    """Audio/signal declarable ops (the reference's audio op family)."""
+
+    def test_windows(self):
+        for name in ("hann_window", "hamming_window", "blackman_window"):
+            w = _np(OPS[name](length=16))
+            # blackman dips infinitesimally below zero at the edges
+            assert w.shape == (16,) and w.min() >= -1e-6 and w.max() <= 1.0
+
+    def test_frame(self):
+        x = np.arange(10, dtype=np.float32)
+        f = _np(OPS["frame"](x, frame_length=4, frame_step=2))
+        assert f.shape == (4, 4)
+        np.testing.assert_array_equal(f[0], [0, 1, 2, 3])
+        np.testing.assert_array_equal(f[1], [2, 3, 4, 5])
+
+    def test_fft_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(3, 16)).astype(np.float32)
+        X = OPS["fft"](x)
+        back = _np(OPS["real"](OPS["ifft"](X)))
+        np.testing.assert_allclose(back, x, atol=1e-5)
+        Xr = OPS["rfft"](x)
+        assert Xr.shape == (3, 9)
+        np.testing.assert_allclose(_np(OPS["irfft"](Xr)), x, atol=1e-5)
+        assert _np(OPS["complex_abs"](Xr)).dtype != np.complex64
+        _ = OPS["angle"](Xr), OPS["imag"](Xr)
+
+    def test_stft_istft_reconstructs(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 256)).astype(np.float32)
+        S = OPS["stft"](x, frame_length=64, frame_step=16)
+        assert S.shape == (2, 13, 33)
+        y = _np(OPS["istft"](S, frame_length=64, frame_step=16))
+        # interior reconstructs (edges lack full overlap coverage)
+        np.testing.assert_allclose(y[:, 64:192], x[:, 64:192], atol=1e-4)
+
+
+class TestReductionTail:
+    def test_all_any(self):
+        x = np.array([[1.0, 0.0], [1.0, 1.0]], np.float32)
+        np.testing.assert_array_equal(_np(OPS["all"](x, axis=1)), [0.0, 1.0])
+        np.testing.assert_array_equal(_np(OPS["any"](x, axis=1)), [1.0, 1.0])
+
+    def test_unsorted_segments(self):
+        x = np.array([1.0, 2.0, 3.0, 4.0], np.float32)
+        ids = np.array([0, 1, 0, 1], np.int32)
+        np.testing.assert_allclose(
+            _np(OPS["unsorted_segment_sum"](x, ids, num_segments=2)), [4.0, 6.0]
+        )
+        np.testing.assert_allclose(
+            _np(OPS["unsorted_segment_mean"](x, ids, num_segments=2)), [2.0, 3.0]
+        )
+        np.testing.assert_allclose(
+            _np(OPS["unsorted_segment_max"](x, ids, num_segments=2)), [3.0, 4.0]
+        )
+        np.testing.assert_allclose(
+            _np(OPS["unsorted_segment_prod"](x, ids, num_segments=2)), [3.0, 8.0]
+        )
+
+    def test_cumulative_logsumexp(self):
+        x = np.array([0.0, 0.0, 0.0], np.float32)
+        out = _np(OPS["cumulative_logsumexp"](x))
+        np.testing.assert_allclose(out, np.log([1.0, 2.0, 3.0]), atol=1e-5)
+
+    def test_bucketing_ops(self):
+        x = np.array([3, 1, 3, 2], np.float32)
+        u = _np(OPS["unique_with_pad"](x, size=4, fill=0))
+        assert set(u.tolist()) == {0.0, 1.0, 2.0, 3.0}
+        np.testing.assert_array_equal(
+            _np(OPS["bincount"](x, length=5)), [0, 1, 1, 2, 0]
+        )
+        h = _np(OPS["histogram_fixed_width"](x, lo=0.0, hi=4.0, nbins=4))
+        assert h.sum() == 4
+        perm = np.array([2, 0, 1], np.int32)
+        np.testing.assert_array_equal(
+            _np(OPS["invert_permutation"](perm)), [1, 2, 0]
+        )
+        np.testing.assert_array_equal(
+            _np(OPS["searchsorted"](np.array([1.0, 3.0, 5.0]), x)), [1, 0, 1, 1]
+        )
+        y = _np(OPS["nan_to_num"](np.array([np.nan, np.inf, 1.0], np.float32)))
+        assert np.isfinite(y).all()
+
+
+class TestLinalgTail:
+    def test_eigh_and_logdet(self):
+        rng = np.random.default_rng(0)
+        a = rng.normal(size=(4, 4)).astype(np.float32)
+        spd = a @ a.T + 4 * np.eye(4, dtype=np.float32)
+        w = _np(OPS["eigh_values"](spd))
+        assert (w > 0).all() and np.all(np.diff(w) >= -1e-4)
+        v = _np(OPS["eigh_vectors"](spd))
+        np.testing.assert_allclose(v @ np.diag(w) @ v.T, spd, atol=1e-3)
+        np.testing.assert_allclose(
+            float(OPS["logdet"](spd)), np.linalg.slogdet(spd)[1], atol=1e-4
+        )
+        assert float(OPS["slogdet_sign"](spd)) == 1.0
+
+    def test_solve_power_kron_pinv(self):
+        rng = np.random.default_rng(1)
+        L = np.tril(rng.normal(size=(3, 3))).astype(np.float32) + 3 * np.eye(3, dtype=np.float32)
+        b = rng.normal(size=(3, 2)).astype(np.float32)
+        x = _np(OPS["triangular_solve"](L, b, lower=True))
+        np.testing.assert_allclose(L @ x, b, atol=1e-4)
+        m = np.array([[1.0, 1.0], [0.0, 1.0]], np.float32)
+        np.testing.assert_allclose(
+            _np(OPS["matrix_power"](m, n=3)), [[1, 3], [0, 1]], atol=1e-5
+        )
+        k = _np(OPS["kron"](np.eye(2, dtype=np.float32), m))
+        assert k.shape == (4, 4)
+        p = _np(OPS["pinv"](m))
+        np.testing.assert_allclose(p @ m, np.eye(2), atol=1e-4)
+        assert float(OPS["matrix_rank"](m)) == 2.0
+        e = _np(OPS["expm"](np.zeros((2, 2), np.float32)))
+        np.testing.assert_allclose(e, np.eye(2), atol=1e-6)
+
+
+class TestLossTail:
+    def test_losses_sane(self):
+        rng = np.random.default_rng(0)
+        pred = rng.uniform(0.1, 0.9, (8, 4)).astype(np.float32)
+        target = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 8)]
+        assert float(OPS["huber_loss"](pred, target, delta=1.0)) >= 0
+        assert float(OPS["absolute_difference"](pred, target)) >= 0
+        assert float(OPS["log_loss"](pred, target)) >= 0
+        assert float(OPS["poisson_loss"](pred, target)) > -np.inf
+        p = np.full((8, 4), 0.25, np.float32)
+        assert abs(float(OPS["kl_divergence"](p, p))) < 1e-6
+        assert float(OPS["kl_divergence"](target + 1e-6, p)) > 0.1
+        assert float(OPS["hinge_loss"](pred, 2 * target - 1)) >= 0
+        same = float(OPS["cosine_proximity_loss"](target, target))
+        assert abs(same + 1.0) < 1e-5
+
+    def test_huber_gradient(self):
+        import jax
+
+        g = jax.grad(lambda p, t: OPS["huber_loss"](p, t, delta=1.0))(
+            np.array([0.5, 5.0], np.float32), np.array([0.0, 0.0], np.float32)
+        )
+        np.testing.assert_allclose(_np(g), [0.25, 0.5], atol=1e-5)
+
+
+class TestRandomAndActivationTail:
+    def test_random_tail_deterministic(self):
+        for name, kw in [
+            ("random_gamma", {"alpha": 2.0}),
+            ("random_poisson", {"lam": 3.0}),
+            ("random_truncated_normal", {}),
+        ]:
+            a = _np(OPS[name](shape=(64,), seed=7, **kw))
+            b = _np(OPS[name](shape=(64,), seed=7, **kw))
+            np.testing.assert_array_equal(a, b)
+            assert a.shape == (64,)
+        x = np.arange(10, dtype=np.float32)
+        s = _np(OPS["random_shuffle"](x, seed=3))
+        assert sorted(s.tolist()) == x.tolist() and not np.array_equal(s, x)
+        tn = _np(OPS["random_truncated_normal"](shape=(256,), seed=1))
+        assert np.abs(tn).max() <= 2.0 + 1e-6
+
+    def test_activation_tail(self):
+        x = np.linspace(-3, 3, 13).astype(np.float32)
+        hs = _np(OPS["hard_swish"](x))
+        assert hs[0] == 0.0 and abs(hs[-1] - 3.0) < 1e-6
+        c = _np(OPS["celu"](x, alpha=1.0))
+        assert (c >= -1.0 - 1e-6).all()
+        g = _np(OPS["glu"](np.ones((2, 4), np.float32)))
+        assert g.shape == (2, 2)
