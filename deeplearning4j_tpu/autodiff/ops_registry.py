@@ -981,9 +981,35 @@ OPS: dict[str, callable] = {
 
 OPS["extract_image_patches"] = OPS["im2col"]
 # jax.ops.segment_* are unsorted-safe (indices_are_sorted=False default),
-# so TF's unsorted_segment_* names are pure aliases — one implementation
-for _k in ("sum", "max", "min", "mean", "prod"):
+# so TF's unsorted_segment_* names alias the same implementations —
+# except max/min, where TF fills EMPTY segments with the dtype's finite
+# lowest/highest while jax yields -inf/+inf (inf * 0 downstream would
+# produce NaN where TF produces 0)
+for _k in ("sum", "mean", "prod"):
     OPS[f"unsorted_segment_{_k}"] = OPS[f"segment_{_k}"]
+
+
+def _unsorted_segment_minmax(kind):
+    def fn(x, ids, *, num_segments):
+        ids = ids.astype(jnp.int32)
+        base = jax.ops.segment_max if kind == "max" else jax.ops.segment_min
+        out = base(x, ids, num_segments)
+        cnt = jax.ops.segment_sum(
+            jnp.ones((x.shape[0],), jnp.float32), ids, num_segments
+        )
+        if jnp.issubdtype(x.dtype, jnp.floating):
+            info = jnp.finfo(x.dtype)
+        else:
+            info = jnp.iinfo(x.dtype)
+        fill = info.min if kind == "max" else info.max
+        shape = (num_segments,) + (1,) * (x.ndim - 1)
+        return jnp.where(cnt.reshape(shape) > 0, out, fill)
+
+    return fn
+
+
+OPS["unsorted_segment_max"] = _unsorted_segment_minmax("max")
+OPS["unsorted_segment_min"] = _unsorted_segment_minmax("min")
 
 
 def _ax(axis):

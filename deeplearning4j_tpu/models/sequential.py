@@ -753,15 +753,34 @@ class SequentialModel(Model):
         self.iteration += 1
         self._dispatch_iteration(loss)
 
-    def fit(self, data, epochs: int = 1, batch_size: int | None = None) -> None:
+    def fit(self, data, epochs: int = 1, batch_size: int | None = None,
+            steps_per_execution: int = 1) -> None:
+        """steps_per_execution > 1 runs that many optimizer steps as ONE
+        compiled XLA program (a lax.scan over stacked batches) — the
+        tf.keras steps_per_execution knob.  On a TPU whose per-dispatch
+        latency rivals a small model's step time this is the difference
+        between dispatch-bound and compute-bound training.  Falls back to
+        per-batch stepping for ragged/mismatched batches and for the
+        TBPTT / compressed / pipelined / distributed paths (which have
+        their own step programs)."""
         if self.params is None:
             self.init()
         iterator = _as_iterator(data, batch_size)
+        use_multi = (
+            steps_per_execution > 1
+            and not getattr(self, "_grad_compression", None)
+            and not (self.conf.backprop_type == "tbptt" and self.conf.tbptt_length > 0)
+            and getattr(self, "_pipeline_schedule", "gpipe") != "1f1b"
+            and getattr(self, "_batch_sharding", None) is None
+        )
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch)
-            for batch in iterator:
-                self.fit_batch(batch)
+            if use_multi:
+                self._fit_epoch_multi(iterator, steps_per_execution)
+            else:
+                for batch in iterator:
+                    self.fit_batch(batch)
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch)
             self.epoch += 1
@@ -770,6 +789,127 @@ class SequentialModel(Model):
             # getattr: on_fit_end is newer than the SPI — tolerate
             # duck-typed listeners written against the original three hooks
             getattr(lst, "on_fit_end", lambda m: None)(self)
+
+    def _fit_epoch_multi(self, iterator, spe: int) -> None:
+        def group_ok(buf):
+            f0, l0 = buf[0].features, buf[0].labels
+            return all(
+                b.features.shape == f0.shape
+                and b.labels.shape == l0.shape
+                and b.features_mask is None
+                and b.labels_mask is None
+                for b in buf
+            )
+
+        # the device-resident step counter is only valid while EVERY step
+        # goes through the grouped program; any single-step fallback (or
+        # steps taken before this fit) advances self.iteration outside it
+        self._multi_iter_dev = None
+        buf: list[DataSet] = []
+        for batch in iterator:
+            buf.append(batch)
+            if len(buf) == spe:
+                if group_ok(buf):
+                    self._run_steps_grouped(buf)
+                else:
+                    for b in buf:
+                        self.fit_batch(b)
+                    self._multi_iter_dev = None
+                buf = []
+        for b in buf:                       # ragged tail group
+            self.fit_batch(b)
+            self._multi_iter_dev = None
+
+    def _finish_grouped_steps(self, losses, k: int) -> None:
+        """Bookkeeping after a program that ran k optimizer steps (TBPTT
+        windows or steps_per_execution groups): score/iteration update,
+        and — only when listeners exist — ONE D2H transfer of all k losses
+        followed by per-step dispatch with host scalars."""
+        self._last_score = losses   # (k,) device array; score_value reads [-1]
+        self.iteration += k
+        if self.listeners:
+            host_losses = np.asarray(losses)
+            self.iteration -= k
+            done = 0
+            try:
+                for w in range(k):
+                    self._last_score = host_losses[w]
+                    self.iteration += 1
+                    done += 1
+                    self._dispatch_iteration(host_losses[w])
+            finally:
+                # a throwing listener must not leave the counter rewound —
+                # all k steps DID run on device
+                self.iteration += k - done
+
+    def _get_step_fn_multi(self):
+        """k optimizer steps in one program: lax.scan over the stacked
+        batch axis, same body as the single step."""
+        key = ("train_multi",)
+        if key not in self._step_fns:
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def step(params, opt_state, net_state, step_i, features_k, labels_k):
+                def one(carry, inp):
+                    params, opt_state, net_state, si = carry
+                    feats, labs = inp
+                    rng = SeedStream.fold(self._stream.root, si)
+
+                    def loss_fn(p):
+                        out, new_state = self._forward(
+                            p, net_state, feats, training=True, rng=rng
+                        )
+                        if self._custom_loss is not None:
+                            data_loss = self._data_loss_custom(p, out, labs, None)
+                        else:
+                            if not self._fused_loss:
+                                out = self._out_activation(out.astype(jnp.float32))
+                            data_loss = compute_loss(
+                                self._loss, out, labs, None,
+                                from_logits=self._fused_loss,
+                            )
+                        aux, new_state = pop_aux_losses(new_state)
+                        return (
+                            data_loss + self._reg_loss(p) + aux, new_state
+                        )
+
+                    (loss, new_state), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(params)
+                    updates, opt_state = self._tx.update(grads, opt_state, params)
+                    params = jax.tree.map(
+                        lambda p, u: (p + u.astype(p.dtype)), params, updates
+                    )
+                    merged = {**net_state, **new_state}
+                    return (params, opt_state, merged, si + 1), loss
+
+                (params, opt_state, net_state, si), losses = jax.lax.scan(
+                    one,
+                    (params, opt_state, net_state, step_i),
+                    (features_k, labels_k),
+                )
+                return params, opt_state, net_state, losses, si
+
+            self._step_fns[key] = step
+        return self._step_fns[key]
+
+    def _run_steps_grouped(self, batches: list) -> None:
+        from deeplearning4j_tpu.runtime.crash import oom_report_scope
+
+        step = self._get_step_fn_multi()
+        k = len(batches)
+        feats = jnp.stack([jnp.asarray(b.features) for b in batches])
+        labs = jnp.stack([jnp.asarray(b.labels) for b in batches])
+        if getattr(self, "_multi_iter_dev", None) is None:
+            self._multi_iter_dev = jax.device_put(np.uint32(self.iteration))
+        with oom_report_scope():
+            (self.params, self.opt_state, self.net_state, losses,
+             self._multi_iter_dev) = step(
+                self.params, self.opt_state, self.net_state,
+                self._multi_iter_dev, feats, labs,
+            )
+        self.last_batch_size = batches[-1].num_examples
+        self._finish_grouped_steps(losses, k)
 
     def fit_batch(self, batch: DataSet) -> None:
         if self.params is None:
@@ -896,18 +1036,7 @@ class SequentialModel(Model):
                 batch.features_mask if has_fmask else self._empty_dev,
             )
         self.last_batch_size = batch.num_examples
-        # (W,) device array; score_value reads the final window's loss
-        self._last_score = losses
-        self.iteration += W
-        if self.listeners:
-            # one D2H transfer for all window losses, then per-window
-            # listener dispatch with host scalars
-            host_losses = np.asarray(losses)
-            self.iteration -= W
-            for w in range(W):
-                self._last_score = host_losses[w]
-                self.iteration += 1
-                self._dispatch_iteration(host_losses[w])
+        self._finish_grouped_steps(losses, W)
         if rem:
             tail = slice(W * L, T)
             window = DataSet(

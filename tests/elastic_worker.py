@@ -91,8 +91,23 @@ def main_dp_parity():
     )
     model = build_model()
     distribute(model, ParallelConfig.data_parallel())
+    router = None
+    ui_url = os.environ.get("DL4JTPU_TEST_UI", "")
+    if ui_url:
+        # remote stats routing: every rank ships its records to the
+        # chief's dashboard (RemoteUIStatsStorageRouter role)
+        from deeplearning4j_tpu.ui import RemoteStatsStorageRouter, StatsListener
+
+        router = RemoteStatsStorageRouter(ui_url)
+        model.set_listeners(
+            StatsListener(router, session_id=f"rank{reg['rank']}")
+        )
     for step in range(FIXED_STEPS):
         model.fit_batch(local_shard(step, reg["rank"], reg["world"]))
+    if router is not None:
+        router.flush()
+        assert router.dropped == 0, f"dropped {router.dropped} stats records"
+        router.close()
     if reg["rank"] == 0 and OUT:
         from deeplearning4j_tpu.runtime.distributed import fetch_global
 

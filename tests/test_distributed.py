@@ -175,6 +175,44 @@ class TestMultiProcessDP:
                 )
 
 
+class TestRemoteStatsFleet:
+    def test_chief_dashboard_sees_all_ranks(self, tmp_path):
+        """Fleet leg of remote stats routing: each worker process attaches
+        a RemoteStatsStorageRouter pointed at the chief's UIServer; after
+        the run the chief dashboard lists every rank's session with the
+        full per-iteration record stream (SURVEY.md §5.5 central UI)."""
+        import urllib.request
+
+        from deeplearning4j_tpu.ui import UIServer
+
+        server = UIServer(port=0)
+        srv = CoordinatorServer(expected_workers=2, heartbeat_timeout=60).start()
+        try:
+            procs = [
+                spawn("dp_parity", f"w{i}", srv.address,
+                      extra={"DL4JTPU_TEST_UI": server.url})
+                for i in range(2)
+            ]
+            rcs = wait_all(procs)
+            if any(rc != 0 for rc in rcs):
+                fail_with_logs(procs, rcs, "remote-stats workers failed")
+            with urllib.request.urlopen(server.url + "api/sessions") as r:
+                sessions = json.load(r)
+            assert {"rank0", "rank1"} <= set(sessions), sessions
+            import elastic_worker as ew
+
+            for rank in (0, 1):
+                with urllib.request.urlopen(
+                    server.url + f"api/stats?session=rank{rank}"
+                ) as r:
+                    recs = json.load(r)
+                assert len(recs) == ew.FIXED_STEPS, (rank, len(recs))
+                assert all(np.isfinite(rec["score"]) for rec in recs)
+        finally:
+            srv.stop()
+            server.stop()
+
+
 # -- elastic: kill one worker, shrink, restore, finish ----------------------
 
 class TestElasticRestore:

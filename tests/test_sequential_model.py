@@ -66,6 +66,51 @@ def test_mlp_learns_two_moons():
     assert ev.accuracy() > 0.95
 
 
+def test_steps_per_execution_matches_per_batch_fit():
+    """steps_per_execution=k compiles k optimizer steps into one program
+    (scan over stacked batches); params, iteration count and listener
+    stream must match the per-batch path exactly."""
+    from deeplearning4j_tpu.train.listeners import CollectScoresListener
+
+    x, y = two_moons(256)
+    it = lambda: NumpyDataSetIterator(x, y, batch_size=32, seed=9)
+    ref = SequentialModel(mlp_conf(seed=3)).init()
+    ref_scores = CollectScoresListener()
+    ref.set_listeners(ref_scores)
+    ref.fit(it(), epochs=2)
+
+    grp = SequentialModel(mlp_conf(seed=3)).init()
+    grp_scores = CollectScoresListener()
+    grp.set_listeners(grp_scores)
+    grp.fit(it(), epochs=2, steps_per_execution=4)
+
+    assert grp.iteration == ref.iteration
+    assert ("train_multi",) in grp._step_fns
+    assert [i for i, _ in grp_scores.scores] == [i for i, _ in ref_scores.scores]
+    np.testing.assert_allclose(
+        [s for _, s in grp_scores.scores], [s for _, s in ref_scores.scores],
+        rtol=1e-4, atol=1e-6,
+    )
+    for k in ref.params:
+        for p in ref.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(grp.params[k][p]), np.asarray(ref.params[k][p]),
+                rtol=2e-4, atol=1e-6,
+                err_msg=f"{k}/{p} diverged under steps_per_execution",
+            )
+
+
+def test_steps_per_execution_ragged_tail():
+    """249 examples / batch 32 = 7 full batches + a ragged one; the tail
+    must train too (single-step fallback), with the right iteration count."""
+    x, y = two_moons(249)
+    m = SequentialModel(mlp_conf(seed=4)).init()
+    m.fit(NumpyDataSetIterator(x, y, batch_size=32, seed=2), epochs=1,
+          steps_per_execution=3)
+    assert m.iteration == 8
+    assert np.isfinite(float(m.score_value))
+
+
 def test_output_probabilities_sum_to_one():
     x, y = two_moons(64)
     model = SequentialModel(mlp_conf()).init()
