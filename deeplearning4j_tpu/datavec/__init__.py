@@ -35,6 +35,7 @@ from deeplearning4j_tpu.datavec.audio import (
 )
 from deeplearning4j_tpu.datavec.schema import Schema, ColumnType
 from deeplearning4j_tpu.datavec.transform import TransformProcess
+from deeplearning4j_tpu.datavec.executor import LocalTransformExecutor
 from deeplearning4j_tpu.datavec.bridge import RecordReaderDataSetIterator
 from deeplearning4j_tpu.datavec.join_reduce import (
     Join,
@@ -59,6 +60,7 @@ __all__ = [
     "Schema",
     "ColumnType",
     "TransformProcess",
+    "LocalTransformExecutor",
     "RecordReaderDataSetIterator",
     "WavFileRecordReader",
     "SpectrogramRecordReader",

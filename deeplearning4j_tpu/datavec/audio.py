@@ -210,13 +210,6 @@ class SpectrogramRecordReader(WavFileRecordReader):
             yield [feats, label]
 
 
-class VideoRecordReader(RecordReader):
-    """Explicit gate: the reference's datavec-data-codec video reader
-    depends on FFmpeg/JavaCV; no video codec ships in this image."""
-
-    def __init__(self, *a, **kw):
-        raise NotImplementedError(
-            "video decoding requires FFmpeg-class codecs that are not "
-            "available in this environment; decode frames offline and use "
-            "ImageRecordReader on the extracted frames instead"
-        )
+# VideoRecordReader moved to datavec.video (real MJPEG-AVI decoding);
+# re-exported here for backwards compatibility with the old gate location
+from deeplearning4j_tpu.datavec.video import VideoRecordReader  # noqa: E402,F401

@@ -1,0 +1,130 @@
+"""Transform executors — the `LocalTransformExecutor` /
+`SparkTransformExecutor` roles (SURVEY.md §2.2 DataVec).
+
+The reference executes a TransformProcess either serially in-process or
+as a Spark job whose serialized DAG ships to cluster executors.  The
+TPU-framework equivalent of that second tier: the process serializes to
+JSON (TransformProcess.to_json), record partitions fan out to worker
+PROCESSES (plain subprocesses running this module, fed JSON over stdin —
+no dependence on the parent's __main__, so it works from scripts, REPLs
+and notebooks alike, and no fork of the JAX-threaded parent), each
+worker rebuilds the pipeline from JSON and transforms its partition.
+Every built-in step is per-row (aggregations live in
+datavec.join_reduce), so partitioning is semantics-preserving, including
+row filters (counts just concatenate).  Worker interpreter startup is
+the Spark-executor-JVM cost of this tier, amortized over cluster-scale
+ETL inputs.
+
+`derive_column` steps carry an arbitrary Python fn that does not
+serialize (reference parity: custom transforms round-trip by class name
+only) — those pipelines run serially with a warning rather than failing.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import warnings
+from typing import List
+
+Records = List[list]
+
+_REPO_ROOT = os.path.dirname(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+)
+
+
+class LocalTransformExecutor:
+    """Executor facade: `execute(process, records)` mirrors the reference's
+    `LocalTransformExecutor.execute(inputData, transformProcess)`; pass
+    num_workers > 1 for the partition-parallel (Spark-role) path."""
+
+    @staticmethod
+    def execute(process, records: Records, num_workers: int = 0,
+                min_records_per_worker: int = 256,
+                timeout: float = 600.0) -> Records:
+        parallel = (
+            num_workers > 1
+            and len(records) >= num_workers * min_records_per_worker
+        )
+        if parallel and any(
+            st.spec.get("kind") == "derive_column" for st in process.steps
+        ):
+            warnings.warn(
+                "TransformProcess contains a derive_column step (opaque "
+                "Python fn — not serializable to workers); executing "
+                "serially",
+                stacklevel=2,
+            )
+            parallel = False
+        if not parallel:
+            return process.execute(records)
+
+        tp_json = process.to_json()
+        n = num_workers
+        size = (len(records) + n - 1) // n
+        parts = [records[i : i + size] for i in range(0, len(records), size)]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        procs = [
+            subprocess.Popen(
+                [sys.executable, "-m", "deeplearning4j_tpu.datavec.executor"],
+                stdin=subprocess.PIPE, stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE, env=env, text=True,
+            )
+            for _ in parts
+        ]
+        # feed + drain every worker CONCURRENTLY — payloads exceed pipe
+        # buffers, so sequential communicate() calls would serialize the
+        # whole fan-out (worker k+1 idle until worker k exits)
+        import threading
+
+        results: list = [None] * len(procs)
+
+        def pump(i, p, part):
+            results[i] = p.communicate(
+                json.dumps({"process": tp_json, "records": part}),
+                timeout=timeout,
+            )
+
+        threads = [
+            threading.Thread(target=pump, args=(i, p, part), daemon=True)
+            for i, (p, part) in enumerate(zip(procs, parts))
+        ]
+        try:
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(timeout=timeout)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+        out: Records = []
+        errors = []
+        for p, res in zip(procs, results):
+            if res is None or p.returncode != 0:
+                errors.append(
+                    (res[1] if res else "worker timed out")[-2000:]
+                )
+                continue
+            out.extend(json.loads(res[0]))
+        if errors:
+            raise RuntimeError(
+                "transform worker(s) failed:\n" + "\n---\n".join(errors)
+            )
+        return out
+
+
+def _worker_main() -> None:
+    payload = json.load(sys.stdin)
+    from deeplearning4j_tpu.datavec.transform import TransformProcess
+
+    tp = TransformProcess.from_json(payload["process"])
+    json.dump(tp.execute(payload["records"]), sys.stdout)
+
+
+if __name__ == "__main__":
+    _worker_main()

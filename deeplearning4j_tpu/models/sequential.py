@@ -762,7 +762,13 @@ class SequentialModel(Model):
         between dispatch-bound and compute-bound training.  Falls back to
         per-batch stepping for ragged/mismatched batches and for the
         TBPTT / compressed / pipelined / distributed paths (which have
-        their own step programs)."""
+        their own step programs).
+
+        Listener caveat (shared with Keras): per-iteration listeners fire
+        AFTER each group completes, so a state-READING listener
+        (checkpoint/evaluative) invoked for a mid-group iteration sees the
+        END-of-group params; losses/scores are exact per step.  Keep
+        steps_per_execution=1 when mid-group snapshots must be exact."""
         if self.params is None:
             self.init()
         iterator = _as_iterator(data, batch_size)

@@ -95,9 +95,81 @@ def test_compressed_audio_gated(tmp_path):
         WavFileRecordReader().initialize(tmp_path)
 
 
-def test_video_reader_gated():
-    with pytest.raises(NotImplementedError, match="video decoding"):
-        VideoRecordReader("anything.mp4")
+class TestVideoReader:
+    """MJPEG-AVI video decoding without FFmpeg (datavec.video)."""
+
+    def _write_tree(self, root):
+        from deeplearning4j_tpu.datavec.video import write_mjpeg_avi
+
+        rng = np.random.default_rng(0)
+        for label, base in (("walk", 40), ("run", 200)):
+            d = root / label
+            d.mkdir()
+            for i in range(2):
+                # class-distinct brightness so a consumer could classify
+                frames = np.clip(
+                    rng.normal(base, 10, (5, 24, 32, 3)), 0, 255
+                ).astype(np.uint8)
+                write_mjpeg_avi(d / f"{i}.avi", frames, fps=10)
+
+    def test_roundtrip_and_labels(self, tmp_path):
+        self._write_tree(tmp_path)
+        rr = VideoRecordReader(12, 16, 3).initialize(tmp_path)
+        assert rr.labels == ["run", "walk"]
+        assert rr.num_videos() == 4
+        recs = list(rr)
+        assert len(recs) == 4
+        frames, label = recs[0]
+        assert frames.shape == (5, 12, 16, 3)
+        assert label in (0, 1)
+        # brightness separates the classes through the JPEG round trip
+        means = {lab: [] for lab in (0, 1)}
+        for f, lab in recs:
+            means[lab].append(f.mean())
+        assert abs(np.mean(means[0]) - np.mean(means[1])) > 50
+
+    def test_max_frames_and_grayscale(self, tmp_path):
+        self._write_tree(tmp_path)
+        rr = VideoRecordReader(8, 8, 1, max_frames=3).initialize(tmp_path)
+        frames, _ = next(iter(rr))
+        assert frames.shape == (3, 8, 8, 1)
+
+    def test_non_mjpeg_stream_raises(self, tmp_path):
+        import struct
+
+        # hand-build an AVI whose video chunk is NOT JPEG
+        payload = b"00dc" + struct.pack("<I", 4) + b"\x00\x01\x02\x03"
+        movi = b"LIST" + struct.pack("<I", 4 + len(payload)) + b"movi" + payload
+        body = b"AVI " + movi
+        p = tmp_path / "raw.avi"
+        p.write_bytes(b"RIFF" + struct.pack("<I", len(body)) + body)
+        from deeplearning4j_tpu.datavec.video import read_avi_frames
+
+        with pytest.raises(NotImplementedError, match="MJPEG"):
+            read_avi_frames(p, 8, 8)
+
+    def test_non_avi_video_tree_gives_codec_advice(self, tmp_path):
+        (tmp_path / "clips").mkdir()
+        (tmp_path / "clips" / "a.mp4").write_bytes(b"\x00" * 16)
+        with pytest.raises(NotImplementedError, match="MJPEG"):
+            VideoRecordReader(8, 8).initialize(tmp_path)
+
+    def test_uppercase_extension_found(self, tmp_path):
+        from deeplearning4j_tpu.datavec.video import write_mjpeg_avi
+
+        d = tmp_path / "c"
+        d.mkdir()
+        write_mjpeg_avi(d / "X.AVI", np.zeros((2, 8, 8, 3), np.uint8))
+        rr = VideoRecordReader(8, 8).initialize(tmp_path)
+        assert rr.num_videos() == 1
+
+    def test_non_avi_rejected(self, tmp_path):
+        p = tmp_path / "x.avi"
+        p.write_bytes(b"not an avi at all")
+        from deeplearning4j_tpu.datavec.video import read_avi_frames
+
+        with pytest.raises(ValueError, match="not an AVI"):
+            read_avi_frames(p, 8, 8)
 
 
 def test_spectrogram_reader_trains_classifier(audio_tree):

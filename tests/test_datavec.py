@@ -532,3 +532,66 @@ class TestLabelGeneratorsAndPathFilters:
             ImageRecordReader(
                 4, 4, 1, label_generator=pattern_label_generator("_", 5)
             ).initialize(flat_tree)
+
+
+class TestTransformExecutor:
+    def _process(self):
+        from deeplearning4j_tpu.datavec import Schema, TransformProcess
+
+        schema = (
+            Schema.builder().add_double("x").add_double("y")
+            .add_categorical("c", ["a", "b"]).build()
+        )
+        return (
+            TransformProcess.builder(schema)
+            .double_math_op("x", "multiply", 2.0)
+            .categorical_to_integer("c")
+            .filter_rows("y", "lt", 0.5)
+            .build()
+        )
+
+    def _records(self, n=4096):
+        import numpy as np
+
+        rng = np.random.default_rng(0)
+        return [
+            [float(i), float(rng.random()), "a" if i % 2 else "b"]
+            for i in range(n)
+        ]
+
+    def test_parallel_matches_serial(self):
+        from deeplearning4j_tpu.datavec import LocalTransformExecutor
+
+        tp = self._process()
+        recs = self._records()
+        serial = tp.execute([list(r) for r in recs])
+        par = LocalTransformExecutor.execute(tp, recs, num_workers=4)
+        assert par == serial
+        assert len(par) < len(recs)          # the row filter actually fired
+
+    def test_small_input_stays_serial_and_derive_falls_back(self):
+        import warnings
+
+        from deeplearning4j_tpu.datavec import (
+            LocalTransformExecutor,
+            Schema,
+            TransformProcess,
+        )
+
+        tp = self._process()
+        small = self._records(16)
+        assert LocalTransformExecutor.execute(tp, small, num_workers=4) == \
+            tp.execute([list(r) for r in small])
+
+        schema = Schema.builder().add_double("x").build()
+        tp2 = (
+            TransformProcess.builder(schema)
+            .derive_column("x2", "double", ["x"], fn=lambda x: x * 3)
+            .build()
+        )
+        recs = [[float(i)] for i in range(4096)]
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            out = LocalTransformExecutor.execute(tp2, recs, num_workers=4)
+        assert any("derive_column" in str(x.message) for x in w)
+        assert out[5] == [5.0, 15.0]

@@ -415,3 +415,16 @@ class TestRandomAndActivationTail:
         assert (c >= -1.0 - 1e-6).all()
         g = _np(OPS["glu"](np.ones((2, 4), np.float32)))
         assert g.shape == (2, 2)
+
+
+def test_unsorted_segment_empty_segment_fills():
+    """TF semantics on EMPTY segments: mean fills 0 (not NaN), max/min
+    fill the dtype's finite lowest/highest (not +/-inf)."""
+    x = np.array([1.0, 3.0], np.float32)
+    ids = np.array([0, 0], np.int32)
+    mean = _np(OPS["unsorted_segment_mean"](x, ids, num_segments=3))
+    np.testing.assert_allclose(mean, [2.0, 0.0, 0.0])
+    mx = _np(OPS["unsorted_segment_max"](x, ids, num_segments=3))
+    assert mx[0] == 3.0 and np.isfinite(mx).all()
+    mn = _np(OPS["unsorted_segment_min"](x, ids, num_segments=3))
+    assert mn[0] == 1.0 and np.isfinite(mn).all()
