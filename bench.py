@@ -177,8 +177,8 @@ def _timed_fit(model, batches, warmup: int, iters: int, spe: int = 1) -> float:
         # train wrong-but-plausibly
         assert getattr(model, "_batch_sharding", None) is None
         assert not getattr(model, "_grad_compression", None)
-        assert not (
-            model.conf.backprop_type == "tbptt" and model.conf.tbptt_length > 0
+        assert getattr(model.conf, "backprop_type", "") != "tbptt" or not getattr(
+            model.conf, "tbptt_length", 0
         )
         assert getattr(model, "_pipeline_schedule", "gpipe") != "1f1b"
         model._multi_iter_dev = None
@@ -286,10 +286,12 @@ def bench_resnet50(peak):
         for _ in range(2 if QUICK else 4)
     ]
     flops = _fwd_flops_graph(model, (np.asarray(batches[0].features),))
-    sps = _timed_fit(model, batches, warmup=2 if QUICK else 10,
-                     iters=4 if QUICK else 60)
+    spe = 1 if QUICK else 4
+    sps = _timed_fit(model, batches, warmup=2 if QUICK else 12,
+                     iters=4 if QUICK else 60, spe=spe)
     return _entry("resnet50_cg", sps, flops, peak, batch,
-                  image=f"{hw}x{hw}x3 synthetic", num_classes=n_classes)
+                  image=f"{hw}x{hw}x3 synthetic", num_classes=n_classes,
+                  steps_per_execution=spe)
 
 
 def bench_lstm(peak):

@@ -18,6 +18,13 @@ ETL inputs.
 `derive_column` steps carry an arbitrary Python fn that does not
 serialize (reference parity: custom transforms round-trip by class name
 only) — those pipelines run serially with a warning rather than failing.
+
+Economics (same as any process-shipping ETL tier, Spark included): each
+record pays a JSON round-trip, so the fan-out wins when per-row transform
+work dominates serialization — long step chains, string parsing, joins of
+wide rows — and loses on trivial scalar math.  num_workers=0 (serial) is
+always correct; the default min_records_per_worker guard keeps small
+inputs serial automatically.
 """
 
 from __future__ import annotations
@@ -68,9 +75,14 @@ class LocalTransformExecutor:
         parts = [records[i : i + size] for i in range(0, len(records), size)]
         env = dict(os.environ)
         env["PYTHONPATH"] = _REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        # run the worker as a FILE with -S: -m would import the package
+        # __init__ chain (which reaches jax) before this module even runs,
+        # and site initialization itself can be seconds on hosts whose
+        # sitecustomize registers accelerator plugins.  The worker needs
+        # only the stdlib plus two pure-stdlib modules loaded by path.
         procs = [
             subprocess.Popen(
-                [sys.executable, "-m", "deeplearning4j_tpu.datavec.executor"],
+                [sys.executable, "-S", os.path.abspath(__file__)],
                 stdin=subprocess.PIPE, stdout=subprocess.PIPE,
                 stderr=subprocess.PIPE, env=env, text=True,
             )
@@ -118,11 +130,43 @@ class LocalTransformExecutor:
         return out
 
 
+def _load_transform_module():
+    """Import datavec.transform WITHOUT the package __init__ chain — that
+    chain reaches `import jax` (bridge -> data.iterator), a multi-second
+    cost per worker that would often exceed the serial transform time the
+    fan-out exists to beat.  schema/transform themselves are pure stdlib,
+    so in a fresh interpreter they load by file path under stub parent
+    packages; a process that already imported the real package just uses
+    it."""
+    if "deeplearning4j_tpu.datavec.transform" in sys.modules:
+        return sys.modules["deeplearning4j_tpu.datavec.transform"]
+    if "deeplearning4j_tpu" in sys.modules:
+        from deeplearning4j_tpu.datavec import transform
+
+        return transform
+    import importlib.util
+    import types
+
+    base = os.path.dirname(os.path.abspath(__file__))
+    for name in ("deeplearning4j_tpu", "deeplearning4j_tpu.datavec"):
+        stub = types.ModuleType(name)
+        stub.__path__ = []
+        sys.modules.setdefault(name, stub)
+    for mod in ("schema", "transform"):
+        full = f"deeplearning4j_tpu.datavec.{mod}"
+        spec = importlib.util.spec_from_file_location(
+            full, os.path.join(base, f"{mod}.py")
+        )
+        m = importlib.util.module_from_spec(spec)
+        sys.modules[full] = m
+        spec.loader.exec_module(m)
+    return sys.modules["deeplearning4j_tpu.datavec.transform"]
+
+
 def _worker_main() -> None:
     payload = json.load(sys.stdin)
-    from deeplearning4j_tpu.datavec.transform import TransformProcess
-
-    tp = TransformProcess.from_json(payload["process"])
+    transform = _load_transform_module()
+    tp = transform.TransformProcess.from_json(payload["process"])
     json.dump(tp.execute(payload["records"]), sys.stdout)
 
 

@@ -55,7 +55,9 @@ def read_avi_frames(path, height: int, width: int, channels: int = 3,
         raise ValueError(f"{path}: not an AVI (RIFF) file")
     frames = []
     for fourcc, payload in _iter_chunks(data, 0, len(data)):
-        if fourcc[2:4] not in (b"dc", b"db") or not payload:
+        # stream 00 only: a multi-stream AVI (main + thumbnail mux) must
+        # not interleave unrelated streams into one clip
+        if fourcc[:2] != b"00" or fourcc[2:4] not in (b"dc", b"db") or not payload:
             continue
         if payload[:2] != b"\xff\xd8":      # JPEG SOI
             raise NotImplementedError(

@@ -236,15 +236,27 @@ class GraphModel(Model):
             return data
         raise TypeError(f"cannot interpret {type(data)} as graph training data")
 
-    def fit(self, data, epochs: int = 1, batch_size: int | None = None) -> None:
+    def fit(self, data, epochs: int = 1, batch_size: int | None = None,
+            steps_per_execution: int = 1) -> None:
+        """steps_per_execution: see SequentialModel.fit — k optimizer
+        steps per compiled program (masked batches, mismatched shapes and
+        distributed models fall back to per-batch stepping; the listener
+        caveat there applies)."""
         if self.params is None:
             self.init()
         iterator = self._as_batches(data, batch_size)
+        use_multi = (
+            steps_per_execution > 1
+            and getattr(self, "_batch_sharding", None) is None
+        )
         for _ in range(epochs):
             for lst in self.listeners:
                 lst.on_epoch_start(self, self.epoch)
-            for batch in iterator:
-                self.fit_batch(batch)
+            if use_multi:
+                self._fit_epoch_multi(iterator, steps_per_execution)
+            else:
+                for batch in iterator:
+                    self.fit_batch(batch)
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch)
             self.epoch += 1
@@ -255,10 +267,122 @@ class GraphModel(Model):
             # duck-typed listeners written against the original three hooks
             getattr(lst, "on_fit_end", lambda m: None)(self)
 
-    def fit_batch(self, batch) -> None:
-        if self.params is None:
-            self.init()
-        mds = self._as_mds(batch)
+    def _fit_epoch_multi(self, iterator, spe: int) -> None:
+        def group_ok(buf):
+            return all(
+                m.labels_masks is None
+                and m.features_masks is None
+                and tuple(a.shape for a in m.features)
+                == tuple(a.shape for a in buf[0].features)
+                and tuple(a.shape for a in m.labels)
+                == tuple(a.shape for a in buf[0].labels)
+                for m in buf
+            )
+
+        self._multi_iter_dev = None
+        buf = []
+        for batch in iterator:
+            buf.append(self._as_mds(batch))
+            if len(buf) == spe:
+                if group_ok(buf):
+                    self._run_steps_grouped(buf)
+                else:
+                    for m in buf:
+                        self.fit_batch(m)
+                    self._multi_iter_dev = None
+                buf = []
+        for m in buf:
+            self.fit_batch(m)
+            self._multi_iter_dev = None
+
+    def _get_step_fn_multi(self):
+        key = ("train_multi",)
+        if key not in self._step_fns:
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def step(params, opt_state, net_state, step_i, features_k, labels_k):
+                def one(carry, inp):
+                    params, opt_state, net_state, si = carry
+                    feats, labs = inp
+                    rng = SeedStream.fold(self._stream.root, si)
+                    inputs = dict(zip(self.conf.network_inputs, feats))
+
+                    def loss_fn(p):
+                        outs, new_state = self._forward(
+                            p, net_state, inputs, training=True, rng=rng
+                        )
+                        total = jnp.zeros((), jnp.float32)
+                        for (loss, act, fused, custom), oname, lab in zip(
+                            self._out_specs, self.conf.network_outputs, labs
+                        ):
+                            out = outs[oname]
+                            if custom is not None:
+                                if isinstance(custom, tuple):
+                                    _, node, fn = custom
+                                    total = total + fn(p.get(node, {}), out, lab, None)
+                                else:
+                                    total = total + custom(out, lab, None)
+                                continue
+                            if not fused:
+                                out = act(out.astype(jnp.float32))
+                            total = total + compute_loss(
+                                loss, out, lab, None, from_logits=fused
+                            )
+                        aux, new_state = pop_aux_losses(new_state)
+                        return total + self._reg_loss(p) + aux, new_state
+
+                    (loss, new_state), grads = jax.value_and_grad(
+                        loss_fn, has_aux=True
+                    )(params)
+                    updates, opt_state = self._tx.update(grads, opt_state, params)
+                    params = jax.tree.map(
+                        lambda p, u: p + u.astype(p.dtype), params, updates
+                    )
+                    merged = {**net_state, **new_state}
+                    return (params, opt_state, merged, si + 1), loss
+
+                (params, opt_state, net_state, si), losses = jax.lax.scan(
+                    one,
+                    (params, opt_state, net_state, step_i),
+                    (features_k, labels_k),
+                )
+                return params, opt_state, net_state, losses, si
+
+            self._step_fns[key] = step
+        return self._step_fns[key]
+
+    def _run_steps_grouped(self, group) -> None:
+        from deeplearning4j_tpu.runtime.crash import oom_report_scope
+
+        # accepts DataSet or MultiDataSet (direct callers like the bench);
+        # _as_mds is an identity on already-converted batches
+        group = [self._as_mds(m) for m in group]
+        for m in group:
+            self._check_mds(m)
+        step = self._get_step_fn_multi()
+        k = len(group)
+        n_in = len(self.conf.network_inputs)
+        n_out = len(self.conf.network_outputs)
+        feats = tuple(
+            jnp.stack([jnp.asarray(m.features[i]) for m in group])
+            for i in range(n_in)
+        )
+        labs = tuple(
+            jnp.stack([jnp.asarray(m.labels[i]) for m in group])
+            for i in range(n_out)
+        )
+        if getattr(self, "_multi_iter_dev", None) is None:
+            self._multi_iter_dev = jax.device_put(np.uint32(self.iteration))
+        with oom_report_scope():
+            (self.params, self.opt_state, self.net_state, losses,
+             self._multi_iter_dev) = step(
+                self.params, self.opt_state, self.net_state,
+                self._multi_iter_dev, feats, labs,
+            )
+        self.last_batch_size = group[-1].num_examples
+        self._finish_grouped_steps(losses, k)
+
+    def _check_mds(self, mds) -> None:
         if len(mds.features) != len(self.conf.network_inputs):
             raise ValueError(
                 f"graph has {len(self.conf.network_inputs)} inputs, batch has "
@@ -269,6 +393,12 @@ class GraphModel(Model):
                 f"graph has {len(self.conf.network_outputs)} outputs, batch has "
                 f"{len(mds.labels)} label arrays"
             )
+
+    def fit_batch(self, batch) -> None:
+        if self.params is None:
+            self.init()
+        mds = self._as_mds(batch)
+        self._check_mds(mds)
         masks = mds.labels_masks
         if masks is not None and len(masks) != len(mds.labels):
             raise ValueError(

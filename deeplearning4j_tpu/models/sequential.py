@@ -826,28 +826,6 @@ class SequentialModel(Model):
             self.fit_batch(b)
             self._multi_iter_dev = None
 
-    def _finish_grouped_steps(self, losses, k: int) -> None:
-        """Bookkeeping after a program that ran k optimizer steps (TBPTT
-        windows or steps_per_execution groups): score/iteration update,
-        and — only when listeners exist — ONE D2H transfer of all k losses
-        followed by per-step dispatch with host scalars."""
-        self._last_score = losses   # (k,) device array; score_value reads [-1]
-        self.iteration += k
-        if self.listeners:
-            host_losses = np.asarray(losses)
-            self.iteration -= k
-            done = 0
-            try:
-                for w in range(k):
-                    self._last_score = host_losses[w]
-                    self.iteration += 1
-                    done += 1
-                    self._dispatch_iteration(host_losses[w])
-            finally:
-                # a throwing listener must not leave the counter rewound —
-                # all k steps DID run on device
-                self.iteration += k - done
-
     def _get_step_fn_multi(self):
         """k optimizer steps in one program: lax.scan over the stacked
         batch axis, same body as the single step."""

@@ -166,3 +166,34 @@ def test_cnn_graph_with_flatten():
     x = np.random.default_rng(0).normal(size=(4, 8, 8, 1)).astype(np.float32)
     out = model.output(x)
     assert out.shape == (4, 2)
+
+
+def test_graph_steps_per_execution_matches_per_batch():
+    """GraphModel.fit(steps_per_execution=k) — the grouped k-steps-in-one-
+    program path must match per-batch fitting exactly."""
+    rng = np.random.default_rng(0)
+    x = rng.normal(0, 1, (256, 4)).astype(np.float32)
+    y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 256)]
+
+    def batches():
+        return [
+            DataSet(x[i : i + 32], y[i : i + 32]) for i in range(0, 256, 32)
+        ]
+
+    ref = GraphModel(residual_mlp_conf(seed=9)).init()
+    for _ in range(2):
+        for b in batches():
+            ref.fit_batch(b)
+
+    grp = GraphModel(residual_mlp_conf(seed=9)).init()
+    grp.fit(batches(), epochs=2, steps_per_execution=4)
+
+    assert grp.iteration == ref.iteration == 16
+    assert ("train_multi",) in grp._step_fns
+    for k in ref.params:
+        for p in ref.params[k]:
+            np.testing.assert_allclose(
+                np.asarray(grp.params[k][p]), np.asarray(ref.params[k][p]),
+                rtol=2e-4, atol=1e-6,
+                err_msg=f"{k}/{p} diverged under graph steps_per_execution",
+            )
