@@ -332,6 +332,88 @@ def _histogram_fixed_width(x, *, lo, hi, nbins):
     return jnp.zeros((nbins,), jnp.int32).at[b].add(1)
 
 
+def _image_gradients(img):
+    """dy, dx of (B,H,W,C) images stacked on a leading axis of 2 (TF's
+    tf.image.image_gradients returns the pair; a single tensor keeps the
+    registry's one-output contract)."""
+    dy = jnp.concatenate(
+        [img[:, 1:] - img[:, :-1], jnp.zeros_like(img[:, :1])], axis=1
+    )
+    dx = jnp.concatenate(
+        [img[:, :, 1:] - img[:, :, :-1], jnp.zeros_like(img[:, :, :1])], axis=2
+    )
+    return jnp.stack([dy, dx])
+
+
+def _sobel_edges(img):
+    """(B,H,W,C) -> (2,B,H,W,C): vertical/horizontal Sobel responses."""
+    ky = jnp.array([[-1, -2, -1], [0, 0, 0], [1, 2, 1]], img.dtype)
+    kx = ky.T
+    B, H, W, C = img.shape
+    x = jnp.moveaxis(img, -1, 1).reshape(B * C, 1, H, W)
+    pad = jnp.pad(x, ((0, 0), (0, 0), (1, 1), (1, 1)), mode="reflect")
+
+    def conv(k):
+        out = jax.lax.conv_general_dilated(
+            pad, k[None, None], (1, 1), "VALID",
+            dimension_numbers=("NCHW", "OIHW", "NCHW"),
+        )
+        return jnp.moveaxis(out.reshape(B, C, H, W), 1, -1)
+
+    return jnp.stack([conv(ky), conv(kx)])
+
+
+def _total_variation(img):
+    dv = jnp.abs(img[:, 1:] - img[:, :-1]).sum(axis=(1, 2, 3))
+    dh = jnp.abs(img[:, :, 1:] - img[:, :, :-1]).sum(axis=(1, 2, 3))
+    return dv + dh
+
+
+def _psnr(a, b, *, max_val=1.0):
+    mse = jnp.mean(jnp.square(a - b), axis=(-3, -2, -1))
+    return 10.0 * jnp.log10(max_val * max_val / jnp.maximum(mse, 1e-12))
+
+
+def _ssim(a, b, *, max_val=1.0):
+    """Global-statistics SSIM per image (windowless simplification of the
+    reference's ssim op; exact for the constant-window limit)."""
+    axes = (-3, -2, -1)
+    mu_a = jnp.mean(a, axis=axes)
+    mu_b = jnp.mean(b, axis=axes)
+    va = jnp.var(a, axis=axes)
+    vb = jnp.var(b, axis=axes)
+    cov = jnp.mean(a * b, axis=axes) - mu_a * mu_b
+    c1 = (0.01 * max_val) ** 2
+    c2 = (0.03 * max_val) ** 2
+    return ((2 * mu_a * mu_b + c1) * (2 * cov + c2)) / (
+        (mu_a**2 + mu_b**2 + c1) * (va + vb + c2)
+    )
+
+
+def _central_crop(x, fraction):
+    """Center-crop the H/W axes of (..., H, W, C) to the given fraction."""
+    if not 0.0 < fraction <= 1.0:
+        raise ValueError(
+            f"central_crop fraction must be in (0, 1], got {fraction}"
+        )
+    h, w = x.shape[-3], x.shape[-2]
+    ch = max(int(round(h * fraction)), 1)
+    cw = max(int(round(w * fraction)), 1)
+    top, left = (h - ch) // 2, (w - cw) // 2
+    return x[..., top : top + ch, left : left + cw, :]
+
+
+def _fake_quant(x, *, min_val=-6.0, max_val=6.0, num_bits=8):
+    """Quantize-dequantize with a straight-through gradient (the
+    fake_quant_with_min_max_args role — QAT's core op)."""
+    n = 2**num_bits - 1
+    scale = (max_val - min_val) / n
+    clipped = jnp.clip(x, min_val, max_val)
+    q = jnp.round((clipped - min_val) / scale) * scale + min_val
+    # straight-through: forward quantized, gradient of the clip
+    return clipped + jax.lax.stop_gradient(q - clipped)
+
+
 def _huber_loss(pred, target, *, delta=1.0):
     d = jnp.abs(pred - target)
     return jnp.mean(jnp.where(d <= delta, 0.5 * d * d, delta * (d - 0.5 * delta)))
@@ -973,10 +1055,114 @@ OPS: dict[str, callable] = {
     "random_poisson": _rand("poisson"),
     "random_truncated_normal": _rand("truncated_normal"),
     "random_shuffle": _random_shuffle,
+    "random_categorical": lambda logits, *, num_samples, seed=0: jnp.moveaxis(
+        jax.random.categorical(
+            jax.random.key(seed), logits,
+            shape=(num_samples,) + logits.shape[:-1],
+        ),
+        0, -1,
+    ),
+    "random_laplace": lambda *, shape, seed=0: jax.random.laplace(
+        jax.random.key(seed), tuple(shape)
+    ),
+    "random_cauchy": lambda *, shape, seed=0: jax.random.cauchy(
+        jax.random.key(seed), tuple(shape)
+    ),
+    "random_rademacher": lambda *, shape, seed=0: jax.random.rademacher(
+        jax.random.key(seed), tuple(shape)
+    ).astype(jnp.float32),
+    "random_beta": lambda *, shape, a=1.0, b=1.0, seed=0: jax.random.beta(
+        jax.random.key(seed), a, b, tuple(shape)
+    ),
     # activation tail
     "hard_swish": jax.nn.hard_swish,
     "celu": lambda x, *, alpha=1.0: jax.nn.celu(x, alpha),
     "glu": lambda x, *, axis=-1: jax.nn.glu(x, axis=axis),
+    "softshrink": lambda x, *, lambd=0.5: jnp.sign(x) * jnp.maximum(
+        jnp.abs(x) - lambd, 0.0
+    ),
+    "hardshrink": lambda x, *, lambd=0.5: jnp.where(jnp.abs(x) > lambd, x, 0.0),
+    "tanhshrink": lambda x: x - jnp.tanh(x),
+    # elementwise tail (reference transform-same/strict stragglers)
+    "rint": jnp.rint,
+    "heaviside": lambda x, *, value=0.5: jnp.heaviside(x, value),
+    "copysign": jnp.copysign,
+    "nextafter": jnp.nextafter,
+    "deg2rad": jnp.deg2rad,
+    "rad2deg": jnp.rad2deg,
+    "sinc": jnp.sinc,
+    "logaddexp": jnp.logaddexp,
+    "logaddexp2": jnp.logaddexp2,
+    "hypot": jnp.hypot,
+    "signbit": lambda x: jnp.signbit(x).astype(jnp.float32),
+    "ldexp": lambda x, *, exp: jnp.ldexp(x, exp),
+    "logit": jax.scipy.special.logit,
+    "erfinv": jax.scipy.special.erfinv,
+    "ndtr": jax.scipy.special.ndtr,
+    "ndtri": jax.scipy.special.ndtri,
+    "lerp": lambda a, b, *, weight: a + weight * (b - a),
+    "popcount": lambda x: jnp.bitwise_count(x.astype(jnp.int32)).astype(
+        jnp.int32
+    ),
+    "isclose": lambda a, b, *, rtol=1e-5, atol=1e-8: jnp.isclose(
+        a, b, rtol=rtol, atol=atol
+    ).astype(jnp.float32),
+    # NaN-aware / range reductions
+    "nansum": lambda x, *, axis=None, keepdims=False: jnp.nansum(
+        x, axis=_ax(axis), keepdims=keepdims
+    ),
+    "nanmean": lambda x, *, axis=None, keepdims=False: jnp.nanmean(
+        x, axis=_ax(axis), keepdims=keepdims
+    ),
+    "nanmax": lambda x, *, axis=None, keepdims=False: jnp.nanmax(
+        x, axis=_ax(axis), keepdims=keepdims
+    ),
+    "nanmin": lambda x, *, axis=None, keepdims=False: jnp.nanmin(
+        x, axis=_ax(axis), keepdims=keepdims
+    ),
+    "nanstd": lambda x, *, axis=None, keepdims=False: jnp.nanstd(
+        x, axis=_ax(axis), keepdims=keepdims
+    ),
+    "ptp": lambda x, *, axis=None: jnp.ptp(x, axis=_ax(axis)),
+    "cummax": lambda x, *, axis=-1: jax.lax.cummax(x, axis=axis % x.ndim),
+    "cummin": lambda x, *, axis=-1: jax.lax.cummin(x, axis=axis % x.ndim),
+    # linalg tail 2
+    # scipy lu_factor semantics: combined LU in one matrix (pivots are
+    # implementation detail; permute_l form would silently DROP U)
+    "lu_factor": lambda x: jax.scipy.linalg.lu_factor(x)[0],
+    "outer": jnp.outer,
+    "cross": lambda a, b, *, axis=-1: jnp.cross(a, b, axis=axis),
+    "vander": lambda x, *, n: jnp.vander(x, n),
+    "diagflat": jnp.diagflat,
+    "matrix_norm": lambda x, *, ord="fro": jnp.linalg.norm(x, ord=ord),
+    "cond_number": lambda x: jnp.linalg.cond(x),
+    # image tail
+    "image_gradients": _image_gradients,
+    "sobel_edges": _sobel_edges,
+    "total_variation": _total_variation,
+    "psnr": _psnr,
+    "ssim": _ssim,
+    "rot90": lambda x, *, k=1: jnp.rot90(x, k, axes=(-3, -2)),
+    "grayscale_to_rgb": lambda x: jnp.repeat(x, 3, axis=-1),
+    "central_crop": lambda x, *, fraction: _central_crop(x, fraction),
+    # quantization
+    "fake_quant": _fake_quant,
+    # loss tail 2
+    "weighted_cross_entropy_with_logits": lambda logits, labels, *, pos_weight: (
+        jnp.mean(
+            (1 - labels) * logits
+            + (1 + (pos_weight - 1) * labels)
+            * jnp.log1p(jnp.exp(-jnp.abs(logits)))
+            + jnp.maximum(-logits, 0.0) * (1 + (pos_weight - 1) * labels)
+        )
+    ),
+    # stable form: log(cosh(d)) = |d| + softplus(-2|d|) - log(2) — the
+    # direct cosh overflows f32 (inf/NaN grads) beyond |d| ~ 89
+    "log_cosh_loss": lambda pred, target: jnp.mean(
+        jnp.abs(pred - target)
+        + jax.nn.softplus(-2.0 * jnp.abs(pred - target))
+        - jnp.log(2.0)
+    ),
 }
 
 OPS["extract_image_patches"] = OPS["im2col"]

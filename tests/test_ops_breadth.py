@@ -428,3 +428,127 @@ def test_unsorted_segment_empty_segment_fills():
     assert mx[0] == 3.0 and np.isfinite(mx).all()
     mn = _np(OPS["unsorted_segment_min"](x, ids, num_segments=3))
     assert mn[0] == 1.0 and np.isfinite(mn).all()
+
+
+class TestRegistryTail2:
+    def test_elementwise_tail(self):
+        x = np.array([-1.5, 0.0, 2.5], np.float32)
+        np.testing.assert_allclose(_np(OPS["rint"](x)), np.rint(x))
+        np.testing.assert_allclose(
+            _np(OPS["heaviside"](x, value=0.5)), [0.0, 0.5, 1.0]
+        )
+        np.testing.assert_allclose(
+            _np(OPS["copysign"](np.abs(x), x)), x
+        )
+        np.testing.assert_allclose(
+            _np(OPS["hypot"](np.array([3.0]), np.array([4.0]))), [5.0]
+        )
+        np.testing.assert_allclose(
+            _np(OPS["logaddexp"](np.zeros(1), np.zeros(1))), [np.log(2)],
+            atol=1e-6,
+        )
+        np.testing.assert_allclose(_np(OPS["deg2rad"](np.array([180.0]))),
+                                   [np.pi], atol=1e-6)
+        np.testing.assert_allclose(
+            _np(OPS["lerp"](np.zeros(3), np.ones(3), weight=0.25)),
+            [0.25] * 3,
+        )
+        p = np.array([0.5], np.float32)
+        np.testing.assert_allclose(_np(OPS["logit"](p)), [0.0], atol=1e-6)
+        np.testing.assert_allclose(
+            _np(OPS["erfinv"](np.array([0.0]))), [0.0], atol=1e-6
+        )
+        np.testing.assert_allclose(
+            _np(OPS["ndtr"](np.array([0.0]))), [0.5], atol=1e-6
+        )
+        assert _np(OPS["popcount"](np.array([7]))).tolist() == [3]
+        assert _np(OPS["isclose"](np.ones(2), np.ones(2))).tolist() == [1.0, 1.0]
+
+    def test_nan_reductions_and_cummax(self):
+        x = np.array([1.0, np.nan, 3.0], np.float32)
+        assert float(OPS["nansum"](x)) == 4.0
+        assert float(OPS["nanmean"](x)) == 2.0
+        assert float(OPS["nanmax"](x)) == 3.0
+        assert float(OPS["nanmin"](x)) == 1.0
+        assert np.isfinite(float(OPS["nanstd"](x)))
+        assert float(OPS["ptp"](np.array([2.0, 7.0, 3.0]))) == 5.0
+        np.testing.assert_allclose(
+            _np(OPS["cummax"](np.array([1.0, 3.0, 2.0]))), [1.0, 3.0, 3.0]
+        )
+        np.testing.assert_allclose(
+            _np(OPS["cummin"](np.array([3.0, 1.0, 2.0]))), [3.0, 1.0, 1.0]
+        )
+
+    def test_linalg_tail2(self):
+        a = np.array([1.0, 2.0], np.float32)
+        assert _np(OPS["outer"](a, a)).shape == (2, 2)
+        c = _np(OPS["cross"](np.array([1.0, 0, 0]), np.array([0, 1.0, 0])))
+        np.testing.assert_allclose(c, [0, 0, 1.0])
+        v = _np(OPS["vander"](a, n=3))
+        assert v.shape == (2, 3)
+        d = _np(OPS["diagflat"](a))
+        assert d[0, 0] == 1.0 and d[1, 1] == 2.0
+        m = np.array([[3.0, 0.0], [0.0, 4.0]], np.float32)
+        assert float(OPS["matrix_norm"](m)) == 5.0
+        assert float(OPS["cond_number"](np.eye(3, dtype=np.float32))) == 1.0
+        lu = _np(OPS["lu_factor"](m + 1.0))
+        assert lu.shape == (2, 2)
+
+    def test_image_tail(self):
+        rng = np.random.default_rng(0)
+        img = rng.uniform(0, 1, (2, 8, 8, 3)).astype(np.float32)
+        g = _np(OPS["image_gradients"](img))
+        assert g.shape == (2, 2, 8, 8, 3)
+        # dy of a vertical ramp is constant 1
+        ramp = np.tile(np.arange(8.0)[None, :, None, None], (1, 1, 8, 1)).astype(np.float32)
+        gr = _np(OPS["image_gradients"](ramp))
+        np.testing.assert_allclose(gr[0][0, :-1], 1.0, atol=1e-6)
+        s = _np(OPS["sobel_edges"](img))
+        assert s.shape == (2, 2, 8, 8, 3)
+        tv = _np(OPS["total_variation"](np.zeros((1, 4, 4, 1), np.float32)))
+        assert tv.shape == (1,) and tv[0] == 0.0
+        assert float(_np(OPS["psnr"](img, img)).min()) > 100.0
+        np.testing.assert_allclose(_np(OPS["ssim"](img, img)), 1.0, atol=1e-4)
+        assert _np(OPS["rot90"](img)).shape == (2, 8, 8, 3)
+        gray = img[..., :1]
+        assert _np(OPS["grayscale_to_rgb"](gray)).shape == (2, 8, 8, 3)
+        cc = _np(OPS["central_crop"](img, fraction=0.5))
+        assert cc.shape == (2, 4, 4, 3)
+
+    def test_fake_quant_straight_through(self):
+        import jax
+
+        x = np.linspace(-8, 8, 9).astype(np.float32)
+        q = _np(OPS["fake_quant"](x, min_val=-6.0, max_val=6.0, num_bits=8))
+        assert q.min() >= -6.0 and q.max() <= 6.0
+        # straight-through gradient: 1 inside range, 0 outside
+        g = jax.grad(lambda v: OPS["fake_quant"](v, min_val=-6.0, max_val=6.0).sum())(x)
+        g = _np(g)
+        assert g[0] == 0.0 and g[4] == 1.0 and g[-1] == 0.0
+
+    def test_loss_tail2_and_random_tail2(self):
+        logits = np.array([[0.5, -0.5]], np.float32)
+        labels = np.array([[1.0, 0.0]], np.float32)
+        w = float(OPS["weighted_cross_entropy_with_logits"](
+            logits, labels, pos_weight=2.0))
+        assert w > 0
+        assert float(OPS["log_cosh_loss"](logits, labels)) > 0
+        for name, kw in [
+            ("random_laplace", {}), ("random_cauchy", {}),
+            ("random_rademacher", {}), ("random_beta", {"a": 2.0, "b": 3.0}),
+        ]:
+            a = _np(OPS[name](shape=(32,), seed=5, **kw))
+            b = _np(OPS[name](shape=(32,), seed=5, **kw))
+            np.testing.assert_array_equal(a, b)
+        cat = _np(OPS["random_categorical"](
+            np.zeros((2, 5), np.float32), num_samples=7, seed=1))
+        assert cat.shape == (2, 7) and cat.max() < 5
+
+    def test_activation_tail2(self):
+        x = np.array([-2.0, -0.2, 0.2, 2.0], np.float32)
+        ss = _np(OPS["softshrink"](x, lambd=0.5))
+        np.testing.assert_allclose(ss, [-1.5, 0.0, 0.0, 1.5])
+        hs = _np(OPS["hardshrink"](x, lambd=0.5))
+        np.testing.assert_allclose(hs, [-2.0, 0.0, 0.0, 2.0])
+        ts = _np(OPS["tanhshrink"](x))
+        np.testing.assert_allclose(ts, x - np.tanh(x), atol=1e-6)
