@@ -171,25 +171,32 @@ def _timed_fit(model, batches, warmup: int, iters: int, spe: int = 1) -> float:
     batches = _stage(batches)
     n = len(batches)
 
+    tbptt = (
+        getattr(model.conf, "backprop_type", "") == "tbptt"
+        and getattr(model.conf, "tbptt_length", 0) > 0
+    )
     if spe > 1:
         # the grouped path bypasses fit()'s compatibility guards; assert
         # the same preconditions so a future config switch can't silently
         # train wrong-but-plausibly
         assert getattr(model, "_batch_sharding", None) is None
         assert not getattr(model, "_grad_compression", None)
-        assert getattr(model.conf, "backprop_type", "") != "tbptt" or not getattr(
-            model.conf, "tbptt_length", 0
-        )
         assert getattr(model, "_pipeline_schedule", "gpipe") != "1f1b"
+        if tbptt:
+            assert batches[0].features.shape[1] % model.conf.tbptt_length == 0
         model._multi_iter_dev = None
 
     def run(i0, count):
         samples = 0
         i = i0
         if spe > 1:
+            grouped = (
+                model._run_steps_grouped_tbptt if tbptt
+                else model._run_steps_grouped
+            )
             for _ in range(count // spe):
                 group = [batches[(i + j) % n] for j in range(spe)]
-                model._run_steps_grouped(group)
+                grouped(group)
                 samples += sum(b.num_examples for b in group)
                 i += spe
         else:
@@ -315,10 +322,12 @@ def bench_lstm(peak):
         y = np.eye(vocab, dtype=np.float32)[np.roll(ids, -1, axis=1)]
         batches.append(DataSet(x, y))
     flops = _lstm_fwd_flops(vocab, hidden, seq)
+    spe = 1 if QUICK else 4
     sps = _timed_fit(model, batches, warmup=2 if QUICK else 8,
-                     iters=4 if QUICK else 40)
+                     iters=4 if QUICK else 40, spe=spe)
     return _entry("graveslstm_charnn", sps, flops, peak, batch,
                   seq_len=seq, tbptt=50, hidden=hidden,
+                  steps_per_execution=spe,
                   flops_source="analytic (XLA cost_analysis counts scan "
                                "bodies once, dropping the recurrent matmuls)")
 

@@ -390,6 +390,15 @@ def _ssim(a, b, *, max_val=1.0):
     )
 
 
+def _grayscale_to_rgb(x):
+    if x.shape[-1] != 1:
+        raise ValueError(
+            f"grayscale_to_rgb expects a single channel, got {x.shape[-1]} "
+            "(TF semantics: non-1-channel input is an error, not a repeat)"
+        )
+    return jnp.repeat(x, 3, axis=-1)
+
+
 def _central_crop(x, fraction):
     """Center-crop the H/W axes of (..., H, W, C) to the given fraction."""
     if not 0.0 < fraction <= 1.0:
@@ -1101,9 +1110,9 @@ OPS: dict[str, callable] = {
     "ndtr": jax.scipy.special.ndtr,
     "ndtri": jax.scipy.special.ndtri,
     "lerp": lambda a, b, *, weight: a + weight * (b - a),
-    "popcount": lambda x: jnp.bitwise_count(x.astype(jnp.int32)).astype(
-        jnp.int32
-    ),
+    # NOTE: without jax_enable_x64 the widest integer is int32, so counts
+    # are exact only for values representable in the input's jnp dtype
+    "popcount": lambda x: jnp.bitwise_count(jnp.asarray(x)).astype(jnp.int32),
     "isclose": lambda a, b, *, rtol=1e-5, atol=1e-8: jnp.isclose(
         a, b, rtol=rtol, atol=atol
     ).astype(jnp.float32),
@@ -1134,7 +1143,9 @@ OPS: dict[str, callable] = {
     "cross": lambda a, b, *, axis=-1: jnp.cross(a, b, axis=axis),
     "vander": lambda x, *, n: jnp.vander(x, n),
     "diagflat": jnp.diagflat,
-    "matrix_norm": lambda x, *, ord="fro": jnp.linalg.norm(x, ord=ord),
+    "matrix_norm": lambda x, *, ord="fro": jnp.linalg.norm(
+        x, ord=ord, axis=(-2, -1)
+    ),
     "cond_number": lambda x: jnp.linalg.cond(x),
     # image tail
     "image_gradients": _image_gradients,
@@ -1143,7 +1154,7 @@ OPS: dict[str, callable] = {
     "psnr": _psnr,
     "ssim": _ssim,
     "rot90": lambda x, *, k=1: jnp.rot90(x, k, axes=(-3, -2)),
-    "grayscale_to_rgb": lambda x: jnp.repeat(x, 3, axis=-1),
+    "grayscale_to_rgb": lambda x: _grayscale_to_rgb(x),
     "central_crop": lambda x, *, fraction: _central_crop(x, fraction),
     # quantization
     "fake_quant": _fake_quant,

@@ -50,6 +50,14 @@ def _as_iterator(data, batch_size: int | None) -> DataSetIterator:
         return ExistingDataSetIterator([data])
     if isinstance(data, tuple) and len(data) == 2:
         return NumpyDataSetIterator(data[0], data[1], batch_size or 32)
+    if data and isinstance(data, list) and all(
+        isinstance(b, DataSet) for b in data
+    ):
+        # non-empty only: fit([]) must stay a loud error, not silent
+        # zero-batch "training"
+        from deeplearning4j_tpu.data.iterator import ExistingDataSetIterator
+
+        return ExistingDataSetIterator(data)
     raise TypeError(f"cannot interpret {type(data)} as training data")
 
 
@@ -468,6 +476,40 @@ class SequentialModel(Model):
         return self._custom_loss(out, labels, lmask)
 
     # -- compiled train step ----------------------------------------------
+    def _step_loss(self, p, net_state, feats, labs, *, lmask=None, fmask=None,
+                   rng=None, carries=None):
+        """The SHARED traced loss body of every training-step program
+        (single, TBPTT window, grouped, grouped-TBPTT): forward + data
+        loss (custom or enum) + aux + regularization.  Returns
+        (loss, new_state, new_carries) — new_carries is {} when carries
+        weren't threaded."""
+        fwd = self._forward(
+            p, net_state, feats, training=True, rng=rng,
+            fmask=fmask, carries=carries,
+        )
+        if carries is not None:
+            out, new_state, new_carries = fwd
+        else:
+            out, new_state = fwd
+            new_carries = {}
+        if self._custom_loss is not None:
+            data_loss = self._data_loss_custom(p, out, labs, lmask)
+        else:
+            if not self._fused_loss:
+                out = self._out_activation(out.astype(jnp.float32))
+            data_loss = compute_loss(
+                self._loss, out, labs, lmask, from_logits=self._fused_loss
+            )
+        aux, new_state = pop_aux_losses(new_state)
+        return data_loss + self._reg_loss(p) + aux, new_state, new_carries
+
+    def _apply_grads(self, params, opt_state, grads):
+        updates, opt_state = self._tx.update(grads, opt_state, params)
+        params = jax.tree.map(
+            lambda p, u: (p + u.astype(p.dtype)), params, updates
+        )
+        return params, opt_state
+
     def _get_step_fn(self, has_lmask: bool, has_fmask: bool, with_carries: bool):
         key = ("train", has_lmask, has_fmask, with_carries)
         if key not in self._step_fns:
@@ -477,47 +519,19 @@ class SequentialModel(Model):
                 rng = SeedStream.fold(self._stream.root, step_i)
 
                 def loss_fn(p):
-                    fwd = self._forward(
-                        p,
-                        net_state,
-                        features,
-                        training=True,
-                        rng=rng,
+                    loss, new_state, new_carries = self._step_loss(
+                        p, net_state, features, labels,
+                        lmask=lmask if has_lmask else None,
                         fmask=fmask if has_fmask else None,
+                        rng=rng,
                         carries=carries if with_carries else None,
                     )
-                    if with_carries:
-                        out, new_state, new_carries = fwd
-                    else:
-                        out, new_state = fwd
-                        new_carries = {}
-                    if self._custom_loss is not None:
-                        data_loss = self._data_loss_custom(
-                            p, out, labels, lmask if has_lmask else None
-                        )
-                    else:
-                        if not self._fused_loss:
-                            out = self._out_activation(out.astype(jnp.float32))
-                        data_loss = compute_loss(
-                            self._loss,
-                            out,
-                            labels,
-                            lmask if has_lmask else None,
-                            from_logits=self._fused_loss,
-                        )
-                    aux, new_state = pop_aux_losses(new_state)
-                    return (
-                        data_loss + self._reg_loss(p) + aux,
-                        (new_state, new_carries),
-                    )
+                    return loss, (new_state, new_carries)
 
                 (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                     loss_fn, has_aux=True
                 )(params)
-                updates, opt_state = self._tx.update(grads, opt_state, params)
-                params = jax.tree.map(
-                    lambda p, u: (p + u.astype(p.dtype)), params, updates
-                )
+                params, opt_state = self._apply_grads(params, opt_state, grads)
                 # carry unchanged state subtrees forward
                 merged_state = {**net_state, **new_state}
                 return params, opt_state, merged_state, loss, new_carries
@@ -577,42 +591,18 @@ class SequentialModel(Model):
                     rng = SeedStream.fold(self._stream.root, si)
 
                     def loss_fn(p):
-                        out, new_state, new_carries = self._forward(
-                            p,
-                            net_state,
-                            feats,
-                            training=True,
-                            rng=rng,
+                        loss, new_state, new_carries = self._step_loss(
+                            p, net_state, feats, labs,
+                            lmask=lm if has_lmask else None,
                             fmask=fm if has_fmask else None,
-                            carries=carries,
+                            rng=rng, carries=carries,
                         )
-                        if self._custom_loss is not None:
-                            data_loss = self._data_loss_custom(
-                                p, out, labs, lm if has_lmask else None
-                            )
-                        else:
-                            if not self._fused_loss:
-                                out = self._out_activation(out.astype(jnp.float32))
-                            data_loss = compute_loss(
-                                self._loss,
-                                out,
-                                labs,
-                                lm if has_lmask else None,
-                                from_logits=self._fused_loss,
-                            )
-                        aux, new_state = pop_aux_losses(new_state)
-                        return (
-                            data_loss + self._reg_loss(p) + aux,
-                            (new_state, new_carries),
-                        )
+                        return loss, (new_state, new_carries)
 
                     (loss, (new_state, new_carries)), grads = jax.value_and_grad(
                         loss_fn, has_aux=True
                     )(params)
-                    updates, opt_state = self._tx.update(grads, opt_state, params)
-                    params = jax.tree.map(
-                        lambda p, u: (p + u.astype(p.dtype)), params, updates
-                    )
+                    params, opt_state = self._apply_grads(params, opt_state, grads)
                     merged_state = {**net_state, **new_state}
                     return (
                         (params, opt_state, merged_state, new_carries, si + 1),
@@ -625,6 +615,87 @@ class SequentialModel(Model):
                     (features_w, labels_w, lmask_w, fmask_w),
                 )
                 return params, opt_state, net_state, losses, carries, si
+
+            self._step_fns[key] = step
+        return self._step_fns[key]
+
+    def _get_step_fn_tbptt_grouped(self):
+        """steps_per_execution x TBPTT composed: an OUTER scan over k
+        stacked batches, each iteration running the full window loop with
+        freshly-zeroed RNN carries (batch boundaries reset state; window
+        boundaries carry it) — k*W optimizer steps, ONE dispatch."""
+        key = ("train_tbptt_grouped",)
+        if key not in self._step_fns:
+            from deeplearning4j_tpu.nn.conf.recurrent import (
+                RecurrentLayerConfig,
+            )
+
+            L = self.conf.tbptt_length
+            rnn_layers = [
+                l for l in self.conf.layers
+                if isinstance(l, RecurrentLayerConfig)
+            ]
+
+            @partial(jax.jit, donate_argnums=(0, 1, 2))
+            def step(params, opt_state, net_state, step_i, features_k, labels_k):
+                B, T = features_k.shape[1], features_k.shape[2]
+                W = T // L
+                cdtype = (
+                    jnp.bfloat16
+                    if self._bf16
+                    and jnp.issubdtype(features_k.dtype, jnp.floating)
+                    else features_k.dtype
+                )
+
+                def windowed(a):
+                    a = a[:, : W * L].reshape((B, W, L) + a.shape[2:])
+                    return jnp.moveaxis(a, 1, 0)
+
+                def one_batch(carry, inp):
+                    params, opt_state, net_state, si = carry
+                    feats, labs = inp
+                    carries = {
+                        l.name: l.init_carry(B, cdtype) for l in rnn_layers
+                    }
+
+                    def window(c, winp):
+                        params, opt_state, net_state, carries, si = c
+                        wf, wl = winp
+                        rng = SeedStream.fold(self._stream.root, si)
+
+                        def loss_fn(p):
+                            loss, new_state, new_carries = self._step_loss(
+                                p, net_state, wf, wl, rng=rng, carries=carries
+                            )
+                            return loss, (new_state, new_carries)
+
+                        (loss, (new_state, new_carries)), grads = (
+                            jax.value_and_grad(loss_fn, has_aux=True)(params)
+                        )
+                        params, opt_state = self._apply_grads(
+                            params, opt_state, grads
+                        )
+                        merged = {**net_state, **new_state}
+                        return (
+                            (params, opt_state, merged, new_carries, si + 1),
+                            loss,
+                        )
+
+                    (params, opt_state, net_state, _, si), losses = (
+                        jax.lax.scan(
+                            window,
+                            (params, opt_state, net_state, carries, si),
+                            (windowed(feats), windowed(labs)),
+                        )
+                    )
+                    return (params, opt_state, net_state, si), losses
+
+                (params, opt_state, net_state, si), losses = jax.lax.scan(
+                    one_batch,
+                    (params, opt_state, net_state, step_i),
+                    (features_k, labels_k),
+                )
+                return params, opt_state, net_state, losses.reshape(-1), si
 
             self._step_fns[key] = step
         return self._step_fns[key]
@@ -759,10 +830,11 @@ class SequentialModel(Model):
         compiled XLA program (a lax.scan over stacked batches) — the
         tf.keras steps_per_execution knob.  On a TPU whose per-dispatch
         latency rivals a small model's step time this is the difference
-        between dispatch-bound and compute-bound training.  Falls back to
-        per-batch stepping for ragged/mismatched batches and for the
-        TBPTT / compressed / pipelined / distributed paths (which have
-        their own step programs).
+        between dispatch-bound and compute-bound training.  TBPTT models
+        compose: k batches' full window loops run in one program (RNN
+        carries reset at batch boundaries).  Ragged/mismatched batches and
+        the compressed / 1F1B-pipelined / distributed paths fall back to
+        per-batch stepping (they have their own step programs).
 
         Listener caveat (shared with Keras): per-iteration listeners fire
         AFTER each group completes, so a state-READING listener
@@ -775,7 +847,6 @@ class SequentialModel(Model):
         use_multi = (
             steps_per_execution > 1
             and not getattr(self, "_grad_compression", None)
-            and not (self.conf.backprop_type == "tbptt" and self.conf.tbptt_length > 0)
             and getattr(self, "_pipeline_schedule", "gpipe") != "1f1b"
             and getattr(self, "_batch_sharding", None) is None
         )
@@ -810,17 +881,38 @@ class SequentialModel(Model):
         # the device-resident step counter is only valid while EVERY step
         # goes through the grouped program; any single-step fallback (or
         # steps taken before this fit) advances self.iteration outside it
+        tbptt = (
+            self.conf.backprop_type == "tbptt" and self.conf.tbptt_length > 0
+        )
+
+        def flush(buf):
+            if not group_ok(buf):
+                for b in buf:
+                    self.fit_batch(b)
+                self._multi_iter_dev = None
+                return
+            if tbptt:
+                T = buf[0].features.shape[1]
+                if T % self.conf.tbptt_length or not getattr(
+                    self, "_tbptt_scan", True
+                ):
+                    # no remainder-window leg in the grouped program, and
+                    # _tbptt_scan=False (the scan-miscompile escape hatch)
+                    # must keep forcing the per-window path
+                    for b in buf:
+                        self.fit_batch(b)
+                    self._multi_iter_dev = None
+                    return
+                self._run_steps_grouped_tbptt(buf)
+            else:
+                self._run_steps_grouped(buf)
+
         self._multi_iter_dev = None
         buf: list[DataSet] = []
         for batch in iterator:
             buf.append(batch)
             if len(buf) == spe:
-                if group_ok(buf):
-                    self._run_steps_grouped(buf)
-                else:
-                    for b in buf:
-                        self.fit_batch(b)
-                    self._multi_iter_dev = None
+                flush(buf)
                 buf = []
         for b in buf:                       # ragged tail group
             self.fit_batch(b)
@@ -840,30 +932,15 @@ class SequentialModel(Model):
                     rng = SeedStream.fold(self._stream.root, si)
 
                     def loss_fn(p):
-                        out, new_state = self._forward(
-                            p, net_state, feats, training=True, rng=rng
+                        loss, new_state, _ = self._step_loss(
+                            p, net_state, feats, labs, rng=rng
                         )
-                        if self._custom_loss is not None:
-                            data_loss = self._data_loss_custom(p, out, labs, None)
-                        else:
-                            if not self._fused_loss:
-                                out = self._out_activation(out.astype(jnp.float32))
-                            data_loss = compute_loss(
-                                self._loss, out, labs, None,
-                                from_logits=self._fused_loss,
-                            )
-                        aux, new_state = pop_aux_losses(new_state)
-                        return (
-                            data_loss + self._reg_loss(p) + aux, new_state
-                        )
+                        return loss, new_state
 
                     (loss, new_state), grads = jax.value_and_grad(
                         loss_fn, has_aux=True
                     )(params)
-                    updates, opt_state = self._tx.update(grads, opt_state, params)
-                    params = jax.tree.map(
-                        lambda p, u: (p + u.astype(p.dtype)), params, updates
-                    )
+                    params, opt_state = self._apply_grads(params, opt_state, grads)
                     merged = {**net_state, **new_state}
                     return (params, opt_state, merged, si + 1), loss
 
@@ -876,6 +953,40 @@ class SequentialModel(Model):
 
             self._step_fns[key] = step
         return self._step_fns[key]
+
+    def _run_steps_grouped_tbptt(self, batches: list) -> None:
+        from deeplearning4j_tpu.nn.conf.recurrent import Bidirectional
+        from deeplearning4j_tpu.runtime.crash import oom_report_scope
+
+        # same config-level preconditions the per-batch TBPTT path raises on
+        if self.conf.output_type().kind != "rnn":
+            raise ValueError(
+                "TBPTT requires a per-timestep output (RnnOutputLayer)"
+            )
+        if any(isinstance(l, Bidirectional) for l in self.conf.layers):
+            raise ValueError("TBPTT is undefined for bidirectional networks")
+        T = batches[0].features.shape[1]
+        if batches[0].labels.ndim < 2 or batches[0].labels.shape[1] != T:
+            raise ValueError(
+                "TBPTT needs per-timestep labels with a (B, T, ...) time axis"
+            )
+        step = self._get_step_fn_tbptt_grouped()
+        k = len(batches)
+        W = T // self.conf.tbptt_length
+        feats = jnp.stack([jnp.asarray(b.features) for b in batches])
+        labs = jnp.stack([jnp.asarray(b.labels) for b in batches])
+        if getattr(self, "_multi_iter_dev", None) is None:
+            self._multi_iter_dev = jax.device_put(np.uint32(self.iteration))
+        with oom_report_scope():
+            (self.params, self.opt_state, self.net_state, losses,
+             self._multi_iter_dev) = step(
+                self.params, self.opt_state, self.net_state,
+                self._multi_iter_dev, feats, labs,
+            )
+        self.last_batch_size = batches[-1].num_examples
+        self._finish_grouped_steps(losses, k * W)
+        # the per-batch TBPTT path keeps its own device counter; resync
+        self._tbptt_iter_dev = None
 
     def _run_steps_grouped(self, batches: list) -> None:
         from deeplearning4j_tpu.runtime.crash import oom_report_scope

@@ -269,6 +269,52 @@ def test_rnn_run_detection_respects_dropout():
     assert m._rnn_runs == {1: 2}
 
 
+def test_tbptt_grouped_steps_matches_per_batch():
+    """fit(steps_per_execution=k) on a TBPTT model runs k batches' full
+    window loops in ONE program (outer batch scan resets RNN carries);
+    params and iteration count must match per-batch fitting."""
+    V, T = 4, 16
+    rng = np.random.default_rng(13)
+    ids = rng.integers(0, V, (64, T + 1))
+    x = np.eye(V, dtype=np.float32)[ids[:, :-1]]
+    y = np.eye(V, dtype=np.float32)[ids[:, 1:]]
+
+    def build():
+        conf = (
+            NeuralNetConfiguration.builder()
+            .seed(31)
+            .updater(Adam(5e-3))
+            .list()
+            .layer(GravesLSTM(n_out=10, activation=Activation.TANH))
+            .layer(RnnOutputLayer(n_out=V, loss=Loss.MCXENT,
+                                  activation=Activation.SOFTMAX))
+            .set_input_type(InputType.recurrent(V))
+            .tbptt(8)
+            .build()
+        )
+        return SequentialModel(conf).init()
+
+    def batches():
+        return [DataSet(x[i : i + 16], y[i : i + 16]) for i in range(0, 64, 16)]
+
+    ref = build()
+    for b in batches():
+        ref.fit_batch(b)
+
+    grp = build()
+    grp.fit(batches(), epochs=1, steps_per_execution=4)
+    # 4 batches x (16/8) windows = 8 optimizer steps, one dispatch
+    assert grp.iteration == ref.iteration == 8
+    assert ("train_tbptt_grouped",) in grp._step_fns
+    for lname, lp in ref.params.items():
+        for pname, pv in lp.items():
+            np.testing.assert_allclose(
+                np.asarray(grp.params[lname][pname]), np.asarray(pv),
+                rtol=2e-4, atol=2e-5,
+                err_msg=f"{lname}/{pname} diverged grouped-TBPTT vs per-batch",
+            )
+
+
 def test_tbptt_scan_remainder_window():
     """T not divisible by tbptt length: full windows run in the scan, the
     tail window in a follow-up step; iteration counts every window."""
