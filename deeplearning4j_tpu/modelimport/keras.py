@@ -35,13 +35,17 @@ from deeplearning4j_tpu.nn.conf.layers import (
     Dropout,
     Embedding,
     GlobalPooling,
+    LayerNorm,
     LossLayer,
     OutputLayer,
     PoolingType,
+    SeparableConv2D,
     Subsampling,
+    Upsampling2D,
     ZeroPadding2D,
 )
-from deeplearning4j_tpu.nn.conf.recurrent import LSTM, LastTimeStep
+from deeplearning4j_tpu.nn.conf.layers_nd import Conv1D, Cropping2D, PReLU
+from deeplearning4j_tpu.nn.conf.recurrent import GRU, LSTM, LastTimeStep
 from deeplearning4j_tpu.nn.losses import Loss
 from deeplearning4j_tpu.nn.updaters import Adam
 
@@ -196,6 +200,88 @@ def _bn_axis(cfg) -> int:
 _TENSOR_RANK = {InputType.KIND_FF: 2, InputType.KIND_RNN: 3, InputType.KIND_CNN: 4}
 
 
+def _map_gru(cfg, name):
+    if _act(cfg.get("activation", "tanh")) != Activation.TANH:
+        raise KerasImportError("GRU import supports tanh cell activation only")
+    if _act(cfg.get("recurrent_activation", "sigmoid")) != Activation.SIGMOID:
+        raise KerasImportError(
+            "GRU import supports sigmoid recurrent activation only (the "
+            "cell hardcodes sigmoid gates)"
+        )
+    if not cfg.get("reset_after", True):
+        raise KerasImportError(
+            "GRU import supports reset_after=True only (reset_after=False "
+            "applies the reset gate BEFORE the recurrent matmul — a "
+            "different cell; re-export with reset_after=True)"
+        )
+    gru = GRU(name=name, n_out=int(cfg["units"]))
+    if cfg.get("return_sequences", False):
+        return gru
+    return [gru, LastTimeStep(name=f"{name}__last")]
+
+
+def _one(v):
+    return int(v[0] if isinstance(v, (list, tuple)) else v)
+
+
+def _map_conv1d(cfg, name):
+    return Conv1D(
+        name=name,
+        n_out=int(cfg["filters"]),
+        kernel=_one(cfg["kernel_size"]),
+        stride=_one(cfg.get("strides", 1)),
+        padding=_padding(cfg),      # rejects 'causal' loudly
+        dilation=_one(cfg.get("dilation_rate", 1)),
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)),
+    )
+
+
+def _map_separable_conv2d(cfg, name):
+    if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+        raise KerasImportError(
+            "SeparableConv2D import does not support dilation_rate != 1"
+        )
+    return SeparableConv2D(
+        name=name,
+        n_out=int(cfg["filters"]),
+        kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        padding=_padding(cfg),
+        depth_multiplier=int(cfg.get("depth_multiplier", 1)),
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)),
+    )
+
+
+def _map_layernorm(cfg, name):
+    axis = cfg.get("axis", -1)
+    if isinstance(axis, (list, tuple)):
+        axis = axis[0] if len(axis) == 1 else axis
+    if axis not in (-1,) and not isinstance(axis, int):
+        raise KerasImportError(
+            f"LayerNormalization over multiple axes {axis} not supported"
+        )
+    if axis != -1:
+        # trailing-axis only; a positive axis equal to the last rank index
+        # cannot be verified here (rank unknown), so be strict
+        raise KerasImportError(
+            f"LayerNormalization axis={axis}: only the trailing axis "
+            "(axis=-1, channels_last) imports"
+        )
+    return LayerNorm(name=name, epsilon=float(cfg.get("epsilon", 1e-3)))
+
+
+def _map_upsampling2d(cfg, name):
+    interp = cfg.get("interpolation", "nearest")
+    if interp != "nearest":
+        raise KerasImportError(
+            f"UpSampling2D interpolation={interp!r}: only 'nearest' imports "
+            "(the runtime layer is a repeat)"
+        )
+    return Upsampling2D(name=name, size=_pair(cfg.get("size", 2)))
+
+
 def _map_lstm(cfg, name):
     if _act(cfg.get("activation", "tanh")) != Activation.TANH:
         raise KerasImportError("LSTM import supports tanh cell activation only")
@@ -229,6 +315,31 @@ _LAYER_MAPPERS: Dict[str, Callable] = {
         name=name, n_in=int(cfg["input_dim"]), n_out=int(cfg["output_dim"])
     ),
     "LSTM": _map_lstm,
+    "GRU": _map_gru,
+    "Conv1D": _map_conv1d,
+    "SeparableConv2D": _map_separable_conv2d,
+    "LayerNormalization": lambda cfg, name: _map_layernorm(cfg, name),
+    "UpSampling2D": lambda cfg, name: _map_upsampling2d(cfg, name),
+    "Cropping2D": lambda cfg, name: Cropping2D(
+        name=name, cropping=tuple(map(tuple, cfg.get("cropping", ((0, 0), (0, 0))))),
+    ),
+    "PReLU": lambda cfg, name: PReLU(name=name),
+    "LeakyReLU": lambda cfg, name: ActivationLayer(
+        name=name, activation=Activation.LEAKYRELU,
+        alpha=float(cfg.get("negative_slope", cfg.get("alpha", 0.3))),
+    ),
+    "ELU": lambda cfg, name: ActivationLayer(
+        name=name, activation=Activation.ELU,
+        alpha=float(cfg.get("alpha", 1.0)),
+    ),
+    # train-time-only noise layers are inference no-ops, like Dropout at
+    # import time — but Dropout keeps its rate for fine-tuning, these don't
+    # have an equivalent knob here
+    "GaussianNoise": lambda cfg, name: None,
+    "GaussianDropout": lambda cfg, name: None,
+    "SpatialDropout2D": lambda cfg, name: Dropout(
+        name=name, rate=float(cfg["rate"])
+    ),
     # structural no-ops: our model auto-inserts reshapes between cnn/ff kinds
     "Flatten": lambda cfg, name: None,
     "InputLayer": lambda cfg, name: None,
@@ -283,11 +394,53 @@ def _collect_layer_weights(h5group) -> Dict[str, np.ndarray]:
 def _apply_weights(layer_conf, weights: Dict[str, np.ndarray], params: dict, state: dict):
     """Write Keras weights into our param/state dicts for one layer."""
     name = layer_conf.name
-    if isinstance(layer_conf, (Dense, OutputLayer, Conv2D)):
+    if isinstance(layer_conf, (Dense, OutputLayer, Conv2D, Conv1D)):
         p = dict(params[name])
         p["W"] = weights["kernel"].astype(np.float32)
         if "bias" in weights and "b" in p:
             p["b"] = weights["bias"].astype(np.float32)
+        params[name] = p
+    elif isinstance(layer_conf, SeparableConv2D):
+        p = dict(params[name])
+        dk = weights["depthwise_kernel"].astype(np.float32)   # (kh,kw,in,m)
+        kh, kw, cin, mult = dk.shape
+        # ours: (kh,kw,1,in*m) with feature_group_count=in — XLA orders the
+        # grouped output channels [in0's m, in1's m, ...], which is exactly
+        # the C-order reshape of the keras layout
+        p["depthW"] = dk.reshape(kh, kw, 1, cin * mult)
+        p["pointW"] = weights["pointwise_kernel"].astype(np.float32)
+        if "bias" in weights and "b" in p:
+            p["b"] = weights["bias"].astype(np.float32)
+        params[name] = p
+    elif isinstance(layer_conf, LayerNorm):
+        p = dict(params[name])
+        p["gamma"] = weights["gamma"].astype(np.float32)
+        p["beta"] = weights["beta"].astype(np.float32)
+        params[name] = p
+    elif isinstance(layer_conf, PReLU):
+        p = dict(params[name])
+        p["alpha"] = weights["alpha"].astype(np.float32).reshape(-1)
+        params[name] = p
+    elif isinstance(layer_conf, GRU):
+        # keras fused gate order [z, r, h] -> ours [r, z, n]; reset_after
+        # bias is (2, 3H): input bias -> b, recurrent bias -> bh
+        H = layer_conf.n_out
+
+        def reorder(a):
+            return np.concatenate(
+                [a[..., H:2*H], a[..., :H], a[..., 2*H:]], axis=-1
+            )
+
+        p = dict(params[name])
+        p["Wx"] = reorder(weights["kernel"].astype(np.float32))
+        p["Wh"] = reorder(weights["recurrent_kernel"].astype(np.float32))
+        if "bias" in weights:
+            b = weights["bias"].astype(np.float32)
+            if b.ndim == 2:               # reset_after: (2, 3H)
+                p["b"] = reorder(b[0])
+                p["bh"] = reorder(b[1])
+            else:
+                p["b"] = reorder(b)
         params[name] = p
     elif isinstance(layer_conf, BatchNorm):
         p = dict(params.get(name, {}))

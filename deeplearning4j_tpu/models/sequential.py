@@ -50,7 +50,7 @@ def _as_iterator(data, batch_size: int | None) -> DataSetIterator:
         return ExistingDataSetIterator([data])
     if isinstance(data, tuple) and len(data) == 2:
         return NumpyDataSetIterator(data[0], data[1], batch_size or 32)
-    if data and isinstance(data, list) and all(
+    if isinstance(data, list) and data and all(
         isinstance(b, DataSet) for b in data
     ):
         # non-empty only: fit([]) must stay a loud error, not silent

@@ -229,8 +229,17 @@ class LossLayer(LayerConfig):
 class ActivationLayer(LayerConfig):
     HAS_PARAMS = False
     REGULARIZED = ()
+    # slope/scale override for the parameterized activations (Keras
+    # LeakyReLU carries alpha=0.3 by default vs this enum's 0.01; ELU
+    # carries a scale) — None keeps the enum's canonical constant
+    alpha: Optional[float] = None
 
     def apply(self, params, state, x, *, training=False, rng=None):
+        if self.alpha is not None:
+            if self.activation == Activation.LEAKYRELU:
+                return jax.nn.leaky_relu(x, self.alpha), state
+            if self.activation == Activation.ELU:
+                return jax.nn.elu(x, self.alpha), state
         return self._act()(x), state
 
 

@@ -266,6 +266,11 @@ class GRU(RecurrentLayerConfig):
         n_out = self.n_out
         act = self._act(Activation.TANH)
         hz = h @ cp["Wh"]
+        if "bh" in cp:
+            # recurrent bias (Keras GRU reset_after=True carries separate
+            # input/recurrent biases; the recurrent one applies INSIDE the
+            # reset gating of the candidate)
+            hz = hz + cp["bh"]
         r = jax.nn.sigmoid(zin[..., :n_out] + hz[..., :n_out])
         z = jax.nn.sigmoid(zin[..., n_out : 2 * n_out] + hz[..., n_out : 2 * n_out])
         n = act(zin[..., 2 * n_out :] + r * hz[..., 2 * n_out :])

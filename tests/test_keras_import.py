@@ -421,3 +421,78 @@ class TestCustomLayerRegistry:
 
         with pytest.raises(TypeError):
             register_keras_layer("X", "not-a-function")
+
+
+class TestRound3LayerBreadth:
+    def test_conv1d_stack(self, tmp_path):
+        km = keras.Sequential([
+            keras.layers.Input((12, 5)),
+            keras.layers.Conv1D(8, 3, padding="same", activation="relu"),
+            keras.layers.Conv1D(6, 3, strides=2, padding="valid"),
+            keras.layers.GlobalAveragePooling1D(),
+            keras.layers.Dense(3),
+        ])
+        ours = import_keras_model(save_h5(km, tmp_path))
+        x = np.random.default_rng(0).normal(size=(4, 12, 5)).astype(np.float32)
+        assert_outputs_match(km, ours, x)
+
+    def test_separable_conv(self, tmp_path):
+        km = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.SeparableConv2D(8, 3, padding="same",
+                                         depth_multiplier=2,
+                                         activation="relu"),
+            keras.layers.GlobalAveragePooling2D(),
+            keras.layers.Dense(2),
+        ])
+        ours = import_keras_model(save_h5(km, tmp_path))
+        x = np.random.default_rng(1).normal(size=(2, 10, 10, 3)).astype(np.float32)
+        assert_outputs_match(km, ours, x)
+
+    def test_gru_reset_after(self, tmp_path):
+        km = keras.Sequential([
+            keras.layers.Input((7, 4)),
+            keras.layers.GRU(6, return_sequences=True),
+            keras.layers.GRU(5),
+            keras.layers.Dense(2, activation="sigmoid"),
+        ])
+        ours = import_keras_model(save_h5(km, tmp_path))
+        x = np.random.default_rng(2).normal(size=(3, 7, 4)).astype(np.float32)
+        assert_outputs_match(km, ours, x)
+
+    def test_layernorm_prelu_activations(self, tmp_path):
+        km = keras.Sequential([
+            keras.layers.Input((9,)),
+            keras.layers.Dense(12),
+            keras.layers.LayerNormalization(),
+            keras.layers.PReLU(),
+            keras.layers.Dense(8),
+            keras.layers.LeakyReLU(),
+            keras.layers.Dense(4),
+            keras.layers.ELU(),
+            keras.layers.Dense(2),
+        ])
+        ours = import_keras_model(save_h5(km, tmp_path))
+        x = np.random.default_rng(3).normal(size=(5, 9)).astype(np.float32)
+        assert_outputs_match(km, ours, x)
+
+    def test_upsampling_cropping(self, tmp_path):
+        km = keras.Sequential([
+            keras.layers.Input((6, 6, 2)),
+            keras.layers.UpSampling2D(2),
+            keras.layers.Cropping2D(((1, 1), (2, 2))),
+            keras.layers.Conv2D(3, 3, padding="same"),
+            keras.layers.GlobalMaxPooling2D(),
+        ])
+        ours = import_keras_model(save_h5(km, tmp_path))
+        x = np.random.default_rng(4).normal(size=(2, 6, 6, 2)).astype(np.float32)
+        assert_outputs_match(km, ours, x)
+
+    def test_gru_reset_after_false_rejected(self, tmp_path):
+        km = keras.Sequential([
+            keras.layers.Input((5, 3)),
+            keras.layers.GRU(4, reset_after=False),
+            keras.layers.Dense(2),
+        ])
+        with pytest.raises(KerasImportError, match="reset_after"):
+            import_keras_model(save_h5(km, tmp_path))
