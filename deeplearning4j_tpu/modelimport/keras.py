@@ -44,8 +44,19 @@ from deeplearning4j_tpu.nn.conf.layers import (
     Upsampling2D,
     ZeroPadding2D,
 )
-from deeplearning4j_tpu.nn.conf.layers_nd import Conv1D, Cropping2D, PReLU
-from deeplearning4j_tpu.nn.conf.recurrent import GRU, LSTM, LastTimeStep
+from deeplearning4j_tpu.nn.conf.layers import Deconv2D
+from deeplearning4j_tpu.nn.conf.layers_nd import (
+    Conv1D,
+    Cropping2D,
+    PReLU,
+    Subsampling1D,
+)
+from deeplearning4j_tpu.nn.conf.recurrent import (
+    GRU,
+    LSTM,
+    LastTimeStep,
+    SimpleRnn,
+)
 from deeplearning4j_tpu.nn.losses import Loss
 from deeplearning4j_tpu.nn.updaters import Adam
 
@@ -282,6 +293,46 @@ def _map_upsampling2d(cfg, name):
     return Upsampling2D(name=name, size=_pair(cfg.get("size", 2)))
 
 
+def _map_simplernn(cfg, name):
+    rnn = SimpleRnn(name=name, n_out=int(cfg["units"]),
+                    activation=_act(cfg.get("activation", "tanh")))
+    if cfg.get("return_sequences", False):
+        return rnn
+    return [rnn, LastTimeStep(name=f"{name}__last")]
+
+
+def _map_conv2d_transpose(cfg, name):
+    if _pair(cfg.get("dilation_rate", 1)) != (1, 1):
+        raise KerasImportError(
+            "Conv2DTranspose import does not support dilation_rate != 1"
+        )
+    if cfg.get("output_padding") is not None:
+        raise KerasImportError(
+            "Conv2DTranspose import does not support explicit output_padding"
+        )
+    return Deconv2D(
+        name=name,
+        n_out=int(cfg["filters"]),
+        kernel=_pair(cfg["kernel_size"]),
+        stride=_pair(cfg.get("strides", 1)),
+        padding=_padding(cfg),
+        activation=_act(cfg.get("activation")),
+        has_bias=bool(cfg.get("use_bias", True)),
+    )
+
+
+def _map_spatial_dropout(cfg, name):
+    import warnings
+
+    warnings.warn(
+        f"SpatialDropout2D {name!r} imports as element-wise Dropout: "
+        "inference is identical, but FINE-TUNING will drop elements, not "
+        "whole feature maps",
+        stacklevel=2,
+    )
+    return Dropout(name=name, rate=float(cfg["rate"]))
+
+
 def _map_lstm(cfg, name):
     if _act(cfg.get("activation", "tanh")) != Activation.TANH:
         raise KerasImportError("LSTM import supports tanh cell activation only")
@@ -316,10 +367,22 @@ _LAYER_MAPPERS: Dict[str, Callable] = {
     ),
     "LSTM": _map_lstm,
     "GRU": _map_gru,
+    "SimpleRNN": lambda cfg, name: _map_simplernn(cfg, name),
+    "Conv2DTranspose": lambda cfg, name: _map_conv2d_transpose(cfg, name),
+    "MaxPooling1D": lambda cfg, name: Subsampling1D(
+        name=name, kernel=_one(cfg.get("pool_size", 2)),
+        stride=_one(cfg.get("strides") or cfg.get("pool_size", 2)),
+        padding=_padding(cfg), pooling=PoolingType.MAX,
+    ),
+    "AveragePooling1D": lambda cfg, name: Subsampling1D(
+        name=name, kernel=_one(cfg.get("pool_size", 2)),
+        stride=_one(cfg.get("strides") or cfg.get("pool_size", 2)),
+        padding=_padding(cfg), pooling=PoolingType.AVG,
+    ),
     "Conv1D": _map_conv1d,
     "SeparableConv2D": _map_separable_conv2d,
-    "LayerNormalization": lambda cfg, name: _map_layernorm(cfg, name),
-    "UpSampling2D": lambda cfg, name: _map_upsampling2d(cfg, name),
+    "LayerNormalization": _map_layernorm,
+    "UpSampling2D": _map_upsampling2d,
     "Cropping2D": lambda cfg, name: Cropping2D(
         name=name, cropping=tuple(map(tuple, cfg.get("cropping", ((0, 0), (0, 0))))),
     ),
@@ -337,9 +400,7 @@ _LAYER_MAPPERS: Dict[str, Callable] = {
     # have an equivalent knob here
     "GaussianNoise": lambda cfg, name: None,
     "GaussianDropout": lambda cfg, name: None,
-    "SpatialDropout2D": lambda cfg, name: Dropout(
-        name=name, rate=float(cfg["rate"])
-    ),
+    "SpatialDropout2D": lambda cfg, name: _map_spatial_dropout(cfg, name),
     # structural no-ops: our model auto-inserts reshapes between cnn/ff kinds
     "Flatten": lambda cfg, name: None,
     "InputLayer": lambda cfg, name: None,
@@ -400,6 +461,23 @@ def _apply_weights(layer_conf, weights: Dict[str, np.ndarray], params: dict, sta
         if "bias" in weights and "b" in p:
             p["b"] = weights["bias"].astype(np.float32)
         params[name] = p
+    elif isinstance(layer_conf, Deconv2D):
+        p = dict(params[name])
+        # keras Conv2DTranspose kernel is (kh, kw, OUT, IN); ours is HWIO
+        # for lax.conv_transpose, which (transpose_kernel=False) also skips
+        # the spatial flip TF's gradient-based definition applies
+        k = weights["kernel"].astype(np.float32)
+        p["W"] = k.transpose(0, 1, 3, 2)[::-1, ::-1]
+        if "bias" in weights and "b" in p:
+            p["b"] = weights["bias"].astype(np.float32)
+        params[name] = p
+    elif isinstance(layer_conf, SimpleRnn):
+        p = dict(params[name])
+        p["Wx"] = weights["kernel"].astype(np.float32)
+        p["Wh"] = weights["recurrent_kernel"].astype(np.float32)
+        if "bias" in weights:
+            p["b"] = weights["bias"].astype(np.float32)
+        params[name] = p
     elif isinstance(layer_conf, SeparableConv2D):
         p = dict(params[name])
         dk = weights["depthwise_kernel"].astype(np.float32)   # (kh,kw,in,m)
@@ -413,13 +491,24 @@ def _apply_weights(layer_conf, weights: Dict[str, np.ndarray], params: dict, sta
             p["b"] = weights["bias"].astype(np.float32)
         params[name] = p
     elif isinstance(layer_conf, LayerNorm):
+        # center=False / scale=False store only one of the pair; the init
+        # values (gamma=1, beta=0) are exactly the missing weight
         p = dict(params[name])
-        p["gamma"] = weights["gamma"].astype(np.float32)
-        p["beta"] = weights["beta"].astype(np.float32)
+        if "gamma" in weights:
+            p["gamma"] = weights["gamma"].astype(np.float32)
+        if "beta" in weights:
+            p["beta"] = weights["beta"].astype(np.float32)
         params[name] = p
     elif isinstance(layer_conf, PReLU):
+        a = weights["alpha"].astype(np.float32)
+        if a.ndim > 1 and max(a.shape) != a.size:
+            raise KerasImportError(
+                f"PReLU {name!r} has per-element alpha of shape {a.shape}; "
+                "only per-channel slopes import — re-export with "
+                "shared_axes=[1, 2] (CNN) so alpha is (channels,)"
+            )
         p = dict(params[name])
-        p["alpha"] = weights["alpha"].astype(np.float32).reshape(-1)
+        p["alpha"] = a.reshape(-1)
         params[name] = p
     elif isinstance(layer_conf, GRU):
         # keras fused gate order [z, r, h] -> ours [r, z, n]; reset_after

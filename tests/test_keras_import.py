@@ -496,3 +496,29 @@ class TestRound3LayerBreadth:
         ])
         with pytest.raises(KerasImportError, match="reset_after"):
             import_keras_model(save_h5(km, tmp_path))
+
+    def test_conv2d_transpose(self, tmp_path):
+        km = keras.Sequential([
+            keras.layers.Input((5, 5, 3)),
+            keras.layers.Conv2DTranspose(4, 3, strides=2, padding="same",
+                                         activation="relu"),
+            keras.layers.Conv2DTranspose(2, 2, strides=1, padding="valid"),
+            keras.layers.GlobalAveragePooling2D(),
+        ])
+        ours = import_keras_model(save_h5(km, tmp_path))
+        x = np.random.default_rng(5).normal(size=(2, 5, 5, 3)).astype(np.float32)
+        assert_outputs_match(km, ours, x)
+
+    def test_simplernn_and_1d_pools(self, tmp_path):
+        km = keras.Sequential([
+            keras.layers.Input((12, 4)),
+            keras.layers.Conv1D(6, 3, padding="same", activation="relu"),
+            keras.layers.MaxPooling1D(2),
+            keras.layers.SimpleRNN(5, return_sequences=True),
+            keras.layers.AveragePooling1D(2),
+            keras.layers.SimpleRNN(4),
+            keras.layers.Dense(2),
+        ])
+        ours = import_keras_model(save_h5(km, tmp_path))
+        x = np.random.default_rng(6).normal(size=(3, 12, 4)).astype(np.float32)
+        assert_outputs_match(km, ours, x)
