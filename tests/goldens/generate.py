@@ -197,6 +197,39 @@ def gen_keras():
     m = keras.Model(inp, out)
     save_keras("functional_branching", m, rng.normal(size=(4, 9)).astype(np.float32))
 
+    m = keras.Sequential([
+        keras.layers.Input((12, 5)),
+        keras.layers.Conv1D(8, 3, padding="same", activation="relu"),
+        keras.layers.MaxPooling1D(2),
+        keras.layers.GRU(6),
+        keras.layers.LayerNormalization(),
+        keras.layers.Dense(3),
+    ])
+    save_keras("conv1d_gru_ln", m, rng.normal(size=(3, 12, 5)).astype(np.float32))
+
+    m = keras.Sequential([
+        keras.layers.Input((8, 8, 3)),
+        keras.layers.SeparableConv2D(6, 3, padding="same",
+                                     depth_multiplier=2, activation="relu"),
+        keras.layers.UpSampling2D(2),
+        keras.layers.Cropping2D(((2, 2), (2, 2))),
+        keras.layers.Conv2DTranspose(4, 3, strides=2, padding="same"),
+        keras.layers.GlobalAveragePooling2D(),
+        keras.layers.Dense(2),
+    ])
+    save_keras("sepconv_upsample_transpose", m,
+               rng.normal(size=(2, 8, 8, 3)).astype(np.float32))
+
+    m = keras.Sequential([
+        keras.layers.Input((10,)),
+        keras.layers.Dense(8),
+        keras.layers.PReLU(),
+        keras.layers.Dense(6),
+        keras.layers.LeakyReLU(),
+        keras.layers.Dense(2),
+    ])
+    save_keras("prelu_leaky", m, rng.normal(size=(4, 10)).astype(np.float32))
+
 
 if __name__ == "__main__":
     gen_tf()
