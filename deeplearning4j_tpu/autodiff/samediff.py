@@ -132,10 +132,14 @@ _NN_OPS = (
     "multi_head_dot_product_attention", "softsign", "hard_sigmoid",
     "hard_tanh", "rationaltanh", "prelu", "thresholded_relu", "log_sigmoid",
     "mish", "swish", "standardize", "xw_plus_b",
+    "hard_swish", "celu", "glu", "softshrink", "hardshrink", "tanhshrink",
 )
 _LOSS_OPS = (
     "softmax_cross_entropy", "sparse_softmax_cross_entropy",
     "sigmoid_cross_entropy", "mse_loss", "l1_loss",
+    "huber_loss", "hinge_loss", "log_loss", "absolute_difference",
+    "poisson_loss", "kl_divergence", "cosine_proximity_loss",
+    "weighted_cross_entropy_with_logits", "log_cosh_loss",
 )
 _MATH_OPS = (
     "add", "sub", "mul", "div", "pow", "neg", "abs", "exp", "log", "sqrt",
@@ -167,6 +171,16 @@ _MATH_OPS = (
     "lgamma", "digamma", "igamma", "igammac", "zeta", "polygamma",
     "betainc", "truncate_div", "floor_mod", "clip_by_norm",
     "confusion_matrix",
+    # round-3 tail: exotic/NaN-aware reductions, bucketing, elementwise
+    "all", "any", "cumulative_logsumexp", "cummax", "cummin",
+    "unsorted_segment_sum", "unsorted_segment_max", "unsorted_segment_min",
+    "unsorted_segment_mean", "unsorted_segment_prod", "segment_prod",
+    "unique_with_pad", "bincount", "searchsorted", "invert_permutation",
+    "histogram_fixed_width", "nan_to_num", "nansum", "nanmean", "nanmax",
+    "nanmin", "nanstd", "ptp", "rint", "heaviside", "copysign", "nextafter",
+    "deg2rad", "rad2deg", "sinc", "logaddexp", "logaddexp2", "hypot",
+    "signbit", "ldexp", "logit", "erfinv", "ndtr", "ndtri", "lerp",
+    "popcount", "isclose", "fake_quant",
 )
 _CNN_OPS = (
     "conv1d", "conv2d", "conv3d", "depthwise_conv2d", "deconv2d",
@@ -180,12 +194,18 @@ _IMAGE_OPS = (
     "rgb_to_hsv", "hsv_to_rgb", "adjust_hue", "adjust_saturation",
     "crop_and_resize", "non_max_suppression", "extract_image_patches",
     "space_to_batch", "batch_to_space",
+    "image_gradients", "sobel_edges", "total_variation", "psnr", "ssim",
+    "rot90", "grayscale_to_rgb", "central_crop",
 )
 _LINALG_OPS = (
     "matmul", "inv", "det", "cholesky", "solve", "svd", "qr", "matrix_trace",
     "diag", "diag_part", "matrix_transpose", "lstsq", "triu", "tril",
     "tensordot", "einsum", "matrix_band_part", "matrix_diag",
     "matrix_set_diag",
+    "eigh_values", "eigh_vectors", "logdet", "slogdet_sign", "pinv",
+    "triangular_solve", "matrix_power", "kron", "matrix_rank", "expm",
+    "lu_factor", "outer", "cross", "vander", "diagflat", "matrix_norm",
+    "cond_number",
 )
 _BITWISE_OPS = (
     "bitwise_and", "bitwise_or", "bitwise_xor", "bitwise_not",
@@ -194,6 +214,15 @@ _BITWISE_OPS = (
 _RANDOM_OPS = (
     "random_normal", "random_uniform", "random_bernoulli",
     "random_exponential",
+    "random_gamma", "random_poisson", "random_truncated_normal",
+    "random_shuffle", "random_categorical", "random_laplace",
+    "random_cauchy", "random_rademacher", "random_beta",
+)
+
+_SIGNAL_OPS = (
+    "hann_window", "hamming_window", "blackman_window", "frame", "stft",
+    "istft", "fft", "ifft", "rfft", "irfft", "fft2", "ifft2", "real",
+    "imag", "complex_abs", "angle",
 )
 
 
@@ -236,6 +265,7 @@ class SameDiff:
         self.linalg = _Namespace(self, _LINALG_OPS)
         self.bitwise = _Namespace(self, _BITWISE_OPS)
         self.random = _Namespace(self, _RANDOM_OPS)
+        self.signal = _Namespace(self, _SIGNAL_OPS)
 
     # -- graph construction ------------------------------------------------
     def _fresh(self, base: str) -> str:

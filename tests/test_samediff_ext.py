@@ -276,3 +276,34 @@ class TestRegistryBreadth:
         w = float(compute(Loss.WASSERSTEIN, preds,
                           jnp.asarray([[1.0, -1.0]])))
         np.testing.assert_allclose(w, (-2.0 + 4.0) / 2, rtol=1e-5)
+
+
+def test_round3_namespaces():
+    """The round-3 op families are reachable through the typed namespaces
+    (sd.signal is new; loss/linalg/image/random/math grew)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    sd = SameDiff()
+    x = sd.placeholder("x")
+    sd.signal.stft(x, frame_length=16, frame_step=8, name="spec")
+    sig = np.random.default_rng(0).normal(size=(2, 64)).astype(np.float32)
+    spec = np.asarray(sd.output({"x": sig}, "spec"))
+    assert spec.shape == (2, 7, 9)
+
+    sd2 = SameDiff()
+    p = sd2.placeholder("p")
+    t = sd2.placeholder("t")
+    sd2.loss.huber_loss(p, t, delta=1.0, name="l")
+    out = float(np.asarray(sd2.output(
+        {"p": np.ones((2, 3), np.float32), "t": np.zeros((2, 3), np.float32)},
+        "l",
+    )))
+    assert abs(out - 0.5) < 1e-6
+
+    sd3 = SameDiff()
+    m = sd3.placeholder("m")
+    sd3.linalg.logdet(m, name="ld")
+    spd = 2.0 * np.eye(3, dtype=np.float32)
+    assert abs(float(np.asarray(sd3.output({"m": spd}, "ld"))) - 3 * np.log(2)) < 1e-5
