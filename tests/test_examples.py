@@ -35,3 +35,7 @@ def test_hpo_search_example(monkeypatch):
 
 def test_audio_classify_example(monkeypatch):
     assert _run("audio_classify.py", monkeypatch) > 0.9
+
+
+def test_video_pipeline_example(monkeypatch):
+    assert _run("video_pipeline.py", monkeypatch) > 0.9
