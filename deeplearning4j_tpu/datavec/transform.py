@@ -102,6 +102,7 @@ class TransformProcess:
 
         def _add(self, name, schema_fn, records_fn, spec):
             self._steps.append(_Step(name, schema_fn, records_fn, spec))
+            self._running_schema = schema_fn(self._current_schema())
             return self
 
         # --- column selection ---------------------------------------
@@ -358,6 +359,193 @@ class TransformProcess:
             return self._add(
                 "add_constant_column", schema_fn, records_fn,
                 {"kind": "add_constant_column", "name": name, "col_type": col_type, "value": value},
+            )
+
+        # --- string transforms (the reference's StringMap / ReplaceString
+        # / ChangeCase / Append / ReplaceEmpty / Concatenate family) ------
+        def _require_string(self, name: str):
+            m = self._current_schema().meta(name)
+            if m.type != ColumnType.STRING:
+                raise ValueError(
+                    f"column {name!r} is {m.type}, expected STRING"
+                )
+
+        def _current_schema(self) -> Schema:
+            # running schema, updated incrementally per _add — replaying
+            # every prior schema_fn here would make builds O(steps^2)
+            if not hasattr(self, "_running_schema"):
+                self._running_schema = self._schema
+            return self._running_schema
+
+        def _string_op(self, kind: str, name: str, fn, spec_extra: dict):
+            self._require_string(name)
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                for r in recs:
+                    r[i] = fn(str(r[i]))
+                return recs
+
+            return self._add(kind, lambda s: s, records_fn,
+                             {"kind": kind, "name": name, **spec_extra})
+
+        def string_map(self, name: str, mapping: dict):
+            """Exact-match value replacement (StringMapTransform role)."""
+            m = dict(mapping)
+            return self._string_op(
+                "string_map", name, lambda v: m.get(v, v),
+                {"mapping": m},
+            )
+
+        def replace_string(self, name: str, regex: str, replacement: str):
+            """Regex substitution (ReplaceStringTransform role)."""
+            import re as _re
+
+            pat = _re.compile(regex)
+            return self._string_op(
+                "replace_string", name,
+                lambda v: pat.sub(replacement, v),
+                {"regex": regex, "replacement": replacement},
+            )
+
+        def change_case(self, name: str, mode: str = "lower"):
+            if mode not in ("lower", "upper"):
+                raise ValueError(f"change_case mode must be lower/upper, got {mode!r}")
+            return self._string_op(
+                "change_case", name,
+                (str.lower if mode == "lower" else str.upper),
+                {"mode": mode},
+            )
+
+        def append_string(self, name: str, suffix: str):
+            return self._string_op(
+                "append_string", name, lambda v: v + suffix,
+                {"suffix": suffix},
+            )
+
+        def prepend_string(self, name: str, prefix: str):
+            return self._string_op(
+                "prepend_string", name, lambda v: prefix + v,
+                {"prefix": prefix},
+            )
+
+        def trim_string(self, name: str):
+            return self._string_op("trim_string", name, str.strip, {})
+
+        def replace_empty(self, name: str, value: str):
+            return self._string_op(
+                "replace_empty", name,
+                lambda v: value if v == "" else v,
+                {"value": value},
+            )
+
+        def concat_strings(self, new_name: str, sources: Sequence[str],
+                           delimiter: str = ""):
+            """New STRING column joining existing string columns
+            (ConcatenateStringColumns role)."""
+            srcs = list(sources)
+            cur = self._current_schema()
+            for n in srcs:
+                m = cur.meta(n)
+                if m.type != ColumnType.STRING:
+                    raise ValueError(
+                        f"concat_strings source {n!r} is {m.type}, "
+                        "expected STRING"
+                    )
+
+            def schema_fn(s: Schema) -> Schema:
+                return Schema(
+                    list(s.columns) + [ColumnMeta(new_name, ColumnType.STRING)]
+                )
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                idx = [s.index_of(n) for n in srcs]
+                for r in recs:
+                    r.append(delimiter.join(str(r[i]) for i in idx))
+                return recs
+
+            return self._add(
+                "concat_strings", schema_fn, records_fn,
+                {"kind": "concat_strings", "new_name": new_name,
+                 "sources": srcs, "delimiter": delimiter},
+            )
+
+        # --- time transforms (StringToTime / DeriveColumnsFromTime) -----
+        def string_to_time(self, name: str, fmt: str):
+            """Parse a STRING column into a TIME column of epoch MILLIS
+            (StringToTimeTransform role).  fmt is strptime syntax; naive
+            timestamps are taken as UTC, an offset in the format (%z) is
+            honored."""
+            import datetime as _dt
+
+            self._require_string(name)
+
+            def schema_fn(s: Schema) -> Schema:
+                i = s.index_of(name)
+                cols = list(s.columns)
+                cols[i] = ColumnMeta(name, ColumnType.TIME)
+                return Schema(cols)
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                for r in recs:
+                    t = _dt.datetime.strptime(str(r[i]), fmt)
+                    if t.tzinfo is None:
+                        t = t.replace(tzinfo=_dt.timezone.utc)
+                    r[i] = int(t.timestamp() * 1000)
+                return recs
+
+            return self._add(
+                "string_to_time", schema_fn, records_fn,
+                {"kind": "string_to_time", "name": name, "fmt": fmt},
+            )
+
+        _TIME_FIELDS = ("year", "month", "day", "hour", "minute", "second",
+                        "day_of_week")
+
+        def derive_time_fields(self, name: str, fields: Sequence[str]):
+            """From an epoch-millis LONG column, append INTEGER columns for
+            the requested UTC fields (DeriveColumnsFromTimeTransform role)."""
+            import datetime as _dt
+
+            fields = list(fields)
+            bad = [f for f in fields if f not in self._TIME_FIELDS]
+            if bad:
+                raise ValueError(
+                    f"unknown time fields {bad}; options: {self._TIME_FIELDS}"
+                )
+            m = self._current_schema().meta(name)
+            if m.type not in (ColumnType.TIME, ColumnType.LONG,
+                              ColumnType.INTEGER):
+                raise ValueError(
+                    f"column {name!r} is {m.type}, expected TIME/LONG "
+                    "epoch millis"
+                )
+
+            def schema_fn(s: Schema) -> Schema:
+                return Schema(
+                    list(s.columns)
+                    + [ColumnMeta(f"{name}_{f}", ColumnType.INTEGER)
+                       for f in fields]
+                )
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                i = s.index_of(name)
+                for r in recs:
+                    t = _dt.datetime.fromtimestamp(
+                        int(r[i]) / 1000.0, tz=_dt.timezone.utc
+                    )
+                    for f in fields:
+                        if f == "day_of_week":
+                            r.append(t.weekday())
+                        else:
+                            r.append(getattr(t, f))
+                return recs
+
+            return self._add(
+                "derive_time_fields", schema_fn, records_fn,
+                {"kind": "derive_time_fields", "name": name,
+                 "fields": fields},
             )
 
         def derive_column(self, name: str, col_type: str, sources: Sequence[str],

@@ -595,3 +595,79 @@ class TestTransformExecutor:
             out = LocalTransformExecutor.execute(tp2, recs, num_workers=4)
         assert any("derive_column" in str(x.message) for x in w)
         assert out[5] == [5.0, 15.0]
+
+
+class TestStringAndTimeTransforms:
+    def test_string_family(self):
+        from deeplearning4j_tpu.datavec import Schema, TransformProcess
+
+        schema = Schema.builder().add_string("a").add_string("b").build()
+        tp = (
+            TransformProcess.builder(schema)
+            .trim_string("a")
+            .change_case("a", "upper")
+            .string_map("a", {"CAT": "FELINE"})
+            .replace_string("b", r"\d+", "#")
+            .replace_empty("b", "missing")
+            .append_string("b", "!")
+            .prepend_string("b", ">")
+            .concat_strings("ab", ["a", "b"], delimiter="|")
+            .build()
+        )
+        out = tp.execute([[" cat ", "x42y"], ["dog", ""]])
+        assert out[0] == ["FELINE", ">x#y!", "FELINE|>x#y!"]
+        assert out[1] == ["DOG", ">missing!", "DOG|>missing!"]
+        assert tp.final_schema.column_names() == ["a", "b", "ab"]
+
+    def test_string_steps_require_string_columns(self):
+        from deeplearning4j_tpu.datavec import Schema, TransformProcess
+
+        schema = Schema.builder().add_double("x").build()
+        with pytest.raises(ValueError, match="expected STRING"):
+            TransformProcess.builder(schema).change_case("x")
+
+    def test_time_family(self):
+        from deeplearning4j_tpu.datavec import Schema, TransformProcess
+        from deeplearning4j_tpu.datavec.schema import ColumnType as CT
+
+        schema = Schema.builder().add_string("ts").add_double("v").build()
+        tp = (
+            TransformProcess.builder(schema)
+            .string_to_time("ts", "%Y-%m-%d %H:%M:%S")
+            .derive_time_fields("ts", ["year", "hour", "day_of_week"])
+            .build()
+        )
+        out = tp.execute([["2026-07-30 21:15:00", 1.0]])
+        assert tp.final_schema.meta("ts").type == CT.TIME
+        assert tp.final_schema.column_names() == [
+            "ts", "v", "ts_year", "ts_hour", "ts_day_of_week"]
+        ts, v, year, hour, dow = out[0]
+        assert year == 2026 and hour == 21 and dow == 3   # Thursday
+        assert ts == 1785446100000  # 2026-07-30T21:15:00Z
+
+    def test_time_honors_explicit_offset(self):
+        from deeplearning4j_tpu.datavec import Schema, TransformProcess
+
+        schema = Schema.builder().add_string("ts").build()
+        tp = (
+            TransformProcess.builder(schema)
+            .string_to_time("ts", "%Y-%m-%d %H:%M:%S %z")
+            .build()
+        )
+        (a,), (b,) = tp.execute(
+            [["2026-01-01 00:00:00 +0500"], ["2026-01-01 00:00:00 +0000"]]
+        )
+        assert b - a == 5 * 3600 * 1000  # +05:00 is five hours EARLIER
+
+    def test_string_time_json_roundtrip(self):
+        from deeplearning4j_tpu.datavec import Schema, TransformProcess
+
+        schema = Schema.builder().add_string("s").build()
+        tp = (
+            TransformProcess.builder(schema)
+            .change_case("s", "upper")
+            .append_string("s", "-Z")
+            .build()
+        )
+        tp2 = TransformProcess.from_json(tp.to_json())
+        assert tp2.execute([["ab"]]) == [["AB-Z"]]
