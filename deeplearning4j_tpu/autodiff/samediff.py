@@ -366,6 +366,30 @@ class SameDiff:
         self._compiled.clear()
         return tuple(outs)
 
+    def py_call(self, fn, *inputs: SDVariable, n_out: int = 1,
+                name: str | None = None) -> tuple[SDVariable, ...]:
+        """Trace-time function application: `fn(*arrays) -> tuple of n_out
+        arrays`, spliced into the graph as one node.  The TF importer uses
+        this for functional control flow (multi-output If, PartitionedCall
+        inlining) whose branch bodies are themselves traced subgraphs.
+        Like if_cond/while_loop, graphs holding py_call nodes carry Python
+        callables and cannot be serialized."""
+        base = name or self._fresh("call")
+        tuple_name = base + "#tuple"
+        self._register(tuple_name, "op")
+        self._ops.append(_OpNode(
+            "_pyfunc", tuple(v.name for v in inputs), tuple_name,
+            {"fn": fn, "n_out": n_out},
+        ))
+        outs = []
+        for i in range(n_out):
+            nm = base if n_out == 1 else f"{base}_{i}"
+            vv = self._register(nm, "op")
+            self._ops.append(_OpNode("_tuple_get", (tuple_name,), nm, {"index": i}))
+            outs.append(vv)
+        self._compiled.clear()
+        return tuple(outs)
+
     # -- execution ---------------------------------------------------------
     def _execute(self, values: dict[str, jnp.ndarray], requested: tuple[str, ...], rng=None):
         """Topological interpretation at TRACE time: runs once under jit,
@@ -404,6 +428,12 @@ class SameDiff:
                     lambda vs, _c=cond: jnp.asarray(_c(*vs)).astype(bool).reshape(()),
                     body_wrap,
                     tuple(args),
+                )
+                continue
+            if node.op == "_pyfunc":
+                out = attrs["fn"](*args)
+                env[node.output] = (
+                    tuple(out) if isinstance(out, (tuple, list)) else (out,)
                 )
                 continue
             if node.op == "_tuple_get":
@@ -587,10 +617,10 @@ class SameDiff:
     # -- serialization (the .fb save/load role) ----------------------------
     def save(self, path: str) -> None:
         for n in self._ops:
-            if n.op in ("_cond", "_while"):
+            if n.op in ("_cond", "_while", "_pyfunc"):
                 raise ValueError(
                     "graphs containing control-flow lambdas (if_cond/"
-                    "while_loop) hold Python callables and cannot be "
+                    "while_loop/py_call) hold Python callables and cannot be "
                     "serialized; rebuild the graph in code after load"
                 )
         graph = {

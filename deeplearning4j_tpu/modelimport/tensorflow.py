@@ -13,9 +13,16 @@ XLA wants.
 
 Scope: the op set covering classic frozen inference graphs (MLPs, convnets,
 and transformer encoders: matmul/batched-matmul, decomposed layer-norm,
-erf-gelu, embedding gather, attention softmax).  Control flow
-(Switch/Merge/Enter/Exit) and dynamic-shape ops (Shape/Size at runtime) are
-rejected with a clear message rather than imported wrong.
+erf-gelu, embedding gather, attention softmax) PLUS control flow in both TF
+representations — V1 frames (Switch/Merge/Enter/Exit/NextIteration/LoopCond,
+the reference's VarId name+frame+iteration scheme, SURVEY §3.3) are
+reconstructed structurally into lax.while_loop / lax.cond, and V2 functional
+While/If/PartitionedCall execute their FunctionDef bodies as trace-time
+sub-interpreters.  Dynamic-shape ops (Shape/Size at runtime) are rejected
+with a clear message rather than imported wrong.  Reverse-mode autodiff
+through imported while loops is not supported (lax.while_loop is
+forward-only); trainable fine-tuning requires the loss not depend on a loop
+output.
 
 ONNX import is gated: the `onnx` package is not available in this
 environment (`import_onnx` raises ImportError with guidance).
@@ -33,11 +40,6 @@ from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
 class TFImportError(ValueError):
     pass
 
-
-_UNSUPPORTED_CONTROL_FLOW = {
-    "Switch", "Merge", "Enter", "Exit", "NextIteration", "LoopCond",
-    "TensorArrayV3", "While", "StatelessWhile", "If", "StatelessIf",
-}
 
 _DTYPES = {
     1: np.float32, 2: np.float64, 3: np.int32, 4: np.uint8, 5: np.int16,
@@ -142,6 +144,8 @@ class _Importer:
                 return list(a.list.f)
             if a.list.s:
                 return [s.decode() for s in a.list.s]
+            if a.list.type:
+                return list(a.list.type)   # e.g. While/If Tin/Tout
             return []
         if kind == "shape":
             return [d.size for d in a.shape.dim]
@@ -149,6 +153,8 @@ class _Importer:
             return a.type
         if kind == "tensor":
             return a.tensor
+        if kind == "func":
+            return a.func
         return default
 
     def nhwc(self, node):
@@ -161,16 +167,48 @@ class _Importer:
         # auto-generated names (op decompositions, _lift consts) must never
         # collide with a TF node name that imports later
         self.sd.reserve_names(n.name for n in self.gd.node)
-        for node in self.gd.node:
+        lib = getattr(self.gd, "library", None)
+        self._funcs = (
+            {f.signature.name: f for f in lib.function} if lib is not None else {}
+        )
+        nodes = list(self.gd.node)
+        # V1 frame-based control flow (Switch/Merge/Enter/Exit/
+        # NextIteration/LoopCond — the reference's VarId frames, SURVEY
+        # §3.3): reconstructed structurally into lax.while_loop / lax.cond
+        # rather than imported op-by-op.
+        frames = self._find_v1_frames(nodes)
+        conds = self._find_v1_conds(nodes, frames)
+        skip: Dict[str, tuple] = {}          # node name -> ("frame"|"cond", key)
+        trigger: Dict[str, tuple] = {}       # first node of a structure
+        for fname, fr in frames.items():
+            for nm in fr["members"]:
+                skip[nm] = ("frame", fname)
+            trigger[fr["trigger"]] = ("frame", fname)
+        for mname, cp in conds.items():
+            for nm in cp["members"]:
+                if nm not in skip:
+                    skip[nm] = ("cond", mname)
+            trigger[mname] = ("cond", mname)
+        for node in nodes:
+            if node.name in trigger:
+                kind, key = trigger[node.name]
+                if kind == "frame":
+                    self._import_v1_frame(frames[key])
+                else:
+                    self._import_v1_cond(conds[key])
+                continue
+            if node.name in skip:
+                continue
             op = node.op
-            if op in _UNSUPPORTED_CONTROL_FLOW:
-                raise TFImportError(
-                    f"{node.name}: TF control-flow op {op!r} not supported; "
-                    "re-export the graph without loops/conds (or lower them "
-                    "into the model fn with lax.cond/lax.scan)"
-                )
             handler = getattr(self, f"op_{op}", None)
             if handler is None:
+                if op.startswith("TensorArray"):
+                    raise TFImportError(
+                        f"{node.name}: {op!r} not supported — re-export the "
+                        "loop with stacked tensors (control-flow-v2 "
+                        "while_loop accumulating via concat) instead of "
+                        "TensorArrays"
+                    )
                 raise TFImportError(f"{node.name}: unsupported TF op {op!r}")
             handler(node)
         return self.sd
@@ -598,6 +636,462 @@ class _Importer:
 
     op_FusedBatchNorm = op_FusedBatchNormV3
     op_FusedBatchNormV2 = op_FusedBatchNormV3
+
+    # --- control flow -------------------------------------------------
+    # The reference imports TF control flow via frame-tracked VarIds
+    # (name+frame+iteration, SURVEY.md §3.3 — Enter/Exit/NextIteration);
+    # TPU-native, both the V1 frame representation and the V2 functional
+    # one (While/If + FunctionDef library) reconstruct into lax.while_loop
+    # / lax.cond inside the ONE compiled XLA program.  Loop bodies become
+    # trace-time sub-interpreters (_SubgraphFn) over the same op handlers.
+
+    # -- V1 frames (Switch/Merge/Enter/Exit/NextIteration/LoopCond) --
+    def _find_v1_frames(self, nodes) -> Dict[str, dict]:
+        enters = [n for n in nodes if n.op == "Enter"]
+        if not enters:
+            return {}
+        by_name = {n.name: n for n in nodes}
+        consumers: Dict[str, list] = {}
+        for n in nodes:
+            for raw in n.input:
+                base, _ = _input_name(raw)
+                consumers.setdefault(base, []).append(n)
+        frames: Dict[str, dict] = {}
+        for n in enters:
+            fr = frames.setdefault(
+                self.attr(n, "frame_name"),
+                {"enters": [], "cap_enters": []},
+            )
+            if self.attr(n, "is_constant", False):
+                fr["cap_enters"].append(n)
+            else:
+                fr["enters"].append(n)
+        for fname, fr in frames.items():
+            members = {n.name for n in fr["enters"] + fr["cap_enters"]}
+            stack = list(members)
+            while stack:
+                cur = stack.pop()
+                if by_name[cur].op == "Exit":
+                    continue  # Exit pops the frame: its output is outside
+                for c in consumers.get(cur, []):
+                    if c.op == "Enter":
+                        raise TFImportError(
+                            f"frame {fname!r}: nested while frames are not "
+                            "supported (flatten or export with "
+                            "control-flow-v2 While)"
+                        )
+                    if c.name not in members:
+                        members.add(c.name)
+                        stack.append(c.name)
+            fr["members"] = members
+            fr["trigger"] = next(n.name for n in nodes if n.name in members)
+            fr["order"] = [n for n in nodes if n.name in members]
+            fr["name"] = fname
+        return frames
+
+    def _import_v1_frame(self, fr: dict) -> None:
+        by_name = {n.name: n for n in fr["order"]}
+        enter_names = {n.name for n in fr["enters"]}
+        merges = [n for n in fr["order"] if n.op == "Merge"]
+        loopconds = [n for n in fr["order"] if n.op == "LoopCond"]
+        if len(loopconds) != 1:
+            raise TFImportError(
+                f"frame {fr['name']!r}: expected exactly one LoopCond, "
+                f"found {len(loopconds)}"
+            )
+        pred_ref = loopconds[0].input[0]
+        merge_of_enter: Dict[str, Any] = {}
+        next_of_merge: Dict[str, Any] = {}
+        for m in merges:
+            srcs = [_input_name(i)[0] for i in m.input]
+            ent = next((s for s in srcs if s in enter_names), None)
+            if ent is None:
+                raise TFImportError(
+                    f"frame {fr['name']!r}: Merge {m.name} has no Enter "
+                    "input (unrecognized loop structure)"
+                )
+            merge_of_enter[ent] = m
+            nxt = next(
+                (s for s in srcs
+                 if s in by_name and by_name[s].op == "NextIteration"),
+                None,
+            )
+            next_of_merge[m.name] = nxt
+        switch_of_merge = {
+            _input_name(s.input[0])[0]: s
+            for s in fr["order"] if s.op == "Switch"
+        }
+        exit_of_switch = {
+            _input_name(e.input[0])[0]: e
+            for e in fr["order"] if e.op == "Exit"
+        }
+        structural = {"Enter", "Merge", "Switch", "Exit", "NextIteration",
+                      "LoopCond"}
+        interior = [n for n in fr["order"] if n.op not in structural]
+
+        # loop-invariant captures (Enter is_constant=true): static parent
+        # values seed the body's const table (so shape/axis consumers keep
+        # working); dynamic ones ride along as extra loop variables
+        statics: Dict[str, np.ndarray] = {}
+        dyn_caps = []
+        for cap in fr["cap_enters"]:
+            base, _ = _input_name(cap.input[0])
+            if base in self.consts:
+                statics[cap.name] = self.consts[base]
+            else:
+                dyn_caps.append(cap)
+
+        cond_inputs, body_inputs, body_outputs, init_vars = [], [], [], []
+        exits = []
+        for ent in fr["enters"]:
+            m = merge_of_enter.get(ent.name)
+            sw = switch_of_merge.get(m.name) if m is not None else None
+            nxt = next_of_merge.get(m.name) if m is not None else None
+            if m is None or sw is None or nxt is None:
+                raise TFImportError(
+                    f"frame {fr['name']!r}: loop var {ent.name} lacks the "
+                    "Merge/Switch/NextIteration chain"
+                )
+            cond_inputs.append(m.name)
+            body_inputs.append(f"{sw.name}:1")
+            body_outputs.append(by_name[nxt].input[0])
+            init_vars.append(self.in_var(ent.input[0]))
+            exits.append(exit_of_switch.get(sw.name))
+        for cap in dyn_caps:
+            cond_inputs.append(cap.name)
+            body_inputs.append(cap.name)
+            body_outputs.append(cap.name)  # pass through unchanged
+            init_vars.append(self.in_var(cap.input[0]))
+
+        label = f"while frame {fr['name']!r}"
+        cond_fn = _SubgraphFn(interior, cond_inputs, [pred_ref],
+                              statics=statics, funcs=self._funcs, label=label)
+        body_fn = _SubgraphFn(interior, body_inputs, body_outputs,
+                              statics=statics, funcs=self._funcs, label=label)
+        outs = self.sd.while_loop(
+            lambda *vs: cond_fn(*vs)[0],
+            lambda *vs: body_fn(*vs),
+            *init_vars,
+        )
+        for i, ex in enumerate(exits):
+            if ex is not None:
+                # keep the TF name addressable for output()/consumers
+                self.vars[ex.name] = self.sd.apply(
+                    "identity", outs[i], name=ex.name
+                )
+
+    # -- V1 conds (Switch/Merge diamonds outside any frame) --
+    def _find_v1_conds(self, nodes, frames) -> Dict[str, dict]:
+        in_frame = set()
+        for fr in frames.values():
+            in_frame |= fr["members"]
+        switch_names = {
+            n.name for n in nodes
+            if n.op == "Switch" and n.name not in in_frame
+        }
+        merges = [
+            n for n in nodes
+            if n.op == "Merge" and n.name not in in_frame
+        ]
+        if not switch_names and not merges:
+            return {}
+        if not merges:
+            raise TFImportError(
+                "graph has Switch nodes outside any while frame but no "
+                "matching Merge (unrecognized control-flow structure)"
+            )
+        by_name = {n.name: n for n in nodes}
+        # pivot switches (Switch(pred, pred)) and their control-pivot
+        # identities exist only to carry branch control deps; skip them
+        pivots = {
+            s for s in switch_names
+            if _input_name(by_name[s].input[0])[0]
+            == _input_name(by_name[s].input[1])[0]
+        }
+        pivot_ids = {
+            n.name for n in nodes
+            if n.op == "Identity" and n.name not in in_frame
+            and _input_name(n.input[0])[0] in pivots
+        }
+
+        def trace(raw):
+            """Walk back from a merge input to the feeding Switches."""
+            interior, used, votes = set(), [], set()
+            stack = [_input_name(raw)]
+            while stack:
+                b, i = stack.pop()
+                if b in switch_names:
+                    if b not in used:
+                        used.append(b)
+                    if b not in pivots:
+                        votes.add(1 if i >= 1 else 0)
+                    continue
+                node = by_name.get(b)
+                if node is None or b in interior:
+                    continue
+                if node.op == "Merge":
+                    raise TFImportError(
+                        f"nested V1 tf.cond (Merge {b} inside a branch) "
+                        "not supported"
+                    )
+                interior.add(b)
+                for r in node.input:
+                    if r.startswith("^"):
+                        # control deps vote via the pivot identities
+                        base, _ = _input_name(r)
+                        piv = by_name.get(base)
+                        if piv is not None and base in pivot_ids:
+                            _, pidx = _input_name(piv.input[0])
+                            votes.add(1 if pidx >= 1 else 0)
+                        continue
+                    stack.append(_input_name(r))
+            return interior, used, votes
+
+        plans: Dict[str, dict] = {}
+        first = True
+        for m in merges:
+            ins = [i for i in m.input if not i.startswith("^")][:2]
+            sides = {}
+            members = {m.name}
+            switches: List[str] = []
+            for raw in ins:
+                interior, used, votes = trace(raw)
+                members |= interior
+                for s in used:
+                    if s not in switches and s not in pivots:
+                        switches.append(s)
+                if len(votes) == 1:
+                    sides[votes.pop()] = raw
+                elif len(votes) > 1:
+                    raise TFImportError(
+                        f"Merge {m.name}: branch mixes both Switch outputs"
+                    )
+                else:
+                    sides.setdefault(None, raw)
+            if None in sides:  # constant branch: it is the other side
+                known = [k for k in sides if k is not None]
+                if len(known) != 1:
+                    raise TFImportError(
+                        f"Merge {m.name}: cannot attribute branches to "
+                        "Switch outputs"
+                    )
+                sides[1 - known[0]] = sides.pop(None)
+            if 0 not in sides or 1 not in sides:
+                raise TFImportError(
+                    f"Merge {m.name}: could not identify both cond branches"
+                )
+            some_sw = by_name[switches[0]] if switches else by_name[
+                next(iter(pivots))
+            ]
+            members |= set(switches)
+            if first:  # pivots are shared across all merges of one cond
+                members |= pivots | pivot_ids
+                first = False
+            plans[m.name] = {
+                "merge": m,
+                "members": members,
+                "true_ref": sides[1],
+                "false_ref": sides[0],
+                "switches": switches,
+                "pred_ref": some_sw.input[1],
+                "interior_order": [
+                    n for n in nodes
+                    if n.name in members and n.op not in
+                    ("Switch", "Merge", "Identity") or
+                    (n.name in members and n.op == "Identity"
+                     and n.name not in pivot_ids)
+                ],
+            }
+        return plans
+
+    def _import_v1_cond(self, plan: dict) -> None:
+        m = plan["merge"]
+        interior = [
+            n for n in plan["interior_order"]
+            if n.op not in ("Switch", "Merge")
+        ]
+        args = [
+            self.in_var(
+                next(i for i in self._cond_switch(sw).input
+                     if not i.startswith("^"))
+            )
+            for sw in plan["switches"]
+        ]
+        true_fn = _SubgraphFn(
+            interior, [f"{sw}:1" for sw in plan["switches"]],
+            [plan["true_ref"]], funcs=self._funcs,
+            label=f"cond {m.name!r} true branch",
+        )
+        false_fn = _SubgraphFn(
+            interior, [sw for sw in plan["switches"]],
+            [plan["false_ref"]], funcs=self._funcs,
+            label=f"cond {m.name!r} false branch",
+        )
+        pred = self.in_var(plan["pred_ref"])
+        out = self.sd.if_cond(
+            pred,
+            lambda *a: true_fn(*a)[0],
+            lambda *a: false_fn(*a)[0],
+            *args,
+            name=m.name,
+        )
+        self.vars[m.name] = out
+
+    def _cond_switch(self, name: str):
+        for n in self.gd.node:
+            if n.name == name:
+                return n
+        raise TFImportError(f"switch node {name!r} vanished")
+
+    # -- V2 functional control flow (While/If + FunctionDef library) --
+    @staticmethod
+    def _norm_fref(raw: str) -> str:
+        """FunctionDef node inputs are 'node:out_arg:idx'; normalize to the
+        GraphDef 'node[:idx]' form the op handlers expect.  (Assumes
+        single-tensor output args — true for every op this importer maps.)"""
+        if raw.startswith("^"):
+            return raw
+        parts = raw.split(":")
+        if len(parts) == 3:
+            name, _arg, idx = parts
+            return name if idx == "0" else f"{name}:{idx}"
+        return raw
+
+    def _func_fn(self, fref, label: str) -> "_SubgraphFn":
+        fname = getattr(fref, "name", None) or str(fref)
+        fd = self._funcs.get(fname)
+        if fd is None:
+            raise TFImportError(
+                f"{label}: function {fname!r} not found in the GraphDef "
+                "library"
+            )
+        in_names = [a.name for a in fd.signature.input_arg]
+        nodes = []
+        for nd in fd.node_def:
+            c = type(nd)()
+            c.CopyFrom(nd)
+            norm = [self._norm_fref(i) for i in nd.input]
+            del c.input[:]
+            c.input.extend(norm)
+            nodes.append(c)
+        outs = [self._norm_fref(fd.ret[a.name])
+                for a in fd.signature.output_arg]
+        return _SubgraphFn(nodes, in_names, outs, funcs=self._funcs,
+                           label=f"function {fname!r}")
+
+    def _bind_multi(self, node, outs) -> None:
+        self.vars[node.name] = outs[0]
+        for i, o in enumerate(outs):
+            self.vars[f"{node.name}:{i}"] = o
+
+    def op_StatelessWhile(self, node):
+        cond_fn = self._func_fn(self.attr(node, "cond"), node.name)
+        body_fn = self._func_fn(self.attr(node, "body"), node.name)
+        init = [self.in_var(i) for i in self.data_inputs(node)]
+        outs = self.sd.while_loop(
+            lambda *vs: cond_fn(*vs)[0],
+            lambda *vs: body_fn(*vs),
+            *init,
+        )
+        self._bind_multi(node, outs)
+
+    op_While = op_StatelessWhile
+
+    def op_StatelessIf(self, node):
+        import jax
+        import jax.numpy as jnp
+
+        ins = self.data_inputs(node)
+        pred = self.in_var(ins[0])
+        args = [self.in_var(i) for i in ins[1:]]
+        then_fn = self._func_fn(self.attr(node, "then_branch"), node.name)
+        else_fn = self._func_fn(self.attr(node, "else_branch"), node.name)
+        n_out = max(len(self.attr(node, "Tout", []) or []), 1)
+
+        def fn(p, *a):
+            return jax.lax.cond(
+                jnp.asarray(p).astype(bool).reshape(()),
+                lambda ops: tuple(then_fn(*ops)),
+                lambda ops: tuple(else_fn(*ops)),
+                tuple(a),
+            )
+
+        outs = self.sd.py_call(fn, pred, *args, n_out=n_out, name=node.name)
+        self._bind_multi(node, outs)
+
+    op_If = op_StatelessIf
+
+    def op_PartitionedCall(self, node):
+        fn = self._func_fn(self.attr(node, "f"), node.name)
+        args = [self.in_var(i) for i in self.data_inputs(node)]
+        outs = self.sd.py_call(
+            lambda *a: fn(*a), *args, n_out=len(fn.out_keys), name=node.name
+        )
+        self._bind_multi(node, outs)
+
+    op_StatefulPartitionedCall = op_PartitionedCall
+
+
+class _SubgraphFn:
+    """A TF subgraph compiled into a Python callable over jnp arrays —
+    the trace-time body of lax.while_loop / lax.cond for imported control
+    flow.  Built ONCE at import: the named inputs become placeholders of a
+    private SameDiff, the node list is backward-sliced from the outputs and
+    imported through the same op_* handlers, and each call interprets that
+    sub-SameDiff at trace time (SameDiff._execute), so the body fuses into
+    the surrounding XLA computation like everything else."""
+
+    def __init__(self, nodes, inputs: List[str], outputs: List[str], *,
+                 statics: Optional[Dict[str, np.ndarray]] = None,
+                 funcs: Optional[dict] = None, label: str = ""):
+        imp = _Importer.__new__(_Importer)
+        imp.gd = None
+        imp.sd = SameDiff()
+        imp.trainable = False
+        imp.vars = {}
+        imp.consts = dict(statics or {})
+        imp._promoted = {}
+        imp._funcs = funcs or {}
+        self._imp = imp
+        self.in_keys: List[str] = []
+        for i, nm in enumerate(inputs):
+            ph = imp.sd.placeholder(f"arg{i}")
+            imp.vars[nm] = ph
+            self.in_keys.append(ph.name)
+        imp.sd.reserve_names(n.name for n in nodes)
+        needed = self._slice(nodes, outputs)
+        for node in nodes:
+            if node.name not in needed:
+                continue
+            handler = getattr(imp, f"op_{node.op}", None)
+            if handler is None:
+                raise TFImportError(
+                    f"{label}: unsupported TF op {node.op!r} in "
+                    "control-flow body"
+                )
+            handler(node)
+        self.out_keys = [imp.in_var(r).name for r in outputs]
+
+    @staticmethod
+    def _slice(nodes, outputs) -> set:
+        by_name = {n.name: n for n in nodes}
+        needed: set = set()
+        stack = [_input_name(r)[0] for r in outputs]
+        while stack:
+            b = stack.pop()
+            if b in needed or b not in by_name:
+                continue
+            needed.add(b)
+            for raw in by_name[b].input:
+                if raw.startswith("^"):
+                    continue
+                stack.append(_input_name(raw)[0])
+        return needed
+
+    def __call__(self, *args):
+        env = dict(self._imp.sd._values)
+        env.update(zip(self.in_keys, args))
+        return self._imp.sd._execute(env, tuple(self.out_keys))
 
 
 def import_graph(path_or_graphdef, trainable: bool = False) -> SameDiff:

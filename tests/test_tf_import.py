@@ -265,13 +265,180 @@ class TestReviewRegressions:
         assert_graph_matches(build, {"x": rng.normal(size=(1, 2, 2, 2)).astype(np.float32)}, "out", atol=1e-4)
 
 
-class TestErrorPaths:
-    def test_control_flow_rejected(self):
-        g = tf1.Graph()
-        with g.as_default():
+class TestControlFlow:
+    """V1 frame reconstruction + V2 functional While/If (SURVEY §3.3
+    VarId frames; VERDICT r3 item 5)."""
+
+    def _v1(self, build_fn, feeds, fetch, atol=1e-5):
+        tf1.disable_control_flow_v2()
+        try:
+            return assert_graph_matches(build_fn, feeds, fetch, atol=atol)
+        finally:
+            tf1.enable_control_flow_v2()
+
+    def test_v1_while_with_capture(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [4], name="x")
+            scale = tf.constant(2.0, name="scale")  # is_constant Enter
+            tf1.while_loop(
+                lambda i, a: i < 5,
+                lambda i, a: (i + 1, a * scale + 1.0),
+                [tf.constant(0), x], name="loop",
+            )
+            # fetch through Exit's consumer
+            tf.identity(tf1.get_default_graph()
+                        .get_tensor_by_name("loop/Exit_1:0"), name="out")
+
+        self._v1(build, {"x": np.array([1., -2., 3., .5], np.float32)},
+                 "out")
+
+    def test_v1_while_dynamic_capture(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [3], name="x")
+            s = tf1.placeholder(tf.float32, [], name="s")  # dynamic capture
+            _, acc = tf1.while_loop(
+                lambda i, a: i < 4,
+                lambda i, a: (i + 1, a + s),
+                [tf.constant(0), x], name="loop",
+            )
+            tf.identity(acc, name="out")
+
+        self._v1(build,
+                 {"x": np.zeros(3, np.float32), "s": np.float32(2.5)},
+                 "out")
+
+    def test_v1_two_sequential_loops(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [2], name="x")
+            _, a1 = tf1.while_loop(
+                lambda i, a: i < 3, lambda i, a: (i + 1, a + 1.0),
+                [tf.constant(0), x], name="l1",
+            )
+            _, a2 = tf1.while_loop(
+                lambda i, a: i < 2, lambda i, a: (i + 1, a * 3.0),
+                [tf.constant(0), a1], name="l2",
+            )
+            tf.identity(a2, name="out")
+
+        self._v1(build, {"x": np.array([1., 2.], np.float32)}, "out")
+
+    def test_v1_cond_both_branches(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [4], name="x")
+            y = tf1.cond(tf.reduce_sum(x) > 0.0,
+                         lambda: x * 2.0 + 1.0, lambda: x - 3.0,
+                         name="branch")
+            tf.identity(y, name="out")
+
+        xv = np.array([1., -2., 3., .5], np.float32)
+        tf1.disable_control_flow_v2()
+        try:
+            g = tf1.Graph()
+            with g.as_default():
+                build()
+        finally:
+            tf1.enable_control_flow_v2()
+        sd = import_graph(g.as_graph_def())
+        for v in (xv, -xv):  # exercise BOTH branches
+            want = golden(g, {"x:0": v}, "out:0")
+            np.testing.assert_allclose(
+                np.asarray(sd.output({"x": v}, "out")), want, atol=1e-5)
+
+    def test_v1_cond_const_branch(self):
+        def build():
             x = tf1.placeholder(tf.float32, [], name="x")
-            tf1.cond(x > 0, lambda: x * 2, lambda: x - 1, name="out")
-        with pytest.raises(TFImportError, match="control-flow"):
+            y = tf1.cond(x > 0.0,
+                         lambda: tf.constant(7.0), lambda: x * 2.0,
+                         name="branch")
+            tf.identity(y, name="out")
+
+        tf1.disable_control_flow_v2()
+        try:
+            g = tf1.Graph()
+            with g.as_default():
+                build()
+        finally:
+            tf1.enable_control_flow_v2()
+        sd = import_graph(g.as_graph_def())
+        for v in (np.float32(3.0), np.float32(-3.0)):
+            want = golden(g, {"x:0": v}, "out:0")
+            np.testing.assert_allclose(
+                np.asarray(sd.output({"x": v}, "out")), want, atol=1e-5)
+
+    def test_v2_multi_output_if(self):
+        """Tout is a list(type) attr; a decode gap here once bound only the
+        first If output (r4 review finding)."""
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+
+        @tf.function
+        def f(x):
+            a, b = tf.cond(tf.reduce_sum(x) > 0.0,
+                           lambda: (x * 2.0, x + 1.0),
+                           lambda: (x - 1.0, x * 3.0))
+            return a + b
+
+        cfn = f.get_concrete_function(tf.TensorSpec([3], tf.float32))
+        frozen = convert_variables_to_constants_v2(
+            cfn, lower_control_flow=False)
+        sd = import_graph(frozen.graph.as_graph_def().SerializeToString())
+        for v in (np.array([1., 2., 3.], np.float32),
+                  np.array([-1., -2., -3.], np.float32)):
+            want = f(tf.constant(v)).numpy()
+            np.testing.assert_allclose(
+                np.asarray(sd.output({"x": v}, "Identity")), want,
+                atol=1e-5)
+
+    def test_v2_functional_while_if(self):
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+
+        @tf.function
+        def f(x):
+            _, acc = tf.while_loop(
+                lambda i, a: i < 5,
+                lambda i, a: (i + 1, a * 2.0 + 1.0),
+                [tf.constant(0), x],
+            )
+            return tf.cond(tf.reduce_sum(acc) > 0.0,
+                           lambda: acc * 2.0, lambda: acc - 1.0)
+
+        cfn = f.get_concrete_function(tf.TensorSpec([4], tf.float32))
+        frozen = convert_variables_to_constants_v2(
+            cfn, lower_control_flow=False)
+        raw = frozen.graph.as_graph_def().SerializeToString()
+        sd = import_graph(raw)  # exercises the self-contained codec too
+        for v in (np.array([1., -2., 3., .5], np.float32),
+                  np.array([-9., -2., -3., -.5], np.float32)):
+            want = f(tf.constant(v)).numpy()
+            np.testing.assert_allclose(
+                np.asarray(sd.output({"x": v}, "Identity")), want,
+                atol=1e-5)
+
+
+class TestErrorPaths:
+    def test_nested_v1_frames_rejected(self):
+        tf1.disable_control_flow_v2()
+        try:
+            g = tf1.Graph()
+            with g.as_default():
+                x = tf1.placeholder(tf.float32, [], name="x")
+
+                def outer_body(i, a):
+                    _, a2 = tf1.while_loop(
+                        lambda j, b: j < 2,
+                        lambda j, b: (j + 1, b * 2.0),
+                        [tf.constant(0), a],
+                    )
+                    return i + 1, a2
+
+                tf1.while_loop(lambda i, a: i < 3, outer_body,
+                               [tf.constant(0), x], name="loop")
+        finally:
+            tf1.enable_control_flow_v2()
+        with pytest.raises(TFImportError, match="nested"):
             import_graph(g.as_graph_def())
 
     def test_unsupported_op_named(self):
