@@ -63,6 +63,21 @@ class DiscreteParameterSpace(ParameterSpace):
         # kernel-size candidate — write [(3, 3)] or [3, 3] to disambiguate
         if len(values) == 1 and isinstance(values[0], list):
             values = tuple(values[0])
+        elif (
+            len(values) == 1
+            and isinstance(values[0], tuple)
+            and all(np.isscalar(v) for v in values[0])
+        ):
+            # pre-r3 this unpacked; the change was silent for old callers
+            import warnings
+
+            warnings.warn(
+                "DiscreteParameterSpace((a, b, ...)) is ONE tuple-valued "
+                "candidate (e.g. a kernel size); write "
+                "DiscreteParameterSpace([a, b, ...]) or "
+                "DiscreteParameterSpace(a, b, ...) to search over scalars",
+                stacklevel=2,
+            )
         object.__setattr__(self, "values", tuple(values))
         if not self.values:
             raise ValueError("DiscreteParameterSpace needs at least one value")

@@ -535,7 +535,14 @@ class SameDiff:
 
         sync=True (default) returns the loss as a Python float, which
         blocks on the device; sync=False returns the device scalar so
-        back-to-back steps pipeline (read it later to observe the loss)."""
+        back-to-back steps pipeline (read it later to observe the loss).
+
+        Failure semantics: because the inputs are donated, a step that
+        raises AFTER dispatch (OOM, transport drop) may leave the donated
+        buffers deleted — the instance is then NOT retryable; a
+        RuntimeError naming the condition chains from the original error
+        (restore from a checkpoint / re-import to continue).  Errors
+        raised before dispatch leave the instance intact."""
         if self._training_config is None:
             raise ValueError("call set_training_config() first")
         if self._loss_var is None:
@@ -584,9 +591,25 @@ class SameDiff:
         frozen = {
             k: v for k, v in self._values.items() if k not in self._trainable
         }
-        new_train, self._opt_state, loss = self._compiled[key](
-            trainable, self._opt_state, frozen, ph, rng
-        )
+        try:
+            new_train, self._opt_state, loss = self._compiled[key](
+                trainable, self._opt_state, frozen, ph, rng
+            )
+        except Exception as exc:
+            # donated buffers may already be deleted; make the corrupted
+            # state loud instead of letting a retry consume dead buffers
+            dead = [
+                n for n, v in trainable.items()
+                if getattr(v, "is_deleted", lambda: False)()
+            ]
+            if dead:
+                raise RuntimeError(
+                    f"fit_batch failed after donating {len(dead)} trainable "
+                    "buffer(s); this SameDiff instance is no longer "
+                    "retryable — restore from a checkpoint or re-import "
+                    f"(first dead: {dead[0]!r})"
+                ) from exc
+            raise
         self._values.update(new_train)
         return float(loss) if sync else loss
 

@@ -33,6 +33,7 @@ import json
 import os
 import subprocess
 import sys
+import time
 import warnings
 from typing import List
 
@@ -96,10 +97,13 @@ class LocalTransformExecutor:
         results: list = [None] * len(procs)
 
         def pump(i, p, part):
-            results[i] = p.communicate(
-                json.dumps({"process": tp_json, "records": part}),
-                timeout=timeout,
-            )
+            try:
+                results[i] = p.communicate(
+                    json.dumps({"process": tp_json, "records": part}),
+                    timeout=timeout,
+                )
+            except subprocess.TimeoutExpired:
+                pass  # results[i] stays None -> reported as timed out
 
         threads = [
             threading.Thread(target=pump, args=(i, p, part), daemon=True)
@@ -108,12 +112,24 @@ class LocalTransformExecutor:
         try:
             for t in threads:
                 t.start()
+            # one shared deadline: a slow worker must not double the
+            # effective bound to ~2x timeout across the join loop
+            deadline = time.monotonic() + (timeout or 0)
             for t in threads:
-                t.join(timeout=timeout)
+                t.join(
+                    timeout=max(0.0, deadline - time.monotonic())
+                    if timeout else None
+                )
         finally:
             for p in procs:
                 if p.poll() is None:
                     p.kill()
+            # once the processes are dead the pumps finish promptly;
+            # re-join so results[] is settled before it is read (a worker
+            # finishing just under the deadline must not be misreported
+            # as timed out)
+            for t in threads:
+                t.join(timeout=10)
         out: Records = []
         errors = []
         for p, res in zip(procs, results):

@@ -87,6 +87,7 @@ class RemoteStatsStorageRouter(StatsStorage):
         self.url = url.rstrip("/") + "/api/stats"
         self.dropped = 0
         self._timeout = timeout
+        self._closed = False
         self._q: "queue.Queue" = queue.Queue(maxsize=max_queue)
         self._thread = threading.Thread(target=self._drain, daemon=True)
         self._thread.start()
@@ -94,6 +95,11 @@ class RemoteStatsStorageRouter(StatsStorage):
     def put_record(self, record: dict) -> None:
         import queue
 
+        if self._closed:
+            # the drain thread has exited; count as dropped rather than
+            # enqueueing records nothing will ever send
+            self.dropped += 1
+            return
         try:
             self._q.put_nowait(record)
         except queue.Full:
@@ -121,12 +127,27 @@ class RemoteStatsStorageRouter(StatsStorage):
                 self._q.task_done()
 
     def flush(self) -> None:
-        """Block until every queued record has been attempted."""
-        self._q.join()
+        """Block until every queued record has been attempted (no-op after
+        close() — joining a queue no thread drains would hang forever)."""
+        if not self._closed:
+            self._q.join()
 
     def close(self) -> None:
+        import queue
+
+        if self._closed:
+            return
+        self._closed = True
         self._q.put(None)
         self._thread.join(timeout=5)
+        # a put_record racing close() can land behind the sentinel where
+        # nothing will ever drain it; count those leftovers as dropped
+        while True:
+            try:
+                if self._q.get_nowait() is not None:
+                    self.dropped += 1
+            except queue.Empty:
+                break
 
     # reads happen on the chief; the router is write-only
     def list_sessions(self) -> list[str]:

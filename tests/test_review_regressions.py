@@ -179,3 +179,83 @@ class TestMaxpoolFusionBarrier:
 
         g = jax.jit(jax.grad(f))(cp)
         assert np.isfinite(np.asarray(g["W"], np.float32)).all()
+
+
+class TestAdvisorRound3:
+    """Regressions for the round-3 advisor findings (ADVICE.md r3)."""
+
+    def test_discrete_space_lone_tuple_warns(self):
+        import warnings
+
+        from deeplearning4j_tpu.arbiter.spaces import DiscreteParameterSpace
+
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            sp = DiscreteParameterSpace((0.1, 0.01))
+        assert any("ONE tuple-valued candidate" in str(x.message) for x in w)
+        assert sp.values == ((0.1, 0.01),)   # behavior unchanged, just loud
+        with warnings.catch_warnings(record=True) as w:
+            warnings.simplefilter("always")
+            DiscreteParameterSpace((3, 3))   # kernel-size: still warns
+            DiscreteParameterSpace([0.1, 0.01])  # canonical: silent
+            DiscreteParameterSpace(0.1, 0.01)    # canonical: silent
+        assert len(w) == 1
+
+    def test_fit_batch_dead_donated_buffers_raise_clearly(self):
+        import jax.numpy as jnp
+        import pytest
+
+        from deeplearning4j_tpu.autodiff.samediff import (
+            SameDiff, TrainingConfig)
+        from deeplearning4j_tpu.nn.updaters import Sgd
+
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        w = sd.var("w", np.ones((3,), np.float32))
+        y = sd.apply("mul", x, w)
+        sd.set_loss(sd.apply("sum", y))
+        sd.set_training_config(TrainingConfig(updater=Sgd(0.1)))
+        feed = {"x": np.ones((3,), np.float32)}
+        sd.fit_batch(feed)  # compiles the step
+
+        (key,) = [k for k in sd._compiled if k[0] == "fit"]
+
+        def boom(*a, **k):
+            # simulate a post-dispatch failure with donated buffers gone
+            sd._values["w"].delete()
+            raise RuntimeError("transport dropped")
+
+        sd._compiled[key] = boom
+        with pytest.raises(RuntimeError, match="no longer retryable"):
+            sd.fit_batch(feed)
+
+    def test_executor_timeout_single_deadline(self, monkeypatch):
+        import time as _time
+
+        from deeplearning4j_tpu.datavec import (
+            LocalTransformExecutor, Schema, TransformProcess)
+
+        schema = Schema.builder().add_double("v").build()
+        tp = TransformProcess.builder(schema).build()
+        recs = [[float(i)] for i in range(2048)]
+        t0 = _time.monotonic()
+        try:
+            LocalTransformExecutor.execute(
+                tp, recs, num_workers=4, min_records_per_worker=1,
+                timeout=0.9)
+        except RuntimeError as e:
+            assert "timed out" in str(e) or "failed" in str(e)
+            # shared deadline: must not stack per-worker timeouts to ~2x
+            assert _time.monotonic() - t0 < 2.5
+        # fast workers finishing under the timeout is also acceptable
+
+    def test_remote_router_after_close(self):
+        from deeplearning4j_tpu.ui.stats import RemoteStatsStorageRouter
+
+        r = RemoteStatsStorageRouter("http://127.0.0.1:9")  # unreachable
+        r.close()
+        before = r.dropped
+        r.put_record({"k": 1})
+        assert r.dropped == before + 1    # counted, not silently queued
+        r.flush()                          # must not hang after close()
+        r.close()                          # idempotent
