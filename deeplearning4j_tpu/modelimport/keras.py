@@ -54,8 +54,11 @@ from deeplearning4j_tpu.nn.conf.layers_nd import (
 from deeplearning4j_tpu.nn.conf.recurrent import (
     GRU,
     LSTM,
+    Bidirectional,
+    ConvLSTM2D,
     LastTimeStep,
     SimpleRnn,
+    TimeDistributed,
 )
 from deeplearning4j_tpu.nn.losses import Loss
 from deeplearning4j_tpu.nn.updaters import Adam
@@ -139,6 +142,13 @@ def _itype_from_shape(shape: tuple) -> InputType:
         return InputType.recurrent(int(shape[1]), t)
     if len(shape) == 3 and None not in shape:
         return InputType.convolutional(int(shape[0]), int(shape[1]), int(shape[2]))
+    if len(shape) == 4 and None not in shape[1:]:
+        # (T, H, W, C): image sequences (ConvLSTM2D) ride the CNN3D kind
+        # with depth read as time; Conv3D inputs are identical
+        t = -1 if shape[0] is None else int(shape[0])
+        return InputType.convolutional3d(
+            t, int(shape[1]), int(shape[2]), int(shape[3])
+        )
     raise KerasImportError(f"cannot infer InputType from input shape {shape}")
 
 
@@ -336,6 +346,13 @@ def _map_spatial_dropout(cfg, name):
 def _map_lstm(cfg, name):
     if _act(cfg.get("activation", "tanh")) != Activation.TANH:
         raise KerasImportError("LSTM import supports tanh cell activation only")
+    if cfg.get("recurrent_activation") == "hard_sigmoid":
+        raise KerasImportError(
+            "LSTM recurrent_activation='hard_sigmoid' (the Keras-1 default) "
+            "does not import: keras' hard_sigmoid (slope 0.2, cutoff ±2.5) "
+            "differs from XLA's (slope 1/6, cutoff ±3) — re-export with "
+            "sigmoid gates"
+        )
     lstm = LSTM(
         name=name,
         n_out=int(cfg["units"]),
@@ -347,6 +364,175 @@ def _map_lstm(cfg, name):
     # Keras default return_sequences=False emits ONLY the final timestep;
     # mappers may return a chain, so append the collapse explicitly
     return [lstm, LastTimeStep(name=f"{name}__last")]
+
+
+# --- Keras-1 legacy dialect -------------------------------------------------
+# The reference's KerasLayerConfiguration reads BOTH Keras 1 and Keras 2
+# field names (SURVEY.md §2.2 "sequential & functional, Keras 1&2"); same
+# here: configs are normalized to the K2 dialect before mapper dispatch,
+# and K1 weight dataset names (dense_1_W, lstm_1_W_i, ...) normalize to K2
+# keys in _collect_layer_weights.
+
+def _k1_normalize(cls: str, cfg: dict) -> tuple[str, dict]:
+    cfg = dict(cfg)
+    if cfg.get("dim_ordering") == "th":
+        raise KerasImportError(
+            f"{cls}: Keras-1 dim_ordering='th' (channels_first) does not "
+            "import — TPU layout is channels_last; re-export with 'tf'"
+        )
+    if cls in ("Convolution2D", "AtrousConvolution2D"):
+        cls = "Conv2D"
+        cfg["filters"] = cfg.pop("nb_filter")
+        cfg["kernel_size"] = [cfg.pop("nb_row"), cfg.pop("nb_col")]
+        if "subsample" in cfg:
+            cfg["strides"] = list(cfg.pop("subsample"))
+        if "border_mode" in cfg:
+            cfg["padding"] = cfg.pop("border_mode")
+    elif cls == "Convolution1D":
+        cls = "Conv1D"
+        cfg["filters"] = cfg.pop("nb_filter")
+        cfg["kernel_size"] = cfg.pop("filter_length")
+        if "subsample_length" in cfg:
+            cfg["strides"] = cfg.pop("subsample_length")
+        if "border_mode" in cfg:
+            cfg["padding"] = cfg.pop("border_mode")
+    elif "border_mode" in cfg:
+        cfg["padding"] = cfg.pop("border_mode")
+    if cls == "Dropout" and "p" in cfg:
+        cfg["rate"] = cfg.pop("p")
+    if "output_dim" in cfg and cls in ("Dense", "LSTM", "GRU", "SimpleRNN"):
+        cfg["units"] = cfg.pop("output_dim")
+        if cls == "GRU":
+            # Keras-1 GRU is reset-BEFORE ((r*h)@U), a different cell than
+            # the reset_after=True one we implement; make _map_gru's guard
+            # fire instead of importing silently-wrong math
+            cfg.setdefault("reset_after", False)
+    if "inner_activation" in cfg:
+        cfg["recurrent_activation"] = cfg.pop("inner_activation")
+    return cls, cfg
+
+
+def _normalize_k1_weight_keys(w: Dict[str, np.ndarray]) -> Dict[str, np.ndarray]:
+    """Map Keras-1 dataset names onto K2 keys; K2-named dicts pass through
+    untouched.  Per-gate K1 RNN arrays fuse into the K2 packed tensors
+    (LSTM gate order [i,f,c,o]; GRU [z,r,h] — downstream mappers reorder
+    for our cells)."""
+    if not w or any(
+        k in ("kernel", "bias", "recurrent_kernel", "embeddings", "gamma",
+              "beta", "depthwise_kernel", "alpha") for k in w
+    ):
+        return w
+
+    def gates(mid, order):
+        found = {}
+        for g in order:
+            hit = [k for k in w if k.endswith(f"_{mid}_{g}")]
+            if len(hit) != 1:
+                return None
+            found[g] = w[hit[0]]
+        return np.concatenate([found[g] for g in order], axis=-1)
+
+    for order in ("ifco", "zrh"):   # K1 LSTM / K1 GRU gate families
+        k_ = gates("W", order)
+        if k_ is not None:
+            u_, b_ = gates("U", order), gates("b", order)
+            if u_ is None or b_ is None:
+                raise KerasImportError(
+                    "Keras-1 per-gate RNN weights are incomplete: found the "
+                    f"W_{{{','.join(order)}}} family but not a full U/b "
+                    f"family among {sorted(w)}"
+                )
+            return {"kernel": k_, "recurrent_kernel": u_, "bias": b_}
+    ren: Dict[str, np.ndarray] = {}
+    suffixes = [
+        ("_running_mean", "moving_mean"), ("_running_std", "moving_variance"),
+        ("_gamma", "gamma"), ("_beta", "beta"),
+        ("_W", "kernel"), ("_U", "recurrent_kernel"), ("_b", "bias"),
+    ]
+    for k, v in w.items():
+        for suf, target in suffixes:
+            if k.endswith(suf):
+                ren[target] = v
+                break
+        else:
+            return w   # unknown naming scheme: assume already K2
+    return ren
+
+
+_BIDIR_MODES = {"concat": "concat", "sum": "add", "ave": "ave", "mul": "mul"}
+
+
+def _map_bidirectional(cfg, name):
+    inner_ld = cfg["layer"]
+    inner_cls = inner_ld["class_name"]
+    inner_cfg = dict(inner_ld["config"])
+    if inner_cls not in ("LSTM", "GRU", "SimpleRNN"):
+        raise KerasImportError(
+            f"Bidirectional({inner_cls}) not supported — wrapped layer must "
+            "be LSTM/GRU/SimpleRNN"
+        )
+    return_sequences = bool(inner_cfg.get("return_sequences", False))
+    # the wrapper owns sequence collapsing; the inner mapper must emit the
+    # bare recurrent layer (no LastTimeStep chain)
+    inner_cfg["return_sequences"] = True
+    inner_name = inner_cfg.get("name") or f"{name}__inner"
+    inner_cfg["name"] = inner_name
+    mapped = _LAYER_MAPPERS[inner_cls](inner_cfg, inner_name)
+    if isinstance(mapped, (list, tuple)):
+        mapped = mapped[0]
+    mode = cfg.get("merge_mode", "concat")
+    if mode not in _BIDIR_MODES:
+        raise KerasImportError(f"Bidirectional merge_mode {mode!r} not supported")
+    return Bidirectional(
+        name=name, layer=mapped, mode=_BIDIR_MODES[mode],
+        return_sequences=return_sequences,
+    )
+
+
+def _map_time_distributed(cfg, name):
+    inner_ld = cfg["layer"]
+    inner_cls = inner_ld["class_name"]
+    inner_cfg = dict(inner_ld["config"])
+    inner_name = inner_cfg.get("name") or f"{name}__inner"
+    if inner_cls not in _LAYER_MAPPERS:
+        raise KerasImportError(f"TimeDistributed({inner_cls}) not supported")
+    mapped = _LAYER_MAPPERS[inner_cls](inner_cfg, inner_name)
+    if isinstance(mapped, (list, tuple)):
+        mapped = mapped[0]
+    if mapped is None:
+        return None
+    if mapped.EXPECTS not in ("ff", "any"):
+        raise KerasImportError(
+            f"TimeDistributed({inner_cls}) not supported — only "
+            "feed-forward inner layers import"
+        )
+    return TimeDistributed(name=name, layer=mapped)
+
+
+def _map_convlstm2d(cfg, name):
+    if _act(cfg.get("activation", "tanh")) != Activation.TANH:
+        raise KerasImportError("ConvLSTM2D import supports tanh activation only")
+    if cfg.get("recurrent_activation", "hard_sigmoid") != "sigmoid":
+        raise KerasImportError(
+            "ConvLSTM2D import needs recurrent_activation='sigmoid' (keras' "
+            "hard_sigmoid has a different slope than XLA's; re-export with "
+            "sigmoid gates)"
+        )
+    if cfg.get("data_format") not in (None, "channels_last"):
+        raise KerasImportError("ConvLSTM2D imports channels_last only")
+    if tuple(_pair(cfg.get("dilation_rate", 1))) != (1, 1):
+        raise KerasImportError("ConvLSTM2D dilation_rate != 1 not supported")
+    if not cfg.get("use_bias", True):
+        raise KerasImportError("ConvLSTM2D use_bias=False not supported")
+    return ConvLSTM2D(
+        name=name,
+        n_out=int(cfg["filters"]),
+        kernel=_pair(cfg.get("kernel_size", 3)),
+        stride=_pair(cfg.get("strides", 1)),
+        padding=cfg.get("padding", "valid"),
+        return_sequences=bool(cfg.get("return_sequences", False)),
+        forget_gate_bias=1.0 if cfg.get("unit_forget_bias", True) else 0.0,
+    )
 
 
 _LAYER_MAPPERS: Dict[str, Callable] = {
@@ -367,6 +553,9 @@ _LAYER_MAPPERS: Dict[str, Callable] = {
     ),
     "LSTM": _map_lstm,
     "GRU": _map_gru,
+    "Bidirectional": _map_bidirectional,
+    "TimeDistributed": _map_time_distributed,
+    "ConvLSTM2D": _map_convlstm2d,
     "SimpleRNN": lambda cfg, name: _map_simplernn(cfg, name),
     "Conv2DTranspose": lambda cfg, name: _map_conv2d_transpose(cfg, name),
     "MaxPooling1D": lambda cfg, name: Subsampling1D(
@@ -449,7 +638,7 @@ def _collect_layer_weights(h5group) -> Dict[str, np.ndarray]:
             out[key] = np.asarray(obj)
 
     h5group.visititems(visit)
-    return out
+    return _normalize_k1_weight_keys(out)
 
 
 def _apply_weights(layer_conf, weights: Dict[str, np.ndarray], params: dict, state: dict):
@@ -544,16 +733,30 @@ def _apply_weights(layer_conf, weights: Dict[str, np.ndarray], params: dict, sta
         }
     elif isinstance(layer_conf, Embedding):
         p = dict(params[name])
-        p["W"] = weights["embeddings"].astype(np.float32)
+        # K1 named the table <name>_W, which normalizes to "kernel"
+        emb = weights.get("embeddings", weights.get("kernel"))
+        p["W"] = emb.astype(np.float32)
         params[name] = p
-    elif isinstance(layer_conf, LSTM):
-        # keras fused gate order [i, f, c, o] == ours [i, f, g, o]
+    elif isinstance(layer_conf, (LSTM, ConvLSTM2D)):
+        # keras fused gate order [i, f, c, o] == ours [i, f, g, o] (for
+        # ConvLSTM2D the kernels are (kh, kw, in, 4F) HWIO — same layout)
         p = dict(params[name])
         p["Wx"] = weights["kernel"].astype(np.float32)
         p["Wh"] = weights["recurrent_kernel"].astype(np.float32)
         if "bias" in weights:
             p["b"] = weights["bias"].astype(np.float32)
         params[name] = p
+    elif isinstance(layer_conf, TimeDistributed):
+        import dataclasses as _dc
+
+        _apply_weights(
+            _dc.replace(layer_conf.layer, name=name), weights, params, state
+        )
+    elif isinstance(layer_conf, Bidirectional):
+        raise KerasImportError(
+            f"Bidirectional layer {name!r} weights must be routed through "
+            "_apply_bidirectional_weights (importer bug)"
+        )
     elif weights:
         raise KerasImportError(
             f"layer {name!r} ({type(layer_conf).__name__}) has weights "
@@ -645,7 +848,7 @@ def import_keras_model(path: str) -> SequentialModel:
         confs = []
         bn_axes: Dict[str, int] = {}
         for ld in layer_dicts:
-            cls, cfg = ld["class_name"], ld.get("config", {})
+            cls, cfg = _k1_normalize(ld["class_name"], ld.get("config", {}))
             name = cfg.get("name") or ld.get("name")
             shape = _input_shape(cfg)
             if shape is not None and input_type is None:
@@ -711,6 +914,49 @@ def import_keras_model(path: str) -> SequentialModel:
         return model
 
 
+def _apply_bidirectional_weights(conf, h5group, params) -> bool:
+    """Route a Bidirectional group's two weight sets into params[name]
+    ['fwd'/'bwd'].  Keras nests them under 'forward_<inner>' /
+    'backward_<inner>' subgroups, whose flattened keys would collide if
+    collected naively; the inner gate-order fixups (GRU reorder etc.)
+    reuse _apply_weights on the wrapped layer class."""
+    import dataclasses as _dc
+
+    import h5py
+
+    sides: Dict[str, Dict[str, np.ndarray]] = {"fwd": {}, "bwd": {}}
+
+    def visit(path, obj):
+        if isinstance(obj, h5py.Dataset):
+            parts = path.split("/")
+            side = None
+            for seg in parts:
+                if seg.startswith("forward"):
+                    side = "fwd"
+                    break
+                if seg.startswith("backward"):
+                    side = "bwd"
+                    break
+            if side is not None:
+                sides[side][parts[-1].split(":")[0]] = np.asarray(obj)
+
+    h5group.visititems(visit)
+    if not sides["fwd"] and not sides["bwd"]:
+        return False
+    inner = _dc.replace(conf.layer, name="__inner")
+    merged = dict(params[conf.name])
+    for side_key in ("fwd", "bwd"):
+        if not sides[side_key]:
+            raise KerasImportError(
+                f"Bidirectional {conf.name!r}: missing {side_key} weights"
+            )
+        tmp = {"__inner": dict(merged[side_key])}
+        _apply_weights(inner, sides[side_key], tmp, {})
+        merged[side_key] = tmp["__inner"]
+    params[conf.name] = merged
+    return True
+
+
 def _load_and_validate_weights(f, name_to_conf: Dict[str, Any], model) -> None:
     """Write H5 weight groups into the initialized model, enforcing that
     every parameterized layer received weights at the initialized shapes —
@@ -723,9 +969,14 @@ def _load_and_validate_weights(f, name_to_conf: Dict[str, Any], model) -> None:
     for gname in wroot:
         if gname not in name_to_conf:
             continue
+        conf = name_to_conf[gname]
+        if isinstance(conf, Bidirectional):
+            if _apply_bidirectional_weights(conf, wroot[gname], params):
+                loaded.add(gname)
+            continue
         weights = _collect_layer_weights(wroot[gname])
         if weights:
-            _apply_weights(name_to_conf[gname], weights, params, state)
+            _apply_weights(conf, weights, params, state)
             loaded.add(gname)
     for name in name_to_conf:
         if name in model.params and name not in loaded:
@@ -846,7 +1097,7 @@ def import_keras_graph(path: str):
         confs: Dict[str, Any] = {}
         bn_axes: Dict[str, int] = {}
         for ld in layers:
-            cls, lcfg = ld["class_name"], ld.get("config", {})
+            cls, lcfg = _k1_normalize(ld["class_name"], ld.get("config", {}))
             name = lcfg.get("name") or ld.get("name")
             if len(ld.get("inbound_nodes", [])) > 1:
                 raise KerasImportError(

@@ -329,6 +329,11 @@ class Bidirectional(LayerConfig):
 
     layer: Optional[RecurrentLayerConfig] = None
     mode: str = "concat"  # concat | add | mul | ave
+    # False = keras Bidirectional(return_sequences=False): emit
+    # combine(fwd final step, bwd final step) as (B, size) — note the
+    # backward half's final step corresponds to ORIGINAL index 0, which is
+    # why Bidirectional + LastTimeStep is NOT equivalent
+    return_sequences: bool = True
 
     EXPECTS = "rnn"
     ACCEPTS_MASK = True
@@ -336,6 +341,8 @@ class Bidirectional(LayerConfig):
     def output_type(self, itype: InputType) -> InputType:
         inner = self.layer.output_type(itype)
         size = inner.size * 2 if self.mode == "concat" else inner.size
+        if not self.return_sequences:
+            return InputType.feed_forward(size)
         return InputType.recurrent(size, itype.shape[0])
 
     def init(self, key, itype):
@@ -373,6 +380,17 @@ class Bidirectional(LayerConfig):
             params["bwd"], xr, carry, mask=mr, training=training, rng=rng
         )
         yb = jnp.flip(yb, axis=1)
+        if not self.return_sequences:
+            # fwd final = last unmasked step; bwd final = the backward
+            # pass's own last step, i.e. original index 0 after unflip
+            if mask is None:
+                yf = yf[:, -1, :]
+            else:
+                T = yf.shape[1]
+                idx = T - 1 - jnp.argmax(jnp.flip(mask, axis=1), axis=1)
+                idx = jnp.clip(idx.astype(jnp.int32), 0, T - 1)
+                yf = jnp.take_along_axis(yf, idx[:, None, None], axis=1)[:, 0, :]
+            yb = yb[:, 0, :]
         if self.mode == "concat":
             return jnp.concatenate([yf, yb], axis=-1), state
         if self.mode == "add":
@@ -439,3 +457,129 @@ class RnnOutputLayer(LayerConfig):
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
         return y, state  # logits; loss/activation handled by the model
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class TimeDistributed(LayerConfig):
+    """Apply a feed-forward layer independently at every timestep of a
+    (B, T, F) sequence, preserving the time axis (the reference's
+    TimeDistributedLayer wrapper / keras TimeDistributed).  The wrapped
+    layer must be a feed-forward kind; parameters are SHARED across
+    timesteps (one inner init)."""
+
+    layer: Optional[LayerConfig] = None
+
+    EXPECTS = "rnn"
+
+    def __post_init__(self):
+        if self.layer is not None and self.layer.EXPECTS not in ("ff", "any"):
+            raise ValueError(
+                "TimeDistributed wraps feed-forward layers; got a layer "
+                f"expecting {self.layer.EXPECTS!r}"
+            )
+
+    def output_type(self, itype: InputType) -> InputType:
+        inner = self.layer.output_type(InputType.feed_forward(itype.size))
+        return InputType.recurrent(inner.size, itype.shape[0])
+
+    def init(self, key, itype):
+        return self.layer.init(key, InputType.feed_forward(itype.size))
+
+    def regularizable_params(self, lp):
+        return self.layer.regularizable_params(lp)
+
+    def regularization_terms(self, lp):
+        return self.layer.regularization_terms(lp)
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        # ff layers are pointwise over leading axes (x @ W broadcasts), so
+        # (B, T, F) passes straight through — no reshape round trip
+        return self.layer.apply(params, state, x, training=training, rng=rng)
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
+class ConvLSTM2D(LayerConfig):
+    """Convolutional LSTM over image sequences (keras ConvLSTM2D; the
+    reference imports it via KerasConvLstm2D).  Input is the CNN3D kind
+    (B, T, H, W, C) with depth read as time; gates are convolutions:
+    z = conv(x_t, Wx) + conv(h, Wh), gate order [i, f, g, o].  The input
+    conv honors `padding`; the recurrent conv is always SAME (state keeps
+    the output's spatial dims), matching keras.  One lax.scan over time —
+    XLA unrolls nothing and the MXU sees every conv."""
+
+    n_out: int = 0                      # filters
+    kernel: tuple[int, int] = (3, 3)
+    stride: tuple[int, int] = (1, 1)
+    padding: str = "valid"
+    return_sequences: bool = False
+    forget_gate_bias: float = 1.0
+
+    EXPECTS = "cnn3d"
+
+    def _out_hw(self, h: int, w: int) -> tuple[int, int]:
+        kh, kw = self.kernel
+        sh, sw = self.stride
+        if self.padding == "same":
+            return -(-h // sh), -(-w // sw)
+        return (h - kh) // sh + 1, (w - kw) // sw + 1
+
+    def output_type(self, itype: InputType) -> InputType:
+        t, h, w, _ = itype.shape
+        oh, ow = self._out_hw(h, w)
+        if self.return_sequences:
+            return InputType.convolutional3d(t, oh, ow, self.n_out)
+        return InputType.convolutional(oh, ow, self.n_out)
+
+    def init(self, key, itype):
+        c_in = itype.shape[-1]
+        kh, kw = self.kernel
+        k1, k2 = jax.random.split(key)
+        wi = self._winit(WeightInit.XAVIER)
+        f = self.n_out
+        params = {
+            "Wx": wi.init(k1, (kh, kw, c_in, 4 * f),
+                          fan_in=kh * kw * c_in, fan_out=kh * kw * f),
+            "Wh": wi.init(k2, (kh, kw, f, 4 * f),
+                          fan_in=kh * kw * f, fan_out=kh * kw * f),
+            "b": jnp.zeros((4 * f,), jnp.float32)
+            .at[f: 2 * f]
+            .set(self.forget_gate_bias),
+        }
+        return params, {}
+
+    def _conv(self, x, w, stride, padding):
+        return lax.conv_general_dilated(
+            x, w, window_strides=stride, padding=padding,
+            dimension_numbers=("NHWC", "HWIO", "NHWC"),
+        )
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        x = _dropout(x, self.dropout_rate or 0.0, training, rng)
+        f = self.n_out
+        wx = params["Wx"].astype(x.dtype)
+        wh = params["Wh"].astype(x.dtype)
+        b = params["b"].astype(x.dtype)
+        pad = "SAME" if self.padding == "same" else "VALID"
+        B, T, H, W, _ = x.shape
+        oh, ow = self._out_hw(H, W)
+        sigmoid = jax.nn.sigmoid
+
+        def step(carry, xt):
+            h, c = carry
+            z = (self._conv(xt, wx, self.stride, pad)
+                 + self._conv(h, wh, (1, 1), "SAME") + b)
+            i = sigmoid(z[..., :f])
+            fg = sigmoid(z[..., f:2 * f])
+            g = jnp.tanh(z[..., 2 * f:3 * f])
+            o = sigmoid(z[..., 3 * f:])
+            c_new = fg * c + i * g
+            h_new = o * jnp.tanh(c_new)
+            return (h_new, c_new), h_new
+
+        h0 = jnp.zeros((B, oh, ow, f), x.dtype)
+        carry, ys = lax.scan(step, (h0, h0), jnp.swapaxes(x, 0, 1))
+        if self.return_sequences:
+            return jnp.swapaxes(ys, 0, 1), state
+        return carry[0], state

@@ -15,6 +15,7 @@ this script (which DOES need tensorflow) is only run to regenerate:
     python tests/goldens/generate.py
 """
 
+import json
 import os
 import sys
 
@@ -354,6 +355,138 @@ def gen_keras():
         keras.layers.Dense(2),
     ])
     save_keras("prelu_leaky", m, rng.normal(size=(4, 10)).astype(np.float32))
+
+    # --- round-4 import tail (VERDICT r3 item 6) --------------------------
+    m = keras.Sequential([
+        keras.layers.Input((7, 5)),
+        keras.layers.Bidirectional(keras.layers.LSTM(6)),
+        keras.layers.Dense(3),
+    ])
+    save_keras("bidir_lstm", m, rng.normal(size=(4, 7, 5)).astype(np.float32))
+
+    m = keras.Sequential([
+        keras.layers.Input((6, 4)),
+        keras.layers.Bidirectional(
+            keras.layers.GRU(5, reset_after=True, return_sequences=True),
+            merge_mode="sum",
+        ),
+        keras.layers.TimeDistributed(keras.layers.Dense(8, activation="relu")),
+        keras.layers.LSTM(4),
+        keras.layers.Dense(2),
+    ])
+    save_keras("bidir_gru_timedistributed", m,
+               rng.normal(size=(3, 6, 4)).astype(np.float32))
+
+    m = keras.Sequential([
+        keras.layers.Input((4, 9, 9, 1)),
+        keras.layers.ConvLSTM2D(3, 3, padding="valid", return_sequences=True,
+                                recurrent_activation="sigmoid"),
+        keras.layers.ConvLSTM2D(2, 3, padding="same",
+                                recurrent_activation="sigmoid"),
+        keras.layers.GlobalMaxPooling2D(),
+        keras.layers.Dense(2),
+    ])
+    save_keras("convlstm2d_stack", m,
+               rng.normal(size=(2, 4, 9, 9, 1)).astype(np.float32))
+
+    gen_keras1(rng)
+
+
+def gen_keras1(rng):
+    """Keras-1 legacy HDF5 fixtures.  Keras 1 cannot run in this
+    environment, so the files are WRITTEN in the K1 dialect by hand —
+    K1 model_config field names (output_dim/nb_filter/border_mode/p) and
+    K1 weight dataset names (dense_1_W, lstm_1_W_i, ...) — from a Keras-2
+    model whose real-TF output is the golden.  The K1<->K2 layer math is
+    identical (same cells, same layouts for dim_ordering='tf'), so the
+    golden is genuine; what these fixtures regression-test is the K1
+    DIALECT handling (_k1_normalize + _normalize_k1_weight_keys)."""
+    import h5py
+
+    def w(layer):
+        return [np.asarray(v) for v in layer.weights]
+
+    # --- k1_mlp_cnn: Convolution2D + MaxPooling2D + Flatten + Dense chain
+    m = keras.Sequential([
+        keras.layers.Input((8, 8, 2)),
+        keras.layers.Conv2D(4, (3, 3), padding="same", activation="relu"),
+        keras.layers.MaxPooling2D((2, 2)),
+        keras.layers.Flatten(),
+        keras.layers.Dense(10, activation="relu"),
+        keras.layers.Dropout(0.25),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    x = rng.normal(size=(5, 8, 8, 2)).astype(np.float32)
+    out = np.asarray(m(x, training=False))
+    conv, dense1, dense2 = m.layers[0], m.layers[3], m.layers[5]
+    k1_cfg = [
+        {"class_name": "Convolution2D", "config": {
+            "name": "convolution2d_1", "nb_filter": 4, "nb_row": 3,
+            "nb_col": 3, "border_mode": "same", "subsample": [1, 1],
+            "activation": "relu", "dim_ordering": "tf",
+            "batch_input_shape": [None, 8, 8, 2]}},
+        {"class_name": "MaxPooling2D", "config": {
+            "name": "maxpooling2d_1", "pool_size": [2, 2],
+            "strides": [2, 2], "border_mode": "valid",
+            "dim_ordering": "tf"}},
+        {"class_name": "Flatten", "config": {"name": "flatten_1"}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "output_dim": 10, "activation": "relu"}},
+        {"class_name": "Dropout", "config": {"name": "dropout_1", "p": 0.25}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_2", "output_dim": 3, "activation": "softmax"}},
+    ]
+    path = os.path.join(HERE, "keras", "k1_mlp_cnn.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["keras_version"] = np.bytes_(b"1.2.2")
+        f.attrs["model_config"] = np.bytes_(json.dumps(
+            {"class_name": "Sequential", "config": k1_cfg}).encode())
+        for k1name, layer in (("convolution2d_1", conv),
+                              ("dense_1", dense1), ("dense_2", dense2)):
+            g = f.create_group(k1name)
+            kw, bw = w(layer)
+            g.create_dataset(f"{k1name}_W", data=kw)
+            g.create_dataset(f"{k1name}_b", data=bw)
+    np.savez(os.path.join(HERE, "keras", "k1_mlp_cnn_io.npz"),
+             in_x=x, out_y=out)
+    print("keras/k1_mlp_cnn.h5 (hand-written Keras-1 dialect)")
+
+    # --- k1_lstm: per-gate K1 LSTM weight arrays
+    m = keras.Sequential([
+        keras.layers.Input((6, 5)),
+        keras.layers.LSTM(7),          # K2 default sigmoid gates
+        keras.layers.Dense(2),
+    ])
+    x = rng.normal(size=(3, 6, 5)).astype(np.float32)
+    out = np.asarray(m(x, training=False))
+    lstm, dense = m.layers[0], m.layers[1]
+    k1_cfg = [
+        {"class_name": "LSTM", "config": {
+            "name": "lstm_1", "output_dim": 7, "activation": "tanh",
+            "inner_activation": "sigmoid",
+            "batch_input_shape": [None, 6, 5]}},
+        {"class_name": "Dense", "config": {
+            "name": "dense_1", "output_dim": 2, "activation": "linear"}},
+    ]
+    path = os.path.join(HERE, "keras", "k1_lstm.h5")
+    with h5py.File(path, "w") as f:
+        f.attrs["keras_version"] = np.bytes_(b"1.2.2")
+        f.attrs["model_config"] = np.bytes_(json.dumps(
+            {"class_name": "Sequential", "config": k1_cfg}).encode())
+        kk, rk, b = w(lstm)
+        H = 7
+        g = f.create_group("lstm_1")
+        for i, gate in enumerate("ifco"):
+            g.create_dataset(f"lstm_1_W_{gate}", data=kk[:, i*H:(i+1)*H])
+            g.create_dataset(f"lstm_1_U_{gate}", data=rk[:, i*H:(i+1)*H])
+            g.create_dataset(f"lstm_1_b_{gate}", data=b[i*H:(i+1)*H])
+        g = f.create_group("dense_1")
+        kw, bw = w(dense)
+        g.create_dataset("dense_1_W", data=kw)
+        g.create_dataset("dense_1_b", data=bw)
+    np.savez(os.path.join(HERE, "keras", "k1_lstm_io.npz"),
+             in_x=x, out_y=out)
+    print("keras/k1_lstm.h5 (hand-written Keras-1 dialect, per-gate LSTM)")
 
 
 if __name__ == "__main__":

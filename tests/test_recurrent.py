@@ -472,3 +472,94 @@ def test_tbptt_rejects_seq_to_one():
     y = np.eye(12, dtype=np.float32)[[0, 1]]
     with pytest.raises(ValueError, match="per-timestep output"):
         m.fit_batch(DataSet(x, y))
+
+
+class TestRound4RecurrentAdditions:
+    """TimeDistributed, ConvLSTM2D, Bidirectional(return_sequences=False)."""
+
+    def test_time_distributed_dense_trains(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn.conf import (
+            InputType, LSTM, LastTimeStep, NeuralNetConfiguration,
+            OutputLayer, TimeDistributed,
+        )
+        from deeplearning4j_tpu.nn.conf.layers import Dense
+
+        conf = (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(TimeDistributed(layer=Dense(n_out=8)))
+                .layer(LSTM(n_out=6))
+                .layer(LastTimeStep())
+                .layer(OutputLayer(n_out=2))
+                .set_input_type(InputType.recurrent(4, 5))
+                .build())
+        model = SequentialModel(conf).init()
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(6, 5, 4)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 6)]
+        s0 = None
+        for _ in range(5):
+            model.fit_batch(DataSet(x, y))
+            s0 = model.score_value if s0 is None else s0
+        assert model.score_value < s0    # loss moves
+        assert model.output(x).shape == (6, 2)
+
+    def test_time_distributed_rejects_rnn_inner(self):
+        import pytest
+
+        from deeplearning4j_tpu.nn.conf import LSTM, TimeDistributed
+
+        with pytest.raises(ValueError, match="feed-forward"):
+            TimeDistributed(layer=LSTM(n_out=3))
+
+    def test_convlstm2d_shapes_and_training(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn.conf import (
+            ConvLSTM2D, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.conf.layers import GlobalPooling
+
+        conf = (NeuralNetConfiguration.builder().seed(0).list()
+                .layer(ConvLSTM2D(n_out=4, kernel=(3, 3), padding="same",
+                                  return_sequences=False))
+                .layer(GlobalPooling())
+                .layer(OutputLayer(n_out=3))
+                .set_input_type(InputType.convolutional3d(5, 8, 8, 2))
+                .build())
+        model = SequentialModel(conf).init()
+        rng = np.random.default_rng(1)
+        x = rng.normal(size=(2, 5, 8, 8, 2)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 2)]
+        model.fit_batch(DataSet(x, y))
+        assert np.isfinite(model.score_value)
+        assert model.output(x).shape == (2, 3)
+
+    def test_bidirectional_last_step_vs_sequences(self):
+        import numpy as np
+
+        import jax.numpy as jnp
+
+        from deeplearning4j_tpu.nn.conf import LSTM, Bidirectional
+
+        lstm = LSTM(name="i", n_out=3)
+        seq = Bidirectional(name="b", layer=lstm, return_sequences=True)
+        last = Bidirectional(name="b2", layer=lstm,
+                             return_sequences=False)
+        import jax
+
+        from deeplearning4j_tpu.nn.conf.input_type import InputType
+
+        params, _ = seq.init(jax.random.key(0), InputType.recurrent(4, 6))
+        x = jnp.asarray(
+            np.random.default_rng(2).normal(size=(2, 6, 4)).astype(np.float32))
+        ys, _ = seq.apply(params, {}, x)
+        yl, _ = last.apply(params, {}, x)
+        assert ys.shape == (2, 6, 6) and yl.shape == (2, 6)
+        # fwd half collapses at T-1, bwd half at 0 (keras semantics)
+        np.testing.assert_allclose(yl[:, :3], ys[:, -1, :3], atol=1e-6)
+        np.testing.assert_allclose(yl[:, 3:], ys[:, 0, 3:], atol=1e-6)
