@@ -1221,3 +1221,531 @@ def get_op(name: str):
     if name not in OPS:
         raise KeyError(f"unknown autodiff op {name!r}; known: {sorted(OPS)}")
     return OPS[name]
+
+
+# ---------------------------------------------------------------------------
+# Round-4 op tail — pushes the registry toward the reference's ~500
+# declarable ops (SURVEY.md §2.1).  Everything here is static-shape,
+# jit-safe, and differentiable where the reference's op is.
+
+
+def _ctc_loss(logits, labels, *, logit_lengths=None, label_lengths=None,
+              blank=0):
+    """Connectionist temporal classification loss (reference `ctc_loss`,
+    speech stacks).  logits (B,T,C) unnormalized; labels (B,S) int ids.
+    Standard log-alpha forward recursion over the blank-interleaved label
+    string, as one lax.scan — differentiable, so the gradient is the full
+    CTC posterior (no custom backward needed)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    B, T, C = logits.shape
+    S = labels.shape[1]
+    labels = labels.astype(jnp.int32)
+    if logit_lengths is None:
+        logit_lengths = jnp.full((B,), T, jnp.int32)
+    if label_lengths is None:
+        label_lengths = jnp.full((B,), S, jnp.int32)
+    logit_lengths = logit_lengths.astype(jnp.int32)
+    label_lengths = label_lengths.astype(jnp.int32)
+    L = 2 * S + 1
+    ext = jnp.full((B, L), blank, jnp.int32).at[:, 1::2].set(labels)
+    NEG = jnp.float32(-1e30)
+
+    # skip transition s-2 -> s allowed when ext[s] is a label differing
+    # from ext[s-2]
+    if L >= 3:
+        prev2 = jnp.pad(ext[:, :-2], ((0, 0), (2, 0)), constant_values=-1)
+    else:
+        prev2 = jnp.full_like(ext, -1)
+    can_skip = (ext != blank) & (ext != prev2)
+
+    emit0 = jnp.take_along_axis(logp[:, 0], ext, axis=-1)      # (B, L)
+    pos = jnp.arange(L)[None, :]
+    alpha = jnp.where(pos <= 1, emit0, NEG)
+    if S == 0:
+        alpha = jnp.where(pos == 0, emit0, NEG)
+
+    def lse(a, b):
+        m = jnp.maximum(a, b)
+        return m + jnp.log1p(jnp.exp(jnp.minimum(a, b) - m))
+
+    def step(alpha, inp):
+        logp_t, t = inp
+        shift1 = jnp.pad(alpha[:, :-1], ((0, 0), (1, 0)), constant_values=NEG)
+        # L<3 (empty label string): no skip transitions exist, and the
+        # pad-by-2 would widen the scan carry from (B,1) to (B,2)
+        shift2 = (
+            jnp.pad(alpha[:, :-2], ((0, 0), (2, 0)), constant_values=NEG)
+            if L >= 3 else jnp.full_like(alpha, NEG)
+        )
+        acc = lse(alpha, shift1)
+        acc = jnp.where(can_skip, lse(acc, shift2), acc)
+        emit = jnp.take_along_axis(logp_t, ext, axis=-1)
+        new = acc + emit
+        # past each example's input length the recursion freezes
+        live = (t < logit_lengths)[:, None]
+        return jnp.where(live, new, alpha), None
+
+    ts = jnp.arange(1, T)
+    alpha, _ = jax.lax.scan(step, alpha, (jnp.swapaxes(logp, 0, 1)[1:], ts))
+    last = 2 * label_lengths - 1                                # final label
+    final = lse(
+        jnp.take_along_axis(alpha, jnp.maximum(last, 0)[:, None], axis=1)[:, 0],
+        jnp.take_along_axis(alpha, (last + 1)[:, None], axis=1)[:, 0],
+    )
+    # degenerate empty-label case: all-blank path only
+    final = jnp.where(label_lengths == 0, alpha[:, 0], final)
+    return jnp.mean(-final)
+
+
+def _ctc_greedy_decode(logits, *, blank=0, pad=-1):
+    """Best-path decode: argmax per frame, collapse repeats, drop blanks.
+    Static shapes: returns (B,T) padded with `pad`; pair with
+    ctc_greedy_decode_lengths."""
+    ids = jnp.argmax(logits, axis=-1).astype(jnp.int32)          # (B,T)
+    prev = jnp.pad(ids[:, :-1], ((0, 0), (1, 0)), constant_values=-1)
+    keep = (ids != blank) & (ids != prev)
+    pos = jnp.cumsum(keep.astype(jnp.int32), axis=1) - 1
+    B, T = ids.shape
+    out = jnp.full((B, T), pad, jnp.int32)
+    rows = jnp.broadcast_to(jnp.arange(B)[:, None], (B, T))
+    # masked scatter: dead slots all write (harmlessly) to column 0 of a
+    # dummy row appended then dropped
+    safe_pos = jnp.where(keep, pos, T)
+    out = jnp.pad(out, ((0, 0), (0, 1)), constant_values=pad)
+    out = out.at[rows, safe_pos].set(jnp.where(keep, ids, pad))
+    return out[:, :T]
+
+
+def _max_pool_patches(x, kernel, stride, padding):
+    """(values, flat_spatial_index) window stacks via static slicing."""
+    B, H, W, C = x.shape
+    kh, kw = kernel
+    sh, sw = stride
+    if padding == "SAME":
+        oh, ow = -(-H // sh), -(-W // sw)
+        ph = max((oh - 1) * sh + kh - H, 0)
+        pw = max((ow - 1) * sw + kw - W, 0)
+        x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                        (pw // 2, pw - pw // 2), (0, 0)),
+                    constant_values=-jnp.inf)
+        off_h, off_w = -(ph // 2), -(pw // 2)
+    else:
+        oh, ow = (H - kh) // sh + 1, (W - kw) // sw + 1
+        off_h = off_w = 0
+    vals, idxs = [], []
+    for i in range(kh):
+        for j in range(kw):
+            sub = x[:, i:i + (oh - 1) * sh + 1:sh,
+                    j:j + (ow - 1) * sw + 1:sw, :]
+            vals.append(sub)
+            y = jnp.arange(oh) * sh + i + off_h
+            z = jnp.arange(ow) * sw + j + off_w
+            flat = y[:, None] * W + z[None, :]
+            idxs.append(jnp.broadcast_to(flat[None, :, :, None],
+                                         sub.shape))
+    return jnp.stack(vals), jnp.stack(idxs), (B, oh, ow, C)
+
+
+def _max_pool_with_argmax_indices(x, *, kernel=(2, 2), stride=(2, 2),
+                                  padding="VALID",
+                                  include_batch_in_index=False):
+    """TF-convention flat indices of the max: ((b*H+)y*W + x)*C + c."""
+    B, H, W, C = x.shape
+    vals, idxs, _ = _max_pool_patches(x, kernel, stride, padding)
+    best = jnp.argmax(vals, axis=0)
+    spatial = jnp.take_along_axis(idxs, best[None], axis=0)[0]
+    c = jnp.arange(C)[None, None, None, :]
+    flat = spatial * C + c
+    if include_batch_in_index:
+        flat = flat + (jnp.arange(B) * H * W * C)[:, None, None, None]
+    return flat.astype(jnp.int32)
+
+
+def _dilation2d(x, filt, *, stride=(1, 1), padding="SAME"):
+    """Grayscale morphological dilation (reference `dilation2d`):
+    out = max_{ij} x[..y+i, x+j..] + filt[i,j,c]."""
+    B, H, W, C = x.shape
+    kh, kw, _ = filt.shape
+    sh, sw = stride
+    if padding == "SAME":
+        oh, ow = -(-H // sh), -(-W // sw)
+        ph = max((oh - 1) * sh + kh - H, 0)
+        pw = max((ow - 1) * sw + kw - W, 0)
+        x = jnp.pad(x, ((0, 0), (ph // 2, ph - ph // 2),
+                        (pw // 2, pw - pw // 2), (0, 0)),
+                    constant_values=-jnp.inf)
+    else:
+        oh, ow = (H - kh) // sh + 1, (W - kw) // sw + 1
+    acc = None
+    for i in range(kh):
+        for j in range(kw):
+            sub = x[:, i:i + (oh - 1) * sh + 1:sh,
+                    j:j + (ow - 1) * sw + 1:sw, :] + filt[i, j]
+            acc = sub if acc is None else jnp.maximum(acc, sub)
+    return acc
+
+
+def _erosion2d(x, filt, *, stride=(1, 1), padding="SAME"):
+    return -_dilation2d(-x, filt[::-1, ::-1], stride=stride, padding=padding)
+
+
+def _col2im(cols, *, input_shape, kernel, stride=(1, 1)):
+    """Adjoint of im2col: overlap-add patches back to the image — exactly
+    the linear transpose of the patch extraction XLA already knows."""
+    x0 = jnp.zeros(tuple(input_shape), cols.dtype)
+    f = lambda img: OPS["im2col"](img, kernel=tuple(kernel),
+                                  stride=tuple(stride))
+    (out,) = jax.linear_transpose(f, x0)(cols)
+    return out
+
+
+def _iou_matrix(a, b):
+    """Pairwise IoU of (N,4) and (M,4) [y1,x1,y2,x2] boxes -> (N,M)."""
+    area = lambda z: jnp.maximum(z[:, 2] - z[:, 0], 0) * jnp.maximum(
+        z[:, 3] - z[:, 1], 0)
+    tl = jnp.maximum(a[:, None, :2], b[None, :, :2])
+    br = jnp.minimum(a[:, None, 2:], b[None, :, 2:])
+    wh = jnp.maximum(br - tl, 0.0)
+    inter = wh[..., 0] * wh[..., 1]
+    union = area(a)[:, None] + area(b)[None, :] - inter
+    return inter / jnp.maximum(union, 1e-9)
+
+
+def _instance_norm(x, gamma, beta, *, epsilon=1e-5):
+    axes = tuple(range(1, x.ndim - 1))
+    mu = jnp.mean(x, axis=axes, keepdims=True)
+    var = jnp.var(x, axis=axes, keepdims=True)
+    return (x - mu) * jax.lax.rsqrt(var + epsilon) * gamma + beta
+
+
+def _group_norm(x, gamma, beta, *, groups, epsilon=1e-5):
+    shp = x.shape
+    C = shp[-1]
+    g = x.reshape(shp[:-1] + (groups, C // groups))
+    axes = tuple(range(1, x.ndim - 1)) + (x.ndim,)
+    mu = jnp.mean(g, axis=axes, keepdims=True)
+    var = jnp.var(g, axis=axes, keepdims=True)
+    g = (g - mu) * jax.lax.rsqrt(var + epsilon)
+    return g.reshape(shp) * gamma + beta
+
+
+def _lrn(x, *, depth_radius=5, bias=1.0, alpha=1.0, beta=0.5):
+    sq = jnp.square(x)
+    pad = jnp.pad(sq, [(0, 0)] * (x.ndim - 1) + [(depth_radius, depth_radius)])
+    window = sum(
+        pad[..., i:i + x.shape[-1]] for i in range(2 * depth_radius + 1)
+    )
+    return x / jnp.power(bias + alpha * window, beta)
+
+
+def _dot_product_attention(q, k, v, *, mask=None, causal=False):
+    d = q.shape[-1]
+    s = jnp.einsum("...qd,...kd->...qk", q, k) / jnp.sqrt(
+        jnp.asarray(d, q.dtype))
+    if causal:
+        T, S = s.shape[-2], s.shape[-1]
+        cm = jnp.tril(jnp.ones((T, S), bool))
+        s = jnp.where(cm, s, jnp.asarray(-1e30, s.dtype))
+    if mask is not None:
+        s = jnp.where(mask.astype(bool), s, jnp.asarray(-1e30, s.dtype))
+    return jnp.einsum("...qk,...kd->...qd", jax.nn.softmax(s, axis=-1), v)
+
+
+def _multi_head_attention(x, wq, wk, wv, wo, *, heads, causal=False):
+    B, T, D = x.shape
+    dh = D // heads
+    split = lambda z: z.reshape(B, T, heads, dh).transpose(0, 2, 1, 3)
+    q, k, v = split(x @ wq), split(x @ wk), split(x @ wv)
+    o = _dot_product_attention(q, k, v, causal=causal)
+    return o.transpose(0, 2, 1, 3).reshape(B, T, D) @ wo
+
+
+def _mixture_density_loss(params, target, *, components):
+    """Negative log likelihood of an isotropic gaussian mixture (the
+    reference's LossMixtureDensity).  params (B, K*(2D+1)) packed as
+    [logit_pi(K), mu(K*D), log_sigma(K*D)]; target (B, D)."""
+    B, D = target.shape
+    K = components
+    logit_pi = params[:, :K]
+    mu = params[:, K:K + K * D].reshape(B, K, D)
+    log_sig = params[:, K + K * D:].reshape(B, K, D)
+    log_pi = jax.nn.log_softmax(logit_pi, axis=-1)
+    z = (target[:, None, :] - mu) * jnp.exp(-log_sig)
+    comp = (
+        -0.5 * jnp.sum(jnp.square(z), axis=-1)
+        - jnp.sum(log_sig, axis=-1)
+        - 0.5 * D * jnp.log(2 * jnp.pi)
+    )
+    return jnp.mean(-jax.scipy.special.logsumexp(log_pi + comp, axis=-1))
+
+
+_RGB_YIQ = jnp.array([[0.299, 0.587, 0.114],
+                      [0.59590059, -0.27455667, -0.32134392],
+                      [0.21153661, -0.52273617, 0.31119955]], jnp.float32)
+_RGB_YUV = jnp.array([[0.299, 0.587, 0.114],
+                      [-0.14714119, -0.28886916, 0.43601035],
+                      [0.61497538, -0.51496512, -0.10001026]], jnp.float32)
+
+
+def _colorspace(mat):
+    def fwd(x):
+        return x @ mat.T.astype(x.dtype)
+
+    return fwd
+
+
+def _resize(method):
+    def fn(x, *, size):
+        shape = (x.shape[0], int(size[0]), int(size[1]), x.shape[3])
+        return jax.image.resize(x, shape, method=method)
+
+    return fn
+
+
+OPS.update({
+    # --- CTC family (speech; SURVEY §2.1 declarable-op tail) ---
+    "ctc_loss": _ctc_loss,
+    "ctc_greedy_decode": _ctc_greedy_decode,
+    "ctc_greedy_decode_lengths": lambda logits, *, blank=0: jnp.sum(
+        (jnp.argmax(logits, -1) != blank)
+        & (jnp.argmax(logits, -1) != jnp.pad(
+            jnp.argmax(logits, -1)[:, :-1], ((0, 0), (1, 0)),
+            constant_values=-1)),
+        axis=1,
+    ).astype(jnp.int32),
+    # --- morphology / argmax pooling ---
+    "dilation2d": _dilation2d,
+    "erosion2d": _erosion2d,
+    "max_pool_with_argmax": lambda x, *, kernel=(2, 2), stride=(2, 2),
+    padding="VALID": jnp.max(
+        _max_pool_patches(x, tuple(kernel), tuple(stride), padding)[0],
+        axis=0,
+    ),
+    "max_pool_with_argmax_indices": _max_pool_with_argmax_indices,
+    # --- image tail 2 ---
+    "rgb_to_yiq": _colorspace(_RGB_YIQ),
+    "yiq_to_rgb": _colorspace(jnp.linalg.inv(_RGB_YIQ)),
+    "rgb_to_yuv": _colorspace(_RGB_YUV),
+    "yuv_to_rgb": _colorspace(jnp.linalg.inv(_RGB_YUV)),
+    "resize_bilinear": _resize("bilinear"),
+    "resize_nearest": _resize("nearest"),
+    "resize_bicubic": _resize("bicubic"),
+    "mirror_pad": lambda x, *, paddings, mode="REFLECT": jnp.pad(
+        x, [tuple(p) for p in paddings],
+        mode="reflect" if str(mode).upper() == "REFLECT" else "symmetric",
+    ),
+    "upsampling2d": lambda x, *, factor=(2, 2): jnp.repeat(
+        jnp.repeat(x, factor[0], axis=1), factor[1], axis=2
+    ),
+    "iou": _iou_matrix,
+    "col2im": _col2im,
+    "random_crop": lambda x, *, size, seed=0: jax.lax.dynamic_slice(
+        x,
+        tuple(
+            jax.random.randint(
+                jax.random.key(seed), (len(size),), 0,
+                jnp.array([d - s + 1 for d, s in zip(x.shape, size)]),
+            )
+        ),
+        tuple(size),
+    ),
+    # --- activations / nn tail ---
+    "hardswish": lambda x: x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0,
+    "softmin": lambda x, *, axis=-1: jax.nn.softmax(-x, axis=_ax(axis)),
+    "rectifiedtanh": lambda x: jnp.maximum(jnp.tanh(x), 0.0),
+    "relu_layer": lambda x, w, b: jax.nn.relu(x @ w + b),
+    "alpha_dropout": lambda x, *, rate=0.5, seed=0: (
+        # SELU-preserving dropout (reference AlphaDropout): affine fixup
+        # keeps self-normalizing mean/var
+        (lambda keep, a_: (
+            (jnp.where(keep, x, a_)
+             * (1.0 / jnp.sqrt((1 - rate) * (1 + rate * a_ ** 2))))
+            + (-(1.0 / jnp.sqrt((1 - rate) * (1 + rate * a_ ** 2)))
+               * rate * a_)
+        ))(
+            jax.random.bernoulli(jax.random.key(seed), 1.0 - rate, x.shape),
+            -1.7580993408473766,
+        )
+    ),
+    # --- norms ---
+    "instance_norm": _instance_norm,
+    "group_norm": _group_norm,
+    "local_response_normalization": _lrn,
+    "l2_normalize": lambda x, *, axis=-1, epsilon=1e-12: x * jax.lax.rsqrt(
+        jnp.maximum(jnp.sum(jnp.square(x), axis=_ax(axis), keepdims=True),
+                    epsilon)
+    ),
+    "normalize_moments": lambda count, mean_ss, var_ss, *, shift=0.0: (
+        jnp.stack([
+            mean_ss / count + shift,
+            var_ss / count - jnp.square(mean_ss / count),
+        ])
+    ),
+    "clip_by_avg_norm": lambda x, *, clip_norm: x * jnp.minimum(
+        1.0,
+        # TF/libnd4j "average norm" is l2/N, NOT the RMS l2/sqrt(N)
+        clip_norm / jnp.maximum(
+            jnp.sqrt(jnp.sum(jnp.square(x))) / x.size, 1e-12),
+    ),
+    # --- attention ---
+    "dot_product_attention": _dot_product_attention,
+    "multi_head_attention": _multi_head_attention,
+    # --- loss-function parity (reference LossFunctions) ---
+    "mae_loss": lambda pred, lab: jnp.mean(jnp.abs(pred - lab)),
+
+    "mape_loss": lambda pred, lab: jnp.mean(
+        jnp.abs((lab - pred) / jnp.maximum(jnp.abs(lab), 1e-8))) * 100.0,
+    "msle_loss": lambda pred, lab: jnp.mean(
+        jnp.square(jnp.log1p(jnp.maximum(pred, -1 + 1e-7))
+                   - jnp.log1p(jnp.maximum(lab, -1 + 1e-7)))),
+    "squared_hinge_loss": lambda pred, lab: jnp.mean(
+        jnp.square(jnp.maximum(0.0, 1.0 - lab * pred))),
+    "kld_loss": lambda pred, lab: jnp.mean(jnp.sum(
+        lab * (jnp.log(jnp.maximum(lab, 1e-12))
+               - jnp.log(jnp.maximum(pred, 1e-12))), axis=-1)),
+    "wasserstein_loss": lambda pred, lab: jnp.mean(pred * lab),
+    "multi_label_loss": lambda logits, labels: jnp.mean(
+        jnp.maximum(logits, 0) - logits * labels
+        + jnp.log1p(jnp.exp(-jnp.abs(logits)))),
+    "fmeasure_loss": lambda pred, lab, *, beta=1.0: 1.0 - (
+        (1 + beta ** 2) * jnp.sum(pred * lab)
+        / jnp.maximum(
+            beta ** 2 * jnp.sum(lab) + jnp.sum(pred), 1e-8)
+    ),
+    "focal_loss": lambda logits, labels, *, gamma=2.0, alpha=0.25: jnp.mean(
+        -labels * alpha
+        * jnp.power(1 - jax.nn.sigmoid(logits), gamma)
+        * jax.nn.log_sigmoid(logits)
+        - (1 - labels) * (1 - alpha)
+        * jnp.power(jax.nn.sigmoid(logits), gamma)
+        * jax.nn.log_sigmoid(-logits)
+    ),
+    "dice_loss": lambda pred, lab, *, smooth=1.0: 1.0 - (
+        (2.0 * jnp.sum(pred * lab) + smooth)
+        / (jnp.sum(jnp.square(pred)) + jnp.sum(jnp.square(lab)) + smooth)
+    ),
+    "log_poisson_loss": lambda logits, targets, *, compute_full_loss=False: (
+        jnp.mean(
+            jnp.exp(logits) - targets * logits
+            # Stirling term only where it approximates log(target!) at all
+            # (TF zeroes it for targets <= 1, where log(0!) = log(1!) = 0)
+            + (jnp.where(
+                targets > 1.0,
+                targets * jnp.log(jnp.maximum(targets, 1e-12)) - targets
+                + 0.5 * jnp.log(2 * jnp.pi * jnp.maximum(targets, 1e-12)),
+                0.0,
+            ) if compute_full_loss else 0.0)
+        )
+    ),
+    "mean_pairwise_squared_error": lambda pred, lab: (
+        # TF defn per example over the n per-element deltas d:
+        # mean_{i<j}(d_i-d_j)^2 = 2*(n*sum d^2 - (sum d)^2) / (n*(n-1))
+        (lambda d: (lambda n: jnp.mean(
+            2.0 * (n * jnp.sum(jnp.square(d), axis=-1)
+                   - jnp.square(jnp.sum(d, axis=-1)))
+            / jnp.maximum(n * (n - 1), 1.0)
+        ))(jnp.asarray(d.shape[1], jnp.float32)))
+        ((pred - lab).reshape(pred.shape[0], -1))
+    ),
+    "cosine_embedding_loss": lambda a, b, y, *, margin=0.0: jnp.mean(
+        jnp.where(
+            y > 0,
+            1.0 - OPS["cosine_similarity"](a, b, axis=-1),
+            jnp.maximum(0.0, OPS["cosine_similarity"](a, b, axis=-1)
+                        - margin),
+        )
+    ),
+    "margin_ranking_loss": lambda x1, x2, y, *, margin=0.0: jnp.mean(
+        jnp.maximum(0.0, -y * (x1 - x2) + margin)),
+    "triplet_margin_loss": lambda anchor, pos, neg, *, margin=1.0: jnp.mean(
+        jnp.maximum(
+            0.0,
+            jnp.sqrt(jnp.sum(jnp.square(anchor - pos), -1) + 1e-12)
+            - jnp.sqrt(jnp.sum(jnp.square(anchor - neg), -1) + 1e-12)
+            + margin,
+        )
+    ),
+    "nll_loss": lambda logp, labels: -jnp.mean(
+        jnp.take_along_axis(logp, labels[..., None].astype(jnp.int32),
+                            axis=-1)),
+    "mixture_density_loss": _mixture_density_loss,
+    # --- math / array tail ---
+    "erfcinv": lambda x: jax.scipy.special.erfinv(1.0 - x),
+    "fmod": jnp.fmod,
+    "trace": lambda x: jnp.trace(x, axis1=-2, axis2=-1),
+    "matrix_diag_part": lambda x: jnp.diagonal(x, axis1=-2, axis2=-1),
+    "choose": lambda idx, x: jnp.choose(idx.astype(jnp.int32), x,
+                                        mode="clip"),
+    "nth_element": lambda x, *, n, reverse=False: (
+        jnp.sort(x, axis=-1)[..., x.shape[-1] - 1 - n]
+        if reverse else jnp.sort(x, axis=-1)[..., n]
+    ),
+    "kth_value": lambda x, *, k: jnp.sort(x, axis=-1)[..., k - 1],
+    "in_top_k": lambda predictions, targets, *, k: (
+        # TF tie semantics: only STRICTLY greater entries spend the budget
+        jnp.sum(
+            (predictions
+             > jnp.take_along_axis(
+                 predictions, targets[:, None].astype(jnp.int32), axis=-1
+             )).astype(jnp.int32),
+            axis=-1,
+        ) < k
+    ),
+    "embedding_lookup": lambda table, ids: jnp.take(
+        table, ids.astype(jnp.int32), axis=0),
+    "tensor_scatter_update": lambda x, indices, updates: jnp.asarray(x).at[
+        tuple(jnp.moveaxis(jnp.asarray(indices, jnp.int32), -1, 0))
+    ].set(updates),
+    "tensor_scatter_add": lambda x, indices, updates: jnp.asarray(x).at[
+        tuple(jnp.moveaxis(jnp.asarray(indices, jnp.int32), -1, 0))
+    ].add(updates),
+    "matmul_transpose": lambda a, b, *, transpose_a=False, transpose_b=False:
+        jnp.matmul(
+            jnp.swapaxes(a, -1, -2) if transpose_a else a,
+            jnp.swapaxes(b, -1, -2) if transpose_b else b,
+        ),
+    "flatten_2d": lambda x: x.reshape(x.shape[0], -1),
+    "reshape_as": lambda x, ref: x.reshape(ref.shape),
+    "meshgrid_x": lambda x, y: jnp.meshgrid(x, y, indexing="xy")[0],
+    "meshgrid_y": lambda x, y: jnp.meshgrid(x, y, indexing="xy")[1],
+    "population_count": lambda x: jax.lax.population_count(
+        x.astype(jnp.uint32)).astype(jnp.int32),
+    "bitcast": lambda x, *, dtype: jax.lax.bitcast_convert_type(
+        x, jnp.dtype(dtype)),
+    # --- complex support (XLA complex64) ---
+    "complex": jax.lax.complex,
+    "conj": jnp.conj,
+})
+
+OPS["softmax_cross_entropy_with_logits"] = OPS["softmax_cross_entropy"]
+OPS["mean_squared_error"] = OPS["mse_loss"]
+OPS["batch_matmul"] = OPS["matmul"]
+OPS["truncated_normal"] = OPS["random_truncated_normal"]
+OPS["cross_entropy_loss"] = OPS["sparse_softmax_cross_entropy"]
+OPS["histogram"] = OPS["histogram_fixed_width"]
+OPS["top_k"] = OPS["top_k_values"]
+OPS["cyclic_shift"] = OPS["roll"]
+OPS["squared_hinge"] = OPS["squared_hinge_loss"]
+
+OPS.update({
+    "matrix_inverse": jnp.linalg.inv,
+    "log2": jnp.log2,
+    "log10": jnp.log10,
+    "exp2": jnp.exp2,
+    "frac": lambda x: x - jnp.trunc(x),
+    "remainder": jnp.remainder,
+    "gcd": jnp.gcd,
+    "lcm": jnp.lcm,
+    "swapaxes": lambda x, *, axis1, axis2: jnp.swapaxes(x, axis1, axis2),
+    "moveaxis": lambda x, *, source, destination: jnp.moveaxis(
+        x, source, destination),
+    "flip_left_right": lambda x: jnp.flip(x, axis=-2),
+    "flip_up_down": lambda x: jnp.flip(x, axis=-3),
+    "adjust_gamma": lambda x, *, gamma=1.0, gain=1.0: gain * jnp.power(
+        jnp.maximum(x, 0.0), gamma),
+    "take_along_axis": lambda x, idx, *, axis=-1: jnp.take_along_axis(
+        x, idx.astype(jnp.int32), axis=axis),
+    "put_along_axis": lambda x, idx, vals, *, axis=-1: jnp.put_along_axis(
+        x, idx.astype(jnp.int32), vals, axis=axis, inplace=False),
+    "array_equal": lambda a, b: jnp.all(a == b),
+})

@@ -552,3 +552,265 @@ class TestRegistryTail2:
         np.testing.assert_allclose(hs, [-2.0, 0.0, 0.0, 2.0])
         ts = _np(OPS["tanhshrink"](x))
         np.testing.assert_allclose(ts, x - np.tanh(x), atol=1e-6)
+
+
+# --- round-4 op tail --------------------------------------------------------
+
+
+class TestCtcFamily:
+    def _brute_force_ctc(self, logp, labels, blank=0):
+        """Exact -log P(labels) by enumerating ALL alignment paths."""
+        import itertools
+
+        T, C = logp.shape
+        total = 0.0
+        for path in itertools.product(range(C), repeat=T):
+            # collapse path -> label
+            out = []
+            prev = -1
+            for s in path:
+                if s != prev and s != blank:
+                    out.append(s)
+                prev = s
+            if out == list(labels):
+                total += np.exp(sum(logp[t, s] for t, s in enumerate(path)))
+        return -np.log(total)
+
+    def test_ctc_loss_matches_brute_force(self):
+        rng = np.random.default_rng(0)
+        T, C = 5, 3
+        logits = rng.normal(size=(1, T, C)).astype(np.float32)
+        labels = np.array([[1, 2]], np.int32)
+        got = float(_np(OPS["ctc_loss"](logits, labels)))
+        logp = np.asarray(logits[0]) - np.log(
+            np.exp(logits[0]).sum(-1, keepdims=True))
+        want = self._brute_force_ctc(logp, [1, 2])
+        assert got == pytest.approx(want, abs=1e-4)
+
+    def test_ctc_loss_repeated_label_needs_blank(self):
+        # labels [1,1]: paths must insert a blank between the 1s
+        rng = np.random.default_rng(1)
+        logits = rng.normal(size=(1, 4, 2)).astype(np.float32)
+        labels = np.array([[1, 1]], np.int32)
+        got = float(_np(OPS["ctc_loss"](logits, labels, blank=0)))
+        logp = np.asarray(logits[0]) - np.log(
+            np.exp(logits[0]).sum(-1, keepdims=True))
+        want = self._brute_force_ctc(logp, [1, 1])
+        assert got == pytest.approx(want, abs=1e-4)
+
+    def test_ctc_loss_finite_difference_grad(self):
+        import jax
+
+        rng = np.random.default_rng(2)
+        logits = rng.normal(size=(2, 6, 4)).astype(np.float64)
+        labels = np.array([[1, 2, 3], [2, 2, 1]], np.int32)
+
+        f = lambda lg: OPS["ctc_loss"](lg, labels)
+        g = np.asarray(jax.grad(lambda lg: f(lg))(logits.astype(np.float32)))
+        eps = 1e-3
+        for idx in [(0, 0, 1), (1, 3, 2), (0, 5, 0)]:
+            lp = logits.copy()
+            lp[idx] += eps
+            lm = logits.copy()
+            lm[idx] -= eps
+            fd = (float(_np(f(lp.astype(np.float32))))
+                  - float(_np(f(lm.astype(np.float32))))) / (2 * eps)
+            assert g[idx] == pytest.approx(fd, abs=5e-3), idx
+
+    def test_ctc_loss_respects_lengths(self):
+        rng = np.random.default_rng(3)
+        logits = rng.normal(size=(1, 6, 3)).astype(np.float32)
+        labels = np.array([[1, 2, 0]], np.int32)   # padded to S=3
+        short = float(_np(OPS["ctc_loss"](
+            logits, labels,
+            logit_lengths=np.array([4]), label_lengths=np.array([2]))))
+        # identical to trimming by hand
+        trimmed = float(_np(OPS["ctc_loss"](
+            logits[:, :4], labels[:, :2])))
+        assert short == pytest.approx(trimmed, abs=1e-5)
+
+    def test_ctc_loss_empty_labels_all_blank_path(self):
+        # S=0: loss is -log P(all-blank); uniform logits -> T*log(C)
+        z = float(_np(OPS["ctc_loss"](
+            np.zeros((2, 4, 3), np.float32), np.zeros((2, 0), np.int32))))
+        assert z == pytest.approx(4 * np.log(3.0), abs=1e-4)
+
+    def test_in_top_k_tie_semantics(self):
+        # TF: only strictly-greater entries spend the top-k budget
+        p = np.array([[1.0, 1.0, 1.0]], np.float32)
+        assert bool(_np(OPS["in_top_k"](p, np.array([0]), k=1))[0])
+
+    def test_ctc_greedy_decode(self):
+        # frames argmax to [1,1,0,2,2] -> collapse -> [1,2]
+        logits = np.full((1, 5, 3), -5.0, np.float32)
+        for t, c in enumerate([1, 1, 0, 2, 2]):
+            logits[0, t, c] = 5.0
+        out = _np(OPS["ctc_greedy_decode"](logits))
+        n = _np(OPS["ctc_greedy_decode_lengths"](logits))
+        assert n[0] == 2
+        assert list(out[0][:2]) == [1, 2]
+        assert all(v == -1 for v in out[0][2:])
+
+
+class TestMorphologyAndArgmaxPool:
+    def test_dilation_erosion_manual(self):
+        x = np.zeros((1, 3, 3, 1), np.float32)
+        x[0, 1, 1, 0] = 1.0
+        filt = np.zeros((3, 3, 1), np.float32)
+        d = _np(OPS["dilation2d"](x, filt, padding="SAME"))
+        assert d[0, :, :, 0] == pytest.approx(np.ones((3, 3)))  # max spreads
+        e = _np(OPS["erosion2d"](d, filt, padding="SAME"))
+        assert e[0, 1, 1, 0] == pytest.approx(1.0)
+
+    def test_max_pool_with_argmax_tf_indices(self):
+        x = np.arange(16, dtype=np.float32).reshape(1, 4, 4, 1)
+        v = _np(OPS["max_pool_with_argmax"](x, kernel=(2, 2), stride=(2, 2)))
+        idx = _np(OPS["max_pool_with_argmax_indices"](
+            x, kernel=(2, 2), stride=(2, 2)))
+        np.testing.assert_allclose(v[0, :, :, 0], [[5, 7], [13, 15]])
+        # TF flat index (y*W + x)*C + c
+        assert idx[0, :, :, 0].tolist() == [[5, 7], [13, 15]]
+
+    def test_col2im_is_adjoint_of_im2col(self):
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 5, 5, 3)).astype(np.float32)
+        cols = _np(OPS["im2col"](jnp.asarray(x), kernel=(3, 3)))
+        y = rng.normal(size=cols.shape).astype(np.float32)
+        back = _np(OPS["col2im"](jnp.asarray(y), input_shape=x.shape,
+                                 kernel=(3, 3)))
+        # <im2col(x), y> == <x, col2im(y)>
+        assert float((cols * y).sum()) == pytest.approx(
+            float((x * back).sum()), rel=1e-4)
+
+
+class TestLossParityTail:
+    def test_loss_values(self):
+        p = np.array([[0.8, 0.2]], np.float32)
+        y = np.array([[1.0, 0.0]], np.float32)
+        assert float(_np(OPS["mae_loss"](p, y))) == pytest.approx(0.2, abs=1e-6)
+        assert float(_np(OPS["mape_loss"](p, y))) > 0
+        assert float(_np(OPS["kld_loss"](p, p))) == pytest.approx(0.0, abs=1e-6)
+        assert float(_np(OPS["dice_loss"](y, y))) == pytest.approx(0.0, abs=1e-3)
+        assert float(_np(OPS["fmeasure_loss"](y, y))) == pytest.approx(
+            0.0, abs=1e-3)
+        # wasserstein critic loss is just mean(pred*label)
+        assert float(_np(OPS["wasserstein_loss"](p, y))) == pytest.approx(0.4)
+
+    def test_focal_reduces_to_xent_at_gamma0(self):
+        rng = np.random.default_rng(0)
+        logits = rng.normal(size=(4, 3)).astype(np.float32)
+        labels = (rng.random((4, 3)) < 0.5).astype(np.float32)
+        focal = float(_np(OPS["focal_loss"](logits, labels, gamma=0.0,
+                                            alpha=0.5)))
+        bce = float(_np(OPS["multi_label_loss"](logits, labels)))
+        assert focal == pytest.approx(0.5 * bce, rel=1e-4)
+
+    def test_mixture_density_single_component_is_gaussian_nll(self):
+        rng = np.random.default_rng(1)
+        B, D = 3, 2
+        mu = rng.normal(size=(B, D)).astype(np.float32)
+        target = rng.normal(size=(B, D)).astype(np.float32)
+        params = np.concatenate(
+            [np.zeros((B, 1), np.float32), mu, np.zeros((B, D), np.float32)],
+            axis=1)
+        got = float(_np(OPS["mixture_density_loss"](params, target,
+                                                    components=1)))
+        want = float(np.mean(
+            0.5 * np.sum((target - mu) ** 2, -1)
+            + 0.5 * D * np.log(2 * np.pi)))
+        assert got == pytest.approx(want, rel=1e-5)
+
+    def test_pairwise_mse(self):
+        # d = [0, 2] -> single pair (0-2)^2 = 4
+        p = np.array([[1.0, 3.0]], np.float32)
+        y = np.array([[1.0, 1.0]], np.float32)
+        assert float(_np(OPS["mean_pairwise_squared_error"](p, y))) == \
+            pytest.approx(4.0)
+
+
+class TestImageAndMathTail:
+    def test_colorspace_roundtrip(self):
+        rng = np.random.default_rng(0)
+        x = rng.random((2, 4, 4, 3)).astype(np.float32)
+        for f, b in (("rgb_to_yiq", "yiq_to_rgb"), ("rgb_to_yuv", "yuv_to_rgb")):
+            back = _np(OPS[b](OPS[f](x)))
+            assert back == pytest.approx(x, abs=1e-5)
+
+    def test_resize_and_upsample(self):
+        x = np.arange(8, dtype=np.float32).reshape(1, 2, 2, 2)
+        assert _np(OPS["resize_bilinear"](x, size=(4, 4))).shape == (1, 4, 4, 2)
+        assert _np(OPS["resize_nearest"](x, size=(3, 5))).shape == (1, 3, 5, 2)
+        up = _np(OPS["upsampling2d"](x, factor=(2, 2)))
+        assert up.shape == (1, 4, 4, 2)
+        assert up[0, 0, 0, 0] == up[0, 1, 1, 0] == x[0, 0, 0, 0]
+
+    def test_iou(self):
+        a = np.array([[0, 0, 2, 2]], np.float32)
+        b = np.array([[0, 0, 2, 2], [1, 1, 3, 3], [5, 5, 6, 6]], np.float32)
+        got = _np(OPS["iou"](a, b))[0]
+        assert got[0] == pytest.approx(1.0)
+        assert got[1] == pytest.approx(1 / 7, abs=1e-5)
+        assert got[2] == pytest.approx(0.0)
+
+    def test_norm_tail(self):
+        rng = np.random.default_rng(0)
+        x = rng.normal(size=(2, 4, 4, 6)).astype(np.float32)
+        g = np.ones((6,), np.float32)
+        b = np.zeros((6,), np.float32)
+        inorm = _np(OPS["instance_norm"](x, g, b))
+        assert inorm.reshape(2, -1, 6).mean(1) == pytest.approx(
+            np.zeros((2, 6)), abs=1e-5)
+        gn = _np(OPS["group_norm"](x, g, b, groups=3))
+        assert gn.shape == x.shape
+        l2n = _np(OPS["l2_normalize"](x, axis=-1))
+        assert np.linalg.norm(l2n, axis=-1) == pytest.approx(
+            np.ones((2, 4, 4)), abs=1e-5)
+
+    def test_attention_ops(self):
+        rng = np.random.default_rng(0)
+        q = rng.normal(size=(2, 3, 5, 8)).astype(np.float32)
+        o = _np(OPS["dot_product_attention"](q, q, q, causal=True))
+        assert o.shape == q.shape
+        # causal: first query position attends only to itself
+        assert o[:, :, 0] == pytest.approx(q[:, :, 0], abs=1e-5)
+        x = rng.normal(size=(2, 4, 8)).astype(np.float32)
+        w = [rng.normal(size=(8, 8)).astype(np.float32) / 3 for _ in range(4)]
+        mh = _np(OPS["multi_head_attention"](x, *w, heads=2))
+        assert mh.shape == x.shape
+
+    def test_scatter_histogram_topk(self):
+        x = np.zeros((3, 3), np.float32)
+        idx = np.array([[0, 0], [2, 2]], np.int32)
+        upd = np.array([5.0, 7.0], np.float32)
+        out = _np(OPS["tensor_scatter_update"](x, idx, upd))
+        assert out[0, 0] == 5.0 and out[2, 2] == 7.0
+        h = _np(OPS["histogram_fixed_width"](
+            np.array([0.0, 0.1, 0.9, 1.0], np.float32), lo=0.0, hi=1.0,
+            nbins=2))
+        assert h.tolist() == [2, 2]
+        preds = np.array([[0.1, 0.9, 0.0], [0.8, 0.1, 0.1]], np.float32)
+        t = np.array([1, 2], np.int32)
+        got = _np(OPS["in_top_k"](preds, t, k=1))
+        assert got.tolist() == [True, False]
+
+    def test_math_tail(self):
+        x = np.array([[1.0, 2.0], [3.0, 4.0]], np.float32)
+        assert float(_np(OPS["trace"](x))) == pytest.approx(5.0)
+        assert _np(OPS["matrix_diag_part"](x)).tolist() == [1.0, 4.0]
+        assert float(_np(OPS["lerp"](
+            np.float32(1.0), np.float32(3.0), weight=0.5))) == 2.0
+        assert float(_np(OPS["nth_element"](
+            np.array([3.0, 1.0, 2.0], np.float32), n=1))) == 2.0
+        assert float(_np(OPS["kth_value"](
+            np.array([3.0, 1.0, 2.0], np.float32), k=1))) == 1.0
+        assert _np(OPS["flatten_2d"](np.zeros((2, 3, 4)))).shape == (2, 12)
+        assert float(_np(OPS["hypot"](np.float32(3.0), np.float32(4.0)))) == 5.0
+        assert _np(OPS["matrix_inverse"](x)) == pytest.approx(
+            np.linalg.inv(x), abs=1e-4)
+
+    def test_registry_size_parity_floor(self):
+        # SURVEY §2.1: the reference declares ~500 ops; VERDICT r3 set the
+        # round-4 floor at 430
+        assert len(OPS) >= 430, len(OPS)
