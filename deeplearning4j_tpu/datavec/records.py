@@ -211,10 +211,43 @@ class ImageRecordReader(RecordReader):
             img = out
         return img
 
+    _NATIVE_CHUNK = 64
+
     def __iter__(self):
         label_idx = {name: i for i, name in enumerate(self.labels)}
-        for p in self._files:
-            yield [self._decode(p), label_idx[self._label_of(p)]]
+        native_jpeg = False
+        try:
+            from deeplearning4j_tpu.runtime import native
+
+            native_jpeg = native.has_jpeg()
+        except Exception:
+            pass
+        if not native_jpeg:
+            for p in self._files:
+                yield [self._decode(p), label_idx[self._label_of(p)]]
+            return
+        # native fast path: decode JPEG runs in threaded C batches (the
+        # reference's JavaCV-native decode tier); other formats per-file
+        for i in range(0, len(self._files), self._NATIVE_CHUNK):
+            chunk = self._files[i:i + self._NATIVE_CHUNK]
+            jpegs = [p for p in chunk if p.suffix.lower() in (".jpg", ".jpeg")]
+            decoded = {}
+            if jpegs:
+                from deeplearning4j_tpu.runtime import native
+
+                batch = native.jpeg_batch_decode(
+                    jpegs, self.height, self.width, self.channels
+                )
+                decoded = {p: batch[j] for j, p in enumerate(jpegs)}
+            for p in chunk:
+                img = decoded.get(p)
+                if img is None or not img.any():
+                    # native decode zero-fills failures; re-decode through
+                    # PIL so corrupt files RAISE like the fallback path
+                    # does (an all-black legit image just takes the slow
+                    # path and comes back black again)
+                    img = self._decode(p)
+                yield [img, label_idx[self._label_of(p)]]
 
 
 def pattern_label_generator(delimiter: str = "_", position: int = 0):

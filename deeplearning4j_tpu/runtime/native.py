@@ -95,6 +95,16 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.dl4jtpu_free.restype = None
         lib.dl4jtpu_free.argtypes = [ctypes.c_void_p]
         lib.dl4jtpu_io_version.restype = ctypes.c_char_p
+        try:
+            lib.dl4jtpu_has_jpeg.restype = ctypes.c_int
+            lib.dl4jtpu_jpeg_batch.restype = ctypes.c_int
+            lib.dl4jtpu_jpeg_batch.argtypes = [
+                ctypes.POINTER(ctypes.c_char_p), ctypes.c_long,
+                ctypes.c_int, ctypes.c_int, ctypes.c_int,
+                ctypes.POINTER(ctypes.c_float), ctypes.c_int,
+            ]
+        except AttributeError:
+            pass   # pre-1.1 library on disk; jpeg path reports unavailable
         _lib = lib
         return _lib
 
@@ -172,3 +182,39 @@ def u8_to_f32_scaled(src: np.ndarray, scale: float = 1.0 / 255.0,
         src.size, scale, shift, _n_threads(),
     )
     return dst
+
+
+def has_jpeg() -> bool:
+    """True when the library was compiled against libjpeg."""
+    lib = _load()
+    return bool(lib is not None and hasattr(lib, "dl4jtpu_has_jpeg")
+                and lib.dl4jtpu_has_jpeg())
+
+
+def jpeg_batch_decode(paths, height: int, width: int, channels: int = 3,
+                      n_threads: int = 0) -> np.ndarray:
+    """Decode + resize a batch of JPEG files natively -> float32
+    (n, height, width, channels) in 0..255 (the ImageRecordReader value
+    convention).  libjpeg's DCT-domain prescale does most of the
+    downscaling inside the IDCT; a bilinear pass lands the exact target.
+    Files that fail to decode come back zero-filled (a warning is
+    logged)."""
+    import logging
+
+    lib = _load()
+    if lib is None or not has_jpeg():
+        raise RuntimeError("native JPEG decode unavailable")
+    paths = [str(p) for p in paths]
+    n = len(paths)
+    out = np.empty((n, height, width, channels), np.float32)
+    arr = (ctypes.c_char_p * n)(*(p.encode() for p in paths))
+    fails = lib.dl4jtpu_jpeg_batch(
+        arr, n, height, width, channels,
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        n_threads or _n_threads(),
+    )
+    if fails:
+        logging.getLogger(__name__).warning(
+            "jpeg_batch_decode: %d/%d files failed (zero-filled)", fails, n
+        )
+    return out

@@ -43,6 +43,10 @@ _PAGE = """<!doctype html>
    <canvas id="ratio" width="560" height="260"></canvas>
    <div class="legend" id="ratioLegend"></div></div>
  <div><h2>device memory (MiB)</h2><canvas id="mem" width="560" height="260"></canvas></div>
+ <div id="histPanel" style="display:none"><h2>histogram
+   <select id="histKind"></select><select id="histLayer"></select></h2>
+   <canvas id="hist" width="560" height="260"></canvas>
+   <div class="legend" id="histMeta"></div></div>
 </div>
 <script>
 const colors=['#2563eb','#dc2626','#16a34a','#9333ea','#ea580c','#0891b2',
@@ -87,6 +91,40 @@ async function refresh(){
   layers.map((l,i)=>`<span style="color:${colors[i%colors.length]}">■ ${l}</span>`).join(' ');
  drawLines(document.getElementById('mem'),
   [recs.map(r=>r.memory?r.memory.bytes_in_use/1048576:NaN)]);
+ drawHist(last);
+}
+function drawBars(cv, counts, lo, hi){
+ const c=cv.getContext('2d'); c.clearRect(0,0,cv.width,cv.height);
+ const W=cv.width-50, H=cv.height-30, mx=Math.max(...counts,1);
+ c.strokeStyle='#999'; c.strokeRect(40,5,W,H);
+ c.fillStyle='#666'; c.font='10px sans-serif';
+ c.fillText(String(mx),2,12);
+ c.fillText(Number(lo).toPrecision(3),40,H+25);
+ c.fillText(Number(hi).toPrecision(3),40+W-30,H+25);
+ c.fillStyle='#2563eb';
+ const bw=W/counts.length;
+ counts.forEach((v,i)=>{
+  const bh=H*v/mx; c.fillRect(40+i*bw+1,5+H-bh,bw-2,bh);
+ });
+}
+function drawHist(last){
+ const panel=document.getElementById('histPanel');
+ const hists=last.histograms;
+ if(!hists){panel.style.display='none';return}
+ panel.style.display='';
+ const kindSel=document.getElementById('histKind');
+ const kinds=Object.keys(hists);
+ if(kindSel.options.length!==kinds.length)
+  kindSel.innerHTML=kinds.map(k=>`<option>${k}</option>`).join('');
+ const layers=Object.keys(hists[kindSel.value]||{});
+ const laySel=document.getElementById('histLayer');
+ if(laySel.options.length!==layers.length)
+  laySel.innerHTML=layers.map(l=>`<option>${l}</option>`).join('');
+ const h=(hists[kindSel.value]||{})[laySel.value];
+ if(!h)return;
+ drawBars(document.getElementById('hist'),h.counts,h.min,h.max);
+ document.getElementById('histMeta').textContent=
+  `${kindSel.value} / ${laySel.value} · range [${Number(h.min).toPrecision(4)}, ${Number(h.max).toPrecision(4)}] · ${h.counts.reduce((a,b)=>a+b,0)} values`;
 }
 setInterval(refresh,2000); refresh();
 </script></body></html>"""

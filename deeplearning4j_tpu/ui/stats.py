@@ -203,6 +203,24 @@ def device_memory_stats() -> Optional[dict]:
     return keep or None
 
 
+
+def _device_histogram(bins: int):
+    """Fixed-bin on-device histogram: (counts[bins], lo, hi)."""
+    import jax.numpy as jnp
+
+    def hist(flat):
+        lo = jnp.min(flat)
+        hi = jnp.max(flat)
+        idx = jnp.clip(
+            ((flat - lo) / jnp.maximum(hi - lo, 1e-12) * bins)
+            .astype(jnp.int32),
+            0, bins - 1,
+        )
+        return jnp.bincount(idx, length=bins), lo, hi
+
+    return hist
+
+
 class StatsListener(TrainingListener):
     """Collects per-iteration stats into a StatsStorage.
 
@@ -212,29 +230,60 @@ class StatsListener(TrainingListener):
     """
 
     def __init__(self, storage: StatsStorage, frequency: int = 1,
-                 session_id: Optional[str] = None, track_updates: bool = True):
+                 session_id: Optional[str] = None, track_updates: bool = True,
+                 histograms: bool = False, histogram_bins: int = 32,
+                 activation_sample=None):
+        """histograms=True adds per-layer fixed-bin distributions of params
+        and per-iteration updates (Δw) to each record — the reference
+        StatsListener's signature charts.  Bins are computed ON DEVICE in
+        the same jitted reduction; only `histogram_bins` ints + 2 range
+        scalars per layer cross the device boundary.  Scalars-only stays
+        the default (histograms cost one small extra transfer per record).
+
+        activation_sample: a fixed probe batch; when given (with
+        histograms=True), each record also carries per-layer ACTIVATION
+        histograms + mean magnitudes of the probe's forward pass — fixed
+        input makes the distribution chart comparable across iterations."""
         self.storage = storage
         self.frequency = max(1, frequency)
         self.session_id = session_id or f"train_{int(time.time())}"
         self.track_updates = track_updates
+        self.histograms = histograms
+        self.histogram_bins = int(histogram_bins)
+        self.activation_sample = activation_sample
         self._prev_params = None
         self._stat_fn = None
+        self._act_fn = None
         self._last_time = None
 
     def _build_stat_fn(self):
         import jax
         import jax.numpy as jnp
 
+        bins = self.histogram_bins
+        want_hist = self.histograms
+
+        hist = _device_histogram(bins)
+
         @jax.jit
         def stats(params, prev):
             mags = {}
             ratios = {}
+            hists = {"params": {}, "updates": {}}
             for lname, sub in params.items():
                 leaves = jax.tree.leaves(sub)
                 total = sum(jnp.sum(jnp.abs(l.astype(jnp.float32))) for l in leaves)
                 count = sum(l.size for l in leaves)
                 mag = total / jnp.maximum(count, 1)
                 mags[lname] = mag
+                flat = (
+                    jnp.concatenate(
+                        [l.astype(jnp.float32).reshape(-1) for l in leaves]
+                    )
+                    if (want_hist and leaves) else None
+                )
+                if flat is not None:
+                    hists["params"][lname] = hist(flat)
                 if prev is not None:
                     pleaves = jax.tree.leaves(prev[lname])
                     dtotal = sum(
@@ -242,9 +291,59 @@ class StatsListener(TrainingListener):
                         for a, b in zip(leaves, pleaves)
                     )
                     ratios[lname] = (dtotal / jnp.maximum(count, 1)) / jnp.maximum(mag, 1e-12)
-            return mags, ratios
+                    if flat is not None:
+                        dflat = jnp.concatenate([
+                            a.astype(jnp.float32).reshape(-1)
+                            - b.astype(jnp.float32).reshape(-1)
+                            for a, b in zip(leaves, pleaves)
+                        ])
+                        hists["updates"][lname] = hist(dflat)
+            return mags, ratios, hists
 
         return stats
+
+    def _build_act_fn(self, model):
+        """Jitted probe-batch forward emitting per-layer activation
+        histograms + mean |a| (the feedForward inspection path, compiled)."""
+        import jax
+        import jax.numpy as jnp
+
+        layers = model.conf.layers
+        flat_before = model._flatten_before
+        bins = self.histogram_bins
+        bf16 = model._bf16
+
+        hist = _device_histogram(bins)
+
+        @jax.jit
+        def act(params, net_state, x):
+            out = {}
+            if bf16 and jnp.issubdtype(x.dtype, jnp.floating):
+                x = x.astype(jnp.bfloat16)
+            for i, layer in enumerate(layers):
+                if flat_before[i]:
+                    x = x.reshape(x.shape[0], -1)
+                x, _ = layer.apply(
+                    params.get(layer.name, {}),
+                    net_state.get(layer.name, {}),
+                    x, training=False, rng=None,
+                )
+                a = x.astype(jnp.float32).reshape(-1)
+                out[layer.name] = hist(a) + (jnp.mean(jnp.abs(a)),)
+            return out
+
+        return act
+
+    @staticmethod
+    def _hist_json(h):
+        import numpy as _np
+
+        counts, lo, hi = h[0], h[1], h[2]
+        return {
+            "counts": _np.asarray(counts).astype(int).tolist(),
+            "min": _finite(lo),
+            "max": _finite(hi),
+        }
 
     def iteration_done(self, model, iteration, epoch, score):
         if iteration % self.frequency:
@@ -255,7 +354,7 @@ class StatsListener(TrainingListener):
         if self._stat_fn is None:
             self._stat_fn = self._build_stat_fn()
         prev = self._prev_params if self.track_updates else None
-        mags, ratios = self._stat_fn(model.params, prev)
+        mags, ratios, hists = self._stat_fn(model.params, prev)
         record = {
             "session": self.session_id,
             "time": now,
@@ -265,6 +364,38 @@ class StatsListener(TrainingListener):
             "param_mean_magnitude": {k: _finite(v) for k, v in mags.items()},
             "update_ratio": {k: _finite(v) for k, v in ratios.items()},
         }
+        if self.histograms:
+            record["histograms"] = {
+                kind: {k: self._hist_json(h) for k, h in d.items()}
+                for kind, d in hists.items() if d
+            }
+            if self.activation_sample is not None and not hasattr(
+                model, "_flatten_before"
+            ):
+                # layer-activation probing walks the Sequential layer
+                # chain; GraphModel topology isn't supported (param/update
+                # histograms still are)
+                import logging
+
+                if not getattr(self, "_warned_act", False):
+                    logging.getLogger(__name__).warning(
+                        "StatsListener activation histograms need a "
+                        "SequentialModel; skipping for %s",
+                        type(model).__name__,
+                    )
+                    self._warned_act = True
+            elif self.activation_sample is not None:
+                if self._act_fn is None:
+                    self._act_fn = self._build_act_fn(model)
+                acts = self._act_fn(
+                    model.params, model.net_state, self.activation_sample
+                )
+                record["histograms"]["activations"] = {
+                    k: self._hist_json(v) for k, v in acts.items()
+                }
+                record["activation_mean_magnitude"] = {
+                    k: _finite(v[3]) for k, v in acts.items()
+                }
         if self._last_time is not None and getattr(model, "last_batch_size", 0):
             dt = now - self._last_time
             if dt > 0:

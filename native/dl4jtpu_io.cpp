@@ -303,6 +303,172 @@ void dl4jtpu_u8_to_f32_scaled(const uint8_t* src, float* dst, long n,
 }
 
 // library identity / version for the ctypes loader
-const char* dl4jtpu_io_version() { return "dl4jtpu_io 1.0"; }
+const char* dl4jtpu_io_version() { return "dl4jtpu_io 1.1"; }
 
 }  // extern "C"
+
+// ---------------------------------------------------------------------------
+// JPEG batch decode + resize (the ImageRecordReader hot path — the
+// reference decodes through JavaCV/OpenCV natively; here libjpeg with its
+// DCT-domain prescale + a bilinear resize to the target shape, threaded
+// across files).  Compiled in when the system libjpeg headers exist
+// (-DDL4JTPU_WITH_JPEG, see Makefile); dl4jtpu_has_jpeg() tells the
+// Python side which path it got.
+// ---------------------------------------------------------------------------
+
+#ifdef DL4JTPU_WITH_JPEG
+#include <csetjmp>
+extern "C" {
+#include <jpeglib.h>
+}
+
+namespace {
+
+struct JpegErrCtx {
+  jpeg_error_mgr mgr;
+  std::jmp_buf jb;
+};
+
+void jpeg_err_exit(j_common_ptr cinfo) {
+  JpegErrCtx* ctx = reinterpret_cast<JpegErrCtx*>(cinfo->err);
+  std::longjmp(ctx->jb, 1);
+}
+
+// setjmp-guarded phases keep only POD locals live across a potential
+// longjmp (libjpeg error_exit): C++ objects (the pixel vector) live in
+// the caller and are only touched through stable pointers — no skipped
+// destructors, no indeterminate objects.
+
+// phase 1: header + output geometry (with DCT-domain prescale chosen so
+// most of the downscale happens for free inside the IDCT)
+int jpeg_phase_header(jpeg_decompress_struct* cinfo, JpegErrCtx* err,
+                      FILE* f, int H, int W, int C) {
+  if (setjmp(err->jb)) return 1;
+  jpeg_create_decompress(cinfo);
+  jpeg_stdio_src(cinfo, f);
+  jpeg_read_header(cinfo, TRUE);
+  cinfo->out_color_space = (C == 1) ? JCS_GRAYSCALE : JCS_RGB;
+  cinfo->scale_num = 1;
+  cinfo->scale_denom = 1;
+  while (cinfo->scale_denom < 8 &&
+         (cinfo->image_width / (cinfo->scale_denom * 2)) >= (unsigned)W &&
+         (cinfo->image_height / (cinfo->scale_denom * 2)) >= (unsigned)H) {
+    cinfo->scale_denom *= 2;
+  }
+  jpeg_start_decompress(cinfo);
+  return 0;
+}
+
+// phase 2: scanlines into a caller-owned buffer
+int jpeg_phase_scan(jpeg_decompress_struct* cinfo, JpegErrCtx* err,
+                    uint8_t* buf, size_t row_stride) {
+  if (setjmp(err->jb)) return 1;
+  while (cinfo->output_scanline < cinfo->output_height) {
+    JSAMPROW row = buf + static_cast<size_t>(cinfo->output_scanline) * row_stride;
+    jpeg_read_scanlines(cinfo, &row, 1);
+  }
+  jpeg_finish_decompress(cinfo);
+  return 0;
+}
+
+// decode one file into out[H*W*C] float32 (0..255), bilinear-resized.
+int decode_one_jpeg(const char* path, int H, int W, int C, float* out) {
+  FILE* f = std::fopen(path, "rb");
+  if (!f) return 1;
+  jpeg_decompress_struct cinfo;
+  JpegErrCtx err;
+  cinfo.err = jpeg_std_error(&err.mgr);
+  err.mgr.error_exit = jpeg_err_exit;
+  std::vector<uint8_t> img;   // lives OUTSIDE every setjmp frame
+  if (jpeg_phase_header(&cinfo, &err, f, H, W, C) != 0) {
+    jpeg_destroy_decompress(&cinfo);
+    std::fclose(f);
+    return 2;
+  }
+  const int sw = cinfo.output_width, sh = cinfo.output_height;
+  const int sc = cinfo.output_components;   // 1 or 3
+  img.resize(static_cast<size_t>(sw) * sh * sc);
+  if (jpeg_phase_scan(&cinfo, &err, img.data(),
+                      static_cast<size_t>(sw) * sc) != 0) {
+    jpeg_destroy_decompress(&cinfo);
+    std::fclose(f);
+    return 2;
+  }
+  jpeg_destroy_decompress(&cinfo);
+  std::fclose(f);
+
+  // bilinear resize (sh, sw, sc) u8 -> (H, W, C) f32; channel count match
+  // guaranteed by out_color_space above (sc == C)
+  const float ys = sh > 1 ? (float)(sh - 1) / (H > 1 ? H - 1 : 1) : 0.f;
+  const float xs = sw > 1 ? (float)(sw - 1) / (W > 1 ? W - 1 : 1) : 0.f;
+  for (int y = 0; y < H; y++) {
+    float fy = y * ys;
+    int y0 = (int)fy;
+    int y1 = y0 + 1 < sh ? y0 + 1 : y0;
+    float wy = fy - y0;
+    for (int x = 0; x < W; x++) {
+      float fx = x * xs;
+      int x0 = (int)fx;
+      int x1 = x0 + 1 < sw ? x0 + 1 : x0;
+      float wx = fx - x0;
+      const uint8_t* p00 = &img[(static_cast<size_t>(y0) * sw + x0) * sc];
+      const uint8_t* p01 = &img[(static_cast<size_t>(y0) * sw + x1) * sc];
+      const uint8_t* p10 = &img[(static_cast<size_t>(y1) * sw + x0) * sc];
+      const uint8_t* p11 = &img[(static_cast<size_t>(y1) * sw + x1) * sc];
+      float* o = &out[(static_cast<size_t>(y) * W + x) * C];
+      for (int c = 0; c < C; c++) {
+        float top = p00[c] + (p01[c] - p00[c]) * wx;
+        float bot = p10[c] + (p11[c] - p10[c]) * wx;
+        o[c] = top + (bot - top) * wy;
+      }
+    }
+  }
+  return 0;
+}
+
+}  // namespace
+
+extern "C" {
+
+int dl4jtpu_has_jpeg() { return 1; }
+
+// Decode n JPEG files into out[n*H*W*C] float32 (0..255), resized to
+// (H, W, C), n_threads-way parallel over files.  Returns the number of
+// files that FAILED to decode (their slots are zero-filled) — callers can
+// treat nonzero as a warning or an error as they prefer.
+int dl4jtpu_jpeg_batch(const char** paths, long n, int height, int width,
+                       int channels, float* out, int n_threads) {
+  int nt = n_threads > 0 ? n_threads : 1;
+  if (nt > n) nt = (int)(n > 0 ? n : 1);
+  const size_t stride = static_cast<size_t>(height) * width * channels;
+  std::vector<int> fails(nt, 0);
+  std::vector<std::thread> workers;
+  for (int t = 0; t < nt; t++) {
+    workers.emplace_back([&, t]() {
+      for (long i = t; i < n; i += nt) {
+        float* dst = out + stride * i;
+        if (decode_one_jpeg(paths[i], height, width, channels, dst) != 0) {
+          std::memset(dst, 0, stride * sizeof(float));
+          fails[t]++;
+        }
+      }
+    });
+  }
+  for (auto& w : workers) w.join();
+  int total = 0;
+  for (int v : fails) total += v;
+  return total;
+}
+
+}  // extern "C"
+
+#else  // !DL4JTPU_WITH_JPEG
+
+extern "C" {
+int dl4jtpu_has_jpeg() { return 0; }
+int dl4jtpu_jpeg_batch(const char**, long, int, int, int, float*, int) {
+  return -1;
+}
+}  // extern "C"
+
+#endif  // DL4JTPU_WITH_JPEG

@@ -144,3 +144,68 @@ class TestReviewRegressions:
         out = ImagePreProcessingScaler(-1.0, 1.0).transform(DataSet(x, y))
         ref = x.astype(np.float32) / 255.0 * 2.0 - 1.0
         np.testing.assert_allclose(out.features, ref, atol=1e-5)
+
+
+class TestNativeJpeg:
+    """Round-4: libjpeg batch decode behind ImageRecordReader."""
+
+    @pytest.fixture()
+    def jpeg_dir(self, tmp_path):
+        PIL = pytest.importorskip("PIL.Image")
+        rng = np.random.default_rng(0)
+        for cls in ("cat", "dog"):
+            d = tmp_path / cls
+            d.mkdir()
+            for i in range(6):
+                flat = np.full((96, 128, 3),
+                               (30 + 40 * (cls == "dog"), 80, 160), np.uint8)
+                flat[:48] += np.uint8(i)
+                PIL.fromarray(flat).save(d / f"{i}.jpg", quality=95)
+        return tmp_path
+
+    def test_batch_decode_matches_pil_values(self, jpeg_dir):
+        from deeplearning4j_tpu.runtime import native
+
+        if not native.has_jpeg():
+            pytest.skip("library built without libjpeg")
+        from PIL import Image
+
+        paths = sorted(jpeg_dir.rglob("*.jpg"))[:3]
+        out = native.jpeg_batch_decode(paths, 48, 64, 3)
+        assert out.shape == (3, 48, 64, 3) and out.dtype == np.float32
+        for i, p in enumerate(paths):
+            with Image.open(p) as im:
+                want = np.asarray(im.convert("RGB").resize((64, 48)),
+                                  np.float32)
+            # resize algorithms differ; near-flat images must agree closely
+            assert np.abs(out[i] - want).mean() < 3.0
+
+    def test_image_record_reader_native_path_matches_pil(self, jpeg_dir,
+                                                         monkeypatch):
+        from deeplearning4j_tpu.datavec import ImageRecordReader
+        from deeplearning4j_tpu.runtime import native
+
+        if not native.has_jpeg():
+            pytest.skip("library built without libjpeg")
+        r = ImageRecordReader(32, 32, 3)
+        r.initialize(jpeg_dir)
+        fast = [(rec[0].copy(), rec[1]) for rec in r]
+        monkeypatch.setenv("DL4JTPU_NO_NATIVE", "1")
+        monkeypatch.setattr(native, "_lib", None)
+        monkeypatch.setattr(native, "_tried", False)
+        slow = [(rec[0].copy(), rec[1]) for rec in r]
+        assert len(fast) == len(slow) == 12
+        for (fi, fl), (si, sl) in zip(fast, slow):
+            assert fl == sl
+            assert np.abs(fi - si).mean() < 3.0   # decode parity
+
+    def test_decode_failure_zero_fills_and_counts(self, tmp_path):
+        from deeplearning4j_tpu.runtime import native
+
+        if not native.has_jpeg():
+            pytest.skip("library built without libjpeg")
+        bad = tmp_path / "bad.jpg"
+        bad.write_bytes(b"not a jpeg at all")
+        out = native.jpeg_batch_decode([bad], 16, 16, 3)
+        assert out.shape == (1, 16, 16, 3)
+        assert (out == 0).all()

@@ -255,3 +255,73 @@ class TestHpoTab:
             assert len(got) == 4
         finally:
             server.stop()
+
+
+class TestHistograms:
+    """Round-4: param/update/activation distributions (SURVEY §5.5 — the
+    reference StatsListener's signature charts), opt-in."""
+
+    def test_histogram_records(self):
+        model = small_model()
+        store = InMemoryStatsStorage()
+        b = batch()
+        model.set_listeners(StatsListener(
+            store, session_id="h", histograms=True, histogram_bins=16,
+            activation_sample=np.asarray(b.features),
+        ))
+        for _ in range(3):
+            model.fit_batch(b)
+        recs = store.get_records("h")
+        assert recs
+        h = recs[-1]["histograms"]
+        assert set(h) == {"params", "updates", "activations"}
+        n_params = sum(
+            int(np.prod(np.shape(v))) for lp in model.params.values()
+            for v in lp.values()
+        )
+        for kind in ("params", "updates"):
+            total = sum(sum(d["counts"]) for d in h[kind].values())
+            assert total == n_params, (kind, total, n_params)
+            for d in h[kind].values():
+                assert len(d["counts"]) == 16
+                assert d["min"] <= d["max"]
+        # activation histogram covers batch x layer width elements
+        for lname, d in h["activations"].items():
+            assert sum(d["counts"]) > 0
+        assert set(recs[-1]["activation_mean_magnitude"]) == set(
+            h["activations"])
+
+    def test_scalars_only_default_has_no_histograms(self):
+        model = small_model()
+        store = InMemoryStatsStorage()
+        model.set_listeners(StatsListener(store, session_id="s"))
+        model.fit_batch(batch())
+        assert "histograms" not in store.get_records("s")[-1]
+
+    def test_dashboard_renders_histograms(self):
+        model = small_model()
+        store = InMemoryStatsStorage()
+        b = batch()
+        model.set_listeners(StatsListener(
+            store, session_id="hh", histograms=True,
+            activation_sample=np.asarray(b.features),
+        ))
+        for _ in range(2):
+            model.fit_batch(b)
+        server = UIServer(port=0)
+        server.attach(store)
+        try:
+            with urllib.request.urlopen(server.url) as r:
+                page = r.read().decode()
+            # the panel + its renderer ship in the page
+            assert 'id="histPanel"' in page and "drawHist" in page
+            with urllib.request.urlopen(
+                server.url + "api/stats?session=hh"
+            ) as r:
+                recs = json.loads(r.read().decode())
+            h = recs[-1]["histograms"]
+            assert h["params"] and h["updates"] and h["activations"]
+            some = next(iter(h["params"].values()))
+            assert sum(some["counts"]) > 0
+        finally:
+            server.stop()
