@@ -1749,3 +1749,28 @@ OPS.update({
         x, idx.astype(jnp.int32), vals, axis=axis, inplace=False),
     "array_equal": lambda a, b: jnp.all(a == b),
 })
+
+
+def _strided_slice(x, *, begin, end, strides, begin_mask=0, end_mask=0,
+                   ellipsis_mask=0, new_axis_mask=0, shrink_axis_mask=0):
+    """TF StridedSlice semantics (static spec): per-dim python slices with
+    the five TF bit masks."""
+    idx = []
+    for i in range(len(begin)):
+        if ellipsis_mask & (1 << i):
+            idx.append(Ellipsis)
+        elif new_axis_mask & (1 << i):
+            idx.append(None)
+        elif shrink_axis_mask & (1 << i):
+            idx.append(int(begin[i]))
+        else:
+            b = None if begin_mask & (1 << i) else int(begin[i])
+            e = None if end_mask & (1 << i) else int(end[i])
+            idx.append(slice(b, e, int(strides[i])))
+    return x[tuple(idx)]
+
+
+OPS.update({
+    "strided_slice": _strided_slice,
+    "l2_loss": lambda x: 0.5 * jnp.sum(jnp.square(x)),
+})

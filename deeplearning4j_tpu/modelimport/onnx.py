@@ -83,6 +83,8 @@ def _attrs(node) -> dict:
             out[a.name] = [int(v) for v in a.ints]
         elif a.type == 8:        # STRINGS
             out[a.name] = [s.decode() for s in a.strings]
+        elif a.type == 5:        # GRAPH (If/Loop/Scan bodies)
+            out[a.name] = a.g
         else:
             raise ONNXImportError(
                 f"node {node.name!r}: unsupported attribute type {a.type} "
@@ -932,6 +934,166 @@ class _Importer:
         y = self.sd.apply("space_to_depth", x,
                           block=int(_attrs(node)["blocksize"]))
         self._emit_nchw(node, y)
+
+    # -- control flow (If / Loop — the reference imports ONNX subgraph
+    # bodies; here they become lax.cond / lax.while_loop inside the same
+    # compiled program, mirroring the TF importer's design) ----------------
+    def op_If(self, node):
+        import jax
+        import jax.numpy as jnp
+
+        a = _attrs(node)
+        then_fn = _OnnxSubgraphFn(self, a["then_branch"],
+                                  f"{node.name or 'If'} then_branch")
+        else_fn = _OnnxSubgraphFn(self, a["else_branch"],
+                                  f"{node.name or 'If'} else_branch")
+        if len(then_fn.out_keys) != len(else_fn.out_keys):
+            raise ONNXImportError(
+                f"{node.name}: If branches disagree on output arity"
+            )
+        pred = self.in_var(node.input[0])
+        # branch signatures must match for lax.cond: pass BOTH branches'
+        # captures, each branch reads its own slice
+        n_then = len(then_fn.captures)
+        cap_vars = [self.in_var(c) for c in then_fn.captures] + [
+            self.in_var(c) for c in else_fn.captures
+        ]
+
+        def fn(p, *caps):
+            return jax.lax.cond(
+                jnp.asarray(p).astype(bool).reshape(()),
+                lambda ops: tuple(then_fn(*ops[:n_then])),
+                lambda ops: tuple(else_fn(*ops[n_then:])),
+                tuple(caps),
+            )
+
+        outs = self.sd.py_call(fn, pred, *cap_vars,
+                               n_out=len(node.output),
+                               name=node.output[0] + "#if")
+        for o, v in zip(node.output, outs):
+            self.vars[o] = self.sd.apply("identity", v, name=o)
+
+    def op_Loop(self, node):
+        a = _attrs(node)
+        body = a["body"]
+        n_state = len(node.input) - 2          # v_initial count
+        n_scan = len(body.output) - 1 - n_state
+        if n_scan > 0:
+            raise ONNXImportError(
+                f"{node.name}: Loop scan_outputs produce per-iteration "
+                "stacked results (dynamic shape under a dynamic trip "
+                "count); re-export with a static-shape accumulation"
+            )
+        body_fn = _OnnxSubgraphFn(self, body, f"{node.name or 'Loop'} body")
+        import jax.numpy as jnp
+
+        m_name, cond_name = node.input[0], node.input[1]
+        max_trip = self.in_var(m_name) if m_name else None
+        cond0 = self.in_var(cond_name) if cond_name else None
+        state0 = [self.in_var(i) for i in node.input[2:]]
+        caps = [self.in_var(c) for c in body_fn.captures]
+        n_caps = len(caps)
+
+        # loop carry: (iter, cond, *state, *captures)
+        def cond_fn(i, c, *rest):
+            ok = jnp.asarray(c).astype(bool).reshape(())
+            if max_trip is not None:
+                # max_trip rides as the LAST capture slot (int32: x64 off)
+                ok = ok & (
+                    i < jnp.asarray(rest[-1]).astype(jnp.int32).reshape(())
+                )
+            return ok
+
+        def body_wrap(i, c, *rest):
+            state = rest[:n_state]
+            capt = rest[n_state:n_state + n_caps]
+            outs = body_fn(i, c, *state, *capt)
+            new_cond, new_state = outs[0], outs[1:]
+            return (i + 1, jnp.asarray(new_cond).reshape(()).astype(jnp.bool_)) \
+                + tuple(new_state) + tuple(rest[n_state:])
+
+        init = [
+            self.sd._lift(np.int32(0)),
+            cond0 if cond0 is not None
+            else self.sd._lift(np.asarray(True)),
+            *state0,
+            *caps,
+        ]
+        if max_trip is not None:
+            init.append(max_trip)
+
+        outs = self.sd.while_loop(cond_fn, body_wrap, *init)
+        # final state vars map to the node outputs (iter/cond dropped)
+        for idx, o in enumerate(node.output[:n_state]):
+            self.vars[o] = self.sd.apply(
+                "identity", outs[2 + idx], name=o)
+
+
+class _OnnxSubgraphFn:
+    """An ONNX subgraph (If branch / Loop body) as a trace-time callable —
+    same design as the TF importer's _SubgraphFn: formal inputs become
+    placeholders of a private SameDiff, outer-scope name captures resolve to
+    extra positional args, and each call interprets the subgraph inside
+    the surrounding trace."""
+
+    def __init__(self, parent: _Importer, graph, label: str):
+        imp = _Importer.__new__(_Importer)
+        # no imp.model: this object outlives import (it is captured in the
+        # py_call closure) and must not pin the whole serialized ModelProto
+        imp.model = None
+        imp.g = graph
+        imp.sd = SameDiff()
+        imp.trainable = False
+        imp.vars = {}
+        imp.consts = {}
+        imp._promoted = {}
+        self.imp = imp
+        for init in graph.initializer:
+            imp.consts[init.name] = tensor_to_np(init)
+        self.in_keys: List[str] = []
+        produced = set(imp.consts)
+        for i, vi in enumerate(graph.input):
+            ph = imp.sd.placeholder(f"arg{i}")
+            imp.vars[vi.name] = ph
+            self.in_keys.append(ph.name)
+            produced.add(vi.name)
+        # outer-scope captures: names consumed before any subgraph node
+        # produces them; parent consts copy over, live values become args
+        self.captures: List[str] = []
+
+        def note(name):
+            if not name or name in produced or name in self.captures:
+                return
+            if name in parent.consts:
+                imp.consts[name] = parent.consts[name]
+            else:
+                self.captures.append(name)
+
+        for n in graph.node:
+            for name in n.input:
+                note(name)
+            produced.update(o for o in n.output)
+        # a branch may RETURN an outer tensor directly (passthrough If
+        # branch with zero nodes): graph.output names capture too
+        for o in graph.output:
+            note(o.name)
+        for j, name in enumerate(self.captures):
+            ph = imp.sd.placeholder(f"cap{j}")
+            imp.vars[name] = ph
+            self.in_keys.append(ph.name)
+        for n in graph.node:
+            fn = getattr(imp, f"op_{n.op_type}", None)
+            if fn is None:
+                raise ONNXImportError(
+                    f"{label}: unmapped ONNX op {n.op_type!r} in subgraph"
+                )
+            fn(n)
+        self.out_keys = [imp.in_var(o.name).name for o in graph.output]
+
+    def __call__(self, *args):
+        env = dict(self.imp.sd._values)
+        env.update(zip(self.in_keys, args))
+        return self.imp.sd._execute(env, tuple(self.out_keys))
 
 
 def import_onnx(path_or_bytes, trainable: bool = False) -> SameDiff:

@@ -637,6 +637,113 @@ class _Importer:
     op_FusedBatchNorm = op_FusedBatchNormV3
     op_FusedBatchNormV2 = op_FusedBatchNormV3
 
+    # --- shape/array tail (round 4) -----------------------------------
+    def op_StridedSlice(self, node):
+        ins = self.data_inputs(node)
+        begin = [int(v) for v in
+                 self.static_value(_input_name(ins[1])[0]).reshape(-1)]
+        end = [int(v) for v in
+               self.static_value(_input_name(ins[2])[0]).reshape(-1)]
+        strides = [int(v) for v in
+                   self.static_value(_input_name(ins[3])[0]).reshape(-1)]
+        self._unary_on(
+            node, ins[0], "strided_slice",
+            begin=tuple(begin), end=tuple(end), strides=tuple(strides),
+            begin_mask=int(self.attr(node, "begin_mask", 0)),
+            end_mask=int(self.attr(node, "end_mask", 0)),
+            ellipsis_mask=int(self.attr(node, "ellipsis_mask", 0)),
+            new_axis_mask=int(self.attr(node, "new_axis_mask", 0)),
+            shrink_axis_mask=int(self.attr(node, "shrink_axis_mask", 0)),
+        )
+
+    def op_Shape(self, node):
+        base, _ = _input_name(self.data_inputs(node)[0])
+        if base not in self.consts:
+            raise TFImportError(
+                f"{node.name}: Shape of a non-constant tensor is dynamic — "
+                "XLA needs static shapes; re-export with shapes folded "
+                "(freeze with constant inputs)"
+            )
+        self.consts[node.name] = np.asarray(
+            self.consts[base].shape, np.int32)
+
+    def op_Fill(self, node):
+        ins = self.data_inputs(node)
+        dims = [int(v) for v in
+                self.static_value(_input_name(ins[0])[0]).reshape(-1)]
+        value = self.static_value(_input_name(ins[1])[0])
+        self.consts[node.name] = np.full(dims, value.reshape(()))
+
+    def op_Range(self, node):
+        ins = self.data_inputs(node)
+        start, limit, delta = (
+            self.static_value(_input_name(i)[0]).reshape(()) for i in ins[:3]
+        )
+        self.consts[node.name] = np.arange(start, limit, delta)
+
+    def op_Unpack(self, node):
+        # gather-with-scalar-index squeezes the axis (jnp.take semantics),
+        # which is exactly unstack — and handles negative axes, where a
+        # begin/end/mask slice spec would need the (untracked) input rank
+        axis = int(self.attr(node, "axis", 0))
+        num = int(self.attr(node, "num"))
+        src = self.in_var(self.data_inputs(node)[0])
+        for i in range(num):
+            nm = node.name if i == 0 else f"{node.name}:{i}"
+            idx = self.sd._lift(np.int32(i))
+            self.vars[nm] = self.sd.apply(
+                "gather", src, idx, name=nm, axis=axis
+            )
+        self.vars.setdefault(f"{node.name}:0", self.vars[node.name])
+
+    def op_Cumsum(self, node):
+        ins = self.data_inputs(node)
+        axis = int(self.static_value(_input_name(ins[1])[0]))
+        if self.attr(node, "exclusive", False) or self.attr(
+            node, "reverse", False
+        ):
+            raise TFImportError(
+                f"{node.name}: exclusive/reverse Cumsum not supported"
+            )
+        self._unary_on(node, ins[0], "cumsum", axis=axis)
+
+    def op_Round(self, node):
+        self._unary(node, "round")
+
+    def op_ZerosLike(self, node):
+        self._unary(node, "zeros_like")
+
+    def op_OnesLike(self, node):
+        self._unary(node, "ones_like")
+
+    def op_L2Loss(self, node):
+        self._unary(node, "l2_loss")
+
+    def op_GatherNd(self, node):
+        a, b = self.data_inputs(node)[:2]
+        self._bind(node, self.sd.apply(
+            "gather_nd", self.in_var(a), self.in_var(b), name=node.name))
+
+    def _resize(self, node, method):
+        if bool(self.attr(node, "align_corners", False)) or not bool(
+            self.attr(node, "half_pixel_centers", False)
+        ):
+            raise TFImportError(
+                f"{node.name}: only half_pixel_centers=True resize imports "
+                "(matches XLA's sampling grid exactly; other modes would "
+                "be silently shifted)"
+            )
+        ins = self.data_inputs(node)
+        size = [int(v) for v in
+                self.static_value(_input_name(ins[1])[0]).reshape(-1)]
+        self._unary_on(node, ins[0], method, size=tuple(size))
+
+    def op_ResizeBilinear(self, node):
+        self._resize(node, "resize_bilinear")
+
+    def op_ResizeNearestNeighbor(self, node):
+        self._resize(node, "resize_nearest")
+
     # --- control flow -------------------------------------------------
     # The reference imports TF control flow via frame-tracked VarIds
     # (name+frame+iteration, SURVEY.md §3.3 — Enter/Exit/NextIteration);

@@ -41,9 +41,12 @@ def _set_attr(node, name, value):
         a.type, a.f = 1, value
     elif isinstance(value, str):
         a.type, a.s = 3, value.encode()
-    elif isinstance(value, np.ndarray):
+    elif isinstance(value, (np.ndarray, np.generic)):
         a.type = 4
-        a.t.CopyFrom(make_tensor(name, value))
+        a.t.CopyFrom(make_tensor(name, np.asarray(value)))
+    elif isinstance(value, pb.GraphProto):
+        a.type = 5
+        a.g.CopyFrom(value)
     elif isinstance(value, (list, tuple)):
         if all(isinstance(v, int) for v in value):
             a.type = 7
@@ -68,6 +71,33 @@ def make_node(op_type, inputs, outputs, name="", **attrs):
     return n
 
 
+def make_graph(nodes, inputs, outputs, initializers=None,
+               name="graph") -> "pb.GraphProto":
+    """inputs/outputs: [(name, shape)] or [name]; initializers:
+    {name: ndarray}.  Standalone GraphProto — also used for If/Loop
+    subgraph attributes."""
+    g = pb.GraphProto()
+    g.name = name
+    for n in nodes:
+        g.node.add().CopyFrom(n)
+    for iname, arr in (initializers or {}).items():
+        g.initializer.add().CopyFrom(make_tensor(iname, np.asarray(arr)))
+    for item in inputs:
+        iname, shape = item if isinstance(item, tuple) else (item, ())
+        vi = g.input.add()
+        vi.name = iname
+        vi.type.tensor_type.elem_type = 1
+        for s in shape:
+            d = vi.type.tensor_type.shape.dim.add()
+            d.dim_value = s
+    for item in outputs:
+        oname = item if isinstance(item, str) else item[0]
+        vi = g.output.add()
+        vi.name = oname
+        vi.type.tensor_type.elem_type = 1
+    return g
+
+
 def make_model(nodes, inputs, outputs, initializers=None,
                opset: int = 17) -> bytes:
     """inputs/outputs: [(name, shape)]; initializers: {name: ndarray}.
@@ -77,22 +107,6 @@ def make_model(nodes, inputs, outputs, initializers=None,
     op = m.opset_import.add()
     op.domain = ""
     op.version = opset
-    g = m.graph
-    g.name = "test_graph"
-    for n in nodes:
-        g.node.add().CopyFrom(n)
-    for name, arr in (initializers or {}).items():
-        g.initializer.add().CopyFrom(make_tensor(name, np.asarray(arr)))
-    for name, shape in inputs:
-        vi = g.input.add()
-        vi.name = name
-        vi.type.tensor_type.elem_type = 1
-        for s in shape:
-            d = vi.type.tensor_type.shape.dim.add()
-            d.dim_value = s
-    for item in outputs:
-        name = item if isinstance(item, str) else item[0]
-        vi = g.output.add()
-        vi.name = name
-        vi.type.tensor_type.elem_type = 1
+    m.graph.CopyFrom(make_graph(nodes, inputs, outputs, initializers,
+                                name="test_graph"))
     return m.SerializeToString()

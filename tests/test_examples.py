@@ -39,3 +39,7 @@ def test_audio_classify_example(monkeypatch):
 
 def test_video_pipeline_example(monkeypatch):
     assert _run("video_pipeline.py", monkeypatch) > 0.9
+
+
+def test_speech_ctc_example(monkeypatch):
+    assert _run("speech_ctc.py", monkeypatch) > 0.9

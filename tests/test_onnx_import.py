@@ -486,3 +486,153 @@ class TestOpsetBreadth:
         np.testing.assert_allclose(fm, np.fmod(x, y), atol=1e-6)
         np.testing.assert_allclose(im, np.mod(x, y), atol=1e-6)
         np.testing.assert_allclose(ge, (x >= y).astype(np.float32))
+
+
+class TestOnnxControlFlow:
+    """ONNX If/Loop subgraphs -> lax.cond / lax.while_loop (round 4 —
+    closes the §2.2 import control-flow gap on the ONNX side)."""
+
+    def test_if_both_branches(self):
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+
+        then_g = make_graph(
+            [make_node("Mul", ["x", "two"], ["tout"])],
+            [], ["tout"], initializers={"two": np.float32(2.0)},
+            name="then",
+        )
+        else_g = make_graph(
+            [make_node("Sub", ["x", "three"], ["eout"])],
+            [], ["eout"], initializers={"three": np.float32(3.0)},
+            name="else",
+        )
+        raw = make_model(
+            [
+                make_node("ReduceSum", ["x"], ["s"], keepdims=0),
+                make_node("Constant", [], ["zero"], value=np.float32(0.0)),
+                make_node("Greater", ["s", "zero"], ["pred"]),
+                make_node("If", ["pred"], ["y"], then_branch=then_g,
+                          else_branch=else_g),
+            ],
+            [("x", (4,))], ["y"],
+        )
+        sd = import_onnx(raw)
+        xp = np.array([1.0, 2.0, -0.5, 0.25], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": xp}, "y")), xp * 2.0, atol=1e-6)
+        xn = -xp
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": xn}, "y")), xn - 3.0, atol=1e-6)
+
+    def test_loop_trip_count(self):
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+
+        # body: (iter, cond, v) -> (cond, v * 2 + 1)
+        body = make_graph(
+            [
+                make_node("Mul", ["v", "two"], ["v2"]),
+                make_node("Add", ["v2", "one"], ["v_out"]),
+                make_node("Identity", ["cond_in"], ["cond_out"]),
+            ],
+            ["iter_num", "cond_in", "v"], ["cond_out", "v_out"],
+            initializers={"two": np.float32(2.0), "one": np.float32(1.0)},
+            name="body",
+        )
+        raw = make_model(
+            [make_node("Loop", ["M", "cond0", "x"], ["y"], body=body)],
+            [("x", (3,))], ["y"],
+            initializers={"M": np.int64(5), "cond0": np.bool_(True)},
+        )
+        sd = import_onnx(raw)
+        xp = np.array([0.0, 1.0, -1.0], np.float32)
+        want = xp.copy()
+        for _ in range(5):
+            want = want * 2 + 1
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": xp}, "y")), want, atol=1e-5)
+
+    def test_loop_with_outer_capture(self):
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+
+        # body captures the OUTER tensor "step" by name
+        body = make_graph(
+            [
+                make_node("Add", ["v", "step"], ["v_out"]),
+                make_node("Identity", ["cond_in"], ["cond_out"]),
+            ],
+            ["iter_num", "cond_in", "v"], ["cond_out", "v_out"],
+            name="body",
+        )
+        raw = make_model(
+            [
+                make_node("Add", ["s0", "s0"], ["step"]),
+                make_node("Loop", ["M", "cond0", "x"], ["y"], body=body),
+            ],
+            [("x", (2,)), ("s0", (2,))], ["y"],
+            initializers={"M": np.int64(3), "cond0": np.bool_(True)},
+        )
+        sd = import_onnx(raw)
+        xp = np.array([1.0, 2.0], np.float32)
+        s0 = np.array([0.5, -0.5], np.float32)
+        want = xp + 3 * (2 * s0)
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": xp, "s0": s0}, "y")), want,
+            atol=1e-6)
+
+    def test_loop_scan_outputs_rejected(self):
+        import numpy as np
+        import pytest
+
+        from onnx_fixtures import make_graph, make_model, make_node
+
+        body = make_graph(
+            [
+                make_node("Identity", ["cond_in"], ["cond_out"]),
+                make_node("Identity", ["v"], ["v_out"]),
+                make_node("Identity", ["v"], ["scan0"]),
+            ],
+            ["iter_num", "cond_in", "v"], ["cond_out", "v_out", "scan0"],
+            name="body",
+        )
+        raw = make_model(
+            [make_node("Loop", ["M", "cond0", "x"], ["y", "ys"], body=body)],
+            [("x", (2,))], ["y", "ys"],
+            initializers={"M": np.int64(2), "cond0": np.bool_(True)},
+        )
+        with pytest.raises(Exception, match="scan_outputs"):
+            import_onnx(raw)
+
+    def test_if_passthrough_branch_captures_outer_tensor(self):
+        """A zero-node branch returning an outer tensor directly (r4
+        review finding)."""
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+
+        then_g = make_graph(
+            [make_node("Mul", ["x", "two"], ["tout"])],
+            [], ["tout"], initializers={"two": np.float32(2.0)},
+            name="then",
+        )
+        else_g = make_graph([], [], ["x"], name="else")   # passthrough
+        raw = make_model(
+            [
+                make_node("ReduceSum", ["x"], ["s"], keepdims=0),
+                make_node("Constant", [], ["zero"], value=np.float32(0.0)),
+                make_node("Greater", ["s", "zero"], ["pred"]),
+                make_node("If", ["pred"], ["y"], then_branch=then_g,
+                          else_branch=else_g),
+            ],
+            [("x", (3,))], ["y"],
+        )
+        sd = import_onnx(raw)
+        xp = np.array([1.0, 2.0, 3.0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": xp}, "y")), xp * 2.0, atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": -xp}, "y")), -xp, atol=1e-6)

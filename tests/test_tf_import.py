@@ -555,3 +555,116 @@ class TestAdvisorRegressions:
         assert len(sd.variables()) == 1, sd.variables()
         got = sd.output({"x": np.ones((1, 2), np.float32)}, "out")
         np.testing.assert_allclose(np.asarray(got), [[12.0, 12.0]])
+
+
+class TestRound4OpTail:
+    """StridedSlice/Shape/Fill/Range/Unpack/Cumsum/Round/ZerosLike/
+    L2Loss/GatherNd mappers."""
+
+    def test_strided_slice_variants(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [4, 6, 8], name="x")
+            a = x[:, 1:5:2, ::-1]            # slices + negative stride
+            b = x[:, 0, 2:]                  # shrink axis
+            tf.identity(a, name="a")
+            tf.identity(b, name="b")
+
+        g = tf1.Graph()
+        with g.as_default():
+            build()
+        xv = np.random.default_rng(0).normal(size=(4, 6, 8)).astype(np.float32)
+        sd = import_graph(g.as_graph_def())
+        for fetch in ("a", "b"):
+            want = golden(g, {"x:0": xv}, f"{fetch}:0")
+            np.testing.assert_allclose(
+                np.asarray(sd.output({"x": xv}, fetch)), want, atol=1e-6)
+
+    def test_shape_fill_range_folding(self):
+        def build():
+            c = tf.constant(np.ones((3, 5), np.float32), name="c")
+            s = tf.shape(c, name="s")
+            f = tf.fill([2, 3], 7.0, name="f")
+            r = tf.range(0.0, 5.0, 1.0, name="r")
+            tf.identity(tf.cast(s, tf.float32), name="s_out")
+            tf.identity(f, name="f_out")
+            tf.identity(r, name="r_out")
+
+        g = tf1.Graph()
+        with g.as_default():
+            build()
+        sd = import_graph(g.as_graph_def())
+        np.testing.assert_allclose(np.asarray(sd.output({}, "s_out")), [3, 5])
+        np.testing.assert_allclose(np.asarray(sd.output({}, "f_out")),
+                                   np.full((2, 3), 7.0))
+        np.testing.assert_allclose(np.asarray(sd.output({}, "r_out")),
+                                   np.arange(5.0))
+
+    def test_unpack_cumsum_round_l2(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [3, 4], name="x")
+            a, b, c = tf.unstack(x, axis=0)
+            cs = tf.cumsum(x, axis=1)
+            tf.identity(b, name="mid")
+            tf.identity(cs, name="cs")
+            tf.identity(tf.round(x), name="rnd")
+            tf.identity(tf.nn.l2_loss(x), name="l2")
+            tf.identity(tf.zeros_like(x) + tf.ones_like(x), name="zl")
+
+        g = tf1.Graph()
+        with g.as_default():
+            build()
+        xv = np.random.default_rng(1).normal(size=(3, 4)).astype(np.float32)
+        sd = import_graph(g.as_graph_def())
+        for fetch in ("mid", "cs", "rnd", "l2", "zl"):
+            want = golden(g, {"x:0": xv}, f"{fetch}:0")
+            np.testing.assert_allclose(
+                np.asarray(sd.output({"x": xv}, fetch)), want,
+                atol=1e-5, rtol=1e-5)
+
+    def test_gather_nd(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [4, 5], name="x")
+            idx = tf.constant([[0, 1], [3, 4]], tf.int32)
+            tf.identity(tf.gather_nd(x, idx), name="out")
+
+        g = tf1.Graph()
+        with g.as_default():
+            build()
+        xv = np.random.default_rng(2).normal(size=(4, 5)).astype(np.float32)
+        sd = import_graph(g.as_graph_def())
+        want = golden(g, {"x:0": xv}, "out:0")
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": xv}, "out")), want, atol=1e-6)
+
+    def test_resize_bilinear_half_pixel(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [2, 4, 4, 3], name="x")
+            y = tf1.image.resize_bilinear(x, [8, 8],
+                                          half_pixel_centers=True)
+            tf.identity(y, name="out")
+
+        assert_graph_matches(
+            build,
+            {"x": np.random.default_rng(3).normal(
+                size=(2, 4, 4, 3)).astype(np.float32)},
+            "out", atol=1e-5)
+
+    def test_resize_default_mode_rejected(self):
+        g = tf1.Graph()
+        with g.as_default():
+            x = tf1.placeholder(tf.float32, [1, 4, 4, 1], name="x")
+            tf.identity(tf1.image.resize_bilinear(x, [8, 8]), name="out")
+        with pytest.raises(TFImportError, match="half_pixel_centers"):
+            import_graph(g.as_graph_def())
+
+    def test_unstack_negative_axis(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [2, 3, 4], name="x")
+            parts = tf.unstack(x, axis=-1)
+            tf.identity(parts[2], name="out")
+
+        assert_graph_matches(
+            build,
+            {"x": np.random.default_rng(5).normal(
+                size=(2, 3, 4)).astype(np.float32)},
+            "out")
