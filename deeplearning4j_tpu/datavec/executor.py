@@ -58,12 +58,15 @@ class LocalTransformExecutor:
             and len(records) >= num_workers * min_records_per_worker
         )
         if parallel and any(
-            st.spec.get("kind") == "derive_column" for st in process.steps
+            st.spec.get("kind") in (
+                "derive_column", "convert_to_sequence", "offset_sequence",
+                "trim_sequence", "sequence_moving_window_reduce",
+            ) for st in process.steps
         ):
             warnings.warn(
-                "TransformProcess contains a derive_column step (opaque "
-                "Python fn — not serializable to workers); executing "
-                "serially",
+                "TransformProcess contains a derive_column (opaque Python "
+                "fn) or sequence step (grouping crosses partition "
+                "boundaries); executing serially",
                 stacklevel=2,
             )
             parallel = False

@@ -47,11 +47,39 @@ class TransformProcess:
     def final_schema(self) -> Schema:
         return self._schemas[-1]
 
+    #: builder step kinds that operate on WHOLE sequences (after
+    #: convert_to_sequence the record stream is List[sequence] =
+    #: List[List[row]]; plain column steps map over each sequence)
+    _SEQ_KINDS = frozenset({
+        "convert_to_sequence", "offset_sequence", "trim_sequence",
+        "sequence_moving_window_reduce",
+    })
+
     def execute(self, records: Records) -> Records:
         out = [list(r) for r in records]
+        seq_mode = False
         for st, schema in zip(self.steps, self._schemas[:-1]):
-            out = st.records_fn(schema, out)
+            kind = st.spec.get("kind")
+            if kind == "convert_to_sequence":
+                out = st.records_fn(schema, out)
+                seq_mode = True
+            elif kind in self._SEQ_KINDS:
+                out = st.records_fn(schema, out)
+            elif seq_mode:
+                out = [st.records_fn(schema, seq) for seq in out]
+                # row filters may empty a sequence entirely
+                out = [seq for seq in out if seq]
+            else:
+                out = st.records_fn(schema, out)
         return out
+
+    @property
+    def emits_sequences(self) -> bool:
+        """True when execute() returns List[sequence] (the reference's
+        convertToSequence switches the pipeline to sequence records)."""
+        return any(
+            st.spec.get("kind") == "convert_to_sequence" for st in self.steps
+        )
 
     def to_json(self) -> str:
         return json.dumps(
@@ -104,6 +132,16 @@ class TransformProcess:
             self._steps.append(_Step(name, schema_fn, records_fn, spec))
             self._running_schema = schema_fn(self._current_schema())
             return self
+
+        def _require_sequence_mode(self, kind: str):
+            if not any(
+                st.spec.get("kind") == "convert_to_sequence"
+                for st in self._steps
+            ):
+                raise ValueError(
+                    f"{kind} operates on sequences; add "
+                    "convert_to_sequence(key, sort) earlier in the pipeline"
+                )
 
         # --- column selection ---------------------------------------
         def remove_columns(self, *names: str):
@@ -568,4 +606,147 @@ class TransformProcess:
             return self._add(
                 "derive_column", schema_fn, records_fn,
                 {"kind": "derive_column", "name": name, "col_type": col_type, "sources": srcs},
+            )
+
+        # --- sequence operations (the reference's convertToSequence /
+        # offset / trim / moving-window sequence transforms) -----------
+        def convert_to_sequence(self, key_column: str, sort_column: str):
+            """Group rows by key, sort each group by sort_column: the
+            record stream becomes List[sequence].  Subsequent column
+            steps apply per step-row within each sequence; sequence
+            steps below operate on whole sequences."""
+
+            def schema_fn(s: Schema) -> Schema:
+                s.index_of(key_column)
+                s.index_of(sort_column)
+                return s
+
+            def records_fn(s: Schema, recs: Records) -> Records:
+                ki, si = s.index_of(key_column), s.index_of(sort_column)
+                groups: dict = {}
+                order = []
+                for r in recs:
+                    k = r[ki]
+                    if k not in groups:
+                        groups[k] = []
+                        order.append(k)
+                    groups[k].append(r)
+                return [
+                    sorted(groups[k], key=lambda r: r[si]) for k in order
+                ]
+
+            return self._add(
+                "convert_to_sequence", schema_fn, records_fn,
+                {"kind": "convert_to_sequence", "key_column": key_column,
+                 "sort_column": sort_column},
+            )
+
+        def offset_sequence(self, columns, offset: int):
+            self._require_sequence_mode("offset_sequence")
+            """Shift the named columns by `offset` steps WITHIN each
+            sequence (positive = values move toward later steps — lag
+            features; negative = lead).  Steps that lose a value are
+            trimmed, so every emitted row is fully populated."""
+            cols = list(columns) if not isinstance(columns, str) else [columns]
+
+            def schema_fn(s: Schema) -> Schema:
+                for c in cols:
+                    s.index_of(c)
+                return s
+
+            def records_fn(s: Schema, seqs: Records) -> Records:
+                idx = [s.index_of(c) for c in cols]
+                out = []
+                for seq in seqs:
+                    n = len(seq)
+                    k = abs(offset)
+                    if n <= k:
+                        continue
+                    rows = []
+                    if offset > 0:
+                        # row t carries column value from t-offset
+                        for t in range(k, n):
+                            r = list(seq[t])
+                            for i in idx:
+                                r[i] = seq[t - k][i]
+                            rows.append(r)
+                    else:
+                        for t in range(0, n - k):
+                            r = list(seq[t])
+                            for i in idx:
+                                r[i] = seq[t + k][i]
+                            rows.append(r)
+                    out.append(rows)
+                return out
+
+            return self._add(
+                "offset_sequence", schema_fn, records_fn,
+                {"kind": "offset_sequence", "columns": cols,
+                 "offset": offset},
+            )
+
+        def trim_sequence(self, num_steps: int, from_start: bool = True):
+            self._require_sequence_mode("trim_sequence")
+            """Drop num_steps rows from the start (or end) of every
+            sequence; sequences that would empty are removed."""
+
+            def records_fn(s: Schema, seqs: Records) -> Records:
+                out = []
+                for seq in seqs:
+                    t = seq[num_steps:] if from_start else (
+                        seq[:-num_steps] if num_steps else seq
+                    )
+                    if t:
+                        out.append(t)
+                return out
+
+            return self._add(
+                "trim_sequence", lambda s: s, records_fn,
+                {"kind": "trim_sequence", "num_steps": num_steps,
+                 "from_start": from_start},
+            )
+
+        def sequence_moving_window_reduce(self, column: str, window: int,
+                                          op: str = "mean"):
+            self._require_sequence_mode("sequence_moving_window_reduce")
+            if int(window) < 1:
+                raise ValueError(f"window must be >= 1, got {window}")
+            """New column <column>_<op>_<window>: the op over the
+            TRAILING window ending at each step (fewer at the head —
+            the reference's SequenceMovingWindowReduce edge behavior)."""
+            ops = {
+                "mean": lambda v: sum(v) / len(v),
+                "sum": sum,
+                "min": min,
+                "max": max,
+            }
+            if op not in ops:
+                raise ValueError(
+                    f"unknown moving-window op {op!r}; have {sorted(ops)}"
+                )
+            new_name = f"{column}_{op}_{window}"
+
+            def schema_fn(s: Schema) -> Schema:
+                s.index_of(column)
+                return Schema(
+                    list(s.columns)
+                    + [ColumnMeta(new_name, ColumnType.DOUBLE)]
+                )
+
+            def records_fn(s: Schema, seqs: Records) -> Records:
+                ci = s.index_of(column)
+                out = []
+                for seq in seqs:
+                    rows = []
+                    for t, r in enumerate(seq):
+                        lo = max(0, t - window + 1)
+                        vals = [float(seq[u][ci]) for u in range(lo, t + 1)]
+                        rows.append(list(r) + [ops[op](vals)])
+                    out.append(rows)
+                return out
+
+            return self._add(
+                "sequence_moving_window_reduce", schema_fn, records_fn,
+                {"kind": "sequence_moving_window_reduce", "column": column,
+                 "window": window, "op": op},
             )

@@ -8,13 +8,14 @@ because both Keras and this framework use (in, out) dense kernels, HWIO conv
 kernels and channels-last feature maps (the reference had to convert
 everything to NCHW for cuDNN — that conversion is exactly what we avoid).
 
-Supported: Sequential models and linear Functional graphs, with layers
-InputLayer, Dense, Conv2D, MaxPooling2D, AveragePooling2D,
-GlobalAverage/MaxPooling1D/2D, Flatten, Dropout, Activation,
-BatchNormalization, ZeroPadding2D, Embedding, LSTM.  Both Keras-2 and
-Keras-3 legacy-H5 config dialects are handled.  Branching functional graphs
-and other layer types raise with a clear message (reference parity gap,
-tracked).
+Supported: Sequential models AND branching multi-input/multi-output
+Functional graphs (import_keras_graph → GraphModel), including
+SHARED-layer topology — a layer called on several inputs becomes one
+param set referenced by per-call graph nodes (GraphNode.param_key).
+~35 layer mappers; Keras-1, Keras-2 and Keras-3 legacy-H5 config
+dialects are all handled (K1 via _k1_normalize + per-gate weight-name
+fusion).  Unsupported layers raise with a clear message naming
+register_keras_layer as the extension point.
 """
 
 from __future__ import annotations
@@ -1008,41 +1009,44 @@ _MERGE_CLASSES = {
 }
 
 
-def _parse_inbound(ld: dict) -> List[str]:
-    """Input layer names for a functional-graph layer (first call node).
-    Handles both the Keras-2 nested-list dialect and the Keras-3
-    keras_history dialect."""
+def _parse_calls(ld: dict) -> List[List[tuple]]:
+    """ALL call nodes of a functional-graph layer, each a list of
+    (producer_name, producer_node_index) — a layer invoked k times
+    (shared layer) has k entries.  Handles both the Keras-2 nested-list
+    dialect and the Keras-3 keras_history dialect."""
     inbound = ld.get("inbound_nodes", [])
-    if not inbound:
-        return []
-    node = inbound[0]
-    names: List[str] = []
-    if isinstance(node, dict):          # keras3
-        def walk(o):
-            if isinstance(o, dict):
-                hist = o.get("config", {}).get("keras_history")
-                if hist:
-                    names.append(hist[0])
-                else:
-                    for v in o.values():
+    calls: List[List[tuple]] = []
+    for node in inbound:
+        refs: List[tuple] = []
+        if isinstance(node, dict):      # keras3
+            def walk(o):
+                if isinstance(o, dict):
+                    hist = o.get("config", {}).get("keras_history")
+                    if hist:
+                        refs.append((hist[0], int(hist[1]) if len(hist) > 1
+                                     else 0))
+                    else:
+                        for v in o.values():
+                            walk(v)
+                elif isinstance(o, (list, tuple)):
+                    for v in o:
                         walk(v)
-            elif isinstance(o, (list, tuple)):
-                for v in o:
-                    walk(v)
 
-        walk(node.get("args", []))
-    else:                               # keras2: [[name, node_idx, t_idx, {}]..]
-        for entry in node:
-            names.append(entry[0])
-    return names
+            walk(node.get("args", []))
+        else:                           # keras2: [[name, node_idx, t_idx, {}]..]
+            for entry in node:
+                refs.append((entry[0], int(entry[1]) if len(entry) > 1 else 0))
+        calls.append(refs)
+    return calls
 
 
-def _out_names(cfg: dict, key: str) -> List[str]:
-    """config['input_layers'/'output_layers'] in either dialect."""
+def _out_refs(cfg: dict, key: str) -> List[tuple]:
+    """config['input_layers'/'output_layers'] as (name, node_index) —
+    the node index picks WHICH call of a shared layer feeds the output."""
     raw = cfg.get(key, [])
     if raw and not isinstance(raw[0], list):
         raw = [raw]
-    return [r[0] for r in raw]
+    return [(r[0], int(r[1]) if len(r) > 1 else 0) for r in raw]
 
 
 def import_keras_graph(path: str):
@@ -1082,8 +1086,8 @@ def import_keras_graph(path: str):
                 raw_t.decode() if isinstance(raw_t, bytes) else raw_t
             )
 
-        graph_inputs = _out_names(cfg, "input_layers")
-        graph_outputs = _out_names(cfg, "output_layers")
+        graph_inputs = [n for n, _ in _out_refs(cfg, "input_layers")]
+        graph_outputs = _out_refs(cfg, "output_layers")
 
         b = GraphBuilder().updater(Adam(1e-3))
         alias: Dict[str, str] = {}       # structural no-op name -> source
@@ -1096,81 +1100,104 @@ def import_keras_graph(path: str):
         input_types: Dict[str, InputType] = {}
         confs: Dict[str, Any] = {}
         bn_axes: Dict[str, int] = {}
+        # (layer_name, node_index) -> vertex name: shared layers are
+        # called k times and consumers pick the call via node_index
+        call_vertex: Dict[tuple, str] = {}
+
+        def resolve_ref(pname: str, nidx: int) -> str:
+            v = call_vertex.get((pname, nidx), pname)
+            return resolve(v)
+
         for ld in layers:
             cls, lcfg = _k1_normalize(ld["class_name"], ld.get("config", {}))
             name = lcfg.get("name") or ld.get("name")
-            if len(ld.get("inbound_nodes", [])) > 1:
-                raise KerasImportError(
-                    f"layer {name!r} is called more than once (shared layer); "
-                    "shared-layer topology is not imported"
-                )
-            inputs = [resolve(n) for n in _parse_inbound(ld)]
+            calls = _parse_calls(ld)
             if cls == "InputLayer":
                 shape = _input_shape(lcfg)
                 if shape is None:
                     raise KerasImportError(f"InputLayer {name!r} has no shape")
                 input_types[name] = _itype_from_shape(shape)
+                call_vertex[(name, 0)] = name
                 continue
-            if cls in _MERGE_CLASSES:
-                b.add_vertex(
-                    name, ElementWiseVertex(op=ElementWiseOp(_MERGE_CLASSES[cls])),
-                    *inputs,
-                )
-                continue
-            if cls == "Concatenate":
-                # positive axes are validated against the input rank at
-                # graph build time (H5 dialects don't reliably carry
-                # shapes); only the trailing axis is concat-able
-                axis = lcfg.get("axis", -1)
-                b.add_vertex(
-                    name,
-                    MergeVertex(declared_axis=-1 if axis is None else int(axis)),
-                    *inputs,
-                )
-                continue
-            if cls not in _LAYER_MAPPERS:
-                raise KerasImportError(
-                    f"unsupported Keras layer {cls!r} ({name}); teach the "
-                    "importer with register_keras_layer(class_name, mapper)"
-                )
-            mapped = _LAYER_MAPPERS[cls](lcfg, name)
-            if mapped is None:           # Flatten etc.: structural no-op
+            shared = len(calls) > 1
+            for ci, call in enumerate(calls or [[]]):
+                vname = name if ci == 0 else f"{name}__call{ci}"
+                call_vertex[(name, ci)] = vname
+                inputs = [resolve_ref(p, ni) for p, ni in call]
+                if cls in _MERGE_CLASSES:
+                    b.add_vertex(
+                        vname,
+                        ElementWiseVertex(op=ElementWiseOp(_MERGE_CLASSES[cls])),
+                        *inputs,
+                    )
+                    continue
+                if cls == "Concatenate":
+                    # positive axes are validated against the input rank at
+                    # graph build time (H5 dialects don't reliably carry
+                    # shapes); only the trailing axis is concat-able
+                    axis = lcfg.get("axis", -1)
+                    b.add_vertex(
+                        vname,
+                        MergeVertex(
+                            declared_axis=-1 if axis is None else int(axis)),
+                        *inputs,
+                    )
+                    continue
+                if cls not in _LAYER_MAPPERS:
+                    raise KerasImportError(
+                        f"unsupported Keras layer {cls!r} ({name}); teach the "
+                        "importer with register_keras_layer(class_name, mapper)"
+                    )
+                mapped = _LAYER_MAPPERS[cls](lcfg, vname)
+                if mapped is None:       # Flatten etc.: structural no-op
+                    if len(inputs) != 1:
+                        raise KerasImportError(
+                            f"structural layer {name!r} must have exactly 1 "
+                            "input"
+                        )
+                    alias[vname] = inputs[0]
+                    continue
                 if len(inputs) != 1:
                     raise KerasImportError(
-                        f"structural layer {name!r} must have exactly 1 input"
+                        f"layer {name!r} ({cls}) takes 1 input, got {inputs}"
                     )
-                alias[name] = inputs[0]
-                continue
-            if len(inputs) != 1:
-                raise KerasImportError(
-                    f"layer {name!r} ({cls}) takes 1 input, got {inputs}"
-                )
-            chain = list(mapped) if isinstance(mapped, (list, tuple)) else [mapped]
-            confs[name] = chain[0]
-            if cls == "BatchNormalization":
-                bn_axes[name] = _bn_axis(lcfg)
-            b.add_layer(name, chain[0], *inputs)
-            prev = name
-            for i, extra in enumerate(chain[1:], 1):
-                en = f"{name}__post{i}"
-                b.add_layer(en, extra, prev)
-                confs[en] = extra
-                prev = en
-            if prev != name:
-                # downstream references to the Keras layer name must see
-                # the END of the chain (e.g. the LastTimeStep collapse)
-                alias[name] = prev
+                chain = list(mapped) if isinstance(mapped, (list, tuple)) \
+                    else [mapped]
+                if ci == 0:
+                    confs[name] = chain[0]
+                    if cls == "BatchNormalization":
+                        bn_axes[name] = _bn_axis(lcfg)
+                # every call of a shared layer trains/reads ONE param set,
+                # keyed by the keras layer name
+                b.add_layer(vname, chain[0], *inputs,
+                            param_key=name if shared else None)
+                prev = vname
+                for i, extra in enumerate(chain[1:], 1):
+                    en = f"{vname}__post{i}"
+                    b.add_layer(
+                        en, extra, prev,
+                        param_key=f"{name}__post{i}" if shared else None,
+                    )
+                    if ci == 0:
+                        confs[en] = extra
+                    prev = en
+                if prev != vname:
+                    # downstream references to the call must see the END
+                    # of the chain (e.g. the LastTimeStep collapse)
+                    alias[vname] = prev
 
         # output heads: promote a Dense tail to OutputLayer, else add a
         # LossLayer node per declared output (losses keyed by output name
         # in multi-output training configs)
         out_nodes: List[str] = []
-        for oname in graph_outputs:
-            oname = resolve(oname)
+        for oref_name, oref_idx in graph_outputs:
+            oname = resolve_ref(oref_name, oref_idx)
             lc = confs.get(oname)
             if isinstance(lc, Dense) and not isinstance(lc, OutputLayer):
                 act = lc.activation or Activation.IDENTITY
-                loss = _infer_loss(training_cfg, act, output_name=oname)
+                # multi-output training configs key losses by the KERAS
+                # layer name, not the per-call vertex name
+                loss = _infer_loss(training_cfg, act, output_name=oref_name)
                 promoted = OutputLayer(
                     name=lc.name, n_out=lc.n_out, has_bias=lc.has_bias,
                     activation=act, loss=loss,
@@ -1180,7 +1207,7 @@ def import_keras_graph(path: str):
                 out_nodes.append(oname)
             else:
                 act = Activation.IDENTITY
-                loss = _infer_loss(training_cfg, act, output_name=oname)
+                loss = _infer_loss(training_cfg, act, output_name=oref_name)
                 head = f"{oname}_loss"
                 b.add_layer(head, LossLayer(name=head, loss=loss,
                                             activation=act), oname)

@@ -1029,6 +1029,68 @@ class _Importer:
                 "identity", outs[2 + idx], name=o)
 
 
+    def op_Scan(self, node):
+        """ONNX Scan -> lax.scan, the natural TPU mapping: scan outputs
+        are STATICALLY shaped (length = the scan input's length), unlike
+        Loop's dynamic-trip accumulation.  Supported: scan axis 0 (the
+        default), forward or reverse directions."""
+        import jax
+        import jax.numpy as jnp
+
+        a = _attrs(node)
+        body = a["body"]
+        m = int(a["num_scan_inputs"])
+        n_state = len(node.input) - m
+        n_scan_out = len(body.output) - n_state
+        for key in ("scan_input_axes", "scan_output_axes"):
+            axes = a.get(key)
+            if axes and any(int(x) != 0 for x in axes):
+                raise ONNXImportError(
+                    f"{node.name}: Scan {key}={axes} not supported (axis 0 "
+                    "only; Transpose around the Scan instead)"
+                )
+        in_dirs = [int(d) for d in a.get("scan_input_directions",
+                                         [0] * m)]
+        out_dirs = [int(d) for d in a.get("scan_output_directions",
+                                          [0] * n_scan_out)]
+        body_fn = _OnnxSubgraphFn(self, body, f"{node.name or 'Scan'} body")
+        state0 = [self.in_var(i) for i in node.input[:n_state]]
+        xs = [self.in_var(i) for i in node.input[n_state:]]
+        caps = [self.in_var(c) for c in body_fn.captures]
+
+        def fn(*args):
+            state = args[:n_state]
+            seqs = list(args[n_state:n_state + m])
+            capt = args[n_state + m:]
+            seqs = [
+                jnp.flip(s, axis=0) if d else s
+                for s, d in zip(seqs, in_dirs)
+            ]
+
+            def step(carry, elems):
+                st = carry[:n_state]
+                cp = carry[n_state:]
+                outs = body_fn(*st, *elems, *cp)
+                return (tuple(outs[:n_state]) + cp,
+                        tuple(outs[n_state:]))
+
+            final, stacked = jax.lax.scan(
+                step, tuple(state) + tuple(capt), tuple(seqs))
+            stacked = [
+                jnp.flip(s, axis=0) if d else s
+                for s, d in zip(stacked, out_dirs)
+            ]
+            return tuple(final[:n_state]) + tuple(stacked)
+
+        outs = self.sd.py_call(
+            fn, *state0, *xs, *caps,
+            n_out=n_state + n_scan_out,
+            name=(node.output[0] or "scan") + "#scan",
+        )
+        for o, v in zip(node.output, outs):
+            self.vars[o] = self.sd.apply("identity", v, name=o)
+
+
 class _OnnxSubgraphFn:
     """An ONNX subgraph (If branch / Loop body) as a trace-time callable —
     same design as the TF importer's _SubgraphFn: formal inputs become

@@ -100,21 +100,23 @@ class GraphModel(Model):
     def init(self) -> "GraphModel":
         params, state = {}, {}
         for node in self._topo:
+            if node.pkey in params or node.pkey in state:
+                continue   # shared param_key: first call initializes
             if node.layer is None:
                 if node.vertex.HAS_PARAMS:
                     itypes = [self._types[i] for i in node.inputs]
                     p = node.vertex.init(
-                        self._stream.key(f"init/{node.name}"), itypes
+                        self._stream.key(f"init/{node.pkey}"), itypes
                     )
                     if p:
-                        params[node.name] = p
+                        params[node.pkey] = p
                 continue
             itype = self._layer_itype(node)
-            p, s = node.layer.init(self._stream.key(f"init/{node.name}"), itype)
+            p, s = node.layer.init(self._stream.key(f"init/{node.pkey}"), itype)
             if p:
-                params[node.name] = p
+                params[node.pkey] = p
             if s:
-                state[node.name] = s
+                state[node.pkey] = s
         self.params = params
         self.net_state = state
         self.opt_state = self._tx.init(params)
@@ -135,16 +137,19 @@ class GraphModel(Model):
                 x = xs[0]
                 if self._flatten[node.name]:
                     x = x.reshape(x.shape[0], -1)
-                lp = params.get(node.name, {})
-                ls = net_state.get(node.name, {})
+                lp = params.get(node.pkey, {})
+                ls = net_state.get(node.pkey, {})
                 lrng = jax.random.fold_in(rng, i) if rng is not None else None
                 y, ns = node.layer.apply(lp, ls, x, training=training, rng=lrng)
                 if ns:
-                    new_state[node.name] = ns
+                    # shared-state layers (e.g. shared BatchNorm): the
+                    # LAST call's statistics win for the step, matching
+                    # call order
+                    new_state[node.pkey] = ns
             elif node.vertex.HAS_PARAMS:
                 lrng = jax.random.fold_in(rng, i) if rng is not None else None
                 y = node.vertex.apply(
-                    xs, params=params.get(node.name, {}), training=training, rng=lrng
+                    xs, params=params.get(node.pkey, {}), training=training, rng=lrng
                 )
             else:
                 y = node.vertex.apply(xs)
@@ -152,14 +157,20 @@ class GraphModel(Model):
         return {o: acts[o] for o in self.conf.network_outputs}, new_state
 
     def _reg_loss(self, params):
+        # dedup by param_key: a shared layer's weights are penalized once
+        seen = set()
+        named = []
+        for n in self.conf.nodes:
+            if n.pkey in seen:
+                continue
+            seen.add(n.pkey)
+            if n.layer is not None:
+                named.append((n.pkey, n.layer))
+            elif n.vertex.HAS_PARAMS:
+                named.append((n.pkey, n.vertex))
         return regularization_loss(
             params,
-            [(n.name, n.layer) for n in self.conf.nodes if n.layer is not None]
-            + [
-                (n.name, n.vertex)
-                for n in self.conf.nodes
-                if n.layer is None and n.vertex.HAS_PARAMS
-            ],
+            named,
         )
 
     # -- compiled train step ----------------------------------------------
@@ -479,13 +490,13 @@ class GraphModel(Model):
                     if self._flatten[nd.name]:
                         x = x.reshape(x.shape[0], -1)
                     y, _ = nd.layer.apply(
-                        fparams.get(nd.name, {}),
-                        self.net_state.get(nd.name, {}),
+                        fparams.get(nd.pkey, {}),
+                        self.net_state.get(nd.pkey, {}),
                         x, training=False, rng=None,
                     )
                 elif nd.vertex.HAS_PARAMS:
                     y = nd.vertex.apply(
-                        xs, params=fparams.get(nd.name, {}),
+                        xs, params=fparams.get(nd.pkey, {}),
                         training=False, rng=None,
                     )
                 else:

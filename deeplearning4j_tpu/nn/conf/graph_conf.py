@@ -358,12 +358,22 @@ class AttentionVertex(VertexConfig):
 @serde.register
 @dataclasses.dataclass(frozen=True)
 class GraphNode:
-    """A named node: either a layer or a structural vertex, plus its inputs."""
+    """A named node: either a layer or a structural vertex, plus its inputs.
+
+    param_key: parameter-sharing handle — nodes with the same param_key
+    read (and train) ONE param/state set (the reference's shared-layer
+    topology, e.g. a Keras layer called on several inputs).  None = the
+    node's own name (no sharing)."""
 
     name: str = ""
     inputs: tuple[str, ...] = ()
     layer: Optional[LayerConfig] = None
     vertex: Optional[VertexConfig] = None
+    param_key: Optional[str] = None
+
+    @property
+    def pkey(self) -> str:
+        return self.param_key or self.name
 
     def __post_init__(self):
         if (self.layer is None) == (self.vertex is None):
@@ -502,9 +512,13 @@ class GraphBuilder:
         self._input_types = tuple(types)
         return self
 
-    def add_layer(self, name: str, layer: LayerConfig, *inputs: str):
+    def add_layer(self, name: str, layer: LayerConfig, *inputs: str,
+                  param_key: str | None = None):
+        """param_key: share parameters with every other node carrying the
+        same key (shared-layer topology); the layer configs must agree."""
         layer = self._fill_defaults(name, layer)
-        self._nodes.append(GraphNode(name=name, inputs=tuple(inputs), layer=layer))
+        self._nodes.append(GraphNode(name=name, inputs=tuple(inputs),
+                                     layer=layer, param_key=param_key))
         return self
 
     def add_vertex(self, name: str, vertex: VertexConfig, *inputs: str):

@@ -1,0 +1,94 @@
+"""Unit tests for bench.py's congestion-robust timing engine — the
+scoreboard machinery itself (VERDICT r3 item 1).  A scripted fake probe
+stands in for the tunnel, so the acceptance logic is testable without a
+chip."""
+
+import sys
+import os
+
+import pytest
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+import bench  # noqa: E402
+
+
+class FakeProbe:
+    def __init__(self, rates):
+        self._script = list(rates)
+        self.rates = []
+
+    def rate(self, calls=8):
+        r = self._script.pop(0) if self._script else self.rates[-1]
+        self.rates.append(r)
+        return r
+
+    @property
+    def best(self):
+        return max(self.rates)
+
+    def summary(self):
+        return {"n_probes": len(self.rates)}
+
+
+@pytest.fixture()
+def fake_probe(monkeypatch):
+    def install(rates):
+        p = FakeProbe(rates)
+        monkeypatch.setattr(bench, "_PROBE", p)
+        return p
+
+    return install
+
+
+def make_chunks(samples_each):
+    """run_chunk returning a fixed sample count instantly."""
+    calls = {"n": 0}
+
+    def chunk():
+        calls["n"] += 1
+        return samples_each
+
+    return chunk, calls
+
+
+def test_healthy_run_stops_at_min_chunks(fake_probe):
+    fake_probe([100, 99, 98, 100, 99])      # all within 20% of best
+    chunk, calls = make_chunks(64)
+    sps, meta = bench._timed_chunks(chunk, min_chunks=4, max_chunks=10)
+    assert calls["n"] == 4
+    assert meta["congested"] is False
+    assert meta["chunks"] == 4
+    assert meta["accepted_health"] >= 0.8
+    # accepted = fastest healthy chunk
+    assert meta["accepted_chunk"] == meta["chunk_rates"].index(
+        max(meta["chunk_rates"]))
+
+
+def test_congested_start_keeps_sampling_until_healthy(fake_probe):
+    # a fast first probe sets the session best; the tunnel then slumps
+    # (chunks unhealthy) and recovers — sampling must continue past
+    # min_chunks until the recovered window
+    fake_probe([100, 50, 50, 50, 50, 50, 99, 100])
+    chunk, calls = make_chunks(10)
+    sps, meta = bench._timed_chunks(chunk, min_chunks=4, max_chunks=10)
+    assert calls["n"] > 4                     # kept going
+    assert meta["congested"] is False         # eventually found a window
+    assert meta["chunk_health"][meta["accepted_chunk"]] >= 0.8
+
+
+def test_never_healthy_flags_congested(fake_probe):
+    fake_probe([100] + [40] * 30)             # burst then sustained slump
+    chunk, calls = make_chunks(10)
+    sps, meta = bench._timed_chunks(chunk, min_chunks=4, max_chunks=6)
+    assert calls["n"] == 6                    # capped
+    assert meta["congested"] is True
+    assert sps > 0                            # still reports the best chunk
+
+
+def test_mean_rate_recorded_alongside_peak(fake_probe):
+    fake_probe([100] * 12)
+    chunk, _ = make_chunks(20)
+    sps, meta = bench._timed_chunks(chunk, min_chunks=4)
+    assert meta["samples_per_sec_mean"] > 0
+    assert len(meta["chunk_rates"]) == meta["chunks"]
+    assert len(meta["chunk_health"]) == meta["chunks"]

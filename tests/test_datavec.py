@@ -671,3 +671,89 @@ class TestStringAndTimeTransforms:
         )
         tp2 = TransformProcess.from_json(tp.to_json())
         assert tp2.execute([["ab"]]) == [["AB-Z"]]
+
+
+class TestSequenceTransforms:
+    """convert_to_sequence + sequence ops (the reference's
+    convertToSequence / offset / trim / moving-window transforms)."""
+
+    def _tp(self, *extra):
+        from deeplearning4j_tpu.datavec import Schema, TransformProcess
+
+        schema = (Schema.builder()
+                  .add_string("device")
+                  .add_integer("t")
+                  .add_double("v")
+                  .build())
+        b = TransformProcess.builder(schema).convert_to_sequence(
+            "device", "t")
+        for f in extra:
+            f(b)
+        return b.build()
+
+    def _rows(self):
+        # interleaved, unsorted within device
+        return [
+            ["a", 2, 10.0], ["b", 1, 100.0], ["a", 1, 5.0],
+            ["b", 3, 300.0], ["a", 3, 20.0], ["b", 2, 200.0],
+        ]
+
+    def test_convert_groups_and_sorts(self):
+        tp = self._tp()
+        assert tp.emits_sequences
+        seqs = tp.execute(self._rows())
+        assert len(seqs) == 2
+        assert [r[2] for r in seqs[0]] == [5.0, 10.0, 20.0]
+        assert [r[2] for r in seqs[1]] == [100.0, 200.0, 300.0]
+
+    def test_offset_creates_lag_features(self):
+        tp = self._tp(lambda b: b.offset_sequence(["v"], 1))
+        seqs = tp.execute(self._rows())
+        # row t carries v from t-1; first row trimmed
+        assert [r[2] for r in seqs[0]] == [5.0, 10.0]
+        assert [r[1] for r in seqs[0]] == [2, 3]     # other cols unshifted
+
+    def test_trim_and_moving_window(self):
+        tp = self._tp(
+            lambda b: b.sequence_moving_window_reduce("v", 2, "mean"),
+            lambda b: b.trim_sequence(1, from_start=True),
+        )
+        assert tp.final_schema.index_of("v_mean_2") == 3
+        seqs = tp.execute(self._rows())
+        # seq a: means [5, 7.5, 15]; trim drops the first row
+        assert [r[3] for r in seqs[0]] == [7.5, 15.0]
+
+    def test_column_steps_apply_per_sequence_row(self):
+        tp = self._tp(
+            lambda b: b.double_math_op("v", "multiply", 2.0),
+            lambda b: b.filter_rows("v", "gte", 100.0),
+        )
+        seqs = tp.execute(self._rows())
+        # device a values doubled; the gte-100 filter removes none of them
+        assert [r[2] for r in seqs[0]] == [10.0, 20.0, 40.0]
+        # device b: 200/400/600 all removed -> empty sequence dropped
+        assert len(seqs) == 1
+
+    def test_sequence_pipeline_json_roundtrip(self):
+        from deeplearning4j_tpu.datavec import TransformProcess
+
+        tp = self._tp(
+            lambda b: b.sequence_moving_window_reduce("v", 3, "max"),
+            lambda b: b.offset_sequence(["v"], 1),
+        )
+        tp2 = TransformProcess.from_json(tp.to_json())
+        assert tp2.execute(self._rows()) == tp.execute(self._rows())
+
+    def test_executor_falls_back_to_serial(self):
+        import warnings as w
+
+        from deeplearning4j_tpu.datavec import LocalTransformExecutor
+
+        tp = self._tp()
+        rows = self._rows() * 200
+        with w.catch_warnings(record=True) as caught:
+            w.simplefilter("always")
+            out = LocalTransformExecutor.execute(
+                tp, rows, num_workers=4, min_records_per_worker=1)
+        assert any("sequence" in str(x.message) for x in caught)
+        assert out == tp.execute(rows)

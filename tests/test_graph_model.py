@@ -197,3 +197,67 @@ def test_graph_steps_per_execution_matches_per_batch():
                 rtol=2e-4, atol=1e-6,
                 err_msg=f"{k}/{p} diverged under graph steps_per_execution",
             )
+
+
+class TestSharedLayers:
+    """param_key sharing (the reference's shared-layer topology)."""
+
+    def _build(self):
+        from deeplearning4j_tpu.models.computation_graph import GraphModel
+        from deeplearning4j_tpu.nn import Adam
+        from deeplearning4j_tpu.nn.conf import Dense, InputType, OutputLayer
+        from deeplearning4j_tpu.nn.conf.graph_conf import (
+            ElementWiseOp, ElementWiseVertex, GraphBuilder)
+
+        b = (GraphBuilder().updater(Adam(1e-2))
+             .add_inputs("a", "b")
+             .set_input_types(InputType.feed_forward(6),
+                              InputType.feed_forward(6)))
+        enc = Dense(name="enc", n_out=8)
+        b.add_layer("enc", enc, "a")
+        b.add_layer("enc__call1", enc, "b", param_key="enc")
+        b.add_vertex("diff", ElementWiseVertex(op=ElementWiseOp.SUBTRACT),
+                     "enc", "enc__call1")
+        b.add_layer("out", OutputLayer(name="out", n_out=2), "diff")
+        b.set_outputs("out")
+        return GraphModel(b.build()).init()
+
+    def test_one_param_set_and_tied_outputs(self):
+        import numpy as np
+
+        model = self._build()
+        assert "enc" in model.params and "enc__call1" not in model.params
+        x = np.random.default_rng(0).normal(size=(4, 6)).astype(np.float32)
+        # identical inputs through the SHARED encoder -> the subtract
+        # vertex output is exactly zero, so pre-activation logits equal
+        # the output bias alone — for ANY input
+        pre = np.asarray(model.output(x, x))
+        x2 = np.random.default_rng(1).normal(size=(4, 6)).astype(np.float32)
+        outs2 = np.asarray(model.output(x2, x2))
+        np.testing.assert_allclose(pre, outs2, atol=1e-5)
+        diff = np.asarray(model._forward(
+            model.params, model.net_state,
+            {"a": x, "b": x}, training=False, rng=None)[0]["out"])
+        import jax.nn
+
+        bias_only = np.asarray(jax.nn.softmax(
+            model.params["out"]["b"].astype(np.float32)))
+        np.testing.assert_allclose(pre, np.broadcast_to(bias_only, pre.shape),
+                                   atol=1e-5)
+
+    def test_shared_training_moves_single_copy(self):
+        import numpy as np
+
+        from deeplearning4j_tpu.data.dataset import MultiDataSet
+
+        model = self._build()
+        rng = np.random.default_rng(2)
+        a = rng.normal(size=(16, 6)).astype(np.float32)
+        bfeat = rng.normal(size=(16, 6)).astype(np.float32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 16)]
+        w0 = np.asarray(model.params["enc"]["W"]).copy()
+        for _ in range(3):
+            model.fit_batch(MultiDataSet([a, bfeat], [y]))
+        w1 = np.asarray(model.params["enc"]["W"])
+        assert not np.allclose(w0, w1)          # trained
+        assert set(model.params) == {"enc", "out"}

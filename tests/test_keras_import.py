@@ -522,3 +522,85 @@ class TestRound3LayerBreadth:
         ours = import_keras_model(save_h5(km, tmp_path))
         x = np.random.default_rng(6).normal(size=(3, 12, 4)).astype(np.float32)
         assert_outputs_match(km, ours, x)
+
+
+class TestSharedLayerImport:
+    """Shared-layer functional topology (a layer called on several
+    inputs) imports with ONE param set via GraphNode.param_key."""
+
+    def test_siamese_shared_encoder(self, tmp_path):
+        keras = tf.keras
+        rng = np.random.default_rng(0)
+        enc = keras.layers.Dense(8, activation="relu", name="enc")
+        in_a = keras.layers.Input((6,), name="ia")
+        in_b = keras.layers.Input((6,), name="ib")
+        ea, eb = enc(in_a), enc(in_b)
+        merged = keras.layers.concatenate([ea, eb])
+        out = keras.layers.Dense(3, name="head")(merged)
+        m = keras.Model([in_a, in_b], out)
+        p = str(tmp_path / "siamese.h5")
+        m.save(p)
+
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+
+        gm = import_keras_graph(p)
+        assert "enc" in gm.params and "enc__call1" not in gm.params
+        xa = rng.normal(size=(4, 6)).astype(np.float32)
+        xb = rng.normal(size=(4, 6)).astype(np.float32)
+        want = np.asarray(m([xa, xb], training=False))
+        got = np.asarray(gm.output(xa, xb))
+        np.testing.assert_allclose(got, want, atol=2e-4, rtol=1e-3)
+
+    def test_shared_lstm_chain(self, tmp_path):
+        """Shared layer whose mapper emits a CHAIN (LSTM + LastTimeStep)."""
+        keras = tf.keras
+        rng = np.random.default_rng(1)
+        enc = keras.layers.LSTM(5, name="lenc")
+        a = keras.layers.Input((7, 4), name="xa")
+        b = keras.layers.Input((7, 4), name="xb")
+        d = keras.layers.subtract([enc(a), enc(b)])
+        out = keras.layers.Dense(2, name="head")(d)
+        m = keras.Model([a, b], out)
+        p = str(tmp_path / "shared_lstm.h5")
+        m.save(p)
+
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+
+        gm = import_keras_graph(p)
+        assert "lenc" in gm.params
+        xa = rng.normal(size=(3, 7, 4)).astype(np.float32)
+        xb = rng.normal(size=(3, 7, 4)).astype(np.float32)
+        want = np.asarray(m([xa, xb], training=False))
+        got = np.asarray(gm.output(xa, xb))
+        np.testing.assert_allclose(got, want, atol=3e-4, rtol=1e-3)
+        # identical inputs through tied encoders cancel exactly
+        same = np.asarray(gm.output(xa, xa))
+        base = np.asarray(gm.output(xb, xb))
+        np.testing.assert_allclose(same, base, atol=1e-5)
+
+    def test_output_from_second_call(self, tmp_path):
+        """A graph output produced by a NON-first call of a shared layer
+        must wire to that call's vertex (r4 review finding)."""
+        keras = tf.keras
+        rng = np.random.default_rng(2)
+        enc = keras.layers.Dense(4, name="enc2")
+        a = keras.layers.Input((5,), name="pa")
+        b2 = keras.layers.Input((5,), name="pb")
+        ya = enc(a)
+        yb = enc(b2)
+        m = keras.Model([a, b2], [ya, yb])
+        p = str(tmp_path / "two_out.h5")
+        m.save(p)
+
+        from deeplearning4j_tpu.modelimport.keras import import_keras_graph
+
+        gm = import_keras_graph(p)
+        xa = rng.normal(size=(3, 5)).astype(np.float32)
+        xb = rng.normal(size=(3, 5)).astype(np.float32)
+        wa, wb = (np.asarray(t) for t in m([xa, xb], training=False))
+        got = gm.output(xa, xb)
+        np.testing.assert_allclose(np.asarray(got[0]), wa, atol=2e-4,
+                                   rtol=1e-3)
+        # the second output must be enc(xb), NOT a rewire of enc(xa)
+        np.testing.assert_allclose(np.asarray(got[1]), wb, atol=2e-4,
+                                   rtol=1e-3)

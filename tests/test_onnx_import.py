@@ -636,3 +636,57 @@ class TestOnnxControlFlow:
             np.asarray(sd.output({"x": xp}, "y")), xp * 2.0, atol=1e-6)
         np.testing.assert_allclose(
             np.asarray(sd.output({"x": -xp}, "y")), -xp, atol=1e-6)
+
+    def test_scan_cumulative_state_and_stacked_outputs(self):
+        """Scan -> lax.scan: running sum state + per-step scan output."""
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+
+        # body: (acc, x_t) -> (acc + x_t, acc + x_t)   [state, scan_out]
+        body = make_graph(
+            [make_node("Add", ["acc", "x_t"], ["acc_out"]),
+             make_node("Identity", ["acc_out"], ["y_t"])],
+            ["acc", "x_t"], ["acc_out", "y_t"], name="body",
+        )
+        raw = make_model(
+            [make_node("Scan", ["acc0", "xs"], ["acc_final", "ys"],
+                       body=body, num_scan_inputs=1)],
+            [("acc0", (2,)), ("xs", (5, 2))], ["acc_final", "ys"],
+        )
+        sd = import_onnx(raw)
+        a0 = np.zeros(2, np.float32)
+        xs = np.arange(10, dtype=np.float32).reshape(5, 2)
+        want = np.cumsum(xs, axis=0)
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"acc0": a0, "xs": xs}, "ys")), want,
+            atol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"acc0": a0, "xs": xs}, "acc_final")),
+            want[-1], atol=1e-6)
+
+    def test_scan_reverse_direction(self):
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+
+        body = make_graph(
+            [make_node("Add", ["acc", "x_t"], ["acc_out"]),
+             make_node("Identity", ["acc_out"], ["y_t"])],
+            ["acc", "x_t"], ["acc_out", "y_t"], name="body",
+        )
+        raw = make_model(
+            [make_node("Scan", ["acc0", "xs"], ["acc_final", "ys"],
+                       body=body, num_scan_inputs=1,
+                       scan_input_directions=[1],
+                       scan_output_directions=[1])],
+            [("acc0", (3,)), ("xs", (4, 3))], ["acc_final", "ys"],
+        )
+        sd = import_onnx(raw)
+        a0 = np.zeros(3, np.float32)
+        xs = np.arange(12, dtype=np.float32).reshape(4, 3)
+        # reverse input + reverse output = suffix sums aligned to input
+        want = np.cumsum(xs[::-1], axis=0)[::-1]
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"acc0": a0, "xs": xs}, "ys")), want,
+            atol=1e-6)
