@@ -635,17 +635,35 @@ class SameDiff:
         if name not in self._values:
             raise KeyError(name)
         self._values[name] = jnp.asarray(value, self._values[name].dtype)
+        # source-backed save must persist runtime-mutated values even when
+        # re-import would regenerate the ORIGINAL (see _save_source_backed)
+        self._mutated_values = getattr(self, "_mutated_values", set())
+        self._mutated_values.add(name)
         self._compiled.clear()
 
     # -- serialization (the .fb save/load role) ----------------------------
+    _CF_OPS = ("_cond", "_while", "_pyfunc")
+
     def save(self, path: str) -> None:
-        for n in self._ops:
-            if n.op in ("_cond", "_while", "_pyfunc"):
+        cf_idx = [i for i, n in enumerate(self._ops) if n.op in self._CF_OPS]
+        if cf_idx:
+            src = getattr(self, "import_source", None)
+            n_imp = getattr(self, "_import_op_count", None)
+            if src is None or n_imp is None:
                 raise ValueError(
                     "graphs containing control-flow lambdas (if_cond/"
                     "while_loop/py_call) hold Python callables and cannot be "
-                    "serialized; rebuild the graph in code after load"
+                    "serialized; rebuild the graph in code after load "
+                    "(IMPORTED graphs save fine — the TF/ONNX importers "
+                    "attach the source bytes and save() re-imports on load)"
                 )
+            if any(i >= n_imp for i in cf_idx):
+                raise ValueError(
+                    "control-flow ops added AFTER import cannot be "
+                    "serialized; keep post-import additions to plain "
+                    "registry ops"
+                )
+            return self._save_source_backed(path, src, n_imp)
         graph = {
             "placeholders": sorted(self._placeholders),
             "trainable": sorted(self._trainable),
@@ -674,10 +692,93 @@ class SameDiff:
             np.savez(buf, **{n: np.asarray(self._values[n]) for n in names})
             zf.writestr("values.npz", buf.getvalue())
 
+    def _save_source_backed(self, path: str, src: dict, n_imp: int) -> None:
+        """Checkpoint an IMPORTED graph with control flow: the original
+        TF/ONNX bytes ARE the graph serialization (the reference stores
+        imported frames the same way — by their source format); this zip
+        adds the fine-tuned values and any post-import plain ops (loss
+        heads), replayed on load after re-import."""
+        post_ops = self._ops[n_imp:]
+        imported_names = getattr(self, "_import_value_names", set())
+        extra_values = sorted(
+            (set(self._values) - set(imported_names))
+            | self._trainable
+            | getattr(self, "_mutated_values", set())
+        )
+        manifest = {
+            "kind": src["kind"],
+            "trainable": bool(src.get("trainable", False)),
+            "placeholders": sorted(self._placeholders),
+            "trainable_names": sorted(self._trainable),
+            "loss_var": self._loss_var,
+            "counter": self._counter,
+            "post_ops": [
+                {
+                    "op": n.op,
+                    "inputs": list(n.inputs),
+                    "output": n.output,
+                    "attrs": _jsonify_attrs(n.attrs),
+                }
+                for n in post_ops
+            ],
+            "training_config": serde.to_jsonable(self._training_config)
+            if self._training_config
+            else None,
+        }
+        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+            zf.writestr("import_manifest.json", json.dumps(manifest, indent=2))
+            zf.writestr("import_source.bin", src["raw"])
+            buf = io.BytesIO()
+            np.savez(buf, **{n: np.asarray(self._values[n])
+                             for n in extra_values})
+            zf.writestr("values.npz", buf.getvalue())
+
+    @staticmethod
+    def _load_source_backed(zf) -> "SameDiff":
+        man = json.loads(zf.read("import_manifest.json"))
+        raw = zf.read("import_source.bin")
+        if man["kind"] == "tf":
+            from deeplearning4j_tpu.modelimport.tensorflow import import_graph
+
+            sd = import_graph(raw, trainable=man["trainable"])
+        elif man["kind"] == "onnx":
+            from deeplearning4j_tpu.modelimport.onnx import import_onnx
+
+            sd = import_onnx(raw, trainable=man["trainable"])
+        else:
+            raise ValueError(f"unknown import_source kind {man['kind']!r}")
+        data = np.load(io.BytesIO(zf.read("values.npz")), allow_pickle=False)
+        for name in man["placeholders"]:
+            if name not in sd._placeholders:
+                sd.placeholder(name)
+        # post-import values (head weights etc.) that re-import didn't make
+        for name in data.files:
+            if name not in sd._values:
+                if name in man["trainable_names"]:
+                    sd.var(name, data[name])
+                else:
+                    sd.constant(name, data[name])
+        for n in man["post_ops"]:
+            node = _OpNode(n["op"], tuple(n["inputs"]), n["output"],
+                           _unjsonify_attrs(n["attrs"]))
+            sd._ops.append(node)
+            if node.output not in sd._vars:
+                sd._vars[node.output] = SDVariable(sd, node.output, "op")
+        # fine-tuned values overwrite the re-imported initials
+        for name in data.files:
+            sd._values[name] = jnp.asarray(data[name])
+        sd._loss_var = man.get("loss_var")
+        sd._counter = max(man.get("counter", 0), sd._counter)
+        if man.get("training_config"):
+            sd.set_training_config(serde.from_jsonable(man["training_config"]))
+        return sd
+
     @staticmethod
     def load(path: str) -> "SameDiff":
         sd = SameDiff()
         with zipfile.ZipFile(path, "r") as zf:
+            if "import_manifest.json" in zf.namelist():
+                return SameDiff._load_source_backed(zf)
             graph = json.loads(zf.read("graph.json"))
             data = np.load(io.BytesIO(zf.read("values.npz")), allow_pickle=False)
         for name in graph["placeholders"]:

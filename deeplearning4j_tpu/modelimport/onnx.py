@@ -935,6 +935,232 @@ class _Importer:
                           block=int(_attrs(node)["blocksize"]))
         self._emit_nchw(node, y)
 
+    # -- recurrent ops (ONNX LSTM/GRU/RNN — exported speech/NLP models
+    # carry these as single fused nodes; they lower to one lax.scan per
+    # direction, the same structure as the DSL recurrent layers) --------
+    def _rnn_common(self, node, n_gates):
+        a = _attrs(node)
+        H = int(a["hidden_size"])
+        direction = a.get("direction", "forward")
+        if direction not in ("forward", "reverse", "bidirectional"):
+            raise ONNXImportError(f"{node.name}: direction {direction!r}?")
+        if a.get("clip"):
+            raise ONNXImportError(
+                f"{node.name}: clip attribute not supported (imports would "
+                "compute unclipped gates — numerically different)"
+            )
+        n_dirs = 2 if direction == "bidirectional" else 1
+        W = self.static_value(node.input[1])     # (dirs, G*H, in)
+        R = self.static_value(node.input[2])     # (dirs, G*H, H)
+        B = None
+        if len(node.input) > 3 and node.input[3]:
+            B = self.static_value(node.input[3])  # (dirs, 2*G*H)
+        if len(node.input) > 4 and node.input[4]:
+            raise ONNXImportError(
+                f"{node.name}: per-example sequence_lens not supported — "
+                "pad and mask downstream instead"
+            )
+        if W.shape[0] != n_dirs or W.shape[1] != n_gates * H:
+            raise ONNXImportError(
+                f"{node.name}: W shape {W.shape} inconsistent with "
+                f"hidden_size={H}, direction={direction}"
+            )
+        return a, H, direction, n_dirs, W, R, B
+
+    def _rnn_states(self, node, n_states):
+        """Optional initial-state inputs at positions 5..: respect EMPTY
+        slots positionally (an absent initial_h with a present initial_c
+        must not shift), reject anything past the supported count (e.g.
+        LSTM peephole P at input 7)."""
+        states = []
+        for k in range(n_states):
+            idx = 5 + k
+            if len(node.input) > idx and node.input[idx]:
+                states.append(self.in_var(node.input[idx]))
+            else:
+                states.append(None)
+        extra = [i for i in node.input[5 + n_states:] if i]
+        if extra:
+            raise ONNXImportError(
+                f"{node.name}: unsupported optional inputs {extra} "
+                "(peephole weights are not implemented)"
+            )
+        return states
+
+    def _rnn_emit(self, node, n_dirs, direction, H, dirs, make_cell,
+                  n_carry, n_states):
+        """Shared per-direction scan driver.
+
+        make_cell(dir_params) -> cell(carry_tuple, x_t) -> (carry, y);
+        carry arity n_carry (1 = h, 2 = (h, c)).  Emits Y (T, dirs, B, H)
+        plus one (dirs, B, H) output per carry slot."""
+        import jax
+        import jax.numpy as jnp
+
+        rev = [direction == "reverse"] + ([True] if n_dirs == 2 else [])
+        states = self._rnn_states(node, n_states)
+        present = [s for s in states if s is not None]
+        mask = [s is not None for s in states]
+
+        def fn(x, *init_vals):
+            it = iter(init_vals)
+            inits = [
+                next(it) if m else None for m in mask
+            ]
+            Bz = x.shape[1]
+            zeros = jnp.zeros((n_dirs, Bz, H), x.dtype)
+            inits = [z if z is not None else zeros for z in inits]
+            ys = []
+            finals = [[] for _ in range(n_carry)]
+            for d in range(n_dirs):
+                xs = jnp.flip(x, 0) if rev[d] else x
+                cell = make_cell(dirs[d])
+                carry0 = tuple(inits[k][d] for k in range(n_carry))
+                carryf, y = jax.lax.scan(cell, carry0, xs)
+                ys.append(jnp.flip(y, 0) if rev[d] else y)
+                for k in range(n_carry):
+                    finals[k].append(carryf[k])
+            return (jnp.stack(ys, axis=1),) + tuple(
+                jnp.stack(f, axis=0) for f in finals
+            )
+
+        X = self.in_var(node.input[0])
+        outs = self.sd.py_call(
+            fn, X, *present, n_out=1 + n_carry,
+            name=(node.output[0] or node.name or "rnn") + "#rnn",
+        )
+        for o, v in zip(node.output, outs):
+            if o:
+                self.vars[o] = self.sd.apply("identity", v, name=o)
+
+    def op_LSTM(self, node):
+        import jax
+        import jax.numpy as jnp
+
+        a, H, direction, n_dirs, W, R, B = self._rnn_common(node, 4)
+        if a.get("activations") not in (None, ["Sigmoid", "Tanh", "Tanh"]
+                                        * n_dirs):
+            raise ONNXImportError(
+                f"{node.name}: only default activations "
+                "(sigmoid, tanh, tanh) import"
+            )
+        if a.get("input_forget"):
+            raise ONNXImportError(
+                f"{node.name}: input_forget coupling not supported"
+            )
+
+        def prep(d):
+            # ONNX packs gate rows [i, o, f, c]; our cell order is
+            # z-slices [i, f, c, o]
+            def reorder(m):
+                i, o, f, c = np.split(m, 4, axis=0)
+                return np.concatenate([i, f, c, o], axis=0)
+
+            wx = reorder(W[d]).T.astype(np.float32)      # (in, 4H)
+            wh = reorder(R[d]).T.astype(np.float32)      # (H, 4H)
+            if B is not None:
+                b = (reorder(B[d][:4 * H, None])[:, 0]
+                     + reorder(B[d][4 * H:, None])[:, 0]).astype(np.float32)
+            else:
+                b = np.zeros(4 * H, np.float32)
+            return jnp.asarray(wx), jnp.asarray(wh), jnp.asarray(b)
+
+        def make_cell(p):
+            wx, wh, b = p
+
+            def cell(carry, xt):
+                h, c = carry
+                z = xt @ wx + h @ wh + b
+                i = jax.nn.sigmoid(z[..., :H])
+                f = jax.nn.sigmoid(z[..., H:2 * H])
+                g = jnp.tanh(z[..., 2 * H:3 * H])
+                o = jax.nn.sigmoid(z[..., 3 * H:])
+                c2 = f * c + i * g
+                h2 = o * jnp.tanh(c2)
+                return (h2, c2), h2
+
+            return cell
+
+        self._rnn_emit(node, n_dirs, direction, H,
+                       [prep(d) for d in range(n_dirs)], make_cell,
+                       n_carry=2, n_states=2)
+
+    def op_GRU(self, node):
+        import jax
+        import jax.numpy as jnp
+
+        a, H, direction, n_dirs, W, R, B = self._rnn_common(node, 3)
+        if not a.get("linear_before_reset", 0):
+            raise ONNXImportError(
+                f"{node.name}: GRU with linear_before_reset=0 computes "
+                "(r*h)@R — a different cell; re-export with "
+                "linear_before_reset=1 (the keras/cuDNN-compatible form)"
+            )
+
+        def prep(d):
+            wx = W[d].T.astype(np.float32)               # (in, 3H) [z r h]
+            wh = R[d].T.astype(np.float32)
+            if B is not None:
+                wb = B[d][:3 * H].astype(np.float32)
+                rb = B[d][3 * H:].astype(np.float32)
+            else:
+                wb = rb = np.zeros(3 * H, np.float32)
+            return (jnp.asarray(wx), jnp.asarray(wh), jnp.asarray(wb),
+                    jnp.asarray(rb))
+
+        def make_cell(p):
+            wx, wh, wb, rb = p
+
+            def cell(carry, xt):
+                (h,) = carry
+                zi = xt @ wx + wb
+                zh = h @ wh + rb
+                z = jax.nn.sigmoid(zi[..., :H] + zh[..., :H])
+                r = jax.nn.sigmoid(zi[..., H:2 * H] + zh[..., H:2 * H])
+                n = jnp.tanh(zi[..., 2 * H:] + r * zh[..., 2 * H:])
+                h2 = (1 - z) * n + z * h
+                return (h2,), h2
+
+            return cell
+
+        self._rnn_emit(node, n_dirs, direction, H,
+                       [prep(d) for d in range(n_dirs)], make_cell,
+                       n_carry=1, n_states=1)
+
+    def op_RNN(self, node):
+        import jax
+        import jax.numpy as jnp
+
+        a, H, direction, n_dirs, W, R, B = self._rnn_common(node, 1)
+        acts = a.get("activations")
+        if acts not in (None, ["Tanh"] * n_dirs):
+            raise ONNXImportError(
+                f"{node.name}: only Tanh RNN activations import"
+            )
+
+        def prep(d):
+            wx = W[d].T.astype(np.float32)
+            wh = R[d].T.astype(np.float32)
+            b = (
+                (B[d][:H] + B[d][H:]).astype(np.float32)
+                if B is not None else np.zeros(H, np.float32)
+            )
+            return jnp.asarray(wx), jnp.asarray(wh), jnp.asarray(b)
+
+        def make_cell(p):
+            wx, wh, b = p
+
+            def cell(carry, xt):
+                (h,) = carry
+                h2 = jnp.tanh(xt @ wx + h @ wh + b)
+                return (h2,), h2
+
+            return cell
+
+        self._rnn_emit(node, n_dirs, direction, H,
+                       [prep(d) for d in range(n_dirs)], make_cell,
+                       n_carry=1, n_states=1)
+
     # -- control flow (If / Loop — the reference imports ONNX subgraph
     # bodies; here they become lax.cond / lax.while_loop inside the same
     # compiled program, mirroring the TF importer's design) ----------------
@@ -1173,7 +1399,16 @@ def import_onnx(path_or_bytes, trainable: bool = False) -> SameDiff:
         with open(m, "rb") as f:
             m = f.read()
     if isinstance(m, bytes):
+        raw = m
         proto = pb.ModelProto()
         proto.ParseFromString(m)
         m = proto
-    return _Importer(m, trainable=trainable).run()
+    else:
+        raw = m.SerializeToString()
+    sd = _Importer(m, trainable=trainable).run()
+    # source-backed serde: the original bytes ARE the graph serialization
+    # for imported control flow (SameDiff.save re-imports them on load)
+    sd.import_source = {"kind": "onnx", "raw": raw, "trainable": trainable}
+    sd._import_op_count = len(sd._ops)
+    sd._import_value_names = set(sd._values)
+    return sd

@@ -24,8 +24,9 @@ through imported while loops is not supported (lax.while_loop is
 forward-only); trainable fine-tuning requires the loss not depend on a loop
 output.
 
-ONNX import is gated: the `onnx` package is not available in this
-environment (`import_onnx` raises ImportError with guidance).
+Serde: imported graphs (including ones with control flow) checkpoint via
+SameDiff.save() — the original frozen bytes ship inside the zip and load()
+re-imports them, then overlays fine-tuned values and post-import ops.
 """
 
 from __future__ import annotations
@@ -1210,6 +1211,7 @@ def import_graph(path_or_graphdef, trainable: bool = False) -> SameDiff:
     `sd.set_loss` + `set_training_config`, then `fit`).
     """
     gd = path_or_graphdef
+    raw = None
     if isinstance(gd, (str, bytes)) or hasattr(gd, "read"):
         # self-contained wire codec (modelimport/_tf) — frozen .pb files
         # import WITHOUT a tensorflow installation, mirroring the ONNX
@@ -1219,13 +1221,22 @@ def import_graph(path_or_graphdef, trainable: bool = False) -> SameDiff:
         proto = tf_graph_subset_pb2.GraphDef()
         if isinstance(gd, str):
             with open(gd, "rb") as f:
-                proto.ParseFromString(f.read())
+                raw = f.read()
         elif isinstance(gd, bytes):
-            proto.ParseFromString(gd)
+            raw = gd
         else:
-            proto.ParseFromString(gd.read())
+            raw = gd.read()
+        proto.ParseFromString(raw)
         gd = proto
-    return _Importer(gd, trainable=trainable).run()
+    else:
+        raw = gd.SerializeToString()
+    sd = _Importer(gd, trainable=trainable).run()
+    # source-backed serde: the original bytes ARE the graph serialization
+    # for imported control flow (SameDiff.save re-imports them on load)
+    sd.import_source = {"kind": "tf", "raw": raw, "trainable": trainable}
+    sd._import_op_count = len(sd._ops)
+    sd._import_value_names = set(sd._values)
+    return sd
 
 
 def import_onnx(path, trainable: bool = False) -> SameDiff:

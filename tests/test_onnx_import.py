@@ -690,3 +690,254 @@ class TestOnnxControlFlow:
         np.testing.assert_allclose(
             np.asarray(sd.output({"acc0": a0, "xs": xs}, "ys")), want,
             atol=1e-6)
+
+
+class TestOnnxRecurrentOps:
+    """Fused ONNX LSTM/GRU/RNN nodes -> one lax.scan per direction;
+    goldens computed with torch's reference cells."""
+
+    def _run(self, raw, feeds, *fetches):
+        sd = import_onnx(raw)
+        return [np.asarray(sd.output(feeds, f)) for f in fetches]
+
+    def test_lstm_forward_matches_torch(self):
+        import torch
+
+        from onnx_fixtures import make_model, make_node
+
+        T, B, I, H = 6, 3, 4, 5
+        torch.manual_seed(0)
+        m = torch.nn.LSTM(I, H)
+        x = torch.randn(T, B, I)
+        want_y, (want_h, want_c) = m(x)
+
+        # torch packs rows [i, f, g, o]; ONNX wants [i, o, f, c]
+        def pack(w):
+            i, f, g, o = np.split(w.detach().numpy(), 4, axis=0)
+            return np.concatenate([i, o, f, g], axis=0)[None]
+
+        W = pack(m.weight_ih_l0)
+        R = pack(m.weight_hh_l0)
+        bi, bh = (pack(b[:, None])[..., 0] for b in
+                  (m.bias_ih_l0, m.bias_hh_l0))
+        Bv = np.concatenate([bi, bh], axis=1)
+        raw = make_model(
+            [make_node("LSTM", ["x", "W", "R", "B"], ["Y", "Y_h", "Y_c"],
+                       hidden_size=H)],
+            [("x", (T, B, I))], ["Y", "Y_h", "Y_c"],
+            initializers={"W": W.astype(np.float32),
+                          "R": R.astype(np.float32),
+                          "B": Bv.astype(np.float32)},
+        )
+        y, yh, yc = self._run(raw, {"x": x.numpy()}, "Y", "Y_h", "Y_c")
+        np.testing.assert_allclose(y[:, 0], want_y.detach().numpy(),
+                                   atol=2e-5, rtol=1e-4)
+        np.testing.assert_allclose(yh, want_h.detach().numpy(),
+                                   atol=2e-5, rtol=1e-4)
+        np.testing.assert_allclose(yc, want_c.detach().numpy(),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_gru_linear_before_reset_matches_torch(self):
+        import torch
+
+        from onnx_fixtures import make_model, make_node
+
+        T, B, I, H = 5, 2, 3, 4
+        torch.manual_seed(1)
+        m = torch.nn.GRU(I, H)
+        x = torch.randn(T, B, I)
+        want_y, want_h = m(x)
+
+        # torch rows [r, z, n] -> ONNX [z, r, h]
+        def pack(w):
+            r, z, n = np.split(w.detach().numpy(), 3, axis=0)
+            return np.concatenate([z, r, n], axis=0)[None]
+
+        W = pack(m.weight_ih_l0)
+        R = pack(m.weight_hh_l0)
+        bi, bh = (pack(b[:, None])[..., 0] for b in
+                  (m.bias_ih_l0, m.bias_hh_l0))
+        Bv = np.concatenate([bi, bh], axis=1)
+        raw = make_model(
+            [make_node("GRU", ["x", "W", "R", "B"], ["Y", "Y_h"],
+                       hidden_size=H, linear_before_reset=1)],
+            [("x", (T, B, I))], ["Y", "Y_h"],
+            initializers={"W": W.astype(np.float32),
+                          "R": R.astype(np.float32),
+                          "B": Bv.astype(np.float32)},
+        )
+        y, yh = self._run(raw, {"x": x.numpy()}, "Y", "Y_h")
+        np.testing.assert_allclose(y[:, 0], want_y.detach().numpy(),
+                                   atol=2e-5, rtol=1e-4)
+        np.testing.assert_allclose(yh, want_h.detach().numpy(),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_bidirectional_rnn_matches_torch(self):
+        import torch
+
+        from onnx_fixtures import make_model, make_node
+
+        T, B, I, H = 4, 2, 3, 3
+        torch.manual_seed(2)
+        m = torch.nn.RNN(I, H, bidirectional=True)
+        x = torch.randn(T, B, I)
+        want_y, want_h = m(x)   # (T, B, 2H), (2, B, H)
+
+        def one(w):
+            return w.detach().numpy()[None]
+
+        W = np.concatenate([one(m.weight_ih_l0),
+                            one(m.weight_ih_l0_reverse)], axis=0)
+        R = np.concatenate([one(m.weight_hh_l0),
+                            one(m.weight_hh_l0_reverse)], axis=0)
+        Bv = np.stack([
+            np.concatenate([m.bias_ih_l0.detach().numpy(),
+                            m.bias_hh_l0.detach().numpy()]),
+            np.concatenate([m.bias_ih_l0_reverse.detach().numpy(),
+                            m.bias_hh_l0_reverse.detach().numpy()]),
+        ])
+        raw = make_model(
+            [make_node("RNN", ["x", "W", "R", "B"], ["Y", "Y_h"],
+                       hidden_size=H, direction="bidirectional")],
+            [("x", (T, B, I))], ["Y", "Y_h"],
+            initializers={"W": W.astype(np.float32),
+                          "R": R.astype(np.float32),
+                          "B": Bv.astype(np.float32)},
+        )
+        y, yh = self._run(raw, {"x": x.numpy()}, "Y", "Y_h")
+        got = np.concatenate([y[:, 0], y[:, 1]], axis=-1)
+        np.testing.assert_allclose(got, want_y.detach().numpy(),
+                                   atol=2e-5, rtol=1e-4)
+        np.testing.assert_allclose(yh, want_h.detach().numpy(),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_gru_reset_before_rejected(self):
+        from onnx_fixtures import make_model, make_node
+
+        raw = make_model(
+            [make_node("GRU", ["x", "W", "R"], ["Y"], hidden_size=2)],
+            [("x", (3, 1, 2))], ["Y"],
+            initializers={"W": np.zeros((1, 6, 2), np.float32),
+                          "R": np.zeros((1, 6, 2), np.float32)},
+        )
+        with pytest.raises(ONNXImportError, match="linear_before_reset"):
+            import_onnx(raw)
+
+
+class TestOnnxSourceBackedSerde:
+    def test_loop_model_roundtrips_through_zip(self, tmp_path):
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        body = make_graph(
+            [make_node("Mul", ["v", "two"], ["v2"]),
+             make_node("Add", ["v2", "one"], ["v_out"]),
+             make_node("Identity", ["cond_in"], ["cond_out"])],
+            ["iter_num", "cond_in", "v"], ["cond_out", "v_out"],
+            initializers={"two": np.float32(2.0), "one": np.float32(1.0)},
+            name="b")
+        raw = make_model(
+            [make_node("Loop", ["M", "cond0", "x"], ["y"], body=body)],
+            [("x", (3,))], ["y"],
+            initializers={"M": np.int64(3), "cond0": np.bool_(True)})
+        sd = import_onnx(raw)
+        xv = np.array([1.0, 0.0, -1.0], np.float32)
+        want = np.asarray(sd.output({"x": xv}, "y"))
+        p = str(tmp_path / "loop.sd.zip")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        np.testing.assert_allclose(
+            np.asarray(sd2.output({"x": xv}, "y")), want, atol=1e-6)
+
+    def test_initial_states_respect_empty_slots(self):
+        """initial_c WITHOUT initial_h: the empty slot must not shift
+        (r4 review finding — c0 was silently used as h0)."""
+        import torch
+
+        from onnx_fixtures import make_model, make_node
+
+        T, B, I, H = 4, 2, 3, 4
+        torch.manual_seed(3)
+        m = torch.nn.LSTM(I, H)
+        x = torch.randn(T, B, I)
+        c0 = torch.randn(1, B, H)
+        h0 = torch.zeros(1, B, H)
+        want_y, _ = m(x, (h0, c0))
+
+        def pack(w):
+            i, f, g, o = np.split(w.detach().numpy(), 4, axis=0)
+            return np.concatenate([i, o, f, g], axis=0)[None]
+
+        W, R = pack(m.weight_ih_l0), pack(m.weight_hh_l0)
+        bi, bh = (pack(b[:, None])[..., 0] for b in
+                  (m.bias_ih_l0, m.bias_hh_l0))
+        raw = make_model(
+            [make_node("LSTM", ["x", "W", "R", "B", "", "", "c0"], ["Y"],
+                       hidden_size=H)],
+            [("x", (T, B, I)), ("c0", (1, B, H))], ["Y"],
+            initializers={"W": W.astype(np.float32),
+                          "R": R.astype(np.float32),
+                          "B": np.concatenate([bi, bh], 1).astype(np.float32)},
+        )
+        sd = import_onnx(raw)
+        y = np.asarray(sd.output({"x": x.numpy(), "c0": c0.numpy()}, "Y"))
+        np.testing.assert_allclose(y[:, 0], want_y.detach().numpy(),
+                                   atol=2e-5, rtol=1e-4)
+
+    def test_peephole_and_clip_rejected(self):
+        from onnx_fixtures import make_model, make_node
+
+        raw = make_model(
+            [make_node("LSTM", ["x", "W", "R", "B", "", "", "", "P"],
+                       ["Y"], hidden_size=2)],
+            [("x", (3, 1, 2))], ["Y"],
+            initializers={"W": np.zeros((1, 8, 2), np.float32),
+                          "R": np.zeros((1, 8, 2), np.float32),
+                          "B": np.zeros((1, 16), np.float32),
+                          "P": np.zeros((1, 6), np.float32)},
+        )
+        with pytest.raises(ONNXImportError, match="peephole"):
+            import_onnx(raw)
+        raw2 = make_model(
+            [make_node("LSTM", ["x", "W", "R"], ["Y"], hidden_size=2,
+                       clip=3.0)],
+            [("x", (3, 1, 2))], ["Y"],
+            initializers={"W": np.zeros((1, 8, 2), np.float32),
+                          "R": np.zeros((1, 8, 2), np.float32)},
+        )
+        with pytest.raises(ONNXImportError, match="clip"):
+            import_onnx(raw2)
+
+    def test_set_value_survives_source_backed_serde(self, tmp_path):
+        """Runtime-mutated imported constants must persist through the
+        source-backed zip (r4 review finding)."""
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        body = make_graph(
+            [make_node("Add", ["v", "one"], ["v_out"]),
+             make_node("Identity", ["cond_in"], ["cond_out"])],
+            ["iter_num", "cond_in", "v"], ["cond_out", "v_out"],
+            initializers={"one": np.float32(1.0)}, name="b")
+        raw = make_model(
+            [make_node("Loop", ["M", "cond0", "x"], ["l"], body=body),
+             make_node("Mul", ["l", "k"], ["y"])],
+            [("x", (2,))], ["y"],
+            initializers={"M": np.int64(2), "cond0": np.bool_(True),
+                          "k": np.array([2.0, 3.0], np.float32)})
+        sd = import_onnx(raw)
+        # k is a top-level imported const consumed as a tensor; mutate it
+        # at runtime — the source-backed zip must carry the NEW value
+        sd.set_value("k", np.array([5.0, 10.0], np.float32))
+        xv = np.array([1.0, 1.0], np.float32)
+        want = np.asarray(sd.output({"x": xv}, "y"))
+        np.testing.assert_allclose(want, [15.0, 30.0], atol=1e-5)
+        p = str(tmp_path / "mut.sd.zip")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        np.testing.assert_allclose(
+            np.asarray(sd2.output({"x": xv}, "y")), want, atol=1e-5)

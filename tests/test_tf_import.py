@@ -668,3 +668,84 @@ class TestRound4OpTail:
             {"x": np.random.default_rng(5).normal(
                 size=(2, 3, 4)).astype(np.float32)},
             "out")
+
+
+class TestSourceBackedSerde:
+    """Imported graphs with control flow checkpoint by shipping the
+    source bytes (save) and re-importing them (load) — round 4."""
+
+    def test_while_graph_roundtrips_through_zip(self, tmp_path):
+        tf1.disable_control_flow_v2()
+        try:
+            g = tf1.Graph()
+            with g.as_default():
+                x = tf1.placeholder(tf.float32, [3], name="x")
+                _, acc = tf1.while_loop(
+                    lambda i, a: i < 4,
+                    lambda i, a: (i + 1, a * 2.0 + 1.0),
+                    [tf.constant(0), x], name="loop",
+                )
+                tf.identity(acc, name="out")
+        finally:
+            tf1.enable_control_flow_v2()
+        raw = g.as_graph_def().SerializeToString()
+        sd = import_graph(raw)
+        xv = np.array([1.0, -2.0, 0.5], np.float32)
+        want = np.asarray(sd.output({"x": xv}, "out"))
+        p = str(tmp_path / "cf.sd.zip")
+        sd.save(p)
+
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd2 = SameDiff.load(p)
+        np.testing.assert_allclose(
+            np.asarray(sd2.output({"x": xv}, "out")), want, atol=1e-6)
+
+    def test_finetuned_import_with_head_roundtrips(self, tmp_path):
+        """The BASELINE-config-4 shape: import trainable, attach a loss
+        head, fine-tune, checkpoint, resume — values and post-import ops
+        must survive."""
+        from deeplearning4j_tpu.autodiff.samediff import (
+            SameDiff, TrainingConfig)
+        from deeplearning4j_tpu.nn.updaters import Adam
+
+        g = tf1.Graph()
+        with g.as_default():
+            build_mini_bert_encoder()
+        sd = import_graph(g.as_graph_def(), trainable=True)
+        rng = np.random.default_rng(0)
+        pooled = sd.apply("mean", sd._vars["encoder_out"], axis=(1,))
+        head_w = sd.var("head_w",
+                        rng.normal(0, 0.1, (8, 2)).astype(np.float32))
+        logits = sd.apply("matmul", pooled, head_w)
+        labels = sd.placeholder("labels")
+        sd.set_loss(sd.apply("softmax_cross_entropy", logits, labels,
+                             name="fine_loss"))
+        sd.set_training_config(TrainingConfig(updater=Adam(5e-3)))
+        ids = rng.integers(0, 30, (4, 6)).astype(np.int32)
+        y = np.eye(2, dtype=np.float32)[rng.integers(0, 2, 4)]
+        for _ in range(3):
+            sd.fit_batch({"input_ids": ids, "labels": y})
+        want = np.asarray(sd.output({"input_ids": ids}, "encoder_out"))
+
+        p = str(tmp_path / "ft.sd.zip")
+        sd.save(p)
+        sd2 = SameDiff.load(p)
+        got = np.asarray(sd2.output({"input_ids": ids}, "encoder_out"))
+        np.testing.assert_allclose(got, want, atol=1e-5)
+        # the post-import head survived and training RESUMES
+        assert "head_w" in sd2.variables()
+        sd2.set_training_config(TrainingConfig(updater=Adam(5e-3)))
+        l2 = sd2.fit_batch({"input_ids": ids, "labels": y})
+        assert np.isfinite(l2)
+
+    def test_hand_built_control_flow_still_rejects(self, tmp_path):
+        import pytest as _pytest
+
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        sd = SameDiff()
+        x = sd.placeholder("x")
+        sd.while_loop(lambda v: (v < 5).all(), lambda v: (v + 1,), x)
+        with _pytest.raises(ValueError, match="rebuild the graph"):
+            sd.save(str(tmp_path / "nope.zip"))
