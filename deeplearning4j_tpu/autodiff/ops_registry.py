@@ -1774,3 +1774,223 @@ OPS.update({
     "strided_slice": _strided_slice,
     "l2_loss": lambda x: 0.5 * jnp.sum(jnp.square(x)),
 })
+
+
+# ---------------------------------------------------------------------------
+# Round-4 tail 2: numpy-parity math, linalg, signal and statistics families
+# (SURVEY §2.1 — the reference's declarable-op library spans the same
+# ground: legacy *_bp grad ops, summary statistics, windows/FFT helpers,
+# distance/correlation kernels).
+
+
+def _spearman(a, b):
+    def ranks(x):
+        # AVERAGE ranks for ties (the standard definition): midpoint of
+        # the first/last positions of each value in sorted order
+        s = jnp.sort(x)
+        lo = jnp.searchsorted(s, x, side="left")
+        hi = jnp.searchsorted(s, x, side="right")
+        return (lo + hi - 1).astype(jnp.float32) / 2.0
+
+    return OPS["pearson_corr"](ranks(a.reshape(-1)), ranks(b.reshape(-1)))
+
+
+def _pearson(a, b):
+    a = a.astype(jnp.float32).reshape(-1)
+    b = b.astype(jnp.float32).reshape(-1)
+    ac = a - jnp.mean(a)
+    bc = b - jnp.mean(b)
+    return jnp.sum(ac * bc) / jnp.maximum(
+        jnp.sqrt(jnp.sum(ac * ac) * jnp.sum(bc * bc)), 1e-12)
+
+
+def _detrend(x):
+    """Remove the least-squares linear fit along the last axis."""
+    n = x.shape[-1]
+    t = jnp.arange(n, dtype=jnp.float32)
+    tc = t - t.mean()
+    xm = jnp.mean(x, axis=-1, keepdims=True)
+    slope = jnp.sum((x - xm) * tc, axis=-1, keepdims=True) / jnp.sum(tc * tc)
+    return x - xm - slope * tc
+
+
+def _medfilt(x, *, kernel=3):
+    k = int(kernel)
+    if k % 2 != 1:
+        raise ValueError("medfilt kernel must be odd")
+    pad = k // 2
+    xp = jnp.pad(x, [(0, 0)] * (x.ndim - 1) + [(pad, pad)], mode="edge")
+    stacked = jnp.stack(
+        [xp[..., i:i + x.shape[-1]] for i in range(k)], axis=0)
+    return jnp.median(stacked, axis=0)
+
+
+def _mel_filterbank(*, n_mels, n_fft_bins, sample_rate, fmin=0.0, fmax=None):
+    """HTK-style triangular mel filterbank matrix (n_mels, n_fft_bins) —
+    the spectrogram->mel projection behind MFCC pipelines."""
+    fmax = fmax or sample_rate / 2.0
+    mel = lambda f: 2595.0 * jnp.log10(1.0 + f / 700.0)
+    imel = lambda m: 700.0 * (10.0 ** (m / 2595.0) - 1.0)
+    pts = imel(jnp.linspace(mel(jnp.asarray(fmin)), mel(jnp.asarray(fmax)),
+                            n_mels + 2))
+    freqs = jnp.linspace(0.0, sample_rate / 2.0, n_fft_bins)
+    lo, ctr, hi = pts[:-2, None], pts[1:-1, None], pts[2:, None]
+    up = (freqs[None] - lo) / jnp.maximum(ctr - lo, 1e-9)
+    down = (hi - freqs[None]) / jnp.maximum(hi - ctr, 1e-9)
+    return jnp.clip(jnp.minimum(up, down), 0.0, 1.0)
+
+
+def _confusion_counts(pred, lab):
+    pred = pred.astype(bool).reshape(-1)
+    lab = lab.astype(bool).reshape(-1)
+    tp = jnp.sum(pred & lab).astype(jnp.float32)
+    fp = jnp.sum(pred & ~lab).astype(jnp.float32)
+    fn = jnp.sum(~pred & lab).astype(jnp.float32)
+    tn = jnp.sum(~pred & ~lab).astype(jnp.float32)
+    return tp, fp, fn, tn
+
+
+def _f1(pred, lab):
+    tp, fp, fn, _ = _confusion_counts(pred, lab)
+    return 2 * tp / jnp.maximum(2 * tp + fp + fn, 1e-12)
+
+
+def _mcc(pred, lab):
+    tp, fp, fn, tn = _confusion_counts(pred, lab)
+    denom = jnp.sqrt((tp + fp) * (tp + fn) * (tn + fp) * (tn + fn))
+    return (tp * tn - fp * fn) / jnp.maximum(denom, 1e-12)
+
+
+def _cohen_kappa(pred, lab):
+    tp, fp, fn, tn = _confusion_counts(pred, lab)
+    n = tp + fp + fn + tn
+    po = (tp + tn) / n
+    pe = ((tp + fp) * (tp + fn) + (fn + tn) * (fp + tn)) / (n * n)
+    return (po - pe) / jnp.maximum(1.0 - pe, 1e-12)
+
+
+def _ensure_shape(x, *, shape):
+    """Identity that VALIDATES the static shape (TF semantics) — None/-1
+    entries are wildcards; a mismatch raises instead of re-laying-out."""
+    shape = tuple(shape)
+    if len(shape) != x.ndim or any(
+        s not in (None, -1) and int(s) != d for s, d in zip(shape, x.shape)
+    ):
+        raise ValueError(
+            f"ensure_shape: got {tuple(x.shape)}, expected {shape}"
+        )
+    return x
+
+
+OPS.update({
+    # --- numpy-parity math/array tail ---
+    "diff": lambda x, *, n=1, axis=-1: jnp.diff(x, n=n, axis=axis),
+    "ediff1d": lambda x: jnp.ediff1d(x),
+    "trapz": lambda y, *, dx=1.0, axis=-1: jnp.trapezoid(y, dx=dx, axis=axis),
+    "gradient_1d": lambda x: jnp.gradient(x),
+    "interp": lambda x, xp, fp: jnp.interp(x, xp, fp),
+    "unwrap": lambda x, *, axis=-1: jnp.unwrap(x, axis=axis),
+    "polyval": lambda coeffs, x: jnp.polyval(coeffs, x),
+    "polyder": lambda coeffs, *, m=1: jnp.polyder(coeffs, m=m),
+    "polyint": lambda coeffs, *, m=1: jnp.polyint(coeffs, m=m),
+    "convolve_1d": lambda a, v, *, mode="full": jnp.convolve(a, v, mode=mode),
+    "correlate_1d": lambda a, v, *, mode="full": jnp.correlate(
+        a, v, mode=mode),
+    "partition": lambda x, *, kth, axis=-1: jnp.partition(x, kth, axis=axis),
+    "argpartition": lambda x, *, kth, axis=-1: jnp.argpartition(
+        x, kth, axis=axis),
+    "lexsort": lambda *keys: jnp.lexsort(keys),
+    "repeat": lambda x, *, repeats, axis=None: jnp.repeat(
+        x, repeats, axis=axis),
+    "take": lambda x, idx, *, axis=None: jnp.take(
+        x, idx.astype(jnp.int32), axis=axis),
+    "compress": lambda cond, x, *, axis=None, size, fill=0: jnp.compress(
+        cond.astype(bool), x, axis=axis, size=size, fill_value=fill),
+    "fill_diagonal": lambda x, *, value: jnp.asarray(x).at[
+        ..., jnp.arange(min(x.shape[-2], x.shape[-1])),
+        jnp.arange(min(x.shape[-2], x.shape[-1]))].set(value),
+    "digitize": lambda x, bins: jnp.digitize(x, bins),
+    "float_power": jnp.float_power,
+    "fix": jnp.trunc,   # numpy fix == trunc toward zero
+    "positive": jnp.positive,
+    "cbrt": jnp.cbrt,
+    "fabs": jnp.fabs,
+    # --- linalg tail 2 ---
+    "norm_fro": lambda x: jnp.linalg.norm(x, ord="fro", axis=(-2, -1)),
+    "inner": jnp.inner,
+    "vdot": jnp.vdot,
+    "multi_dot": lambda *ms: jnp.linalg.multi_dot(ms),
+    "cholesky_inverse": lambda L: jax.scipy.linalg.cho_solve(
+        (L, True), jnp.eye(L.shape[-1], dtype=L.dtype)),
+    "diag_embed": lambda x: x[..., None] * jnp.eye(x.shape[-1], dtype=x.dtype),
+    "block_diag": lambda *ms: jax.scipy.linalg.block_diag(*ms),
+    "toeplitz": lambda c, r=None: jax.scipy.linalg.toeplitz(
+        c, r if r is not None else c),
+    "adjoint": lambda x: jnp.conj(jnp.swapaxes(x, -1, -2)),
+    # --- signal tail 2 ---
+    "bartlett_window": lambda *, length: jnp.bartlett(length),
+    "kaiser_window": lambda *, length, beta=12.0: jnp.kaiser(length, beta),
+    "fft2d": lambda x: jnp.fft.fft2(x.astype(jnp.complex64)),
+    "ifft2d": lambda x: jnp.fft.ifft2(x),
+    "mel_filterbank": _mel_filterbank,
+    "power_to_db": lambda s, *, ref=1.0, amin=1e-10: 10.0 * (
+        jnp.log10(jnp.maximum(s, amin)) - jnp.log10(jnp.maximum(ref, amin))),
+    "db_to_power": lambda db, *, ref=1.0: ref * jnp.power(10.0, db / 10.0),
+    "rms": lambda x, *, axis=None: jnp.sqrt(
+        jnp.mean(jnp.square(x.astype(jnp.float32)), axis=_ax(axis))),
+    # (x >= 0) transitions count crossings THROUGH exact zeros too
+    # (sign(0)=0 would silently drop them)
+    "zero_crossings": lambda x: jnp.sum(
+        jnp.abs(jnp.diff((x >= 0).astype(jnp.int32), axis=-1)), axis=-1),
+    "autocorr": lambda x, *, lag=1: _pearson(
+        x[..., :-lag].reshape(-1), x[..., lag:].reshape(-1)),
+    "detrend": _detrend,
+    "medfilt": _medfilt,
+    # --- statistics / metrics tail (reference summary-stats + eval ops) ---
+    "covariance": lambda a, b: jnp.mean(
+        (a.astype(jnp.float32) - jnp.mean(a))
+        * (b.astype(jnp.float32) - jnp.mean(b))),
+    "pearson_corr": _pearson,
+    "spearman_corr": _spearman,
+    "skewness": lambda x: (lambda c, s: jnp.mean(c ** 3) / jnp.maximum(
+        s ** 3, 1e-12))(x.astype(jnp.float32) - jnp.mean(x), jnp.std(x)),
+    "kurtosis": lambda x: (lambda c, s: jnp.mean(c ** 4) / jnp.maximum(
+        s ** 4, 1e-12) - 3.0)(x.astype(jnp.float32) - jnp.mean(x),
+                              jnp.std(x)),
+    "quantile": lambda x, *, q, axis=None: jnp.quantile(x, q, axis=_ax(axis)),
+    "iqr": lambda x: jnp.quantile(x, 0.75) - jnp.quantile(x, 0.25),
+    "mad": lambda x: jnp.median(jnp.abs(x - jnp.median(x))),
+    "zscore": lambda x, *, axis=None, epsilon=1e-12: (
+        (x - jnp.mean(x, axis=_ax(axis), keepdims=True))
+        / (jnp.std(x, axis=_ax(axis), keepdims=True) + epsilon)),
+    "weighted_mean": lambda x, w: jnp.sum(x * w) / jnp.maximum(
+        jnp.sum(w), 1e-12),
+    "ema": lambda x, *, alpha: jnp.moveaxis(
+        jax.lax.scan(
+            lambda c, v: ((1 - alpha) * c + alpha * v,) * 2,
+            x[..., 0], jnp.moveaxis(x, -1, 0),
+        )[1], 0, -1),
+    "sma": lambda x, *, window: jnp.convolve(
+        x, jnp.ones(window) / window, mode="valid"),
+    "f1_score": _f1,
+    "matthews_corrcoef": _mcc,
+    "cohen_kappa": _cohen_kappa,
+    "r2_score": lambda pred, lab: 1.0 - jnp.sum(jnp.square(lab - pred))
+        / jnp.maximum(jnp.sum(jnp.square(lab - jnp.mean(lab))), 1e-12),
+    "explained_variance": lambda pred, lab: 1.0 - jnp.var(lab - pred)
+        / jnp.maximum(jnp.var(lab), 1e-12),
+    "rmse": lambda pred, lab: jnp.sqrt(jnp.mean(jnp.square(pred - lab))),
+    # --- legacy *_bp grad ops (the reference ships these as declarable
+    # backward ops; useful for hand-built backward graphs) ---
+    "sigmoid_bp": lambda x, g: g * jax.nn.sigmoid(x)
+        * (1.0 - jax.nn.sigmoid(x)),
+    "tanh_bp": lambda x, g: g * (1.0 - jnp.square(jnp.tanh(x))),
+    "relu_bp": lambda x, g: g * (x > 0).astype(g.dtype),
+    "softmax_bp": lambda x, g, *, axis=-1: (lambda s: s * (
+        g - jnp.sum(g * s, axis=axis, keepdims=True)))(
+        jax.nn.softmax(x, axis=axis)),
+    "ensure_shape": _ensure_shape,
+})
+
+OPS["matrix_exp"] = OPS["expm"]
+OPS["log_matrix_determinant"] = OPS["logdet"]

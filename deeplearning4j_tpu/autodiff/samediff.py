@@ -764,9 +764,11 @@ class SameDiff:
             sd._ops.append(node)
             if node.output not in sd._vars:
                 sd._vars[node.output] = SDVariable(sd, node.output, "op")
-        # fine-tuned values overwrite the re-imported initials
+        # fine-tuned values overwrite the re-imported initials; mark them
+        # mutated so a SECOND save() of this loaded graph persists them too
         for name in data.files:
             sd._values[name] = jnp.asarray(data[name])
+        sd._mutated_values = set(data.files)
         sd._loss_var = man.get("loss_var")
         sd._counter = max(man.get("counter", 0), sd._counter)
         if man.get("training_config"):

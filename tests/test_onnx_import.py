@@ -941,3 +941,34 @@ class TestOnnxSourceBackedSerde:
         sd2 = SameDiff.load(p)
         np.testing.assert_allclose(
             np.asarray(sd2.output({"x": xv}, "y")), want, atol=1e-5)
+
+    def test_double_roundtrip_keeps_mutation(self, tmp_path):
+        """save -> load -> save -> load must not revert set_value
+        mutations (r4 review finding)."""
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+        from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+        body = make_graph(
+            [make_node("Add", ["v", "one"], ["v_out"]),
+             make_node("Identity", ["cond_in"], ["cond_out"])],
+            ["iter_num", "cond_in", "v"], ["cond_out", "v_out"],
+            initializers={"one": np.float32(1.0)}, name="b")
+        raw = make_model(
+            [make_node("Loop", ["M", "cond0", "x"], ["l"], body=body),
+             make_node("Mul", ["l", "k"], ["y"])],
+            [("x", (2,))], ["y"],
+            initializers={"M": np.int64(1), "cond0": np.bool_(True),
+                          "k": np.array([2.0, 2.0], np.float32)})
+        sd = import_onnx(raw)
+        sd.set_value("k", np.array([10.0, 10.0], np.float32))
+        xv = np.zeros(2, np.float32)
+        want = np.asarray(sd.output({"x": xv}, "y"))
+        p1, p2 = str(tmp_path / "a.zip"), str(tmp_path / "b.zip")
+        sd.save(p1)
+        sd2 = SameDiff.load(p1)
+        sd2.save(p2)
+        sd3 = SameDiff.load(p2)
+        np.testing.assert_allclose(
+            np.asarray(sd3.output({"x": xv}, "y")), want, atol=1e-6)
