@@ -1886,7 +1886,8 @@ OPS.update({
     # --- numpy-parity math/array tail ---
     "diff": lambda x, *, n=1, axis=-1: jnp.diff(x, n=n, axis=axis),
     "ediff1d": lambda x: jnp.ediff1d(x),
-    "trapz": lambda y, *, dx=1.0, axis=-1: jnp.trapezoid(y, dx=dx, axis=axis),
+    "trapz": lambda y, *, dx=1.0, axis=-1: getattr(
+        jnp, "trapezoid", getattr(jnp, "trapz", None))(y, dx=dx, axis=axis),
     "gradient_1d": lambda x: jnp.gradient(x),
     "interp": lambda x, xp, fp: jnp.interp(x, xp, fp),
     "unwrap": lambda x, *, axis=-1: jnp.unwrap(x, axis=axis),
@@ -1991,6 +1992,16 @@ OPS.update({
         jax.nn.softmax(x, axis=axis)),
     "ensure_shape": _ensure_shape,
 })
+
+OPS["split_part"] = (
+    # one output of an even split — shapes resolve at trace time, so the
+    # importer doesn't need shape inference (TF Split -> one op per output)
+    lambda x, *, index, num, axis=0: jnp.split(x, num, axis=axis)[index]
+)
+OPS["slice_axis"] = (
+    lambda x, *, begin, size, axis=0: jax.lax.slice_in_dim(
+        x, begin, begin + size, axis=axis)
+)
 
 OPS["matrix_exp"] = OPS["expm"]
 OPS["log_matrix_determinant"] = OPS["logdet"]

@@ -720,6 +720,36 @@ class _Importer:
     def op_L2Loss(self, node):
         self._unary(node, "l2_loss")
 
+    def op_Split(self, node):
+        ins = self.data_inputs(node)
+        axis = int(self.static_value(_input_name(ins[0])[0]))
+        num = int(self.attr(node, "num_split"))
+        src = self.in_var(ins[1])
+        for i in range(num):
+            nm = node.name if i == 0 else f"{node.name}:{i}"
+            self.vars[nm] = self.sd.apply(
+                "split_part", src, name=nm, index=i, num=num, axis=axis)
+        self.vars.setdefault(f"{node.name}:0", self.vars[node.name])
+
+    def op_SplitV(self, node):
+        ins = self.data_inputs(node)
+        src = self.in_var(ins[0])
+        sizes = [int(v) for v in
+                 self.static_value(_input_name(ins[1])[0]).reshape(-1)]
+        axis = int(self.static_value(_input_name(ins[2])[0]))
+        if any(s < 0 for s in sizes):
+            raise TFImportError(
+                f"{node.name}: SplitV with -1 (inferred) size needs shape "
+                "inference; re-export with explicit sizes"
+            )
+        off = 0
+        for i, s in enumerate(sizes):
+            nm = node.name if i == 0 else f"{node.name}:{i}"
+            self.vars[nm] = self.sd.apply(
+                "slice_axis", src, name=nm, begin=off, size=s, axis=axis)
+            off += s
+        self.vars.setdefault(f"{node.name}:0", self.vars[node.name])
+
     def op_GatherNd(self, node):
         a, b = self.data_inputs(node)[:2]
         self._bind(node, self.sd.apply(

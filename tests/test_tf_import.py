@@ -749,3 +749,28 @@ class TestSourceBackedSerde:
         sd.while_loop(lambda v: (v < 5).all(), lambda v: (v + 1,), x)
         with _pytest.raises(ValueError, match="rebuild the graph"):
             sd.save(str(tmp_path / "nope.zip"))
+
+    def test_split_and_splitv(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [2, 6], name="x")
+            a, b2, c = tf.split(x, 3, axis=1)
+            d, e = tf.split(x, [2, 4], axis=1)
+            tf.identity(b2, name="mid")
+            tf.identity(tf.concat([a, c], 1), name="outer")
+            tf.identity(e - d[:, :1], name="v")
+
+        assert_graph_matches(
+            build,
+            {"x": np.random.default_rng(7).normal(
+                size=(2, 6)).astype(np.float32)},
+            "mid")
+        # also check the other fetches wire correctly
+        g = tf1.Graph()
+        with g.as_default():
+            build()
+        xv = np.random.default_rng(8).normal(size=(2, 6)).astype(np.float32)
+        sd = import_graph(g.as_graph_def())
+        for fetch in ("outer", "v"):
+            want = golden(g, {"x:0": xv}, f"{fetch}:0")
+            np.testing.assert_allclose(
+                np.asarray(sd.output({"x": xv}, fetch)), want, atol=1e-6)
