@@ -2005,3 +2005,114 @@ OPS["slice_axis"] = (
 
 OPS["matrix_exp"] = OPS["expm"]
 OPS["log_matrix_determinant"] = OPS["logdet"]
+
+
+# ---------------------------------------------------------------------------
+# CTC prefix beam search (the reference's ctc_beam declarable op) — fully
+# static shapes: fixed beam width, fixed per-frame symbol top-k pruning,
+# candidate merge by prefix equality, one lax.scan over time.
+
+
+def _ctc_beam_search(logits, *, beam_width=8, blank=0, symbol_topk=8,
+                     pad=-1):
+    """Returns (prefixes (B, W, T), lengths (B, W), log_probs (B, W)),
+    beams sorted best-first.  Standard CTC prefix beam search: per beam a
+    (p_blank, p_nonblank) pair; per frame the beam extends with the top-k
+    symbols, equal prefixes merge by probability sum, and the best W
+    survive — every step fixed-shape, so the whole decode jits."""
+    NEG = jnp.float32(-1e30)
+    B, T, C = logits.shape
+    W = int(beam_width)
+    K = min(int(symbol_topk), C)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+
+    def decode_one(lp_seq):
+        prefixes0 = jnp.full((W, T), pad, jnp.int32)
+        lengths0 = jnp.zeros((W,), jnp.int32)
+        pb0 = jnp.full((W,), NEG).at[0].set(0.0)
+        pnb0 = jnp.full((W,), NEG)
+
+        def step(state, lp):
+            prefixes, lengths, pb, pnb = state
+            top_v, top_i = jax.lax.top_k(lp, K)
+
+            last = jnp.take_along_axis(
+                prefixes,
+                jnp.maximum(lengths - 1, 0)[:, None], axis=1,
+            )[:, 0]
+            lp_last = jnp.where(lengths > 0, lp[jnp.maximum(last, 0)], NEG)
+
+            # stay candidates (same prefix): blank path + repeat collapse
+            stay_pb = jnp.logaddexp(pb, pnb) + lp[blank]
+            stay_pnb = pnb + lp_last
+            # extension candidates: (W, K)
+            is_rep = top_i[None, :] == last[:, None]        # repeat after blank
+            base = jnp.where(
+                is_rep & (lengths > 0)[:, None],
+                pb[:, None],                                # only the blank path
+                jnp.logaddexp(pb, pnb)[:, None],
+            )
+            ext_pnb = base + top_v[None, :]
+            ext_pnb = jnp.where(
+                (top_i[None, :] == blank) | (lengths >= T)[:, None],
+                NEG, ext_pnb,
+            )
+            # candidate tensors: M = W + W*K
+            ext_prefix = jnp.repeat(prefixes, K, axis=0)
+            pos = jnp.repeat(lengths, K)
+            ext_prefix = ext_prefix.at[
+                jnp.arange(W * K), jnp.minimum(pos, T - 1)
+            ].set(jnp.tile(top_i, W))
+            cand_prefix = jnp.concatenate([prefixes, ext_prefix], axis=0)
+            cand_len = jnp.concatenate(
+                [lengths, jnp.minimum(pos + 1, T)], axis=0)
+            cand_pb = jnp.concatenate(
+                [stay_pb, jnp.full((W * K,), NEG)], axis=0)
+            cand_pnb = jnp.concatenate([stay_pnb, ext_pnb.reshape(-1)],
+                                       axis=0)
+
+            # merge candidates with EQUAL prefixes (prob mass adds)
+            eq = (
+                jnp.all(cand_prefix[:, None, :] == cand_prefix[None, :, :],
+                        axis=-1)
+                & (cand_len[:, None] == cand_len[None, :])
+            )
+            canon = jnp.argmax(eq, axis=1)          # first equal candidate
+            M = cand_pb.shape[0]
+            owns = canon[None, :] == jnp.arange(M)[:, None]   # (M slots, M)
+            merged_pb = jax.nn.logsumexp(
+                jnp.where(owns, cand_pb[None, :], NEG), axis=1)
+            merged_pnb = jax.nn.logsumexp(
+                jnp.where(owns, cand_pnb[None, :], NEG), axis=1)
+            is_canon = canon == jnp.arange(M)
+            score = jnp.where(
+                is_canon, jnp.logaddexp(merged_pb, merged_pnb), NEG)
+
+            _, keep = jax.lax.top_k(score, W)
+            return (
+                cand_prefix[keep], cand_len[keep],
+                merged_pb[keep], merged_pnb[keep],
+            ), None
+
+        (prefixes, lengths, pb, pnb), _ = jax.lax.scan(
+            step, (prefixes0, lengths0, pb0, pnb0), lp_seq)
+        score = jnp.logaddexp(pb, pnb)
+        order = jnp.argsort(-score)
+        return prefixes[order], lengths[order], score[order]
+
+    return jax.vmap(decode_one)(logp)
+
+
+# Public triple-return entry: EAGER callers should use this (one search);
+# the three registry ops below are graph-building conveniences — inside a
+# single jitted computation XLA CSE collapses their identical subgraphs,
+# so only eager triple-fetch would pay 3x.
+ctc_beam_search = _ctc_beam_search
+
+OPS.update({
+    "ctc_beam_decode": lambda logits, **kw: _ctc_beam_search(logits, **kw)[0],
+    "ctc_beam_decode_lengths": lambda logits, **kw: _ctc_beam_search(
+        logits, **kw)[1],
+    "ctc_beam_decode_log_probs": lambda logits, **kw: _ctc_beam_search(
+        logits, **kw)[2],
+})

@@ -43,6 +43,9 @@ _PAGE = """<!doctype html>
    <canvas id="ratio" width="560" height="260"></canvas>
    <div class="legend" id="ratioLegend"></div></div>
  <div><h2>device memory (MiB)</h2><canvas id="mem" width="560" height="260"></canvas></div>
+ <div id="actPanel" style="display:none"><h2>activation mean |a| (log10)</h2>
+   <canvas id="act" width="560" height="260"></canvas>
+   <div class="legend" id="actLegend"></div></div>
  <div id="histPanel" style="display:none"><h2>histogram
    <select id="histKind"></select><select id="histLayer"></select></h2>
    <canvas id="hist" width="560" height="260"></canvas>
@@ -68,6 +71,17 @@ function drawLines(cv, series, labels){
   }); c.stroke();
  });
 }
+function drawLayerPanel(canvasId, legendId, recs, key){
+ const last=recs[recs.length-1];
+ const layers=Object.keys(last[key]||{});
+ if(!layers.length) return false;
+ drawLines(document.getElementById(canvasId),
+  layers.map(l=>recs.map(r=>{
+   const v=(r[key]||{})[l]; return v>0?Math.log10(v):NaN;})));
+ document.getElementById(legendId).innerHTML=
+  layers.map((l,i)=>`<span style="color:${colors[i%colors.length]}">■ ${l}</span>`).join(' ');
+ return true;
+}
 async function refresh(){
  const sess=document.getElementById('session');
  const sessions=await (await fetch('api/sessions')).json();
@@ -83,15 +97,13 @@ async function refresh(){
   +(Number.isFinite(last.score)?last.score.toPrecision(5):'NaN')
   +(last.samples_per_sec?` · ${Math.round(last.samples_per_sec)} samples/s`:'');
  drawLines(document.getElementById('score'),[recs.map(r=>r.score)]);
- const layers=Object.keys(last.update_ratio||{});
- drawLines(document.getElementById('ratio'),
-  layers.map(l=>recs.map(r=>{
-   const v=(r.update_ratio||{})[l]; return v>0?Math.log10(v):NaN;})));
- document.getElementById('ratioLegend').innerHTML=
-  layers.map((l,i)=>`<span style="color:${colors[i%colors.length]}">■ ${l}</span>`).join(' ');
+ drawLayerPanel('ratio','ratioLegend',recs,'update_ratio');
  drawLines(document.getElementById('mem'),
   [recs.map(r=>r.memory?r.memory.bytes_in_use/1048576:NaN)]);
  drawHist(last);
+ document.getElementById('actPanel').style.display=
+  drawLayerPanel('act','actLegend',recs,'activation_mean_magnitude')
+  ? '' : 'none';
 }
 function drawBars(cv, counts, lo, hi){
  const c=cv.getContext('2d'); c.clearRect(0,0,cv.width,cv.height);
@@ -143,6 +155,17 @@ _HPO_PAGE = """<!doctype html>
 <canvas id="scores" width="720" height="240"></canvas>
 <div id="table"></div>
 <script>
+function drawLayerPanel(canvasId, legendId, recs, key){
+ const last=recs[recs.length-1];
+ const layers=Object.keys(last[key]||{});
+ if(!layers.length) return false;
+ drawLines(document.getElementById(canvasId),
+  layers.map(l=>recs.map(r=>{
+   const v=(r[key]||{})[l]; return v>0?Math.log10(v):NaN;})));
+ document.getElementById(legendId).innerHTML=
+  layers.map((l,i)=>`<span style="color:${colors[i%colors.length]}">■ ${l}</span>`).join(' ');
+ return true;
+}
 async function refresh(){
  const rs=await (await fetch('api/hpo')).json();
  if(!rs.length){document.getElementById('table').textContent='no results yet';return}

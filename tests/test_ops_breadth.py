@@ -953,3 +953,69 @@ class TestRound4Tail2:
         # wildcard dims pass through untouched
         y = np.zeros((3, 5), np.float32)
         assert _np(OPS["ensure_shape"](y, shape=(-1, 5))).shape == (3, 5)
+
+
+class TestCtcBeamSearch:
+    """CTC prefix beam search (the reference's ctc_beam op): exact vs
+    brute-force enumeration at full width, sane when truncated."""
+
+    def _exact_scores(self, logp, T, C):
+        """One pass over all C^T alignment paths, accumulating each
+        path's collapsed sequence — O(C^T), not O(C^T x #sequences)."""
+        import itertools
+        from collections import defaultdict
+
+        scores = defaultdict(float)
+        for path in itertools.product(range(C), repeat=T):
+            out, prev = [], -1
+            p = 0.0
+            for t, s in enumerate(path):
+                p += logp[t, s]
+                if s != prev and s != 0:
+                    out.append(s)
+                prev = s
+            scores[tuple(out)] += np.exp(p)
+        return sorted(scores.items(), key=lambda kv: -kv[1])
+
+    def test_full_width_beam_is_exact(self):
+        import jax
+
+        rng = np.random.default_rng(0)
+        T, C = 5, 3
+        logits = rng.normal(0, 1.5, (1, T, C)).astype(np.float32)
+        logp = np.asarray(jax.nn.log_softmax(logits[0], -1))
+        ranked = self._exact_scores(logp, T, C)
+        pre = _np(OPS["ctc_beam_decode"](logits, beam_width=64))
+        lens = _np(OPS["ctc_beam_decode_lengths"](logits, beam_width=64))
+        lps = _np(OPS["ctc_beam_decode_log_probs"](logits, beam_width=64))
+        for k in range(5):
+            got = tuple(int(v) for v in pre[0, k][:lens[0, k]])
+            assert got == ranked[k][0], (k, got, ranked[k][0])
+            assert np.exp(lps[0, k]) == pytest.approx(ranked[k][1],
+                                                      abs=1e-4)
+
+    def test_narrow_beam_top1_still_best(self):
+        import jax
+
+        rng = np.random.default_rng(3)
+        T, C = 6, 4
+        logits = rng.normal(0, 1.2, (2, T, C)).astype(np.float32)
+        logp = np.asarray(jax.nn.log_softmax(logits[0], -1))
+        ranked = self._exact_scores(logp, T, C)
+        pre = _np(OPS["ctc_beam_decode"](logits, beam_width=16))
+        lens = _np(OPS["ctc_beam_decode_lengths"](logits, beam_width=16))
+        got = tuple(int(v) for v in pre[0, 0][:lens[0, 0]])
+        assert got == ranked[0][0]
+        # batched output shapes
+        assert pre.shape == (2, 16, T) and lens.shape == (2, 16)
+
+    def test_beam_beats_or_matches_greedy(self):
+        rng = np.random.default_rng(4)
+        logits = rng.normal(0, 1.0, (3, 8, 5)).astype(np.float32)
+        beam = _np(OPS["ctc_beam_decode_log_probs"](logits, beam_width=8))
+        # greedy path prob is a lower bound on the best beam's SEQUENCE prob
+        import jax
+
+        logp = np.asarray(jax.nn.log_softmax(logits, -1))
+        greedy_path = logp.max(-1).sum(-1)
+        assert (beam[:, 0] >= greedy_path - 1e-4).all()
