@@ -1252,11 +1252,200 @@ class KerasModelImport:
     )
 
 
-def import_keras_auto(path: str):
-    """Dispatch on the saved model class: Functional/Model -> GraphModel,
-    Sequential -> SequentialModel (importKerasModelAndWeights accepts both)."""
+# --- Keras-3 native .keras (zip) format -------------------------------------
+# config.json carries the same layer-config dialect the mappers already
+# read; model.weights.h5 stores per-layer variables as ORDERED `vars/N`
+# datasets under auto-generated snake-case group paths (NOT the user layer
+# names).  Import converts the zip into the legacy-HDF5 layout in a temp
+# file and rides the existing import path — one weight-mapping codebase.
+
+def _keras_to_snake(name: str) -> str:
+    """Keras's to_snake_case (weight group paths): PReLU -> p_re_lu."""
+    import re
+
+    name = re.sub(r"\W+", "", name)
+    name = re.sub(r"(.)([A-Z][a-z]+)", r"\1_\2", name)
+    return re.sub(r"([a-z])([A-Z])", r"\1_\2", name).lower()
+
+
+# Keras-3 stores per-layer variables as ORDERED vars/N datasets; the
+# names must be reconstructed from the layer class AND config — optional
+# weights (use_bias/center/scale=False) drop from wherever they sit in
+# the order, not just the tail.
+def _k3_var_names(cls: str, cfg: dict):
+    """Ordered variable names for one layer, or None if unknown."""
+    bias = ("bias",) if cfg.get("use_bias", True) else ()
+    if cls in ("Dense", "Conv1D", "Conv2D", "Conv2DTranspose"):
+        return ("kernel",) + bias
+    if cls == "SeparableConv2D":
+        return ("depthwise_kernel", "pointwise_kernel") + bias
+    if cls == "BatchNormalization":
+        return (
+            (("gamma",) if cfg.get("scale", True) else ())
+            + (("beta",) if cfg.get("center", True) else ())
+            + ("moving_mean", "moving_variance")
+        )
+    if cls == "LayerNormalization":
+        return (
+            (("gamma",) if cfg.get("scale", True) else ())
+            + (("beta",) if cfg.get("center", True) else ())
+        )
+    if cls == "Embedding":
+        return ("embeddings",)
+    if cls == "PReLU":
+        return ("alpha",)
+    if cls in ("LSTM", "GRU", "SimpleRNN", "ConvLSTM2D"):
+        return ("kernel", "recurrent_kernel") + bias
+    return None
+
+
+_KERAS3_CELL_CLASSES = {"LSTM", "GRU", "SimpleRNN", "ConvLSTM2D"}
+
+_KERAS3_NO_VARS = {
+    "InputLayer", "Dropout", "Activation", "Flatten", "MaxPooling1D",
+    "MaxPooling2D", "AveragePooling1D", "AveragePooling2D",
+    "GlobalAveragePooling1D", "GlobalAveragePooling2D",
+    "GlobalMaxPooling1D", "GlobalMaxPooling2D", "ZeroPadding2D",
+    "Cropping2D", "UpSampling2D", "LeakyReLU", "ELU", "GaussianNoise",
+    "GaussianDropout", "SpatialDropout2D", "Reshape", "Add", "Subtract",
+    "Multiply", "Average", "Maximum", "Concatenate",
+}
+
+
+def _convert_keras3_zip(path: str, out_h5: str) -> None:
+    """Rewrite a .keras zip as a legacy-layout HDF5: model_config /
+    training_config attrs + model_weights/<layer_name>/<param> groups."""
+    import io
+    import zipfile
+
     import h5py
 
+    with zipfile.ZipFile(path) as z:
+        names = set(z.namelist())
+        if "config.json" not in names or "model.weights.h5" not in names:
+            raise KerasImportError(
+                f"{path}: not a .keras archive (config.json + "
+                "model.weights.h5 expected)"
+            )
+        cfg = json.loads(z.read("config.json"))
+        wsrc = h5py.File(io.BytesIO(z.read("model.weights.h5")), "r")
+
+    layer_dicts = cfg["config"]["layers"]
+    # keras 3 weight paths use snake-case CLASS names uniquified by a
+    # per-base counter in layer order, independent of user layer names
+    counters: Dict[str, int] = {}
+    with h5py.File(out_h5, "w") as out:
+        out.attrs["model_config"] = json.dumps(cfg)
+        compile_cfg = cfg.get("compile_config")
+        if compile_cfg:
+            out.attrs["training_config"] = json.dumps(compile_cfg)
+        wroot = out.create_group("model_weights")
+        def copy_vars(src_path, dst_grp, names, lname, cls):
+            if src_path not in wsrc:
+                raise KerasImportError(
+                    f".keras import: expected weights at {src_path!r} for "
+                    f"layer {lname!r} ({cls}); archive has "
+                    f"{sorted(wsrc.get('layers', {}).keys())}"
+                )
+            vars_grp = wsrc[src_path]
+            if len(vars_grp) != len(names):
+                raise KerasImportError(
+                    f".keras import: layer {lname!r} ({cls}) stores "
+                    f"{len(vars_grp)} variables but the config implies "
+                    f"{len(names)} ({names})"
+                )
+            for i, nm in enumerate(names):
+                dst_grp.create_dataset(nm, data=vars_grp[str(i)][()])
+
+        def inner_src(cls):
+            return "/cell/vars" if cls in _KERAS3_CELL_CLASSES else "/vars"
+
+        for ld in layer_dicts:
+            cls = ld["class_name"]
+            lcfg = ld.get("config", {})
+            lname = lcfg.get("name") or ld.get("name")
+            if cls == "InputLayer":
+                continue
+            base = _keras_to_snake(cls)
+            n = counters.get(base, 0)
+            counters[base] = n + 1
+            group = base if n == 0 else f"{base}_{n}"
+            if cls in _KERAS3_NO_VARS:
+                continue
+            if cls == "Bidirectional":
+                inner = lcfg["layer"]
+                icls = inner["class_name"]
+                names = _k3_var_names(icls, inner.get("config", {}))
+                if names is None:
+                    raise KerasImportError(
+                        f".keras import: Bidirectional({icls}) wrapped "
+                        "layer has no variable-order table"
+                    )
+                dst = wroot.create_group(lname)
+                # the legacy router splits by forward_*/backward_* path
+                # segments — mirror that layout
+                for side in ("forward", "backward"):
+                    copy_vars(
+                        f"layers/{group}/{side}_layer" + inner_src(icls),
+                        dst.create_group(f"{side}_{_keras_to_snake(icls)}"),
+                        names, lname, cls,
+                    )
+                continue
+            if cls == "TimeDistributed":
+                inner = lcfg["layer"]
+                icls = inner["class_name"]
+                names = _k3_var_names(icls, inner.get("config", {}))
+                if names is None:
+                    raise KerasImportError(
+                        f".keras import: TimeDistributed({icls}) wrapped "
+                        "layer has no variable-order table"
+                    )
+                copy_vars(
+                    f"layers/{group}/layer" + inner_src(icls),
+                    wroot.create_group(lname), names, lname, cls,
+                )
+                continue
+            names = _k3_var_names(cls, lcfg)
+            if names is None:
+                raise KerasImportError(
+                    f".keras import: no variable-order table for layer "
+                    f"class {cls!r} ({lname})"
+                )
+            copy_vars(
+                f"layers/{group}" + inner_src(cls),
+                wroot.create_group(lname), names, lname, cls,
+            )
+    wsrc.close()
+
+
+def import_keras3(path: str):
+    """Import a Keras-3 native `.keras` archive (Sequential or
+    Functional).  The zip converts to the legacy-HDF5 layout in a temp
+    file and the standard import path (mappers + weight validation) runs
+    unchanged."""
+    import os
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(suffix=".h5")
+    os.close(fd)
+    try:
+        _convert_keras3_zip(path, tmp)
+        return import_keras_auto(tmp)
+    finally:
+        os.unlink(tmp)
+
+
+def import_keras_auto(path: str):
+    """Dispatch on the container: .keras zip archives convert and recurse;
+    HDF5 files dispatch on the saved model class — Functional/Model ->
+    GraphModel, Sequential -> SequentialModel (the reference's
+    importKerasModelAndWeights accepts both)."""
+    import zipfile
+
+    import h5py
+
+    if zipfile.is_zipfile(path):
+        return import_keras3(path)
     with h5py.File(path, "r") as f:
         raw = f.attrs.get("model_config")
         if raw is None:

@@ -389,6 +389,32 @@ def gen_keras():
     save_keras("convlstm2d_stack", m,
                rng.normal(size=(2, 4, 9, 9, 1)).astype(np.float32))
 
+    # Keras-3 native .keras archives (zip: config.json + ordered-vars
+    # weights) — same golden scheme, exercising the zip converter
+    m = keras.Sequential([
+        keras.layers.Input((6,)),
+        keras.layers.Dense(9, activation="relu"),
+        keras.layers.BatchNormalization(),
+        keras.layers.Dense(3, activation="softmax"),
+    ])
+    m.compile(loss="categorical_crossentropy", optimizer="adam")
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    m.save(os.path.join(HERE, "keras", "native_mlp.keras"))
+    np.savez(os.path.join(HERE, "keras", "native_mlp_io.npz"),
+             in_x=x, out_y=np.asarray(m(x, training=False)))
+    print("keras/native_mlp.keras (Keras-3 zip archive)")
+
+    m = keras.Sequential([
+        keras.layers.Input((5, 4)),
+        keras.layers.LSTM(6),
+        keras.layers.Dense(2),
+    ])
+    x = rng.normal(size=(3, 5, 4)).astype(np.float32)
+    m.save(os.path.join(HERE, "keras", "native_lstm.keras"))
+    np.savez(os.path.join(HERE, "keras", "native_lstm_io.npz"),
+             in_x=x, out_y=np.asarray(m(x, training=False)))
+    print("keras/native_lstm.keras (Keras-3 zip archive)")
+
     gen_keras1(rng)
 
 

@@ -30,7 +30,9 @@ def _cases(d, ext):
 
 
 TF_CASES = _cases(TF_DIR, ".pb")
-KERAS_CASES = _cases(KERAS_DIR, ".h5")
+KERAS_CASES = _cases(KERAS_DIR, ".h5") + [
+    n + ".keras" for n in _cases(KERAS_DIR, ".keras")
+]
 
 
 def test_corpus_exists():
@@ -55,8 +57,10 @@ def test_tf_golden(name):
 
 @pytest.mark.parametrize("name", KERAS_CASES)
 def test_keras_golden(name):
-    model = import_keras_auto(os.path.join(KERAS_DIR, f"{name}.h5"))
-    io = np.load(os.path.join(KERAS_DIR, f"{name}_io.npz"))
+    fname = name if name.endswith(".keras") else f"{name}.h5"
+    stem = name[:-6] if name.endswith(".keras") else name
+    model = import_keras_auto(os.path.join(KERAS_DIR, fname))
+    io = np.load(os.path.join(KERAS_DIR, f"{stem}_io.npz"))
     got = model.output(io["in_x"].astype(np.float32))
     if isinstance(got, tuple):
         (got,) = got

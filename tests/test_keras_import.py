@@ -604,3 +604,91 @@ class TestSharedLayerImport:
         # the second output must be enc(xb), NOT a rewire of enc(xa)
         np.testing.assert_allclose(np.asarray(got[1]), wb, atol=2e-4,
                                    rtol=1e-3)
+
+
+class TestKeras3NativeFormat:
+    """Keras-3 .keras zip archives (config.json + ordered-vars weights)
+    convert to the legacy layout and ride the standard import path."""
+
+    def _check(self, m, x, tmp_path, tag, atol=3e-4):
+        from deeplearning4j_tpu.modelimport.keras import import_keras_auto
+
+        p = str(tmp_path / f"{tag}.keras")
+        m.save(p)
+        want = np.asarray(m(x, training=False))
+        got = np.asarray(import_keras_auto(p).output(x))
+        np.testing.assert_allclose(got, want, atol=atol, rtol=1e-3)
+
+    def test_mlp_cnn_rnn(self, tmp_path):
+        keras = tf.keras
+        rng = np.random.default_rng(0)
+        m = keras.Sequential([
+            keras.layers.Input((10, 10, 3)),
+            keras.layers.Conv2D(6, 3, padding="same", activation="relu"),
+            keras.layers.BatchNormalization(),
+            keras.layers.MaxPooling2D(),
+            keras.layers.Flatten(),
+            keras.layers.Dense(4),
+        ])
+        self._check(m, rng.normal(size=(2, 10, 10, 3)).astype(np.float32),
+                    tmp_path, "cnn")
+        m = keras.Sequential([
+            keras.layers.Input((7, 5)),
+            keras.layers.LSTM(6, return_sequences=True),
+            keras.layers.GRU(4),
+            keras.layers.Dense(2),
+        ])
+        self._check(m, rng.normal(size=(3, 7, 5)).astype(np.float32),
+                    tmp_path, "rnn")
+
+    def test_optional_weights_dropped_mid_order(self, tmp_path):
+        """BN scale=False / LN center=False shift the vars order from the
+        FRONT/middle — names must come from the config, not a fixed
+        prefix (r4 review finding)."""
+        keras = tf.keras
+        rng = np.random.default_rng(1)
+        m = keras.Sequential([
+            keras.layers.Input((8, 8, 2)),
+            keras.layers.Conv2D(4, 3, padding="same", use_bias=False),
+            keras.layers.BatchNormalization(scale=False),
+            keras.layers.Flatten(),
+            keras.layers.Dense(2),
+        ])
+        self._check(m, rng.normal(size=(2, 8, 8, 2)).astype(np.float32),
+                    tmp_path, "bn_noscale")
+        m = keras.Sequential([
+            keras.layers.Input((6,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.LayerNormalization(center=False),
+            keras.layers.Dense(3),
+        ])
+        self._check(m, rng.normal(size=(4, 6)).astype(np.float32),
+                    tmp_path, "ln_nocenter")
+
+    def test_wrapper_layers(self, tmp_path):
+        """Bidirectional/TimeDistributed weights nest under
+        forward_layer/backward_layer/layer paths (r4 review finding)."""
+        keras = tf.keras
+        rng = np.random.default_rng(2)
+        m = keras.Sequential([
+            keras.layers.Input((6, 4)),
+            keras.layers.Bidirectional(
+                keras.layers.LSTM(5, return_sequences=True)),
+            keras.layers.TimeDistributed(
+                keras.layers.Dense(3, activation="relu")),
+            keras.layers.Bidirectional(keras.layers.GRU(2)),
+            keras.layers.Dense(2),
+        ])
+        self._check(m, rng.normal(size=(3, 6, 4)).astype(np.float32),
+                    tmp_path, "wrappers")
+
+    def test_functional_keras3(self, tmp_path):
+        keras = tf.keras
+        rng = np.random.default_rng(3)
+        inp = keras.layers.Input((9,))
+        a = keras.layers.Dense(8, activation="relu")(inp)
+        b = keras.layers.Dense(8, activation="tanh")(inp)
+        out = keras.layers.Dense(3)(keras.layers.concatenate([a, b]))
+        m = keras.Model(inp, out)
+        self._check(m, rng.normal(size=(4, 9)).astype(np.float32),
+                    tmp_path, "functional")
