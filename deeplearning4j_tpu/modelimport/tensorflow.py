@@ -16,7 +16,8 @@ and transformer encoders: matmul/batched-matmul, decomposed layer-norm,
 erf-gelu, embedding gather, attention softmax) PLUS control flow in both TF
 representations — V1 frames (Switch/Merge/Enter/Exit/NextIteration/LoopCond,
 the reference's VarId name+frame+iteration scheme, SURVEY §3.3) are
-reconstructed structurally into lax.while_loop / lax.cond, and V2 functional
+reconstructed structurally into lax.while_loop / lax.cond — RECURSIVELY,
+so nested while frames import — and V2 functional
 While/If/PartitionedCall execute their FunctionDef bodies as trace-time
 sub-interpreters.  Dynamic-shape ops (Shape/Size at runtime) are rejected
 with a clear message rather than imported wrong.  Reverse-mode autodiff
@@ -176,12 +177,27 @@ class _Importer:
         # V1 frame-based control flow (Switch/Merge/Enter/Exit/
         # NextIteration/LoopCond — the reference's VarId frames, SURVEY
         # §3.3): reconstructed structurally into lax.while_loop / lax.cond
-        # rather than imported op-by-op.
+        # rather than imported op-by-op.  The same pass runs RECURSIVELY
+        # inside loop-body subgraphs, so nested while frames import too.
+        self._run_structured(nodes)
+        return self.sd
+
+    def _run_structured(self, nodes) -> None:
+        """Dispatch a node list with V1 control-flow reconstruction: frame
+        and cond structures fire as macro-nodes; everything else goes
+        through the op_* handlers."""
         frames = self._find_v1_frames(nodes)
-        conds = self._find_v1_conds(nodes, frames)
+        top = {
+            fname: fr for fname, fr in frames.items()
+            if not any(
+                fname != other and fr["members"] < frames[other]["members"]
+                for other in frames
+            )
+        }
+        conds = self._find_v1_conds(nodes, top)
         skip: Dict[str, tuple] = {}          # node name -> ("frame"|"cond", key)
         trigger: Dict[str, tuple] = {}       # first node of a structure
-        for fname, fr in frames.items():
+        for fname, fr in top.items():
             for nm in fr["members"]:
                 skip[nm] = ("frame", fname)
             trigger[fr["trigger"]] = ("frame", fname)
@@ -194,7 +210,7 @@ class _Importer:
             if node.name in trigger:
                 kind, key = trigger[node.name]
                 if kind == "frame":
-                    self._import_v1_frame(frames[key])
+                    self._import_v1_frame(top[key], frames)
                 else:
                     self._import_v1_cond(conds[key])
                 continue
@@ -212,7 +228,6 @@ class _Importer:
                     )
                 raise TFImportError(f"{node.name}: unsupported TF op {op!r}")
             handler(node)
-        return self.sd
 
     def _const_var(self, name: str, value: np.ndarray, base: str | None = None) -> SDVariable:
         """Materialize a static value as a graph node, honoring trainable
@@ -809,15 +824,29 @@ class _Importer:
             stack = list(members)
             while stack:
                 cur = stack.pop()
-                if by_name[cur].op == "Exit":
-                    continue  # Exit pops the frame: its output is outside
+                node = by_name[cur]
+                if node.op == "Exit":
+                    # OUR Exit pops the frame (its output lives outside);
+                    # an INNER frame's Exit is interior and propagation
+                    # continues through it.  Ownership: an Exit belongs to
+                    # the frame whose Enter feeds the Merge behind its
+                    # Switch.
+                    sw_base = _input_name(node.input[0])[0]
+                    sw = by_name.get(sw_base)
+                    ours = False
+                    if sw is not None and sw.op == "Switch":
+                        mg = by_name.get(_input_name(sw.input[0])[0])
+                        if mg is not None and mg.op == "Merge":
+                            ent_names = {
+                                n.name for n in fr["enters"] + fr["cap_enters"]
+                            }
+                            ours = any(
+                                _input_name(i)[0] in ent_names
+                                for i in mg.input
+                            )
+                    if ours:
+                        continue  # OUR Exit pops the frame
                 for c in consumers.get(cur, []):
-                    if c.op == "Enter":
-                        raise TFImportError(
-                            f"frame {fname!r}: nested while frames are not "
-                            "supported (flatten or export with "
-                            "control-flow-v2 While)"
-                        )
                     if c.name not in members:
                         members.add(c.name)
                         stack.append(c.name)
@@ -827,45 +856,71 @@ class _Importer:
             fr["name"] = fname
         return frames
 
-    def _import_v1_frame(self, fr: dict) -> None:
+    def _import_v1_frame(self, fr: dict, all_frames: dict) -> None:
         by_name = {n.name: n for n in fr["order"]}
+        # nested frames: nodes of strictly-contained child frames are part
+        # of the INTERIOR (the body sub-pass reconstructs them); only THIS
+        # frame's LOOP structure is stripped.  Cond diamonds inside the
+        # body (tf.cond in a while body) keep their Switch/Merge nodes in
+        # the interior too — the recursive sub-pass rebuilds them.
+        child_names: set = set()
+        for other, ofr in all_frames.items():
+            if other != fr["name"] and ofr["members"] < fr["members"]:
+                child_names |= ofr["members"]
+        own = lambda n: n.name not in child_names
         enter_names = {n.name for n in fr["enters"]}
-        merges = [n for n in fr["order"] if n.op == "Merge"]
-        loopconds = [n for n in fr["order"] if n.op == "LoopCond"]
+        loopconds = [n for n in fr["order"]
+                     if n.op == "LoopCond" and own(n)]
         if len(loopconds) != 1:
             raise TFImportError(
                 f"frame {fr['name']!r}: expected exactly one LoopCond, "
                 f"found {len(loopconds)}"
             )
-        pred_ref = loopconds[0].input[0]
+        loopcond = loopconds[0]
+        pred_ref = loopcond.input[0]
+        # THIS frame's loop plumbing: merges fed by our Enters, switches
+        # gated by our LoopCond, their NextIterations and Exits.  Any
+        # other Merge/Switch in the frame is a cond diamond -> interior.
         merge_of_enter: Dict[str, Any] = {}
         next_of_merge: Dict[str, Any] = {}
-        for m in merges:
+        loop_structural: set = {loopcond.name}
+        for m in fr["order"]:
+            if m.op != "Merge" or not own(m):
+                continue
             srcs = [_input_name(i)[0] for i in m.input]
             ent = next((s for s in srcs if s in enter_names), None)
             if ent is None:
-                raise TFImportError(
-                    f"frame {fr['name']!r}: Merge {m.name} has no Enter "
-                    "input (unrecognized loop structure)"
-                )
+                continue               # cond-diamond Merge: body interior
             merge_of_enter[ent] = m
+            loop_structural.add(m.name)
             nxt = next(
                 (s for s in srcs
                  if s in by_name and by_name[s].op == "NextIteration"),
                 None,
             )
             next_of_merge[m.name] = nxt
-        switch_of_merge = {
-            _input_name(s.input[0])[0]: s
-            for s in fr["order"] if s.op == "Switch"
-        }
-        exit_of_switch = {
-            _input_name(e.input[0])[0]: e
-            for e in fr["order"] if e.op == "Exit"
-        }
-        structural = {"Enter", "Merge", "Switch", "Exit", "NextIteration",
-                      "LoopCond"}
-        interior = [n for n in fr["order"] if n.op not in structural]
+            if nxt is not None:
+                loop_structural.add(nxt)
+        switch_of_merge = {}
+        for s in fr["order"]:
+            if s.op != "Switch" or not own(s):
+                continue
+            if _input_name(s.input[1])[0] != loopcond.name:
+                continue               # cond-diamond Switch: body interior
+            switch_of_merge[_input_name(s.input[0])[0]] = s
+            loop_structural.add(s.name)
+        exit_of_switch = {}
+        for e in fr["order"]:
+            if e.op != "Exit" or not own(e):
+                continue
+            sw = _input_name(e.input[0])[0]
+            if sw in {s.name for s in switch_of_merge.values()}:
+                exit_of_switch[sw] = e
+                loop_structural.add(e.name)
+        loop_structural |= {n.name for n in fr["enters"] + fr["cap_enters"]}
+        interior = [
+            n for n in fr["order"] if n.name not in loop_structural
+        ]
 
         # loop-invariant captures (Enter is_constant=true): static parent
         # values seed the body's const table (so shape/axis consumers keep
@@ -1031,6 +1086,7 @@ class _Importer:
                 "true_ref": sides[1],
                 "false_ref": sides[0],
                 "switches": switches,
+                "switch_nodes": [by_name[s] for s in switches],
                 "pred_ref": some_sw.input[1],
                 "interior_order": [
                     n for n in nodes
@@ -1050,10 +1106,9 @@ class _Importer:
         ]
         args = [
             self.in_var(
-                next(i for i in self._cond_switch(sw).input
-                     if not i.startswith("^"))
+                next(i for i in sw_node.input if not i.startswith("^"))
             )
-            for sw in plan["switches"]
+            for sw_node in plan["switch_nodes"]
         ]
         true_fn = _SubgraphFn(
             interior, [f"{sw}:1" for sw in plan["switches"]],
@@ -1074,12 +1129,6 @@ class _Importer:
             name=m.name,
         )
         self.vars[m.name] = out
-
-    def _cond_switch(self, name: str):
-        for n in self.gd.node:
-            if n.name == name:
-                return n
-        raise TFImportError(f"switch node {name!r} vanished")
 
     # -- V2 functional control flow (While/If + FunctionDef library) --
     @staticmethod
@@ -1198,16 +1247,10 @@ class _SubgraphFn:
             self.in_keys.append(ph.name)
         imp.sd.reserve_names(n.name for n in nodes)
         needed = self._slice(nodes, outputs)
-        for node in nodes:
-            if node.name not in needed:
-                continue
-            handler = getattr(imp, f"op_{node.op}", None)
-            if handler is None:
-                raise TFImportError(
-                    f"{label}: unsupported TF op {node.op!r} in "
-                    "control-flow body"
-                )
-            handler(node)
+        try:
+            imp._run_structured([n for n in nodes if n.name in needed])
+        except TFImportError as exc:
+            raise TFImportError(f"{label}: {exc}") from exc
         self.out_keys = [imp.in_var(r).name for r in outputs]
 
     @staticmethod
