@@ -910,11 +910,12 @@ class _Importer:
             switch_of_merge[_input_name(s.input[0])[0]] = s
             loop_structural.add(s.name)
         exit_of_switch = {}
+        loop_switch_names = {s.name for s in switch_of_merge.values()}
         for e in fr["order"]:
             if e.op != "Exit" or not own(e):
                 continue
             sw = _input_name(e.input[0])[0]
-            if sw in {s.name for s in switch_of_merge.values()}:
+            if sw in loop_switch_names:
                 exit_of_switch[sw] = e
                 loop_structural.add(e.name)
         loop_structural |= {n.name for n in fr["enters"] + fr["cap_enters"]}
