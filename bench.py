@@ -864,12 +864,15 @@ def bench_scaling() -> None:
     print(json.dumps(out))
 
 
-def _device_backend_alive(timeout: float = 180.0, tries: int = 3,
-                           wait: float = 60.0) -> bool:
+def _device_backend_alive(timeout: float = 120.0, tries: int = 2,
+                           wait: float = 30.0) -> bool:
     """Probe backend initialization in a SUBPROCESS with a hard timeout:
     a dead tunnel makes jax.devices() hang indefinitely IN-PROCESS
     (observed r4), which would leave the driver with no record at all.
-    Retries cover transient flaps."""
+    Retries cover transient flaps.  A hang (timeout) is retried; a
+    DETERMINISTIC child failure (broken install) is reported with its
+    stderr and not retried.  Skip the probe (and its one extra backend
+    init, tens of seconds on a tunnel) with BENCH_SKIP_PROBE=1."""
     import subprocess
 
     for i in range(tries):
@@ -880,10 +883,15 @@ def _device_backend_alive(timeout: float = 180.0, tries: int = 3,
             )
             if r.returncode == 0:
                 return True
+            print("[bench] backend probe FAILED (not a hang) rc="
+                  f"{r.returncode}: "
+                  f"{r.stderr.decode(errors='replace')[-500:]}",
+                  file=sys.stderr)
+            return False
         except subprocess.TimeoutExpired:
-            pass
-        print(f"[bench] device backend unreachable "
-              f"(attempt {i + 1}/{tries})", file=sys.stderr)
+            print(f"[bench] device backend unreachable — init hung "
+                  f">{timeout:.0f}s (attempt {i + 1}/{tries})",
+                  file=sys.stderr)
         if i + 1 < tries:
             time.sleep(wait)
     return False
@@ -893,15 +901,17 @@ def main() -> None:
     global QUICK
     t_start = time.time()
     tpu_unreachable = False
-    if os.environ.get("BENCH_FORCE_CPU", "") not in ("", "0"):
+    forced_cpu = os.environ.get("BENCH_FORCE_CPU", "") not in ("", "0")
+    if not forced_cpu and os.environ.get(
+        "BENCH_SKIP_PROBE", ""
+    ) in ("", "0") and not _device_backend_alive():
         tpu_unreachable = True
-    elif not _device_backend_alive():
-        tpu_unreachable = True
-    if tpu_unreachable:
+    if tpu_unreachable or forced_cpu:
         # record SOMETHING honest rather than hanging the driver: tiny
         # CPU shapes, clearly marked — numbers are not comparable
         print("[bench] falling back to CPU quick mode "
-              "(tpu_unreachable=true)", file=sys.stderr)
+              + ("(forced)" if forced_cpu else "(tpu_unreachable=true)"),
+              file=sys.stderr)
         QUICK = True
         import jax
 
@@ -963,6 +973,8 @@ def main() -> None:
         "device_kind": kind,
         "peak_bf16_flops": peak,
         "quick_mode": QUICK,
+        "tpu_unreachable": tpu_unreachable,
+        "forced_cpu": forced_cpu,
         "wall_s": round(time.time() - t_start, 1),
         "baseline_assumption": (
             "cuDNN A100 fp32 ResNet-50 ~400 samples/sec "
@@ -1010,6 +1022,7 @@ def main() -> None:
                     "tokens_per_sec"),
                 "quick_mode": QUICK,
                 "tpu_unreachable": tpu_unreachable or None,
+                "forced_cpu": forced_cpu or None,
                 "detail_file": "BENCH_DETAILS.json",
             },
         }
