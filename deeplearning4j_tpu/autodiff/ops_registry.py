@@ -11,6 +11,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 
 def _conv2d(x, w, *, stride=(1, 1), padding="SAME", dilation=(1, 1)):
@@ -1479,17 +1480,20 @@ def _mixture_density_loss(params, target, *, components):
     return jnp.mean(-jax.scipy.special.logsumexp(log_pi + comp, axis=-1))
 
 
-_RGB_YIQ = jnp.array([[0.299, 0.587, 0.114],
-                      [0.59590059, -0.27455667, -0.32134392],
-                      [0.21153661, -0.52273617, 0.31119955]], jnp.float32)
-_RGB_YUV = jnp.array([[0.299, 0.587, 0.114],
-                      [-0.14714119, -0.28886916, 0.43601035],
-                      [0.61497538, -0.51496512, -0.10001026]], jnp.float32)
+# HOST-side constants: a module-level jnp.array would initialize the
+# device backend at import time — which HANGS outright when the tunneled
+# chip is down (observed r4).  The cast to device happens inside the op.
+_RGB_YIQ = np.array([[0.299, 0.587, 0.114],
+                     [0.59590059, -0.27455667, -0.32134392],
+                     [0.21153661, -0.52273617, 0.31119955]], np.float32)
+_RGB_YUV = np.array([[0.299, 0.587, 0.114],
+                     [-0.14714119, -0.28886916, 0.43601035],
+                     [0.61497538, -0.51496512, -0.10001026]], np.float32)
 
 
 def _colorspace(mat):
     def fwd(x):
-        return x @ mat.T.astype(x.dtype)
+        return x @ jnp.asarray(mat.T, x.dtype)
 
     return fwd
 
@@ -1524,9 +1528,9 @@ OPS.update({
     "max_pool_with_argmax_indices": _max_pool_with_argmax_indices,
     # --- image tail 2 ---
     "rgb_to_yiq": _colorspace(_RGB_YIQ),
-    "yiq_to_rgb": _colorspace(jnp.linalg.inv(_RGB_YIQ)),
+    "yiq_to_rgb": _colorspace(np.linalg.inv(_RGB_YIQ)),
     "rgb_to_yuv": _colorspace(_RGB_YUV),
-    "yuv_to_rgb": _colorspace(jnp.linalg.inv(_RGB_YUV)),
+    "yuv_to_rgb": _colorspace(np.linalg.inv(_RGB_YUV)),
     "resize_bilinear": _resize("bilinear"),
     "resize_nearest": _resize("nearest"),
     "resize_bicubic": _resize("bicubic"),
