@@ -864,53 +864,194 @@ def bench_scaling() -> None:
     print(json.dumps(out))
 
 
-def _device_backend_alive(timeout: float = 120.0, tries: int = 2,
-                           wait: float = 30.0) -> bool:
-    """Probe backend initialization in a SUBPROCESS with a hard timeout:
-    a dead tunnel makes jax.devices() hang indefinitely IN-PROCESS
-    (observed r4), which would leave the driver with no record at all.
-    Retries cover transient flaps.  A hang (timeout) is retried; a
-    DETERMINISTIC child failure (broken install) is reported with its
-    stderr and not retried.  Skip the probe (and its one extra backend
-    init, tens of seconds on a tunnel) with BENCH_SKIP_PROBE=1."""
+def _await_backend(window_s: float = 600.0) -> dict:
+    """Retry-with-backoff backend probe over a BOUNDED window (~10 min:
+    tunnels flap on the order of minutes, and round 4's driver capture
+    hit a dead window that a single 2-try probe could not ride out).
+
+    Each attempt initializes the backend in a SUBPROCESS with a hard
+    timeout — a dead tunnel makes jax.devices() hang indefinitely
+    in-process (observed r4).  Non-zero child exits are retried too: a
+    dead tunnel can surface as a client exception rather than a hang;
+    the stderr tail is recorded per attempt so a genuinely broken
+    install is still diagnosable from the evidence.  Skip entirely with
+    BENCH_SKIP_PROBE=1; shrink/grow the window with BENCH_PROBE_WINDOW_S.
+
+    Returns {"alive": bool, "window_s": float, "attempts": [...]} —
+    kept as the probe evidence in the record when the backend never
+    comes up.
+    """
     import subprocess
 
-    for i in range(tries):
+    t0 = time.time()
+    deadline = t0 + window_s
+    waits = [15.0, 30.0, 60.0, 120.0]
+    attempts = []
+    i = 0
+    while True:
+        remaining = deadline - time.time()
+        att = {"t_s": round(time.time() - t0, 1)}
         try:
             r = subprocess.run(
                 [sys.executable, "-c", "import jax; jax.devices()"],
-                timeout=timeout, capture_output=True,
+                timeout=max(30.0, min(120.0, remaining)),
+                capture_output=True,
             )
             if r.returncode == 0:
-                return True
-            print("[bench] backend probe FAILED (not a hang) rc="
-                  f"{r.returncode}: "
-                  f"{r.stderr.decode(errors='replace')[-500:]}",
+                att["outcome"] = "ok"
+                attempts.append(att)
+                return {"alive": True, "window_s": window_s,
+                        "attempts": attempts}
+            att["outcome"] = f"rc={r.returncode}"
+            att["stderr_tail"] = r.stderr.decode(errors="replace")[-300:]
+            print(f"[bench] backend probe exited rc={r.returncode} "
+                  f"(attempt {i + 1}): {att['stderr_tail'][-160:]}",
                   file=sys.stderr)
-            return False
         except subprocess.TimeoutExpired:
-            print(f"[bench] device backend unreachable — init hung "
-                  f">{timeout:.0f}s (attempt {i + 1}/{tries})",
+            att["outcome"] = "hang"
+            print(f"[bench] backend probe hung (attempt {i + 1}, "
+                  f"{time.time() - t0:.0f}s into {window_s:.0f}s window)",
                   file=sys.stderr)
-        if i + 1 < tries:
-            time.sleep(wait)
-    return False
+        attempts.append(att)
+        wait = waits[min(i, len(waits) - 1)]
+        i += 1
+        if time.time() + wait >= deadline:
+            return {"alive": False, "window_s": window_s,
+                    "attempts": attempts}
+        time.sleep(wait)
+
+
+def _last_committed_tpu_record(limit: int = 40):
+    """Walk git history of BENCH_DETAILS.json for the most recent record
+    measured on a real TPU (not quick-mode, not a fallback) and return a
+    compact summary with its commit hash.  This is the evidence block the
+    scoreboard carries instead of a CPU number when the backend is dead:
+    the reader gets the chip's last known numbers plus the hash to verify
+    them, never a 400x-off fallback measurement in the value field."""
+    import subprocess
+
+    root = os.path.dirname(os.path.abspath(__file__))
+
+    def _run(*cmd):
+        return subprocess.run(cmd, cwd=root, capture_output=True,
+                              text=True, timeout=60)
+
+    try:
+        r = _run("git", "rev-list", f"-{limit}", "HEAD", "--",
+                 "BENCH_DETAILS.json")
+        if r.returncode != 0:
+            return None
+        shas = r.stdout.split()
+    except Exception:
+        return None
+    for sha in shas:
+        try:
+            raw = _run("git", "show", f"{sha}:BENCH_DETAILS.json")
+            if raw.returncode != 0:
+                continue
+            d = json.loads(raw.stdout)
+        except Exception:
+            continue
+        if "tpu" not in str(d.get("device_kind", "")).lower():
+            continue
+        if d.get("quick_mode") or d.get("tpu_unreachable"):
+            continue
+        cfg = d.get("configs", {})
+
+        def g(name, key):
+            return cfg.get(name, {}).get(key)
+
+        return {
+            "git": sha[:12],
+            "device_kind": d.get("device_kind"),
+            "resnet50_sps": g("resnet50", "samples_per_sec"),
+            "resnet50_mfu": g("resnet50", "mfu_vs_bf16_peak"),
+            "bert_sps": g("bert", "samples_per_sec"),
+            "bert_mfu": g("bert", "mfu_vs_bf16_peak"),
+            "lstm_sps": g("lstm", "samples_per_sec"),
+            "longctx_mfu": g("longctx", "mfu_vs_bf16_peak"),
+        }
+    return None
+
+
+def _headline_value(kind, measured):
+    """The canonical `value` field carries a genuine TPU measurement or
+    null — NEVER a CPU/fallback number (VERDICT r4 weak #1: a scoreboard
+    that can silently swap in CPU numbers will eventually be read
+    wrong).  Non-TPU measurements stay available under extra.*."""
+    return measured if "tpu" in str(kind).lower() else None
+
+
+def _emit_unreachable(probe_evidence, t_start, out_dir=None) -> None:
+    """Backend never came up inside the probe window: write the evidence
+    record (BENCH_DETAILS.json) and print a value=null headline carrying
+    the probe attempts and the last committed TPU record.  No benches
+    run — a CPU fallback number must not reach the scoreboard."""
+    last = _last_committed_tpu_record()
+    details = {
+        "device_kind": None,
+        "tpu_unreachable": True,
+        "quick_mode": False,
+        "wall_s": round(time.time() - t_start, 1),
+        "probe": probe_evidence,
+        "last_committed_tpu": last,
+        "note": (
+            "device backend unreachable for the whole probe window; "
+            "no benches were run (a CPU fallback would poison the "
+            "canonical value field — VERDICT r4 #1).  last_committed_tpu "
+            "carries the chip's most recent committed record and the git "
+            "hash to verify it."
+        ),
+    }
+    details_path = os.path.join(
+        out_dir or os.path.dirname(os.path.abspath(__file__)),
+        "BENCH_DETAILS.json")
+    try:
+        with open(details_path, "w") as f:
+            json.dump(details, f, indent=1)
+    except OSError as exc:
+        print(f"[bench] could not write {details_path}: {exc}",
+              file=sys.stderr)
+    probe_compact = {
+        "window_s": probe_evidence.get("window_s"),
+        "attempts": len(probe_evidence.get("attempts", [])),
+        "outcomes": [a.get("outcome")
+                     for a in probe_evidence.get("attempts", [])][:6],
+    }
+    line = json.dumps({
+        "metric": "ResNet-50 GraphModel fit() samples/sec "
+                  "(1 chip, 224x224, steady-state)",
+        "value": None,
+        "unit": "samples/sec",
+        "vs_baseline": None,
+        "extra": {
+            "tpu_unreachable": True,
+            "probe": probe_compact,
+            "last_committed_tpu": last,
+            "detail_file": "BENCH_DETAILS.json",
+        },
+    })
+    assert len(line) < 1024, f"headline line too long ({len(line)}B)"
+    print(line)
 
 
 def main() -> None:
     global QUICK
     t_start = time.time()
-    tpu_unreachable = False
     forced_cpu = os.environ.get("BENCH_FORCE_CPU", "") not in ("", "0")
-    if not forced_cpu and os.environ.get(
-        "BENCH_SKIP_PROBE", ""
-    ) in ("", "0") and not _device_backend_alive():
-        tpu_unreachable = True
-    if tpu_unreachable or forced_cpu:
-        # record SOMETHING honest rather than hanging the driver: tiny
-        # CPU shapes, clearly marked — numbers are not comparable
-        print("[bench] falling back to CPU quick mode "
-              + ("(forced)" if forced_cpu else "(tpu_unreachable=true)"),
+    if not forced_cpu and os.environ.get("BENCH_SKIP_PROBE", "") in ("", "0"):
+        evidence = _await_backend(
+            float(os.environ.get("BENCH_PROBE_WINDOW_S", "600")))
+        if not evidence["alive"]:
+            # no benches at all: a CPU fallback number must never reach
+            # the scoreboard's value field (VERDICT r4 #1)
+            _emit_unreachable(evidence, t_start)
+            return
+    if forced_cpu:
+        # explicit dev/CI knob: run tiny CPU shapes for plumbing checks —
+        # the headline value still reports null (see _headline_value)
+        print("[bench] BENCH_FORCE_CPU=1: CPU quick mode (headline value "
+              "will be null — CPU numbers live in extra/details only)",
               file=sys.stderr)
         QUICK = True
         import jax
@@ -955,7 +1096,10 @@ def main() -> None:
                 time.sleep(10)
 
     headline = results.get("resnet50", {})
-    value = headline.get("samples_per_sec", 0.0)
+    # missing -> None, not 0.0: an errored-out headline bench on a live
+    # chip must surface as null-with-evidence, not "the chip measured 0"
+    measured = headline.get("samples_per_sec")
+    value = _headline_value(kind, measured) if measured is not None else None
     h_timing = headline.get("timing", {})
     probe_summary = _PROBE.summary() if _PROBE is not None else {}
     # congestion_index: how far below the session-best tunnel health the
@@ -973,7 +1117,7 @@ def main() -> None:
         "device_kind": kind,
         "peak_bf16_flops": peak,
         "quick_mode": QUICK,
-        "tpu_unreachable": tpu_unreachable,
+        "tpu_unreachable": False,
         "forced_cpu": forced_cpu,
         "wall_s": round(time.time() - t_start, 1),
         "baseline_assumption": (
@@ -991,40 +1135,47 @@ def main() -> None:
     except OSError as exc:
         print(f"[bench] could not write {details_path}: {exc}", file=sys.stderr)
 
+    extra = {
+        "device_kind": kind,
+        "non_tpu_samples_per_sec": measured if value is None else None,
+        "last_committed_tpu": (
+            _last_committed_tpu_record() if value is None else None
+        ),
+        "batch": headline.get("batch"),
+        "mfu_vs_bf16_peak": headline.get("mfu_vs_bf16_peak"),
+        "congestion_index": congestion_index,
+        "window": {
+            k: h_timing.get(k)
+            for k in ("accepted_chunk", "chunks", "congested",
+                      "samples_per_sec_mean")
+        } if h_timing else None,
+        "probe": probe_summary or None,
+        "etl_fed_sps": results.get("resnet50_etl", {}).get(
+            "samples_per_sec"),
+        "etl_images_per_sec": results.get("resnet50_etl", {}).get(
+            "etl_images_per_sec"),
+        "lstm_sps": results.get("lstm", {}).get("samples_per_sec"),
+        "bert_sps": results.get("bert", {}).get("samples_per_sec"),
+        "bert_mfu": results.get("bert", {}).get("mfu_vs_bf16_peak"),
+        "longctx_tokens_per_sec": results.get("longctx", {}).get(
+            "tokens_per_sec"),
+        "quick_mode": QUICK,
+        "forced_cpu": forced_cpu or None,
+        "detail_file": "BENCH_DETAILS.json",
+    }
     line = json.dumps(
         {
             "metric": "ResNet-50 GraphModel fit() samples/sec "
                       "(1 chip, 224x224, steady-state)",
             "value": value,
             "unit": "samples/sec",
-            "vs_baseline": round(
-                value / ASSUMED_RESNET50_A100_SAMPLES_PER_SEC, 3
+            "vs_baseline": (
+                round(value / ASSUMED_RESNET50_A100_SAMPLES_PER_SEC, 3)
+                if value is not None else None
             ),
-            "extra": {
-                "device_kind": kind,
-                "batch": headline.get("batch"),
-                "mfu_vs_bf16_peak": headline.get("mfu_vs_bf16_peak"),
-                "congestion_index": congestion_index,
-                "window": {
-                    k: h_timing.get(k)
-                    for k in ("accepted_chunk", "chunks", "congested",
-                              "samples_per_sec_mean")
-                } if h_timing else None,
-                "probe": probe_summary or None,
-                "etl_fed_sps": results.get("resnet50_etl", {}).get(
-                    "samples_per_sec"),
-                "etl_images_per_sec": results.get("resnet50_etl", {}).get(
-                    "etl_images_per_sec"),
-                "lstm_sps": results.get("lstm", {}).get("samples_per_sec"),
-                "bert_sps": results.get("bert", {}).get("samples_per_sec"),
-                "bert_mfu": results.get("bert", {}).get("mfu_vs_bf16_peak"),
-                "longctx_tokens_per_sec": results.get("longctx", {}).get(
-                    "tokens_per_sec"),
-                "quick_mode": QUICK,
-                "tpu_unreachable": tpu_unreachable or None,
-                "forced_cpu": forced_cpu or None,
-                "detail_file": "BENCH_DETAILS.json",
-            },
+            # null-valued extras are pruned to keep the line inside the
+            # driver's 1KB tail window even with the evidence block
+            "extra": {k: v for k, v in extra.items() if v is not None},
         }
     )
     assert len(line) < 1024, f"headline line too long ({len(line)}B)"

@@ -949,6 +949,9 @@ class _Importer:
                 f"{node.name}: clip attribute not supported (imports would "
                 "compute unclipped gates — numerically different)"
             )
+        layout = int(a.get("layout", 0))
+        if layout not in (0, 1):
+            raise ONNXImportError(f"{node.name}: layout must be 0 or 1")
         n_dirs = 2 if direction == "bidirectional" else 1
         W = self.static_value(node.input[1])     # (dirs, G*H, in)
         R = self.static_value(node.input[2])     # (dirs, G*H, H)
@@ -965,7 +968,7 @@ class _Importer:
                 f"{node.name}: W shape {W.shape} inconsistent with "
                 f"hidden_size={H}, direction={direction}"
             )
-        return a, H, direction, n_dirs, W, R, B
+        return a, H, direction, n_dirs, W, R, B, layout
 
     def _rnn_states(self, node, n_states):
         """Optional initial-state inputs at positions 5..: respect EMPTY
@@ -988,12 +991,16 @@ class _Importer:
         return states
 
     def _rnn_emit(self, node, n_dirs, direction, H, dirs, make_cell,
-                  n_carry, n_states):
+                  n_carry, n_states, layout=0):
         """Shared per-direction scan driver.
 
         make_cell(dir_params) -> cell(carry_tuple, x_t) -> (carry, y);
         carry arity n_carry (1 = h, 2 = (h, c)).  Emits Y (T, dirs, B, H)
-        plus one (dirs, B, H) output per carry slot."""
+        plus one (dirs, B, H) output per carry slot.  With layout=1
+        (opset >= 14 batch-first), X/states are transposed to the
+        time-major form on entry and Y/finals transposed back on exit —
+        XLA folds these into the scan's gather/scatter, so the cost is a
+        layout change at the graph edges, not per step."""
         import jax
         import jax.numpy as jnp
 
@@ -1007,6 +1014,13 @@ class _Importer:
             inits = [
                 next(it) if m else None for m in mask
             ]
+            if layout:
+                # layout=1: X (B, T, I), states (B, dirs, H)
+                x = jnp.transpose(x, (1, 0, 2))
+                inits = [
+                    None if z is None else jnp.transpose(z, (1, 0, 2))
+                    for z in inits
+                ]
             Bz = x.shape[1]
             zeros = jnp.zeros((n_dirs, Bz, H), x.dtype)
             inits = [z if z is not None else zeros for z in inits]
@@ -1020,9 +1034,13 @@ class _Importer:
                 ys.append(jnp.flip(y, 0) if rev[d] else y)
                 for k in range(n_carry):
                     finals[k].append(carryf[k])
-            return (jnp.stack(ys, axis=1),) + tuple(
-                jnp.stack(f, axis=0) for f in finals
-            )
+            Y = jnp.stack(ys, axis=1)
+            fin = tuple(jnp.stack(f, axis=0) for f in finals)
+            if layout:
+                # Y (T, dirs, B, H) -> (B, T, dirs, H); finals -> (B, dirs, H)
+                Y = jnp.transpose(Y, (2, 0, 1, 3))
+                fin = tuple(jnp.transpose(f, (1, 0, 2)) for f in fin)
+            return (Y,) + fin
 
         X = self.in_var(node.input[0])
         outs = self.sd.py_call(
@@ -1037,7 +1055,7 @@ class _Importer:
         import jax
         import jax.numpy as jnp
 
-        a, H, direction, n_dirs, W, R, B = self._rnn_common(node, 4)
+        a, H, direction, n_dirs, W, R, B, layout = self._rnn_common(node, 4)
         if a.get("activations") not in (None, ["Sigmoid", "Tanh", "Tanh"]
                                         * n_dirs):
             raise ONNXImportError(
@@ -1083,13 +1101,13 @@ class _Importer:
 
         self._rnn_emit(node, n_dirs, direction, H,
                        [prep(d) for d in range(n_dirs)], make_cell,
-                       n_carry=2, n_states=2)
+                       n_carry=2, n_states=2, layout=layout)
 
     def op_GRU(self, node):
         import jax
         import jax.numpy as jnp
 
-        a, H, direction, n_dirs, W, R, B = self._rnn_common(node, 3)
+        a, H, direction, n_dirs, W, R, B, layout = self._rnn_common(node, 3)
         if not a.get("linear_before_reset", 0):
             raise ONNXImportError(
                 f"{node.name}: GRU with linear_before_reset=0 computes "
@@ -1125,13 +1143,13 @@ class _Importer:
 
         self._rnn_emit(node, n_dirs, direction, H,
                        [prep(d) for d in range(n_dirs)], make_cell,
-                       n_carry=1, n_states=1)
+                       n_carry=1, n_states=1, layout=layout)
 
     def op_RNN(self, node):
         import jax
         import jax.numpy as jnp
 
-        a, H, direction, n_dirs, W, R, B = self._rnn_common(node, 1)
+        a, H, direction, n_dirs, W, R, B, layout = self._rnn_common(node, 1)
         acts = a.get("activations")
         if acts not in (None, ["Tanh"] * n_dirs):
             raise ONNXImportError(
@@ -1159,7 +1177,7 @@ class _Importer:
 
         self._rnn_emit(node, n_dirs, direction, H,
                        [prep(d) for d in range(n_dirs)], make_cell,
-                       n_carry=1, n_states=1)
+                       n_carry=1, n_states=1, layout=layout)
 
     # -- control flow (If / Loop — the reference imports ONNX subgraph
     # bodies; here they become lax.cond / lax.while_loop inside the same

@@ -155,17 +155,6 @@ _HPO_PAGE = """<!doctype html>
 <canvas id="scores" width="720" height="240"></canvas>
 <div id="table"></div>
 <script>
-function drawLayerPanel(canvasId, legendId, recs, key){
- const last=recs[recs.length-1];
- const layers=Object.keys(last[key]||{});
- if(!layers.length) return false;
- drawLines(document.getElementById(canvasId),
-  layers.map(l=>recs.map(r=>{
-   const v=(r[key]||{})[l]; return v>0?Math.log10(v):NaN;})));
- document.getElementById(legendId).innerHTML=
-  layers.map((l,i)=>`<span style="color:${colors[i%colors.length]}">■ ${l}</span>`).join(' ');
- return true;
-}
 async function refresh(){
  const rs=await (await fetch('api/hpo')).json();
  if(!rs.length){document.getElementById('table').textContent='no results yet';return}

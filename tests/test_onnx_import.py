@@ -737,6 +737,53 @@ class TestOnnxRecurrentOps:
         np.testing.assert_allclose(yc, want_c.detach().numpy(),
                                    atol=2e-5, rtol=1e-4)
 
+    def test_lstm_layout_batch_first_matches_time_major(self):
+        """opset>=14 layout=1 (batch-first X/Y/states) must produce the
+        transposed results of the identical layout=0 model — round 4
+        imported layout=1 silently with swapped axes."""
+        T, B, I, H = 5, 3, 4, 6
+        W = RNG.normal(0, 0.4, (1, 4 * H, I)).astype(np.float32)
+        R = RNG.normal(0, 0.4, (1, 4 * H, H)).astype(np.float32)
+        Bv = RNG.normal(0, 0.1, (1, 8 * H)).astype(np.float32)
+        x = RNG.normal(0, 1, (T, B, I)).astype(np.float32)
+        h0 = RNG.normal(0, 1, (1, B, H)).astype(np.float32)
+        c0 = RNG.normal(0, 1, (1, B, H)).astype(np.float32)
+        inits = {"W": W, "R": R, "B": Bv}
+
+        raw0 = make_model(
+            [make_node("LSTM", ["x", "W", "R", "B", "", "h0", "c0"],
+                       ["Y", "Y_h", "Y_c"], hidden_size=H)],
+            [("x", (T, B, I)), ("h0", (1, B, H)), ("c0", (1, B, H))],
+            ["Y", "Y_h", "Y_c"], initializers=inits)
+        raw1 = make_model(
+            [make_node("LSTM", ["x", "W", "R", "B", "", "h0", "c0"],
+                       ["Y", "Y_h", "Y_c"], hidden_size=H, layout=1)],
+            [("x", (B, T, I)), ("h0", (B, 1, H)), ("c0", (B, 1, H))],
+            ["Y", "Y_h", "Y_c"], initializers=inits)
+
+        y0, yh0, yc0 = self._run(
+            raw0, {"x": x, "h0": h0, "c0": c0}, "Y", "Y_h", "Y_c")
+        y1, yh1, yc1 = self._run(
+            raw1,
+            {"x": x.transpose(1, 0, 2), "h0": h0.transpose(1, 0, 2),
+             "c0": c0.transpose(1, 0, 2)},
+            "Y", "Y_h", "Y_c")
+        np.testing.assert_allclose(y1, y0.transpose(2, 0, 1, 3), atol=1e-6)
+        np.testing.assert_allclose(yh1, yh0.transpose(1, 0, 2), atol=1e-6)
+        np.testing.assert_allclose(yc1, yc0.transpose(1, 0, 2), atol=1e-6)
+
+    def test_gru_layout_rejected_when_invalid(self):
+        T, B, I, H = 3, 2, 3, 4
+        W = RNG.normal(0, 0.4, (1, 3 * H, I)).astype(np.float32)
+        R = RNG.normal(0, 0.4, (1, 3 * H, H)).astype(np.float32)
+        raw = make_model(
+            [make_node("GRU", ["x", "W", "R"], ["Y"], hidden_size=H,
+                       layout=2, linear_before_reset=1)],
+            [("x", (T, B, I))], ["Y"],
+            initializers={"W": W, "R": R})
+        with pytest.raises(ONNXImportError, match="layout"):
+            import_onnx(raw)
+
     def test_gru_linear_before_reset_matches_torch(self):
         import torch
 
