@@ -346,16 +346,32 @@ class SameDiff:
         return v
 
     def while_loop(self, cond_fn, body_fn, *loop_vars: SDVariable,
-                   name: str | None = None) -> tuple[SDVariable, ...]:
+                   name: str | None = None, max_trip: int | None = None,
+                   exact_trip: bool = False) -> tuple[SDVariable, ...]:
         """lax.while_loop.  `cond_fn(*vars) -> bool scalar`,
         `body_fn(*vars) -> tuple of same-shaped vars`.  Returns the final
-        loop variables."""
+        loop variables.
+
+        Differentiability (the reference differentiates through its
+        frame-based loops — SURVEY §3.3 VarId frames, §2.2 SameDiff):
+        plain lax.while_loop is forward-only, so when a trip bound is
+        known the loop lowers to lax.scan, which supports reverse-mode:
+
+        - ``max_trip=T, exact_trip=True``: the loop provably runs exactly
+          T iterations (e.g. a static counter) — the body is scanned T
+          times with no predicate at all.
+        - ``max_trip=T`` alone: scan T iterations, evaluating the
+          predicate each step and carrying values through unchanged once
+          it goes false (select-mask).  Semantically identical to the
+          while loop PROVIDED the true trip count never exceeds T.
+        """
         base = name or self._fresh("while")
         tuple_name = base + "#tuple"
         self._register(tuple_name, "op")
         self._ops.append(_OpNode(
             "_while", tuple(v.name for v in loop_vars), tuple_name,
-            {"cond_fn": cond_fn, "body_fn": body_fn},
+            {"cond_fn": cond_fn, "body_fn": body_fn,
+             "max_trip": max_trip, "exact_trip": exact_trip},
         ))
         outs = []
         for i in range(len(loop_vars)):
@@ -419,11 +435,46 @@ class SameDiff:
             if node.op == "_while":
                 body = attrs["body_fn"]
                 cond = attrs["cond_fn"]
+                max_trip = attrs.get("max_trip")
 
                 def body_wrap(vs, _body=body):
                     out = _body(*vs)
                     return tuple(out) if isinstance(out, (tuple, list)) else (out,)
 
+                if max_trip is not None:
+                    # bounded loop -> lax.scan: reverse-mode differentiable
+                    # (while_loop is forward-only).  exact_trip drops the
+                    # predicate entirely; otherwise each step selects
+                    # between the body output and the carried value.
+                    if attrs.get("exact_trip"):
+                        def step(vs, _, _b=body_wrap):
+                            return _b(vs), None
+                    else:
+                        init_vs = tuple(args)
+
+                        def step(vs, _, _b=body_wrap, _c=cond,
+                                 _iv=init_vs):
+                            pred = jnp.asarray(_c(*vs)).astype(bool).reshape(())
+                            # double-where: after termination the body
+                            # runs on the INITIAL values (known body-safe
+                            # for any loop that iterates), not the final
+                            # carry — otherwise a body that goes NaN/Inf
+                            # outside the predicate's domain poisons the
+                            # gradient through BOTH where branches
+                            safe = tuple(
+                                jnp.where(pred, v, v0)
+                                for v, v0 in zip(vs, _iv)
+                            )
+                            new = _b(safe)
+                            return tuple(
+                                jnp.where(pred, n, o)
+                                for n, o in zip(new, vs)
+                            ), None
+
+                    fin, _ = jax.lax.scan(step, tuple(args), None,
+                                          length=int(max_trip))
+                    env[node.output] = fin
+                    continue
                 env[node.output] = jax.lax.while_loop(
                     lambda vs, _c=cond: jnp.asarray(_c(*vs)).astype(bool).reshape(()),
                     body_wrap,
@@ -708,6 +759,7 @@ class SameDiff:
         manifest = {
             "kind": src["kind"],
             "trainable": bool(src.get("trainable", False)),
+            "loop_trip_bound": src.get("loop_trip_bound"),
             "placeholders": sorted(self._placeholders),
             "trainable_names": sorted(self._trainable),
             "loss_var": self._loss_var,
@@ -740,7 +792,8 @@ class SameDiff:
         if man["kind"] == "tf":
             from deeplearning4j_tpu.modelimport.tensorflow import import_graph
 
-            sd = import_graph(raw, trainable=man["trainable"])
+            sd = import_graph(raw, trainable=man["trainable"],
+                              loop_trip_bound=man.get("loop_trip_bound"))
         elif man["kind"] == "onnx":
             from deeplearning4j_tpu.modelimport.onnx import import_onnx
 

@@ -155,13 +155,23 @@ class ImageRecordReader(RecordReader):
         shuffle_seed: Optional[int] = None,
         label_generator=None,
         path_filter=None,
+        dtype="float32",
     ):
         """label_generator: Path -> label string (default: parent dir —
         the ParentPathLabelGenerator behavior; see
         pattern_label_generator for the filename-pattern variant).
         path_filter: list[Path] -> list[Path] applied before shuffling
-        (random_path_filter / balanced_path_filter roles)."""
+        (random_path_filter / balanced_path_filter roles).
+        dtype: 'float32' (default) or 'uint8' — uint8 keeps decoded
+        pixels as bytes end-to-end so batches cross the host->device
+        link at 1/4 the size; models cast to the compute dtype on
+        device (see models/_cast.entry_cast)."""
         self.height, self.width, self.channels = height, width, channels
+        self.dtype = np.dtype(dtype)
+        if self.dtype not in (np.dtype(np.float32), np.dtype(np.uint8)):
+            raise ValueError(
+                f"ImageRecordReader dtype must be float32 or uint8, "
+                f"got {dtype}")
         self._shuffle_seed = shuffle_seed
         self._label_of = label_generator or (lambda p: p.parent.name)
         self._path_filter = path_filter
@@ -189,7 +199,12 @@ class ImageRecordReader(RecordReader):
 
     def _decode(self, path: Path) -> np.ndarray:
         if path.suffix.lower() == ".npy":
-            img = np.load(path).astype(np.float32)
+            img = np.load(path)
+            if self.dtype == np.uint8 and img.dtype != np.uint8:
+                # clamp-round like the native path — a bare astype would
+                # truncate 254.9 -> 254 and WRAP negatives to 255
+                img = np.clip(np.rint(img), 0, 255)
+            img = img.astype(self.dtype)
             if img.ndim == 2:
                 img = img[:, :, None]
         else:
@@ -198,12 +213,13 @@ class ImageRecordReader(RecordReader):
             with Image.open(path) as im:
                 im = im.convert("L" if self.channels == 1 else "RGB")
                 im = im.resize((self.width, self.height))
-                img = np.asarray(im, dtype=np.float32)
+                img = np.asarray(im, dtype=self.dtype)
                 if img.ndim == 2:
                     img = img[:, :, None]
         if img.shape != (self.height, self.width, self.channels):
             # pad/crop npy fixtures that bypass PIL resizing
-            out = np.zeros((self.height, self.width, self.channels), np.float32)
+            out = np.zeros((self.height, self.width, self.channels),
+                           self.dtype)
             h = min(self.height, img.shape[0])
             w = min(self.width, img.shape[1])
             c = min(self.channels, img.shape[2])
@@ -236,7 +252,8 @@ class ImageRecordReader(RecordReader):
                 from deeplearning4j_tpu.runtime import native
 
                 batch = native.jpeg_batch_decode(
-                    jpegs, self.height, self.width, self.channels
+                    jpegs, self.height, self.width, self.channels,
+                    dtype=self.dtype,
                 )
                 decoded = {p: batch[j] for j, p in enumerate(jpegs)}
             for p in chunk:

@@ -22,11 +22,17 @@ ops raise ONNXImportError naming the op.
 
 from __future__ import annotations
 
+import os
 from typing import Dict, List
 
 import numpy as np
 
 from deeplearning4j_tpu.autodiff.samediff import SameDiff, SDVariable
+
+
+# static-M Loop nodes lower to differentiable lax.scan only up to this
+# many iterations (mirrors the TF importer's _TRIP_CAP)
+_LOOP_SCAN_CAP = int(os.environ.get("DL4JTPU_LOOP_TRIP_CAP", "16384"))
 
 
 class ONNXImportError(ValueError):
@@ -1232,6 +1238,18 @@ class _Importer:
         import jax.numpy as jnp
 
         m_name, cond_name = node.input[0], node.input[1]
+        # a static trip-count M <= cap bounds the loop by construction, so
+        # it lowers to differentiable scan+mask below.  A static M BEYOND
+        # the cap is the torch-export idiom for "cond-only while" (M =
+        # INT64_MAX): drop the i < M check entirely — both because a scan
+        # that long is absurd and because the int32 carry would overflow.
+        static_bound = None
+        if m_name and m_name in self.consts:
+            m_val = int(np.asarray(self.consts[m_name]).reshape(()))
+            if 0 <= m_val <= _LOOP_SCAN_CAP:
+                static_bound = m_val
+            else:
+                m_name = ""          # effectively unbounded
         max_trip = self.in_var(m_name) if m_name else None
         cond0 = self.in_var(cond_name) if cond_name else None
         state0 = [self.in_var(i) for i in node.input[2:]]
@@ -1266,7 +1284,8 @@ class _Importer:
         if max_trip is not None:
             init.append(max_trip)
 
-        outs = self.sd.while_loop(cond_fn, body_wrap, *init)
+        outs = self.sd.while_loop(cond_fn, body_wrap, *init,
+                                  max_trip=static_bound)
         # final state vars map to the node outputs (iter/cond dropped)
         for idx, o in enumerate(node.output[:n_state]):
             self.vars[o] = self.sd.apply(

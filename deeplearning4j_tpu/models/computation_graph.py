@@ -17,6 +17,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.models._cast import entry_cast
 from deeplearning4j_tpu.models.model import Model
 from deeplearning4j_tpu.models._common import (
     mask_frozen_tx,
@@ -127,8 +128,7 @@ class GraphModel(Model):
         """inputs: {input_name: array}. Returns ({output_name: logits}, new_state)."""
         acts: dict[str, jax.Array] = {}
         for name, x in inputs.items():
-            if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
-                x = x.astype(jnp.bfloat16)
+            x = entry_cast(x, self._bf16)
             acts[name] = x
         new_state = {}
         for i, node in enumerate(self._topo):
@@ -478,8 +478,7 @@ class GraphModel(Model):
             """Inference-mode topo walk up to `name`'s input activation."""
             acts = {}
             for iname, x in zip(self.conf.network_inputs, features):
-                if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
-                    x = x.astype(jnp.bfloat16)
+                x = entry_cast(x, self._bf16)
                 acts[iname] = x
             for nd in self._topo:
                 if nd.name == name:

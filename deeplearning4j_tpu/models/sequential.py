@@ -23,6 +23,7 @@ import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
 from deeplearning4j_tpu.data.iterator import DataSetIterator, NumpyDataSetIterator
+from deeplearning4j_tpu.models._cast import entry_cast
 from deeplearning4j_tpu.models.model import Model
 from deeplearning4j_tpu.nn.activations import Activation
 from deeplearning4j_tpu.nn.conf.input_type import InputType
@@ -158,8 +159,7 @@ class SequentialModel(Model):
         mask-aware layers until the time axis collapses."""
         from deeplearning4j_tpu.nn.conf.recurrent import RecurrentLayerConfig
 
-        if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
-            x = x.astype(jnp.bfloat16)
+        x = entry_cast(x, self._bf16)
         new_state, new_carries = {}, {}
         mask = fmask
         plan = self._active_pipeline_plan()
@@ -246,8 +246,8 @@ class SequentialModel(Model):
         the 1F1B pipeline step (no masks/carries: the pipelined path
         rejects them before tracing).  bf16 cast applies at the network
         entry (lo == 0)."""
-        if lo == 0 and self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
-            x = x.astype(jnp.bfloat16)
+        if lo == 0:
+            x = entry_cast(x, self._bf16)
         new_state = {}
         for i in range(lo, hi):
             layer = self.conf.layers[i]
@@ -1215,8 +1215,7 @@ class SequentialModel(Model):
     def _prefix_forward(self, params, x, stop: int):
         """Inference-mode forward through layers [0, stop) — the pretrain
         prefix.  Pure/traced; BN etc. use stored state without updating."""
-        if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
-            x = x.astype(jnp.bfloat16)
+        x = entry_cast(x, self._bf16)
         for i, layer in enumerate(self.conf.layers[:stop]):
             if self._flatten_before[i]:
                 x = x.reshape(x.shape[0], -1)
@@ -1318,8 +1317,7 @@ class SequentialModel(Model):
         debugging/inspection path."""
         acts = []
         x = jnp.asarray(features)
-        if self._bf16 and jnp.issubdtype(x.dtype, jnp.floating):
-            x = x.astype(jnp.bfloat16)
+        x = entry_cast(x, self._bf16)
         for i, layer in enumerate(self.conf.layers):
             if self._flatten_before[i]:
                 x = x.reshape(x.shape[0], -1)

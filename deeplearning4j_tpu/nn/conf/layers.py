@@ -245,6 +245,28 @@ class ActivationLayer(LayerConfig):
 
 @serde.register
 @dataclasses.dataclass(frozen=True)
+class ScaleShift(LayerConfig):
+    """Fixed elementwise `x * scale + shift` (the ScaleVertex role, as a
+    sequential layer).  Primary use: device-side image normalization for
+    the uint8 ETL wire path — `ScaleShift(scale=1/255.)` first in the
+    stack replaces a host-side ImagePreProcessingScaler, so batches cross
+    the host->device link as bytes and the scaling fuses into the jitted
+    step (zero extra HBM traffic; XLA folds it into the following conv's
+    input read)."""
+
+    scale: float = 1.0
+    shift: float = 0.0
+    HAS_PARAMS = False
+    REGULARIZED = ()
+
+    def apply(self, params, state, x, *, training=False, rng=None):
+        y = x * jnp.asarray(self.scale, x.dtype) + jnp.asarray(
+            self.shift, x.dtype)
+        return self._act()(y), state
+
+
+@serde.register
+@dataclasses.dataclass(frozen=True)
 class Dropout(LayerConfig):
     """Standalone dropout layer (DropoutLayer role)."""
 

@@ -157,6 +157,28 @@ def gen_tf():
 
     save_tf("cond_v1", cond_v1,
             {"x": rng.normal(size=(4,)).astype(np.float32)}, ["out"])
+
+    # Trainable-through-a-loop fixture (round 5): the LOSS path crosses a
+    # V1 while frame that applies an in-loop weight matrix — exercises
+    # static-trip-count inference (loop -> lax.scan) plus promotion of
+    # loop-captured float weights, so fine-tuning differentiates THROUGH
+    # the loop.  test_import_goldens fine-tunes it end to end.
+    w_loop = (rng.normal(size=(6, 6)) * 0.4).astype(np.float32)
+    w_head = (rng.normal(size=(6, 3)) * 0.4).astype(np.float32)
+
+    def while_train_v1():
+        x = tf1.placeholder(tf.float32, [None, 6], name="x")
+        wl = tf.constant(w_loop, name="W_loop")
+        wh = tf.constant(w_head, name="W_head")
+        _, h = tf.while_loop(
+            lambda i, a: i < 4,
+            lambda i, a: (i + 1, tf.tanh(tf.matmul(a, wl))),
+            [tf.constant(0, name="i0"), x], name="rec",
+        )
+        tf.matmul(h, wh, name="logits")
+
+    save_tf("while_train_v1", while_train_v1,
+            {"x": rng.normal(size=(5, 6)).astype(np.float32)}, ["logits"])
     tf1.enable_control_flow_v2()
 
     # V2 functional representation (StatelessWhile/StatelessIf +

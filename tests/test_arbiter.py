@@ -116,7 +116,8 @@ class TestRunner:
         assert best.score < bad_loss
         assert 1e-3 <= best.candidate["lr"] <= 1.0
         # persistence: one line per candidate, best model saved+loadable
-        lines = [json.loads(l) for l in open(tmp_path / "results.jsonl")]
+        lines = [json.loads(l) for l in
+                 (tmp_path / "results.jsonl").read_text().splitlines()]
         assert len(lines) == 6
         from deeplearning4j_tpu.train.checkpoint import ModelSerializer
 

@@ -44,13 +44,20 @@ def spawn(mode, worker_id, coord, out="", extra=None):
 
 
 def wait_all(procs, timeout=240):
+    """Waits for every worker and DRAINS its pipes (communicate closes
+    stdout/stderr — leaving them open trips ResourceWarning under the
+    -W error policy).  Captured stderr is stashed on the Popen object
+    for fail_with_logs."""
     rcs = []
     for p in procs:
         try:
-            rcs.append(p.wait(timeout=timeout))
+            out, err = p.communicate(timeout=timeout)
+            p._captured_err = err
+            rcs.append(p.returncode)
         except subprocess.TimeoutExpired:
             for q in procs:
                 q.kill()
+                q.communicate()
             raise
     return rcs
 
@@ -58,7 +65,9 @@ def wait_all(procs, timeout=240):
 def fail_with_logs(procs, rcs, msg):
     logs = []
     for i, p in enumerate(procs):
-        out, err = p.communicate()
+        err = getattr(p, "_captured_err", None)
+        if err is None:
+            err = p.communicate()[1]
         logs.append(f"--- worker {i} rc={rcs[i]}\n{err.decode()[-2000:]}")
     pytest.fail(msg + "\n" + "\n".join(logs))
 
@@ -252,9 +261,14 @@ class TestElasticRestore:
             fail_with_logs(spawned, rcs, "elastic supervisor failed")
         finally:
             srv.stop()
+            for p in spawned:          # drain + close worker pipes
+                if p.poll() is None:
+                    p.kill()
+                p.communicate()
 
         assert sup.generations_run == 2            # gen1 died, gen2 finished
-        lines = [json.loads(l) for l in open(out)]
+        with open(out) as f:
+            lines = [json.loads(l) for l in f]
         finishers = {l["worker"]: l for l in lines}
         assert set(finishers) == {"w0", "w1"}      # survivors only
         for l in finishers.values():
@@ -363,7 +377,8 @@ class TestMultiProcessShardedCheckpoint:
                 fail_with_logs(procs, rcs, "sharded ckpt fleet failed")
             import json
 
-            result = json.load(open(out))
+            with open(out) as f:
+                result = json.load(f)
             assert result["ok"] and len(result["steps"]) == 1
         finally:
             server.stop()

@@ -179,3 +179,18 @@ def test_weight_inits():
     # he-normal std ~ sqrt(2/fan_in)
     w = WeightInit.RELU.init(key, (1000, 100))
     assert abs(np.asarray(w).std() - np.sqrt(2 / 1000)) < 0.005
+
+
+def test_scale_shift_layer_and_serde():
+    """ScaleShift: fixed x*scale+shift (ScaleVertex role as a layer) —
+    the device-side normalizer for the uint8 ETL wire path."""
+    from deeplearning4j_tpu.nn.conf import ScaleShift
+    from deeplearning4j_tpu.utils import serde
+
+    layer = ScaleShift(scale=1 / 255., shift=-0.5, name="s")
+    x = np.arange(12, dtype=np.float32).reshape(3, 4) * 20
+    y, params, _ = run_layer(layer, InputType.feed_forward(4), x)
+    assert params == {}
+    np.testing.assert_allclose(np.asarray(y), x / 255. - 0.5, atol=1e-6)
+    clone = serde.from_jsonable(serde.to_jsonable(layer))
+    assert clone == layer

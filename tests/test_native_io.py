@@ -209,3 +209,82 @@ class TestNativeJpeg:
         out = native.jpeg_batch_decode([bad], 16, 16, 3)
         assert out.shape == (1, 16, 16, 3)
         assert (out == 0).all()
+
+    def test_uint8_wire_format_matches_f32_within_rounding(self, jpeg_dir):
+        """Round 5: the uint8 ETL wire path (4x fewer h2d bytes) must be
+        the clamp-rounded image of the f32 decode — same pixels, 1/4 the
+        bytes."""
+        from deeplearning4j_tpu.runtime import native
+
+        if not native.has_jpeg():
+            pytest.skip("library built without libjpeg")
+        paths = sorted(jpeg_dir.rglob("*.jpg"))
+        f = native.jpeg_batch_decode(paths, 24, 24, 3)
+        u = native.jpeg_batch_decode(paths, 24, 24, 3, dtype=np.uint8)
+        assert u.dtype == np.uint8 and f.dtype == np.float32
+        assert u.nbytes * 4 == f.nbytes
+        assert np.abs(u.astype(np.float32) - f).max() <= 0.5 + 1e-5
+
+    def test_uint8_reader_feeds_training_end_to_end(self, jpeg_dir):
+        """ImageRecordReader(dtype='uint8') -> uint8 DataSet batches ->
+        fit_batch: the cast to compute dtype happens inside the jitted
+        step (models/_cast.entry_cast), so uint8 features train."""
+        from deeplearning4j_tpu.datavec import (
+            ImageRecordReader,
+            RecordReaderDataSetIterator,
+        )
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn import Adam
+        from deeplearning4j_tpu.nn.activations import Activation
+        from deeplearning4j_tpu.nn.conf import (
+            Conv2D,
+            Dense,
+            InputType,
+            NeuralNetConfiguration,
+            OutputLayer,
+        )
+        from deeplearning4j_tpu.nn.losses import Loss
+
+        from deeplearning4j_tpu.nn.conf import ScaleShift
+
+        r = ImageRecordReader(16, 16, 3, shuffle_seed=0, dtype="uint8")
+        r.initialize(jpeg_dir)
+        batch = next(iter(RecordReaderDataSetIterator(
+            r, 8, label_index=1, num_classes=2)))
+        assert batch.features.dtype == np.uint8
+        conf = (NeuralNetConfiguration.builder().seed(0).updater(Adam(2e-3))
+                .activation(Activation.RELU).list()
+                # device-side normalization: the ScaleShift layer replaces
+                # a host-side ImagePreProcessingScaler so the wire keeps
+                # carrying bytes (raw 0..255 into a conv never trains)
+                .layer(ScaleShift(scale=1 / 255.))
+                .layer(Conv2D(n_out=4, kernel=(3, 3)))
+                .layer(Dense(n_out=8))
+                .layer(OutputLayer(n_out=2, loss=Loss.MCXENT,
+                                   activation=Activation.SOFTMAX))
+                .set_input_type(InputType.convolutional(16, 16, 3))
+                .build())
+        m = SequentialModel(conf).init()
+        m.fit_batch(batch)
+        out = np.asarray(m.output(batch.features))
+        assert out.shape == (8, 2)
+        assert np.isfinite(out).all()
+        # uint8 output path == f32 output path (same pixels, same net)
+        out_f = np.asarray(m.output(batch.features.astype(np.float32)))
+        np.testing.assert_allclose(out, out_f, atol=1e-5)
+        # and the pipeline actually LEARNS through the device-side cast
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.iterator import NumpyDataSetIterator
+
+        full = next(iter(RecordReaderDataSetIterator(
+            r, 12, label_index=1, num_classes=2, drop_last=True)))
+        m.fit(NumpyDataSetIterator(full.features, full.labels,
+                                   batch_size=6), epochs=25)
+        acc = m.evaluate(DataSet(full.features, full.labels)).accuracy()
+        assert acc > 0.9, acc
+
+    def test_uint8_reader_rejects_other_dtypes(self):
+        from deeplearning4j_tpu.datavec import ImageRecordReader
+
+        with pytest.raises(ValueError, match="dtype"):
+            ImageRecordReader(8, 8, 3, dtype="int16")

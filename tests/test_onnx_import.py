@@ -554,6 +554,78 @@ class TestOnnxControlFlow:
         np.testing.assert_allclose(
             np.asarray(sd.output({"x": xp}, "y")), want, atol=1e-5)
 
+    def test_loop_static_trip_differentiates(self):
+        """Round 5: a static trip-count input M bounds the Loop by its
+        own semantics, so it lowers to lax.scan — reverse-mode
+        differentiable (fine-tuning through imported Loop bodies)."""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+
+        body = make_graph(
+            [
+                make_node("Mul", ["v", "two"], ["v_out"]),
+                make_node("Identity", ["cond_in"], ["cond_out"]),
+            ],
+            ["iter_num", "cond_in", "v"], ["cond_out", "v_out"],
+            initializers={"two": np.float32(2.0)},
+            name="body",
+        )
+        raw = make_model(
+            [make_node("Loop", ["M", "cond0", "x"], ["y"], body=body)],
+            [("x", (2,))], ["y"],
+            initializers={"M": np.int64(4), "cond0": np.bool_(True)},
+        )
+        sd = import_onnx(raw)
+        (w,) = [n for n in sd._ops if n.op == "_while"]
+        assert w.attrs["max_trip"] == 4
+        xp = np.array([1.0, -2.0], np.float32)
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": xp}, "y")), xp * 16, atol=1e-5)
+
+        def f(xval):
+            (o,) = sd._execute({**sd._values, "x": xval}, ("y",))
+            return jnp.sum(o)
+
+        g = jax.grad(f)(jnp.asarray(xp))
+        np.testing.assert_allclose(np.asarray(g), [16.0, 16.0], rtol=1e-6)
+
+    def test_loop_huge_m_keeps_while_lowering(self):
+        """torch exports cond-only while-loops with M=INT64_MAX; such an
+        M must NOT become a scan length (r5 review finding)."""
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+
+        body = make_graph(
+            [
+                make_node("Mul", ["v", "half"], ["v_out"]),
+                make_node("ReduceSum", ["v_out"], ["s"], keepdims=0),
+                make_node("Greater", ["s", "thresh"], ["cond_out"]),
+            ],
+            ["iter_num", "cond_in", "v"], ["cond_out", "v_out"],
+            initializers={"half": np.float32(0.5),
+                          "thresh": np.float32(0.1)},
+            name="body",
+        )
+        raw = make_model(
+            [make_node("Loop", ["M", "cond0", "x"], ["y"], body=body)],
+            [("x", (2,))], ["y"],
+            initializers={"M": np.int64(2 ** 62),
+                          "cond0": np.bool_(True)},
+        )
+        sd = import_onnx(raw)
+        (w,) = [n for n in sd._ops if n.op == "_while"]
+        assert w.attrs.get("max_trip") is None
+        xp = np.array([4.0, 4.0], np.float32)
+        got = np.asarray(sd.output({"x": xp}, "y"))
+        want = xp.copy()
+        while want.sum() > 0.1:
+            want = want * 0.5
+        np.testing.assert_allclose(got, want, atol=1e-6)
+
     def test_loop_with_outer_capture(self):
         import numpy as np
 

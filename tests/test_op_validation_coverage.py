@@ -1,0 +1,617 @@
+"""Registry-wide op-validation coverage gate (VERDICT r4 missing #2).
+
+The reference's OpValidation "tracks coverage of all registered ops and
+prints an unvalidated-op report" (SURVEY.md §4.1).  This suite drives that
+harness across the ENTIRE ops registry in one CI test:
+
+- every op gets example inputs — generic rules by signature/name family,
+  plus an explicit table for ops with structural requirements (convs,
+  gathers, decompositions, ...);
+- each op is validated through the SameDiff graph path (`sd.apply` →
+  compiled execute), its output compared against the direct registry
+  call, and — for differentiable float ops — finite-difference
+  gradient-checked via OpValidation;
+- tuple-output / special-protocol ops are exercised by direct call
+  ("direct" mode), still on real example inputs;
+- the resulting coverage report is written to OPVALIDATION.md (committed)
+  and a coverage FLOOR is enforced, ratchetable upward.
+
+Run with OPVALIDATION_WRITE=0 to skip refreshing the committed report.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.autodiff.ops_registry import OPS, get_op
+from deeplearning4j_tpu.autodiff.samediff import SameDiff
+from deeplearning4j_tpu.autodiff.validation import OpValidation, TestCase
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+REPORT = os.path.join(HERE, "..", "OPVALIDATION.md")
+
+# coverage floor: validated / total must stay at or above this.  Ratchet
+# UP as the long tail gains examples — never down.  (Round 5 landed at
+# 100%; the floor leaves slack only for environment-dependent flakes.)
+FLOOR = 0.98
+
+RNG = np.random.default_rng(20250731)
+
+
+def _pos(shape, lo=0.3, hi=0.9):
+    """Positive floats away from non-differentiable kinks and ties."""
+    return RNG.uniform(lo, hi, shape).astype(np.float32)
+
+
+def _sym(shape, scale=0.7):
+    x = RNG.uniform(-scale, scale, shape).astype(np.float32)
+    # keep away from 0 (abs/sign/relu kinks) and +-1 (atanh/asin edges)
+    x = np.where(np.abs(x) < 0.15, 0.2 * np.sign(x) + (x == 0) * 0.2, x)
+    return x.astype(np.float32)
+
+
+def _ints(shape, lo=0, hi=4):
+    return RNG.integers(lo, hi, shape).astype(np.int32)
+
+
+class Ex:
+    """One op example: positional args, attrs, and how to validate.
+
+    mode "graph": build a SameDiff graph, compare against the direct
+    call, gradient-check if `grad`.  mode "direct": call the registry fn
+    directly and require finite outputs (tuple-output / special ops)."""
+
+    def __init__(self, *args, attrs=None, grad=True, mode="graph",
+                 skip=None):
+        self.args = list(args)
+        self.attrs = dict(attrs or {})
+        self.grad = grad
+        self.mode = mode
+        self.skip = skip
+
+
+# ---------------------------------------------------------------------------
+# explicit examples for ops whose inputs have structure the generic rules
+# can't guess.  Grouped by family; entries say WHY when non-obvious.
+# ---------------------------------------------------------------------------
+NHWC = _pos((2, 6, 6, 3))
+KHWIO = _sym((3, 3, 3, 4), 0.4)          # kH kW inC outC
+
+OVERRIDES: dict[str, Ex] = {}
+
+
+def _ov(names, ex_fn):
+    for n in names:
+        OVERRIDES[n] = ex_fn(n)
+
+
+EXPLICIT = {
+    # -- linalg / matmul ---------------------------------------------------
+    "matmul": Ex(_sym((4, 3)), _sym((3, 5))),
+    "batch_matmul": Ex(_sym((2, 4, 3)), _sym((2, 3, 5))),
+    "tensordot": Ex(_sym((4, 3)), _sym((3, 5)), attrs={"axes": 1}),
+    "outer": Ex(_sym((4,)), _sym((3,))),
+    "dot": Ex(_sym((4,)), _sym((4,))),
+    "matrix_inverse": Ex(_sym((3, 3)) + 3 * np.eye(3, dtype=np.float32)),
+    "matrix_determinant": Ex(_sym((3, 3)) + 2 * np.eye(3, dtype=np.float32)),
+    "matrix_solve": Ex(_sym((3, 3)) + 3 * np.eye(3, dtype=np.float32),
+                       _sym((3, 2))),
+    "matrix_triangular_solve": Ex(
+        np.tril(_sym((3, 3))) + 2 * np.eye(3, dtype=np.float32),
+        _sym((3, 2))),
+    "matrix_diag": Ex(_sym((4,))),
+    "matrix_diag_part": Ex(_sym((4, 4))),
+    "matrix_set_diag": Ex(_sym((4, 4)), _sym((4,))),
+    "matrix_band_part": Ex(_sym((4, 4)), attrs={"lower": 1, "upper": 1}),
+    "cholesky": Ex(np.eye(3, dtype=np.float32) * 2.0, grad=False),
+    "qr": Ex(_sym((4, 3)), mode="direct"),
+    "svd": Ex(_sym((4, 3)), mode="direct"),
+    "self_adjoint_eig": Ex(np.eye(3, dtype=np.float32) * 2.0,
+                           mode="direct"),
+    "lstsq": Ex(_sym((4, 3)), _sym((4, 2)), grad=False),
+    "lu": Ex(_sym((3, 3)) + 3 * np.eye(3, dtype=np.float32),
+             mode="direct"),
+    "trace": Ex(_sym((4, 4))),
+    "cross": Ex(_sym((2, 3)), _sym((2, 3))),
+    "moments": Ex(_sym((4, 3)), mode="direct"),
+    "log_matrix_determinant": Ex(
+        _sym((3, 3)) + 3 * np.eye(3, dtype=np.float32), mode="direct"),
+    "norm": Ex(_pos((4, 3))),
+    "matrix_power": Ex(_sym((3, 3)), attrs={"n": 2}),
+    "kron": Ex(_sym((2, 2)), _sym((2, 3))),
+    "pinv": Ex(_sym((4, 3)), grad=False),
+    "expm": Ex(_sym((3, 3)) * 0.3, grad=False),
+    "einsum": Ex(_sym((4, 3)), _sym((3, 5)),
+                 attrs={"equation": "ij,jk->ik"}),
+
+    # -- conv / pool family ------------------------------------------------
+    "conv1d": Ex(_pos((2, 8, 3)), _sym((3, 3, 4), 0.4),
+                 attrs={"stride": 1, "padding": "SAME"}),
+    "conv2d": Ex(NHWC, KHWIO, attrs={"stride": (1, 1), "padding": "SAME"}),
+    "conv3d": Ex(_pos((1, 4, 4, 4, 2)), _sym((2, 2, 2, 2, 3), 0.4),
+                 attrs={"stride": (1, 1, 1), "padding": "SAME"}),
+    "deconv2d": Ex(NHWC, _sym((3, 3, 3, 4), 0.4),
+                   attrs={"stride": (1, 1), "padding": "SAME"}),
+    "depthwise_conv2d": Ex(NHWC, _sym((3, 3, 3, 2), 0.4),
+                           attrs={"stride": (1, 1), "padding": "SAME"}),
+    "separable_conv2d": Ex(NHWC, _sym((3, 3, 3, 2), 0.4),
+                           _sym((1, 1, 6, 5), 0.4),
+                           attrs={"stride": (1, 1), "padding": "SAME"}),
+    "max_pool2d": Ex(NHWC, attrs={"kernel": (2, 2), "stride": (2, 2),
+                                  "padding": "VALID"}),
+    "avg_pool2d": Ex(NHWC, attrs={"kernel": (2, 2), "stride": (2, 2),
+                                  "padding": "VALID"}),
+    "max_pool_with_argmax": Ex(NHWC, attrs={"kernel": (2, 2),
+                                            "stride": (2, 2),
+                                            "padding": "VALID"},
+                               mode="direct"),
+    "max_pool1d": Ex(_pos((2, 8, 3)), attrs={"kernel": 2, "stride": 2,
+                                             "padding": "VALID"}),
+    "avg_pool1d": Ex(_pos((2, 8, 3)), attrs={"kernel": 2, "stride": 2,
+                                             "padding": "VALID"}),
+    "max_pool3d": Ex(_pos((1, 4, 4, 4, 2)),
+                     attrs={"kernel": (2, 2, 2), "stride": (2, 2, 2),
+                            "padding": "VALID"}),
+    "avg_pool3d": Ex(_pos((1, 4, 4, 4, 2)),
+                     attrs={"kernel": (2, 2, 2), "stride": (2, 2, 2),
+                            "padding": "VALID"}),
+    "space_to_depth": Ex(NHWC, attrs={"block": 2}),
+    "depth_to_space": Ex(_pos((2, 3, 3, 8)), attrs={"block": 2}),
+    "space_to_batch": Ex(NHWC, attrs={"block": 2,
+                                      "paddings": ((0, 0), (0, 0))}),
+    "batch_to_space": Ex(_pos((8, 3, 3, 3)),
+                         attrs={"block": 2, "crops": ((0, 0), (0, 0))}),
+    "upsampling2d": Ex(NHWC, attrs={"factor": 2}),
+    "resize_bilinear": Ex(NHWC, attrs={"size": (8, 8)}),
+    "resize_nearest": Ex(NHWC, attrs={"size": (8, 8)}, grad=False),
+    "resize_bicubic": Ex(NHWC, attrs={"size": (8, 8)}),
+    "resize_area": Ex(NHWC, attrs={"size": (3, 3)}, grad=False),
+    "local_response_normalization": Ex(NHWC),
+
+    # -- losses (need matched prediction/label pairs) ----------------------
+    "softmax_cross_entropy": Ex(_sym((4, 3)),
+                                np.eye(3, dtype=np.float32)[[0, 1, 2, 0]]),
+    "sparse_softmax_cross_entropy": Ex(_sym((4, 3)), _ints((4,), 0, 3),
+                                       grad=False),
+    "sigmoid_cross_entropy": Ex(_sym((4, 3)), _pos((4, 3))),
+    "weighted_cross_entropy": Ex(_sym((4, 3)), _pos((4, 3)),
+                                 attrs={"pos_weight": 2.0}),
+    "hinge_loss": Ex(_sym((4, 3)),
+                     (2.0 * _ints((4, 3), 0, 2) - 1).astype(np.float32)),
+    "huber_loss": Ex(_sym((4, 3)), _sym((4, 3)), attrs={"delta": 1.0}),
+    "log_loss": Ex(_pos((4, 3), 0.2, 0.8), _pos((4, 3), 0.2, 0.8)),
+    "mean_squared_error": Ex(_sym((4, 3)), _sym((4, 3))),
+    "mean_pairwise_squared_error": Ex(_sym((4, 3)), _sym((4, 3))),
+    "absolute_difference": Ex(_sym((4, 3)), _sym((4, 3))),
+    "cosine_distance": Ex(_sym((4, 3)), _sym((4, 3)), attrs={"axis": -1}),
+    "kl_divergence": Ex(_pos((4, 3), 0.2, 0.8), _pos((4, 3), 0.2, 0.8)),
+    "l2_loss": Ex(_sym((4, 3))),
+    "ctc_loss": Ex(mode="direct", skip="validated in test_ops_breadth "
+                                       "(structured logits/labels setup)"),
+    "ctc_beam_search": Ex(mode="direct",
+                          skip="validated in test_ops_breadth"),
+    "ctc_greedy_decode": Ex(mode="direct",
+                            skip="validated in test_ops_breadth"),
+}
+OVERRIDES.update(EXPLICIT)
+
+_CTC_LOGITS = np.log(
+    RNG.dirichlet(np.ones(5), (2, 6)).astype(np.float32))  # (B, T, C)
+_BOXES_A = np.array([[0, 0, .5, .5], [.2, .2, .8, .8], [.5, .5, 1, 1]],
+                    np.float32)
+_BOXES_B = np.array([[0, 0, .6, .6], [.4, .4, .9, .9]], np.float32)
+_SQ = _sym((3, 3)) + 3 * np.eye(3, dtype=np.float32)
+_SPD = (_SQ @ _SQ.T + np.eye(3, dtype=np.float32)).astype(np.float32)
+
+ROUND2 = {
+    # -- special functions: domain-restricted inputs -----------------------
+    "acosh": Ex(_pos((4, 3)) + 1.2),
+    "erfcinv": Ex(_pos((4, 3), 0.3, 1.6)),        # domain (0, 2)
+    "ndtri": Ex(_pos((4, 3), 0.2, 0.8)),          # domain (0, 1)
+    "float_power": Ex(_pos((4, 3)), _pos((4, 3))),
+    "betainc": Ex(_pos((4, 3), 0.5, 2.0), _pos((4, 3), 0.5, 2.0),
+                  _pos((4, 3), 0.1, 0.9), grad=False),  # jax: no a/b grad
+    "zeta": Ex(_pos((4, 3)) + 1.5, _pos((4, 3)) + 0.5, grad=False),
+    "gcd": Ex(_ints((4, 3), 1, 20), _ints((4, 3), 1, 20)),
+    "lcm": Ex(_ints((4, 3), 1, 9), _ints((4, 3), 1, 9)),
+    "popcount": Ex(_ints((4, 3), 0, 255)),
+    "neg": Ex(_sym((4, 3))),                       # raw jnp.negative ufunc
+    "fmod": Ex(_pos((4, 3), 2.0, 5.0), _pos((4, 3), 0.7, 1.3),
+               grad=False),                        # FD straddles the kink
+    "bitcast": Ex(_sym((4, 3)), attrs={"dtype": np.int32}, grad=False),
+    "stop_gradient": Ex(_sym((4, 3)), grad=False),  # analytic 0 BY DESIGN
+    "fake_quant": Ex(_sym((4, 3)), grad=False),     # STE: analytic != FD
+    "percentile": Ex(_pos((4, 3)), attrs={"q": 50.0}, grad=False),
+    "spearman_corr": Ex(_sym((4, 3)), _sym((4, 3)), grad=False),  # ranks
+    "l1_loss": Ex(_sym((4, 3)), _sym((4, 3)) + 0.5),  # keep |d| off 0
+    "ldexp": Ex(_sym((4, 3)), attrs={"exp": 2}),
+    "lerp": Ex(_sym((4, 3)), _sym((4, 3)), attrs={"weight": 0.3}),
+
+    # -- 1-D-only numerics -------------------------------------------------
+    "convolve_1d": Ex(_sym((8,)), _sym((3,))),
+    "correlate_1d": Ex(_sym((8,)), _sym((3,))),
+    "interp": Ex(_pos((5,)), np.linspace(0, 1, 4).astype(np.float32),
+                 _sym((4,)), grad=False),
+    "digitize": Ex(_pos((6,)), np.linspace(0, 1, 4).astype(np.float32),
+                   grad=False),
+    "searchsorted": Ex(np.linspace(0, 1, 6).astype(np.float32),
+                       _pos((4,)), grad=False),
+    "vander": Ex(_sym((4,)), attrs={"n": 3}),
+    "polyint": Ex(_sym((4,))),
+    "gradient_1d": Ex(_sym((8,)), mode="direct"),
+    "meshgrid_x": Ex(_sym((4,)), _sym((3,)), grad=False),
+    "meshgrid_y": Ex(_sym((4,)), _sym((3,)), grad=False),
+    "ema": Ex(_sym((8,)), attrs={"alpha": 0.3}),
+    "sma": Ex(_sym((8,)), attrs={"window": 3}),
+    "compress": Ex(np.array([1, 0, 1, 1], bool), _sym((4, 3)),
+                   attrs={"size": 3}, grad=False),
+
+    # -- square-matrix linalg ----------------------------------------------
+    "det": Ex(_SQ), "inv": Ex(_SQ), "logdet": Ex(_SPD),
+    "slogdet_sign": Ex(_SQ, grad=False),
+    "matrix_exp": Ex(_sym((3, 3)) * 0.3, grad=False),
+    "solve": Ex(_SQ, _sym((3, 2))),
+    "triangular_solve": Ex(
+        np.tril(_sym((3, 3))) + 2 * np.eye(3, dtype=np.float32),
+        _sym((3, 2))),
+    "cholesky_inverse": Ex(np.linalg.cholesky(_SPD).astype(np.float32),
+                           grad=False),
+    "eigh_values": Ex(_SPD, grad=False),
+    "eigh_vectors": Ex(_SPD, grad=False),
+    "multi_dot": Ex(_sym((4, 3)), _sym((3, 5)), grad=False),
+    "matmul_transpose": Ex(_sym((4, 3)), _sym((3, 5))),
+
+    # -- NN compounds ------------------------------------------------------
+    "lstm_cell": Ex(_sym((2, 3)), _sym((2, 4)), _sym((2, 4)),
+                    _sym((3, 16), 0.4), _sym((4, 16), 0.4), _sym((16,))),
+    "gru_cell": Ex(_sym((2, 3)), _sym((2, 4)),
+                   _sym((3, 12), 0.4), _sym((4, 12), 0.4), _sym((12,))),
+    "relu_layer": Ex(_sym((4, 3)), _sym((3, 5)), _sym((5,))),
+    "xw_plus_b": Ex(_sym((4, 3)), _sym((3, 5)), _sym((5,))),
+    "glu": Ex(_sym((4, 6))),
+    "group_norm": Ex(_sym((2, 6)), _pos((6,)), _sym((6,)),
+                     attrs={"groups": 2}),
+    "batch_norm": Ex(_pos((4, 3)), _pos((3,)), _pos((3,)), _sym((3,)),
+                     _pos((3,)), attrs={"epsilon": 1e-3}),
+    "multi_head_attention": Ex(
+        _sym((2, 5, 8), 0.4), _sym((8, 8), 0.4), _sym((8, 8), 0.4),
+        _sym((8, 8), 0.4), _sym((8, 8), 0.4), attrs={"heads": 2}),
+    "multi_head_dot_product_attention": Ex(
+        _sym((2, 5, 2, 3), 0.4), _sym((2, 5, 2, 3), 0.4),
+        _sym((2, 5, 2, 3), 0.4)),
+    "mixture_density_loss": Ex(_sym((4, 10), 0.4), _sym((4, 2)),
+                               attrs={"components": 2}),
+
+    # -- losses needing int labels or matched shapes -----------------------
+    "cross_entropy_loss": Ex(_sym((4, 3)), _ints((4,), 0, 3), grad=False),
+    "nll_loss": Ex(np.log(RNG.dirichlet(np.ones(3), 4).astype(np.float32)),
+                   _ints((4,), 0, 3), grad=False),
+    "in_top_k": Ex(_sym((4, 5)), _ints((4,), 0, 5), attrs={"k": 2},
+                   grad=False),
+    "cosine_embedding_loss": Ex(_sym((4, 3)), _sym((4, 3)),
+                                np.ones(4, np.float32), grad=False),
+    "confusion_matrix": Ex(_ints((6,), 0, 4), _ints((6,), 0, 4),
+                           attrs={"num_classes": 4}, grad=False),
+    "weighted_cross_entropy_with_logits": Ex(
+        _sym((4, 3)), _pos((4, 3)), attrs={"pos_weight": 2.0}),
+    "sequence_mask": Ex(_ints((4,), 1, 6), attrs={"maxlen": 6},
+                        grad=False),
+
+    # -- segment / scatter / gather family ---------------------------------
+    **{n: Ex(_sym((6,)), np.array([0, 0, 1, 2, 2, 3], np.int32),
+             attrs={"num_segments": 4}, grad=False)
+       for n in ("segment_sum", "segment_mean", "segment_max",
+                 "segment_min", "segment_prod")},
+    **{n: Ex(_sym((6,)), np.array([2, 0, 1, 0, 3, 1], np.int32),
+             attrs={"num_segments": 4}, grad=False)
+       for n in ("unsorted_segment_sum", "unsorted_segment_mean",
+                 "unsorted_segment_max", "unsorted_segment_min",
+                 "unsorted_segment_prod")},
+    **{n: Ex(_sym((5, 3)), np.array([1, 3], np.int32), _sym((2, 3)),
+             grad=False)
+       for n in ("scatter_add", "scatter_sub", "scatter_mul",
+                 "scatter_max", "scatter_min", "scatter_update")},
+    "scatter_nd": Ex(np.array([[0], [2], [4]], np.int32), _sym((3, 4)),
+                     attrs={"shape": (5, 4)}, grad=False),
+    "tensor_scatter_add": Ex(_sym((5, 3)), np.array([[0], [2]], np.int32),
+                             _sym((2, 3)), grad=False),
+    "tensor_scatter_update": Ex(_sym((5, 3)),
+                                np.array([[0], [2]], np.int32),
+                                _sym((2, 3)), grad=False),
+    "gather_nd": Ex(_sym((4, 3)),
+                    np.array([[0, 1], [3, 2], [2, 0]], np.int32),
+                    grad=False),
+
+    # -- shape / indexing --------------------------------------------------
+    "squeeze": Ex(_sym((4, 1, 3)), attrs={"axis": (1,)}),
+    "tile": Ex(_sym((4, 3)), attrs={"reps": (2, 1)}),
+    "repeat": Ex(_sym((4, 3)), attrs={"repeats": 2, "axis": 0}),
+    "moveaxis": Ex(_sym((4, 3)), attrs={"source": 0, "destination": 1}),
+    "swapaxes": Ex(_sym((4, 3)), attrs={"axis1": 0, "axis2": 1}),
+    "strided_slice": Ex(_sym((4, 6)),
+                        attrs={"begin": (0, 1), "end": (3, 5),
+                               "strides": (1, 2)}),
+    "slice_axis": Ex(_sym((4, 6)), attrs={"begin": 1, "size": 3,
+                                          "axis": 1}),
+    "onnx_slice": Ex(_sym((4, 6)), attrs={"starts": (1,), "ends": (3,),
+                                          "axes": (0,)}),
+    "split_part": Ex(_sym((6, 3)), attrs={"index": 1, "num": 3,
+                                          "axis": 0}),
+    "unique_with_pad": Ex(np.array([3, 1, 3, 2, 1, 0], np.int32),
+                          attrs={"size": 8}, mode="direct"),
+    "linspace": Ex(attrs={"start": 0.0, "stop": 1.0, "num": 5},
+                   grad=False),
+    "range": Ex(attrs={"start": 0, "limit": 5, "delta": 1}, grad=False),
+    "where": Ex(_ints((4, 3), 0, 2).astype(bool), _sym((4, 3)),
+                _sym((4, 3)), grad=False),
+
+    # -- image family (rank-4 NHWC) ----------------------------------------
+    "adjust_contrast": Ex(_pos((2, 5, 5, 3)), attrs={"factor": 1.5}),
+    "flip_lr": Ex(_pos((2, 5, 5, 3))),
+    "flip_ud": Ex(_pos((2, 5, 5, 3))),
+    "flip_up_down": Ex(_pos((2, 5, 5, 3))),
+    "rot90": Ex(_pos((2, 5, 5, 3)), attrs={"k": 1}),
+    "grayscale_to_rgb": Ex(_pos((2, 5, 5, 1))),
+    "central_crop": Ex(_pos((2, 6, 6, 3)), attrs={"fraction": 0.5}),
+    "crop": Ex(_pos((2, 6, 6, 3)), attrs={"offset": (1, 1),
+                                          "size": (4, 4)}),
+    "crop_and_resize": Ex(_pos((2, 6, 6, 3)),
+                          np.array([[0, 0, 1, 1], [.2, .2, .8, .8]],
+                                   np.float32),
+                          np.array([0, 1], np.int32),
+                          attrs={"crop_size": (3, 3)}, grad=False),
+    "resize": Ex(_pos((2, 5, 5, 3)), attrs={"size": (8, 8)}),
+    "sobel_edges": Ex(_pos((2, 6, 6, 3)), mode="direct"),
+    "image_gradients": Ex(_pos((2, 6, 6, 3)), mode="direct"),
+    "psnr": Ex(_pos((2, 5, 5, 3), 0, 1), _pos((2, 5, 5, 3), 0, 1)),
+    "ssim": Ex(_pos((2, 12, 12, 3), 0, 1), _pos((2, 12, 12, 3), 0, 1),
+               grad=False),
+    "iou": Ex(_BOXES_A, _BOXES_B, grad=False),
+    "non_max_suppression": Ex(_BOXES_A, _pos((3,)),
+                              attrs={"max_output_size": 2}, grad=False),
+    "max_pool_with_argmax_indices": Ex(_pos((2, 6, 6, 3)), grad=False),
+    "image_resize_with_pad": Ex(_pos((2, 5, 5, 3)),
+                                attrs={"size": (8, 8)}),
+
+    # -- conv helpers with exact kwargs ------------------------------------
+    "im2col": Ex(NHWC, attrs={"kernel": (2, 2), "stride": (1, 1)}),
+    "col2im": Ex(_pos((2, 25, 12)),
+                 attrs={"input_shape": (2, 6, 6, 3), "kernel": (2, 2),
+                        "stride": (1, 1)}),
+    "extract_image_patches": Ex(NHWC, attrs={"kernel": (2, 2),
+                                             "stride": (1, 1)}),
+    "dilation2d": Ex(NHWC, _sym((2, 2, 3), 0.3),
+                     attrs={"stride": (1, 1), "padding": "SAME"}),
+    "erosion2d": Ex(NHWC, _sym((2, 2, 3), 0.3),
+                    attrs={"stride": (1, 1), "padding": "SAME"}),
+    "upsampling2d": Ex(NHWC, attrs={"factor": (2, 2)}),
+
+    # -- audio / misc ------------------------------------------------------
+    "mel_filterbank": Ex(attrs={"n_mels": 4, "n_fft_bins": 16,
+                                "sample_rate": 16000}, grad=False),
+    "random_categorical": Ex(_sym((4, 3)), attrs={"num_samples": 2},
+                             grad=False),
+    "ctc_beam_decode": Ex(_CTC_LOGITS, mode="direct"),
+    "ctc_beam_decode_lengths": Ex(_CTC_LOGITS, mode="direct"),
+    "ctc_beam_decode_log_probs": Ex(_CTC_LOGITS, mode="direct"),
+    "ctc_greedy_decode_lengths": Ex(_CTC_LOGITS, mode="direct"),
+
+    # -- finite-difference kink cases: forward-validated only (the FD
+    # probe lands on a non-differentiable point by construction) ----------
+    "col2im": Ex(_pos((2, 5, 5, 12)),
+                 attrs={"input_shape": (2, 6, 6, 3), "kernel": (2, 2),
+                        "stride": (1, 1)}),
+    "cummin": Ex(_sym((4, 3)), grad=False),     # running-min ties
+    "nanmax": Ex(_sym((4, 3)), grad=False),     # argmax ties under eps
+    "mod": Ex(_pos((4, 3), 2.0, 5.0), _pos((4, 3), 0.7, 1.3),
+              grad=False),                       # kink at integer ratios
+    "power_to_db": Ex(_pos((4, 3)), grad=False),  # ref=max clamp kink
+    "total_variation": Ex(_pos((2, 5, 5, 3)), grad=False),  # |.| kinks
+    "erosion2d": Ex(NHWC, _sym((2, 2, 3), 0.3),
+                    attrs={"stride": (1, 1), "padding": "SAME"},
+                    grad=False),                  # min-selection ties
+    "kth_value": Ex(_sym((4, 3)), attrs={"k": 1},
+                    grad=False),                  # rank-selection ties
+    "manhattan_distance": Ex(_sym((4, 3)), _sym((4, 3)),
+                             grad=False),         # |.| kinks
+    "normalize_moments": Ex(_pos((1,)) + 4.0, _sym((3,)), _pos((3,)) + 1.0,
+                            grad=False),          # FD precision on 1/count
+}
+OVERRIDES.update(ROUND2)
+
+
+def _generic_example(name, fn):
+    """Build an example from the signature + name-family heuristics.
+    Returns Ex or None when no rule applies."""
+    try:
+        sig = inspect.signature(fn)
+    except (TypeError, ValueError):
+        return None
+    params = list(sig.parameters.values())
+    n_pos = len([p for p in params
+                 if p.kind in (p.POSITIONAL_ONLY, p.POSITIONAL_OR_KEYWORD)
+                 and p.default is p.empty])
+    req_kw = [p.name for p in params
+              if p.kind == p.KEYWORD_ONLY and p.default is p.empty]
+    has_var = any(p.kind == p.VAR_POSITIONAL for p in params)
+
+    # name-family input rules
+    intish = any(t in name for t in (
+        "bitwise", "shift", "bincount", "invert_permutation", "bucket"))
+    logical = name.startswith(("logical", "is_", "in_top_k")) or name in (
+        "where", "select")
+    positive = any(t in name for t in (
+        "log", "sqrt", "rsqrt", "rgb", "hsv", "yiq", "yuv", "adjust",
+        "digamma", "lgamma", "igamma", "polygamma", "zeta", "entr")) or \
+        name in ("pow", "xdivy", "xlogy", "xlog1py")
+    # order-statistics / selection ops: the FD probe lands on a
+    # min/max/rank tie for SOME random draw eventually — forward-validate
+    # only, deterministically, instead of per-draw whack-a-mole
+    toks = set(name.split("_"))
+    kinky = toks & {
+        "min", "max", "amin", "amax", "nanmin", "nanmax", "median",
+        "quantile", "percentile", "iqr", "mad", "kth", "sort", "argsort",
+        "mode", "ptp", "cummin", "cummax", "maximum", "minimum", "top",
+        "extremum", "trimmed",
+    }
+    grad = not (intish or logical or kinky or name.startswith((
+        "argmax", "argmin", "round", "rint", "floor", "ceil", "sign",
+        "equal", "not_equal", "greater", "less", "one_hot", "shape",
+        "size", "rank", "top_k", "unique", "searchsorted", "nextafter",
+        "random", "bernoulli", "dropout")))
+
+    def arr(i):
+        if intish:
+            return _ints((4, 3), 0, 8)
+        if logical:
+            return _ints((4, 3), 0, 2).astype(bool)
+        if positive:
+            return _pos((4, 3))
+        return _sym((4, 3))
+
+    kw_fill = {
+        "shape": (4, 3), "axis": -1, "size": (4, 3), "num_segments": 4,
+        "k": 2, "n": 2, "block": 2, "length": 4, "dtype": np.float32,
+        "kernel": (2, 2), "delta": 1.0, "factor": 0.5, "bits": 8,
+        "q": 50.0, "clip_norm": 1.0, "lo": 0.0, "hi": 1.0, "nbins": 4,
+        "kth": 1, "begin": (0, 0), "paddings": ((1, 1), (1, 1)),
+        "shift": 1, "value": 0.5, "frame_length": 4, "frame_step": 2,
+        "equation": "ij->ji", "num_lower": 1, "num_upper": 1,
+        "max_output_size": 4, "seed": 0, "rate": 0.5, "perm": (1, 0),
+        "multiples": (2, 1), "depth": 4, "num": 3, "rep": 2,
+    }
+    if any(k not in kw_fill for k in req_kw):
+        return None
+    attrs = {k: kw_fill[k] for k in req_kw}
+    if has_var and n_pos == 0:
+        return Ex(_sym((4, 3)), _sym((4, 3)), attrs=attrs, grad=grad)
+    if n_pos == 0 and not req_kw:
+        return None
+    return Ex(*[arr(i) for i in range(n_pos)], attrs=attrs, grad=grad)
+
+
+def _example_for(name):
+    if name in OVERRIDES:
+        return OVERRIDES[name]
+    return _generic_example(name, OPS[name])
+
+
+def _validate_graph(name, ex):
+    """Graph-path validation: sd.apply must reproduce the direct call;
+    float ops additionally gradient-check (finite diff vs jax.grad)."""
+    fn = get_op(name)
+    want = fn(*ex.args, **ex.attrs)
+    if isinstance(want, (tuple, list)):
+        raise TypeError("tuple output — use direct mode")
+    sd = SameDiff()
+    vars_ = []
+    all_float = True
+    for i, a in enumerate(ex.args):
+        a = np.asarray(a)
+        if np.issubdtype(a.dtype, np.floating):
+            vars_.append(sd.var(f"x{i}", a))
+        else:
+            vars_.append(sd.constant(f"x{i}", a))
+            all_float = False
+    out = sd.apply(name, *vars_, **ex.attrs, name="out")
+    want = np.asarray(want)
+    do_grad = (ex.grad and all_float and len(ex.args) > 0
+               and np.issubdtype(want.dtype, np.floating))
+    if do_grad:
+        sd.set_loss(sd.apply("sum", out * out, name="loss"))
+    tc = TestCase(sd=sd, expected={"out": want},
+                  gradient_check=do_grad,
+                  forward_rtol=2e-4, forward_atol=2e-5,
+                  rtol=8e-2, atol=5e-3, max_checks_per_array=4)
+    return OpValidation.validate(tc)
+
+
+def _validate_direct(name, ex):
+    fn = get_op(name)
+    out = fn(*ex.args, **ex.attrs)
+    leaves = out if isinstance(out, (tuple, list)) else (out,)
+    for leaf in leaves:
+        leaf = np.asarray(leaf)
+        if np.issubdtype(leaf.dtype, np.floating):
+            if not np.all(np.isfinite(leaf)):
+                return [f"{name}: non-finite output"]
+    OpValidation._validated_ops.add(name)
+    return []
+
+
+@pytest.mark.slow
+def test_registry_coverage_floor():
+    """Drive OpValidation across every registered op; enforce the floor
+    and refresh the committed OPVALIDATION.md report."""
+    results = {}        # name -> ("ok"|"ok-direct"|"skip"|"fail", detail)
+    for name in sorted(OPS):
+        ex = _example_for(name)
+        if ex is None:
+            results[name] = ("fail", "no example inputs")
+            continue
+        if ex.skip is not None:
+            # skip entries must point at the suite that DOES validate it
+            OpValidation._validated_ops.add(name)
+            results[name] = ("skip", ex.skip)
+            continue
+        try:
+            if ex.mode == "direct":
+                errs = _validate_direct(name, ex)
+            else:
+                errs = _validate_graph(name, ex)
+        except Exception as exc:  # noqa: BLE001 — report, don't abort
+            errs = [f"{type(exc).__name__}: {exc}"]
+        if errs:
+            results[name] = ("fail", "; ".join(str(e) for e in errs)[:200])
+        else:
+            results[name] = (
+                "ok-direct" if ex.mode == "direct" else "ok", "")
+
+    n = len(results)
+    failed = {k: v for k, (s, v) in results.items() if s == "fail"}
+    validated = n - len(failed)
+    coverage = validated / n
+
+    if os.environ.get("OPVALIDATION_WRITE", "1") not in ("", "0"):
+        lines = [
+            "# Op-validation coverage report",
+            "",
+            "Generated by tests/test_op_validation_coverage.py "
+            "(SURVEY.md §4.1 unvalidated-op report).",
+            "",
+            f"- registry ops: **{n}**",
+            f"- validated: **{validated}** "
+            f"({100 * coverage:.1f}%, floor {100 * FLOOR:.0f}%)",
+            f"- graph-path (forward vs direct call"
+            f"{''} + grad-check where differentiable): "
+            f"{sum(1 for s, _ in results.values() if s == 'ok')}",
+            f"- direct-call (tuple-output/special): "
+            f"{sum(1 for s, _ in results.values() if s == 'ok-direct')}",
+            f"- covered by dedicated suites: "
+            f"{sum(1 for s, _ in results.values() if s == 'skip')}",
+            "",
+        ]
+        if failed:
+            lines.append("## Unvalidated ops")
+            lines.append("")
+            for k in sorted(failed):
+                lines.append(f"- `{k}` — {failed[k]}")
+            lines.append("")
+        new = "\n".join(lines)
+        try:
+            with open(REPORT) as f:
+                old = f.read()
+        except OSError:
+            old = ""
+        if new != old:
+            with open(REPORT, "w") as f:
+                f.write(new)
+
+    assert coverage >= FLOOR, (
+        f"op-validation coverage {100 * coverage:.1f}% fell below the "
+        f"{100 * FLOOR:.0f}% floor; unvalidated: {sorted(failed)[:20]}..."
+    )

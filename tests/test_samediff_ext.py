@@ -136,6 +136,65 @@ class TestControlFlow:
         assert float(out_acc) == 0 + 1 + 2 + 3 + 4
         del jnp
 
+    def test_while_loop_bounded_scan_matches_and_differentiates(self):
+        """max_trip lowers the loop to lax.scan: identical results to the
+        unbounded while_loop, but reverse-mode differentiable."""
+        import jax
+        import jax.numpy as jnp
+
+        def build(**kw):
+            sd = SameDiff()
+            x = sd.placeholder("x")
+            i0 = sd.constant("i0", np.array(0, np.int32))
+            _, acc = sd.while_loop(
+                lambda i, a: i < 6,
+                lambda i, a: (i + 1, a * 1.5),
+                i0, x, name="loop", **kw,
+            )
+            return sd, acc
+
+        xv = np.array([2.0, -1.0], np.float32)
+        ref_sd, ref_acc = build()
+        want = np.asarray(ref_sd.output({"x": xv}, ref_acc.name))
+        for kw in ({"max_trip": 6, "exact_trip": True},
+                   {"max_trip": 10}):        # masked: 4 dead iterations
+            sd, acc = build(**kw)
+            got = np.asarray(sd.output({"x": xv}, acc.name))
+            np.testing.assert_allclose(got, want, rtol=1e-6)
+
+            def f(xval, _sd=sd, _a=acc.name):
+                (o,) = _sd._execute({**_sd._values, "x": xval}, (_a,))
+                return jnp.sum(o)
+
+            g = jax.grad(f)(jnp.asarray(xv))
+            np.testing.assert_allclose(np.asarray(g), [1.5 ** 6] * 2,
+                                       rtol=1e-5)
+
+    def test_masked_scan_gradient_survives_nan_body_past_termination(self):
+        """Double-where guard: a body that goes NaN outside the
+        predicate's domain (sqrt of a negative once the loop should have
+        stopped) must not poison the gradient of the bounded lowering."""
+        import jax
+        import jax.numpy as jnp
+
+        sd = SameDiff()
+        x0 = sd.placeholder("x0")
+        (xf,) = sd.while_loop(
+            lambda x: x > 0.6,
+            lambda x: (jnp.sqrt(x - 0.5),),
+            x0, name="loop", max_trip=8,
+        )
+
+        def f(xv):
+            (o,) = sd._execute({**sd._values, "x0": xv}, (xf.name,))
+            return o
+
+        v = jnp.float32(1.6)
+        out = f(v)          # 1.6 -> 1.0488 -> 0.7408 -> 0.4908 (stop)
+        assert 0.4 < float(out) < 0.6
+        g = jax.grad(f)(v)
+        assert np.isfinite(float(g)), g
+
     def test_control_flow_not_serializable(self, tmp_path):
         sd = SameDiff()
         x = sd.placeholder("x")

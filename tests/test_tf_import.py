@@ -884,3 +884,237 @@ class TestNestedFrames:
             want = golden(g, {"x:0": xv}, "out:0")
             np.testing.assert_allclose(
                 np.asarray(sd.output({"x": xv}, "out")), want, atol=1e-5)
+
+
+class TestDifferentiableImportedLoops:
+    """Round 5 (VERDICT r4 missing #1): statically-counted imported loops
+    lower to lax.scan and support reverse-mode autodiff; dynamic loops
+    keep the while_loop fallback unless loop_trip_bound is given."""
+
+    def _v1_graph(self, build):
+        tf1.disable_control_flow_v2()
+        try:
+            g = tf1.Graph()
+            with g.as_default():
+                build()
+        finally:
+            tf1.enable_control_flow_v2()
+        return g
+
+    @staticmethod
+    def _while_attrs(sd):
+        return [n.attrs for n in sd._ops if n.op == "_while"]
+
+    def test_v1_static_counter_lowered_to_exact_scan(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [3], name="x")
+            tf1.while_loop(lambda i, a: i < 7,
+                           lambda i, a: (i + 1, a * 2.0),
+                           [tf.constant(0), x], name="loop")
+            tf.identity(tf1.get_default_graph()
+                        .get_tensor_by_name("loop/Exit_1:0"), name="out")
+
+        g = self._v1_graph(build)
+        xv = np.array([1.0, -1.0, 0.5], np.float32)
+        want = golden(g, {"x:0": xv}, "out:0")
+        sd = import_graph(g.as_graph_def())
+        (w,) = self._while_attrs(sd)
+        assert w["max_trip"] == 7 and w["exact_trip"] is True
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": xv}, "out")), want, atol=1e-5)
+
+    def test_v1_countdown_and_step2_counters(self):
+        """Non-unit stride and descending counters infer exactly too."""
+        def build():
+            x = tf1.placeholder(tf.float32, [2], name="x")
+            tf1.while_loop(lambda i, a: i > 0,
+                           lambda i, a: (i - 2, a + 1.0),
+                           [tf.constant(9), x], name="loop")
+            tf.identity(tf1.get_default_graph()
+                        .get_tensor_by_name("loop/Exit_1:0"), name="out")
+
+        g = self._v1_graph(build)
+        sd = import_graph(g.as_graph_def())
+        (w,) = self._while_attrs(sd)
+        assert w["max_trip"] == 5 and w["exact_trip"] is True  # 9,7,5,3,1
+        want = golden(g, {"x:0": np.zeros(2, np.float32)}, "out:0")
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": np.zeros(2, np.float32)}, "out")),
+            want, atol=1e-5)
+
+    def test_v1_data_dependent_pred_falls_back_to_while(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [], name="x")
+            tf1.while_loop(lambda a: a < 100.0, lambda a: a * 2.0,
+                           [x], name="loop")
+            tf.identity(tf1.get_default_graph()
+                        .get_tensor_by_name("loop/Exit:0"), name="out")
+
+        g = self._v1_graph(build)
+        sd = import_graph(g.as_graph_def())
+        (w,) = self._while_attrs(sd)
+        assert w["max_trip"] is None and w["exact_trip"] is False
+        want = golden(g, {"x:0": np.float32(3.0)}, "out:0")
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": np.float32(3.0)}, "out")), want)
+
+    def test_v1_dynamic_loop_with_trip_bound_differentiates(self):
+        """loop_trip_bound lowers a data-dependent loop to scan+mask:
+        same forward values, and gradients flow."""
+        import jax
+        import jax.numpy as jnp
+
+        def build():
+            x = tf1.placeholder(tf.float32, [], name="x")
+            tf1.while_loop(lambda a: a < 100.0, lambda a: a * 2.0,
+                           [x], name="loop")
+            tf.identity(tf1.get_default_graph()
+                        .get_tensor_by_name("loop/Exit:0"), name="out")
+
+        g = self._v1_graph(build)
+        sd = import_graph(g.as_graph_def(), loop_trip_bound=16)
+        (w,) = self._while_attrs(sd)
+        assert w["max_trip"] == 16 and w["exact_trip"] is False
+        for xv in (3.0, 0.5, 150.0):
+            want = golden(g, {"x:0": np.float32(xv)}, "out:0")
+            np.testing.assert_allclose(
+                np.asarray(sd.output({"x": np.float32(xv)}, "out")), want)
+
+        def f(xv):
+            (o,) = sd._execute({**sd._values, "x": xv}, ("out",))
+            return o
+
+        # d(out)/dx = 2^trips; for x=3: 3->6->12->24->48->96->192, 6 trips
+        assert float(jax.grad(f)(jnp.float32(3.0))) == 64.0
+
+    def test_trip_bound_reaches_nested_function_loops(self):
+        """loop_trip_bound must propagate into FunctionDef sub-importers
+        (r5 review finding: it was reset to None, leaving inner dynamic
+        loops forward-only)."""
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+
+        @tf.function
+        def inner(x):
+            return tf.while_loop(lambda a: tf.reduce_sum(a) < 10.0,
+                                 lambda a: a * 2.0, [x])[0]
+
+        @tf.function
+        def fn(x):
+            return inner(x) + 1.0
+
+        cfn = fn.get_concrete_function(tf.TensorSpec([2], tf.float32))
+        frozen = convert_variables_to_constants_v2(
+            cfn, lower_control_flow=False)
+        sd = import_graph(frozen.graph.as_graph_def(), loop_trip_bound=12)
+        xv = np.array([0.5, 0.7], np.float32)
+        want = fn(tf.constant(xv)).numpy()
+        ph = [k for k in sd._placeholders][0]
+        np.testing.assert_allclose(
+            np.asarray(sd.output({ph: xv}, "Identity")), want, rtol=1e-6)
+        # the nested loop's while node lives in a sub-SameDiff; assert on
+        # behavior instead: gradients flow because it scanned
+        import jax
+        import jax.numpy as jnp
+
+        def f(v):
+            (o,) = sd._execute({**sd._values, ph: v}, ("Identity",))
+            return jnp.sum(o)
+
+        g = jax.grad(f)(jnp.asarray(xv))
+        assert np.all(np.isfinite(np.asarray(g)))
+        assert float(jnp.abs(g).max()) > 0
+
+    def test_v2_functional_while_static_trip(self):
+        from tensorflow.python.framework.convert_to_constants import (
+            convert_variables_to_constants_v2,
+        )
+
+        @tf.function
+        def fn(x):
+            i = tf.constant(0)
+            _, acc = tf.while_loop(lambda i, a: i < 5,
+                                   lambda i, a: (i + 1, tf.tanh(a) + a),
+                                   [i, x])
+            return acc
+
+        cfn = fn.get_concrete_function(tf.TensorSpec([4], tf.float32))
+        frozen = convert_variables_to_constants_v2(
+            cfn, lower_control_flow=False)
+        sd = import_graph(frozen.graph.as_graph_def())
+        (w,) = self._while_attrs(sd)
+        assert w["max_trip"] == 5 and w["exact_trip"] is True
+        xv = np.array([0.1, -0.2, 0.3, 0.4], np.float32)
+        want = fn(tf.constant(xv)).numpy()
+        ph = [k for k in sd._placeholders][0]
+        np.testing.assert_allclose(
+            np.asarray(sd.output({ph: xv}, sd.onnx_outputs[0]
+                                 if hasattr(sd, "onnx_outputs") else
+                                 "Identity")),
+            want, rtol=1e-5, atol=1e-5)
+
+    def test_trainable_loop_capture_promotes_and_trains(self):
+        """A float weight matrix captured by the loop body promotes to a
+        trainable variable (not a baked static), and its gradient through
+        the scanned loop matches finite differences."""
+        import jax
+        import jax.numpy as jnp
+
+        rng = np.random.default_rng(3)
+        wv = (rng.normal(size=(3, 3)) * 0.5).astype(np.float32)
+
+        def build():
+            x = tf1.placeholder(tf.float32, [2, 3], name="x")
+            wl = tf.constant(wv, name="W")
+            tf1.while_loop(lambda i, a: i < 4,
+                           lambda i, a: (i + 1, tf.tanh(tf.matmul(a, wl))),
+                           [tf.constant(0), x], name="loop")
+            tf.identity(tf1.get_default_graph()
+                        .get_tensor_by_name("loop/Exit_1:0"), name="out")
+
+        g = self._v1_graph(build)
+        sd = import_graph(g.as_graph_def(), trainable=True)
+        assert "W" in sd._trainable
+        (w,) = self._while_attrs(sd)
+        assert w["max_trip"] == 4 and w["exact_trip"] is True
+
+        xv = rng.normal(size=(2, 3)).astype(np.float32)
+        want = golden(g, {"x:0": xv}, "out:0")
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": xv}, "out")), want, atol=1e-5)
+
+        def loss(wval):
+            (o,) = sd._execute(
+                {**sd._values, "W": wval, "x": jnp.asarray(xv)}, ("out",))
+            return jnp.sum(o ** 2)
+
+        gw = jax.grad(loss)(jnp.asarray(wv))
+        eps = 1e-3
+        e = np.zeros_like(wv)
+        e[1, 2] = eps
+        fd = (loss(jnp.asarray(wv + e)) - loss(jnp.asarray(wv - e))) / (2 * eps)
+        np.testing.assert_allclose(float(gw[1, 2]), float(fd), atol=1e-2)
+
+    def test_nested_static_loops_both_scan(self):
+        def build():
+            x = tf1.placeholder(tf.float32, [2], name="x")
+
+            def outer_body(i, a):
+                _, a2 = tf1.while_loop(lambda j, b: j < 3,
+                                       lambda j, b: (j + 1, b + 1.0),
+                                       [tf.constant(0), a], name="inner")
+                return i + 1, a2 * 1.5
+
+            _, acc = tf1.while_loop(lambda i, a: i < 2, outer_body,
+                                    [tf.constant(0), x], name="outer")
+            tf.identity(acc, name="out")
+
+        g = self._v1_graph(build)
+        sd = import_graph(g.as_graph_def())
+        (w,) = self._while_attrs(sd)       # outer frame: top-level node
+        assert w["max_trip"] == 2 and w["exact_trip"] is True
+        want = golden(g, {"x:0": np.ones(2, np.float32)}, "out:0")
+        np.testing.assert_allclose(
+            np.asarray(sd.output({"x": np.ones(2, np.float32)}, "out")),
+            want, atol=1e-5)

@@ -4,6 +4,7 @@ profiler trace capture, and the OOM crash report."""
 
 import json
 import os
+import pathlib
 import urllib.request
 
 import numpy as np
@@ -79,7 +80,8 @@ class TestStatsListener:
         recs = storage.get_records("file_sess")
         assert len(recs) == 3
         # raw file is valid jsonl
-        lines = [json.loads(l) for l in open(path)]
+        lines = [json.loads(l)
+                 for l in pathlib.Path(path).read_text().splitlines()]
         assert len(lines) == 3
 
     def test_frequency_thins_records(self):
@@ -203,7 +205,7 @@ class TestCrashReport:
         m = small_model()
         m.fit_batch(batch())
         path = write_memory_report(str(tmp_path / "report.txt"), header="TEST")
-        text = open(path).read()
+        text = pathlib.Path(path).read_text()
         assert "device memory report" in text
         assert "live jax.Array buffers" in text
         assert "TEST" in text
@@ -216,7 +218,7 @@ class TestCrashReport:
         err = RuntimeError("RESOURCE_EXHAUSTED: Out of memory allocating 1TB")
         path = crash.maybe_write_oom_report(err)
         assert path and os.path.exists(path)
-        assert "RESOURCE_EXHAUSTED" in open(path).read()
+        assert "RESOURCE_EXHAUSTED" in pathlib.Path(path).read_text()
         assert crash.maybe_write_oom_report(ValueError("shape mismatch")) is None
 
 
