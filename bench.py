@@ -260,6 +260,13 @@ def _timed_chunks(run_chunk, *, min_chunks: int = 4, max_chunks: int = 10,
         "accepted_chunk": i_best,
         "accepted_health": round(healths[i_best], 3),
         "congested": not healthy,
+        # rate_spread = max/min - 1 over all chunks: recorded EVIDENCE of
+        # measurement self-consistency.  spe-grouped configs amortize
+        # tunnel latency over long device programs, so their chunk rates
+        # can sit within ~2% even while the latency-dominated PROBE reads
+        # unhealthy — a tight spread says the number itself is stable
+        # despite the weather (it does NOT change acceptance/congested).
+        "rate_spread": round(max(rates) / max(min(rates), 1e-9) - 1, 4),
     }
     return rates[i_best], meta
 
@@ -547,14 +554,15 @@ def bench_resnet50_etl(peak):
         host_cpus=_os.cpu_count(),
         n_images=n_img, num_classes=n_classes,
         source_size="500x375 JPEG q85",
-        note="real-image pipeline: disk JPEG -> native libjpeg batch "
+        note="real-image pipeline (uint8 wire): disk JPEG -> native "
              "decode -> async prefetch -> fit.  The gap vs the synthetic "
-             "resnet50_cg entry decomposes into JPEG decode (CPU-bound, "
-             "etl_images_per_sec scales per core — see host_cpus) and "
-             "host->device transfer (h2d_mb_per_s; a 224px f32 batch is "
-             "~0.6 MB/image, which a TPU-VM DMAs at GB/s but a tunneled "
-             "dev chip moves at WAN speed — on this rig the TUNNEL, not "
-             "the ETL tier, is the binding constraint)",
+             "resnet50_cg entry decomposes into JPEG decode (CPU-bound; "
+             "measure scaling with bench.py --decode-scaling) and "
+             "host->device transfer (h2d_mb_per_s; the uint8 wire puts a "
+             "224px image at ~0.147 MB — 4x under f32 — which a TPU-VM "
+             "DMAs at GB/s but a tunneled dev chip moves at WAN speed — "
+             "on this rig the TUNNEL, not the ETL tier, is the binding "
+             "constraint)",
     )
 
 
@@ -743,6 +751,60 @@ def bench_longctx(peak):
         flops_source="analytic (XLA cost analysis cannot see through the "
                      "Pallas flash-attention call)",
     )
+
+
+def bench_resnet_ab() -> None:
+    """ResNet batch/spe A/B matrix (VERDICT r5 ask 7 measurement aid):
+    runs the headline config across (batch, spe) pairs in one session so
+    the pairs share tunnel weather, printing one JSON line per pair.
+    Pairs via BENCH_AB_PAIRS="256:8,256:16,384:8,512:8" (default).
+    Run:  python bench.py --resnet-ab"""
+    if QUICK or os.environ.get("BENCH_FORCE_CPU", "") not in ("", "0"):
+        # quick mode hardcodes batch 8 / spe 1 (every pair would measure
+        # the SAME config under its requested label — false data), and a
+        # full-size ResNet matrix on a host CPU runs for hours; this mode
+        # is a chip measurement aid, not a plumbing check
+        print(json.dumps({"metric": "resnet50 batch/spe A-B",
+                          "error": "requires a real device run "
+                                   "(unset BENCH_QUICK/BENCH_FORCE_CPU)"}))
+        return
+    if os.environ.get("BENCH_SKIP_PROBE", "") in ("", "0"):
+        evidence = _await_backend(
+            float(os.environ.get("BENCH_PROBE_WINDOW_S", "600")))
+        if not evidence["alive"]:
+            print(json.dumps({"metric": "resnet50 batch/spe A-B",
+                              "error": "device backend unreachable",
+                              "probe": {"attempts": len(
+                                  evidence["attempts"])}}))
+            return
+    peak, kind = _peak_flops()
+    pairs = [
+        tuple(int(v) for v in p.split(":"))
+        for p in os.environ.get(
+            "BENCH_AB_PAIRS", "256:8,256:16,384:8,512:8").split(",")
+    ]
+    out = []
+    for batch, spe in pairs:
+        os.environ["BENCH_RESNET_BATCH"] = str(batch)
+        os.environ["BENCH_RESNET_SPE"] = str(spe)
+        try:
+            r = bench_resnet50(peak)
+        except Exception as exc:
+            r = {"error": f"{type(exc).__name__}: {exc}"}
+        t = r.get("timing", {})
+        row = {
+            "batch": batch, "spe": spe,
+            "samples_per_sec": r.get("samples_per_sec"),
+            "mfu": r.get("mfu_vs_bf16_peak"),
+            "health": t.get("accepted_health"),
+            "congested": t.get("congested"),
+            "rate_spread": t.get("rate_spread"),
+            "error": r.get("error"),
+        }
+        out.append({k: v for k, v in row.items() if v is not None})
+        print(f"[ab] {json.dumps(out[-1])}", file=sys.stderr)
+    print(json.dumps({"metric": "resnet50 batch/spe A-B",
+                      "device_kind": kind, "rows": out}))
 
 
 def bench_decode_scaling() -> None:
@@ -1252,4 +1314,6 @@ if __name__ == "__main__":
         sys.exit(bench_scaling())
     if "--decode-scaling" in sys.argv:
         sys.exit(bench_decode_scaling())
+    if "--resnet-ab" in sys.argv:
+        sys.exit(bench_resnet_ab())
     sys.exit(main())

@@ -43,3 +43,9 @@ def test_video_pipeline_example(monkeypatch):
 
 def test_speech_ctc_example(monkeypatch):
     assert _run("speech_ctc.py", monkeypatch) > 0.9
+
+
+def test_finetune_imported_example(monkeypatch):
+    """Round 5: import-then-fine-tune THROUGH a V1 while loop, zero
+    tensorflow dependency (codec-synthesized frozen graph)."""
+    assert _run("finetune_imported.py", monkeypatch) > 0.9
