@@ -86,6 +86,25 @@ def test_never_healthy_flags_congested(fake_probe):
     assert sps > 0                            # still reports the best chunk
 
 
+def test_mid_chunk_stall_with_healthy_brackets_flags_congested(fake_probe):
+    """r5 run-3 regression: a device-contention stall INSIDE a chunk can
+    leave the crawling chunk healthy-bracketed while fast chunks sit
+    between unhealthy probes — the self-contradictory window must be
+    flagged congested, not published as a clean 151-sps headline."""
+    # probes: chunk0 healthy (100,100) but its rate will be tiny; chunks
+    # 1..3 fast but bracketed by slumped probes
+    fake_probe([100, 100, 40, 40, 40, 40, 41])
+    rates = iter([5, 100, 100, 100])
+
+    def chunk():
+        return next(rates)
+
+    sps, meta = bench._timed_chunks(chunk, min_chunks=4, max_chunks=4)
+    assert meta["accept_anomaly"] is True
+    assert meta["congested"] is True          # evidence contradicts itself
+    assert meta["accepted_health"] >= 0.8     # ...even though brackets said ok
+
+
 def test_mean_rate_recorded_alongside_peak(fake_probe):
     fake_probe([100] * 12)
     chunk, _ = make_chunks(20)
