@@ -1457,19 +1457,10 @@ class _SubgraphFn:
 
     @staticmethod
     def _slice(nodes, outputs) -> set:
-        by_name = {n.name: n for n in nodes}
-        needed: set = set()
-        stack = [_input_name(r)[0] for r in outputs]
-        while stack:
-            b = stack.pop()
-            if b in needed or b not in by_name:
-                continue
-            needed.add(b)
-            for raw in by_name[b].input:
-                if raw.startswith("^"):
-                    continue
-                stack.append(_input_name(raw)[0])
-        return needed
+        # the shared backward slice, restricted to nodes in this subgraph
+        # (external leaves are the slice's inputs, not members)
+        return _backward_slice_bases(nodes, outputs) & {
+            n.name for n in nodes}
 
     def __call__(self, *args):
         env = dict(self._imp.sd._values)

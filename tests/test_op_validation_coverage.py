@@ -586,8 +586,8 @@ def test_registry_coverage_floor():
             f"- registry ops: **{n}**",
             f"- validated: **{validated}** "
             f"({100 * coverage:.1f}%, floor {100 * FLOOR:.0f}%)",
-            f"- graph-path (forward vs direct call"
-            f"{''} + grad-check where differentiable): "
+            f"- graph-path (forward vs direct call + grad-check where "
+            f"differentiable): "
             f"{sum(1 for s, _ in results.values() if s == 'ok')}",
             f"- direct-call (tuple-output/special): "
             f"{sum(1 for s, _ in results.values() if s == 'ok-direct')}",
