@@ -742,6 +742,48 @@ class SameDiff:
             names = sorted(self._values)
             np.savez(buf, **{n: np.asarray(self._values[n]) for n in names})
             zf.writestr("values.npz", buf.getvalue())
+            self._save_opt_state(zf)
+
+    # -- training-runtime persistence (the reference checkpoints updater
+    # state alongside params — SURVEY §2.2 "Model serialization"; the
+    # MLN/CG ModelSerializer already does).  SameDiff resume restores the
+    # Adam moments AND the RNG stream position, so the resumed step is
+    # the step the uninterrupted run would have taken — including dropout
+    # masks. -------------------------------------------------------------
+    def _save_opt_state(self, zf) -> None:
+        zf.writestr("rng_state.json", json.dumps(self._stream.state_dict()))
+        if self._opt_state is None:
+            return
+        from deeplearning4j_tpu.train.checkpoint import _save_npz_pytree
+
+        _save_npz_pytree(zf, "opt_state.npz", self._opt_state)
+
+    def _load_opt_state(self, zf) -> None:
+        names = zf.namelist()
+        if "rng_state.json" in names:
+            self._stream.load_state_dict(
+                json.loads(zf.read("rng_state.json")))
+        if "opt_state.npz" not in names or self._training_config is None:
+            return
+        from deeplearning4j_tpu.train.checkpoint import _load_npz_into
+
+        tx = self._training_config.updater.to_optax()
+        ref = tx.init({n: self._values[n] for n in sorted(self._trainable)})
+        try:
+            loaded = _load_npz_into(zf, "opt_state.npz", ref)
+        except ValueError:
+            loaded = None
+        # leaf-count match isn't structure match: a reshaped or reordered
+        # trainable set can keep the count while mispairing moments — any
+        # per-leaf shape mismatch also means "structure changed", and the
+        # honest fallback is a fresh init on the next fit_batch
+        if loaded is not None and any(
+            np.shape(a) != np.shape(b)
+            for a, b in zip(jax.tree_util.tree_leaves(loaded),
+                            jax.tree_util.tree_leaves(ref))
+        ):
+            loaded = None
+        self._opt_state = loaded
 
     def _save_source_backed(self, path: str, src: dict, n_imp: int) -> None:
         """Checkpoint an IMPORTED graph with control flow: the original
@@ -784,6 +826,7 @@ class SameDiff:
             np.savez(buf, **{n: np.asarray(self._values[n])
                              for n in extra_values})
             zf.writestr("values.npz", buf.getvalue())
+            self._save_opt_state(zf)
 
     @staticmethod
     def _load_source_backed(zf) -> "SameDiff":
@@ -826,6 +869,7 @@ class SameDiff:
         sd._counter = max(man.get("counter", 0), sd._counter)
         if man.get("training_config"):
             sd.set_training_config(serde.from_jsonable(man["training_config"]))
+        sd._load_opt_state(zf)
         return sd
 
     @staticmethod
@@ -836,20 +880,23 @@ class SameDiff:
                 return SameDiff._load_source_backed(zf)
             graph = json.loads(zf.read("graph.json"))
             data = np.load(io.BytesIO(zf.read("values.npz")), allow_pickle=False)
-        for name in graph["placeholders"]:
-            sd.placeholder(name)
-        for name in graph["trainable"]:
-            sd.var(name, data[name])
-        for name in graph["constants"]:
-            sd.constant(name, data[name])
-        for n in graph["ops"]:
-            node = _OpNode(n["op"], tuple(n["inputs"]), n["output"], _unjsonify_attrs(n["attrs"]))
-            sd._ops.append(node)
-            sd._vars[node.output] = SDVariable(sd, node.output, "op")
-        sd._loss_var = graph.get("loss_var")
-        sd._counter = graph.get("counter", len(sd._vars))
-        if graph.get("training_config"):
-            sd.set_training_config(serde.from_jsonable(graph["training_config"]))
+            for name in graph["placeholders"]:
+                sd.placeholder(name)
+            for name in graph["trainable"]:
+                sd.var(name, data[name])
+            for name in graph["constants"]:
+                sd.constant(name, data[name])
+            for n in graph["ops"]:
+                node = _OpNode(n["op"], tuple(n["inputs"]), n["output"],
+                               _unjsonify_attrs(n["attrs"]))
+                sd._ops.append(node)
+                sd._vars[node.output] = SDVariable(sd, node.output, "op")
+            sd._loss_var = graph.get("loss_var")
+            sd._counter = graph.get("counter", len(sd._vars))
+            if graph.get("training_config"):
+                sd.set_training_config(
+                    serde.from_jsonable(graph["training_config"]))
+            sd._load_opt_state(zf)
         return sd
 
     def __getitem__(self, name: str) -> SDVariable:

@@ -46,6 +46,23 @@ class SeedStream:
         self._count += 1
         return jax.random.fold_in(self._key, self._count)
 
+    # -- persistence: checkpoints must resume the SAME key sequence, or a
+    # resumed run's dropout masks diverge from the uninterrupted run --
+    def state_dict(self) -> dict:
+        import numpy as np
+
+        return {
+            "key_data": np.asarray(jax.random.key_data(self._key)).tolist(),
+            "count": self._count,
+        }
+
+    def load_state_dict(self, d: dict) -> None:
+        import jax.numpy as jnp
+
+        self._key = jax.random.wrap_key_data(
+            jnp.asarray(d["key_data"], jnp.uint32))
+        self._count = int(d["count"])
+
     @staticmethod
     def fold(key: jax.Array, step: jax.Array | int) -> jax.Array:
         return jax.random.fold_in(key, jnp.asarray(step, dtype=jnp.uint32))

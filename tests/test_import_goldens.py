@@ -70,15 +70,32 @@ def test_keras_golden(name):
     )
 
 
+def _finetune_while_golden(steps: int):
+    """Shared setup for the while_train_v1 fixture: trainable import +
+    softmax-CE head + Adam, fine-tuned `steps` batches.  Returns
+    (sd, x, y, losses)."""
+    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    sd = import_graph(os.path.join(TF_DIR, "while_train_v1.pb"),
+                      trainable=True)
+    io = np.load(os.path.join(TF_DIR, "while_train_v1_io.npz"))
+    x = io["in_x"]
+    labels = sd.placeholder("labels")
+    sd.set_loss(sd.loss.softmax_cross_entropy(sd["logits"], labels,
+                                              name="loss"))
+    sd.set_training_config(TrainingConfig(updater=Adam(5e-2)))
+    y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0, 1]]
+    losses = [sd.fit_batch({"x": x, "labels": y}) for _ in range(steps)]
+    return sd, x, y, losses
+
+
 def test_while_train_v1_finetunes_through_loop():
     """Round-5 fixture: the training loss depends on a V1 while-frame
     output with an in-loop weight matrix.  Static-trip inference must
     lower the frame to lax.scan (exact_trip), promotion must make the
     loop-captured weight trainable, and fine-tuning must move it —
     i.e. the gradient flows THROUGH the loop (VERDICT r4 missing #1)."""
-    from deeplearning4j_tpu.autodiff.samediff import TrainingConfig
-    from deeplearning4j_tpu.nn.updaters import Adam
-
     sd = import_graph(os.path.join(TF_DIR, "while_train_v1.pb"),
                       trainable=True)
     wnodes = [n for n in sd._ops if n.op == "_while"]
@@ -88,23 +105,49 @@ def test_while_train_v1_finetunes_through_loop():
     assert "W_loop" in sd._trainable
 
     io = np.load(os.path.join(TF_DIR, "while_train_v1_io.npz"))
-    x = io["in_x"]
     # forward still matches the real-TF golden after the scan lowering
     np.testing.assert_allclose(
-        np.asarray(sd.output({"x": x}, "logits")), io["out_logits"],
-        atol=2e-4, rtol=1e-3)
+        np.asarray(sd.output({"x": io["in_x"]}, "logits")),
+        io["out_logits"], atol=2e-4, rtol=1e-3)
 
-    w0 = np.asarray(sd._values["W_loop"]).copy()
-    labels = sd.placeholder("labels")
-    loss = sd.loss.softmax_cross_entropy(sd["logits"], labels, name="loss")
-    sd.set_loss(loss)
-    sd.set_training_config(TrainingConfig(updater=Adam(5e-2)))
-    y = np.eye(3, dtype=np.float32)[[0, 1, 2, 0, 1]]
-    losses = [sd.fit_batch({"x": x, "labels": y}) for _ in range(25)]
+    sd2, _, _, losses = _finetune_while_golden(steps=25)
     assert losses[-1] < losses[0], losses[:3] + losses[-3:]
-    w1 = np.asarray(sd._values["W_loop"])
+    w0 = np.asarray(sd.get_value("W_loop"))       # untrained copy
+    w1 = np.asarray(sd2.get_value("W_loop"))
     assert np.abs(w1 - w0).max() > 1e-4, \
         "in-loop weight never moved — gradient did not cross the loop"
+
+
+def test_finetuned_loop_model_roundtrips_through_zip(tmp_path):
+    """Source-backed serde with a fine-tuned IN-LOOP weight: save() ships
+    the original frozen bytes + tuned values AND optimizer state; load()
+    re-imports (re-proving the trip count), overlays the tuned weights,
+    and restores the Adam moments — outputs match and training resumes
+    with the saved moments, not re-warmed ones."""
+    import jax
+
+    from deeplearning4j_tpu.autodiff.samediff import SameDiff
+
+    sd, x, y, _ = _finetune_while_golden(steps=10)
+    out_before = np.asarray(sd.output({"x": x}, "logits"))
+
+    path = str(tmp_path / "tuned_loop.zip")
+    sd.save(path)
+    sd2 = SameDiff.load(path)
+    (w,) = [n for n in sd2._ops if n.op == "_while"]
+    assert w.attrs["max_trip"] == 4 and w.attrs["exact_trip"] is True
+    np.testing.assert_allclose(
+        np.asarray(sd2.output({"x": x}, "logits")), out_before,
+        atol=1e-6, err_msg="fine-tuned in-loop weight lost in serde")
+    # the optimizer state came back leaf-for-leaf (not a fresh init)
+    assert sd2._opt_state is not None
+    for a, b in zip(jax.tree.leaves(sd._opt_state),
+                    jax.tree.leaves(sd2._opt_state)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=1e-7)
+    # and the NEXT step matches what the un-serialized model computes
+    want = sd.fit_batch({"x": x, "labels": y})
+    got = sd2.fit_batch({"x": x, "labels": y})
+    np.testing.assert_allclose(got, want, rtol=1e-5)
 
 
 def test_mini_bert_synth_trainable_finetunes():
