@@ -364,6 +364,22 @@ class SameDiff:
           predicate each step and carrying values through unchanged once
           it goes false (select-mask).  Semantically identical to the
           while loop PROVIDED the true trip count never exceeds T.
+
+        At-least-one-iteration assumption (masked-scan path only): after
+        the predicate goes false, the scan still EXECUTES the body each
+        remaining step — on the INITIAL loop values, discarding the
+        result (the double-where in `_execute`; that keeps a body that
+        goes NaN/Inf outside the predicate's domain from poisoning the
+        gradient).  This is sound for any loop that iterates at least
+        once: the initial values are then known body-safe.  A ZERO-trip
+        loop (predicate false on entry) still runs the body once on
+        those initial values — the returned values are correct (the
+        where selects the originals) but the body must be total on its
+        initial operands, or its NaN can leak through the gradient.
+        Importers (TF `import_graph`, ONNX `op_Loop`) inherit exactly
+        this contract; export zero-trip-reachable loops with a dynamic
+        (non-const) trip count to get the plain while_loop lowering
+        instead.
         """
         base = name or self._fresh("while")
         tuple_name = base + "#tuple"

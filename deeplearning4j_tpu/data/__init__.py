@@ -1,3 +1,4 @@
+from deeplearning4j_tpu.data.cached import CachedDataSetIterator
 from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
 from deeplearning4j_tpu.data.iterator import (
     AsyncDataSetIterator,
@@ -13,4 +14,5 @@ __all__ = [
     "NumpyDataSetIterator",
     "ExistingDataSetIterator",
     "AsyncDataSetIterator",
+    "CachedDataSetIterator",
 ]

@@ -36,7 +36,10 @@ from deeplearning4j_tpu.datavec.audio import (
 from deeplearning4j_tpu.datavec.schema import Schema, ColumnType
 from deeplearning4j_tpu.datavec.transform import TransformProcess
 from deeplearning4j_tpu.datavec.executor import LocalTransformExecutor
-from deeplearning4j_tpu.datavec.bridge import RecordReaderDataSetIterator
+from deeplearning4j_tpu.datavec.bridge import (
+    RecordReaderDataSetIterator,
+    SequenceRecordReaderDataSetIterator,
+)
 from deeplearning4j_tpu.datavec.join_reduce import (
     Join,
     JoinType,
@@ -62,6 +65,7 @@ __all__ = [
     "TransformProcess",
     "LocalTransformExecutor",
     "RecordReaderDataSetIterator",
+    "SequenceRecordReaderDataSetIterator",
     "WavFileRecordReader",
     "SpectrogramRecordReader",
     "VideoRecordReader",

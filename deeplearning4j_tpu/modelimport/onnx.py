@@ -1239,16 +1239,19 @@ class _Importer:
 
         m_name, cond_name = node.input[0], node.input[1]
         # a static trip-count M <= cap bounds the loop by construction, so
-        # it lowers to differentiable scan+mask below.  A static M BEYOND
-        # the cap is the torch-export idiom for "cond-only while" (M =
-        # INT64_MAX): drop the i < M check entirely — both because a scan
-        # that long is absurd and because the int32 carry would overflow.
+        # it lowers to differentiable scan+mask below.  A static M beyond
+        # INT32_MAX is the torch-export idiom for "cond-only while" (M =
+        # INT64_MAX): drop the i < M check entirely — both because a trip
+        # count that long is absurd and because the int32 carry could not
+        # represent it.  In between ((cap, INT32_MAX]) the bound is real:
+        # too long for a scan, but it must still terminate the loop —
+        # keep the check and lower via lax.while_loop (forward-only).
         static_bound = None
         if m_name and m_name in self.consts:
             m_val = int(np.asarray(self.consts[m_name]).reshape(()))
             if 0 <= m_val <= _LOOP_SCAN_CAP:
                 static_bound = m_val
-            else:
+            elif m_val > np.iinfo(np.int32).max:
                 m_name = ""          # effectively unbounded
         max_trip = self.in_var(m_name) if m_name else None
         cond0 = self.in_var(cond_name) if cond_name else None
@@ -1284,6 +1287,10 @@ class _Importer:
         if max_trip is not None:
             init.append(max_trip)
 
+        # static_bound lowering inherits SameDiff.while_loop's masked-scan
+        # contract: the body must be total on the INITIAL state (a
+        # zero-trip Loop — cond0 false — still executes it once, result
+        # discarded); see the at-least-one-iteration note there
         outs = self.sd.while_loop(cond_fn, body_wrap, *init,
                                   max_trip=static_bound)
         # final state vars map to the node outputs (iter/cond dropped)

@@ -1140,6 +1140,10 @@ class _Importer:
             interior, body_inputs, body_outputs,
             statics, static_inits, label)
         bound = trip if trip is not None else self.loop_trip_bound
+        # bounded lowering inherits SameDiff.while_loop's masked-scan
+        # contract: the body must be total on the INITIAL loop values (a
+        # zero-trip loop still executes it once, result discarded) — see
+        # the at-least-one-iteration note in that docstring
         outs = self.sd.while_loop(
             lambda *vs: cond_fn(*vs)[0],
             lambda *vs: body_fn(*vs),

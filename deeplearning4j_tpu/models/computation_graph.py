@@ -266,7 +266,7 @@ class GraphModel(Model):
             if use_multi:
                 self._fit_epoch_multi(iterator, steps_per_execution)
             else:
-                for batch in iterator:
+                for batch in self._timed_batches(iterator):
                     self.fit_batch(batch)
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch)
@@ -292,7 +292,7 @@ class GraphModel(Model):
 
         self._multi_iter_dev = None
         buf = []
-        for batch in iterator:
+        for batch in self._timed_batches(iterator):
             buf.append(self._as_mds(batch))
             if len(buf) == spe:
                 if group_ok(buf):

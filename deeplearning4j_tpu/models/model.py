@@ -7,6 +7,7 @@ accounting, listener dispatch, save/load.
 
 from __future__ import annotations
 
+import time
 from typing import Any
 
 import numpy as np
@@ -25,6 +26,49 @@ class Model:
         self.listeners: list[TrainingListener] = []
         self.last_batch_size: int = 0
         self._last_score = None
+        # ETL accounting: seconds fit() sat blocked on the input iterator
+        # (decode/tokenize/disk — anything the device waited for)
+        self.etl_wait_s: float = 0.0        # cumulative across fits
+        self.last_etl_wait_s: float = 0.0   # wait before the latest batch
+        from deeplearning4j_tpu.runtime import compile_stats as _cs
+
+        self._compile_snap = _cs.snapshot()   # baseline at model creation
+
+    # -- input-pipeline accounting ----------------------------------------
+    def _timed_batches(self, iterator):
+        """Iterate `iterator`, charging time blocked on next() to
+        etl_wait_s.  Every fit loop pulls batches through this, so the
+        iterator-starvation tax (JPEG decode, tokenization, disk) is a
+        first-class metric next to samples/sec instead of silently
+        deflating it.  Near-zero when AsyncDataSetIterator's producer
+        keeps ahead of the device."""
+        it = iter(iterator)
+        while True:
+            t0 = time.perf_counter()
+            try:
+                batch = next(it)
+            except StopIteration:
+                return
+            wait = time.perf_counter() - t0
+            self.last_etl_wait_s = wait
+            self.etl_wait_s += wait
+            yield batch
+
+    def compile_stats(self) -> dict:
+        """Compile-tax counters since this model was constructed, plus
+        `step_programs` — the number of DISTINCT XLA programs compiled
+        for this model's cached step functions (one per (step kind,
+        shape signature); the recompile counter the bucketing tests
+        assert on)."""
+        from deeplearning4j_tpu.runtime import compile_stats as _cs
+
+        d = (_cs.snapshot() - self._compile_snap).as_dict()
+        d["step_programs"] = sum(
+            fn._cache_size()
+            for fn in getattr(self, "_step_fns", {}).values()
+            if hasattr(fn, "_cache_size")
+        )
+        return d
 
     # -- listeners ---------------------------------------------------------
     def set_listeners(self, *listeners: TrainingListener) -> None:

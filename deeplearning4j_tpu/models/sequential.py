@@ -856,7 +856,7 @@ class SequentialModel(Model):
             if use_multi:
                 self._fit_epoch_multi(iterator, steps_per_execution)
             else:
-                for batch in iterator:
+                for batch in self._timed_batches(iterator):
                     self.fit_batch(batch)
             for lst in self.listeners:
                 lst.on_epoch_end(self, self.epoch)
@@ -909,7 +909,7 @@ class SequentialModel(Model):
 
         self._multi_iter_dev = None
         buf: list[DataSet] = []
-        for batch in iterator:
+        for batch in self._timed_batches(iterator):
             buf.append(batch)
             if len(buf) == spe:
                 flush(buf)

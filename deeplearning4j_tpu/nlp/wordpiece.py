@@ -159,12 +159,24 @@ class BertIterator(DataSetIterator):
     """Fixed-shape BERT fine-tune batches (BertIterator role): sentences
     (+ optional pairs) with integer labels -> DataSet batches whose
     features are token ids, features_mask is the attention mask, labels
-    one-hot.  Static shapes: every batch pads to (batch_size, max_len)."""
+    one-hot.  Static shapes: every batch pads to (batch_size, max_len).
+
+    dynamic_seq_len=True enables SEQUENCE BUCKETING: examples are grouped
+    by tokenized length and each batch's time axis is the group's length
+    rounded UP to the bucket quantum (`bucket_size`, default
+    `environment().sequence_bucket_size`), capped at max_len.  A
+    mixed-length corpus then compiles at most ceil(max_len / quantum)
+    distinct step programs instead of one per distinct length, and short
+    batches stop paying max_len's worth of attention FLOPs.  The
+    attention mask still carries per-token validity, so the loss/metrics
+    are identical to the padded-to-max_len layout."""
 
     def __init__(self, tokenizer: BertWordPieceTokenizer,
                  sentences: Sequence, labels: Sequence[int], *,
                  num_classes: int, batch_size: int = 32, max_len: int = 128,
-                 pairs: Optional[Sequence] = None):
+                 pairs: Optional[Sequence] = None,
+                 dynamic_seq_len: bool = False,
+                 bucket_size: Optional[int] = None):
         if len(sentences) != len(labels):
             raise ValueError("sentences and labels must align")
         if pairs is not None and len(pairs) != len(sentences):
@@ -176,7 +188,10 @@ class BertIterator(DataSetIterator):
         self.num_classes = num_classes
         self._batch_size = batch_size
         self.max_len = max_len
+        self.dynamic_seq_len = dynamic_seq_len
+        self.bucket_size = bucket_size
         self._encoded = None         # (ids, mask, segments) cached across epochs
+        self._lengths = None         # per-example real token counts
 
     @property
     def batch_size(self) -> int:
@@ -198,6 +213,7 @@ class BertIterator(DataSetIterator):
                 )
                 ids[j], mask[j], segs[j] = i, m, sg
             self._encoded = (ids, mask, segs)
+            self._lengths = mask.sum(axis=1).astype(np.int64)
         return self._encoded
 
     def segment_ids(self):
@@ -207,25 +223,48 @@ class BertIterator(DataSetIterator):
         these ids from a custom layer/graph input if segments matter."""
         return self._encode_all()[2]
 
+    def _bucket_plan(self) -> list[tuple[int, list[int]]]:
+        """(bucket_len, example indices) groups, shortest bucket first.
+        Bucket lengths are multiples of the quantum capped at max_len, so
+        distinct feature shapes number at most ceil(max_len/quantum)."""
+        from deeplearning4j_tpu.runtime.flags import bucket_length
+
+        self._encode_all()
+        q = self.bucket_size
+        buckets: dict[int, list[int]] = {}
+        for j, ln in enumerate(self._lengths):
+            L = min(self.max_len, bucket_length(int(ln), q))
+            buckets.setdefault(L, []).append(j)
+        return sorted(buckets.items())
+
+    def _emit(self, idx: list[int], seq_len: int):
+        all_ids, all_mask, _ = self._encoded
+        bs = self._batch_size
+        count = len(idx)
+        ids = np.zeros((bs, seq_len), np.float32)
+        mask = np.zeros((bs, seq_len), np.float32)
+        y = np.zeros((bs, self.num_classes), np.float32)
+        lmask = np.zeros((bs,), np.float32)
+        ids[:count] = all_ids[idx, :seq_len]
+        mask[:count] = all_mask[idx, :seq_len]
+        for j, src in enumerate(idx):
+            y[j, self.labels[src]] = 1.0
+            lmask[j] = 1.0
+        # static batch shape: the tail batch pads EXAMPLES too and
+        # masks them out of the loss via labels_mask
+        return DataSet(ids, y, features_mask=mask, labels_mask=lmask)
+
     def __iter__(self):
-        all_ids, all_mask, _ = self._encode_all()
+        self._encode_all()
         n = len(self.sentences)
         bs = self._batch_size
-        for lo in range(0, n, bs):
-            hi = min(lo + bs, n)
-            count = hi - lo
-            ids = np.zeros((bs, self.max_len), np.float32)
-            mask = np.zeros((bs, self.max_len), np.float32)
-            y = np.zeros((bs, self.num_classes), np.float32)
-            lmask = np.zeros((bs,), np.float32)
-            ids[:count] = all_ids[lo:hi]
-            mask[:count] = all_mask[lo:hi]
-            for j in range(count):
-                y[j, self.labels[lo + j]] = 1.0
-                lmask[j] = 1.0
-            # static batch shape: the tail batch pads EXAMPLES too and
-            # masks them out of the loss via labels_mask
-            yield DataSet(ids, y, features_mask=mask, labels_mask=lmask)
+        if not self.dynamic_seq_len:
+            for lo in range(0, n, bs):
+                yield self._emit(list(range(lo, min(lo + bs, n))), self.max_len)
+            return
+        for seq_len, idx in self._bucket_plan():
+            for lo in range(0, len(idx), bs):
+                yield self._emit(idx[lo : lo + bs], seq_len)
 
     def reset(self) -> None:
         pass

@@ -11,8 +11,10 @@ from deeplearning4j_tpu.runtime.backend import (
     backend,
     device_count,
     devices,
+    init_compile_cache,
     platform,
 )
+from deeplearning4j_tpu.runtime.compile_stats import CompileStats
 from deeplearning4j_tpu.runtime.coordinator import (
     CoordinatorClient,
     CoordinatorServer,
@@ -28,8 +30,10 @@ __all__ = [
     "DistributedConfig",
     "Backend",
     "backend",
+    "CompileStats",
     "device_count",
     "devices",
+    "init_compile_cache",
     "platform",
     "Environment",
     "environment",

@@ -12,9 +12,13 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import logging
+import os
 
 import jax
 import numpy as np
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 @dataclasses.dataclass(frozen=True)
@@ -39,7 +43,66 @@ class Backend:
 
 
 @functools.cache
+def init_compile_cache() -> str | None:
+    """Enable the persistent XLA compile cache by default (idempotent).
+
+    Every user process otherwise recompiles its models from scratch —
+    seconds to minutes of pure tax for programs XLA already built
+    yesterday.  `__graft_entry__.py` set this up for the dryrun
+    subprocess only; here it becomes the default for every run.
+
+    Resolution order for the cache directory:
+      1. an already-configured ``jax_compilation_cache_dir`` (config or
+         the standard ``JAX_COMPILATION_CACHE_DIR`` env var) wins;
+      2. ``DL4J_TPU_COMPILE_CACHE`` — a path, or ``0``/``off`` to skip
+         enabling the default (it cannot un-configure a jax-level cache
+         the user set explicitly);
+      3. default: ``$XDG_CACHE_HOME/deeplearning4j_tpu/xla`` (falling
+         back to ``~/.cache``).
+
+    ``DL4J_TPU_CACHE_MIN_COMPILE_SECS`` overrides jax's persist
+    threshold (default 1.0s: tiny programs recompile faster than disk
+    round-trips; set 0 to persist everything, as the warm-start tests
+    do).  Returns the active cache dir, or None when disabled.
+    Hit/miss counts are observable via `runtime.compile_stats`.
+    """
+    from deeplearning4j_tpu.runtime import compile_stats
+
+    compile_stats.install()          # count hits/misses from the first jit
+    override = os.environ.get("DL4J_TPU_COMPILE_CACHE", "").strip()
+    configured = jax.config.jax_compilation_cache_dir
+    if configured:
+        # explicit jax-level config wins — including over "off": this
+        # function only ever ADDS a default, it never un-configures a
+        # cache the user set up through jax itself
+        path = configured
+    elif override.lower() in ("0", "off", "false", "none"):
+        return None
+    elif override:
+        path = override
+    else:
+        base = os.environ.get("XDG_CACHE_HOME") or os.path.join(
+            os.path.expanduser("~"), ".cache"
+        )
+        path = os.path.join(base, "deeplearning4j_tpu", "xla")
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+    except OSError as exc:            # read-only home etc. — never fatal
+        log.warning("persistent compile cache disabled (%s): %s", path, exc)
+        return None
+    min_secs = os.environ.get("DL4J_TPU_CACHE_MIN_COMPILE_SECS")
+    if min_secs is not None:
+        jax.config.update(
+            "jax_persistent_cache_min_compile_time_secs", float(min_secs)
+        )
+    log.info("persistent XLA compile cache: %s", path)
+    return path
+
+
+@functools.cache
 def backend() -> Backend:
+    init_compile_cache()
     devs = jax.devices()
     d0 = devs[0]
     kind = getattr(d0, "device_kind", d0.platform)

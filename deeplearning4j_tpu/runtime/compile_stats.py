@@ -1,0 +1,148 @@
+"""Compile-tax instrumentation — counts what the whole-program-compile
+design pays for.
+
+A whole-step-compiled stack lives or dies on amortizing compilation
+(PROFILE.md: the compiled step sits at ~90% of roofline, so the headroom
+is everything AROUND it).  This module keeps process-global counters of
+the three compile taxes, fed by `jax.monitoring` events:
+
+- **jit cache misses** (fresh traces): every distinct (function, shape,
+  dtype) signature traced — the recompile tax a new sequence length or
+  batch shape triggers.
+- **backend compiles + compile seconds**: wall time inside XLA
+  compilation (or persistent-cache retrieval, which rides the same
+  event but costs milliseconds).
+- **persistent cache hits / time saved**: programs served from the
+  on-disk cache (`runtime/backend.py` enables it by default) instead of
+  being recompiled.
+
+Everything is cheap integers/floats behind one lock; listeners stay
+registered for the process lifetime (jax.monitoring has no targeted
+unregister).  Consumers take a `snapshot()` and subtract:
+
+    before = compile_stats.snapshot()
+    model.fit(data)
+    spent = compile_stats.snapshot() - before
+    print(spent.jit_cache_misses, spent.compile_secs)
+
+`PerformanceListener` / `StatsListener` surface these per fit/record;
+`Model.compile_stats()` adds the per-model distinct-program count.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+
+# jax.monitoring event names (stable since jax 0.4.x; see
+# jax/_src/dispatch.py and jax/_src/compiler.py)
+_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+_BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+_CACHE_HIT_EVENT = "/jax/compilation_cache/cache_hits"
+_CACHE_PUT_EVENT = "/jax/compilation_cache/cache_misses"
+_CACHE_SAVED_EVENT = "/jax/compilation_cache/compile_time_saved_sec"
+
+
+@dataclasses.dataclass(frozen=True)
+class CompileStats:
+    """Immutable counter snapshot; subtract two for a window's delta."""
+
+    jit_cache_misses: int = 0      # fresh traces (per jit signature)
+    backend_compiles: int = 0      # XLA compile requests (incl. cache loads)
+    compile_secs: float = 0.0      # wall seconds in compile/cache-retrieval
+    persistent_cache_hits: int = 0  # programs loaded from the disk cache
+    persistent_cache_puts: int = 0  # programs written to the disk cache
+    compile_secs_saved: float = 0.0  # compile time the disk cache avoided
+
+    @property
+    def fresh_backend_compiles(self) -> int:
+        """Compiles that actually ran XLA — requests NOT served from the
+        persistent cache.  The warm-start criterion: a second process on a
+        primed cache should show 0 here."""
+        return self.backend_compiles - self.persistent_cache_hits
+
+    def __sub__(self, other: "CompileStats") -> "CompileStats":
+        return CompileStats(
+            jit_cache_misses=self.jit_cache_misses - other.jit_cache_misses,
+            backend_compiles=self.backend_compiles - other.backend_compiles,
+            compile_secs=self.compile_secs - other.compile_secs,
+            persistent_cache_hits=(
+                self.persistent_cache_hits - other.persistent_cache_hits
+            ),
+            persistent_cache_puts=(
+                self.persistent_cache_puts - other.persistent_cache_puts
+            ),
+            compile_secs_saved=(
+                self.compile_secs_saved - other.compile_secs_saved
+            ),
+        )
+
+    def as_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["fresh_backend_compiles"] = self.fresh_backend_compiles
+        d["compile_secs"] = round(d["compile_secs"], 4)
+        d["compile_secs_saved"] = round(d["compile_secs_saved"], 4)
+        return d
+
+
+_lock = threading.Lock()
+_counts = {
+    "traces": 0,
+    "compiles": 0,
+    "compile_secs": 0.0,
+    "hits": 0,
+    "puts": 0,
+    "saved_secs": 0.0,
+}
+_installed = False
+
+
+def _on_event(event: str, **kwargs) -> None:
+    if event == _CACHE_HIT_EVENT:
+        with _lock:
+            _counts["hits"] += 1
+    elif event == _CACHE_PUT_EVENT:
+        with _lock:
+            _counts["puts"] += 1
+
+
+def _on_duration(event: str, duration: float, **kwargs) -> None:
+    if event == _TRACE_EVENT:
+        with _lock:
+            _counts["traces"] += 1
+    elif event == _BACKEND_COMPILE_EVENT:
+        with _lock:
+            _counts["compiles"] += 1
+            _counts["compile_secs"] += duration
+    elif event == _CACHE_SAVED_EVENT:
+        with _lock:
+            _counts["saved_secs"] += max(0.0, duration)
+
+
+def install() -> None:
+    """Register the monitoring listeners (idempotent, process-global)."""
+    global _installed
+    with _lock:
+        if _installed:
+            return
+        _installed = True
+    import jax.monitoring
+
+    jax.monitoring.register_event_listener(_on_event)
+    jax.monitoring.register_event_duration_secs_listener(_on_duration)
+
+
+def snapshot() -> CompileStats:
+    """Current process-global counters (installs listeners on first use —
+    a snapshot taken before install() still subtracts cleanly: both ends
+    of the window see the same zero baseline)."""
+    install()
+    with _lock:
+        return CompileStats(
+            jit_cache_misses=_counts["traces"],
+            backend_compiles=_counts["compiles"],
+            compile_secs=_counts["compile_secs"],
+            persistent_cache_hits=_counts["hits"],
+            persistent_cache_puts=_counts["puts"],
+            compile_secs_saved=_counts["saved_secs"],
+        )

@@ -49,6 +49,9 @@ class Environment:
             debug=_env_bool("DL4J_TPU_DEBUG"),
             verbose=_env_bool("DL4J_TPU_VERBOSE"),
             use_bfloat16_compute=_env_bool("DL4J_TPU_BF16", True),
+            sequence_bucket_size=int(
+                os.environ.get("DL4J_TPU_SEQUENCE_BUCKET", "64")
+            ),
         )
         if _env_bool("DL4J_TPU_NAN_PANIC"):
             env.set_nan_panic(True)
@@ -63,3 +66,21 @@ def environment() -> Environment:
     if _ENV is None:
         _ENV = Environment.from_env()
     return _ENV
+
+
+def bucket_length(length: int, quantum: int | None = None) -> int:
+    """Round a sequence length UP to the bucketing quantum.
+
+    The recompile-hygiene primitive (SURVEY.md §7.3 item 6): a compiled
+    step specializes on the time axis, so a mixed-length corpus fed at
+    its raw lengths compiles one XLA program PER DISTINCT LENGTH.
+    Rounding every batch's time axis up to a multiple of the quantum
+    bounds the program count at ceil(max_len / quantum); masks carry
+    which positions are real.  quantum=None reads
+    ``environment().sequence_bucket_size``.
+    """
+    q = quantum if quantum is not None else environment().sequence_bucket_size
+    if q <= 0:
+        raise ValueError(f"bucket quantum must be positive, got {q}")
+    n = max(1, int(length))
+    return ((n + q - 1) // q) * q

@@ -32,7 +32,27 @@ class SeedStream:
     """
 
     def __init__(self, seed: int | jax.Array = 0):
-        self._key = seed if isinstance(seed, jax.Array) else jax.random.key(seed)
+        import numpy as np
+
+        if isinstance(seed, (jax.Array, np.ndarray)):
+            if hasattr(seed, "dtype") and jnp.issubdtype(
+                seed.dtype, jax.dtypes.prng_key
+            ):
+                self._key = seed
+            elif seed.dtype == jnp.uint32:
+                # old-style raw key array (jax.random.PRNGKey / a loaded
+                # checkpoint's uint32 pair): normalize to a typed key NOW
+                # — accepting it raw would defer the failure to
+                # state_dict()'s key_data() call at checkpoint time
+                self._key = jax.random.wrap_key_data(jnp.asarray(seed))
+            else:
+                raise TypeError(
+                    "SeedStream seed array must be a typed PRNG key "
+                    "(jax.random.key) or an old-style uint32 key array "
+                    f"(jax.random.PRNGKey); got dtype {seed.dtype}"
+                )
+        else:
+            self._key = jax.random.key(seed)
         self._count = 0
 
     @property

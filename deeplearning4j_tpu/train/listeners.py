@@ -48,9 +48,22 @@ class CollectScoresListener(TrainingListener):
 
 
 class PerformanceListener(TrainingListener):
-    """samples/sec + batches/sec, with warmup-excluded steady-state rate."""
+    """samples/sec + batches/sec, with warmup-excluded steady-state rate,
+    plus the two feed-and-compile taxes the rate silently pays:
+
+    - **ETL wait**: seconds fit() sat blocked on the input iterator
+      (`etl_wait_seconds()` / `etl_wait_fraction()` of steady-state
+      wall time) — distinguishes "the step is slow" from "the feed is
+      slow", the diagnostic VERDICT's ETL-fed gap needed.
+    - **recompiles**: jit cache misses and XLA compile seconds since
+      this listener was constructed (`compile_stats()`), from
+      `runtime.compile_stats` — a mixed-shape corpus that recompiles
+      per batch shows up HERE, not as a mysteriously low samples/sec.
+    """
 
     def __init__(self, frequency: int = 10, warmup_iterations: int = 10):
+        from deeplearning4j_tpu.runtime import compile_stats as _cs
+
         self.frequency = max(1, frequency)
         self.warmup = warmup_iterations
         self._count = 0
@@ -59,6 +72,29 @@ class PerformanceListener(TrainingListener):
         self._steady_t0: float | None = None
         self._steady_samples = 0
         self._steady_batches = 0
+        self._compile_base = _cs.snapshot()
+        self._etl_wait = 0.0
+        self._steady_etl_wait = 0.0
+        self._model_wait_seen: float | None = None
+
+    def _track_etl_wait(self, model) -> None:
+        total = getattr(model, "etl_wait_s", None)
+        if total is None:
+            return
+        if self._model_wait_seen is None:
+            # first observation: credit the wait for the batch that just
+            # ran, not any pre-listener history
+            self._model_wait_seen = max(
+                0.0, total - getattr(model, "last_etl_wait_s", 0.0)
+            )
+        delta = max(0.0, total - self._model_wait_seen)
+        self._model_wait_seen = total
+        self._etl_wait += delta
+        # strictly AFTER the warmup boundary: the wait for the batch that
+        # set _steady_t0 happened before t0, so crediting it would let
+        # etl_wait_fraction exceed the window it divides by
+        if self._count > self.warmup and self._steady_t0 is not None:
+            self._steady_etl_wait += delta
 
     def iteration_done(self, model, iteration, epoch, score):
         now = time.perf_counter()
@@ -72,11 +108,20 @@ class PerformanceListener(TrainingListener):
         elif self._count > self.warmup and self._steady_t0 is not None:
             self._steady_samples += batch
             self._steady_batches += 1
+        self._track_etl_wait(model)
         if self._count % self.frequency == 0 and self._count > 1:
             total_dt = now - self._t0
             msg = f"iteration {iteration}: {self._samples / total_dt:.1f} samples/sec overall"
             if self._steady_batches:
                 msg += f", {self.samples_per_sec():.1f} samples/sec steady-state"
+            if self._etl_wait > 0:
+                msg += f", etl-wait {100.0 * self._etl_wait / total_dt:.0f}%"
+            cs = self.compile_stats()
+            if cs["jit_cache_misses"]:
+                msg += (
+                    f", {cs['jit_cache_misses']} recompiles"
+                    f" ({cs['compile_secs']:.1f}s compile)"
+                )
             log.info(msg)
 
     def samples_per_sec(self) -> float:
@@ -91,6 +136,26 @@ class PerformanceListener(TrainingListener):
             return 0.0
         dt = time.perf_counter() - self._steady_t0
         return self._steady_batches / dt if dt > 0 else 0.0
+
+    def etl_wait_seconds(self) -> float:
+        """Cumulative seconds the training loop was blocked on the input
+        iterator while this listener was attached."""
+        return self._etl_wait
+
+    def etl_wait_fraction(self) -> float:
+        """Fraction of steady-state wall time spent iterator-blocked
+        (0.0 = the feed always had a batch ready)."""
+        if self._steady_t0 is None:
+            return 0.0
+        dt = time.perf_counter() - self._steady_t0
+        return self._steady_etl_wait / dt if dt > 0 else 0.0
+
+    def compile_stats(self) -> dict:
+        """jit cache misses / XLA compile seconds / persistent-cache hits
+        since this listener was constructed (see runtime.compile_stats)."""
+        from deeplearning4j_tpu.runtime import compile_stats as _cs
+
+        return (_cs.snapshot() - self._compile_base).as_dict()
 
 
 class TimeIterationListener(TrainingListener):

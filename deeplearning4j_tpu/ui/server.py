@@ -95,7 +95,14 @@ async function refresh(){
  document.getElementById('meta').textContent=
   `iteration ${last.iteration} · epoch ${last.epoch} · score `
   +(Number.isFinite(last.score)?last.score.toPrecision(5):'NaN')
-  +(last.samples_per_sec?` · ${Math.round(last.samples_per_sec)} samples/s`:'');
+  +(last.samples_per_sec?` · ${Math.round(last.samples_per_sec)} samples/s`:'')
+  +(last.compile&&last.compile.jit_cache_misses?
+    ` · ${last.compile.jit_cache_misses} recompiles / `
+    +`${Number(last.compile.compile_secs).toFixed(1)}s compile`
+    +(last.compile.persistent_cache_hits?
+      ` (${last.compile.persistent_cache_hits} cache hits)`:''):'')
+  +(typeof last.etl_wait_s==='number'?
+    ` · etl wait ${Number(last.etl_wait_s).toFixed(1)}s`:'');
  drawLines(document.getElementById('score'),[recs.map(r=>r.score)]);
  drawLayerPanel('ratio','ratioLegend',recs,'update_ratio');
  drawLines(document.getElementById('mem'),

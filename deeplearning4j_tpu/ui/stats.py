@@ -244,6 +244,8 @@ class StatsListener(TrainingListener):
         histograms=True), each record also carries per-layer ACTIVATION
         histograms + mean magnitudes of the probe's forward pass — fixed
         input makes the distribution chart comparable across iterations."""
+        from deeplearning4j_tpu.runtime import compile_stats as _cs
+
         self.storage = storage
         self.frequency = max(1, frequency)
         self.session_id = session_id or f"train_{int(time.time())}"
@@ -255,6 +257,7 @@ class StatsListener(TrainingListener):
         self._stat_fn = None
         self._act_fn = None
         self._last_time = None
+        self._compile_base = _cs.snapshot()
 
     def _build_stat_fn(self):
         import jax
@@ -401,6 +404,16 @@ class StatsListener(TrainingListener):
             if dt > 0:
                 record["samples_per_sec"] = model.last_batch_size * self.frequency / dt
         self._last_time = now
+        # feed-and-compile taxes (cumulative since this listener was
+        # built): the dashboard shows recompiles and iterator-blocked
+        # time next to samples/sec — a rate dip reads as "compiling" or
+        # "starved", not guesswork
+        from deeplearning4j_tpu.runtime import compile_stats as _cs
+
+        record["compile"] = (_cs.snapshot() - self._compile_base).as_dict()
+        etl_wait = getattr(model, "etl_wait_s", None)
+        if etl_wait is not None:
+            record["etl_wait_s"] = round(float(etl_wait), 4)
         mem = device_memory_stats()
         if mem:
             record["memory"] = mem

@@ -27,6 +27,15 @@ def test_scaling_bench_runs_on_cpu_mesh():
         assert r["samples_per_sec"] > 0
         assert "efficiency" in r and "per_chip" in r
     assert out["rows"][0]["efficiency"] == 1.0
+    # fixed-work variant: global batch constant, so mechanism_efficiency
+    # isolates distribute() overhead even on the shared-core CPU mesh
+    fw = out["fixed_work_rows"]
+    assert [r["devices"] for r in fw] == [1, 2, 4, 8]
+    assert len({r["global_batch"] for r in fw}) == 1
+    for r in fw:
+        assert r["samples_per_sec"] > 0
+        assert "mechanism_efficiency" in r
+    assert fw[0]["mechanism_efficiency"] == 1.0
     ip = out["input_pipeline"]
     assert ip["async_feed_samples_per_sec"] > 0
     assert isinstance(ip["feed_covers_step"], bool)

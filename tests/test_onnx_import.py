@@ -592,6 +592,39 @@ class TestOnnxControlFlow:
         g = jax.grad(f)(jnp.asarray(xp))
         np.testing.assert_allclose(np.asarray(g), [16.0, 16.0], rtol=1e-6)
 
+    def test_loop_mid_range_m_keeps_termination_check(self):
+        """M in (scan cap, INT32_MAX] is a REAL bound, not the torch
+        cond-only-while idiom: it must stay an i < M check on the
+        while_loop lowering — a cond that never goes false must still
+        terminate at M (ADVICE.md: the old code dropped the bound for
+        any M beyond the cap, turning these into infinite loops)."""
+        import numpy as np
+
+        from onnx_fixtures import make_graph, make_model, make_node
+
+        # body: v = v + 1, cond stays True forever — only i < M stops it
+        body = make_graph(
+            [
+                make_node("Add", ["v", "one"], ["v_out"]),
+                make_node("Identity", ["cond_in"], ["cond_out"]),
+            ],
+            ["iter_num", "cond_in", "v"], ["cond_out", "v_out"],
+            initializers={"one": np.float32(1.0)},
+            name="body",
+        )
+        m_val = 20000                      # > _LOOP_SCAN_CAP, << INT32_MAX
+        raw = make_model(
+            [make_node("Loop", ["M", "cond0", "x"], ["y"], body=body)],
+            [("x", (1,))], ["y"],
+            initializers={"M": np.int64(m_val), "cond0": np.bool_(True)},
+        )
+        sd = import_onnx(raw)
+        (w,) = [n for n in sd._ops if n.op == "_while"]
+        assert w.attrs.get("max_trip") is None     # while_loop, not scan
+        xp = np.array([0.0], np.float32)
+        got = np.asarray(sd.output({"x": xp}, "y"))
+        np.testing.assert_allclose(got, [float(m_val)], atol=0)
+
     def test_loop_huge_m_keeps_while_lowering(self):
         """torch exports cond-only while-loops with M=INT64_MAX; such an
         M must NOT become a scan length (r5 review finding)."""

@@ -42,6 +42,31 @@ def test_seed_stream_deterministic():
     assert not np.allclose(np.asarray(ka), np.asarray(kc))
 
 
+def test_seed_stream_normalizes_old_style_uint32_key():
+    """An old-style raw uint32 key array (jax.random.PRNGKey / loaded
+    checkpoint) must be wrapped into a typed key at construction so
+    state_dict() can't raise at checkpoint time (ADVICE.md)."""
+    old = jax.random.PRNGKey(11)                 # raw uint32 pair
+    s = SeedStream(old)
+    d = s.state_dict()                           # would raise pre-fix
+    t = SeedStream(jax.random.key(11))
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.normal(s.key("l"), (3,))),
+        np.asarray(jax.random.normal(t.key("l"), (3,))),
+    )
+    r = SeedStream(0)
+    r.load_state_dict(d)
+    np.testing.assert_array_equal(
+        np.asarray(jax.random.key_data(r.root)),
+        np.asarray(jax.random.key_data(s.root)),
+    )
+
+
+def test_seed_stream_rejects_non_key_array():
+    with pytest.raises(TypeError, match="uint32|typed PRNG key"):
+        SeedStream(np.zeros((2,), np.float32))
+
+
 class TestDonationGuard:
     """SURVEY §5.2 donation-after-use guard: fit_batch donates the param/
     opt-state buffers into the compiled step; a stale reference held from
