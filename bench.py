@@ -383,6 +383,24 @@ def _timed_fit(model, batches, warmup: int, iters: int,
     return _timed_chunks(chunk)
 
 
+def _metrics_snapshot():
+    """Telemetry-spine snapshot for a result row: the compile / ETL-wait /
+    cache / step counters from `observe.metrics` (cumulative since
+    process start — rows later in the run include earlier configs'
+    taxes; the per-row DELTA is the difference between consecutive
+    rows).  BENCH_*.json therefore carries the feed-and-compile evidence
+    alongside the throughput it explains."""
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        return registry().snapshot(prefixes=(
+            "dl4jtpu_compile_", "dl4jtpu_etl_", "dl4jtpu_data_cache_",
+            "dl4jtpu_train_steps", "dl4jtpu_health_",
+        ))
+    except Exception:
+        return None
+
+
 def _entry(name, sps, fwd_flops_per_example, peak, batch, note=None,
            timing=None, **extra):
     train_flops = 3.0 * fwd_flops_per_example if fwd_flops_per_example else None
@@ -398,6 +416,7 @@ def _entry(name, sps, fwd_flops_per_example, peak, batch, note=None,
         "fwd_flops_per_example": fwd_flops_per_example,
         "train_flops_per_example_est": train_flops,
         "mfu_vs_bf16_peak": mfu,
+        "metrics": _metrics_snapshot(),
     }
     if timing:
         e["timing"] = timing

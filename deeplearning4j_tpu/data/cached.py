@@ -37,6 +37,14 @@ from deeplearning4j_tpu.data.iterator import DataSetIterator
 _ARRAYS = ("features", "labels", "features_mask", "labels_mask")
 
 
+def _cache_counter():
+    """The spine's cache family (lazy: data/ stays importable without
+    observe in odd partial checkouts)."""
+    from deeplearning4j_tpu.observe.metrics import registry
+
+    return registry().counter("dl4jtpu_data_cache_batches_total")
+
+
 class CachedDataSetIterator(DataSetIterator):
     """Cache a base iterator's batches to disk on the first pass, replay
     them via mmap afterwards.
@@ -94,6 +102,7 @@ class CachedDataSetIterator(DataSetIterator):
     def _replay(self) -> Iterator[DataSet]:
         n = int(self._manifest["n_batches"])
         present = self._manifest["arrays"]
+        counter = _cache_counter()
         for i in range(n):
             arrs = {}
             for name in _ARRAYS:
@@ -106,12 +115,14 @@ class CachedDataSetIterator(DataSetIterator):
                 else:
                     arrs[name] = None
             self.cache_hits += 1
+            counter.inc(source="cache")
             yield DataSet(arrs["features"], arrs["labels"],
                           arrs["features_mask"], arrs["labels_mask"])
 
     def _populate(self) -> Iterator[DataSet]:
         count = 0
         present: Optional[list] = None
+        counter = _cache_counter()
         for batch in self._base:
             arrs = {
                 "features": batch.features,
@@ -132,6 +143,7 @@ class CachedDataSetIterator(DataSetIterator):
                 np.save(self._batch_path(count, name),
                         np.asarray(arrs[name]))
             count += 1
+            counter.inc(source="decode")
             yield batch
         if count == 0:
             raise ValueError("base iterator yielded no batches to cache")

@@ -374,24 +374,31 @@ class GraphModel(Model):
         k = len(group)
         n_in = len(self.conf.network_inputs)
         n_out = len(self.conf.network_outputs)
-        feats = tuple(
-            jnp.stack([jnp.asarray(m.features[i]) for m in group])
-            for i in range(n_in)
-        )
-        labs = tuple(
-            jnp.stack([jnp.asarray(m.labels[i]) for m in group])
-            for i in range(n_out)
-        )
-        if getattr(self, "_multi_iter_dev", None) is None:
-            self._multi_iter_dev = jax.device_put(np.uint32(self.iteration))
-        with oom_report_scope():
-            (self.params, self.opt_state, self.net_state, losses,
-             self._multi_iter_dev) = step(
-                self.params, self.opt_state, self.net_state,
-                self._multi_iter_dev, feats, labs,
-            )
-        self.last_batch_size = group[-1].num_examples
-        self._finish_grouped_steps(losses, k)
+        with self._observe_step(k) as obs:
+            with oom_report_scope():
+                with obs.phase("host_stage"):
+                    feats = tuple(
+                        jnp.stack([jnp.asarray(m.features[i]) for m in group])
+                        for i in range(n_in)
+                    )
+                    labs = tuple(
+                        jnp.stack([jnp.asarray(m.labels[i]) for m in group])
+                        for i in range(n_out)
+                    )
+                    if getattr(self, "_multi_iter_dev", None) is None:
+                        self._multi_iter_dev = jax.device_put(
+                            np.uint32(self.iteration)
+                        )
+                with obs.phase("dispatch"):
+                    (self.params, self.opt_state, self.net_state, losses,
+                     self._multi_iter_dev) = step(
+                        self.params, self.opt_state, self.net_state,
+                        self._multi_iter_dev, feats, labs,
+                    )
+                with obs.phase("device_sync"):
+                    obs.sync(losses)
+            self.last_batch_size = group[-1].num_examples
+            self._finish_grouped_steps(losses, k)
 
     def _check_mds(self, mds) -> None:
         if len(mds.features) != len(self.conf.network_inputs):
@@ -423,22 +430,39 @@ class GraphModel(Model):
 
         from deeplearning4j_tpu.runtime.crash import oom_report_scope
 
-        with oom_report_scope(), active_mesh_scope(getattr(self, "_mesh", None)):
-            self.params, self.opt_state, self.net_state, loss = step(
-                self.params,
-                self.opt_state,
-                self.net_state,
-                jnp.uint32(self.iteration),
-                tuple(place_batch(self, f) for f in mds.features),
-                tuple(place_batch(self, l, is_label=True) for l in mds.labels),
-                tuple(place_batch(self, m, is_mask=True) for m in masks)
-                if masks is not None
-                else (),
-            )
-        self._last_score = loss
-        self.last_batch_size = mds.num_examples
-        self.iteration += 1
-        self._dispatch_iteration(loss)
+        with self._observe_step() as obs:
+            # staging stays INSIDE the oom/mesh scopes (a device OOM while
+            # placing the batch must still write the crash report)
+            with oom_report_scope(), active_mesh_scope(
+                getattr(self, "_mesh", None)
+            ):
+                with obs.phase("host_stage"):
+                    feats = tuple(place_batch(self, f) for f in mds.features)
+                    labs = tuple(
+                        place_batch(self, l, is_label=True)
+                        for l in mds.labels
+                    )
+                    lms = (
+                        tuple(
+                            place_batch(self, m, is_mask=True) for m in masks
+                        )
+                        if masks is not None else ()
+                    )
+                with obs.phase("dispatch"):
+                    self.params, self.opt_state, self.net_state, loss = step(
+                        self.params,
+                        self.opt_state,
+                        self.net_state,
+                        jnp.uint32(self.iteration),
+                        feats, labs, lms,
+                    )
+                with obs.phase("device_sync"):
+                    obs.sync(loss)
+            self._last_score = loss
+            self.last_batch_size = mds.num_examples
+            self.iteration += 1
+            with obs.phase("listeners"):
+                self._dispatch_iteration(loss)
 
     # -- layerwise unsupervised pretraining --------------------------------
     def pretrain(self, data, epochs: int = 1) -> None:

@@ -41,7 +41,17 @@ class Model:
         iterator-starvation tax (JPEG decode, tokenization, disk) is a
         first-class metric next to samples/sec instead of silently
         deflating it.  Near-zero when AsyncDataSetIterator's producer
-        keeps ahead of the device."""
+        keeps ahead of the device.  Each wait also lands on the
+        telemetry spine: the `dl4jtpu_etl_wait_seconds_total` counter
+        and, when tracing is on, an `etl_wait` span opening the step's
+        host timeline."""
+        from deeplearning4j_tpu.observe.metrics import registry
+        from deeplearning4j_tpu.observe.trace import tracer
+
+        reg = registry()
+        wait_total = reg.counter("dl4jtpu_etl_wait_seconds_total")
+        batches_total = reg.counter("dl4jtpu_etl_batches_total")
+        rec = tracer()
         it = iter(iterator)
         while True:
             t0 = time.perf_counter()
@@ -52,7 +62,20 @@ class Model:
             wait = time.perf_counter() - t0
             self.last_etl_wait_s = wait
             self.etl_wait_s += wait
+            wait_total.inc(wait)
+            batches_total.inc()
+            rec.add_complete("etl_wait", t0, wait, cat="step_phase")
             yield batch
+
+    def _observe_step(self, n_steps: int = 1):
+        """StepScope for the next dispatched step program: observes the
+        step-latency histogram always, and the per-phase host spans
+        (host_stage/dispatch/device_sync/listeners) when the global
+        tracer is enabled.  Every fit path wraps its program dispatch
+        in one of these."""
+        from deeplearning4j_tpu.observe.trace import step_scope
+
+        return step_scope(self, n_steps)
 
     def compile_stats(self) -> dict:
         """Compile-tax counters since this model was constructed, plus
@@ -86,18 +109,25 @@ class Model:
         windows or steps_per_execution groups): score/iteration update,
         and — only when listeners exist — ONE D2H transfer of all k losses
         followed by per-step dispatch with host scalars."""
+        from deeplearning4j_tpu.observe.trace import tracer
+
+        rec = tracer()
         self._last_score = losses   # (k,) device array; score_value reads [-1]
         self.iteration += k
         if self.listeners:
+            # no device_sync span here: every grouped caller already
+            # emitted one around obs.sync, and a second ~0us span would
+            # double-count the phase in the timeline
             host_losses = np.asarray(losses)
             self.iteration -= k
             done = 0
             try:
-                for w in range(k):
-                    self._last_score = host_losses[w]
-                    self.iteration += 1
-                    done += 1
-                    self._dispatch_iteration(host_losses[w])
+                with rec.span("listeners", cat="step_phase"):
+                    for w in range(k):
+                        self._last_score = host_losses[w]
+                        self.iteration += 1
+                        done += 1
+                        self._dispatch_iteration(host_losses[w])
             finally:
                 # a throwing listener must not leave the counter rewound —
                 # all k steps DID run on device

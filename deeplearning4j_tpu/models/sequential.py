@@ -411,19 +411,26 @@ class SequentialModel(Model):
                 "drop the masks or use schedule='gpipe' without masks"
             )
         step = self._get_step_fn_1f1b()
-        with oom_report_scope(), active_mesh_scope(self._mesh):
-            self.params, self.opt_state, self.net_state, loss = step(
-                self.params,
-                self.opt_state,
-                self.net_state,
-                jnp.uint32(self.iteration),
-                place_batch(self, batch.features),
-                place_batch(self, batch.labels, is_label=True),
-            )
-        self._last_score = loss
-        self.last_batch_size = batch.num_examples
-        self.iteration += 1
-        self._dispatch_iteration(loss)
+        with self._observe_step() as obs:
+            with oom_report_scope(), active_mesh_scope(self._mesh):
+                with obs.phase("host_stage"):
+                    feats = place_batch(self, batch.features)
+                    labs = place_batch(self, batch.labels, is_label=True)
+                with obs.phase("dispatch"):
+                    self.params, self.opt_state, self.net_state, loss = step(
+                        self.params,
+                        self.opt_state,
+                        self.net_state,
+                        jnp.uint32(self.iteration),
+                        feats, labs,
+                    )
+                with obs.phase("device_sync"):
+                    obs.sync(loss)
+            self._last_score = loss
+            self.last_batch_size = batch.num_examples
+            self.iteration += 1
+            with obs.phase("listeners"):
+                self._dispatch_iteration(loss)
 
     # -- pipeline parallelism ---------------------------------------------
     def _setup_pipeline(self, mesh, n_micro: int = 0,
@@ -806,23 +813,32 @@ class SequentialModel(Model):
         has_fmask = batch.features_mask is not None
         step = self._get_step_fn_compressed(has_lmask, has_fmask)
         empty = np.zeros((0,), np.float32)
-        with oom_report_scope(), active_mesh_scope(self._mesh):
-            (self.params, self.opt_state, self.net_state,
-             self._grad_residual, loss) = step(
-                self.params,
-                self.opt_state,
-                self.net_state,
-                self._grad_residual,
-                jnp.uint32(self.iteration),
-                place_batch(self, batch.features),
-                place_batch(self, batch.labels, is_label=True),
-                place_batch(self, batch.labels_mask, is_mask=True) if has_lmask else empty,
-                place_batch(self, batch.features_mask, is_mask=True) if has_fmask else empty,
-            )
-        self._last_score = loss
-        self.last_batch_size = batch.num_examples
-        self.iteration += 1
-        self._dispatch_iteration(loss)
+        with self._observe_step() as obs:
+            with oom_report_scope(), active_mesh_scope(self._mesh):
+                with obs.phase("host_stage"):
+                    feats = place_batch(self, batch.features)
+                    labs = place_batch(self, batch.labels, is_label=True)
+                    lm = (place_batch(self, batch.labels_mask, is_mask=True)
+                          if has_lmask else empty)
+                    fm = (place_batch(self, batch.features_mask, is_mask=True)
+                          if has_fmask else empty)
+                with obs.phase("dispatch"):
+                    (self.params, self.opt_state, self.net_state,
+                     self._grad_residual, loss) = step(
+                        self.params,
+                        self.opt_state,
+                        self.net_state,
+                        self._grad_residual,
+                        jnp.uint32(self.iteration),
+                        feats, labs, lm, fm,
+                    )
+                with obs.phase("device_sync"):
+                    obs.sync(loss)
+            self._last_score = loss
+            self.last_batch_size = batch.num_examples
+            self.iteration += 1
+            with obs.phase("listeners"):
+                self._dispatch_iteration(loss)
 
     def fit(self, data, epochs: int = 1, batch_size: int | None = None,
             steps_per_execution: int = 1) -> None:
@@ -973,18 +989,27 @@ class SequentialModel(Model):
         step = self._get_step_fn_tbptt_grouped()
         k = len(batches)
         W = T // self.conf.tbptt_length
-        feats = jnp.stack([jnp.asarray(b.features) for b in batches])
-        labs = jnp.stack([jnp.asarray(b.labels) for b in batches])
-        if getattr(self, "_multi_iter_dev", None) is None:
-            self._multi_iter_dev = jax.device_put(np.uint32(self.iteration))
-        with oom_report_scope():
-            (self.params, self.opt_state, self.net_state, losses,
-             self._multi_iter_dev) = step(
-                self.params, self.opt_state, self.net_state,
-                self._multi_iter_dev, feats, labs,
-            )
-        self.last_batch_size = batches[-1].num_examples
-        self._finish_grouped_steps(losses, k * W)
+        with self._observe_step(k * W) as obs:
+            with oom_report_scope():
+                with obs.phase("host_stage"):
+                    feats = jnp.stack(
+                        [jnp.asarray(b.features) for b in batches]
+                    )
+                    labs = jnp.stack([jnp.asarray(b.labels) for b in batches])
+                    if getattr(self, "_multi_iter_dev", None) is None:
+                        self._multi_iter_dev = jax.device_put(
+                            np.uint32(self.iteration)
+                        )
+                with obs.phase("dispatch"):
+                    (self.params, self.opt_state, self.net_state, losses,
+                     self._multi_iter_dev) = step(
+                        self.params, self.opt_state, self.net_state,
+                        self._multi_iter_dev, feats, labs,
+                    )
+                with obs.phase("device_sync"):
+                    obs.sync(losses)
+            self.last_batch_size = batches[-1].num_examples
+            self._finish_grouped_steps(losses, k * W)
         # the per-batch TBPTT path keeps its own device counter; resync
         self._tbptt_iter_dev = None
 
@@ -993,18 +1018,28 @@ class SequentialModel(Model):
 
         step = self._get_step_fn_multi()
         k = len(batches)
-        feats = jnp.stack([jnp.asarray(b.features) for b in batches])
-        labs = jnp.stack([jnp.asarray(b.labels) for b in batches])
-        if getattr(self, "_multi_iter_dev", None) is None:
-            self._multi_iter_dev = jax.device_put(np.uint32(self.iteration))
-        with oom_report_scope():
-            (self.params, self.opt_state, self.net_state, losses,
-             self._multi_iter_dev) = step(
-                self.params, self.opt_state, self.net_state,
-                self._multi_iter_dev, feats, labs,
-            )
-        self.last_batch_size = batches[-1].num_examples
-        self._finish_grouped_steps(losses, k)
+        with self._observe_step(k) as obs:
+            with oom_report_scope():
+                with obs.phase("host_stage"):
+                    feats = jnp.stack(
+                        [jnp.asarray(b.features) for b in batches]
+                    )
+                    labs = jnp.stack([jnp.asarray(b.labels) for b in batches])
+                    if getattr(self, "_multi_iter_dev", None) is None:
+                        self._multi_iter_dev = jax.device_put(
+                            np.uint32(self.iteration)
+                        )
+                with obs.phase("dispatch"):
+                    (self.params, self.opt_state, self.net_state, losses,
+                     self._multi_iter_dev) = step(
+                        self.params, self.opt_state, self.net_state,
+                        self._multi_iter_dev, feats, labs,
+                    )
+                with obs.phase("device_sync"):
+                    obs.sync(losses)
+            self.last_batch_size = batches[-1].num_examples
+            # listeners span lives in _finish_grouped_steps
+            self._finish_grouped_steps(losses, k)
 
     def fit_batch(self, batch: DataSet) -> None:
         if self.params is None:
@@ -1045,22 +1080,36 @@ class SequentialModel(Model):
         from deeplearning4j_tpu.runtime.crash import oom_report_scope
 
         empty = np.zeros((0,), np.float32)
-        with oom_report_scope(), active_mesh_scope(getattr(self, "_mesh", None)):
-            self.params, self.opt_state, self.net_state, loss, new_carries = step(
-                self.params,
-                self.opt_state,
-                self.net_state,
-                jnp.uint32(self.iteration),
-                place_batch(self, batch.features),
-                place_batch(self, batch.labels, is_label=True),
-                place_batch(self, batch.labels_mask, is_mask=True) if has_lmask else empty,
-                place_batch(self, batch.features_mask, is_mask=True) if has_fmask else empty,
-                carries if with_carries else {},
-            )
-        self._last_score = loss
-        self.last_batch_size = batch.num_examples
-        self.iteration += 1
-        self._dispatch_iteration(loss)
+        with self._observe_step() as obs:
+            # staging stays INSIDE the oom/mesh scopes (a device OOM while
+            # placing the batch must still write the crash report)
+            with oom_report_scope(), active_mesh_scope(
+                getattr(self, "_mesh", None)
+            ):
+                with obs.phase("host_stage"):
+                    feats = place_batch(self, batch.features)
+                    labs = place_batch(self, batch.labels, is_label=True)
+                    lm = (place_batch(self, batch.labels_mask, is_mask=True)
+                          if has_lmask else empty)
+                    fm = (place_batch(self, batch.features_mask, is_mask=True)
+                          if has_fmask else empty)
+                with obs.phase("dispatch"):
+                    (self.params, self.opt_state, self.net_state, loss,
+                     new_carries) = step(
+                        self.params,
+                        self.opt_state,
+                        self.net_state,
+                        jnp.uint32(self.iteration),
+                        feats, labs, lm, fm,
+                        carries if with_carries else {},
+                    )
+                with obs.phase("device_sync"):
+                    obs.sync(loss)
+            self._last_score = loss
+            self.last_batch_size = batch.num_examples
+            self.iteration += 1
+            with obs.phase("listeners"):
+                self._dispatch_iteration(loss)
         return new_carries
 
     def _fit_batch_tbptt(self, batch: DataSet) -> None:
@@ -1115,23 +1164,32 @@ class SequentialModel(Model):
         # device-resident step counter + cached empty: a tunneled chip pays
         # milliseconds per host->device transfer, so per-call traffic is
         # held to the batch handles alone
-        if getattr(self, "_tbptt_iter_dev", None) is None:
-            self._tbptt_iter_dev = jax.device_put(np.uint32(self.iteration))
-            self._empty_dev = jax.device_put(np.zeros((0,), np.float32))
-        with oom_report_scope():
-            (self.params, self.opt_state, self.net_state, losses,
-             carries, self._tbptt_iter_dev) = step(
-                self.params,
-                self.opt_state,
-                self.net_state,
-                self._tbptt_iter_dev,
-                batch.features,
-                batch.labels,
-                batch.labels_mask if has_lmask else self._empty_dev,
-                batch.features_mask if has_fmask else self._empty_dev,
-            )
-        self.last_batch_size = batch.num_examples
-        self._finish_grouped_steps(losses, W)
+        with self._observe_step(W) as obs:
+            with oom_report_scope():
+                with obs.phase("host_stage"):
+                    if getattr(self, "_tbptt_iter_dev", None) is None:
+                        self._tbptt_iter_dev = jax.device_put(
+                            np.uint32(self.iteration)
+                        )
+                        self._empty_dev = jax.device_put(
+                            np.zeros((0,), np.float32)
+                        )
+                with obs.phase("dispatch"):
+                    (self.params, self.opt_state, self.net_state, losses,
+                     carries, self._tbptt_iter_dev) = step(
+                        self.params,
+                        self.opt_state,
+                        self.net_state,
+                        self._tbptt_iter_dev,
+                        batch.features,
+                        batch.labels,
+                        batch.labels_mask if has_lmask else self._empty_dev,
+                        batch.features_mask if has_fmask else self._empty_dev,
+                    )
+                with obs.phase("device_sync"):
+                    obs.sync(losses)
+            self.last_batch_size = batch.num_examples
+            self._finish_grouped_steps(losses, W)
         if rem:
             tail = slice(W * L, T)
             window = DataSet(

@@ -1,0 +1,57 @@
+"""One telemetry spine — metrics registry, step tracing, numeric health.
+
+Three pillars, zero dependencies beyond the stdlib (jax is imported
+lazily and only where a signal actually comes from a device):
+
+- `observe.metrics`: a thread-safe process-global `MetricsRegistry`
+  (counters / gauges / fixed-bucket histograms) with Prometheus text
+  exposition.  Every existing silo feeds it — compile taxes
+  (`runtime/compile_stats.py`), ETL wait (the fit loops), disk batch
+  cache hits (`data/cached.py`), coordinator heartbeat ages, PJRT
+  memory — and `UIServer` serves it at ``GET /metrics``.
+- `observe.trace`: a ring-buffer span recorder emitting Chrome
+  trace-event JSON (Perfetto-loadable).  The fit loops instrument each
+  step as ``etl_wait -> host_stage -> dispatch -> device_sync ->
+  listeners`` — the host-side timeline the device profiler cannot see.
+  ``GET /api/trace`` on `UIServer` serves the current buffer.
+- `observe.health`: `HealthListener`, one jitted scalars-only
+  all-finite + global-norm reduction over params at a configurable
+  cadence; divergence events are counted, logged structurally, and
+  routed into `runtime/crash.py`'s report writer.
+
+    from deeplearning4j_tpu.observe import registry, tracer, HealthListener
+
+    model.add_listener(HealthListener(frequency=10))
+    tracer().enable()                      # opt-in step timeline
+    model.fit(data)
+    print(registry().to_prometheus_text()) # or scrape UIServer /metrics
+"""
+
+from deeplearning4j_tpu.observe.health import DivergenceError, HealthListener
+from deeplearning4j_tpu.observe.metrics import (
+    Counter,
+    Gauge,
+    Histogram,
+    MetricsRegistry,
+    registry,
+)
+from deeplearning4j_tpu.observe.trace import (
+    StepScope,
+    TraceRecorder,
+    step_scope,
+    tracer,
+)
+
+__all__ = [
+    "Counter",
+    "DivergenceError",
+    "Gauge",
+    "HealthListener",
+    "Histogram",
+    "MetricsRegistry",
+    "StepScope",
+    "TraceRecorder",
+    "registry",
+    "step_scope",
+    "tracer",
+]

@@ -1,0 +1,249 @@
+"""Numeric-health monitoring — notice divergence fast, with evidence.
+
+A whole-step-compiled stack fails QUIETLY: a NaN born inside the fused
+XLA program propagates through donated buffers for thousands of steps
+before anyone reads a score, and by then the checkpoint rotation may
+have overwritten the last healthy state.  `jax_debug_nans`
+(flags.nan_panic) catches it but deoptimizes every step; this module is
+the production-grade middle ground the reference's ND4J "NAN_PANIC"
+profiling mode never had.
+
+`HealthListener` runs ONE jitted scalars-only reduction over the param
+pytree at a configurable cadence: a non-finite element count, the global
+L2 norm, and (via a kept device copy of the previous monitored params,
+the same trick `StatsListener` uses for update ratios) the inter-check
+update norm |Δw|.  Three scalars cross the device boundary per check —
+no param downloads, no per-layer loops on the host.
+
+Divergence events (non-finite score, non-finite params, global-norm
+explosion vs the first healthy baseline) are:
+
+- counted in the metrics registry (`dl4jtpu_health_divergence_total`,
+  by kind) so ``/metrics`` alerts fire;
+- logged structurally (one JSON line on the package logger);
+- routed into `runtime/crash.py`'s report writer — the same
+  per-buffer-attribution report an OOM produces, headed by the event;
+- optionally raised (`raise_on_divergence=True`) to stop a doomed run.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import time
+from typing import Optional
+
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class DivergenceError(RuntimeError):
+    """Raised by HealthListener(raise_on_divergence=True) on a flagged
+    divergence event; `.event` carries the structured record."""
+
+    def __init__(self, event: dict):
+        super().__init__(
+            f"training diverged at iteration {event.get('iteration')}: "
+            f"{event.get('kind')} (score={event.get('score')}, "
+            f"global_norm={event.get('global_norm')})"
+        )
+        self.event = event
+
+
+def _build_health_fn(with_prev: bool, want_copy: bool):
+    """One jitted reduction: (nonfinite_count, global_norm, update_norm,
+    prev_copy) — ONE program dispatch per check, scalars-only transfers.
+    The previous-params copy for the next check's |Δw| is produced
+    INSIDE the program (jit outputs own fresh buffers, so the next
+    step's donation can't invalidate them) instead of a per-leaf host
+    loop of jnp.copy dispatches."""
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def health(params, prev):
+        leaves = jax.tree.leaves(params)
+        nonfinite = sum(
+            jnp.sum(~jnp.isfinite(l.astype(jnp.float32))) for l in leaves
+        ) if leaves else jnp.int32(0)
+        sq = sum(
+            jnp.sum(jnp.square(l.astype(jnp.float32))) for l in leaves
+        ) if leaves else jnp.float32(0)
+        gnorm = jnp.sqrt(sq)
+        if with_prev:
+            pleaves = jax.tree.leaves(prev)
+            dsq = sum(
+                jnp.sum(jnp.square(
+                    a.astype(jnp.float32) - b.astype(jnp.float32)
+                ))
+                for a, b in zip(leaves, pleaves)
+            ) if leaves else jnp.float32(0)
+            unorm = jnp.sqrt(dsq)
+        else:
+            unorm = jnp.float32(-1.0)
+        copies = jax.tree.map(jnp.copy, params) if want_copy else 0
+        return nonfinite, gnorm, unorm, copies
+
+    return health
+
+
+class HealthListener(TrainingListener):
+    """Per-step numeric-health watchdog on the TrainingListener SPI.
+
+    frequency: check every N iterations (1 = every step; the check is
+      one compiled reduction + 3 scalar transfers, cheap enough for 1 on
+      small models, 10+ recommended for the big ones).
+    track_updates: keep a device copy of the previous monitored params
+      for the |Δw| norm (costs one params-sized HBM buffer, same as
+      StatsListener's update ratios; off for memory-tight runs).
+    norm_explosion_factor: flag when the global param norm exceeds this
+      multiple of the first healthy baseline norm.
+    raise_on_divergence: raise DivergenceError instead of just
+      recording/logging/reporting.
+    write_reports: route events into runtime/crash.py's report writer
+      (at most `max_reports` files per listener).
+    """
+
+    def __init__(self, frequency: int = 10, track_updates: bool = True,
+                 norm_explosion_factor: float = 100.0,
+                 raise_on_divergence: bool = False,
+                 write_reports: bool = True, max_reports: int = 3):
+        self.frequency = max(1, frequency)
+        self.track_updates = track_updates
+        self.norm_explosion_factor = float(norm_explosion_factor)
+        self.raise_on_divergence = raise_on_divergence
+        self.write_reports = write_reports
+        self.max_reports = max_reports
+        self.events: list[dict] = []
+        self.report_paths: list[str] = []
+        self.baseline_norm: Optional[float] = None
+        self.last_global_norm: Optional[float] = None
+        self.last_update_norm: Optional[float] = None
+        self._prev_params = None
+        self._last_seen_params = None
+        self._fns: dict[tuple, object] = {}
+
+    # -- the check ---------------------------------------------------------
+    def iteration_done(self, model, iteration, epoch, score):
+        if iteration % self.frequency:
+            return
+        import math
+
+        if model.params is self._last_seen_params:
+            # grouped programs (steps_per_execution / TBPTT windows)
+            # dispatch k listener calls after ONE device update;
+            # re-running the reduction on the identical param state would
+            # waste a dispatch and clobber the |Δw| gauge with ~0.  The
+            # per-step SCORE is still distinct (one host scalar per step
+            # of the group) — keep watching it.
+            score_f = float(score)
+            if math.isfinite(score_f):
+                return
+            from deeplearning4j_tpu.observe.metrics import registry as _reg
+
+            self._flag(model, iteration, epoch, "nonfinite_score", score_f,
+                       self.last_global_norm, self.last_update_norm, 0,
+                       _reg())
+            return
+        self._last_seen_params = model.params
+
+        from deeplearning4j_tpu.observe.metrics import registry
+        from deeplearning4j_tpu.observe.trace import tracer
+
+        reg = registry()
+        with tracer().span("health_check", cat="health"):
+            import jax
+
+            with_prev = self.track_updates and self._prev_params is not None
+            key = (with_prev, self.track_updates)
+            fn = self._fns.get(key)
+            if fn is None:
+                fn = self._fns[key] = _build_health_fn(
+                    with_prev, self.track_updates
+                )
+            nonfinite, gnorm, unorm, copies = fn(
+                model.params,
+                self._prev_params if with_prev else model.params,
+            )
+            if self.track_updates:
+                self._prev_params = copies
+            # one batched transfer for the three scalars, not three syncs
+            nonfinite, gnorm, unorm = (
+                v.item() for v in jax.device_get((nonfinite, gnorm, unorm))
+            )
+            nonfinite = int(nonfinite)
+            unorm = float(unorm) if with_prev else None
+            score_f = float(score)
+        reg.counter("dl4jtpu_health_checks_total").inc()
+        reg.gauge("dl4jtpu_health_param_global_norm").set(gnorm)
+        if unorm is not None:
+            reg.gauge("dl4jtpu_health_update_norm").set(unorm)
+        self.last_global_norm = gnorm
+        self.last_update_norm = unorm
+
+        kind = None
+        if not math.isfinite(score_f):
+            kind = "nonfinite_score"
+        elif nonfinite > 0:
+            kind = "nonfinite_params"
+        elif (
+            self.baseline_norm is not None
+            and math.isfinite(gnorm)
+            and gnorm > self.norm_explosion_factor
+            * max(self.baseline_norm, 1e-12)
+        ):
+            kind = "norm_explosion"
+        if kind is None:
+            if self.baseline_norm is None and math.isfinite(gnorm):
+                self.baseline_norm = gnorm
+            return
+        self._flag(model, iteration, epoch, kind, score_f, gnorm, unorm,
+                   nonfinite, reg)
+
+    @staticmethod
+    def _json_safe(v):
+        """Non-finite floats become strings — json.dumps would emit bare
+        NaN/Infinity (invalid JSON) exactly in the records that matter."""
+        import math
+
+        if v is None or (isinstance(v, float) and math.isfinite(v)):
+            return v
+        if isinstance(v, float):
+            return repr(v)
+        return v
+
+    def _flag(self, model, iteration, epoch, kind, score, gnorm, unorm,
+              nonfinite, reg) -> None:
+        event = {
+            "kind": kind,
+            "iteration": int(iteration),
+            "epoch": int(epoch),
+            "score": self._json_safe(score),
+            "global_norm": self._json_safe(gnorm),
+            "update_norm": self._json_safe(unorm),
+            "nonfinite_param_elements": nonfinite,
+            "baseline_norm": self.baseline_norm,
+            "norm_explosion_factor": self.norm_explosion_factor,
+            "time": time.time(),
+            "model": type(model).__name__,
+        }
+        self.events.append(event)
+        reg.counter("dl4jtpu_health_divergence_total").inc(kind=kind)
+        log.error("DIVERGENCE %s", json.dumps(event, sort_keys=True))
+        if self.write_reports and len(self.report_paths) < self.max_reports:
+            from deeplearning4j_tpu.runtime import crash
+
+            try:
+                self.report_paths.append(
+                    crash.write_divergence_report(event)
+                )
+            except Exception:
+                # reporting must never take down the training loop
+                log.exception("divergence report write failed")
+        if self.raise_on_divergence:
+            raise DivergenceError(event)
+
+    @property
+    def diverged(self) -> bool:
+        return bool(self.events)

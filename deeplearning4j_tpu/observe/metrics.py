@@ -1,0 +1,446 @@
+"""MetricsRegistry — the one telemetry spine every signal lands on.
+
+PR 1 left high-value counters scattered across silos: `runtime/
+compile_stats.py` keeps compile-tax integers, the fit loops meter
+ETL-wait, `CachedDataSetIterator` counts cache hits, the coordinator
+knows heartbeat ages, PJRT knows HBM occupancy.  Each had its own ad-hoc
+accessor and NO common scrape path — exactly the gap the TensorFlow
+system paper calls out by making monitoring a first-class subsystem.
+
+This module is the fix: a thread-safe, process-global registry of
+**counters** (monotonic), **gauges** (set-to-current) and **fixed-bucket
+histograms**, zero dependencies beyond the stdlib, with Prometheus text
+exposition (served by `UIServer` at ``GET /metrics``) and a dict
+`snapshot()` (dumped into bench rows and logs).
+
+Two ways signals arrive:
+
+- **push**: hot paths call `counter.inc()` / `hist.observe()` directly
+  (ETL wait, step latency, cache hits, health checks).  Cost: one lock
+  acquire + an add — noise next to a training step.
+- **collectors**: pull-style sources (compile_stats, PJRT memory,
+  coordinator membership) register a callback that refreshes their
+  families at scrape/snapshot time, so idle processes pay nothing.
+
+Metric families are pre-declared at registry creation, so a fresh
+process's ``/metrics`` already exposes every core family (at zero) —
+dashboards and alerts can be written before the first divergence.
+
+    from deeplearning4j_tpu.observe import registry
+    reg = registry()
+    reg.counter("dl4jtpu_my_events_total", "what it counts").inc()
+    print(reg.to_prometheus_text())
+"""
+
+from __future__ import annotations
+
+import bisect
+import threading
+from typing import Callable, Optional, Sequence
+
+# Default latency buckets (seconds) — spans sub-ms CPU steps to
+# multi-second cold-compile steps on a tunneled chip.
+DEFAULT_LATENCY_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1,
+    0.25, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+)
+
+_RESERVED_LABELS = ("le",)
+
+
+def _escape_label(v: str) -> str:
+    return (
+        str(v).replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+    )
+
+
+def _fmt(v: float) -> str:
+    """Prometheus-friendly number formatting (ints stay ints).  Handles
+    non-finite values with the text format's literals — a diverged run
+    sets the health gauges to NaN, and the scrape that matters most must
+    not 500 on it."""
+    f = float(v)
+    if f != f:
+        return "NaN"
+    if f == float("inf"):
+        return "+Inf"
+    if f == float("-inf"):
+        return "-Inf"
+    if f == int(f) and abs(f) < 1e15:
+        return str(int(f))
+    return repr(f)
+
+
+def _series_key(labels: dict) -> tuple:
+    return tuple(sorted(labels.items()))
+
+
+def _render_labels(key: tuple, extra: str = "") -> str:
+    parts = [f'{k}="{_escape_label(v)}"' for k, v in key]
+    if extra:
+        parts.append(extra)
+    return "{" + ",".join(parts) + "}" if parts else ""
+
+
+class _Metric:
+    """Common family plumbing: name, help, label-keyed series."""
+
+    kind = "untyped"
+
+    def __init__(self, name: str, help: str, lock: threading.Lock):
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self._series: dict[tuple, float] = {}
+
+    def _key(self, labels: dict) -> tuple:
+        for k in labels:
+            if k in _RESERVED_LABELS:
+                raise ValueError(f"label name {k!r} is reserved")
+        return _series_key(labels)
+
+    def value(self, **labels) -> float:
+        with self._lock:
+            return self._series.get(self._key(labels), 0.0)
+
+    def expose(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} {self.kind}",
+        ]
+        with self._lock:
+            for key in sorted(self._series):
+                lines.append(
+                    f"{self.name}{_render_labels(key)} "
+                    f"{_fmt(self._series[key])}"
+                )
+        return lines
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            if set(self._series) == {()}:
+                return {"value": self._series[()]}
+            return {
+                "series": {
+                    _render_labels(k) or "": v
+                    for k, v in sorted(self._series.items())
+                }
+            }
+
+
+class Counter(_Metric):
+    """Monotonic counter; `inc(amount)` only goes up."""
+
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        if amount < 0:
+            raise ValueError(f"counter {self.name} cannot decrease "
+                             f"(inc {amount})")
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def set_total(self, value: float, **labels) -> None:
+        """Set the cumulative total directly — for COLLECTORS bridging an
+        external monotonic source (compile_stats) whose own counter is
+        the ground truth.  Never goes backwards."""
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = max(self._series.get(key, 0.0), float(value))
+
+
+class Gauge(_Metric):
+    """Set-to-current-value metric (memory in use, heartbeat age...)."""
+
+    kind = "gauge"
+
+    def set(self, value: float, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = float(value)
+
+    def inc(self, amount: float = 1.0, **labels) -> None:
+        key = self._key(labels)
+        with self._lock:
+            self._series[key] = self._series.get(key, 0.0) + amount
+
+    def remove(self, **labels) -> None:
+        with self._lock:
+            self._series.pop(self._key(labels), None)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._series.clear()
+
+
+class Histogram:
+    """Fixed-bucket histogram (cumulative buckets + sum + count), the
+    Prometheus layout: `name_bucket{le="x"}`, `name_sum`, `name_count`."""
+
+    kind = "histogram"
+
+    def __init__(self, name: str, help: str, lock: threading.Lock,
+                 buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS):
+        if not buckets or list(buckets) != sorted(buckets):
+            raise ValueError("histogram buckets must be a sorted non-empty "
+                             "sequence of upper bounds")
+        self.name = name
+        self.help = help
+        self._lock = lock
+        self.buckets = tuple(float(b) for b in buckets)
+        self._counts = [0] * (len(self.buckets) + 1)  # +1 for +Inf
+        self._sum = 0.0
+        self._count = 0
+
+    def observe(self, value: float) -> None:
+        v = float(value)
+        i = bisect.bisect_left(self.buckets, v)
+        with self._lock:
+            self._counts[i] += 1
+            self._sum += v
+            self._count += 1
+
+    @property
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    @property
+    def sum(self) -> float:
+        with self._lock:
+            return self._sum
+
+    def expose(self) -> list[str]:
+        lines = [
+            f"# HELP {self.name} {self.help}",
+            f"# TYPE {self.name} histogram",
+        ]
+        with self._lock:
+            acc = 0
+            for b, c in zip(self.buckets, self._counts):
+                acc += c
+                lines.append(
+                    f'{self.name}_bucket{{le="{_fmt(b)}"}} {acc}'
+                )
+            acc += self._counts[-1]
+            lines.append(f'{self.name}_bucket{{le="+Inf"}} {acc}')
+            lines.append(f"{self.name}_sum {_fmt(self._sum)}")
+            lines.append(f"{self.name}_count {self._count}")
+        return lines
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {
+                "count": self._count,
+                "sum": round(self._sum, 6),
+                "buckets": {
+                    _fmt(b): c for b, c in zip(self.buckets, self._counts)
+                    if c
+                },
+            }
+
+
+class MetricsRegistry:
+    """Thread-safe family registry + collector hooks + exposition."""
+
+    def __init__(self):
+        self._lock = threading.Lock()          # registry structure
+        self._metrics: dict[str, object] = {}  # name -> metric family
+        self._collectors: list[Callable[[], None]] = []
+
+    # -- family creation (idempotent: same name returns the same object) --
+    def _get_or_create(self, cls, name: str, help: str, **kw):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is not None:
+                if not isinstance(m, cls):
+                    raise TypeError(
+                        f"metric {name!r} already registered as "
+                        f"{type(m).__name__}, not {cls.__name__}"
+                    )
+                want = kw.get("buckets")
+                if want is not None and tuple(
+                    float(b) for b in want
+                ) != m.buckets:
+                    # silently returning the old boundaries would put
+                    # observations in buckets the caller believes don't
+                    # exist
+                    raise ValueError(
+                        f"histogram {name!r} already registered with "
+                        f"buckets {m.buckets}, requested {tuple(want)}"
+                    )
+                return m
+            # per-family lock: hot-path incs never contend with registry
+            # structure changes or other families
+            m = cls(name, help, threading.Lock(), **kw)
+            self._metrics[name] = m
+            return m
+
+    def counter(self, name: str, help: str = "") -> Counter:
+        return self._get_or_create(Counter, name, help)
+
+    def gauge(self, name: str, help: str = "") -> Gauge:
+        return self._get_or_create(Gauge, name, help)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: Sequence[float] = DEFAULT_LATENCY_BUCKETS
+                  ) -> Histogram:
+        return self._get_or_create(Histogram, name, help, buckets=buckets)
+
+    # -- collectors --------------------------------------------------------
+    def register_collector(self, fn: Callable[[], None]) -> None:
+        """Register a callback run before every exposition/snapshot; pull
+        sources refresh their gauges there.  A collector that raises is
+        dropped from the run, never breaks the scrape."""
+        with self._lock:
+            if fn not in self._collectors:
+                self._collectors.append(fn)
+
+    def unregister_collector(self, fn: Callable[[], None]) -> None:
+        with self._lock:
+            if fn in self._collectors:
+                self._collectors.remove(fn)
+
+    def collect(self) -> None:
+        with self._lock:
+            collectors = list(self._collectors)
+        for fn in collectors:
+            try:
+                fn()
+            except Exception:
+                # a broken pull source must not take down the scrape path
+                continue
+
+    # -- exposition --------------------------------------------------------
+    def to_prometheus_text(self) -> str:
+        """Prometheus text exposition format 0.0.4 of every family
+        (collectors refreshed first).  Families with no samples yet still
+        emit HELP/TYPE so scrapers see the full schema from step 0."""
+        self.collect()
+        with self._lock:
+            metrics = [self._metrics[n] for n in sorted(self._metrics)]
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.expose())
+        return "\n".join(lines) + "\n"
+
+    def snapshot(self, prefixes: Optional[Sequence[str]] = None) -> dict:
+        """{family_name: {value|series|histogram}} dict of current state
+        (collectors refreshed); `prefixes` filters family names."""
+        self.collect()
+        with self._lock:
+            metrics = dict(self._metrics)
+        out = {}
+        for name in sorted(metrics):
+            if prefixes is not None and not any(
+                name.startswith(p) for p in prefixes
+            ):
+                continue
+            out[name] = metrics[name].snapshot()
+        return out
+
+
+# -- process-global registry ----------------------------------------------
+
+_REGISTRY: Optional[MetricsRegistry] = None
+_REGISTRY_LOCK = threading.Lock()
+
+
+def registry() -> MetricsRegistry:
+    """The process-global registry, core families pre-declared and the
+    default pull collectors (compile stats, device memory) installed."""
+    global _REGISTRY
+    with _REGISTRY_LOCK:
+        if _REGISTRY is None:
+            reg = MetricsRegistry()
+            _declare_core(reg)
+            reg.register_collector(_compile_stats_collector)
+            reg.register_collector(_device_memory_collector)
+            _REGISTRY = reg
+    return _REGISTRY
+
+
+def _declare_core(reg: MetricsRegistry) -> None:
+    """Pre-declare the spine's metric families: a fresh process's
+    /metrics shows the full schema before the first step runs."""
+    # compile taxes (bridged from runtime/compile_stats.py)
+    reg.counter("dl4jtpu_compile_jit_cache_misses_total",
+                "Fresh jit traces (one per distinct step signature)")
+    reg.counter("dl4jtpu_compile_backend_compiles_total",
+                "XLA compile requests, incl. persistent-cache retrievals")
+    reg.counter("dl4jtpu_compile_seconds_total",
+                "Wall seconds inside XLA compilation / cache retrieval")
+    reg.counter("dl4jtpu_compile_persistent_cache_hits_total",
+                "Programs served from the on-disk compile cache")
+    reg.counter("dl4jtpu_compile_persistent_cache_puts_total",
+                "Programs written to the on-disk compile cache")
+    reg.counter("dl4jtpu_compile_seconds_saved_total",
+                "Compile seconds the persistent cache avoided")
+    # ETL feed
+    reg.counter("dl4jtpu_etl_wait_seconds_total",
+                "Seconds fit() sat blocked on the input iterator")
+    reg.counter("dl4jtpu_etl_batches_total",
+                "Batches pulled through the fit loops' timed feed")
+    # disk batch cache (data/cached.py)
+    reg.counter("dl4jtpu_data_cache_batches_total",
+                "Batches served by CachedDataSetIterator, by source "
+                "(cache=mmap replay, decode=base-pipeline population)")
+    # step engine
+    reg.histogram("dl4jtpu_step_latency_seconds",
+                  "Host wall time per dispatched training-step program "
+                  "(grouped programs observe once for k steps)")
+    reg.counter("dl4jtpu_train_steps_total",
+                "Optimizer steps run (grouped programs count k)")
+    # numeric health (observe/health.py)
+    reg.counter("dl4jtpu_health_checks_total",
+                "HealthListener monitored steps")
+    reg.counter("dl4jtpu_health_divergence_total",
+                "Divergence events flagged, by kind")
+    reg.gauge("dl4jtpu_health_param_global_norm",
+              "Last measured global L2 norm of all params")
+    reg.gauge("dl4jtpu_health_update_norm",
+              "Last measured global L2 norm of the param delta |w_t - "
+              "w_{t-1}| between monitored steps")
+    # device memory (PJRT; collector-set)
+    reg.gauge("dl4jtpu_device_bytes_in_use",
+              "PJRT bytes currently allocated on device 0")
+    reg.gauge("dl4jtpu_device_peak_bytes_in_use",
+              "PJRT peak bytes allocated on device 0")
+
+
+def _compile_stats_collector() -> None:
+    """Bridge runtime/compile_stats.py process-global counters into the
+    registry (set_total: compile_stats is the ground truth)."""
+    from deeplearning4j_tpu.runtime import compile_stats
+
+    snap = compile_stats.snapshot()
+    reg = registry()
+    for family, value in (
+        ("dl4jtpu_compile_jit_cache_misses_total", snap.jit_cache_misses),
+        ("dl4jtpu_compile_backend_compiles_total", snap.backend_compiles),
+        ("dl4jtpu_compile_seconds_total", snap.compile_secs),
+        ("dl4jtpu_compile_persistent_cache_hits_total",
+         snap.persistent_cache_hits),
+        ("dl4jtpu_compile_persistent_cache_puts_total",
+         snap.persistent_cache_puts),
+        ("dl4jtpu_compile_seconds_saved_total", snap.compile_secs_saved),
+    ):
+        reg.counter(family).set_total(value)
+
+
+def _device_memory_collector() -> None:
+    """PJRT memory stats for device 0 (no-op on backends that don't
+    report, e.g. CPU)."""
+    from deeplearning4j_tpu.ui.stats import device_memory_stats
+
+    stats = device_memory_stats()
+    if not stats:
+        return
+    reg = registry()
+    if "bytes_in_use" in stats:
+        reg.gauge("dl4jtpu_device_bytes_in_use").set(stats["bytes_in_use"])
+    if "peak_bytes_in_use" in stats:
+        reg.gauge("dl4jtpu_device_peak_bytes_in_use").set(
+            stats["peak_bytes_in_use"]
+        )

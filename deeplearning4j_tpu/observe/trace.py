@@ -1,0 +1,267 @@
+"""Step-timeline tracing — the host-side half Perfetto cannot see.
+
+`jax.profiler` (ui/profiler.py) captures the DEVICE timeline: per-op HLO
+time, HBM traffic.  What it cannot show is where the HOST spends the
+step: blocked on the input iterator, staging batches, dispatching the
+program down the (possibly tunneled) PJRT link, or syncing on results.
+PROFILE.md could only ESTIMATE that gap (~7% on the ResNet config, from
+bench-wall minus device-time); this module measures it.
+
+`TraceRecorder` is a low-overhead ring-buffer span store (fixed
+capacity, oldest spans evicted) with a context-manager + decorator API,
+emitting Chrome trace-event JSON (`chrome://tracing` / Perfetto `Load
+trace`).  Disabled (the default) it costs one attribute check per
+call site; enabled it costs two `perf_counter` reads and a deque append
+per span — no locks on the hot path beyond the GIL-atomic append.
+
+The fit loops of `Model`/`SequentialModel`/`GraphModel` instrument each
+step with five spans: ``etl_wait`` -> ``host_stage`` -> ``dispatch`` ->
+``device_sync`` -> ``listeners``.  `device_sync` blocks on the step's
+loss scalar ONLY while tracing is enabled, so the default (untraced)
+path keeps full host/device overlap.
+
+    from deeplearning4j_tpu.observe import tracer
+    t = tracer(); t.enable()
+    model.fit(data, epochs=1)
+    t.save("/tmp/step_timeline.json")      # open in Perfetto
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from functools import wraps
+from typing import Optional
+
+
+class _NullSpan:
+    """Shared no-op context manager — the disabled-tracer fast path."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL_SPAN = _NullSpan()
+
+
+class _Span:
+    __slots__ = ("_rec", "name", "cat", "args", "_t0")
+
+    def __init__(self, rec: "TraceRecorder", name: str, cat: str, args):
+        self._rec = rec
+        self.name = name
+        self.cat = cat
+        self.args = args
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self._rec.add_complete(
+            self.name, self._t0, time.perf_counter() - self._t0,
+            cat=self.cat, **(self.args or {}),
+        )
+        return False
+
+
+class TraceRecorder:
+    """Ring buffer of completed spans, Chrome trace-event JSON out."""
+
+    def __init__(self, capacity: int = 16384):
+        self.capacity = int(capacity)
+        self._spans: deque = deque(maxlen=self.capacity)
+        self._enabled = False
+        self._pid = os.getpid()
+
+    # -- control -----------------------------------------------------------
+    @property
+    def enabled(self) -> bool:
+        return self._enabled
+
+    def enable(self, capacity: Optional[int] = None) -> "TraceRecorder":
+        if capacity is not None and capacity != self.capacity:
+            self.capacity = int(capacity)
+            self._spans = deque(self._spans, maxlen=self.capacity)
+        self._enabled = True
+        return self
+
+    def disable(self) -> "TraceRecorder":
+        self._enabled = False
+        return self
+
+    def clear(self) -> None:
+        self._spans.clear()
+
+    def __len__(self) -> int:
+        return len(self._spans)
+
+    # -- recording ---------------------------------------------------------
+    def span(self, name: str, cat: str = "step", **args):
+        """Context manager recording one complete ("X") span.  Returns a
+        shared no-op when disabled — call sites don't branch."""
+        if not self._enabled:
+            return _NULL_SPAN
+        return _Span(self, name, cat, args)
+
+    def add_complete(self, name: str, t0: float, dur: float,
+                     cat: str = "step", **args) -> None:
+        """Record an already-measured span (t0/dur in perf_counter
+        seconds) — for call sites that timed the work themselves (the
+        fit loops' ETL-wait accounting)."""
+        if not self._enabled:
+            return
+        # deque.append is GIL-atomic; no lock on the hot path
+        self._spans.append((
+            name, cat, t0, dur, threading.get_ident(), args or None,
+        ))
+
+    def traced(self, name: Optional[str] = None, cat: str = "func"):
+        """Decorator form: `@tracer().traced()` wraps a function in a
+        span named after it."""
+        def deco(fn):
+            span_name = name or fn.__qualname__
+
+            @wraps(fn)
+            def wrapper(*a, **kw):
+                if not self._enabled:
+                    return fn(*a, **kw)
+                with self.span(span_name, cat=cat):
+                    return fn(*a, **kw)
+
+            return wrapper
+
+        return deco
+
+    # -- exposition --------------------------------------------------------
+    def to_chrome_trace(self) -> dict:
+        """Chrome trace-event JSON object (the Perfetto-loadable schema:
+        phase "X" complete events, microsecond timestamps)."""
+        events = []
+        for name, cat, t0, dur, tid, args in list(self._spans):
+            ev = {
+                "name": name,
+                "cat": cat,
+                "ph": "X",
+                "ts": round(t0 * 1e6, 3),
+                "dur": round(dur * 1e6, 3),
+                "pid": self._pid,
+                "tid": tid,
+            }
+            if args:
+                ev["args"] = args
+            events.append(ev)
+        events.sort(key=lambda e: e["ts"])
+        return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            json.dump(self.to_chrome_trace(), f)
+        return path
+
+
+# -- process-global recorder ------------------------------------------------
+
+_TRACER: Optional[TraceRecorder] = None
+_TRACER_LOCK = threading.Lock()
+
+
+def tracer() -> TraceRecorder:
+    """The process-global recorder (created disabled)."""
+    global _TRACER
+    with _TRACER_LOCK:
+        if _TRACER is None:
+            _TRACER = TraceRecorder()
+    return _TRACER
+
+
+# -- fit-loop step instrumentation ------------------------------------------
+
+_STEP_FAMILIES = None
+
+
+def _step_families():
+    """(histogram, counter) for the step engine, resolved once — the
+    per-step path must not pay registry lookups/locks."""
+    global _STEP_FAMILIES
+    if _STEP_FAMILIES is None:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        reg = registry()
+        _STEP_FAMILIES = (
+            reg.histogram("dl4jtpu_step_latency_seconds"),
+            reg.counter("dl4jtpu_train_steps_total"),
+        )
+    return _STEP_FAMILIES
+
+
+class StepScope:
+    """One training-step-program observation: a context manager the fit
+    loops wrap each dispatched program in.
+
+    - always: observes `dl4jtpu_step_latency_seconds` (host wall per
+      program) and `dl4jtpu_train_steps_total` (+n_steps) — the scrape
+      path's step-rate signal costs two perf_counter reads per program;
+    - tracing enabled: `.phase(name)` sub-spans land in the ring buffer
+      and `.sync(x)` blocks on the step's output so `device_sync` is a
+      real measured span instead of async-dispatch noise.
+    """
+
+    __slots__ = ("_rec", "_hist", "_steps", "_n", "_iteration", "_t0",
+                 "_dispatched")
+
+    def __init__(self, iteration: int, n_steps: int = 1):
+        self._rec = tracer()
+        self._hist, self._steps = _step_families()
+        self._n = n_steps
+        self._iteration = iteration
+        self._dispatched = False
+
+    def __enter__(self) -> "StepScope":
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dur = time.perf_counter() - self._t0
+        failed = bool(exc) and exc[0] is not None
+        if not failed or self._dispatched:
+            # count a step once its program reached the device (sync()
+            # ran): a listener throwing AFTER the update (DivergenceError)
+            # must not make /metrics disagree with model.iteration.  A
+            # pre-sync failure (OOM mid-dispatch) is NOT an optimizer
+            # step and stays out of the counter and the histogram.
+            self._hist.observe(dur)
+            self._steps.inc(self._n)
+        args = {"iteration": self._iteration, "n_steps": self._n}
+        if failed:
+            args["error"] = exc[0].__name__
+        self._rec.add_complete("train_step", self._t0, dur, cat="step",
+                               **args)
+        return False
+
+    def phase(self, name: str):
+        return self._rec.span(name, cat="step_phase")
+
+    def sync(self, x) -> None:
+        """Block until the step's outputs are ready — ONLY while tracing
+        (the untraced path must keep host/device dispatch overlap).
+        Reaching sync() marks the program as dispatched: later failures
+        (a throwing listener) no longer void the step metrics."""
+        self._dispatched = True
+        if self._rec.enabled and x is not None:
+            import jax
+
+            jax.block_until_ready(x)
+
+
+def step_scope(model, n_steps: int = 1) -> StepScope:
+    """StepScope for a model's next dispatched program."""
+    return StepScope(getattr(model, "iteration", 0), n_steps)

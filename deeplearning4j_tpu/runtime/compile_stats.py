@@ -27,6 +27,11 @@ unregister).  Consumers take a `snapshot()` and subtract:
 
 `PerformanceListener` / `StatsListener` surface these per fit/record;
 `Model.compile_stats()` adds the per-model distinct-program count.
+
+These counters also feed the telemetry spine: `observe.metrics` bridges
+every field into `dl4jtpu_compile_*` Prometheus families at scrape time
+(see `observe.metrics._compile_stats_collector`), so ``GET /metrics`` on
+the UIServer carries the compile taxes without any per-step push cost.
 """
 
 from __future__ import annotations

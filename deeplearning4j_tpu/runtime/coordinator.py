@@ -104,17 +104,95 @@ class CoordinatorServer:
             threading.Thread(target=self._monitor, daemon=True),
         ]
         self._stopped = False
+        self._metrics_collector = None
+        self._metrics_cleanup = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "CoordinatorServer":
         for t in self._threads:
             t.start()
+        self._register_metrics()
         return self
 
     def stop(self) -> None:
         self._stopped = True
+        if self._metrics_collector is not None:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().unregister_collector(self._metrics_collector)
+            self._metrics_collector = None
+            if self._metrics_cleanup is not None:
+                # drop this server's series instead of freezing them at
+                # their last values — a heartbeat-age alert must not stay
+                # quiet because a dead coordinator still exports a small
+                # stale age
+                self._metrics_cleanup()
+                self._metrics_cleanup = None
         self._server.shutdown()
         self._server.server_close()
+
+    def _register_metrics(self) -> None:
+        """Publish cluster health into the telemetry spine: per-worker
+        heartbeat age (the 'notice it fast' gauge — an alert on
+        `heartbeat_age > timeout/2` fires BEFORE the eviction does),
+        membership counts, generation, and the eviction total.  Pull
+        style: gauges refresh at scrape time; an idle cluster costs
+        nothing.  stop() unregisters the collector."""
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        reg = registry()
+        age = reg.gauge(
+            "dl4jtpu_coordinator_heartbeat_age_seconds",
+            "Seconds since each member's last heartbeat",
+        )
+        members = reg.gauge(
+            "dl4jtpu_coordinator_members", "Sealed members this generation"
+        )
+        gen = reg.gauge(
+            "dl4jtpu_coordinator_generation", "Current cluster generation"
+        )
+        evict = reg.counter(
+            "dl4jtpu_coordinator_evictions_total", "Workers evicted"
+        )
+
+        seen: set = set()
+        # concurrent scrapes (UIServer is threaded) run this collector
+        # concurrently; the read-modify-write on `seen` must not interleave
+        collect_lock = threading.Lock()
+
+        def collect() -> None:
+            if self._stopped:
+                return
+            now = time.time()
+            with self._lock:
+                ages = {
+                    wid: now - m["last_hb"] for wid, m in self.members.items()
+                }
+                n, g, ev = len(self.members), self.generation, len(self.evictions)
+            with collect_lock:
+                # remove only THIS server's departed workers — clear()
+                # would clobber series owned by another coordinator in
+                # the process
+                for wid in seen - set(ages):
+                    age.remove(worker=wid)
+                seen.clear()
+                seen.update(ages)
+                for wid, a in ages.items():
+                    age.set(a, worker=wid)
+            members.set(n)
+            gen.set(g)
+            evict.set_total(ev)
+
+        def cleanup() -> None:
+            with collect_lock:
+                for wid in seen:
+                    age.remove(worker=wid)
+                seen.clear()
+            members.set(0)
+
+        self._metrics_collector = collect
+        self._metrics_cleanup = cleanup
+        reg.register_collector(collect)
 
     # -- request dispatch --------------------------------------------------
     def _dispatch(self, req: dict) -> dict:
@@ -253,7 +331,11 @@ class CoordinatorClient:
     def _rpc(self, obj: dict) -> dict:
         with socket.create_connection(self._addr, timeout=self.timeout) as s:
             _send_json(s, obj)
-            resp = _recv_json(s.makefile("r"))
+            # close the makefile wrapper explicitly: a GC'd-but-unclosed
+            # wrapper raises ResourceWarning at an arbitrary later point
+            # (pytest's unraisable collector pins it on innocent tests)
+            with s.makefile("r") as f:
+                resp = _recv_json(f)
         if resp is None:
             raise ConnectionError("coordinator closed connection")
         return resp

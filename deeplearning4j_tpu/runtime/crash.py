@@ -112,6 +112,41 @@ def maybe_write_oom_report(exc: BaseException) -> Optional[str]:
         return None
 
 
+import itertools as _itertools
+
+_divergence_seq = _itertools.count()
+
+
+def write_divergence_report(event: dict, path: Optional[str] = None) -> str:
+    """Divergence report — the numeric-health analog of the OOM report.
+
+    `observe.health.HealthListener` routes flagged events (NaN/Inf score,
+    non-finite params, norm explosion) here: the structured event heads
+    the same device-memory + live-buffer report an OOM produces, so the
+    post-mortem has the params' residence and sizes next to the numbers
+    that went bad.  Returns the report path.
+    """
+    import json
+
+    if path is None:
+        d = os.environ.get(ENV_CRASH_DIR, ".")
+        os.makedirs(d, exist_ok=True)
+        # timestamp + process-wide sequence: back-to-back events (the k
+        # listener dispatches of a grouped program land in the same ms)
+        # must not overwrite each other's reports
+        path = os.path.join(
+            d,
+            f"dl4jtpu-divergence-report-{int(time.time() * 1000)}"
+            f"-{next(_divergence_seq)}.txt",
+        )
+    header = "\n".join(
+        ["DIVERGENCE EVENT (observe.health numeric monitor):"]
+        + [f"  {k}: {v}" for k, v in sorted(event.items())]
+        + ["", "event json: " + json.dumps(event, sort_keys=True)]
+    )
+    return write_memory_report(path, header=header)
+
+
 class oom_report_scope:
     """Context manager the models wrap their compiled-step invocation in: a
     device OOM escaping the scope gets the memory report written and a

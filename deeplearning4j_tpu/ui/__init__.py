@@ -14,6 +14,11 @@ Plus the TPU-specific pieces the reference's CUDA stack can't have:
 `ProfilerListener` captures jax.profiler traces (TensorBoard/Perfetto) for
 a window of steps, and `runtime.crash` writes an HBM OOM report with
 per-buffer attribution (the CrashReportingUtil role).
+
+The scrape/trace spine lives in `deeplearning4j_tpu.observe`
+(MetricsRegistry, TraceRecorder, HealthListener); UIServer exposes it at
+``GET /metrics`` (Prometheus text) and ``GET /api/trace`` (Chrome
+trace-event JSON of the host-side step timeline).
 """
 
 from deeplearning4j_tpu.ui.stats import (

@@ -42,6 +42,17 @@ class ProfilerListener(TrainingListener):
             self._active = False
             self.captured = True
 
+    def on_fit_end(self, model):
+        # fit() can return before start_iteration + num_iterations (short
+        # run, early stopping): a trace left open here would leak the
+        # profiler session and poison the NEXT start_trace with
+        # "already active".  Stop it and keep the partial capture.
+        if self._active:
+            import jax
+
+            jax.block_until_ready(model.params)
+        self.close()
+
     def close(self):
         if self._active:
             import jax

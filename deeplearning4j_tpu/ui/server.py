@@ -10,7 +10,11 @@ charts, auto-refresh, JSON API.
     server.attach(storage)
     print(server.url)                     # http://127.0.0.1:<port>/
 
-JSON API: /api/sessions, /api/stats?session=<id>.
+JSON API: /api/sessions, /api/stats?session=<id>, /api/trace (Chrome
+trace-event JSON of the step-timeline ring buffer).  Scrape API:
+/metrics (Prometheus text exposition of the process-global
+`observe.metrics` registry — compile taxes, ETL wait, cache hits, step
+latency histogram, health counters, device memory).
 """
 
 from __future__ import annotations
@@ -35,7 +39,8 @@ _PAGE = """<!doctype html>
 </style></head><body>
 <h1>deeplearning4j_tpu training dashboard
   <select id="session"></select>
-  <a href="hpo" style="font-size:12px;margin-left:16px">HPO results →</a></h1>
+  <a href="hpo" style="font-size:12px;margin-left:16px">HPO results →</a>
+  <a href="metrics" style="font-size:12px;margin-left:8px">/metrics</a></h1>
 <div id="meta"></div>
 <div class="row">
  <div><h2>score</h2><canvas id="score" width="560" height="260"></canvas></div>
@@ -257,6 +262,27 @@ class UIServer:
                     self.wfile.write(body)
                 elif u.path == "/api/hpo":
                     self._json(outer._hpo_results())
+                elif u.path == "/metrics":
+                    # Prometheus scrape endpoint: the process-global
+                    # registry (collectors refresh compile stats, device
+                    # memory, coordinator ages at scrape time)
+                    from deeplearning4j_tpu.observe.metrics import registry
+
+                    body = registry().to_prometheus_text().encode()
+                    self.send_response(200)
+                    self.send_header(
+                        "Content-Type",
+                        "text/plain; version=0.0.4; charset=utf-8",
+                    )
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                elif u.path == "/api/trace":
+                    # the step-timeline ring buffer as Chrome trace-event
+                    # JSON — save the response and load it in Perfetto
+                    from deeplearning4j_tpu.observe.trace import tracer
+
+                    self._json(tracer().to_chrome_trace())
                 else:
                     self._json({"error": "not found"}, 404)
 

@@ -158,17 +158,53 @@ class RemoteStatsStorageRouter(StatsStorage):
 
 
 class FileStatsStorage(StatsStorage):
-    """Append-only jsonl file; readable while training writes."""
+    """Append-only jsonl file; readable while training writes.
+
+    One persistent append handle, flushed after EVERY record: `tail -f`,
+    the dashboard's poll loop, and a crash post-mortem all see the
+    latest record immediately instead of waiting for buffer pressure or
+    interpreter exit (a diverging run's final — most interesting —
+    records used to be exactly the ones at risk)."""
 
     def __init__(self, path: str):
         self.path = path
         self._lock = threading.Lock()
+        self._f = None
         os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+
+    def _rotated(self) -> bool:
+        """True when self.path no longer names the held handle's inode —
+        the file was rotated (renamed away + recreated) or removed.
+        Writing on would append to an inode no reader ever sees."""
+        try:
+            st = os.stat(self.path)
+            cur = os.fstat(self._f.fileno())
+        except OSError:
+            return True
+        return (st.st_ino, st.st_dev) != (cur.st_ino, cur.st_dev)
 
     def put_record(self, record: dict) -> None:
         line = json.dumps(record)
-        with self._lock, open(self.path, "a") as f:
-            f.write(line + "\n")
+        with self._lock:
+            if self._f is not None and self._rotated():
+                self._f.close()
+                self._f = None
+            if self._f is None:
+                self._f = open(self.path, "a")
+            self._f.write(line + "\n")
+            self._f.flush()
+
+    def close(self) -> None:
+        with self._lock:
+            if self._f is not None:
+                self._f.close()
+                self._f = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
 
     def _read(self) -> list[dict]:
         if not os.path.exists(self.path):

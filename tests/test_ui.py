@@ -92,6 +92,51 @@ class TestStatsListener:
             m.fit_batch(batch(i))
         assert [r["iteration"] for r in storage.get_records("s")] == [3, 6]
 
+    def test_file_storage_flushes_every_record(self, tmp_path):
+        """Each append is flushed immediately: `tail -f` and the
+        dashboard see records without waiting for buffer pressure or
+        close() — a diverging run's last records are the ones at risk."""
+        path = tmp_path / "live.jsonl"
+        storage = FileStatsStorage(str(path))
+        try:
+            storage.put_record({"session": "s", "iteration": 1})
+            # read WITHOUT close(): the bytes must already be on disk
+            lines = path.read_text().splitlines()
+            assert len(lines) == 1
+            assert json.loads(lines[0])["iteration"] == 1
+            storage.put_record({"session": "s", "iteration": 2})
+            assert len(path.read_text().splitlines()) == 2
+        finally:
+            storage.close()
+        # close is idempotent and reopening for append still works
+        storage.close()
+        storage.put_record({"session": "s", "iteration": 3})
+        storage.close()
+        assert len(path.read_text().splitlines()) == 3
+
+    def test_file_storage_survives_rotation(self, tmp_path):
+        """An externally rotated/removed jsonl must not strand records
+        on the old inode — the storage reopens at the path."""
+        path = tmp_path / "rot.jsonl"
+        storage = FileStatsStorage(str(path))
+        try:
+            storage.put_record({"session": "s", "iteration": 1})
+            path.unlink()                        # operator rm
+            storage.put_record({"session": "s", "iteration": 2})
+            lines = path.read_text().splitlines()
+            assert len(lines) == 1
+            assert json.loads(lines[0])["iteration"] == 2
+            # rename-based rotation (logrotate default): path still
+            # exists afterwards but names a DIFFERENT inode
+            path.rename(tmp_path / "rot.jsonl.1")
+            path.write_text("")
+            storage.put_record({"session": "s", "iteration": 3})
+            lines = path.read_text().splitlines()
+            assert len(lines) == 1
+            assert json.loads(lines[0])["iteration"] == 3
+        finally:
+            storage.close()
+
 
 class TestUIServer:
     def test_rest_roundtrip(self):
@@ -166,6 +211,36 @@ class TestUIServer:
         finally:
             router.close()
 
+    def test_metrics_and_trace_endpoints(self):
+        """The telemetry spine rides the dashboard server: /metrics is
+        Prometheus text, /api/trace is Chrome trace-event JSON (the full
+        family-presence smoke lives in tests/test_observe.py)."""
+        from deeplearning4j_tpu.observe import tracer
+
+        rec = tracer()
+        rec.enable()
+        rec.clear()
+        try:
+            m = small_model()
+            m.fit([batch(i) for i in range(2)], epochs=1)
+        finally:
+            rec.disable()
+        server = UIServer(port=0)
+        try:
+            with urllib.request.urlopen(server.url + "metrics") as r:
+                assert r.headers["Content-Type"].startswith("text/plain")
+                text = r.read().decode()
+            assert "# TYPE dl4jtpu_step_latency_seconds histogram" in text
+            with urllib.request.urlopen(server.url + "api/trace") as r:
+                trace = json.load(r)
+            names = {e["name"] for e in trace["traceEvents"]}
+            assert {"etl_wait", "host_stage", "dispatch",
+                    "device_sync"} <= names
+            with urllib.request.urlopen(server.url) as r:
+                assert 'href="metrics"' in r.read().decode()
+        finally:
+            server.stop()
+
     def test_singleton_attach_detach(self):
         server = UIServer.get_instance()
         try:
@@ -196,6 +271,32 @@ class TestProfilerListener:
         for root, _, files in os.walk(d):
             found.extend(f for f in files if f.endswith((".xplane.pb", ".trace.json.gz", ".pb")))
         assert found, f"no trace artifacts under {d}"
+
+    def test_short_fit_does_not_leak_open_trace(self, tmp_path):
+        """fit() ending before start_iteration + num_iterations used to
+        leave the jax.profiler session open — the NEXT start_trace then
+        failed with 'already active'.  on_fit_end stops the trace and
+        keeps the partial capture."""
+        d = str(tmp_path / "prof_short")
+        m = small_model()
+        lst = ProfilerListener(d, start_iteration=2, num_iterations=50)
+        m.set_listeners(lst)
+        # 4 iterations < 2 + 50: the window can never complete
+        m.fit([batch(i) for i in range(4)], epochs=1)
+        assert not lst._active
+        assert lst.captured
+        found = []
+        for root, _, files in os.walk(d):
+            found.extend(f for f in files
+                         if f.endswith((".xplane.pb", ".trace.json.gz", ".pb")))
+        assert found, f"no partial-capture artifacts under {d}"
+        # and a fresh listener can start a new trace afterwards
+        m2 = small_model()
+        lst2 = ProfilerListener(str(tmp_path / "prof2"),
+                                start_iteration=1, num_iterations=1)
+        m2.set_listeners(lst2)
+        m2.fit([batch(i) for i in range(3)], epochs=1)
+        assert lst2.captured
 
 
 class TestCrashReport:
