@@ -191,9 +191,8 @@ class OptimizationRunner:
                             ModelSerializer,
                         )
 
-                        tmp = path + ".tmp"
-                        ModelSerializer.write_model(model, tmp)
-                        os.replace(tmp, path)
+                        # write_model publishes atomically itself
+                        ModelSerializer.write_model(model, path)
                         result.model_path = path
             # persist AFTER model_path is set so the jsonl records which
             # candidate produced best_model.zip

@@ -47,6 +47,7 @@ class Model:
         host timeline."""
         from deeplearning4j_tpu.observe.metrics import registry
         from deeplearning4j_tpu.observe.trace import tracer
+        from deeplearning4j_tpu.runtime import faults
 
         reg = registry()
         wait_total = reg.counter("dl4jtpu_etl_wait_seconds_total")
@@ -56,6 +57,10 @@ class Model:
         while True:
             t0 = time.perf_counter()
             try:
+                # fault site: every batch pull in every fit loop (armed
+                # plans provoke the flaky-input-pipeline failure mode;
+                # disarmed this is one attribute check)
+                faults.maybe_fail("data.next_batch")
                 batch = next(it)
             except StopIteration:
                 return

@@ -407,6 +407,14 @@ def _declare_core(reg: MetricsRegistry) -> None:
               "PJRT bytes currently allocated on device 0")
     reg.gauge("dl4jtpu_device_peak_bytes_in_use",
               "PJRT peak bytes allocated on device 0")
+    # fault tolerance (runtime/faults.py, runtime/coordinator.py,
+    # train/checkpoint.py)
+    reg.counter("dl4jtpu_rpc_retries_total",
+                "CoordinatorClient request retries, by op")
+    reg.counter("dl4jtpu_faults_injected_total",
+                "Faults fired by the armed FaultPlan, by site")
+    reg.counter("dl4jtpu_ckpt_verify_failures_total",
+                "Checkpoints that failed manifest/CRC verification")
 
 
 def _compile_stats_collector() -> None:

@@ -18,7 +18,10 @@ from deeplearning4j_tpu.runtime.compile_stats import CompileStats
 from deeplearning4j_tpu.runtime.coordinator import (
     CoordinatorClient,
     CoordinatorServer,
+    RetryExhausted,
+    RetryPolicy,
 )
+from deeplearning4j_tpu.runtime.faults import FaultPlan, InjectedFault
 from deeplearning4j_tpu.runtime.distributed import DistributedConfig
 from deeplearning4j_tpu.runtime.flags import Environment, environment
 from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh, virtual_cpu_devices
@@ -27,6 +30,10 @@ from deeplearning4j_tpu.runtime.rng import SeedStream
 __all__ = [
     "CoordinatorClient",
     "CoordinatorServer",
+    "RetryExhausted",
+    "RetryPolicy",
+    "FaultPlan",
+    "InjectedFault",
     "DistributedConfig",
     "Backend",
     "backend",

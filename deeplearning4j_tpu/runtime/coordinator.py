@@ -31,11 +31,15 @@ analog of the reference's dummy/delayed-transport tests (SURVEY.md §4.2).
 from __future__ import annotations
 
 import json
+import random
 import socket
 import socketserver
 import threading
 import time
-from typing import Any, Optional
+from collections import deque
+from typing import Any, Callable, Optional
+
+from deeplearning4j_tpu.runtime import faults
 
 
 def _free_port(host: str = "127.0.0.1") -> int:
@@ -43,6 +47,81 @@ def _free_port(host: str = "127.0.0.1") -> int:
     with socket.socket() as s:
         s.bind((host, 0))
         return s.getsockname()[1]
+
+
+def _reserve_port(host: str) -> socket.socket:
+    """Bind-and-hold a free port: the returned LISTENING socket keeps other
+    processes off the port until we close it (SO_REUSEADDR so the next
+    reservation isn't blocked by our own TIME_WAIT residue)."""
+    s = socket.socket()
+    s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+    s.bind((host, 0))
+    s.listen(1)
+    return s
+
+
+# -- retry / backoff --------------------------------------------------------
+
+class RetryExhausted(ConnectionError):
+    """A CoordinatorClient op ran out of retry budget.  Carries the op and
+    attempt count so the worker can exit with a control-plane-lost code the
+    supervisor distinguishes from a real eviction."""
+
+    def __init__(self, op: str, attempts: int, last: BaseException):
+        super().__init__(
+            f"coordinator op {op!r} failed after {attempts} attempt(s): "
+            f"{type(last).__name__}: {last}"
+        )
+        self.op = op
+        self.attempts = attempts
+        self.last = last
+
+
+class RetryPolicy:
+    """Capped exponential backoff + jitter for one op class.
+
+    `sleep` and `rand` are injectable so tests can run a patient budget
+    without wall-clocking it (the `-m faults` group stays sub-second).
+    Policies are stateless across calls — safe to share between clients.
+    """
+
+    #: transient shapes worth retrying: every socket-level failure
+    #: (ConnectionError/timeout are OSError subclasses) plus a garbled
+    #: half-written response from a server that died mid-reply
+    RETRYABLE: tuple = (OSError, json.JSONDecodeError)
+
+    def __init__(self, max_attempts: int = 4, base_delay: float = 0.05,
+                 max_delay: float = 2.0, jitter: float = 0.25,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rand: Callable[[], float] = random.random):
+        if max_attempts < 1:
+            raise ValueError("max_attempts must be >= 1")
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.max_delay = float(max_delay)
+        self.jitter = float(jitter)
+        self._sleep = sleep
+        self._rand = rand
+
+    def backoff(self, attempt: int) -> float:
+        """Delay before attempt `attempt` (2-based): capped exponential
+        with multiplicative jitter in [1-j, 1+j]."""
+        raw = min(self.max_delay, self.base_delay * (2 ** (attempt - 2)))
+        return raw * (1.0 + self.jitter * (2.0 * self._rand() - 1.0))
+
+    def run(self, op: str, fn: Callable[[], Any],
+            on_retry: Optional[Callable[[int, BaseException], None]] = None):
+        last: Optional[BaseException] = None
+        for attempt in range(1, self.max_attempts + 1):
+            if attempt > 1:
+                if on_retry is not None:
+                    on_retry(attempt, last)
+                self._sleep(self.backoff(attempt))
+            try:
+                return fn()
+            except self.RETRYABLE as e:
+                last = e
+        raise RetryExhausted(op, self.max_attempts, last) from last
 
 
 def _send_json(sock: socket.socket, obj: dict) -> None:
@@ -59,38 +138,61 @@ def _recv_json(f) -> Optional[dict]:
 class CoordinatorServer:
     """Membership + heartbeat + checkpoint-registry service."""
 
+    #: ledger ring size: a long-lived supervisor crosses many generations;
+    #: the last 256 checkpoint reports / evictions are plenty for the
+    #: supervisor's per-generation queries and status debugging
+    LEDGER_CAP = 256
+
     def __init__(self, expected_workers: int, heartbeat_timeout: float = 10.0,
-                 host: str = "127.0.0.1", port: int = 0):
+                 host: str = "127.0.0.1", port: int = 0,
+                 request_timeout: float = 10.0):
         self._lock = threading.Condition()
         self.expected = expected_workers
         self.heartbeat_timeout = heartbeat_timeout
+        # per-request socket read timeout: a half-open client (SYN, then
+        # silence — a worker killed mid-request) must not pin a handler
+        # thread forever
+        self.request_timeout = request_timeout
         # generation state
         self.generation = 0
         self.members: dict[str, dict[str, Any]] = {}   # id -> {rank, last_hb}
         self.abort = False
         self.pending: dict[str, dict[str, Any]] = {}   # joiners for next gen
-        # checkpoint registry: latest wins
+        # checkpoint registry: latest wins; history is a bounded ring
         self.latest_ckpt: Optional[dict[str, Any]] = None
-        self.history: list[dict[str, Any]] = []
+        self.history: deque[dict[str, Any]] = deque(maxlen=self.LEDGER_CAP)
         self._host = host
         self.jax_coordinator: Optional[str] = None
+        # the NEXT generation's data-plane port, reserved (bound + listening)
+        # from now until the seal hands it out — closing only at the seal
+        # shrinks the steal window from "whole registration barrier" to the
+        # worker's jax.distributed bring-up; a worker that still loses the
+        # race exits non-zero and the supervisor respawns the generation
+        self._port_hold: Optional[socket.socket] = _reserve_port(host)
         # eviction ledger: who actually failed, per generation (the signal
         # the supervisor shrinks on — collateral aborts of healthy peers,
         # which JAX's own coordination service causes by design, are not
-        # evictions)
-        self.evictions: list[dict[str, Any]] = []
+        # evictions).  Bounded ring, same rationale as history.
+        self.evictions: deque[dict[str, Any]] = deque(maxlen=self.LEDGER_CAP)
 
         outer = self
 
         class Handler(socketserver.StreamRequestHandler):
             def handle(self):
                 try:
+                    self.connection.settimeout(outer.request_timeout)
                     req = _recv_json(self.rfile)
                     if req is None:
                         return
+                    # register blocks in the membership barrier longer than
+                    # any read should: lift the timeout for the RESPONSE
+                    # write (reads are done at this point)
+                    self.connection.settimeout(None)
                     resp = outer._dispatch(req)
                     _send_json(self.request, resp)
-                except (ConnectionError, json.JSONDecodeError):
+                except (OSError, json.JSONDecodeError):
+                    # timeouts, resets, garbage — drop the request; the
+                    # client's retry policy owns recovery
                     pass
 
         class Server(socketserver.ThreadingTCPServer):
@@ -130,6 +232,9 @@ class CoordinatorServer:
                 self._metrics_cleanup = None
         self._server.shutdown()
         self._server.server_close()
+        if self._port_hold is not None:
+            self._port_hold.close()
+            self._port_hold = None
 
     def _register_metrics(self) -> None:
         """Publish cluster health into the telemetry spine: per-worker
@@ -241,6 +346,24 @@ class CoordinatorServer:
         """Membership barrier: blocks until `expected` workers are pending,
         then seals a new generation and assigns dense ranks."""
         with self._lock:
+            if worker in self.members and not self.abort:
+                # idempotent re-register: the worker's previous attempt was
+                # sealed but the response got lost in transit — hand back
+                # the existing assignment instead of queueing a ghost that
+                # would wedge the next generation's barrier.  Refresh the
+                # heartbeat too: the worker can't start beating until
+                # register() returns, and the monitor must not evict a
+                # reachable worker whose retries are still in flight.
+                self.members[worker]["last_hb"] = time.time()
+                return {
+                    "ok": True,
+                    "generation": self.generation,
+                    "rank": self.members[worker]["rank"],
+                    "world": len(self.members),
+                    "members": sorted(self.members),
+                    "jax_coordinator": self.jax_coordinator,
+                    "ckpt": self.latest_ckpt,
+                }
             self.pending[worker] = {"info": info, "time": time.time()}
             if not self._maybe_seal():
                 # wait until a seal consumes our pending entry
@@ -274,8 +397,18 @@ class CoordinatorServer:
         self.abort = False
         # a fresh jax.distributed coordination-service port per generation
         # (the data-plane runtime cannot be rejoined on a stale port after
-        # an abort)
-        self.jax_coordinator = f"{self._host}:{_free_port(self._host)}"
+        # an abort).  The port was RESERVED (held listening) since the
+        # previous seal; release it now — the last possible moment — and
+        # immediately reserve the next generation's.
+        hold, self._port_hold = self._port_hold, None
+        if hold is not None:
+            port = hold.getsockname()[1]
+        else:                               # stop() raced us; fall back
+            port = _free_port(self._host)
+        self._port_hold = _reserve_port(self._host)
+        if hold is not None:
+            hold.close()
+        self.jax_coordinator = f"{self._host}:{port}"
         now = time.time()
         self.members = {}
         for rank, wid in enumerate(sorted(self.pending)):
@@ -318,17 +451,56 @@ class CoordinatorServer:
                     self._evict(wid, reason="heartbeat timeout")
 
 
+def default_retry_policies(sleep: Callable[[float], None] = time.sleep
+                           ) -> dict[str, RetryPolicy]:
+    """Per-op retry budgets (ISSUE 3 control-plane hardening):
+
+    - ``register`` is PATIENT: losing the membership barrier to one dropped
+      packet costs a whole generation, so it gets the deepest budget.
+    - ``heartbeat`` is SINGLE-TRY: it repeats every interval anyway, and the
+      heartbeat thread already tolerates individual failures — retrying
+      inside one beat would only delay the next one.
+    - ``report_ckpt``/``leave`` (and the rest) are BOUNDED: useful to retry
+      a few times, but the checkpoint on disk / process exit is the ground
+      truth, so giving up is safe.
+    """
+    return {
+        "register": RetryPolicy(max_attempts=8, base_delay=0.1,
+                                max_delay=2.0, sleep=sleep),
+        "heartbeat": RetryPolicy(max_attempts=1, sleep=sleep),
+        "report_ckpt": RetryPolicy(max_attempts=4, base_delay=0.05,
+                                   max_delay=1.0, sleep=sleep),
+        "leave": RetryPolicy(max_attempts=3, base_delay=0.05,
+                             max_delay=0.5, sleep=sleep),
+        "*": RetryPolicy(max_attempts=4, base_delay=0.05, max_delay=1.0,
+                         sleep=sleep),
+    }
+
+
 class CoordinatorClient:
     """Worker-side stub. Every call is one short-lived TCP round trip —
-    no long-lived connection to leak across fork/exec."""
+    no long-lived connection to leak across fork/exec.
 
-    def __init__(self, address: str, worker_id: str, timeout: float = 130.0):
+    Transient failures (refused/reset connections, read timeouts, a reply
+    cut off mid-line) are retried per `default_retry_policies`; retries
+    land on the telemetry spine as ``dl4jtpu_rpc_retries_total{op=...}``.
+    Pass ``retry={...}`` to override budgets (tests inject a no-op sleep so
+    patient budgets don't wall-clock), or ``retry={}``-with-missing-op to
+    fall through to the ``"*"`` default.
+    """
+
+    def __init__(self, address: str, worker_id: str, timeout: float = 130.0,
+                 retry: Optional[dict[str, RetryPolicy]] = None):
         host, port = address.rsplit(":", 1)
         self._addr = (host, int(port))
         self.worker_id = worker_id
         self.timeout = timeout
+        self._retry = default_retry_policies()
+        if retry:
+            self._retry.update(retry)
 
-    def _rpc(self, obj: dict) -> dict:
+    def _rpc_once(self, obj: dict) -> dict:
+        faults.maybe_fail("coordinator.rpc")
         with socket.create_connection(self._addr, timeout=self.timeout) as s:
             _send_json(s, obj)
             # close the makefile wrapper explicitly: a GC'd-but-unclosed
@@ -340,6 +512,22 @@ class CoordinatorClient:
             raise ConnectionError("coordinator closed connection")
         return resp
 
+    def _rpc(self, obj: dict) -> dict:
+        op = obj.get("op", "?")
+        policy = self._retry.get(op) or self._retry["*"]
+        if policy.max_attempts == 1:
+            return self._rpc_once(obj)
+
+        def on_retry(attempt, last):
+            try:
+                from deeplearning4j_tpu.observe.metrics import registry
+
+                registry().counter("dl4jtpu_rpc_retries_total").inc(op=op)
+            except Exception:
+                pass
+
+        return policy.run(op, lambda: self._rpc_once(obj), on_retry=on_retry)
+
     def register(self, info: dict | None = None) -> dict:
         r = self._rpc({"op": "register", "worker": self.worker_id, "info": info})
         if not r.get("ok"):
@@ -347,6 +535,7 @@ class CoordinatorClient:
         return r
 
     def heartbeat(self, step: int | None = None) -> dict:
+        faults.maybe_fail("heartbeat.send")
         return self._rpc({"op": "heartbeat", "worker": self.worker_id, "step": step})
 
     def report_ckpt(self, step: int, path: str) -> None:

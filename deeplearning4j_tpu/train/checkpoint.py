@@ -8,35 +8,80 @@ net state (BN running stats) + training counters.  Format: a .zip with
   netstate.npz         — non-trainable state
   updater.npz          — optax state leaves (structure rebuilt from config)
   meta.json            — iteration/epoch counters, format version
+  manifest.json        — per-entry CRC32 + byte size + npz leaf counts
 Restore rebuilds the model from config, then loads arrays back into the
 freshly-initialized pytrees (structure comes from code, data from the file —
 robust to optax internals as long as the leaf count matches).
+
+Integrity (ISSUE 3): `write_model` ALWAYS publishes via tmp-file +
+``os.replace`` with an fsync before the rename — a `kill -9` mid-write
+leaves a ``.tmp`` orphan, never a truncated published checkpoint — and
+writes `manifest.json` so `restore()`/`verify()` can prove a file intact
+before trusting it.  `CheckpointStore` layers last-good-fallback on top:
+scan a directory, skip corrupt/truncated/unverified files, restore the
+newest VALID one, garbage-collect the rest.
 """
 
 from __future__ import annotations
 
 import io
 import json
+import logging
+import os
+import re
 import zipfile
+import zlib
+
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.runtime import faults
 from deeplearning4j_tpu.utils import serde
 
-FORMAT_VERSION = 1
+log = logging.getLogger("deeplearning4j_tpu")
+
+# v2 adds manifest.json; v1 files (no manifest) still restore — verify()
+# falls back to the zip's own per-entry CRC check for them
+FORMAT_VERSION = 2
+
+MANIFEST_NAME = "manifest.json"
+_REQUIRED_ENTRIES = ("configuration.json", "params.npz", "netstate.npz",
+                     "meta.json")
 
 
-def _save_npz_pytree(zf: zipfile.ZipFile, name: str, tree) -> None:
+class CheckpointVerifyError(RuntimeError):
+    """The checkpoint file failed integrity verification (truncated zip,
+    CRC mismatch, missing entries, leaf-count drift)."""
+
+
+def _count_verify_failure(path: str, reason: str) -> None:
+    log.warning("checkpoint %s failed verification: %s", path, reason)
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        registry().counter("dl4jtpu_ckpt_verify_failures_total").inc()
+    except Exception:
+        pass
+
+
+def _npz_bytes(tree) -> tuple[bytes, int]:
+    """(npz bytes, leaf count) for a pytree; multi-host-sharded leaves are
+    allgathered (fetch_global) before the single-writer save."""
     from deeplearning4j_tpu.runtime.distributed import fetch_global
 
     leaves = jax.tree.leaves(tree)
     buf = io.BytesIO()
-    # fetch_global: multi-host-sharded leaves are allgathered before the
-    # single-writer save (plain np.asarray for everything addressable)
     np.savez(buf, *[fetch_global(x) for x in leaves])
-    zf.writestr(name, buf.getvalue())
+    return buf.getvalue(), len(leaves)
+
+
+def _save_npz_pytree(zf: zipfile.ZipFile, name: str, tree) -> None:
+    """Write a pytree as one npz entry (autodiff/samediff's save path
+    shares this helper)."""
+    zf.writestr(name, _npz_bytes(tree)[0])
 
 
 def _load_npz_into(zf: zipfile.ZipFile, name: str, tree):
@@ -76,10 +121,38 @@ class ModelSerializer:
 
     @staticmethod
     def write_model(model, path: str, save_updater: bool = True) -> None:
+        """Write the checkpoint zip ATOMICALLY: bytes land in
+        ``path + ".tmp"``, are fsynced, and only then renamed over `path`.
+        Readers either see the previous complete file or the new complete
+        file — never a torn write.  Fault sites: ``checkpoint.write`` at
+        entry (``truncate`` corrupts the published bytes — the
+        slipped-past-fsync disk-corruption case), ``checkpoint.fsync``
+        between the zip landing and the publish (a ``kill`` there is
+        exactly kill-9-mid-checkpoint: a ``.tmp`` orphan is left behind)."""
         if model.params is None:
             raise RuntimeError("model not initialized")
-        with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr(
+        action = faults.maybe_fail("checkpoint.write")
+
+        manifest_entries: dict[str, dict] = {}
+        leaf_counts: dict[str, int] = {}
+
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            zf = zipfile.ZipFile(f, "w", zipfile.ZIP_DEFLATED)
+
+            def put(name: str, data: bytes, leaves: Optional[int] = None):
+                # one entry's bytes alive at a time: a checkpoint-sized
+                # buffer is fine, three of them (params + netstate +
+                # updater at once) risks a host OOM on memory-tight
+                # workers
+                zf.writestr(name, data)
+                manifest_entries[name] = {
+                    "crc32": zlib.crc32(data), "size": len(data),
+                }
+                if leaves is not None:
+                    leaf_counts[name] = leaves
+
+            put(
                 "configuration.json",
                 json.dumps(
                     {
@@ -92,13 +165,13 @@ class ModelSerializer:
                         "conf": serde.to_jsonable(model.conf),
                     },
                     indent=2,
-                ),
+                ).encode(),
             )
-            _save_npz_pytree(zf, "params.npz", model.params)
-            _save_npz_pytree(zf, "netstate.npz", model.net_state)
+            put("params.npz", *_npz_bytes(model.params))
+            put("netstate.npz", *_npz_bytes(model.net_state))
             if save_updater and model.opt_state is not None:
-                _save_npz_pytree(zf, "updater.npz", model.opt_state)
-            zf.writestr(
+                put("updater.npz", *_npz_bytes(model.opt_state))
+            put(
                 "meta.json",
                 json.dumps(
                     {
@@ -106,13 +179,90 @@ class ModelSerializer:
                         "iteration": model.iteration,
                         "epoch": model.epoch,
                     }
-                ),
+                ).encode(),
             )
+            zf.writestr(MANIFEST_NAME, json.dumps({
+                "format_version": FORMAT_VERSION,
+                "entries": manifest_entries,
+                "leaf_counts": leaf_counts,
+            }))
+            zf.close()
+            if action == "truncate":
+                # injected corruption that survives publish (bytes lost
+                # AFTER the write path believed them durable)
+                f.flush()
+                f.truncate(max(1, f.tell() // 2))
+            faults.maybe_fail("checkpoint.fsync")
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)       # atomic publish
 
     @staticmethod
-    def restore(path: str):
+    def verify(path: str) -> dict:
+        """Prove `path` is an intact checkpoint without building a model.
+
+        Checks: the zip opens, required entries exist, every manifest entry
+        decompresses to the recorded CRC32 + size, and the npz leaf counts
+        match the manifest.  Pre-manifest (v1) files fall back to the zip's
+        own per-entry CRCs.  Returns the parsed ``meta.json``; raises
+        `CheckpointVerifyError` (and bumps
+        ``dl4jtpu_ckpt_verify_failures_total``) on any defect."""
+        try:
+            with zipfile.ZipFile(path, "r") as zf:
+                names = set(zf.namelist())
+                missing = [n for n in _REQUIRED_ENTRIES if n not in names]
+                if missing:
+                    raise ValueError(f"missing entries: {missing}")
+                if MANIFEST_NAME in names:
+                    manifest = json.loads(zf.read(MANIFEST_NAME))
+                    leaf_counts = manifest.get("leaf_counts", {})
+                    for name in leaf_counts:
+                        if name not in names:
+                            raise ValueError(f"{name}: in manifest, not in zip")
+                    # one read per entry: the decompressed bytes serve the
+                    # CRC/size check AND the leaf count (params.npz can be
+                    # GBs — decompressing it twice doubles recovery time
+                    # on the elastic-restart hot path)
+                    for name, ent in manifest.get("entries", {}).items():
+                        data = zf.read(name)
+                        if len(data) != ent["size"]:
+                            raise ValueError(
+                                f"{name}: size {len(data)} != manifest "
+                                f"{ent['size']}"
+                            )
+                        if zlib.crc32(data) != ent["crc32"]:
+                            raise ValueError(f"{name}: CRC32 mismatch")
+                        want = leaf_counts.get(name)
+                        if want is not None:
+                            npz = np.load(io.BytesIO(data),
+                                          allow_pickle=False)
+                            if len(npz.files) != want:
+                                raise ValueError(
+                                    f"{name}: {len(npz.files)} leaves, "
+                                    f"manifest says {want}"
+                                )
+                else:
+                    bad = zf.testzip()
+                    if bad is not None:
+                        raise ValueError(f"{bad}: zip CRC check failed")
+                return json.loads(zf.read("meta.json"))
+        except CheckpointVerifyError:
+            raise
+        except (zipfile.BadZipFile, zlib.error, KeyError, ValueError,
+                OSError, json.JSONDecodeError) as e:
+            _count_verify_failure(path, f"{type(e).__name__}: {e}")
+            raise CheckpointVerifyError(
+                f"checkpoint {path} failed verification: {e}"
+            ) from e
+
+    @staticmethod
+    def restore(path: str, verify: bool = True):
         """Restore any saved model (restoreMultiLayerNetwork /
-        restoreComputationGraph role, class-dispatched)."""
+        restoreComputationGraph role, class-dispatched).  Verifies the
+        manifest first (`verify=False` skips it when the caller — e.g.
+        `CheckpointStore` — just did)."""
+        if verify:
+            ModelSerializer.verify(path)
         with zipfile.ZipFile(path, "r") as zf:
             cfg = json.loads(zf.read("configuration.json"))
             conf = serde.from_jsonable(cfg["conf"])
@@ -140,3 +290,110 @@ class ModelSerializer:
             model.iteration = meta.get("iteration", 0)
             model.epoch = meta.get("epoch", 0)
         return model
+
+
+class CheckpointStore:
+    """A directory of rolling ``ckpt_<step>.zip`` files with verification,
+    last-good fallback and garbage collection.
+
+    Single-writer by design (the elastic chief / the preemption handler);
+    readers may scan concurrently.  `save()` publishes atomically (via
+    `ModelSerializer.write_model`) and GCs; `latest_valid()` walks the
+    directory newest-first and returns the first checkpoint that PASSES
+    verification — a truncated/corrupt newest file is skipped (and
+    counted), not fatal.  Also duck-types the PreemptionHandler
+    checkpointer contract (``save(model)`` + ``wait()``).
+    """
+
+    def __init__(self, directory: str, keep_last: int = 3,
+                 prefix: str = "ckpt_"):
+        if keep_last < 1:
+            raise ValueError("keep_last must be >= 1")
+        self.directory = directory
+        self.keep_last = keep_last
+        self.prefix = prefix
+        self._name_re = re.compile(
+            re.escape(prefix) + r"(\d+)\.zip$"
+        )
+
+    # -- naming / scanning -------------------------------------------------
+    def path_for(self, step: int) -> str:
+        return os.path.join(self.directory, f"{self.prefix}{step:08d}.zip")
+
+    def _scan(self) -> list[tuple[int, str]]:
+        """[(step, path)] on disk, newest step first; .tmp orphans and
+        foreign files are ignored."""
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return []
+        out = []
+        for n in names:
+            m = self._name_re.match(n)
+            if m:
+                out.append((int(m.group(1)), os.path.join(self.directory, n)))
+        out.sort(reverse=True)
+        return out
+
+    def all_steps(self) -> list[int]:
+        """Steps present on disk (unverified), ascending."""
+        return sorted(s for s, _ in self._scan())
+
+    # -- write side --------------------------------------------------------
+    def save(self, model, step: Optional[int] = None) -> int:
+        """Write `model` at `step` (default: its iteration counter),
+        publish atomically, GC old checkpoints.  Returns the step."""
+        step = int(model.iteration if step is None else step)
+        os.makedirs(self.directory, exist_ok=True)
+        ModelSerializer.write_model(model, self.path_for(step))
+        self.gc()
+        return step
+
+    def wait(self) -> None:
+        """PreemptionHandler checkpointer contract — writes are sync."""
+
+    def gc(self) -> None:
+        """Delete checkpoints beyond the newest `keep_last` and any
+        ``.tmp`` orphans (a dead writer's torn file — we are the only
+        writer, so any tmp lying around is garbage)."""
+        for _, path in self._scan()[self.keep_last:]:
+            try:
+                os.remove(path)
+            except OSError:
+                pass
+        try:
+            names = os.listdir(self.directory)
+        except FileNotFoundError:
+            return
+        for n in names:
+            if n.startswith(self.prefix) and n.endswith(".tmp"):
+                try:
+                    os.remove(os.path.join(self.directory, n))
+                except OSError:
+                    pass
+
+    # -- read side ---------------------------------------------------------
+    def latest_valid(self) -> Optional[dict]:
+        """Newest checkpoint that passes verification:
+        ``{"step", "path", "meta"}`` — or None when nothing on disk
+        survives.  Corrupt files are skipped and counted
+        (``dl4jtpu_ckpt_verify_failures_total``), never raised."""
+        for step, path in self._scan():
+            try:
+                meta = ModelSerializer.verify(path)
+            except CheckpointVerifyError:
+                continue
+            return {"step": step, "path": path, "meta": meta}
+        return None
+
+    def restore_latest(self):
+        """Restore the newest VALID checkpoint, or None when there is no
+        valid checkpoint to restore."""
+        entry = self.latest_valid()
+        if entry is None:
+            return None
+        return ModelSerializer.restore(entry["path"], verify=False)
+
+    def restore_model(self, step: int):
+        """Restore a specific step (verifying it first)."""
+        return ModelSerializer.restore(self.path_for(step))

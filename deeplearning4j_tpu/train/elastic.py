@@ -23,12 +23,19 @@ the fail-the-world model the supervisor exists to absorb.
 
 from __future__ import annotations
 
+import logging
 import os
 import threading
 import time
 from typing import Callable, Optional
 
+log = logging.getLogger("deeplearning4j_tpu")
+
 EXIT_MEMBERSHIP_CHANGED = 23
+#: the worker exhausted its control-plane retry budget (coordinator
+#: unreachable) — distinct from an eviction: the supervisor does NOT
+#: shrink the world for these, it just respawns the generation
+EXIT_CONTROL_PLANE_LOST = 24
 
 
 class _HeartbeatThread(threading.Thread):
@@ -89,7 +96,10 @@ class ElasticWorkerLoop:
         platform: Optional[str] = None,
         parallel_config=None,
         jax_heartbeat_timeout_seconds: Optional[int] = None,
+        keep_last: int = 3,
     ):
+        from deeplearning4j_tpu.train.checkpoint import CheckpointStore
+
         self.client = client
         self.ckpt_dir = ckpt_dir
         self.save_every = save_every
@@ -98,9 +108,33 @@ class ElasticWorkerLoop:
         self.platform = platform
         self.parallel_config = parallel_config
         self.jax_heartbeat_timeout_seconds = jax_heartbeat_timeout_seconds
+        self.store = CheckpointStore(ckpt_dir, keep_last=keep_last)
 
     def _ckpt_path(self, step: int) -> str:
-        return os.path.join(self.ckpt_dir, f"ckpt_{step:08d}.zip")
+        return self.store.path_for(step)
+
+    def _pick_restore_path(self, ckpt) -> Optional[str]:
+        """The restore point this process's filesystem can actually prove
+        valid: the coordinator-reported checkpoint if it verifies, else the
+        newest VALID file in ckpt_dir (last-good fallback — a
+        reported-but-corrupt path must not abort the generation)."""
+        from deeplearning4j_tpu.train.checkpoint import (
+            CheckpointVerifyError,
+            ModelSerializer,
+        )
+
+        if ckpt and os.path.exists(ckpt["path"]):
+            try:
+                ModelSerializer.verify(ckpt["path"])
+                return ckpt["path"]
+            except CheckpointVerifyError:
+                log.warning(
+                    "reported checkpoint %s is corrupt; falling back to "
+                    "newest valid checkpoint in %s",
+                    ckpt["path"], self.ckpt_dir,
+                )
+        entry = self.store.latest_valid()
+        return entry["path"] if entry else None
 
     def _restore_or_build(self, build_model, reg, world):
         """Form a cross-process-consistent starting model.
@@ -114,8 +148,9 @@ class ElasticWorkerLoop:
 
         ckpt = reg.get("ckpt") or self.client.latest_ckpt()
         if world <= 1:
-            if ckpt and os.path.exists(ckpt["path"]):
-                return ModelSerializer.restore(ckpt["path"])
+            path = self._pick_restore_path(ckpt)
+            if path is not None:
+                return ModelSerializer.restore(path, verify=False)
             return build_model()
 
         import numpy as np
@@ -124,12 +159,18 @@ class ElasticWorkerLoop:
         from deeplearning4j_tpu.runtime import distributed
 
         chief = distributed.is_chief()
-        can_restore = bool(chief and ckpt and os.path.exists(ckpt["path"]))
+        path = self._pick_restore_path(ckpt) if chief else None
+        can_restore = bool(chief and path is not None)
         flag = multihost_utils.broadcast_one_to_all(np.int32(can_restore))
-        if int(flag) and ckpt and os.path.exists(ckpt["path"]):
-            model = ModelSerializer.restore(ckpt["path"])
+        if chief and int(flag):
+            model = ModelSerializer.restore(path, verify=False)
         else:
-            model = build_model()        # structure only; values follow
+            # non-chief ranks NEVER restore locally: every value is
+            # broadcast from the chief below, so a local restore (which
+            # could verify differently or pick a different newest-valid
+            # file) only buys divergence surface and wasted I/O.
+            # Structure comes from the conf, values from the chief.
+            model = build_model()
         # broadcast the chief's state on BOTH paths: a fresh build with a
         # non-deterministic init would otherwise silently train a different
         # model per host under 'replicated' params
@@ -154,9 +195,17 @@ class ElasticWorkerLoop:
     ):
         from deeplearning4j_tpu.parallel import ParallelConfig, distribute
         from deeplearning4j_tpu.runtime import distributed
+        from deeplearning4j_tpu.runtime.coordinator import RetryExhausted
         from deeplearning4j_tpu.train.checkpoint import ModelSerializer
 
-        reg = self.client.register()
+        try:
+            reg = self.client.register()
+        except RetryExhausted as exc:
+            # the coordinator is gone, not this worker: exit with the
+            # control-plane-lost code so the supervisor respawns without
+            # shrinking the world
+            log.error("registration lost the control plane: %s", exc)
+            raise SystemExit(EXIT_CONTROL_PLANE_LOST) from exc
         self.last_registration = reg
         rank, world = reg["rank"], reg["world"]
         generation = reg["generation"]
@@ -202,20 +251,32 @@ class ElasticWorkerLoop:
                     os._exit(EXIT_MEMBERSHIP_CHANGED)
                 if (step + 1) % self.save_every == 0 or step + 1 == total_steps:
                     # ALL ranks enter (cross-host-sharded leaves allgather
-                    # inside write_model_distributed); only the chief writes
+                    # inside write_model_distributed); only the chief writes.
+                    # write_model publishes atomically (tmp + fsync +
+                    # os.replace) itself now.
                     path = self._ckpt_path(step + 1)
-                    tmp = path + ".tmp"
                     if rank == 0:
                         os.makedirs(self.ckpt_dir, exist_ok=True)
-                    ModelSerializer.write_model_distributed(model, tmp)
+                    ModelSerializer.write_model_distributed(model, path)
                     if rank == 0:
-                        os.replace(tmp, path)       # atomic publish
-                        self.client.report_ckpt(step + 1, path)
+                        self.store.gc()
+                        try:
+                            self.client.report_ckpt(step + 1, path)
+                        except RetryExhausted as exc:
+                            # the file on disk is the ground truth; the
+                            # registry entry is an optimization.  Survivors
+                            # fall back to scanning ckpt_dir.
+                            log.warning("report_ckpt gave up: %s", exc)
         finally:
             # never leak the heartbeat: a raised bootstrap/step error would
             # otherwise keep this dead worker "alive" in membership forever
             hb.stop()
-        self.client.leave()
+        try:
+            self.client.leave()
+        except Exception:
+            # a flaky goodbye must not fail a COMPLETED run; the monitor
+            # will age this membership out by heartbeat timeout
+            log.warning("leave() failed after completed run", exc_info=True)
         return model
 
 
@@ -245,6 +306,12 @@ class ElasticSupervisor:
         self.min_world = min_world
         self.max_generations = max_generations
         self.generations_run = 0
+        # workers that exited EXIT_CONTROL_PLANE_LOST (retry budget
+        # exhausted against the coordinator) across all generations —
+        # tracked separately from evictions because they do NOT shrink
+        # the world: the worker was healthy, the control plane wasn't
+        self.control_plane_losses = 0
+        self.last_exit_codes: list[int] = []
 
     def run(self, timeout: float = 300.0) -> None:
         world = self.initial_world
@@ -280,8 +347,17 @@ class ElasticSupervisor:
                 raise TimeoutError(
                     f"elastic generation did not finish: {exc}"
                 ) from exc
+            self.last_exit_codes = rcs
             if all(rc == 0 for rc in rcs):
                 return
+            lost = sum(1 for rc in rcs if rc == EXIT_CONTROL_PLANE_LOST)
+            if lost:
+                self.control_plane_losses += lost
+                log.warning(
+                    "generation %d: %d worker(s) lost the control plane "
+                    "(retry-exhausted, NOT evicted) — respawning same world",
+                    generation, lost,
+                )
 
             def _evicted():
                 with self.server._lock:
@@ -291,12 +367,17 @@ class ElasticSupervisor:
                     ]
 
             # a worker killed outright (no fail() call) is only discovered
-            # by heartbeat timeout — give the ledger time to settle
-            settle_deadline = time.time() + self.server.heartbeat_timeout + 2
+            # by heartbeat timeout — give the ledger time to settle.  When
+            # every failure was a control-plane loss there is nobody to
+            # evict, so don't wall-clock the timeout for nothing.
             evicted = _evicted()
-            while not evicted and time.time() < settle_deadline:
-                time.sleep(0.25)
-                evicted = _evicted()
+            if lost != sum(1 for rc in rcs if rc != 0):
+                settle_deadline = (
+                    time.time() + self.server.heartbeat_timeout + 2
+                )
+                while not evicted and time.time() < settle_deadline:
+                    time.sleep(0.25)
+                    evicted = _evicted()
             # shrink by actual failures; collateral aborts respawn as-is
             world -= len(evicted)
         raise RuntimeError(f"elastic training did not converge in "

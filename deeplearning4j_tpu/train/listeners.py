@@ -306,12 +306,11 @@ class CheckpointListener(TrainingListener):
             from deeplearning4j_tpu.train.checkpoint import ModelSerializer
 
             try:
-                # tmp + rename: a process killed mid-write leaves no
-                # truncated zip behind, and the index only ever names
-                # fully-published files
-                tmp = path + ".tmp"
-                ModelSerializer.write_model(snap, tmp)
-                os.replace(tmp, path)
+                # write_model publishes atomically (tmp + fsync + rename):
+                # a process killed mid-write leaves no truncated zip
+                # behind, and the index only ever names fully-published
+                # files
+                ModelSerializer.write_model(snap, path)
                 self._finish(num, path, iteration, epoch)
             except BaseException as exc:   # surfaced by the next flush()
                 self._pending_error = exc

@@ -32,10 +32,12 @@ class PreemptionError(RuntimeError):
 class PreemptionHandler:
     """Signal-flag + checkpoint-on-next-step-boundary.
 
-    checkpointer: anything with save(model) + wait() (ShardedCheckpointer)
-    or save-like callable via `on_preempt`.  The signal handler itself
-    only sets a flag — async-signal-safe by construction; all real work
-    happens on the training thread at the next iteration boundary.
+    checkpointer: anything with save(model) + wait() (ShardedCheckpointer,
+    train.checkpoint.CheckpointStore — the latter adds manifest
+    verification + last-good fallback on the restore side) or a save-like
+    callable via `on_preempt`.  The signal handler itself only sets a
+    flag — async-signal-safe by construction; all real work happens on
+    the training thread at the next iteration boundary.
     """
 
     def __init__(self, checkpointer=None, *, signals=(signal.SIGTERM,),
@@ -51,15 +53,35 @@ class PreemptionHandler:
         self._installed = False
 
     # -- signal plumbing ---------------------------------------------------
+    @staticmethod
+    def _require_main_thread(what: str) -> None:
+        # CPython only allows signal.signal on the main thread; without
+        # this guard the caller gets a cryptic ValueError from deep inside
+        # listener() instead of an actionable message
+        if threading.current_thread() is not threading.main_thread():
+            raise RuntimeError(
+                f"PreemptionHandler.{what} must be called from the main "
+                "thread (signal handlers can only be (un)installed there); "
+                "install() on the main thread before handing the listener "
+                "to a worker thread"
+            )
+
     def install(self) -> "PreemptionHandler":
         if self._installed:
             return self
+        self._require_main_thread("install()")
         for sig in self._signals:
             self._prev[sig] = signal.signal(sig, self._on_signal)
         self._installed = True
         return self
 
     def uninstall(self) -> None:
+        """Restore the previous signal handlers.  Idempotent: safe to call
+        from a listener's on_fit_end AND again afterwards — the second and
+        later calls are no-ops."""
+        if not self._installed and not self._prev:
+            return
+        self._require_main_thread("uninstall()")
         for sig, prev in self._prev.items():
             signal.signal(sig, prev)
         self._prev.clear()

@@ -187,6 +187,13 @@ def main_elastic():
         jax_heartbeat_timeout_seconds=10,   # fast fail-the-world in tests
     )
 
+    # per-step pacing for the fault-plan tests: gives the survivors'
+    # heartbeat threads time to observe the abort at a STEP BOUNDARY, so
+    # they exit cleanly (EXIT_MEMBERSHIP_CHANGED) instead of wedging in a
+    # collective whose peer died and waiting out jax's own failure
+    # detection (which this jax version exposes no timeout knob for)
+    step_sleep = float(os.environ.get("DL4JTPU_TEST_STEP_SLEEP", "0") or 0)
+
     def on_step(model, step):
         if (
             WORKER_ID == victim
@@ -197,8 +204,24 @@ def main_elastic():
             # then die hard (no leave(), no cleanup)
             client.fail(reason="injected crash")
             os._exit(1)
+        if step_sleep:
+            import time
+
+            time.sleep(step_sleep)
 
     model = loop.run(build_model, local_shard, total_steps, on_step=on_step)
+    metrics_out = os.environ.get("DL4JTPU_TEST_METRICS_OUT", "")
+    if metrics_out:
+        # deterministic retry evidence under an every-Nth rpc-drop plan:
+        # three consecutive retryable rpcs guarantee at least one consult
+        # lands on a multiple of N<=3, forcing a retry that the policy
+        # absorbs — so dl4jtpu_rpc_retries_total is provably non-zero
+        for _ in range(3):
+            client.status()
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        with open(f"{metrics_out}.{WORKER_ID}.{os.getpid()}", "w") as f:
+            f.write(registry().to_prometheus_text())
     if OUT:
         with open(OUT, "a") as f:
             f.write(json.dumps({

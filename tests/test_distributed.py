@@ -246,6 +246,12 @@ class TestElasticRestore:
                     "DL4JTPU_TEST_CKPT_DIR": ckpt_dir,
                     "DL4JTPU_TEST_VICTIM": "w2",
                     "DL4JTPU_TEST_DIE_AT_STEP": 4,
+                    # pace steps so survivors observe the abort at a step
+                    # boundary and exit cleanly (EXIT_MEMBERSHIP_CHANGED)
+                    # instead of wedging in a dead collective until jax's
+                    # own failure detection (no timeout knob on this jax
+                    # version) SIGABRTs them ~a minute later
+                    "DL4JTPU_TEST_STEP_SLEEP": 0.6,
                 },
             )
             spawned.append(p)
