@@ -1,0 +1,46 @@
+"""tpulint — JAX-aware static analysis for this repo's invariants.
+
+Five rule families over stdlib ``ast`` (nothing is imported or
+executed during analysis):
+
+- **TP** trace purity: impure host calls, global mutation, print and
+  telemetry hooks inside jit/pmap/shard_map/lax.* traced bodies.
+- **RH** recompile/host-sync hazards: int/float/bool/len/.item()/
+  np.asarray/f-strings on tracers, Python if/while on tracer values.
+- **LK** lock discipline: Lock-adjacent mutable containers mutated
+  outside `with <lock>:`.
+- **RG** registry drift: metric families vs observe/metrics.py,
+  fault sites vs runtime/faults.py SITES, pytest marks vs pyproject.
+- **EH** error hygiene: bare except, swallowed exceptions, non-atomic
+  checkpoint publishes.
+
+Entry points: ``python -m deeplearning4j_tpu.analysis`` (or the
+``tpulint`` console script), or programmatically::
+
+    from deeplearning4j_tpu.analysis import lint_paths, LintContext
+    findings, errors = lint_paths(LintContext(project_root="."), ["pkg/"])
+
+Rule catalog and suppression/baseline workflow: docs/static_analysis.md.
+"""
+
+from deeplearning4j_tpu.analysis.baseline import (
+    Baseline, BaselineEntry, BaselineError, load_baseline,
+)
+from deeplearning4j_tpu.analysis.core import (
+    Finding, LintContext, ModuleUnit, RULE_CATALOG, lint_paths,
+)
+from deeplearning4j_tpu.analysis.report import (
+    SCHEMA, parse_json, render_json, render_text,
+)
+
+__all__ = [
+    "Baseline", "BaselineEntry", "BaselineError", "Finding",
+    "LintContext", "ModuleUnit", "RULE_CATALOG", "SCHEMA",
+    "lint_paths", "load_baseline", "parse_json", "render_json",
+    "render_text", "main",
+]
+
+
+def main(argv=None) -> int:
+    from deeplearning4j_tpu.analysis.__main__ import main as _main
+    return _main(argv)

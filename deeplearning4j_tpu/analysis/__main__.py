@@ -1,0 +1,158 @@
+"""tpulint CLI.
+
+    python -m deeplearning4j_tpu.analysis [paths...] [options]
+    tpulint [paths...] [options]            # console script
+
+With no paths, lints the deeplearning4j_tpu package the analyzer was
+imported from.  Exit codes: 0 clean (after baseline), 1 findings (or a
+malformed baseline / unparseable file), 2 usage error.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+from deeplearning4j_tpu.analysis import baseline as baseline_mod
+from deeplearning4j_tpu.analysis import report
+from deeplearning4j_tpu.analysis.core import (
+    RULE_CATALOG, LintContext, lint_paths,
+)
+
+_PKG_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_PROJECT_ROOT = os.path.dirname(_PKG_ROOT)
+DEFAULT_BASELINE = os.path.join(
+    _PKG_ROOT, "analysis", "baseline.toml"
+)
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpulint",
+        description="JAX-aware static analysis: trace purity (TP), "
+                    "recompile/host-sync hazards (RH), lock discipline "
+                    "(LK), registry drift (RG), error hygiene (EH).",
+    )
+    p.add_argument(
+        "paths", nargs="*",
+        help="files or directories to lint (default: the "
+             "deeplearning4j_tpu package)",
+    )
+    p.add_argument(
+        "--format", choices=("text", "json"), default="text",
+        help="report format (json is the archival schema "
+             f"{report.SCHEMA!r})",
+    )
+    p.add_argument(
+        "--baseline", default=None, metavar="TOML",
+        help="baseline allowlist (default: analysis/baseline.toml "
+             "next to the analyzer)",
+    )
+    p.add_argument(
+        "--no-baseline", action="store_true",
+        help="ignore the baseline: report every finding",
+    )
+    p.add_argument(
+        "--select", default=None, metavar="IDS",
+        help="comma-separated rule-ID prefixes to run "
+             "(e.g. 'LK,RG302')",
+    )
+    p.add_argument(
+        "--project-root", default=None, metavar="DIR",
+        help="root for relative paths + RG registry discovery "
+             "(default: the repo containing the analyzer)",
+    )
+    p.add_argument(
+        "--write-baseline", default=None, metavar="TOML",
+        help="write current findings as a starter baseline (reasons "
+             "are TODOs you must fill in) and exit 0",
+    )
+    p.add_argument(
+        "--list-rules", action="store_true",
+        help="print the rule catalog and exit",
+    )
+    return p
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+
+    if args.list_rules:
+        for rid in sorted(RULE_CATALOG):
+            print(f"{rid}  {RULE_CATALOG[rid]}")
+        return 0
+
+    project_root = os.path.abspath(args.project_root or DEFAULT_PROJECT_ROOT)
+    paths = args.paths or [_PKG_ROOT]
+    for p in paths:
+        if not os.path.exists(p):
+            print(f"tpulint: no such path: {p}", file=sys.stderr)
+            return 2
+
+    select = None
+    if args.select:
+        select = {s.strip() for s in args.select.split(",") if s.strip()}
+
+    ctx = LintContext(project_root=project_root, select=select)
+    findings, errors = lint_paths(ctx, paths)
+
+    if args.write_baseline:
+        pairs = []
+        for f in findings:
+            line = _source_line(project_root, f)
+            pairs.append((f, line))
+        with open(args.write_baseline, "w", encoding="utf-8") as fh:
+            fh.write(baseline_mod.render_baseline(pairs))
+        print(f"tpulint: wrote {len(pairs)} starter entries to "
+              f"{args.write_baseline} — fill in every reason")
+        if errors:
+            # a baseline bootstrapped over unparseable files is a lie:
+            # surface them and fail so the operator knows it's partial
+            for e in errors:
+                print(f"tpulint: error: {e}", file=sys.stderr)
+            return 1
+        return 0
+
+    base = baseline_mod.Baseline([])
+    if not args.no_baseline:
+        bpath = args.baseline or DEFAULT_BASELINE
+        try:
+            base = baseline_mod.load_baseline(bpath)
+        except baseline_mod.BaselineError as e:
+            print(f"tpulint: {e}", file=sys.stderr)
+            return 1
+
+    kept, baselined = [], []
+    for f in findings:
+        line = _source_line(project_root, f)
+        (baselined if base.match(f, line) else kept).append(f)
+
+    unused = base.unused()
+    if args.format == "json":
+        print(report.render_json(kept, baselined, errors, unused,
+                                 project_root))
+    else:
+        print(report.render_text(kept, baselined, errors, unused))
+    return 1 if (kept or errors) else 0
+
+
+def _source_line(project_root: str, finding) -> str:
+    path = os.path.join(project_root, finding.file)
+    try:
+        with open(path, "r", encoding="utf-8") as fh:
+            for i, line in enumerate(fh, 1):
+                if i == finding.line:
+                    return line.rstrip("\n")
+    except OSError:
+        pass
+    return ""
+
+
+if __name__ == "__main__":
+    try:
+        sys.exit(main())
+    except BrokenPipeError:
+        # reader (head, less) closed the pipe — that's their prerogative
+        os.dup2(os.open(os.devnull, os.O_WRONLY), sys.stdout.fileno())
+        sys.exit(0)

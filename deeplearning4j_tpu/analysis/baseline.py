@@ -1,0 +1,116 @@
+"""Baseline allowlist — vetted false positives, out-of-line.
+
+`analysis/baseline.toml` holds ``[[suppress]]`` entries.  Each entry
+MUST carry a non-empty ``reason`` (the gate test rejects baselines with
+silent entries — a baseline that can absorb true positives without a
+written justification defeats the whole gate):
+
+    [[suppress]]
+    rule = "LK201"
+    file = "deeplearning4j_tpu/ui/stats.py"
+    line_text = "self._index[sid] = offs"
+    reason = "only reached from _replay() which holds self._lock"
+
+Matching is by (rule, file, stripped source-line text) — NOT by line
+number, so unrelated edits above the site don't invalidate entries.
+``line_text`` may be omitted to baseline every finding of one rule in
+one file (coarse; use sparingly).  `match()` returns the entries a
+finding hit so the runner can report *unused* entries — a stale entry
+means the FP was fixed and the baseline must shrink.
+"""
+
+from __future__ import annotations
+
+import os
+from dataclasses import dataclass
+from typing import Iterable, Optional
+
+from deeplearning4j_tpu.analysis import tomlmini
+from deeplearning4j_tpu.analysis.core import Finding
+
+__all__ = ["BaselineEntry", "Baseline", "load_baseline", "BaselineError"]
+
+
+class BaselineError(ValueError):
+    """Malformed baseline file (bad TOML subset, missing reason, ...)."""
+
+
+@dataclass
+class BaselineEntry:
+    rule: str
+    file: str
+    reason: str
+    line_text: Optional[str] = None
+    hits: int = 0
+
+    def matches(self, finding: Finding, source_line: str) -> bool:
+        if self.rule != finding.rule or self.file != finding.file:
+            return False
+        if self.line_text is not None:
+            return self.line_text.strip() == source_line.strip()
+        return True
+
+
+class Baseline:
+    def __init__(self, entries: list):
+        self.entries: list[BaselineEntry] = entries
+
+    def match(self, finding: Finding, source_line: str) -> bool:
+        hit = False
+        for e in self.entries:
+            if e.matches(finding, source_line):
+                e.hits += 1
+                hit = True
+        return hit
+
+    def unused(self) -> list:
+        return [e for e in self.entries if e.hits == 0]
+
+
+def load_baseline(path: str) -> Baseline:
+    if not os.path.exists(path):
+        return Baseline([])
+    with open(path, "r", encoding="utf-8") as f:
+        try:
+            data = tomlmini.parse(f.read())
+        except tomlmini.TomlSubsetError as e:
+            raise BaselineError(f"{path}: {e}") from e
+    raw = data.get("suppress", [])
+    if not isinstance(raw, list):
+        raise BaselineError(f"{path}: [[suppress]] must be array-of-tables")
+    entries: list[BaselineEntry] = []
+    for i, d in enumerate(raw):
+        where = f"{path} [[suppress]] #{i + 1}"
+        for req in ("rule", "file", "reason"):
+            if not str(d.get(req, "")).strip():
+                raise BaselineError(
+                    f"{where}: {req!r} is required and must be non-empty "
+                    "(every baselined finding needs a written "
+                    "justification)"
+                )
+        entries.append(BaselineEntry(
+            rule=d["rule"], file=d["file"], reason=d["reason"],
+            line_text=d.get("line_text"),
+        ))
+    return Baseline(entries)
+
+
+def render_baseline(findings: Iterable[tuple]) -> str:
+    """Render (finding, source_line) pairs as a starter baseline.  Every
+    reason is a TODO the author must replace — load_baseline accepts
+    the file, but a reviewer should never let a TODO through."""
+    lines = [
+        "# tpulint baseline — vetted FALSE POSITIVES only.",
+        "# Every entry must explain WHY the finding is wrong; true",
+        "# positives get fixed, not parked here.",
+        "",
+    ]
+    for finding, source_line in findings:
+        lines.append("[[suppress]]")
+        lines.append(f'rule = "{finding.rule}"')
+        lines.append(f'file = "{finding.file}"')
+        text = source_line.strip().replace("\\", "\\\\").replace('"', '\\"')
+        lines.append(f'line_text = "{text}"')
+        lines.append('reason = "TODO: justify or fix"')
+        lines.append("")
+    return "\n".join(lines)

@@ -1,0 +1,288 @@
+"""tpulint core — findings, module units, suppressions, and the runner.
+
+The analyzer is a thin orchestration layer over five rule families
+(see `rules/`): each family exposes ``check_module(ctx, unit)`` and
+yields `Finding`s.  Everything here is stdlib-``ast`` only — tpulint
+must run in CI containers that have nothing installed beyond the
+package's own dependencies, and must never import the code it lints
+(a module with a side-effectful import would otherwise run during
+analysis).
+
+Suppression syntax (documented in docs/static_analysis.md):
+
+- same-line:   ``x = risky()  # tpulint: disable=LK201``
+               (comma-separated rule IDs, or ``all``)
+- whole-file:  ``# tpulint: disable-file=RG303`` anywhere in the first
+               15 lines of the file.
+
+Suppressions silence *vetted false positives at the call site*; the
+baseline (`baseline.py`) silences vetted false positives *out-of-line*
+so third-party-shaped code does not grow lint chatter.  True positives
+belong in neither — fix them.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from dataclasses import dataclass, field
+from typing import Iterable, Iterator, Optional
+
+__all__ = [
+    "Finding", "ModuleUnit", "LintContext", "collect_py_files",
+    "load_unit", "lint_paths", "RULE_CATALOG",
+]
+
+# Rule catalog: every ID tpulint can emit, with its one-line contract.
+# docs/static_analysis.md holds the long-form rationale per rule.
+RULE_CATALOG: dict[str, str] = {
+    "TP001": "impure call (time/random/os.environ/open/...) inside a "
+             "traced (jit/pmap/shard_map/scan) body",
+    "TP002": "print() inside a traced body",
+    "TP003": "global/nonlocal mutation declared inside a traced body",
+    "TP004": "telemetry call (metrics registry / fault site) inside a "
+             "traced body",
+    "RH101": "host conversion (int/float/bool/len/.item()/np.asarray/"
+             ".tolist()) of a tracer inside a traced body",
+    "RH102": "Python if/while on a tracer value inside a traced body",
+    "RH103": "tracer interpolated into an f-string inside a traced body",
+    "LK201": "instance container guarded by a sibling Lock mutated "
+             "outside `with <lock>:`",
+    "LK202": "module-level container guarded by a module Lock mutated "
+             "outside `with <lock>:`",
+    "RG301": "metric family used but not pre-declared in "
+             "observe/metrics.py:_declare_core",
+    "RG302": "fault-site string not registered in runtime/faults.py "
+             "SITES",
+    "RG303": "pytest.mark.<name> not declared in pyproject.toml markers",
+    "EH401": "bare `except:`",
+    "EH402": "swallowed exception: `except Exception/BaseException:` "
+             "whose body is only pass/...",
+    "EH403": "checkpoint-publishing write without tmp-file + os.replace",
+}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*tpulint:\s*disable=([A-Za-z0-9_,\s]+?|all)\s*(?:#|$)"
+)
+_SUPPRESS_FILE_RE = re.compile(
+    r"#\s*tpulint:\s*disable-file=([A-Za-z0-9_,\s]+?|all)\s*(?:#|$)"
+)
+_FILE_SUPPRESS_SCAN_LINES = 15
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One violation.  `file` is project-root-relative posix; `line` is
+    1-based and always points at real source (reporters print it)."""
+
+    rule: str
+    file: str
+    line: int
+    col: int
+    message: str
+    symbol: str = ""       # enclosing def/class qualname, "" at module level
+
+    def sort_key(self) -> tuple:
+        return (self.file, self.line, self.col, self.rule)
+
+    def to_dict(self) -> dict:
+        return {
+            "rule": self.rule, "file": self.file, "line": self.line,
+            "col": self.col, "message": self.message, "symbol": self.symbol,
+        }
+
+    @staticmethod
+    def from_dict(d: dict) -> "Finding":
+        return Finding(
+            rule=d["rule"], file=d["file"], line=int(d["line"]),
+            col=int(d["col"]), message=d["message"],
+            symbol=d.get("symbol", ""),
+        )
+
+
+@dataclass
+class ModuleUnit:
+    """One parsed source file."""
+
+    path: str              # absolute
+    relpath: str           # posix, relative to project root
+    source: str
+    tree: ast.Module
+    lines: list[str] = field(default_factory=list)
+
+    def line_text(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1]
+        return ""
+
+
+@dataclass
+class LintContext:
+    """Project-wide facts the rules consult.
+
+    The registry-drift (RG) family needs to know what the project
+    declares; those sets are resolved lazily from `project_root` by
+    `rules/registry.py` unless a test injects them explicitly.
+    """
+
+    project_root: str
+    declared_families: Optional[set] = None      # metric family names
+    fault_sites: Optional[set] = None            # runtime/faults.py SITES
+    declared_marks: Optional[set] = None         # pyproject markers
+    select: Optional[set] = None                 # rule-ID prefix filter
+    # EH rules apply to these package subpackages (plus any file outside
+    # the package, e.g. tests/ entrypoints and lint fixtures).
+    eh_scope: tuple = ("runtime", "train", "observe", "analysis")
+
+    def wants(self, rule_id: str) -> bool:
+        if not self.select:
+            return True
+        return any(rule_id.startswith(s) for s in self.select)
+
+
+def collect_py_files(paths: Iterable[str]) -> list[str]:
+    """Expand files/dirs into a sorted list of .py files.  Hidden dirs,
+    __pycache__ and build/egg dirs are skipped."""
+    out: list[str] = []
+    skip_dirs = {"__pycache__", "build", "dist", ".git"}
+    for p in paths:
+        p = os.path.abspath(p)
+        if os.path.isfile(p):
+            if p.endswith(".py"):
+                out.append(p)
+            continue
+        for root, dirs, files in os.walk(p):
+            dirs[:] = sorted(
+                d for d in dirs
+                if not d.startswith(".") and d not in skip_dirs
+                and not d.endswith(".egg-info")
+            )
+            for f in sorted(files):
+                if f.endswith(".py"):
+                    out.append(os.path.join(root, f))
+    # stable + deduped
+    seen: set = set()
+    uniq = []
+    for p in out:
+        if p not in seen:
+            seen.add(p)
+            uniq.append(p)
+    return uniq
+
+
+def load_unit(path: str, project_root: str) -> ModuleUnit:
+    with open(path, "r", encoding="utf-8") as f:
+        source = f.read()
+    rel = os.path.relpath(path, project_root).replace(os.sep, "/")
+    tree = ast.parse(source, filename=path)
+    return ModuleUnit(
+        path=path, relpath=rel, source=source, tree=tree,
+        lines=source.splitlines(),
+    )
+
+
+def _file_suppressions(unit: ModuleUnit) -> set:
+    rules: set = set()
+    for line in unit.lines[:_FILE_SUPPRESS_SCAN_LINES]:
+        m = _SUPPRESS_FILE_RE.search(line)
+        if m:
+            rules.update(r.strip() for r in m.group(1).split(","))
+    return rules
+
+
+def _line_suppressions(text: str) -> set:
+    m = _SUPPRESS_RE.search(text)
+    if not m:
+        return set()
+    return {r.strip() for r in m.group(1).split(",")}
+
+
+def apply_suppressions(
+    unit: ModuleUnit, findings: Iterable[Finding]
+) -> list[Finding]:
+    """Drop findings silenced by `# tpulint: disable=...` comments."""
+    file_off = _file_suppressions(unit)
+    kept = []
+    for f in findings:
+        if "all" in file_off or f.rule in file_off:
+            continue
+        on_line = _line_suppressions(unit.line_text(f.line))
+        if "all" in on_line or f.rule in on_line:
+            continue
+        kept.append(f)
+    return kept
+
+
+class ScopedVisitor(ast.NodeVisitor):
+    """NodeVisitor that tracks the enclosing def/class qualname so rules
+    can stamp findings with a `symbol`."""
+
+    def __init__(self) -> None:
+        self._scope: list[str] = []
+
+    @property
+    def scope_name(self) -> str:
+        return ".".join(self._scope)
+
+    def _push(self, name: str, node: ast.AST) -> None:
+        self._scope.append(name)
+        self.generic_visit(node)
+        self._scope.pop()
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._push(node.name, node)
+
+    def visit_AsyncFunctionDef(self, node: ast.AsyncFunctionDef) -> None:
+        self._push(node.name, node)
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._push(node.name, node)
+
+
+def dotted_name(node: ast.AST) -> Optional[str]:
+    """`a.b.c` for Name/Attribute chains, None for anything dynamic."""
+    parts: list[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def lint_unit(ctx: LintContext, unit: ModuleUnit) -> list[Finding]:
+    from deeplearning4j_tpu.analysis.rules import ALL_CHECKERS
+
+    findings: list[Finding] = []
+    for checker in ALL_CHECKERS:
+        findings.extend(
+            f for f in checker(ctx, unit) if ctx.wants(f.rule)
+        )
+    findings = apply_suppressions(unit, findings)
+    return sorted(findings, key=Finding.sort_key)
+
+
+def lint_paths(
+    ctx: LintContext, paths: Iterable[str]
+) -> tuple[list[Finding], list[str]]:
+    """Lint every .py under `paths`.  Returns (findings, errors) where
+    errors are human-readable parse/read failures (a file tpulint cannot
+    parse is itself reported, never silently skipped)."""
+    findings: list[Finding] = []
+    errors: list[str] = []
+    for path in collect_py_files(paths):
+        try:
+            unit = load_unit(path, ctx.project_root)
+        except (SyntaxError, UnicodeDecodeError, OSError) as e:
+            errors.append(f"{path}: {e}")
+            continue
+        findings.extend(lint_unit(ctx, unit))
+    return sorted(findings, key=Finding.sort_key), errors

@@ -1,0 +1,102 @@
+"""Reporters: human text and machine JSON.
+
+The JSON schema is versioned and round-trips through
+`core.Finding.from_dict` — bench/CI archive these reports next to
+BENCH_*.json, so the shape is a contract:
+
+    {
+      "schema": "tpulint-report/1",
+      "root": "<project root>",
+      "findings": [{rule,file,line,col,message,symbol}, ...],
+      "baselined": [... same shape ...],
+      "counts": {"TP001": 2, ...},        # non-baselined only
+      "errors": ["unparseable file: ..."],
+      "unused_baseline": [{rule,file,line_text,reason}, ...]
+    }
+"""
+
+from __future__ import annotations
+
+import json
+
+from deeplearning4j_tpu.analysis.core import RULE_CATALOG, Finding
+
+SCHEMA = "tpulint-report/1"
+
+
+def render_text(
+    findings: list,
+    baselined: list,
+    errors: list,
+    unused_baseline: list,
+    verbose_catalog: bool = False,
+) -> str:
+    out: list[str] = []
+    for f in findings:
+        sym = f" [{f.symbol}]" if f.symbol else ""
+        out.append(f"{f.file}:{f.line}:{f.col + 1}: {f.rule} "
+                   f"{f.message}{sym}")
+    for e in errors:
+        out.append(f"error: {e}")
+    for e in unused_baseline:
+        out.append(
+            f"warning: unused baseline entry ({e.rule} {e.file}"
+            + (f" {e.line_text!r}" if e.line_text else "")
+            + ") — the false positive is gone; delete the entry"
+        )
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    if findings:
+        by_rule = ", ".join(
+            f"{r}×{n}" for r, n in sorted(counts.items())
+        )
+        out.append(f"tpulint: {len(findings)} finding"
+                   f"{'s' if len(findings) != 1 else ''} ({by_rule})"
+                   + (f"; {len(baselined)} baselined" if baselined else ""))
+        if verbose_catalog:
+            for r in sorted(counts):
+                out.append(f"  {r}: {RULE_CATALOG.get(r, '?')}")
+    else:
+        suffix = f" ({len(baselined)} baselined)" if baselined else ""
+        out.append(f"tpulint: clean{suffix}")
+    return "\n".join(out)
+
+
+def render_json(
+    findings: list,
+    baselined: list,
+    errors: list,
+    unused_baseline: list,
+    root: str,
+) -> str:
+    counts: dict[str, int] = {}
+    for f in findings:
+        counts[f.rule] = counts.get(f.rule, 0) + 1
+    doc = {
+        "schema": SCHEMA,
+        "root": root,
+        "findings": [f.to_dict() for f in findings],
+        "baselined": [f.to_dict() for f in baselined],
+        "counts": counts,
+        "errors": list(errors),
+        "unused_baseline": [
+            {
+                "rule": e.rule, "file": e.file,
+                "line_text": e.line_text, "reason": e.reason,
+            }
+            for e in unused_baseline
+        ],
+    }
+    return json.dumps(doc, indent=2, sort_keys=True)
+
+
+def parse_json(text: str) -> dict:
+    """Inverse of render_json, with findings rehydrated to `Finding`s
+    (used by the golden tests and by bench tooling that diffs runs)."""
+    doc = json.loads(text)
+    if doc.get("schema") != SCHEMA:
+        raise ValueError(f"not a {SCHEMA} document")
+    doc["findings"] = [Finding.from_dict(d) for d in doc["findings"]]
+    doc["baselined"] = [Finding.from_dict(d) for d in doc["baselined"]]
+    return doc

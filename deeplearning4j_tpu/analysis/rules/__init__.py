@@ -1,0 +1,19 @@
+"""Rule families.  Each module exposes ``check_module(ctx, unit)``
+yielding Findings; `ALL_CHECKERS` is the run order (stable so reports
+diff cleanly)."""
+
+from deeplearning4j_tpu.analysis.rules import (
+    errors as _errors,
+    locks as _locks,
+    registry as _registry,
+    trace as _trace,
+)
+
+ALL_CHECKERS = (
+    _trace.check_module,
+    _locks.check_module,
+    _registry.check_module,
+    _errors.check_module,
+)
+
+__all__ = ["ALL_CHECKERS"]

@@ -1,0 +1,176 @@
+"""EH — error hygiene on the paths where swallowed errors cost runs.
+
+EH401: a bare ``except:`` catches ``KeyboardInterrupt`` and
+``SystemExit`` — on the runtime/train paths that means a worker that
+cannot be interrupted out of a wedged collective, and a preemption
+SIGTERM handler that never runs.
+
+EH402: ``except Exception:`` (or ``BaseException``) whose body is only
+``pass``/``...`` erases the failure entirely — the checkpoint-verify
+and control-plane work of PR 3 exists precisely because silent
+failures turn into corrupt state three steps later.  Narrow the type,
+or at least record the error.
+
+EH403: a function that *publishes* a checkpoint-shaped file (its name
+or module says checkpoint/ckpt/snapshot and it opens a path for
+writing) must follow the tmp-file + ``os.replace`` protocol from
+train/checkpoint.py — a plain ``open(path, "wb")`` over the previous
+checkpoint is a torn write under kill-9 and the whole reason
+CheckpointStore exists.
+
+Scope: these rules run on files under the package subpackages in
+``ctx.eh_scope`` (runtime/train/observe/analysis — the code that runs
+unattended) and on any file OUTSIDE the package (test entrypoints,
+fixtures).  Import-probe ``except Exception: pass`` in optional-dep
+shims elsewhere in the package is deliberate and out of scope.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from typing import Iterator
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, LintContext, ModuleUnit, dotted_name, str_const,
+)
+
+_CKPT_NAME_RE = re.compile(r"(ckpt|checkpoint|snapshot)", re.IGNORECASE)
+_WRITEISH_RE = re.compile(r"(write|save|publish|dump|store)", re.IGNORECASE)
+PKG_PREFIX = "deeplearning4j_tpu/"
+
+
+def _in_scope(ctx: LintContext, unit: ModuleUnit) -> bool:
+    rel = unit.relpath
+    if not rel.startswith(PKG_PREFIX):
+        return True
+    parts = rel[len(PKG_PREFIX):].split("/")
+    return bool(parts) and parts[0] in ctx.eh_scope
+
+
+def _swallows(handler: ast.ExceptHandler) -> bool:
+    """Body is only pass / ... — nothing recorded, nothing re-raised."""
+    for stmt in handler.body:
+        if isinstance(stmt, ast.Pass):
+            continue
+        if (isinstance(stmt, ast.Expr)
+                and isinstance(stmt.value, ast.Constant)
+                and stmt.value.value is ...):
+            continue
+        return False
+    return True
+
+
+def _broad_type(handler: ast.ExceptHandler) -> bool:
+    t = handler.type
+    if t is None:
+        return True
+    d = dotted_name(t)
+    if d in ("Exception", "BaseException"):
+        return True
+    if isinstance(t, ast.Tuple):
+        return any(
+            dotted_name(el) in ("Exception", "BaseException")
+            for el in t.elts
+        )
+    return False
+
+
+def _iter_write_opens(func: ast.AST):
+    """(call, path_expr) for open(..., 'w*') / ZipFile(..., 'w') calls."""
+    for n in ast.walk(func):
+        if not isinstance(n, ast.Call) or not n.args:
+            continue
+        d = dotted_name(n.func)
+        mode = None
+        if d == "open" and len(n.args) >= 2:
+            mode = str_const(n.args[1])
+        elif d in ("zipfile.ZipFile", "ZipFile") and len(n.args) >= 2:
+            mode = str_const(n.args[1])
+        else:
+            for kw in n.keywords:
+                if kw.arg == "mode":
+                    if d == "open" or d in ("zipfile.ZipFile", "ZipFile"):
+                        mode = str_const(kw.value)
+        if mode and ("w" in mode or "x" in mode or "a" in mode):
+            yield n, n.args[0]
+
+
+def _mentions_tmp(expr: ast.AST) -> bool:
+    for n in ast.walk(expr):
+        if isinstance(n, ast.Constant) and isinstance(n.value, str):
+            if "tmp" in n.value or "temp" in n.value:
+                return True
+        elif isinstance(n, ast.Name) and "tmp" in n.id.lower():
+            return True
+        elif isinstance(n, ast.Attribute) and "tmp" in n.attr.lower():
+            return True
+    return False
+
+
+def _calls_replace(func: ast.AST) -> bool:
+    for n in ast.walk(func):
+        if isinstance(n, ast.Call):
+            d = dotted_name(n.func)
+            if d in ("os.replace", "os.rename"):
+                return True
+    return False
+
+
+def check_module(ctx: LintContext, unit: ModuleUnit) -> Iterator[Finding]:
+    if not _in_scope(ctx, unit):
+        return
+
+    # EH401 / EH402 — walk all handlers with enclosing-symbol tracking
+    parents: dict[int, str] = {}
+    for node in ast.walk(unit.tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.ClassDef)):
+            for child in ast.walk(node):
+                if isinstance(child, ast.ExceptHandler):
+                    # innermost wins: later (deeper) walks overwrite
+                    parents[id(child)] = node.name
+
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        symbol = parents.get(id(node), "")
+        if node.type is None:
+            yield Finding(
+                "EH401", unit.relpath, node.lineno, node.col_offset,
+                "bare `except:` also catches KeyboardInterrupt/"
+                "SystemExit — name the exception type", symbol,
+            )
+            continue
+        if _broad_type(node) and _swallows(node):
+            yield Finding(
+                "EH402", unit.relpath, node.lineno, node.col_offset,
+                "`except Exception: pass` swallows the failure — narrow "
+                "the type or record the error before continuing", symbol,
+            )
+
+    # EH403 — checkpoint-publishing writes
+    module_ckptish = _CKPT_NAME_RE.search(unit.relpath) is not None
+    for node in ast.walk(unit.tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        name_ckptish = (
+            _CKPT_NAME_RE.search(node.name) is not None
+            or node.name in ("write_model", "save_model")
+        )
+        if not (_WRITEISH_RE.search(node.name)
+                and (module_ckptish or name_ckptish)):
+            continue
+        has_replace = _calls_replace(node)
+        for call, path_expr in _iter_write_opens(node):
+            if _mentions_tmp(path_expr):
+                continue          # writing the tmp side of the protocol
+            if has_replace:
+                continue          # same function publishes atomically
+            yield Finding(
+                "EH403", unit.relpath, call.lineno, call.col_offset,
+                f"{node.name}() writes a checkpoint path directly — "
+                "write to `path + '.tmp'`, fsync, then os.replace() so "
+                "kill-9 mid-write can never publish a torn file",
+                node.name,
+            )

@@ -1,0 +1,335 @@
+"""LK — lock discipline for Lock-adjacent mutable containers.
+
+The repo's concurrency-heavy subsystems (MetricsRegistry, the
+coordinator's membership ledgers, FaultPlan's per-site counters,
+FileStatsStorage's index) all follow one convention: shared mutable
+state lives next to a ``threading.Lock``/``RLock`` and every mutation
+happens under ``with <lock>:``.  Nothing enforced that convention —
+one forgotten ``with`` is a read-modify-write race that only fires
+under scrape-while-train load.
+
+LK201 (instance level): a class whose methods assign both
+``self.X = threading.Lock()`` and ``self.Y = {}/[]/set()/...`` must
+mutate ``self.Y`` only inside a ``with self.<some lock attr>:`` block.
+``__init__`` is exempt (construction happens-before publication).
+
+LK202 (module level): same contract for module-global containers
+declared in a module that also declares a module-global Lock.  Module
+top-level statements are exempt (the import lock serializes them).
+
+Scoping is lexical and per-function: a closure defined inside a
+``with`` block is scanned as its own scope with the lock NOT held —
+it runs whenever it is later called, not where it was defined.  A
+mutation in a helper that every caller invokes while holding the lock
+is a vetted false positive: suppress at the site with
+``# tpulint: disable=LK201`` (say which lock the caller holds) or
+baseline it.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Callable, Iterator, Optional
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, LintContext, ModuleUnit, dotted_name,
+)
+
+LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "Lock", "RLock",
+    "threading.Condition", "Condition",
+}
+CONTAINER_CTORS = {
+    "dict", "list", "set", "collections.OrderedDict", "OrderedDict",
+    "collections.defaultdict", "defaultdict", "collections.deque",
+    "deque", "collections.Counter", "Counter",
+}
+MUTATORS = {
+    "append", "add", "update", "pop", "clear", "extend", "remove",
+    "discard", "insert", "setdefault", "popitem", "appendleft",
+    "popleft", "sort", "reverse",
+}
+
+FuncDef = (ast.FunctionDef, ast.AsyncFunctionDef)
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) in LOCK_CTORS)
+
+
+def _is_container_ctor(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    return (isinstance(node, ast.Call)
+            and dotted_name(node.func) in CONTAINER_CTORS)
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _assign_pairs(node: ast.AST):
+    """(target, value) pairs for plain AND annotated assignments, so
+    `_CACHE: dict = {}` declares a container just like `_CACHE = {}`."""
+    if isinstance(node, ast.Assign):
+        for t in node.targets:
+            yield t, node.value
+    elif isinstance(node, ast.AnnAssign) and node.value is not None:
+        yield node.target, node.value
+
+
+class _ScopeScan:
+    """Scan ONE function scope.  Nested defs/lambdas are returned as
+    fresh scopes (with their qualname) instead of being descended into:
+    a closure body does not inherit the lexically-enclosing `with`."""
+
+    def __init__(
+        self,
+        unit: ModuleUnit,
+        rule: str,
+        where: str,
+        match_target: Callable[[ast.AST], Optional[str]],
+        is_lock_expr: Callable[[ast.AST], bool],
+        flag_rebinds: bool = True,
+    ):
+        self.unit = unit
+        self.rule = rule
+        self.where = where
+        self.match_target = match_target
+        self.is_lock_expr = is_lock_expr
+        self.flag_rebinds = flag_rebinds
+        self.findings: list[Finding] = []
+        self.nested: list[tuple[str, ast.AST]] = []
+
+    def _flag(self, node: ast.AST, name: str, verb: str) -> None:
+        self.findings.append(Finding(
+            self.rule, self.unit.relpath, node.lineno, node.col_offset,
+            f"{verb} of lock-guarded container `{name}` outside "
+            "`with <lock>:`", self.where,
+        ))
+
+    def run(self, body: list, lock_depth: int = 0) -> None:
+        for stmt in body:
+            self._stmt(stmt, lock_depth)
+
+    # ------------------------------------------------------------------
+    def _stmt(self, node: ast.AST, depth: int) -> None:
+        if isinstance(node, FuncDef):
+            self.nested.append((f"{self.where}.{node.name}", node))
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            takes = any(
+                self.is_lock_expr(i.context_expr) for i in node.items
+            )
+            for i in node.items:
+                self._expr(i.context_expr, depth)
+            self.run(node.body, depth + 1 if takes else depth)
+            return
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                self._store_target(t, depth)
+            self._expr(node.value, depth)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._store_target(node.target, depth, aug=True)
+            self._expr(node.value, depth)
+            return
+        if isinstance(node, ast.AnnAssign):
+            self._store_target(node.target, depth)
+            if node.value is not None:
+                self._expr(node.value, depth)
+            return
+        if isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript):
+                    name = self.match_target(t.value)
+                    if name is not None and depth == 0:
+                        self._flag(t, name, "item deletion")
+            return
+        # generic: recurse statements, scan expressions
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.stmt):
+                self._stmt(child, depth)
+            elif isinstance(child, ast.expr):
+                self._expr(child, depth)
+            elif isinstance(child, ast.excepthandler):
+                for s in child.body:
+                    self._stmt(s, depth)
+
+    def _store_target(self, target: ast.AST, depth: int,
+                      aug: bool = False) -> None:
+        verb = "augmented " if aug else ""
+        if isinstance(target, (ast.Tuple, ast.List)):
+            for el in target.elts:
+                self._store_target(el, depth, aug)
+            return
+        if isinstance(target, ast.Subscript):
+            name = self.match_target(target.value)
+            if name is not None and depth == 0:
+                self._flag(target, name, verb + "item assignment")
+            self._expr(target.slice, depth)
+            return
+        if isinstance(target, ast.Starred):
+            self._store_target(target.value, depth, aug)
+            return
+        name = self.match_target(target)
+        if name is not None and depth == 0 and self.flag_rebinds:
+            self._flag(target, name, verb + "rebinding")
+
+    def _expr(self, node: ast.AST, depth: int) -> None:
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Lambda):
+                self.nested.append((f"{self.where}.<lambda>", n))
+                continue
+            if (isinstance(n, ast.Call)
+                    and isinstance(n.func, ast.Attribute)
+                    and n.func.attr in MUTATORS):
+                name = self.match_target(n.func.value)
+                if name is not None and depth == 0:
+                    self._flag(n, f"{name}.{n.func.attr}()", "mutating call")
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _scan_scopes(
+    unit: ModuleUnit, rule: str, seeds: list,
+    match_target, is_lock_expr, flag_rebinds_for: Callable[[ast.AST], bool],
+) -> Iterator[Finding]:
+    """Run _ScopeScan over seed (name, funcdef) scopes and every nested
+    scope discovered, each with the lock considered NOT held at entry."""
+    work = list(seeds)
+    while work:
+        where, func = work.pop(0)
+        scan = _ScopeScan(
+            unit, rule, where, match_target, is_lock_expr,
+            flag_rebinds=flag_rebinds_for(func),
+        )
+        if isinstance(func, ast.Lambda):
+            scan._expr(func.body, 0)
+        else:
+            scan.run(func.body)
+        yield from scan.findings
+        work.extend(scan.nested)
+
+
+# ---------------------------------------------------------------------
+# instance level (LK201)
+
+
+def _class_guarded_state(cls: ast.ClassDef) -> tuple[set, set]:
+    """(lock attrs, container attrs) assigned as `self.X = ...` anywhere
+    in the class's methods (locks are usually made in __init__ but
+    re-open paths recreate containers elsewhere)."""
+    locks: set = set()
+    containers: set = set()
+    for method in cls.body:
+        if not isinstance(method, FuncDef):
+            continue
+        for n in ast.walk(method):
+            for t, value in _assign_pairs(n):
+                attr = _self_attr(t)
+                if attr is None:
+                    continue
+                if _is_lock_ctor(value):
+                    locks.add(attr)
+                elif _is_container_ctor(value):
+                    containers.add(attr)
+    return locks, containers
+
+
+def _check_class(unit: ModuleUnit, cls: ast.ClassDef) -> Iterator[Finding]:
+    locks, containers = _class_guarded_state(cls)
+    if not locks or not containers:
+        return
+
+    def match_target(expr: ast.AST) -> Optional[str]:
+        attr = _self_attr(expr)
+        if attr in containers:
+            return f"self.{attr}"
+        return None
+
+    def is_lock_expr(expr: ast.AST) -> bool:
+        return _self_attr(expr) in locks
+
+    seeds = [
+        (f"{cls.name}.{m.name}", m) for m in cls.body
+        if isinstance(m, FuncDef) and m.name != "__init__"
+    ]
+    # rebinding self.<container> wholesale is allowed only in __init__;
+    # everywhere else it swaps shared state and needs the lock
+    yield from _scan_scopes(
+        unit, "LK201", seeds, match_target, is_lock_expr,
+        flag_rebinds_for=lambda f: True,
+    )
+
+
+# ---------------------------------------------------------------------
+# module level (LK202)
+
+
+def _module_guarded_state(tree: ast.Module) -> tuple[set, set]:
+    locks: set = set()
+    containers: set = set()
+    for n in tree.body:
+        for t, value in _assign_pairs(n):
+            if not isinstance(t, ast.Name):
+                continue
+            if _is_lock_ctor(value):
+                locks.add(t.id)
+            elif _is_container_ctor(value):
+                containers.add(t.id)
+    return locks, containers
+
+
+def _check_module_globals(
+    unit: ModuleUnit, tree: ast.Module
+) -> Iterator[Finding]:
+    locks, containers = _module_guarded_state(tree)
+    if not locks or not containers:
+        return
+
+    def match_target(expr: ast.AST) -> Optional[str]:
+        if isinstance(expr, ast.Name) and expr.id in containers:
+            return expr.id
+        return None
+
+    def is_lock_expr(expr: ast.AST) -> bool:
+        return isinstance(expr, ast.Name) and expr.id in locks
+
+    def flag_rebinds_for(func: ast.AST) -> bool:
+        # plain `NAME = ...` in a function without `global NAME` binds a
+        # local — only a declared-global rebind touches shared state
+        if isinstance(func, ast.Lambda):
+            return False
+        return any(
+            isinstance(g, ast.Global) and (set(g.names) & containers)
+            for g in ast.walk(func)
+        )
+
+    # seed with top-level functions only: _scan_scopes discovers nested
+    # scopes itself, so each function body is scanned exactly once
+    seeds = []
+    for n in tree.body:
+        if isinstance(n, FuncDef):
+            seeds.append((n.name, n))
+        elif isinstance(n, ast.ClassDef):
+            for m in n.body:
+                if isinstance(m, FuncDef):
+                    seeds.append((f"{n.name}.{m.name}", m))
+    yield from _scan_scopes(
+        unit, "LK202", seeds, match_target, is_lock_expr, flag_rebinds_for,
+    )
+
+
+def check_module(ctx: LintContext, unit: ModuleUnit) -> Iterator[Finding]:
+    yield from _check_module_globals(unit, unit.tree)
+    for n in ast.walk(unit.tree):
+        if isinstance(n, ast.ClassDef):
+            yield from _check_class(unit, n)

@@ -1,0 +1,231 @@
+"""RG — registry drift: stringly-typed registries must not diverge.
+
+Three registries in this repo are addressed by string at the call site
+and declared somewhere else entirely; nothing but reviewer eyeballs
+kept them consistent before this rule:
+
+- **metric families** (RG301): every
+  ``*.counter("name")`` / ``*.gauge("name")`` / ``*.histogram("name")``
+  call with a literal family name must name a family pre-declared in
+  ``observe/metrics.py:_declare_core`` — otherwise a fresh process's
+  ``/metrics`` is missing series that dashboards and alerts were
+  written against, and typos silently create parallel families.
+- **fault sites** (RG302): every literal passed to
+  ``faults.maybe_fail(...)`` must exist in ``runtime/faults.py``'s
+  ``SITES`` table — an unregistered site means a fault plan targeting
+  it silently never fires (the worst kind of fault-test rot).
+- **pytest marks** (RG303): every ``pytest.mark.<name>`` must be a
+  pytest builtin or declared in ``pyproject.toml`` ``markers`` — with
+  ``--strict-markers`` ambitions and marker-driven tier gating, an
+  undeclared mark is a silently-deselected test.
+
+The declared sets are parsed from the project's own sources (AST for
+the Python side, `tomlmini` for pyproject) at lint startup — the
+analyzer never imports the code it checks.  Tests inject the sets
+directly on LintContext.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+from typing import Iterator, Optional
+
+from deeplearning4j_tpu.analysis import tomlmini
+from deeplearning4j_tpu.analysis.core import (
+    Finding, LintContext, ModuleUnit, dotted_name, str_const,
+)
+
+FAMILY_METHODS = {"counter", "gauge", "histogram"}
+DECLARING_FUNC = "_declare_core"
+METRICS_REL = "deeplearning4j_tpu/observe/metrics.py"
+FAULTS_REL = "deeplearning4j_tpu/runtime/faults.py"
+
+# Marks pytest itself (or its bundled plugins) define.
+BUILTIN_MARKS = {
+    "parametrize", "skip", "skipif", "xfail", "usefixtures",
+    "filterwarnings", "tryfirst", "trylast",
+}
+
+
+# ------------------------------------------------------------ loaders --
+
+def load_declared_families(project_root: str) -> set:
+    """Family names declared in observe/metrics.py:_declare_core."""
+    path = os.path.join(project_root, METRICS_REL)
+    out: set = set()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return out
+    for node in ast.walk(tree):
+        if (isinstance(node, ast.FunctionDef)
+                and node.name == DECLARING_FUNC):
+            for call in ast.walk(node):
+                if (isinstance(call, ast.Call)
+                        and isinstance(call.func, ast.Attribute)
+                        and call.func.attr in FAMILY_METHODS
+                        and call.args):
+                    name = str_const(call.args[0])
+                    if name:
+                        out.add(name)
+    return out
+
+
+def load_fault_sites(project_root: str) -> set:
+    """Site names from runtime/faults.py's module-level SITES table."""
+    path = os.path.join(project_root, FAULTS_REL)
+    out: set = set()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            tree = ast.parse(f.read(), filename=path)
+    except (OSError, SyntaxError):
+        return out
+    for node in tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target]
+            value = node.value
+        else:
+            continue
+        if not any(
+            isinstance(t, ast.Name) and t.id == "SITES" for t in targets
+        ):
+            continue
+        if isinstance(value, ast.Dict):
+            for k in value.keys:
+                name = str_const(k) if k is not None else None
+                if name:
+                    out.add(name)
+        elif isinstance(value, (ast.Set, ast.Tuple, ast.List)):
+            for el in value.elts:
+                name = str_const(el)
+                if name:
+                    out.add(name)
+    return out
+
+
+def load_declared_marks(project_root: str) -> set:
+    """Extract [tool.pytest.ini_options] markers from pyproject.toml.
+
+    pyproject as a whole is full TOML (inline tables etc.) that
+    `tomlmini` rightly refuses, so this scans for the one section and
+    one key it needs and hands only that array to the subset parser.
+    """
+    path = os.path.join(project_root, "pyproject.toml")
+    out: set = set()
+    try:
+        with open(path, "r", encoding="utf-8") as f:
+            lines = f.read().splitlines()
+    except OSError:
+        return out
+    in_section = False
+    i = 0
+    while i < len(lines):
+        line = lines[i].strip()
+        i += 1
+        if line.startswith("["):
+            in_section = line == "[tool.pytest.ini_options]"
+            continue
+        if not in_section or not line.startswith("markers"):
+            continue
+        _, _, rest = line.partition("=")
+        buf = rest.strip()
+        while tomlmini._bracket_open(buf) and i < len(lines):
+            buf += "\n" + lines[i]
+            i += 1
+        try:
+            section = tomlmini.parse(f"markers = {buf}")
+        except tomlmini.TomlSubsetError:
+            return out
+        for m in section.get("markers", []):
+            out.add(str(m).split(":", 1)[0].strip())
+        return out
+    return out
+
+
+def _ensure_loaded(ctx: LintContext) -> None:
+    if ctx.declared_families is None:
+        ctx.declared_families = load_declared_families(ctx.project_root)
+    if ctx.fault_sites is None:
+        ctx.fault_sites = load_fault_sites(ctx.project_root)
+    if ctx.declared_marks is None:
+        ctx.declared_marks = load_declared_marks(ctx.project_root)
+
+
+# ------------------------------------------------------------- checks --
+
+def _in_declaring_span(node: ast.AST, declaring_spans: list) -> bool:
+    return any(lo <= node.lineno <= hi for lo, hi in declaring_spans)
+
+
+def check_module(ctx: LintContext, unit: ModuleUnit) -> Iterator[Finding]:
+    _ensure_loaded(ctx)
+    families = ctx.declared_families or set()
+    sites = ctx.fault_sites or set()
+    marks = ctx.declared_marks or set()
+
+    # line spans of declaring scopes, exempt from RG301/RG302: the
+    # metrics pre-declaration function, and faults.py's own module (its
+    # docstring/table IS the registry).
+    declare_spans: list = []
+    if unit.relpath == METRICS_REL:
+        for n in ast.walk(unit.tree):
+            if (isinstance(n, ast.FunctionDef)
+                    and n.name == DECLARING_FUNC):
+                declare_spans.append(
+                    (n.lineno, getattr(n, "end_lineno", n.lineno))
+                )
+    site_check_exempt = unit.relpath == FAULTS_REL
+
+    for node in ast.walk(unit.tree):
+        if isinstance(node, ast.Call):
+            # RG301 — metric families
+            if (isinstance(node.func, ast.Attribute)
+                    and node.func.attr in FAMILY_METHODS
+                    and node.args):
+                name = str_const(node.args[0])
+                if (name is not None
+                        and name.startswith("dl4jtpu_")
+                        and name not in families
+                        and not _in_declaring_span(node, declare_spans)):
+                    yield Finding(
+                        "RG301", unit.relpath, node.lineno,
+                        node.col_offset,
+                        f"metric family {name!r} is not pre-declared in "
+                        f"{METRICS_REL}:{DECLARING_FUNC} — a fresh "
+                        "process's /metrics will not expose it",
+                    )
+            # RG302 — fault sites
+            f = dotted_name(node.func)
+            if (f is not None and f.split(".")[-1] == "maybe_fail"
+                    and node.args and not site_check_exempt):
+                site = str_const(node.args[0])
+                if site is not None and site not in sites:
+                    yield Finding(
+                        "RG302", unit.relpath, node.lineno,
+                        node.col_offset,
+                        f"fault site {site!r} is not registered in "
+                        f"{FAULTS_REL} SITES — plans targeting it can "
+                        "never fire",
+                    )
+        elif isinstance(node, ast.Attribute):
+            # RG303 — pytest marks: pytest.mark.<name> (possibly called
+            # or parameterized; the bare attribute chain is enough)
+            if (isinstance(node.value, ast.Attribute)
+                    and node.value.attr == "mark"
+                    and isinstance(node.value.value, ast.Name)
+                    and node.value.value.id == "pytest"):
+                name = node.attr
+                if name not in BUILTIN_MARKS and name not in marks:
+                    yield Finding(
+                        "RG303", unit.relpath, node.lineno,
+                        node.col_offset,
+                        f"pytest.mark.{name} is not declared in "
+                        "pyproject.toml [tool.pytest.ini_options] "
+                        "markers",
+                    )

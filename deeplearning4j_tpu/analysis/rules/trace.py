@@ -1,0 +1,620 @@
+"""TP (trace purity) + RH (recompile / host-sync hazard) rules.
+
+Staged execution makes these bugs invisible at the call site: a
+``time.time()`` traced into a jitted step is evaluated ONCE at trace
+time and frozen into the program; a Python ``if`` on a tracer either
+raises at trace time or — when the branch condition is shape-derived —
+silently recompiles per shape; a ``float()`` on a tracer is a host
+sync.  Both families therefore need the same first step: find the
+**traced regions** of a module.
+
+A function body is traced when the function is
+
+- decorated with ``jax.jit`` / ``jax.pmap`` / ``shard_map`` (directly
+  or via ``partial(jax.jit, ...)``), or
+- passed to a jit-wrapper or a tracing combinator (``lax.scan`` /
+  ``cond`` / ``while_loop`` / ``fori_loop`` / ``switch`` / ``map``,
+  ``jax.vjp`` / ``grad`` / ``value_and_grad`` / ``vmap`` /
+  ``checkpoint``) as a function-valued argument, resolved to a local
+  ``def`` or ``lambda``.
+
+The traced region is the full lexical body (nested defs are closures
+of the same program).  Purity (TP) additionally follows ONE level of
+out-of-line helpers: bare-name calls to same-module functions and
+``self.method`` calls to methods of the lexically enclosing class.
+
+RH taint: the root's parameters (minus ``static_argnums`` /
+``static_argnames``) are tracers; assignment propagates; parameters of
+nested defs that are themselves combinator operands are tracers too.
+``if``/``while`` statements on tainted values are flagged except for
+identity/membership tests (``is``/``is not``/``in``/``not in`` — those
+inspect Python structure, not tracer values) and
+``isinstance``/``hasattr``/``callable`` probes.  Conditional
+*expressions* are deliberately NOT flagged: ``x if leaves else y`` on a
+pytree-leaf list is the dominant static idiom in this codebase.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Iterator, Optional
+
+from deeplearning4j_tpu.analysis.core import (
+    Finding, LintContext, ModuleUnit, dotted_name,
+)
+
+JIT_WRAPPERS = {
+    "jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
+    "jax.experimental.shard_map.shard_map", "jax.named_call",
+}
+PARTIAL_NAMES = {"partial", "functools.partial", "_partial"}
+# Calls whose function-valued arguments are traced when invoked.
+COMBINATORS = {
+    "jax.lax.scan", "lax.scan", "jax.lax.map", "lax.map",
+    "jax.lax.cond", "lax.cond", "jax.lax.switch", "lax.switch",
+    "jax.lax.while_loop", "lax.while_loop",
+    "jax.lax.fori_loop", "lax.fori_loop",
+    "jax.lax.associative_scan", "lax.associative_scan",
+    "jax.grad", "grad", "jax.value_and_grad", "value_and_grad",
+    "jax.vjp", "vjp", "jax.jvp", "jvp", "jax.linearize",
+    "jax.vmap", "vmap", "jax.checkpoint", "jax.remat",
+}
+
+# TP001 deny list.  Exact dotted names, prefix matches, and suffix
+# matches are kept separate so the report can say what matched.
+IMPURE_EXACT = {
+    "os.getenv", "os.putenv", "os.system", "os.urandom",
+    "input", "breakpoint", "open", "uuid.uuid4", "uuid.uuid1",
+    "os.environ.get", "os.environ.setdefault", "os.environ.pop",
+}
+IMPURE_PREFIX = ("time.", "random.", "np.random.", "numpy.random.",
+                 "logging.", "secrets.")
+IMPURE_SUFFIX = ("datetime.now", "datetime.utcnow", "datetime.today",
+                 "date.today")
+LOGGER_METHODS = {"debug", "info", "warning", "error", "exception",
+                  "critical", "log"}
+LOGGER_NAMES = {"logger", "log", "_logger", "LOG", "LOGGER"}
+
+HOST_CONVERSIONS = {"int", "float", "bool", "len", "complex"}
+HOST_ARRAY_FNS = {"np.asarray", "np.array", "numpy.asarray",
+                  "numpy.array", "np.float32", "np.float64", "np.int32",
+                  "np.int64"}
+HOST_METHODS = {"item", "tolist", "to_py"}
+STATIC_PROBES = {"isinstance", "hasattr", "callable", "getattr", "type"}
+# Attributes of a tracer that are static at trace time: branching on
+# them specializes the trace by shape/dtype, which is exactly how JAX
+# is meant to be used (one program per signature).
+STATIC_ATTRS = {"dtype", "shape", "ndim", "size", "aval", "sharding",
+                "weak_type"}
+
+
+def _attach_parents(tree: ast.AST) -> None:
+    for parent in ast.walk(tree):
+        for child in ast.iter_child_nodes(parent):
+            child._tpl_parent = parent          # type: ignore[attr-defined]
+
+
+def _parent(node: ast.AST) -> Optional[ast.AST]:
+    return getattr(node, "_tpl_parent", None)
+
+
+def _qualname(node: ast.AST) -> str:
+    parts: list[str] = []
+    cur: Optional[ast.AST] = node
+    while cur is not None:
+        if isinstance(cur, (ast.FunctionDef, ast.AsyncFunctionDef,
+                            ast.ClassDef)):
+            parts.append(cur.name)
+        elif isinstance(cur, ast.Lambda):
+            parts.append("<lambda>")
+        cur = _parent(cur)
+    return ".".join(reversed(parts))
+
+
+FuncNode = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Lambda)
+
+
+def _is_jit_decorator(dec: ast.AST) -> bool:
+    d = dotted_name(dec)
+    if d in JIT_WRAPPERS:
+        return True
+    if isinstance(dec, ast.Call):
+        f = dotted_name(dec.func)
+        if f in JIT_WRAPPERS:
+            return True
+        if f in PARTIAL_NAMES and dec.args:
+            return dotted_name(dec.args[0]) in JIT_WRAPPERS
+    return False
+
+
+def _jit_static_params(node: ast.AST, func: ast.AST) -> tuple[set, set]:
+    """(static names, static positions) from a jit decorator/wrapper
+    call, when spelled as literals."""
+    names: set = set()
+    nums: set = set()
+    calls: list[ast.Call] = []
+    if isinstance(node, ast.Call):
+        calls.append(node)
+    for call in calls:
+        for kw in call.keywords:
+            if kw.arg == "static_argnames":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                        names.add(n.value)
+            elif kw.arg == "static_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                        nums.add(n.value)
+    return names, nums
+
+
+class _TracedRoot:
+    def __init__(self, func: ast.AST, static_names: set, static_nums: set):
+        self.func = func
+        self.static_names = static_names
+        self.static_nums = static_nums
+
+
+def _collect_traced(tree: ast.Module) -> tuple[list, set]:
+    """Find traced root functions and the set of ALL traced-marked
+    function nodes (roots + combinator operands — used so nested
+    operand defs get their params tainted)."""
+    roots: dict[int, _TracedRoot] = {}
+    marked: set = set()
+
+    # local defs by name, for resolving function-valued arguments
+    defs_by_name: dict[str, list] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            defs_by_name.setdefault(n.name, []).append(n)
+
+    def mark(func: ast.AST, static_names=frozenset(), static_nums=frozenset()):
+        marked.add(id(func))
+        if id(func) not in roots:
+            roots[id(func)] = _TracedRoot(
+                func, set(static_names), set(static_nums)
+            )
+
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                if _is_jit_decorator(dec):
+                    sn, sp = _jit_static_params(dec, n)
+                    mark(n, sn, sp)
+        elif isinstance(n, ast.Call):
+            f = dotted_name(n.func)
+            if f in JIT_WRAPPERS or f in COMBINATORS:
+                sn, sp = (
+                    _jit_static_params(n, None) if f in JIT_WRAPPERS
+                    else (set(), set())
+                )
+                operands = list(n.args) + [
+                    kw.value for kw in n.keywords if kw.arg is not None
+                ]
+                for arg in operands:
+                    if isinstance(arg, ast.Lambda):
+                        mark(arg, sn, sp)
+                    elif isinstance(arg, ast.Name):
+                        for d in defs_by_name.get(arg.id, ()):
+                            mark(d, sn, sp)
+
+    # drop roots lexically nested inside another root: they are covered
+    # by the enclosing region (but stay in `marked` for taint seeding)
+    top: list = []
+    for r in roots.values():
+        cur = _parent(r.func)
+        nested = False
+        while cur is not None:
+            if id(cur) in roots:
+                nested = True
+                break
+            cur = _parent(cur)
+        if not nested:
+            top.append(r)
+    return top, marked
+
+
+def _enclosing_class(node: ast.AST) -> Optional[ast.ClassDef]:
+    cur = _parent(node)
+    while cur is not None:
+        if isinstance(cur, ast.ClassDef):
+            return cur
+        cur = _parent(cur)
+    return None
+
+
+def _param_names(func: ast.AST, static_names: set, static_nums: set) -> set:
+    a = func.args
+    ordered = list(a.posonlyargs) + list(a.args)
+    names = set()
+    for i, arg in enumerate(ordered):
+        if i in static_nums or arg.arg in static_names:
+            continue
+        names.add(arg.arg)
+    for arg in list(a.kwonlyargs):
+        if arg.arg not in static_names:
+            names.add(arg.arg)
+    if a.vararg:
+        names.add(a.vararg.arg)
+    names.discard("self")
+    names.discard("cls")
+    return names
+
+
+# ---------------------------------------------------------------- TP --
+
+def _impure_reason(d: str) -> Optional[str]:
+    if d in IMPURE_EXACT:
+        return d
+    for p in IMPURE_PREFIX:
+        if d.startswith(p):
+            return d
+    for s in IMPURE_SUFFIX:
+        if d.endswith(s):
+            return d
+    parts = d.split(".")
+    if (len(parts) == 2 and parts[0] in LOGGER_NAMES
+            and parts[1] in LOGGER_METHODS):
+        return d
+    return None
+
+
+def _scan_purity(
+    unit: ModuleUnit, region: ast.AST, where: str, via: str = ""
+) -> Iterator[Finding]:
+    suffix = f" (reached via {via})" if via else ""
+    for n in ast.walk(region):
+        if isinstance(n, ast.Call):
+            d = dotted_name(n.func)
+            if d is None:
+                continue
+            if d == "print":
+                yield Finding(
+                    "TP002", unit.relpath, n.lineno, n.col_offset,
+                    f"print() inside traced code{suffix}: output happens "
+                    "at trace time only, then never again", where,
+                )
+                continue
+            reason = _impure_reason(d)
+            if reason is not None:
+                yield Finding(
+                    "TP001", unit.relpath, n.lineno, n.col_offset,
+                    f"impure call {reason}() inside traced code{suffix}: "
+                    "evaluated once at trace time and frozen into the "
+                    "compiled program", where,
+                )
+                continue
+            last = d.split(".")[-1]
+            if last == "registry" or last == "maybe_fail":
+                yield Finding(
+                    "TP004", unit.relpath, n.lineno, n.col_offset,
+                    f"telemetry call {d}() inside traced code{suffix}: "
+                    "metrics/fault hooks are host-side effects — hoist "
+                    "them out of the jitted body", where,
+                )
+        elif isinstance(n, (ast.Global, ast.Nonlocal)):
+            kind = "global" if isinstance(n, ast.Global) else "nonlocal"
+            yield Finding(
+                "TP003", unit.relpath, n.lineno, n.col_offset,
+                f"{kind} mutation of {', '.join(n.names)} inside traced "
+                f"code{suffix}: runs at trace time, not per step", where,
+            )
+        elif (isinstance(n, ast.Subscript)
+              and dotted_name(n.value) == "os.environ"):
+            yield Finding(
+                "TP001", unit.relpath, n.lineno, n.col_offset,
+                f"os.environ read inside traced code{suffix}: the value "
+                "is frozen at trace time", where,
+            )
+
+
+def _helper_targets(
+    region: ast.AST, tree: ast.Module
+) -> list[tuple[str, ast.AST]]:
+    """One level of out-of-line helpers: (via-label, funcdef) pairs for
+    bare-name calls resolving to module-level defs and self.method calls
+    resolving to methods of the lexically enclosing class."""
+    module_defs = {
+        n.name: n for n in tree.body
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+    cls = _enclosing_class(region)
+    methods = {}
+    if cls is not None:
+        methods = {
+            n.name: n for n in cls.body
+            if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+        }
+
+    local_defs = {
+        n.name for n in ast.walk(region)
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+    }
+
+    out: list[tuple[str, ast.AST]] = []
+    seen: set = set()
+    for n in ast.walk(region):
+        if not isinstance(n, ast.Call):
+            continue
+        target: Optional[ast.AST] = None
+        label = ""
+        if isinstance(n.func, ast.Name):
+            name = n.func.id
+            if name in local_defs:
+                continue                      # lexically inside the region
+            target = module_defs.get(name)
+            label = name
+        elif (isinstance(n.func, ast.Attribute)
+              and isinstance(n.func.value, ast.Name)
+              and n.func.value.id == "self"):
+            target = methods.get(n.func.attr)
+            label = f"self.{n.func.attr}"
+        if target is not None and id(target) not in seen:
+            if id(target) == id(region):
+                continue                      # direct recursion
+            seen.add(id(target))
+            out.append((label, target))
+    return out
+
+
+# ---------------------------------------------------------------- RH --
+
+def _names_in(node: ast.AST) -> set:
+    return {n.id for n in ast.walk(node) if isinstance(n, ast.Name)}
+
+
+def _tainted_names_in(node: ast.AST, tainted: set) -> set:
+    """Tainted names used in `node`, EXCLUDING reads through a static
+    attribute (`x.shape` / `x.ndim` / ... are trace-time constants, so
+    `len(x.shape)`, `ndim = x.ndim` and friends must not propagate or
+    trigger taint)."""
+    out: set = set()
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            continue
+        if isinstance(n, ast.Name) and n.id in tainted:
+            out.add(n.id)
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+def _expr_tainted(node: ast.AST, tainted: set) -> bool:
+    return bool(_tainted_names_in(node, tainted))
+
+
+def _target_names(target: ast.AST) -> set:
+    out = set()
+    for n in ast.walk(target):
+        if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Store):
+            out.add(n.id)
+    return out
+
+
+def _is_direct_tainted_iter(node: ast.AST, tainted: set) -> bool:
+    """True for `for x in tracer` / `tracer[i]` / `tracer.leaves` —
+    not for calls like zip(...) that mix static and traced operands."""
+    cur = node
+    while isinstance(cur, (ast.Subscript, ast.Attribute)):
+        if isinstance(cur, ast.Attribute) and cur.attr in STATIC_ATTRS:
+            return False               # for d in x.shape: — static ints
+        cur = cur.value
+    return isinstance(cur, ast.Name) and cur.id in tainted
+
+
+def _hazardous_test(test: ast.AST, tainted: set) -> Optional[str]:
+    """A tainted name in an if/while test, ignoring identity/membership
+    comparisons and static type probes.  Returns the offending name."""
+    benign: set = set()
+    for n in ast.walk(test):
+        if isinstance(n, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+            for op in n.ops
+        ):
+            benign |= {id(x) for x in ast.walk(n) if isinstance(x, ast.Name)}
+        elif (isinstance(n, ast.Call)
+              and dotted_name(n.func) in STATIC_PROBES):
+            benign |= {id(x) for x in ast.walk(n) if isinstance(x, ast.Name)}
+        elif isinstance(n, ast.Attribute) and n.attr in STATIC_ATTRS:
+            # x.dtype / x.shape / x.ndim are trace-time constants:
+            # branching on them is per-signature specialization, not a
+            # per-value recompile
+            benign |= {id(x) for x in ast.walk(n) if isinstance(x, ast.Name)}
+    for n in ast.walk(test):
+        if (isinstance(n, ast.Name) and n.id in tainted
+                and id(n) not in benign):
+            return n.id
+    return None
+
+
+class _TaintScanner:
+    """Single forward pass over a traced region.  Approximate by
+    design: taint is per-name, flows through assignments in source
+    order, and nested defs fork the ambient set (+ their own params
+    when the def is itself a combinator operand)."""
+
+    def __init__(self, unit: ModuleUnit, marked: set, where: str):
+        self.unit = unit
+        self.marked = marked
+        self.where = where
+        self.findings: list[Finding] = []
+
+    def scan(self, func: ast.AST, tainted: set) -> None:
+        if isinstance(func, ast.Lambda):
+            self._expr(func.body, tainted)
+            return
+        for stmt in func.body:
+            self._stmt(stmt, tainted)
+
+    # -- statements ----------------------------------------------------
+    def _stmt(self, node: ast.AST, tainted: set) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            inner = set(tainted)
+            if id(node) in self.marked:
+                inner |= _param_names(node, set(), set())
+            else:
+                # closure sees ambient taint, but its own params shadow
+                inner -= {a.arg for a in node.args.args}
+            self.scan(node, inner)
+            return
+        if isinstance(node, ast.Assign):
+            self._expr(node.value, tainted)
+            is_t = _expr_tainted(node.value, tainted)
+            for t in node.targets:
+                for name in _target_names(t):
+                    (tainted.add if is_t else tainted.discard)(name)
+            return
+        if isinstance(node, ast.AnnAssign) and node.value is not None:
+            self._expr(node.value, tainted)
+            if isinstance(node.target, ast.Name):
+                if _expr_tainted(node.value, tainted):
+                    tainted.add(node.target.id)
+                else:
+                    tainted.discard(node.target.id)
+            return
+        if isinstance(node, ast.AugAssign):
+            self._expr(node.value, tainted)
+            if isinstance(node.target, ast.Name):
+                if _expr_tainted(node.value, tainted):
+                    tainted.add(node.target.id)
+            return
+        if isinstance(node, (ast.If, ast.While)):
+            bad = _hazardous_test(node.test, tainted)
+            if bad is not None:
+                kw = "if" if isinstance(node, ast.If) else "while"
+                self.findings.append(Finding(
+                    "RH102", self.unit.relpath, node.lineno,
+                    node.col_offset,
+                    f"Python `{kw}` on tracer-derived `{bad}`: branches "
+                    "at trace time (TracerBoolConversionError or a "
+                    "recompile per value) — use lax.cond/lax.select or "
+                    "mark the argument static", self.where,
+                ))
+            self._expr(node.test, tainted)
+            for s in node.body + node.orelse:
+                self._stmt(s, tainted)
+            return
+        if isinstance(node, ast.For):
+            self._expr(node.iter, tainted)
+            # taint loop targets only for DIRECT iteration over a
+            # tainted value (unrolls tracers element-wise); iteration
+            # through zip()/enumerate()/dict methods mixes static
+            # structure (pytree keys, spec tuples) with tracers and
+            # tainting those targets drowns the report in noise
+            if _is_direct_tainted_iter(node.iter, tainted):
+                tainted |= _target_names(node.target)
+            for s in node.body + node.orelse:
+                self._stmt(s, tainted)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self._expr(item.context_expr, tainted)
+            for s in node.body:
+                self._stmt(s, tainted)
+            return
+        if isinstance(node, ast.Try):
+            for s in (node.body + node.orelse + node.finalbody
+                      + [h2 for h in node.handlers for h2 in h.body]):
+                self._stmt(s, tainted)
+            return
+        if isinstance(node, (ast.Return, ast.Expr)):
+            if node.value is not None:
+                self._expr(node.value, tainted)
+            return
+        # fallthrough: scan any embedded expressions
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, ast.expr):
+                self._expr(child, tainted)
+            elif isinstance(child, ast.stmt):
+                self._stmt(child, tainted)
+
+    # -- expressions ---------------------------------------------------
+    def _expr(self, node: ast.AST, tainted: set) -> None:
+        # manual walk that does NOT descend into lambdas — those fork
+        # the taint set (param shadowing / combinator operands) and are
+        # scanned exactly once via scan()
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            if isinstance(n, ast.Lambda):
+                inner = set(tainted)
+                if id(n) in self.marked:
+                    inner |= _param_names(n, set(), set())
+                else:
+                    inner -= {a.arg for a in n.args.args}
+                self.scan(n, inner)
+                continue
+            if isinstance(n, ast.Call):
+                self._check_call(n, tainted)
+            elif isinstance(n, ast.JoinedStr):
+                for v in n.values:
+                    if (isinstance(v, ast.FormattedValue)
+                            and _expr_tainted(v.value, tainted)):
+                        self.findings.append(Finding(
+                            "RH103", self.unit.relpath, n.lineno,
+                            n.col_offset,
+                            "tracer formatted into an f-string: bakes "
+                            "the trace-time repr (or syncs the host) — "
+                            "format after the program returns",
+                            self.where,
+                        ))
+                        break
+            stack.extend(ast.iter_child_nodes(n))
+
+    def _check_call(self, n: ast.Call, tainted: set) -> None:
+        d = dotted_name(n.func)
+        if d in HOST_CONVERSIONS or d in HOST_ARRAY_FNS:
+            if any(_expr_tainted(a, tainted) for a in n.args):
+                self.findings.append(Finding(
+                    "RH101", self.unit.relpath, n.lineno, n.col_offset,
+                    f"{d}() applied to a tracer: forces a host "
+                    "sync / concretization inside the traced program",
+                    self.where,
+                ))
+            return
+        if (isinstance(n.func, ast.Attribute)
+                and n.func.attr in HOST_METHODS
+                and not n.args
+                and _expr_tainted(n.func.value, tainted)):
+            self.findings.append(Finding(
+                "RH101", self.unit.relpath, n.lineno, n.col_offset,
+                f".{n.func.attr}() on a tracer: host sync inside the "
+                "traced program — return the value and read it outside",
+                self.where,
+            ))
+
+
+# ------------------------------------------------------------ driver --
+
+def check_module(ctx: LintContext, unit: ModuleUnit) -> Iterator[Finding]:
+    tree = unit.tree
+    _attach_parents(tree)
+    roots, marked = _collect_traced(tree)
+    # a helper reachable from N traced roots is still ONE defect site:
+    # dedup by (rule, line, col) so reports and baselines see it once
+    seen: set = set()
+
+    def emit(findings):
+        for f in findings:
+            key = (f.rule, f.line, f.col)
+            if key not in seen:
+                seen.add(key)
+                yield f
+
+    for root in roots:
+        region = root.func
+        where = _qualname(region)
+
+        # TP over the region + one level of helpers
+        yield from emit(_scan_purity(unit, region, where))
+        for via, helper in _helper_targets(region, tree):
+            yield from emit(_scan_purity(
+                unit, helper, _qualname(helper), via=f"{where} -> {via}"
+            ))
+
+        # RH taint over the root region only
+        scanner = _TaintScanner(unit, marked, where)
+        tainted = _param_names(region, root.static_names, root.static_nums)
+        scanner.scan(region, tainted)
+        yield from emit(scanner.findings)

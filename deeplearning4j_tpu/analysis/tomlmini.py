@@ -1,0 +1,186 @@
+"""A deliberately tiny TOML-subset reader.
+
+This container pins Python 3.10 (no stdlib ``tomllib``) and tpulint may
+not grow third-party dependencies, so the two TOML files it must read —
+`analysis/baseline.toml` and the `markers` list in `pyproject.toml` —
+are parsed with this subset reader instead.  Supported grammar:
+
+- comments (``#`` to end of line, outside strings)
+- table headers ``[a.b]`` and array-of-table headers ``[[a.b]]``
+- ``key = "basic string"`` (with ``\\\\``, ``\\"``, ``\\n``, ``\\t``
+  escapes)
+- ``key = [ "s1", "s2", ... ]`` string arrays, single- or multi-line
+- bare keys only; integers/floats/dates/inline tables are NOT supported
+  and raise, so a drive-by baseline edit that leaves the subset fails
+  loudly instead of being silently misread.
+
+The result shape mirrors ``tomllib.load``: nested dicts, with
+array-of-tables as lists of dicts.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+__all__ = ["parse", "TomlSubsetError"]
+
+
+class TomlSubsetError(ValueError):
+    """Input is outside the supported TOML subset (or malformed)."""
+
+
+_ESCAPES = {"\\": "\\", '"': '"', "n": "\n", "t": "\t", "r": "\r"}
+
+
+def _strip_comment(line: str) -> str:
+    out = []
+    in_str = False
+    i = 0
+    while i < len(line):
+        c = line[i]
+        if c == '"' and (i == 0 or line[i - 1] != "\\"):
+            in_str = not in_str
+        if c == "#" and not in_str:
+            break
+        out.append(c)
+        i += 1
+    return "".join(out).strip()
+
+
+def _parse_basic_string(s: str, where: str) -> tuple[str, str]:
+    """Parse a leading double-quoted string; return (value, rest)."""
+    if not s.startswith('"'):
+        raise TomlSubsetError(f"{where}: expected a double-quoted string")
+    out = []
+    i = 1
+    while i < len(s):
+        c = s[i]
+        if c == "\\":
+            if i + 1 >= len(s) or s[i + 1] not in _ESCAPES:
+                raise TomlSubsetError(f"{where}: unsupported escape")
+            out.append(_ESCAPES[s[i + 1]])
+            i += 2
+            continue
+        if c == '"':
+            return "".join(out), s[i + 1:].strip()
+        out.append(c)
+        i += 1
+    raise TomlSubsetError(f"{where}: unterminated string")
+
+
+def _target_table(root: dict, dotted: str, where: str) -> dict:
+    cur = root
+    for part in dotted.split("."):
+        part = part.strip()
+        if not part:
+            raise TomlSubsetError(f"{where}: empty table-name segment")
+        nxt = cur.setdefault(part, {})
+        if isinstance(nxt, list):          # array-of-tables: descend last
+            nxt = nxt[-1]
+        if not isinstance(nxt, dict):
+            raise TomlSubsetError(f"{where}: {part!r} is not a table")
+        cur = nxt
+    return cur
+
+
+def parse(text: str) -> dict:
+    root: dict = {}
+    current: dict = root
+    lines = text.splitlines()
+    i = 0
+    while i < len(lines):
+        where = f"line {i + 1}"
+        line = _strip_comment(lines[i])
+        i += 1
+        if not line:
+            continue
+        if line.startswith("[["):
+            if not line.endswith("]]"):
+                raise TomlSubsetError(f"{where}: malformed [[table]]")
+            dotted = line[2:-2].strip()
+            head, _, leaf = dotted.rpartition(".")
+            parent = _target_table(root, head, where) if head else root
+            arr = parent.setdefault(leaf, [])
+            if not isinstance(arr, list):
+                raise TomlSubsetError(f"{where}: {leaf!r} is not an array")
+            current = {}
+            arr.append(current)
+            continue
+        if line.startswith("["):
+            if not line.endswith("]"):
+                raise TomlSubsetError(f"{where}: malformed [table]")
+            current = _target_table(root, line[1:-1].strip(), where)
+            continue
+        if "=" not in line:
+            raise TomlSubsetError(f"{where}: expected key = value")
+        key, _, rest = line.partition("=")
+        key = key.strip()
+        rest = rest.strip()
+        if not key or " " in key:
+            raise TomlSubsetError(f"{where}: bad key {key!r}")
+        if rest.startswith('"'):
+            value, tail = _parse_basic_string(rest, where)
+            if tail:
+                raise TomlSubsetError(f"{where}: trailing junk after string")
+            current[key] = value
+            continue
+        if rest.startswith("["):
+            # string array, possibly spanning lines: join until the
+            # bracket closes (strings may not contain brackets — true
+            # for both files this reader serves)
+            buf = rest
+            while _bracket_open(buf):
+                if i >= len(lines):
+                    raise TomlSubsetError(f"{where}: unterminated array")
+                buf += "\n" + _strip_comment(lines[i])
+                i += 1
+            current[key] = _parse_string_array(buf, where)
+            continue
+        raise TomlSubsetError(
+            f"{where}: unsupported value {rest!r} (tomlmini reads only "
+            "strings and string arrays)"
+        )
+    return root
+
+
+def _bracket_open(buf: str) -> bool:
+    depth = 0
+    in_str = False
+    prev = ""
+    for c in buf:
+        if c == '"' and prev != "\\":
+            in_str = not in_str
+        elif not in_str:
+            if c == "[":
+                depth += 1
+            elif c == "]":
+                depth -= 1
+        prev = c
+    return depth > 0
+
+
+def _parse_string_array(buf: str, where: str) -> list[str]:
+    buf = buf.strip()
+    if not (buf.startswith("[") and buf.endswith("]")):
+        raise TomlSubsetError(f"{where}: malformed array")
+    body = buf[1:-1].strip()
+    out: list[str] = []
+    while body:
+        if body.startswith(","):
+            body = body[1:].strip()
+            continue
+        value, body = _parse_basic_string(body, where)
+        out.append(value)
+        body = body.strip()
+        if body and not body.startswith(","):
+            raise TomlSubsetError(f"{where}: expected ',' in array")
+    return out
+
+
+def get_path(d: dict, *keys: str) -> Optional[object]:
+    cur: object = d
+    for k in keys:
+        if not isinstance(cur, dict) or k not in cur:
+            return None
+        cur = cur[k]
+    return cur

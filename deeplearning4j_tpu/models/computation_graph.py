@@ -192,7 +192,9 @@ class GraphModel(Model):
                         self._out_specs,
                         self.conf.network_outputs,
                         labels,
-                        lmasks if n_masks else [None] * len(labels),
+                        # len() of the label TUPLE is static structure,
+                        # not a tracer read
+                        lmasks if n_masks else [None] * len(labels),  # tpulint: disable=RH101
                     ):
                         out = outs[oname]
                         if custom is not None:

@@ -407,6 +407,17 @@ def _declare_core(reg: MetricsRegistry) -> None:
               "PJRT bytes currently allocated on device 0")
     reg.gauge("dl4jtpu_device_peak_bytes_in_use",
               "PJRT peak bytes allocated on device 0")
+    # cluster control plane (runtime/coordinator.py; the server's pull
+    # collector refreshes these at scrape time — declaring them here
+    # keeps a fresh process's /metrics schema-complete and is what
+    # tpulint rule RG301 checks every use against)
+    reg.gauge("dl4jtpu_coordinator_heartbeat_age_seconds",
+              "Seconds since each member's last heartbeat")
+    reg.gauge("dl4jtpu_coordinator_members",
+              "Sealed members this generation")
+    reg.gauge("dl4jtpu_coordinator_generation",
+              "Current cluster generation")
+    reg.counter("dl4jtpu_coordinator_evictions_total", "Workers evicted")
     # fault tolerance (runtime/faults.py, runtime/coordinator.py,
     # train/checkpoint.py)
     reg.counter("dl4jtpu_rpc_retries_total",

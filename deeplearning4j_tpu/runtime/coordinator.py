@@ -31,6 +31,7 @@ analog of the reference's dummy/delayed-transport tests (SURVEY.md §4.2).
 from __future__ import annotations
 
 import json
+import logging
 import random
 import socket
 import socketserver
@@ -40,6 +41,8 @@ from collections import deque
 from typing import Any, Callable, Optional
 
 from deeplearning4j_tpu.runtime import faults
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 def _free_port(host: str = "127.0.0.1") -> int:
@@ -410,11 +413,14 @@ class CoordinatorServer:
             hold.close()
         self.jax_coordinator = f"{self._host}:{port}"
         now = time.time()
-        self.members = {}
+        # tpulint: disable=LK201 — every caller (register / set_expected
+        # handlers, monitor loop) enters with self._lock held; the
+        # notify_all() below would raise otherwise
+        self.members = {}  # tpulint: disable=LK201
         for rank, wid in enumerate(sorted(self.pending)):
-            self.members[wid] = {"rank": rank, "last_hb": now,
+            self.members[wid] = {"rank": rank, "last_hb": now,  # tpulint: disable=LK201
                                  "info": self.pending[wid]["info"]}
-        self.pending = {}
+        self.pending = {}  # tpulint: disable=LK201
         self._lock.notify_all()
         return True
 
@@ -429,10 +435,12 @@ class CoordinatorServer:
         return {"ok": True, "generation": self.generation, "abort": self.abort}
 
     def _evict(self, worker: str, reason: str) -> None:
+        # caller (fail() handler, monitor sweep) holds self._lock — the
+        # notify_all() below needs it
         if worker in self.members:
-            del self.members[worker]
+            del self.members[worker]  # tpulint: disable=LK201
             self.abort = True
-            self.evictions.append(
+            self.evictions.append(  # tpulint: disable=LK201
                 {"generation": self.generation, "worker": worker,
                  "reason": reason, "time": time.time()}
             )
@@ -523,8 +531,10 @@ class CoordinatorClient:
                 from deeplearning4j_tpu.observe.metrics import registry
 
                 registry().counter("dl4jtpu_rpc_retries_total").inc(op=op)
-            except Exception:
-                pass
+            except Exception as e:
+                # telemetry failure must never break the retry loop it
+                # meters, but it should not vanish either
+                log.debug("rpc retry metric failed: %s", e)
 
         return policy.run(op, lambda: self._rpc_once(obj), on_retry=on_retry)
 

@@ -25,8 +25,11 @@ fleets this way.
 from __future__ import annotations
 
 import dataclasses
+import logging
 import os
 from typing import Optional
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 ENV_COORDINATOR = "DL4JTPU_COORDINATOR"       # host:port of process 0
 ENV_NUM_PROCESSES = "DL4JTPU_NUM_PROCESSES"
@@ -162,8 +165,10 @@ def shutdown() -> None:
     if _initialized:
         try:
             jax.distributed.shutdown()
-        except Exception:
-            pass
+        except Exception as e:
+            # best-effort teardown (peers may already be gone), but a
+            # silent failure here has masked wedged-barrier bugs before
+            log.debug("jax.distributed.shutdown failed: %s", e)
     _initialized = False
 
 

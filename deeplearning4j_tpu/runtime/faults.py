@@ -54,6 +54,22 @@ _ENV_VAR = "DL4J_TPU_FAULT_PLAN"
 
 _KINDS = ("raise", "delay", "truncate", "kill")
 
+# The site registry: every `maybe_fail("<site>")` call in the package
+# must use a name listed here (machine-checked by tpulint rule RG302 —
+# an unregistered site is a fault plan that silently never fires).
+# Plans may still name ad-hoc sites (tests do); this table is the
+# contract for PRODUCTION call sites, not a runtime gate.
+SITES: dict = {
+    "coordinator.rpc": "every CoordinatorClient request attempt",
+    "heartbeat.send": "the worker heartbeat, before the rpc",
+    "checkpoint.write": "ModelSerializer.write_model entry (may return "
+                        "'truncate' — the site chops published bytes)",
+    "checkpoint.fsync": "between the zip landing in the tmp file and "
+                        "its atomic publish (kill here = kill-9 "
+                        "mid-write)",
+    "data.next_batch": "the fit loops' batch pull",
+}
+
 
 class InjectedFault(ConnectionError):
     """Raised at a fault site by an armed plan (transient-shaped: subclasses
@@ -257,8 +273,10 @@ def _count_fire(site: str) -> None:
         from deeplearning4j_tpu.observe.metrics import registry
 
         registry().counter("dl4jtpu_faults_injected_total").inc(site=site)
-    except Exception:
-        pass             # telemetry must never mask the injected fault
+    except Exception:  # tpulint: disable=EH402
+        pass             # telemetry must never mask the injected fault —
+        # and this path runs INSIDE the injected failure, where even a
+        # logging call can recurse into a faulted subsystem
 
 
 # -- process-global arming --------------------------------------------------

@@ -63,8 +63,10 @@ def _count_verify_failure(path: str, reason: str) -> None:
         from deeplearning4j_tpu.observe.metrics import registry
 
         registry().counter("dl4jtpu_ckpt_verify_failures_total").inc()
-    except Exception:
-        pass
+    except Exception as e:
+        # best-effort metric: the verify failure itself (already logged
+        # above) must propagate even when telemetry is broken
+        log.debug("ckpt verify-failure metric failed: %s", e)
 
 
 def _npz_bytes(tree) -> tuple[bytes, int]:

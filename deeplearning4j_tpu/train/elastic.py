@@ -246,8 +246,10 @@ class ElasticWorkerLoop:
                     # dead peer.  The supervisor respawns the new world.
                     try:
                         self.client.leave()
-                    except Exception:
-                        pass
+                    except Exception as e:
+                        # leaving is a courtesy to the monitor; the exit
+                        # below is the real teardown
+                        log.debug("voluntary leave failed: %s", e)
                     os._exit(EXIT_MEMBERSHIP_CHANGED)
                 if (step + 1) % self.save_every == 0 or step + 1 == total_steps:
                     # ALL ranks enter (cross-host-sharded leaves allgather

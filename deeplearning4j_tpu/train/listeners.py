@@ -361,7 +361,10 @@ class CheckpointListener(TrainingListener):
     def __del__(self):
         try:
             self.flush()
-        except Exception:
+        except Exception:  # tpulint: disable=EH402
+            # finalizer at interpreter shutdown: modules (including
+            # logging) may already be torn down — raising or logging
+            # here turns a clean exit into stderr noise
             pass
 
     # -- static loaders (reference parity: lastCheckpoint(dir) etc.) -------

@@ -1,0 +1,53 @@
+"""tpulint golden fixture: idiomatic code — zero findings.
+
+Exercises the patterns the rules must NOT flag: static-arg branches,
+shape/dtype specialization, lax.cond instead of Python if, locked
+mutations, declared registries, narrow excepts, atomic writes.
+"""
+import os
+import threading
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_LOCK = threading.Lock()
+_STATE = {}
+
+
+@partial(jax.jit, static_argnames=("training",))
+def step(params, x, training):
+    if training:
+        x = x + 1.0
+    if x.ndim > 2:
+        x = x.reshape(x.shape[0], -1)
+    # static-attr reads are trace-time constants: none of these may
+    # taint, convert, or branch-flag
+    rank = x.ndim
+    if rank == 2:
+        width = int(x.shape[-1])
+        depth = len(x.shape)
+        x = x * float(width * depth)
+    for _dim in x.shape:
+        pass
+    y = jax.lax.cond(
+        jnp.all(jnp.isfinite(x)), lambda v: v, lambda v: v * 0.0, x
+    )
+    return params, y
+
+
+def remember(key, value):
+    with _LOCK:
+        _STATE[key] = value
+
+
+def read_env_outside_trace():
+    return os.environ.get("DL4J_TPU_FLAG", "")
+
+
+def careful():
+    try:
+        remember("k", 1)
+    except KeyError:
+        return False
+    return True

@@ -1,0 +1,45 @@
+"""tpulint golden fixture: EH (error hygiene) violations.
+
+`save_checkpoint_atomic` proves the approved tmp+os.replace protocol
+does NOT fire EH403.
+"""
+import os
+
+
+def swallow_everything():
+    try:
+        risky()
+    except:                             # line 11: EH401
+        pass
+
+
+def swallow_broad():
+    try:
+        risky()
+    except Exception:                   # line 18: EH402
+        pass
+
+
+def narrow_is_fine():
+    try:
+        risky()
+    except OSError:                     # narrowed: NOT a finding
+        pass
+
+
+def save_checkpoint(path, data):
+    with open(path, "wb") as f:         # line 30: EH403
+        f.write(data)
+
+
+def save_checkpoint_atomic(path, data):
+    tmp = path + ".tmp"
+    with open(tmp, "wb") as f:          # tmp + replace: NOT a finding
+        f.write(data)
+        f.flush()
+        os.fsync(f.fileno())
+    os.replace(tmp, path)
+
+
+def risky():
+    raise RuntimeError("boom")

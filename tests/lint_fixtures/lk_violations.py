@@ -1,0 +1,46 @@
+"""tpulint golden fixture: LK (lock discipline) violations.
+
+The locked mutations prove the negative space: `with self._lock:` /
+`with _LOCK:` silences the rule.
+"""
+import threading
+
+_LOCK = threading.Lock()
+_REGISTRY = {}
+
+
+def module_unlocked(key, value):
+    _REGISTRY[key] = value              # line 13: LK202
+
+
+def module_locked(key, value):
+    with _LOCK:
+        _REGISTRY[key] = value          # locked: NOT a finding
+
+
+class Ledger:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.entries = []
+        self.index = {}
+
+    def add_unlocked(self, e):
+        self.entries.append(e)          # line 27: LK201
+
+    def index_unlocked(self, k, e):
+        self.index[k] = e               # line 30: LK201
+
+    def reset_unlocked(self):
+        self.entries = []               # line 33: LK201 (rebinding)
+
+    def add_locked(self, e):
+        with self._lock:
+            self.entries.append(e)      # locked: NOT a finding
+            self.index[id(e)] = e
+
+
+_TABLE: dict = {}                       # AnnAssign declares too
+
+
+def table_unlocked(k, v):
+    _TABLE[k] = v                       # line 46: LK202 (annotated decl)

@@ -1,0 +1,36 @@
+"""tpulint golden fixture: RG (registry drift) violations.
+
+The test injects declared_families={"dl4jtpu_known_total"},
+fault_sites={"known.site"}, declared_marks={"slow"} on the
+LintContext, so only the unknown names below fire.
+"""
+import pytest
+
+from deeplearning4j_tpu.observe.metrics import registry
+from deeplearning4j_tpu.runtime import faults
+
+
+def good_metric():
+    registry().counter("dl4jtpu_known_total").inc()     # declared: clean
+
+
+def drifted_metric():
+    registry().counter("dl4jtpu_unknown_total").inc()   # line 18: RG301
+
+
+def good_site():
+    faults.maybe_fail("known.site")                     # registered: clean
+
+
+def drifted_site():
+    faults.maybe_fail("rogue.site")                     # line 26: RG302
+
+
+@pytest.mark.slow
+def declared_mark():
+    pass
+
+
+@pytest.mark.flaky_quarantine
+def undeclared_mark():                                  # line 34: RG303
+    pass

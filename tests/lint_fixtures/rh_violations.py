@@ -1,0 +1,42 @@
+"""tpulint golden fixture: RH (recompile / host-sync hazard) violations.
+
+Also proves the negative space: static_argnames parameters and
+shape/dtype branches are NOT hazards.
+"""
+from functools import partial
+
+import jax
+import numpy as np
+
+
+@jax.jit
+def hazards(x, y):
+    a = int(x)                          # line 14: RH101
+    b = x.item()                        # line 15: RH101
+    c = np.asarray(y)                   # line 16: RH101
+    if x > 0:                           # line 17: RH102
+        a = a + 1
+    while y:                            # line 19: RH102
+        y = y - 1
+    msg = f"x was {x}"                  # line 21: RH103
+    return a, b, c, msg
+
+
+@partial(jax.jit, static_argnames=("mode",))
+def with_static(x, mode):
+    if mode == "train":                 # static arg: NOT a finding
+        x = x + 1
+    if x.ndim > 2:                      # shape branch: NOT a finding
+        x = x.reshape(x.shape[0], -1)
+    derived = x + 1
+    if derived:                         # line 32: RH102 (derived taint)
+        x = x * 2
+    return x
+
+
+def scan_body_hazard(carry, item):
+    return carry, float(item)           # line 38: RH101 (scan operand)
+
+
+def run_scan(xs):
+    return jax.lax.scan(scan_body_hazard, 0.0, xs)

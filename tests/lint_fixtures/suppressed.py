@@ -1,0 +1,35 @@
+"""tpulint golden fixture: suppression comments silence vetted sites.
+# tpulint: disable-file=RG303
+
+Every violation below carries a suppression — the whole file must lint
+clean.  The file-level directive above silences RG303 everywhere.
+"""
+import threading
+import time
+
+import jax
+import pytest
+
+_LOCK = threading.Lock()
+_CACHE = {}
+
+
+@jax.jit
+def step(x):
+    t0 = time.time()  # tpulint: disable=TP001
+    if x > 0:  # tpulint: disable=RH102
+        x = x + 1
+    return x + t0
+
+
+def put(k, v):
+    _CACHE[k] = v  # tpulint: disable=LK202
+
+
+def put_all_off(k, v):
+    _CACHE[k] = v  # tpulint: disable=all
+
+
+@pytest.mark.totally_undeclared
+def marked():
+    pass

@@ -1,0 +1,39 @@
+"""tpulint golden fixture: TP (trace purity) violations.
+
+test_analysis.py asserts the EXACT (rule, line) pairs below — keep the
+line layout stable or update the goldens.
+"""
+import time
+
+import jax
+
+COUNTER = 0
+
+
+@jax.jit
+def impure_step(x):
+    t0 = time.time()                    # line 15: TP001
+    print("step at", t0)                # line 16: TP002
+    global COUNTER                      # line 17: TP003
+    COUNTER += 1
+    return x + t0
+
+
+def bump_metrics():
+    from deeplearning4j_tpu.observe.metrics import registry
+    registry().counter("x").inc()       # line 24: TP004 (via helper)
+
+
+@jax.jit
+def telemetry_step(x):
+    bump_metrics()
+    return x
+
+
+def kw_operand_body(carry, item):
+    print("traced via keyword")         # line 34: TP002 (f=... operand)
+    return carry, item
+
+
+def run_keyword_scan(xs):
+    return jax.lax.scan(f=kw_operand_body, init=0, xs=xs)
