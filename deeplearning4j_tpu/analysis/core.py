@@ -47,6 +47,9 @@ RULE_CATALOG: dict[str, str] = {
              ".tolist()) of a tracer inside a traced body",
     "RH102": "Python if/while on a tracer value inside a traced body",
     "RH103": "tracer interpolated into an f-string inside a traced body",
+    "RH105": "use-after-donate: a reference passed at a donate_argnums "
+             "position of a jitted call is read after the dispatch "
+             "without being rebound from its results",
     "LK201": "instance container guarded by a sibling Lock mutated "
              "outside `with <lock>:`",
     "LK202": "module-level container guarded by a module Lock mutated "

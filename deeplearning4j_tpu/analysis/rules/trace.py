@@ -585,11 +585,218 @@ class _TaintScanner:
             ))
 
 
+# --------------------------------------------------------- RH105 ------
+# Use-after-donate: a jitted step compiled with donate_argnums consumes
+# its donated arguments' buffers — the caller's reference points at
+# freed device memory after the dispatch.  The exemption that makes the
+# rule usable is donation awareness: the dominant correct idiom rebinds
+# the donated names from the call's own results
+# (``params, opt = step(params, opt, ...)``), which clears the hazard,
+# so only references that stay live AFTER the dispatch are flagged.
+
+def _jit_donate_nums(node: ast.AST) -> set:
+    """Literal donate_argnums positions from a jit decorator/wrapper
+    call (``@partial(jax.jit, donate_argnums=(0, 1))`` /
+    ``jax.jit(f, donate_argnums=(0,))``)."""
+    nums: set = set()
+    if isinstance(node, ast.Call):
+        for kw in node.keywords:
+            if kw.arg == "donate_argnums":
+                for n in ast.walk(kw.value):
+                    if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                        nums.add(n.value)
+    return nums
+
+
+def _collect_donating_defs(tree: ast.Module) -> dict:
+    """{callable name: donated positions} for every def decorated with
+    a jit wrapper carrying donate_argnums, plus ``name = jax.jit(f,
+    donate_argnums=...)`` assignments."""
+    out: dict[str, set] = {}
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            for dec in n.decorator_list:
+                if _is_jit_decorator(dec):
+                    nums = _jit_donate_nums(dec)
+                    if nums:
+                        out[n.name] = nums
+        elif isinstance(n, ast.Assign) and isinstance(n.value, ast.Call):
+            f = dotted_name(n.value.func)
+            if f in JIT_WRAPPERS:
+                nums = _jit_donate_nums(n.value)
+                if nums:
+                    for t in n.targets:
+                        if isinstance(t, ast.Name):
+                            out[t.id] = nums
+    return out
+
+
+def _ref_chain(node: ast.AST) -> Optional[str]:
+    """Dotted string for a Name / self-rooted Attribute chain
+    (``params``, ``self.params``) — the reference forms donation
+    tracking follows.  None for anything else."""
+    parts: list[str] = []
+    cur = node
+    while isinstance(cur, ast.Attribute):
+        parts.append(cur.attr)
+        cur = cur.value
+    if isinstance(cur, ast.Name):
+        parts.append(cur.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _read_refs(node: ast.AST, skip: Optional[set] = None) -> dict:
+    """{dotted ref: first line} of every Name/attribute-chain READ under
+    `node`, counting the longest chain once (a read of ``self.params``
+    does not also count as a read of ``self``).  `skip` holds node ids
+    to not descend into (nested defs fork their own scope)."""
+    out: dict[str, int] = {}
+    stack = [node]
+    while stack:
+        n = stack.pop()
+        if skip is not None and id(n) in skip:
+            continue
+        if isinstance(n, FuncNode):
+            continue
+        chain = _ref_chain(n) if isinstance(n, (ast.Name, ast.Attribute)) \
+            else None
+        if chain is not None:
+            ctx = getattr(n, "ctx", None)
+            if isinstance(ctx, ast.Load):
+                out.setdefault(chain, n.lineno)
+                continue              # the chain is one read; don't split
+        stack.extend(ast.iter_child_nodes(n))
+    return out
+
+
+class _DonationScanner:
+    """Linear source-order walk of ONE function body tracking which
+    references were donated to a jitted call and not rebound since."""
+
+    def __init__(self, unit: ModuleUnit, donating: dict):
+        self.unit = unit
+        self.donating = donating
+        self.findings: list[Finding] = []
+        self.donated: dict[str, int] = {}    # ref -> donating call line
+
+    def scan(self, func: ast.AST) -> None:
+        for stmt in func.body:
+            self._stmt(stmt)
+
+    def _stmt(self, node: ast.AST) -> None:
+        if isinstance(node, FuncNode):
+            return                    # nested scopes tracked separately
+        if isinstance(node, ast.If):
+            self._flat(node.test, node)
+            for s in node.body + node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, (ast.For, ast.While)):
+            if isinstance(node, ast.For):
+                self._flat(node.iter, node)
+            else:
+                self._flat(node.test, node)
+            for s in node.body:
+                self._stmt(s)
+            # back-edge: a donation made in the body with NO rebinding
+            # reaches the body's own reads on iteration 2 — the
+            # canonical `for x in xs: step(params, opt, x)` bug.  One
+            # extra pass with the accumulated donated state models it
+            # (rebinding idioms cleared the set above, so they stay
+            # silent).
+            if self.donated:
+                for s in node.body:
+                    self._stmt(s)
+            for s in node.orelse:
+                self._stmt(s)
+            return
+        if isinstance(node, (ast.With, ast.AsyncWith)):
+            for item in node.items:
+                self._flat(item.context_expr, node)
+            for s in node.body:
+                self._stmt(s)
+            return
+        if isinstance(node, ast.Try):
+            for s in (node.body + node.orelse
+                      + [h2 for h in node.handlers for h2 in h.body]
+                      + node.finalbody):
+                self._stmt(s)
+            return
+        self._flat(node, node)
+
+    def _flat(self, node: ast.AST, stmt: ast.AST) -> None:
+        """One flat statement/expression: reads are checked against the
+        donated set FIRST (passing an already-donated buffer anywhere —
+        including back into the step — is a use-after-donate), then this
+        statement's own donations and rebinds apply."""
+        skip = {id(n) for n in ast.walk(node) if isinstance(n, FuncNode)}
+        for ref, line in sorted(_read_refs(node, skip).items()):
+            if ref in self.donated:
+                self.findings.append(Finding(
+                    "RH105", self.unit.relpath, line, stmt.col_offset,
+                    f"`{ref}` read after being donated to a jitted call "
+                    f"on line {self.donated[ref]} (donate_argnums): the "
+                    "buffer is freed by the dispatch — rebind the name "
+                    "from the call's results or drop the donation",
+                ))
+                del self.donated[ref]          # one report per donation
+        pending: dict[str, int] = {}
+        for call in ast.walk(node):
+            if id(call) in skip or not isinstance(call, ast.Call):
+                continue
+            name = dotted_name(call.func)
+            nums = self.donating.get(name) if name else None
+            if not nums:
+                continue
+            for i, arg in enumerate(call.args):
+                if i in nums:
+                    ref = _ref_chain(arg)
+                    if ref is not None:
+                        pending[ref] = call.lineno
+        rebound: set = set()
+        if isinstance(node, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                for sub in ast.walk(t):
+                    chain = _ref_chain(sub) if isinstance(
+                        sub, (ast.Name, ast.Attribute)) else None
+                    if chain is not None and isinstance(
+                            getattr(sub, "ctx", None), ast.Store):
+                        rebound.add(chain)
+        for ref in rebound:
+            pending.pop(ref, None)
+            self.donated.pop(ref, None)
+        self.donated.update(pending)
+
+
+def _scan_donation(unit: ModuleUnit, tree: ast.Module) -> Iterator[Finding]:
+    donating = _collect_donating_defs(tree)
+    if not donating:
+        return
+    seen: set = set()
+    for n in ast.walk(tree):
+        if not isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        if n.name in donating:
+            continue                  # the jitted body itself: traced rules
+        scanner = _DonationScanner(unit, donating)
+        scanner.scan(n)
+        for f in scanner.findings:
+            # the loop back-edge re-pass may revisit a site: one report
+            key = (f.rule, f.line, f.col)
+            if key not in seen:
+                seen.add(key)
+                yield f
+
+
 # ------------------------------------------------------------ driver --
 
 def check_module(ctx: LintContext, unit: ModuleUnit) -> Iterator[Finding]:
     tree = unit.tree
     _attach_parents(tree)
+    yield from _scan_donation(unit, tree)
     roots, marked = _collect_traced(tree)
     # a helper reachable from N traced roots is still ONE defect site:
     # dedup by (rule, line, col) so reports and baselines see it once

@@ -6,6 +6,7 @@ from deeplearning4j_tpu.data.iterator import (
     ExistingDataSetIterator,
     NumpyDataSetIterator,
 )
+from deeplearning4j_tpu.data.prefetch import PrefetchIterator
 
 __all__ = [
     "DataSet",
@@ -15,4 +16,5 @@ __all__ = [
     "ExistingDataSetIterator",
     "AsyncDataSetIterator",
     "CachedDataSetIterator",
+    "PrefetchIterator",
 ]

@@ -9,11 +9,8 @@ step never waits on host→HBM DMA — the double-buffering idiom.
 
 from __future__ import annotations
 
-import queue
-import threading
 from collections.abc import Iterable, Iterator
 
-import jax
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
@@ -100,66 +97,36 @@ class AsyncDataSetIterator(DataSetIterator):
     batches).  With device_put=True, batches are transferred to the default
     device from the producer thread, overlapping host ETL + DMA with the
     running step.
+
+    Since the pipelined fit loop landed this is a thin facade over
+    `data/prefetch.PrefetchIterator` — ONE producer-thread
+    implementation carries all the hardening (bounded queue, in-order
+    error sentinel, close()-joins-the-thread shutdown, the
+    `data.prefetch` fault site, overlap stage tags): `queue_size` maps
+    to `depth`, `device_put=True` maps to the `stage_to_device` hook.
     """
 
-    _END = object()
-
     def __init__(self, base: DataSetIterator, queue_size: int = 2, device_put: bool = True):
+        from deeplearning4j_tpu.data.prefetch import (
+            PrefetchIterator, stage_to_device,
+        )
+
         self._base = base
-        self._qsize = max(1, queue_size)
-        self._device_put = device_put
+        self._prefetch = PrefetchIterator(
+            base,
+            depth=queue_size,
+            stage=stage_to_device if device_put else None,
+        )
 
     @property
     def batch_size(self) -> int:
         return self._base.batch_size
 
     def reset(self) -> None:
-        self._base.reset()
+        self._prefetch.reset()
+
+    def close(self) -> None:
+        self._prefetch.close()
 
     def __iter__(self) -> Iterator[DataSet]:
-        q: queue.Queue = queue.Queue(maxsize=self._qsize)
-        err: list[BaseException] = []
-        stop = threading.Event()
-
-        def put(item) -> bool:
-            # bounded put that gives up when the consumer abandoned the
-            # iterator — otherwise the thread (and its pinned device
-            # buffers) would leak on early exit from the for-loop
-            while not stop.is_set():
-                try:
-                    q.put(item, timeout=0.1)
-                    return True
-                except queue.Full:
-                    continue
-            return False
-
-        def produce():
-            try:
-                for batch in self._base:
-                    if self._device_put:
-                        batch = DataSet(
-                            jax.device_put(batch.features),
-                            jax.device_put(batch.labels),
-                            None if batch.features_mask is None else jax.device_put(batch.features_mask),
-                            None if batch.labels_mask is None else jax.device_put(batch.labels_mask),
-                        )
-                    if not put(batch):
-                        return
-            except BaseException as e:  # surfaced on the consumer side
-                err.append(e)
-            finally:
-                put(self._END)
-
-        t = threading.Thread(target=produce, daemon=True)
-        t.start()
-        try:
-            while True:
-                item = q.get()
-                if item is self._END:
-                    break
-                yield item
-        finally:
-            stop.set()
-            t.join(timeout=5.0)
-        if err:
-            raise err[0]
+        return iter(self._prefetch)

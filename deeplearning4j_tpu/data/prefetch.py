@@ -1,0 +1,203 @@
+"""Device prefetch — the fit loops' software-pipelining stage.
+
+PR 2's per-step spans showed every fit path running strictly serially:
+``etl_wait -> host_stage -> dispatch -> device_sync`` — the device idles
+while the host pulls and stages the NEXT batch, and the host idles while
+the device computes.  `PrefetchIterator` breaks that serialization: a
+background thread pulls batch N+1 from the base iterator and stages it
+to device (``jax.device_put``) while step N's program runs, feeding a
+bounded queue the training thread drains.  The overlap this buys is
+exactly the input-pipeline/compute overlap the TF system paper and GSPMD
+get their throughput from (PAPERS.md).
+
+The fit loops wrap their iterator in one of these automatically (see
+``Model._prefetch_feed``) behind ``flags.prefetch_depth`` — default 2,
+0 restores the serial behavior.  Contract:
+
+- **ordering + byte identity**: batches come out in base-iterator order
+  with identical values (staging moves bytes, never transforms them);
+- **bounded depth**: at most ``depth`` staged batches exist at once, so
+  prefetching never pins more than ``depth`` batches of HBM;
+- **clean shutdown**: abandoning the iteration (an exception or
+  KeyboardInterrupt in the training loop) stops the producer thread and
+  joins it — no leaked threads, no orphaned device buffers being
+  written to after the loop died;
+- **error transparency**: a producer-side exception (decode error, an
+  armed ``data.prefetch`` fault) surfaces on the training thread at the
+  queue position where it happened, after every batch staged before it;
+- **overlap accounting**: each staged batch carries the producer-side
+  seconds spent pulling + staging it; the fit loops subtract their own
+  queue wait to measure how much of that work was actually hidden
+  behind compute (``overlap_seconds`` on the ``train_step`` span,
+  ``dl4jtpu_prefetch_overlap_seconds_total`` on the spine).
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+from typing import Callable, Iterator, Optional
+
+from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+from deeplearning4j_tpu.data.iterator import DataSetIterator
+
+# Attributes _timed_batches reads off a staged batch.  Stage functions
+# must copy them from the source batch (tag-preserving staging keeps the
+# cache-hit ETL attribution working through the prefetch wrap).
+BATCH_TAGS = ("_etl_source",)
+
+
+def stage_to_device(batch):
+    """Default staging: move every array of a DataSet/MultiDataSet to
+    the default device (values unchanged — uint8 stays uint8).  Runs on
+    the producer thread so host->HBM DMA overlaps the running step."""
+    import jax
+
+    def put(a):
+        return None if a is None else jax.device_put(a)
+
+    if isinstance(batch, MultiDataSet):
+        staged = MultiDataSet(
+            tuple(put(f) for f in batch.features),
+            tuple(put(l) for l in batch.labels),
+            None if batch.features_masks is None
+            else tuple(put(m) for m in batch.features_masks),
+            None if batch.labels_masks is None
+            else tuple(put(m) for m in batch.labels_masks),
+        )
+    elif isinstance(batch, DataSet):
+        staged = DataSet(
+            put(batch.features),
+            put(batch.labels),
+            put(batch.features_mask),
+            put(batch.labels_mask),
+        )
+    else:
+        return batch          # unknown batch type: pull-ahead only
+    for tag in BATCH_TAGS:
+        v = getattr(batch, tag, None)
+        if v is not None:
+            setattr(staged, tag, v)
+    return staged
+
+
+class PrefetchIterator(DataSetIterator):
+    """Background-thread device prefetch with a bounded queue.
+
+    stage: callable applied to each batch ON THE PRODUCER THREAD
+      (default `stage_to_device`); pass `None` for pull-ahead without
+      device placement (multi-process feeds stage on the training
+      thread via `place_batch` — `put_global` forms global arrays and
+      must not run concurrently with the step).
+    """
+
+    _END = object()
+
+    def __init__(self, base, depth: int = 2,
+                 stage: Optional[Callable] = stage_to_device):
+        self._base = base
+        self._depth = max(1, int(depth))
+        self._stage = stage
+        self._stop: Optional[threading.Event] = None
+        self._thread: Optional[threading.Thread] = None
+
+    @property
+    def depth(self) -> int:
+        return self._depth
+
+    @property
+    def batch_size(self) -> int:
+        return getattr(self._base, "batch_size", 0)
+
+    def reset(self) -> None:
+        self.close()
+        if hasattr(self._base, "reset"):
+            self._base.reset()
+
+    def close(self) -> None:
+        """Stop and join the active producer thread (idempotent).  The
+        fit loops call this in a finally: an exception mid-epoch must
+        not leave a thread pulling batches for a dead loop."""
+        stop, thread = self._stop, self._thread
+        self._stop, self._thread = None, None
+        if stop is not None:
+            stop.set()
+        if thread is not None and thread.is_alive():
+            thread.join(timeout=10.0)
+
+    def __iter__(self) -> Iterator:
+        from deeplearning4j_tpu.runtime import faults
+
+        self.close()                      # one producer per iteration
+        q: queue.Queue = queue.Queue(maxsize=self._depth)
+        stop = threading.Event()
+
+        def put(item) -> bool:
+            # bounded put that gives up once the consumer abandoned the
+            # epoch — otherwise the thread (and the staged device
+            # buffers it holds) would leak on early exit
+            while not stop.is_set():
+                try:
+                    q.put(item, timeout=0.1)
+                    return True
+                except queue.Full:
+                    continue
+            return False
+
+        def produce():
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            staged_total = registry().counter(
+                "dl4jtpu_prefetch_batches_total"
+            )
+            try:
+                it = iter(self._base)
+                while True:
+                    t0 = time.perf_counter()
+                    # fault site: the producer's pull+stage (armed plans
+                    # provoke the flaky-prefetch failure mode; disarmed
+                    # this is one attribute check)
+                    faults.maybe_fail("data.prefetch")
+                    try:
+                        batch = next(it)
+                    except StopIteration:
+                        return
+                    if self._stage is not None:
+                        batch = self._stage(batch)
+                    try:
+                        batch._prefetch_stage_s = (
+                            time.perf_counter() - t0
+                        )
+                    except AttributeError:
+                        pass              # slotted/foreign batch types
+                    staged_total.inc()
+                    if not put(batch):
+                        return
+            except BaseException as e:
+                # surfaced in-order on the consumer side: batches staged
+                # before the failure still train
+                put((self._END, e))
+                return
+            finally:
+                put((self._END, None))
+
+        t = threading.Thread(
+            target=produce, name="dl4jtpu-prefetch", daemon=True
+        )
+        self._stop, self._thread = stop, t
+        t.start()
+        try:
+            while True:
+                item = q.get()
+                if isinstance(item, tuple) and len(item) == 2 \
+                        and item[0] is self._END:
+                    if item[1] is not None:
+                        raise item[1]
+                    return
+                yield item
+        finally:
+            stop.set()
+            t.join(timeout=10.0)
+            if self._thread is t:
+                self._stop, self._thread = None, None

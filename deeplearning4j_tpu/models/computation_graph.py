@@ -258,23 +258,31 @@ class GraphModel(Model):
         if self.params is None:
             self.init()
         iterator = self._as_batches(data, batch_size)
+        self._donation_checked = False     # re-arm the one-time alias check
         use_multi = (
             steps_per_execution > 1
             and getattr(self, "_batch_sharding", None) is None
         )
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch)
-            if use_multi:
-                self._fit_epoch_multi(iterator, steps_per_execution)
-            else:
-                for batch in self._timed_batches(iterator):
-                    self.fit_batch(batch)
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch)
-            self.epoch += 1
-            if hasattr(iterator, "reset"):
-                iterator.reset()
+        # software pipelining, same contract as SequentialModel.fit:
+        # pull + device staging for batch N+1 overlap step N's compute
+        feed = self._prefetch_feed(iterator)
+        try:
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self, self.epoch)
+                if use_multi:
+                    self._fit_epoch_multi(feed, steps_per_execution)
+                else:
+                    for batch in self._timed_batches(feed):
+                        self.fit_batch(batch)
+                for lst in self.listeners:
+                    lst.on_epoch_end(self, self.epoch)
+                self.epoch += 1
+                if hasattr(iterator, "reset"):
+                    iterator.reset()
+        finally:
+            if feed is not iterator:
+                feed.close()
         for lst in self.listeners:
             # getattr: on_fit_end is newer than the SPI — tolerate
             # duck-typed listeners written against the original three hooks

@@ -16,6 +16,112 @@ from deeplearning4j_tpu.train.listeners import TrainingListener
 from deeplearning4j_tpu.utils.pytree import param_count, tree_flatten_with_paths
 
 
+class _LazyScores:
+    """The k device losses of one grouped program, materialized host-side
+    AT MOST ONCE — on the first listener that actually reads a score
+    (one batched transfer) instead of unconditionally at program exit.
+    A fit whose listeners never read scores (checkpointing, ETA logging)
+    never blocks on the device at all."""
+
+    __slots__ = ("_device", "_host")
+
+    def __init__(self, device_losses):
+        self._device = device_losses
+        self._host = None
+
+    def fetch(self) -> np.ndarray:
+        if self._host is None:
+            self._host = np.asarray(self._device)
+            self._device = None           # drop the device handle
+        return self._host
+
+    def __getitem__(self, i: int) -> "_LazyScore":
+        return _LazyScore(self, i)
+
+
+class _LazyScore:
+    """One step's score from a _LazyScores group: quacks like the host
+    float listeners always received — conversion, formatting,
+    comparison and arithmetic all work — but defers the D2H sync until
+    the first such numeric read actually happens."""
+
+    __slots__ = ("_group", "_i")
+
+    def __init__(self, group: _LazyScores, i: int):
+        self._group = group
+        self._i = i
+
+    def __float__(self) -> float:
+        return float(self._group.fetch()[self._i])
+
+    def __array__(self, dtype=None, copy=None):
+        a = np.asarray(self._group.fetch()[self._i])
+        return a.astype(dtype) if dtype is not None else a
+
+    def __format__(self, spec: str) -> str:
+        return format(float(self), spec)
+
+    def __repr__(self) -> str:
+        return repr(float(self))
+
+    def __bool__(self) -> bool:
+        return bool(float(self))
+
+    def __int__(self) -> int:
+        return int(float(self))
+
+    # duck-typed listeners compare and accumulate scores (`score <
+    # best`, `total += score`); each delegates to the batched fetch
+    def __lt__(self, other):
+        return float(self) < other
+
+    def __le__(self, other):
+        return float(self) <= other
+
+    def __gt__(self, other):
+        return float(self) > other
+
+    def __ge__(self, other):
+        return float(self) >= other
+
+    def __eq__(self, other):
+        return float(self) == other
+
+    def __ne__(self, other):
+        return float(self) != other
+
+    def __hash__(self):
+        return hash(float(self))
+
+    def __add__(self, other):
+        return float(self) + other
+
+    __radd__ = __add__
+
+    def __sub__(self, other):
+        return float(self) - other
+
+    def __rsub__(self, other):
+        return other - float(self)
+
+    def __mul__(self, other):
+        return float(self) * other
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, other):
+        return float(self) / other
+
+    def __rtruediv__(self, other):
+        return other / float(self)
+
+    def __neg__(self):
+        return -float(self)
+
+    def __abs__(self):
+        return abs(float(self))
+
+
 class Model:
     def __init__(self):
         self.params: Any = None        # pytree {layer_name: {param_name: array}}
@@ -30,6 +136,13 @@ class Model:
         # (decode/tokenize/disk — anything the device waited for)
         self.etl_wait_s: float = 0.0        # cumulative across fits
         self.last_etl_wait_s: float = 0.0   # wait before the latest batch
+        # Pipelining accounting: producer-thread staging seconds hidden
+        # behind device compute (PrefetchIterator), accumulated between
+        # step scopes and stamped onto the train_step span
+        self.last_overlap_s: float = 0.0
+        self._overlap_accum: float = 0.0
+        # one-time per fit: donated trees must not be aliased by listeners
+        self._donation_checked: bool = True
         from deeplearning4j_tpu.runtime import compile_stats as _cs
 
         self._compile_snap = _cs.snapshot()   # baseline at model creation
@@ -52,6 +165,9 @@ class Model:
         reg = registry()
         wait_total = reg.counter("dl4jtpu_etl_wait_seconds_total")
         batches_total = reg.counter("dl4jtpu_etl_batches_total")
+        overlap_total = reg.counter(
+            "dl4jtpu_prefetch_overlap_seconds_total"
+        )
         rec = tracer()
         it = iter(iterator)
         while True:
@@ -65,11 +181,32 @@ class Model:
             except StopIteration:
                 return
             wait = time.perf_counter() - t0
-            self.last_etl_wait_s = wait
-            self.etl_wait_s += wait
-            wait_total.inc(wait)
             batches_total.inc()
-            rec.add_complete("etl_wait", t0, wait, cat="step_phase")
+            source = getattr(batch, "_etl_source", None)
+            if source is not None:
+                # cache replay: the pull cost is mmap/page-cache time,
+                # not input-pipeline starvation — attribute it to its
+                # own labeled series instead of inflating ETL wait
+                self.last_etl_wait_s = 0.0
+                wait_total.inc(wait, source=source)
+                rec.add_complete("etl_wait", t0, wait, cat="step_phase",
+                                 source=source)
+            else:
+                self.last_etl_wait_s = wait
+                self.etl_wait_s += wait
+                wait_total.inc(wait)
+                rec.add_complete("etl_wait", t0, wait, cat="step_phase")
+            stage_s = getattr(batch, "_prefetch_stage_s", None)
+            if stage_s is not None:
+                # producer work not re-paid as consumer wait = the
+                # seconds the prefetch pipeline hid behind compute
+                overlap = max(0.0, stage_s - wait)
+                self.last_overlap_s = overlap
+                self._overlap_accum += overlap
+                if overlap > 0:
+                    overlap_total.inc(overlap)
+            else:
+                self.last_overlap_s = 0.0
             yield batch
 
     def _observe_step(self, n_steps: int = 1):
@@ -81,6 +218,92 @@ class Model:
         from deeplearning4j_tpu.observe.trace import step_scope
 
         return step_scope(self, n_steps)
+
+    def _prefetch_feed(self, iterator):
+        """Wrap a fit iterator in the pipelining PrefetchIterator
+        (flags.prefetch_depth deep; 0 disables).  The caller owns
+        shutdown: close() the returned feed in a finally when it is not
+        the original iterator.
+
+        Multi-process/sharded models keep staging on the training
+        thread (place_batch -> put_global forms global arrays and is
+        not guaranteed re-entrant against a running step), so their
+        wrap is pull-ahead only — ETL decode still overlaps compute,
+        the device placement does not.
+
+        Already-materialized in-memory feeds (ExistingDataSetIterator,
+        NumpyDataSetIterator, plain lists — every `fit([batch, ...])`
+        or `fit((x, y))` call) are exempt: they have no per-batch
+        decode cost to hide, so the wrap would be pure thread-handoff
+        tax on sub-millisecond steps.  Wrap explicitly in
+        PrefetchIterator/AsyncDataSetIterator to overlap the H2D
+        staging of a pre-decoded corpus."""
+        from deeplearning4j_tpu.data.iterator import (
+            AsyncDataSetIterator,
+            ExistingDataSetIterator,
+            NumpyDataSetIterator,
+        )
+        from deeplearning4j_tpu.data.prefetch import (
+            PrefetchIterator, stage_to_device,
+        )
+        from deeplearning4j_tpu.runtime.flags import environment
+
+        depth = environment().prefetch_depth
+        if depth <= 0:
+            return iterator
+        if isinstance(iterator, (PrefetchIterator, AsyncDataSetIterator)):
+            return iterator       # already pipelined; don't double-thread
+        if isinstance(iterator, (ExistingDataSetIterator,
+                                 NumpyDataSetIterator, list, tuple)):
+            return iterator       # in-memory: nothing to hide
+        stage = (
+            None if getattr(self, "_batch_sharding", None) is not None
+            else stage_to_device
+        )
+        return PrefetchIterator(iterator, depth=depth, stage=stage)
+
+    def _check_donation_aliases(self) -> None:
+        """One-time (per fit) guard for the jitted steps' donate_argnums:
+        a listener that stashed a reference to model.params /
+        opt_state / net_state during its first iteration_done would read
+        donated (deleted) buffers after the NEXT step consumes them.
+        Runs after the first listener dispatch — exactly when such a
+        stash exists but before the second step invalidates it — and
+        scans each listener's PUBLIC attributes for leaves aliasing the
+        live trees.  Private (underscore) attributes are trusted to
+        manage donation themselves (HealthListener keeps an old params
+        DICT for identity comparison and jit-output COPIES for |Δw| —
+        both safe by construction)."""
+        import jax
+
+        live = {
+            id(leaf) for leaf in jax.tree.leaves(
+                (self.params, self.opt_state, self.net_state)
+            )
+        }
+        for lst in self.listeners:
+            attrs = getattr(lst, "__dict__", None)
+            if not attrs:
+                continue
+            for attr, value in attrs.items():
+                if attr.startswith("_"):
+                    continue
+                try:
+                    leaves = jax.tree.leaves(value)
+                except Exception:
+                    continue      # exotic containers: not our trees
+                for leaf in leaves:
+                    if id(leaf) in live:
+                        raise RuntimeError(
+                            f"listener {type(lst).__name__}.{attr} "
+                            "aliases the model's live param/opt-state "
+                            "buffers; the next training step DONATES "
+                            "those buffers to XLA and the reference "
+                            "would read freed memory.  Copy instead "
+                            "(np.asarray / jax.tree.map(jnp.copy, ...)) "
+                            "or snapshot via train.listeners."
+                            "_host_snapshot."
+                        )
 
     def compile_stats(self) -> dict:
         """Compile-tax counters since this model was constructed, plus
@@ -108,12 +331,23 @@ class Model:
     def _dispatch_iteration(self, score) -> None:
         for lst in self.listeners:
             lst.iteration_done(self, self.iteration, self.epoch, score)
+        if not self._donation_checked:
+            # after the FIRST dispatch of a fit: any stash a listener
+            # just took still aliases the live trees, and the second
+            # step has not yet donated them — the one moment the
+            # use-after-donate hazard is both present and harmless
+            self._donation_checked = True
+            if self.listeners:
+                self._check_donation_aliases()
 
     def _finish_grouped_steps(self, losses, k: int) -> None:
         """Bookkeeping after a program that ran k optimizer steps (TBPTT
         windows or steps_per_execution groups): score/iteration update,
-        and — only when listeners exist — ONE D2H transfer of all k losses
-        followed by per-step dispatch with host scalars."""
+        and per-step listener dispatch with LAZY scores — the k device
+        losses are fetched host-side at most once (one batched D2H
+        transfer), and only when a listener actually reads a score.
+        Log-every-K listeners therefore sync at THEIR cadence instead of
+        every group."""
         from deeplearning4j_tpu.observe.trace import tracer
 
         rec = tracer()
@@ -123,16 +357,16 @@ class Model:
             # no device_sync span here: every grouped caller already
             # emitted one around obs.sync, and a second ~0us span would
             # double-count the phase in the timeline
-            host_losses = np.asarray(losses)
+            lazy = _LazyScores(losses)
             self.iteration -= k
             done = 0
             try:
                 with rec.span("listeners", cat="step_phase"):
                     for w in range(k):
-                        self._last_score = host_losses[w]
+                        self._last_score = lazy[w]
                         self.iteration += 1
                         done += 1
-                        self._dispatch_iteration(host_losses[w])
+                        self._dispatch_iteration(lazy[w])
             finally:
                 # a throwing listener must not leave the counter rewound —
                 # all k steps DID run on device

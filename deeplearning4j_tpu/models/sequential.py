@@ -860,24 +860,34 @@ class SequentialModel(Model):
         if self.params is None:
             self.init()
         iterator = _as_iterator(data, batch_size)
+        self._donation_checked = False     # re-arm the one-time alias check
         use_multi = (
             steps_per_execution > 1
             and not getattr(self, "_grad_compression", None)
             and getattr(self, "_pipeline_schedule", "gpipe") != "1f1b"
             and getattr(self, "_batch_sharding", None) is None
         )
-        for _ in range(epochs):
-            for lst in self.listeners:
-                lst.on_epoch_start(self, self.epoch)
-            if use_multi:
-                self._fit_epoch_multi(iterator, steps_per_execution)
-            else:
-                for batch in self._timed_batches(iterator):
-                    self.fit_batch(batch)
-            for lst in self.listeners:
-                lst.on_epoch_end(self, self.epoch)
-            self.epoch += 1
-            iterator.reset()
+        # software pipelining: batch N+1 is pulled + staged to device on
+        # a background thread while step N computes (flags.prefetch_depth
+        # deep; 0 = serial).  close() in the finally stops the producer
+        # even when a step raises mid-epoch.
+        feed = self._prefetch_feed(iterator)
+        try:
+            for _ in range(epochs):
+                for lst in self.listeners:
+                    lst.on_epoch_start(self, self.epoch)
+                if use_multi:
+                    self._fit_epoch_multi(feed, steps_per_execution)
+                else:
+                    for batch in self._timed_batches(feed):
+                        self.fit_batch(batch)
+                for lst in self.listeners:
+                    lst.on_epoch_end(self, self.epoch)
+                self.epoch += 1
+                iterator.reset()
+        finally:
+            if feed is not iterator:
+                feed.close()
         for lst in self.listeners:
             # getattr: on_fit_end is newer than the SPI — tolerate
             # duck-typed listeners written against the original three hooks

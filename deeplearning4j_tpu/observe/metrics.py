@@ -386,6 +386,13 @@ def _declare_core(reg: MetricsRegistry) -> None:
     reg.counter("dl4jtpu_data_cache_batches_total",
                 "Batches served by CachedDataSetIterator, by source "
                 "(cache=mmap replay, decode=base-pipeline population)")
+    # pipelined fit loop (data/prefetch.py)
+    reg.counter("dl4jtpu_prefetch_batches_total",
+                "Batches pulled + staged by the PrefetchIterator "
+                "producer thread")
+    reg.counter("dl4jtpu_prefetch_overlap_seconds_total",
+                "Producer-thread staging seconds hidden behind device "
+                "compute (stage time not re-paid as consumer wait)")
     # step engine
     reg.histogram("dl4jtpu_step_latency_seconds",
                   "Host wall time per dispatched training-step program "

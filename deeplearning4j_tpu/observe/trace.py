@@ -216,14 +216,16 @@ class StepScope:
     """
 
     __slots__ = ("_rec", "_hist", "_steps", "_n", "_iteration", "_t0",
-                 "_dispatched")
+                 "_dispatched", "_overlap")
 
-    def __init__(self, iteration: int, n_steps: int = 1):
+    def __init__(self, iteration: int, n_steps: int = 1,
+                 overlap_s: float = 0.0):
         self._rec = tracer()
         self._hist, self._steps = _step_families()
         self._n = n_steps
         self._iteration = iteration
         self._dispatched = False
+        self._overlap = overlap_s
 
     def __enter__(self) -> "StepScope":
         self._t0 = time.perf_counter()
@@ -241,6 +243,10 @@ class StepScope:
             self._hist.observe(dur)
             self._steps.inc(self._n)
         args = {"iteration": self._iteration, "n_steps": self._n}
+        if self._overlap > 0:
+            # the prefetch pipeline's win for this step: producer-thread
+            # staging seconds that ran concurrently with compute
+            args["overlap_seconds"] = round(self._overlap, 6)
         if failed:
             args["error"] = exc[0].__name__
         self._rec.add_complete("train_step", self._t0, dur, cat="step",
@@ -263,5 +269,10 @@ class StepScope:
 
 
 def step_scope(model, n_steps: int = 1) -> StepScope:
-    """StepScope for a model's next dispatched program."""
-    return StepScope(getattr(model, "iteration", 0), n_steps)
+    """StepScope for a model's next dispatched program.  Drains the
+    model's accumulated prefetch-overlap seconds (everything hidden
+    since the previous scope) onto this step's span."""
+    overlap = getattr(model, "_overlap_accum", 0.0)
+    if overlap:
+        model._overlap_accum = 0.0
+    return StepScope(getattr(model, "iteration", 0), n_steps, overlap)

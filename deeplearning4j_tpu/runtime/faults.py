@@ -17,6 +17,8 @@ Sites wired today:
   ``checkpoint.fsync``   between the zip landing in the tmp file and its
                          atomic publish (a ``kill`` here IS kill-9-mid-write)
   ``data.next_batch``    the fit loops' batch pull
+  ``data.prefetch``      the PrefetchIterator producer thread, before each
+                         base-iterator pull + device staging
 
 Plan grammar (also the ``DL4J_TPU_FAULT_PLAN`` env value, so subprocess
 workers inherit the plan from their spawner's environment)::
@@ -68,6 +70,8 @@ SITES: dict = {
                         "its atomic publish (kill here = kill-9 "
                         "mid-write)",
     "data.next_batch": "the fit loops' batch pull",
+    "data.prefetch": "the PrefetchIterator producer thread, before each "
+                     "base-iterator pull + device staging",
 }
 
 

@@ -38,6 +38,12 @@ class Environment:
     # Shape-bucketing quantum for variable-length sequence batches
     # (recompilation hygiene, SURVEY.md §7.3 item 6).
     sequence_bucket_size: int = 64
+    # Software-pipelined fit loop: how many batches the fit loops'
+    # PrefetchIterator stages to device ahead of the running step
+    # (background thread + bounded queue).  0 disables the wrap — every
+    # batch is pulled and staged serially on the training thread, the
+    # pre-pipelining behavior.
+    prefetch_depth: int = 2
 
     def set_nan_panic(self, on: bool) -> None:
         self.nan_panic = on
@@ -51,6 +57,9 @@ class Environment:
             use_bfloat16_compute=_env_bool("DL4J_TPU_BF16", True),
             sequence_bucket_size=int(
                 os.environ.get("DL4J_TPU_SEQUENCE_BUCKET", "64")
+            ),
+            prefetch_depth=int(
+                os.environ.get("DL4J_TPU_PREFETCH_DEPTH", "2")
             ),
         )
         if _env_bool("DL4J_TPU_NAN_PANIC"):

@@ -31,12 +31,18 @@ class TrainingListener:
 
 
 class ScoreIterationListener(TrainingListener):
+    """Logs the score every N iterations.  Deferred-sync contract: the
+    score arrives as a device scalar (or a lazy grouped-program view)
+    and is only converted — the batched block_until_ready — at this
+    listener's cadence, so the other print_every-1 steps never block
+    the host on the device."""
+
     def __init__(self, print_every: int = 10):
         self.print_every = max(1, print_every)
 
     def iteration_done(self, model, iteration, epoch, score):
         if iteration % self.print_every == 0:
-            log.info("Score at iteration %d is %s", iteration, score)
+            log.info("Score at iteration %d is %s", iteration, float(score))
 
 
 class CollectScoresListener(TrainingListener):

@@ -40,3 +40,32 @@ def scan_body_hazard(carry, item):
 
 def run_scan(xs):
     return jax.lax.scan(scan_body_hazard, 0.0, xs)
+
+
+@partial(jax.jit, donate_argnums=(0, 1))
+def donated_step(params, opt, x):
+    return params, opt, x * 2
+
+
+def use_after_donate(params, opt, xs):
+    new_p, new_o, y = donated_step(params, opt, xs)
+    z = params + y                      # line 52: RH105 (params donated)
+    return new_p, new_o, z, opt         # line 53: RH105 (opt donated)
+
+
+def donation_rebound_ok(params, opt, xs):
+    for x in xs:
+        # rebinding from the call's results clears the hazard — the
+        # donation-awareness exemption; NOT a finding
+        params, opt, y = donated_step(params, opt, x)
+    return params, opt, y
+
+
+def donation_loop_no_rebind(params, opt, xs):
+    out = []
+    for x in xs:
+        # the canonical bug: iteration 2 passes buffers iteration 1
+        # donated — caught on the loop back-edge pass
+        _, _, y = donated_step(params, opt, x)  # line 69: RH105
+        out.append(y)
+    return out

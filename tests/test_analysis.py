@@ -62,10 +62,16 @@ class TestGoldenFixtures:
             ("RH103", 21),       # f"x was {x}"
             ("RH102", 32),       # if on tracer-DERIVED name
             ("RH101", 38),       # float() inside a lax.scan body
+            ("RH105", 52),       # params read after donation
+            ("RH105", 53),       # opt read after donation
+            ("RH105", 69),       # loop back-edge: re-donation, no rebind
         ]
-        # the negative space: static_argnames params and .ndim/.shape
-        # branches (lines 27/29) must NOT appear
+        # the negative space: static_argnames params, .ndim/.shape
+        # branches (lines 27/29), and donated args REBOUND from the
+        # call's results (donation_rebound_ok, lines 56-61) must NOT
+        # appear
         assert not any(f.line in (27, 29) for f in got)
+        assert not any(56 <= f.line <= 61 for f in got)
 
     def test_lk_lock_discipline(self):
         got = lint_fixture("lk_violations.py")
@@ -281,7 +287,7 @@ class TestTier1Gate:
         sites = load_fault_sites(REPO)
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",
-            "checkpoint.fsync", "data.next_batch",
+            "checkpoint.fsync", "data.next_batch", "data.prefetch",
         }
         assert {"slow", "faults"} <= load_declared_marks(REPO)
 

@@ -1,0 +1,441 @@
+"""Pipelined fit loop (ISSUE 5): PrefetchIterator ordering/identity,
+bounded-depth backpressure, producer-error transparency, the
+`data.prefetch` fault site, the donation-alias safety check, and the
+deferred-sync listener cadence.
+
+Fault-plan tests carry the `faults` marker; everything runs in tier-1.
+"""
+
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import (
+    DataSetIterator,
+    ExistingDataSetIterator,
+)
+from deeplearning4j_tpu.data.prefetch import PrefetchIterator
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn import Sgd
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.runtime.flags import environment
+from deeplearning4j_tpu.train.listeners import TrainingListener
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Never leak an armed fault plan into the next test."""
+    yield
+    faults.disarm()
+
+
+def small_model():
+    conf = (
+        NeuralNetConfiguration.builder()
+        .seed(7)
+        .updater(Sgd(0.1))
+        .list()
+        .layer(Dense(n_out=8, activation=Activation.TANH))
+        .layer(OutputLayer(n_out=3, activation=Activation.SOFTMAX))
+        .set_input_type(InputType.feed_forward(5))
+        .build()
+    )
+    return SequentialModel(conf).init()
+
+
+def batches(n=4, seed=0):
+    rng = np.random.default_rng(seed)
+    return [
+        DataSet(
+            rng.normal(0, 1, (8, 5)).astype(np.float32),
+            np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)],
+        )
+        for _ in range(n)
+    ]
+
+
+class _LazyFeed(DataSetIterator):
+    """Decode-per-next() feed — the lazily-producing iterator shape the
+    fit loops' auto-wrap targets (in-memory lists are exempt)."""
+
+    batch_size = 8
+
+    def __init__(self, n, seed=0):
+        self._n = n
+        self._seed = seed
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        yield from batches(self._n, self._seed)
+
+
+class _RaisingIterator(DataSetIterator):
+    """Yields `good` batches, then raises from the producer side."""
+
+    def __init__(self, good, exc):
+        self._good = good
+        self._exc = exc
+
+    @property
+    def batch_size(self):
+        return self._good[0].num_examples
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        yield from self._good
+        raise self._exc
+
+
+class TestPrefetchIterator:
+    def test_ordering_and_byte_identity(self):
+        src = batches(6)
+        out = list(PrefetchIterator(ExistingDataSetIterator(src), depth=2))
+        assert len(out) == len(src)
+        for staged, ref in zip(out, src):
+            # same order, identical bytes — staging moves, never mutates
+            np.testing.assert_array_equal(
+                np.asarray(staged.features), ref.features
+            )
+            np.testing.assert_array_equal(
+                np.asarray(staged.labels), ref.labels
+            )
+            # staged to device: the consumer sees jax arrays, not host
+            # numpy (the H2D copy happened on the producer thread)
+            import jax
+
+            assert isinstance(staged.features, jax.Array)
+            assert staged._prefetch_stage_s >= 0.0
+
+    def test_bounded_depth_backpressure(self):
+        """The producer never runs more than `depth` batches ahead of
+        the consumer — prefetching must not buffer the whole epoch."""
+        produced = []
+
+        class Tracking(DataSetIterator):
+            batch_size = 8
+
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                for i, b in enumerate(batches(10)):
+                    produced.append(i)
+                    yield b
+
+        depth = 2
+        it = iter(PrefetchIterator(Tracking(), depth=depth, stage=None))
+        first = next(it)
+        assert first is not None
+        deadline = time.time() + 5.0
+        while len(produced) < 1 + depth and time.time() < deadline:
+            time.sleep(0.01)
+        time.sleep(0.2)      # give an unbounded producer rope to hang itself
+        # 1 consumed + `depth` queued + 1 blocked in put() is the ceiling
+        assert len(produced) <= 1 + depth + 1
+        rest = list(it)
+        assert len(rest) == 9 and len(produced) == 10
+
+    def test_producer_exception_surfaces_in_order(self):
+        src = batches(3)
+        feed = PrefetchIterator(
+            _RaisingIterator(src, ValueError("decode exploded")), depth=2
+        )
+        got = []
+        with pytest.raises(ValueError, match="decode exploded"):
+            for b in feed:
+                got.append(b)
+        # every batch staged before the failure was delivered first
+        assert len(got) == 3
+
+    def test_abandoned_iteration_stops_producer_thread(self):
+        feed = PrefetchIterator(
+            ExistingDataSetIterator(batches(50)), depth=2, stage=None
+        )
+        it = iter(feed)
+        next(it)
+        feed.close()                      # the fit loops' finally
+        deadline = time.time() + 5.0
+        while time.time() < deadline and any(
+            t.name == "dl4jtpu-prefetch" and t.is_alive()
+            for t in threading.enumerate()
+        ):
+            time.sleep(0.01)
+        assert not any(
+            t.name == "dl4jtpu-prefetch" and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    def test_fit_results_identical_with_and_without_prefetch(self):
+        """The pipelined fit must train the SAME model: identical params
+        after identical batches, prefetch on vs off."""
+        env = environment()
+        saved = env.prefetch_depth
+        try:
+            env.prefetch_depth = 0
+            m_serial = small_model()
+            m_serial.fit(_LazyFeed(5), epochs=2)
+            env.prefetch_depth = 2
+            m_piped = small_model()
+            m_piped.fit(_LazyFeed(5), epochs=2)
+        finally:
+            env.prefetch_depth = saved
+        import jax
+
+        ref = jax.tree.leaves(m_serial.params)
+        got = jax.tree.leaves(m_piped.params)
+        assert len(ref) == len(got)
+        for a, b in zip(ref, got):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.faults
+class TestPrefetchFaults:
+    def test_fault_plan_raise_at_data_prefetch(self):
+        """An armed raise at data.prefetch kills the feed mid-epoch:
+        steps before the injection trained, the error reaches the
+        training thread, and no producer thread leaks."""
+        faults.arm("data.prefetch:raise:nth=3,exc=runtime")
+        m = small_model()
+        with pytest.raises(faults.InjectedError, match="data.prefetch"):
+            m.fit(_LazyFeed(6), epochs=1)
+        assert m.iteration == 2           # batches 1-2 staged + trained
+        stats = faults.active_plan().stats()
+        assert stats["data.prefetch"]["fires"] == 1
+        time.sleep(0.1)
+        assert not any(
+            t.name == "dl4jtpu-prefetch" and t.is_alive()
+            for t in threading.enumerate()
+        )
+
+    def test_fault_plan_delay_is_absorbed(self):
+        """A delay at the prefetch site slows the producer but must not
+        change training results or drop batches."""
+        faults.arm("data.prefetch:delay:every=2,secs=0.02")
+        m = small_model()
+        m.fit(_LazyFeed(4), epochs=1)
+        assert m.iteration == 4
+
+    def test_site_is_registered(self):
+        assert "data.prefetch" in faults.SITES
+
+    def test_in_memory_feeds_exempt_from_auto_wrap(self):
+        """Lists / ExistingDataSetIterator have no decode cost to hide:
+        the auto-wrap skips them (the data.prefetch site never
+        consults), so sub-millisecond in-memory fits pay zero
+        thread-handoff tax."""
+        faults.arm("data.prefetch:raise:nth=1,exc=runtime")
+        m = small_model()
+        m.fit(batches(3), epochs=1)       # list feed: no prefetch wrap
+        assert m.iteration == 3
+        stats = faults.active_plan().stats()
+        assert stats.get("data.prefetch", {}).get("consults", 0) == 0
+
+
+class TestDonationSafety:
+    def test_listener_stashing_params_trips_the_check(self):
+        class Stasher(TrainingListener):
+            def iteration_done(self, model, iteration, epoch, score):
+                # the use-after-donate bug: the NEXT step donates these
+                # buffers to XLA and this reference reads freed memory
+                self.stash = model.params
+
+        m = small_model()
+        m.set_listeners(Stasher())
+        with pytest.raises(RuntimeError, match="DONATES"):
+            m.fit(batches(3), epochs=1)
+
+    def test_copying_listener_passes(self):
+        class Copier(TrainingListener):
+            def iteration_done(self, model, iteration, epoch, score):
+                self.snapshot = {
+                    k: {p: np.asarray(v) for p, v in d.items()}
+                    for k, d in model.params.items()
+                }
+
+        m = small_model()
+        m.set_listeners(Copier())
+        m.fit(batches(3), epochs=1)
+        assert m.iteration == 3
+
+    def test_health_listener_does_not_trip(self):
+        from deeplearning4j_tpu.observe.health import HealthListener
+
+        m = small_model()
+        m.set_listeners(HealthListener(frequency=1, write_reports=False))
+        m.fit(batches(3), epochs=2)
+        assert m.iteration == 6
+
+
+class TestDeferredSync:
+    def test_grouped_scores_fetch_lazily_and_match(self):
+        """Grouped programs hand listeners LAZY scores: no D2H transfer
+        until a listener reads one, then ONE batched fetch serves the
+        whole group.  Values must match the per-step run exactly."""
+        from deeplearning4j_tpu.models.model import _LazyScores
+
+        fetches = []
+        orig_fetch = _LazyScores.fetch
+
+        def counting_fetch(self):
+            first = self._host is None
+            out = orig_fetch(self)
+            if first:
+                fetches.append(1)
+            return out
+
+        data = batches(4)
+        m_ref = small_model()
+        ref_scores = []
+
+        class Collect(TrainingListener):
+            def __init__(self, sink):
+                self.sink = sink
+
+            def iteration_done(self, model, iteration, epoch, score):
+                self.sink.append(float(score))
+
+        m_ref.set_listeners(Collect(ref_scores))
+        m_ref.fit(data, epochs=1)
+
+        grp_scores = []
+        m_grp = small_model()
+        m_grp.set_listeners(Collect(grp_scores))
+        _LazyScores.fetch = counting_fetch
+        try:
+            m_grp.fit(data, epochs=1, steps_per_execution=4)
+        finally:
+            _LazyScores.fetch = orig_fetch
+        assert fetches == [1]             # one batched transfer for k=4
+        np.testing.assert_allclose(grp_scores, ref_scores, rtol=1e-5)
+
+    def test_lazy_score_is_a_numeric_drop_in(self):
+        """Duck-typed listeners compare/accumulate scores — the lazy
+        view must support the full numeric surface a host float did."""
+        from deeplearning4j_tpu.models.model import _LazyScores
+
+        lazy = _LazyScores(np.array([2.0, 4.0]))
+        s = lazy[1]
+        assert s > 3 and s <= 4.0 and s == 4.0 and bool(s)
+        assert s + 1 == 5.0 and 1 + s == 5.0 and -s == -4.0
+        assert s * 2 == 8.0 and 8 / s == 2.0 and abs(s) == 4.0
+        assert int(s) == 4 and f"{s:.1f}" == "4.0"
+        assert min(s, 10.0) == 4.0
+
+    def test_no_score_reader_never_fetches(self):
+        from deeplearning4j_tpu.models.model import _LazyScores
+
+        fetched = []
+        orig_fetch = _LazyScores.fetch
+
+        def counting_fetch(self):
+            fetched.append(1)
+            return orig_fetch(self)
+
+        class Blind(TrainingListener):
+            def iteration_done(self, model, iteration, epoch, score):
+                self.count = getattr(self, "count", 0) + 1
+
+        m = small_model()
+        m.set_listeners(Blind())
+        _LazyScores.fetch = counting_fetch
+        try:
+            m.fit(batches(4), epochs=1, steps_per_execution=4)
+        finally:
+            _LazyScores.fetch = orig_fetch
+        assert fetched == []              # nobody read a score: zero syncs
+        assert m.listeners[0].count == 4
+        # score_value still works afterwards (fetches on demand)
+        assert np.isfinite(m.score_value)
+
+    def test_score_iteration_listener_cadence(self, caplog):
+        """ScoreIterationListener converts (syncs) only at its cadence."""
+        import logging
+
+        from deeplearning4j_tpu.train.listeners import (
+            ScoreIterationListener,
+        )
+
+        m = small_model()
+        m.set_listeners(ScoreIterationListener(print_every=3))
+        with caplog.at_level(logging.INFO, logger="deeplearning4j_tpu"):
+            m.fit(batches(6), epochs=1)
+        printed = [r for r in caplog.records if "Score at iteration" in
+                   r.getMessage()]
+        assert len(printed) == 2          # iterations 3 and 6
+        # the logged score is a real host float, not a device repr
+        assert all(
+            isinstance(r.args[-1], float) for r in printed
+        )
+
+
+class TestOverlapAccounting:
+    def test_overlap_seconds_lands_on_train_step_spans(self):
+        from deeplearning4j_tpu.observe.trace import tracer
+
+        class Slow(DataSetIterator):
+            batch_size = 8
+
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                for b in batches(5):
+                    time.sleep(0.01)      # decode cost prefetch can hide
+                    yield b
+
+        rec = tracer()
+        rec.enable()
+        rec.clear()
+        try:
+            m = small_model()
+            m.fit(Slow(), epochs=1)
+        finally:
+            rec.disable()
+        steps = [
+            e for e in rec.to_chrome_trace()["traceEvents"]
+            if e["name"] == "train_step"
+        ]
+        assert steps
+        overlaps = [
+            e["args"].get("overlap_seconds", 0.0) for e in steps
+        ]
+        # the first batch cannot overlap (nothing to hide behind), but
+        # later pulls ran while earlier steps computed
+        assert max(overlaps) > 0.0
+
+    def test_cache_replay_wait_not_charged_to_etl(self, tmp_path):
+        """CachedDataSetIterator hit-path pull time lands on the
+        source="cache" series, not the headline ETL-wait total."""
+        from deeplearning4j_tpu.data.cached import CachedDataSetIterator
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        base = ExistingDataSetIterator(batches(3))
+        cached = CachedDataSetIterator(base, str(tmp_path / "cache"))
+        m = small_model()
+        m.fit(cached, epochs=1)           # epoch 1: decode + populate
+        assert cached.is_cached
+        wait = registry().counter("dl4jtpu_etl_wait_seconds_total")
+        plain_before = wait.value()
+        cache_before = wait.value(source="cache")
+        etl_before = m.etl_wait_s
+        m.fit(cached, epochs=1)           # epoch 2: mmap replay
+        assert cached.cache_hits == 3
+        assert wait.value(source="cache") > cache_before
+        # replay pulls did NOT inflate the unlabeled ETL-wait series or
+        # the model's cumulative ETL accounting
+        assert wait.value() == pytest.approx(plain_before)
+        assert m.etl_wait_s == pytest.approx(etl_before)
