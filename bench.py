@@ -47,6 +47,7 @@ useful on CPU; numbers from quick mode are not comparable.
 from __future__ import annotations
 
 import json
+import math
 import os
 import sys
 import time
@@ -1457,6 +1458,159 @@ def _emit_unreachable(probe_evidence, t_start, out_dir=None) -> None:
     print(line)
 
 
+def bench_chaos() -> None:
+    """bench.py --chaos: one fixed fit under a composite seeded fault
+    plan — a simulated hang (device.sync delay), a decode failure
+    (data.decode raise) and a NaN-poisoned batch (data.decode corrupt)
+    — with the full self-healing stack attached (StepWatchdog +
+    RecoveryPolicy over a CheckpointStore).  Records steps-to-recover
+    and the recovered-step fraction into BENCH_CHAOS.json.
+
+    Runs on CPU by default (the subject is recovery control flow, not
+    device throughput); BENCH_CHAOS_PLATFORM overrides."""
+    import tempfile
+
+    import jax
+
+    jax.config.update(
+        "jax_platforms", os.environ.get("BENCH_CHAOS_PLATFORM", "cpu")
+    )
+    import numpy as np
+
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterator import DataSetIterator
+    from deeplearning4j_tpu.models import SequentialModel
+    from deeplearning4j_tpu.nn.conf import (
+        Dense, InputType, NeuralNetConfiguration, OutputLayer,
+    )
+    from deeplearning4j_tpu.observe.metrics import registry
+    from deeplearning4j_tpu.runtime import faults
+    from deeplearning4j_tpu.runtime.flags import environment
+    from deeplearning4j_tpu.train.checkpoint import CheckpointStore
+    from deeplearning4j_tpu.train.listeners import TrainingListener
+    from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+    total_batches = 28
+    save_every = 4
+    plan = ("device.sync:delay:nth=6,secs=0.4;"
+            "data.decode:raise:nth=10,exc=runtime;"
+            "data.decode:corrupt:nth=16")
+
+    tmp = tempfile.mkdtemp(prefix="dl4jtpu-chaos-")
+    os.environ.setdefault("DL4JTPU_CRASH_DIR", os.path.join(tmp, "crash"))
+    env = environment()
+    floor_before = env.watchdog_floor_s
+    env.watchdog_floor_s = 0.06      # the 0.4s injected hang must escalate
+
+    conf = (
+        NeuralNetConfiguration.builder().seed(7).list()
+        .layer(Dense(n_out=32)).layer(OutputLayer(n_out=4))
+        .set_input_type(InputType.feed_forward(16)).build()
+    )
+    model = SequentialModel(conf).init()
+    store = CheckpointStore(os.path.join(tmp, "ckpts"), keep_last=3)
+
+    class _Saver(TrainingListener):
+        def iteration_done(self, model, iteration, epoch, score):
+            if iteration and iteration % save_every == 0:
+                store.save(model, step=iteration)
+
+    model.add_listener(_Saver())
+    policy = RecoveryPolicy(
+        store, skip_window=2, quarantine_dir=os.path.join(tmp, "quarantine"),
+    ).attach(model)
+
+    class _Feed(DataSetIterator):
+        def __init__(self, n, seed=11):
+            self.n, self.seed = n, seed
+
+        def reset(self):
+            pass
+
+        def __iter__(self):
+            rng = np.random.default_rng(self.seed)
+            for _ in range(self.n):
+                x = rng.normal(size=(16, 16)).astype(np.float32)
+                y = np.eye(4, dtype=np.float32)[rng.integers(0, 4, 16)]
+                yield DataSet(x, y)
+
+    reg = registry()
+    # warmup fit BEFORE arming: the watchdog's latency EWMA must decay
+    # past the compile-step spike so the injected 0.4s hang actually
+    # blows the deadline (same reason every bench floors warmup steps)
+    warmup_batches = max(16, WARMUP_STEPS)
+    model.fit(_Feed(warmup_batches, seed=5), epochs=1)
+    warmup_iters = int(model.iteration)
+    t0 = time.time()
+    faults.arm(plan)
+    try:
+        model.fit(_Feed(total_batches), epochs=1)
+    finally:
+        faults.disarm()
+        env.watchdog_floor_s = floor_before
+    wall = time.time() - t0
+    # fresh process: the post-fit totals ARE the chaos run's totals
+    metrics = {
+        name: reg.counter(name).snapshot()
+        for name in (
+            "dl4jtpu_watchdog_stalls_total",
+            "dl4jtpu_quarantined_batches_total",
+            "dl4jtpu_recovery_events_total",
+        )
+    }
+
+    rollback = next(
+        (e for e in policy.events if e["kind"] == "rollback"), None
+    )
+    steps_to_recover = (
+        rollback["from_iteration"] - rollback["restored_iteration"]
+        + rollback["skip_window"] if rollback else None
+    )
+    final_score = float(model.score_value)
+    # finite means NaN AND Inf screened: an Inf score is just as
+    # diverged, and json.dump would write it as the non-standard
+    # `Infinity` literal strict parsers reject
+    score_ok = math.isfinite(final_score)
+    row = {
+        "bench": "chaos",
+        "plan": plan,
+        "total_batches": total_batches,
+        "final_iteration": int(model.iteration),
+        "final_score": final_score if score_ok else None,
+        "completed": score_ok,
+        "rollbacks": policy.rollbacks,
+        "quarantined": policy.quarantined,
+        "lr_scale": policy.lr_scale,
+        "steps_to_recover": steps_to_recover,
+        # unique optimizer steps retained / batches fed — the cost of
+        # chaos in lost work (skips + rollback rewind + quarantines)
+        "recovered_step_fraction": round(
+            (model.iteration - warmup_iters) / total_batches, 3
+        ),
+        "watchdog_events": [
+            (e["stage"], e["stalled_s"])
+            for e in (model._watchdog.events if model._watchdog else [])
+        ],
+        "metrics": metrics,
+        "wall_s": round(wall, 2),
+    }
+    path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                        "BENCH_CHAOS.json")
+    with open(path, "w") as f:
+        json.dump(row, f, indent=1)
+    print(f"[bench] chaos row -> {path}", file=sys.stderr)
+    print(json.dumps({
+        "metric": "chaos fit recovered-step fraction "
+                  "(hang + NaN step + poison batch, seeded plan)",
+        "value": row["recovered_step_fraction"],
+        "unit": "fraction",
+        "extra": {k: row[k] for k in (
+            "completed", "rollbacks", "quarantined", "steps_to_recover",
+            "lr_scale", "wall_s",
+        )},
+    }))
+
+
 def main() -> None:
     global QUICK
     t_start = time.time()
@@ -1614,6 +1768,8 @@ if __name__ == "__main__":
             sys.exit("usage: bench.py --warmup-steps N [--scaling ...]")
         WARMUP_STEPS = int(sys.argv[_i + 1])
         del sys.argv[_i:_i + 2]
+    if "--chaos" in sys.argv:
+        sys.exit(bench_chaos())
     if "--scaling" in sys.argv:
         sys.exit(bench_scaling())
     if "--decode-scaling" in sys.argv:

@@ -82,3 +82,84 @@ class MultiDataSet:
             None if ds.features_mask is None else (ds.features_mask,),
             None if ds.labels_mask is None else (ds.labels_mask,),
         )
+
+    def split_batches(self, batch_size: int) -> list["MultiDataSet"]:
+        out = []
+        n = self.num_examples
+        for i in range(0, n, batch_size):
+            sl = slice(i, min(i + batch_size, n))
+
+            def cut(arrays):
+                if arrays is None:
+                    return None
+                return tuple(None if a is None else a[sl] for a in arrays)
+
+            out.append(
+                MultiDataSet(
+                    cut(self.features),
+                    cut(self.labels),
+                    cut(self.features_masks),
+                    cut(self.labels_masks),
+                )
+            )
+        return out
+
+
+def map_batch(batch, fn, *, masks: bool = True):
+    """A structural copy of a DataSet/MultiDataSet with `fn` applied to
+    every feature/label array — masks too unless ``masks=False`` (they
+    then carry over untouched).  None entries and non-batch objects
+    pass through.  The single batch traversal behind example slicing
+    (recovery's microbatch resume) and poison-fill (the injected
+    corrupt decoder): knowledge of batch structure stays in this
+    module."""
+    def ap(a):
+        return None if a is None else fn(a)
+
+    if isinstance(batch, DataSet):
+        return DataSet(
+            ap(batch.features), ap(batch.labels),
+            ap(batch.features_mask) if masks else batch.features_mask,
+            ap(batch.labels_mask) if masks else batch.labels_mask,
+        )
+    if isinstance(batch, MultiDataSet):
+        def apt(arrays, mask_group=False):
+            if arrays is None:
+                return None
+            if mask_group and not masks:
+                return arrays
+            return tuple(ap(a) for a in arrays)
+
+        return MultiDataSet(
+            apt(batch.features), apt(batch.labels),
+            apt(batch.features_masks, mask_group=True),
+            apt(batch.labels_masks, mask_group=True),
+        )
+    return batch
+
+
+def named_arrays(batch, *, masks: bool = True) -> dict:
+    """Flatten a DataSet/MultiDataSet into a stable name->np.ndarray
+    dict — ``features``/``labels``/``*_mask``, MultiDataSet entries
+    suffixed ``_<i>``; None entries dropped; non-batch objects give {}.
+    The npz/scan view of a batch (quarantine records, non-finite input
+    screening)."""
+    out: dict = {}
+    if isinstance(batch, DataSet):
+        pairs = [("features", batch.features), ("labels", batch.labels)]
+        if masks:
+            pairs += [("features_mask", batch.features_mask),
+                      ("labels_mask", batch.labels_mask)]
+        for name, a in pairs:
+            if a is not None:
+                out[name] = np.asarray(a)
+    elif isinstance(batch, MultiDataSet):
+        groups = [("features", batch.features), ("labels", batch.labels)]
+        if masks:
+            groups += [("features_mask", batch.features_masks or ()),
+                       ("labels_mask", batch.labels_masks or ())]
+        for group, arrays in groups:
+            for i, a in enumerate(arrays):
+                if a is not None:
+                    out[f"{group}_{i}"] = np.asarray(a)
+    return out

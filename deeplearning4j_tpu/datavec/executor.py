@@ -133,6 +133,21 @@ class LocalTransformExecutor:
             # as timed out)
             for t in threads:
                 t.join(timeout=10)
+            # reap killed workers and close their pipes: the pump's
+            # communicate() raised TimeoutExpired before doing either —
+            # an unreaped kill leaves a zombie plus Popen/pipe
+            # ResourceWarnings at GC
+            for p in procs:
+                try:
+                    p.wait(timeout=10)
+                except Exception:
+                    pass
+                for f in (p.stdin, p.stdout, p.stderr):
+                    if f is not None:
+                        try:
+                            f.close()
+                        except Exception:
+                            pass
         out: Records = []
         errors = []
         for p, res in zip(procs, results):

@@ -259,6 +259,7 @@ class GraphModel(Model):
             self.init()
         iterator = self._as_batches(data, batch_size)
         self._donation_checked = False     # re-arm the one-time alias check
+        self._ensure_watchdog()            # step-deadline hang detection
         use_multi = (
             steps_per_execution > 1
             and getattr(self, "_batch_sharding", None) is None
@@ -274,7 +275,7 @@ class GraphModel(Model):
                     self._fit_epoch_multi(feed, steps_per_execution)
                 else:
                     for batch in self._timed_batches(feed):
-                        self.fit_batch(batch)
+                        self._fit_one(batch)
                 for lst in self.listeners:
                     lst.on_epoch_end(self, self.epoch)
                 self.epoch += 1
@@ -306,14 +307,14 @@ class GraphModel(Model):
             buf.append(self._as_mds(batch))
             if len(buf) == spe:
                 if group_ok(buf):
-                    self._run_steps_grouped(buf)
+                    self._fit_group(buf, self._run_steps_grouped)
                 else:
                     for m in buf:
-                        self.fit_batch(m)
+                        self._fit_one(m)
                     self._multi_iter_dev = None
                 buf = []
         for m in buf:
-            self.fit_batch(m)
+            self._fit_one(m)
             self._multi_iter_dev = None
 
     def _get_step_fn_multi(self):

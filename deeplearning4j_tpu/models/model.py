@@ -7,6 +7,7 @@ accounting, listener dispatch, save/load.
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any
 
@@ -14,6 +15,8 @@ import numpy as np
 
 from deeplearning4j_tpu.train.listeners import TrainingListener
 from deeplearning4j_tpu.utils.pytree import param_count, tree_flatten_with_paths
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 class _LazyScores:
@@ -122,6 +125,22 @@ class _LazyScore:
         return abs(float(self))
 
 
+def _poison_batch(batch):
+    """The injected ``data.decode`` 'corrupt' action: a copy of the
+    batch with every FLOAT feature/label array NaN-filled — same
+    shapes/dtypes, the values a broken decoder would emit.  Masks are
+    left alone (a corrupt record keeps its framing)."""
+    from deeplearning4j_tpu.data.dataset import map_batch
+
+    def bad(a):
+        a = np.array(a, copy=True)
+        if np.issubdtype(a.dtype, np.floating):
+            a.fill(np.nan)
+        return a
+
+    return map_batch(batch, bad, masks=False)
+
+
 class Model:
     def __init__(self):
         self.params: Any = None        # pytree {layer_name: {param_name: array}}
@@ -143,6 +162,15 @@ class Model:
         self._overlap_accum: float = 0.0
         # one-time per fit: donated trees must not be aliased by listeners
         self._donation_checked: bool = True
+        # self-healing hooks: a StepWatchdog armed by the step scopes
+        # (created at fit entry when flags.watchdog_enabled), and the
+        # RecoveryPolicy the fit chokepoints route through when attached
+        self._watchdog = None
+        self._recovery = None
+        # device-resident step counters of the grouped/TBPTT programs
+        # (recovery resets them after a rollback rewinds `iteration`)
+        self._multi_iter_dev = None
+        self._tbptt_iter_dev = None
         from deeplearning4j_tpu.runtime import compile_stats as _cs
 
         self._compile_snap = _cs.snapshot()   # baseline at model creation
@@ -170,16 +198,55 @@ class Model:
         )
         rec = tracer()
         it = iter(iterator)
+        absorbed_pull_failure = False
+        no_batch = object()
         while True:
             t0 = time.perf_counter()
+            batch = no_batch
             try:
                 # fault site: every batch pull in every fit loop (armed
                 # plans provoke the flaky-input-pipeline failure mode;
                 # disarmed this is one attribute check)
                 faults.maybe_fail("data.next_batch")
                 batch = next(it)
+                # fault site: the per-batch decode boundary, AFTER the
+                # pull — 'corrupt' poisons the batch (a decoder emitting
+                # garbage), 'raise' is a per-record decode failure.
+                # Sited post-pull so a raise never tears the iterator's
+                # generator frame and the feed can continue.
+                action = faults.maybe_fail("data.decode")
+                if action == "corrupt":
+                    batch = _poison_batch(batch)
+                absorbed_pull_failure = False
             except StopIteration:
+                if absorbed_pull_failure:
+                    # a generator-backed iterator cannot resume after
+                    # raising — the quarantined pull may have ended the
+                    # feed early, and a silently short epoch must not
+                    # read as a clean one
+                    log.warning(
+                        "feed ended immediately after a quarantined pull "
+                        "failure; generator-backed iterators cannot "
+                        "resume, so any remaining batches this epoch "
+                        "were skipped"
+                    )
                 return
+            except Exception as exc:
+                recov = self._recovery
+                # the policy declines non-poison failures (host memory
+                # pressure, programming errors — recovery.NON_POISON_ERRORS)
+                # and they re-raise below; a failure AT the decode
+                # boundary leaves the pulled batch in hand — forward it
+                # so the quarantine record carries replayable bytes,
+                # not just metadata
+                if (recov is not None
+                        and recov.quarantine_pull_failure(
+                            self, exc,
+                            batch=None if batch is no_batch else batch,
+                        )):
+                    absorbed_pull_failure = True
+                    continue      # absorbed (bounded by the quarantine cap)
+                raise
             wait = time.perf_counter() - t0
             batches_total.inc()
             source = getattr(batch, "_etl_source", None)
@@ -208,6 +275,47 @@ class Model:
             else:
                 self.last_overlap_s = 0.0
             yield batch
+
+    def _fit_one(self, batch) -> None:
+        """The single-batch chokepoint every per-batch fit loop routes
+        through: plain fit_batch normally; the attached RecoveryPolicy's
+        envelope (skip-window, input scan, OOM microbatch split,
+        divergence rollback) when one is installed.  The planned
+        StepProgram executor inherits recovery by keeping this the one
+        entry point."""
+        recov = self._recovery
+        if recov is None:
+            self.fit_batch(batch)
+        else:
+            recov.run_step(self, batch)
+
+    def _fit_group(self, batches, runner) -> None:
+        """The grouped-program chokepoint (steps_per_execution /
+        grouped-TBPTT): `runner(batches)` dispatches the k-step program;
+        the RecoveryPolicy wraps it when attached."""
+        recov = self._recovery
+        if recov is None:
+            runner(batches)
+        else:
+            recov.run_group(self, batches, runner)
+
+    def _ensure_watchdog(self):
+        """Create this model's StepWatchdog at fit entry (lazily, once)
+        when flags enable it; the step scopes arm it around every
+        dispatched program.  One shared monitor thread serves every
+        watchdog in the process."""
+        if self._watchdog is None:
+            from deeplearning4j_tpu.runtime.flags import environment
+
+            env = environment()
+            if env.watchdog_enabled:
+                from deeplearning4j_tpu.runtime.watchdog import StepWatchdog
+
+                self._watchdog = StepWatchdog(
+                    floor_s=env.watchdog_floor_s, k=env.watchdog_k,
+                    name=type(self).__name__,
+                )
+        return self._watchdog
 
     def _observe_step(self, n_steps: int = 1):
         """StepScope for the next dispatched step program: observes the

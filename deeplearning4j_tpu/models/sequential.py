@@ -861,6 +861,7 @@ class SequentialModel(Model):
             self.init()
         iterator = _as_iterator(data, batch_size)
         self._donation_checked = False     # re-arm the one-time alias check
+        self._ensure_watchdog()            # step-deadline hang detection
         use_multi = (
             steps_per_execution > 1
             and not getattr(self, "_grad_compression", None)
@@ -880,7 +881,7 @@ class SequentialModel(Model):
                     self._fit_epoch_multi(feed, steps_per_execution)
                 else:
                     for batch in self._timed_batches(feed):
-                        self.fit_batch(batch)
+                        self._fit_one(batch)
                 for lst in self.listeners:
                     lst.on_epoch_end(self, self.epoch)
                 self.epoch += 1
@@ -914,7 +915,7 @@ class SequentialModel(Model):
         def flush(buf):
             if not group_ok(buf):
                 for b in buf:
-                    self.fit_batch(b)
+                    self._fit_one(b)
                 self._multi_iter_dev = None
                 return
             if tbptt:
@@ -926,12 +927,12 @@ class SequentialModel(Model):
                     # _tbptt_scan=False (the scan-miscompile escape hatch)
                     # must keep forcing the per-window path
                     for b in buf:
-                        self.fit_batch(b)
+                        self._fit_one(b)
                     self._multi_iter_dev = None
                     return
-                self._run_steps_grouped_tbptt(buf)
+                self._fit_group(buf, self._run_steps_grouped_tbptt)
             else:
-                self._run_steps_grouped(buf)
+                self._fit_group(buf, self._run_steps_grouped)
 
         self._multi_iter_dev = None
         buf: list[DataSet] = []
@@ -941,7 +942,7 @@ class SequentialModel(Model):
                 flush(buf)
                 buf = []
         for b in buf:                       # ragged tail group
-            self.fit_batch(b)
+            self._fit_one(b)
             self._multi_iter_dev = None
 
     def _get_step_fn_multi(self):

@@ -433,6 +433,19 @@ def _declare_core(reg: MetricsRegistry) -> None:
                 "Faults fired by the armed FaultPlan, by site")
     reg.counter("dl4jtpu_ckpt_verify_failures_total",
                 "Checkpoints that failed manifest/CRC verification")
+    # self-healing (runtime/watchdog.py, train/recovery.py)
+    reg.counter("dl4jtpu_watchdog_stalls_total",
+                "Step-watchdog escalations, by stage (warn, stack_dump, "
+                "abort)")
+    reg.counter("dl4jtpu_recovery_events_total",
+                "RecoveryPolicy actions, by kind (rollback, oom_split, "
+                "oom_restore, batch_skipped, quarantined)")
+    reg.counter("dl4jtpu_quarantined_batches_total",
+                "Poison batches absorbed by the quarantine, by reason "
+                "(decode_error, nonfinite_input)")
+    reg.gauge("dl4jtpu_recovery_lr_scale",
+              "Cumulative LR backoff factor applied by the active "
+              "RecoveryPolicy (1.0 = no rollback yet)")
 
 
 def _compile_stats_collector() -> None:

@@ -216,24 +216,34 @@ class StepScope:
     """
 
     __slots__ = ("_rec", "_hist", "_steps", "_n", "_iteration", "_t0",
-                 "_dispatched", "_overlap")
+                 "_dispatched", "_overlap", "_watchdog")
 
     def __init__(self, iteration: int, n_steps: int = 1,
-                 overlap_s: float = 0.0):
+                 overlap_s: float = 0.0, watchdog=None):
         self._rec = tracer()
         self._hist, self._steps = _step_families()
         self._n = n_steps
         self._iteration = iteration
         self._dispatched = False
         self._overlap = overlap_s
+        self._watchdog = watchdog
 
     def __enter__(self) -> "StepScope":
         self._t0 = time.perf_counter()
+        if self._watchdog is not None:
+            # hang detection: the deadline covers host_stage ->
+            # dispatch -> device_sync -> listeners (everything between
+            # scope enter and exit)
+            self._watchdog.arm(self._iteration, self._n)
         return self
 
     def __exit__(self, *exc):
         dur = time.perf_counter() - self._t0
         failed = bool(exc) and exc[0] is not None
+        if self._watchdog is not None:
+            # failed steps disarm but do not feed the EWMA — an aborted
+            # dispatch's wall time says nothing about healthy latency
+            self._watchdog.disarm(None if failed else dur)
         if not failed or self._dispatched:
             # count a step once its program reached the device (sync()
             # ran): a listener throwing AFTER the update (DivergenceError)
@@ -261,6 +271,12 @@ class StepScope:
         (the untraced path must keep host/device dispatch overlap).
         Reaching sync() marks the program as dispatched: later failures
         (a throwing listener) no longer void the step metrics."""
+        from deeplearning4j_tpu.runtime import faults
+
+        # fault site: the device_sync barrier — an armed 'delay' here is
+        # the simulated wedged step the watchdog escalation is tested
+        # against (disarmed: one global load + None check)
+        faults.maybe_fail("device.sync")
         self._dispatched = True
         if self._rec.enabled and x is not None:
             import jax
@@ -275,4 +291,5 @@ def step_scope(model, n_steps: int = 1) -> StepScope:
     overlap = getattr(model, "_overlap_accum", 0.0)
     if overlap:
         model._overlap_accum = 0.0
-    return StepScope(getattr(model, "iteration", 0), n_steps, overlap)
+    return StepScope(getattr(model, "iteration", 0), n_steps, overlap,
+                     watchdog=getattr(model, "_watchdog", None))

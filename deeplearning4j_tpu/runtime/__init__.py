@@ -26,6 +26,7 @@ from deeplearning4j_tpu.runtime.distributed import DistributedConfig
 from deeplearning4j_tpu.runtime.flags import Environment, environment
 from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh, virtual_cpu_devices
 from deeplearning4j_tpu.runtime.rng import SeedStream
+from deeplearning4j_tpu.runtime.watchdog import EXIT_STEP_WEDGED, StepWatchdog
 
 __all__ = [
     "CoordinatorClient",
@@ -48,4 +49,6 @@ __all__ = [
     "make_mesh",
     "virtual_cpu_devices",
     "SeedStream",
+    "EXIT_STEP_WEDGED",
+    "StepWatchdog",
 ]

@@ -147,6 +147,63 @@ def write_divergence_report(event: dict, path: Optional[str] = None) -> str:
     return write_memory_report(path, header=header)
 
 
+_hang_seq = _itertools.count()
+
+
+def write_hang_report(context: dict, path: Optional[str] = None) -> str:
+    """Thread-stack dump for a wedged step (watchdog stage 2).
+
+    Deliberately does NOT touch jax: the device runtime is exactly what
+    may be hung, and a `memory_stats()` / `live_arrays()` call could
+    block the watchdog thread too.  Pure host introspection: every
+    thread's current stack via `sys._current_frames`, names/daemon
+    flags, plus the watchdog's context (iteration, armed seconds,
+    deadline).  Returns the report path.
+    """
+    import json
+    import sys
+    import threading
+    import traceback
+
+    if path is None:
+        d = os.environ.get(ENV_CRASH_DIR, ".")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(
+            d,
+            f"dl4jtpu-hang-report-{int(time.time() * 1000)}"
+            f"-{next(_hang_seq)}.txt",
+        )
+    by_ident = {t.ident: t for t in threading.enumerate()}
+    lines = [
+        "deeplearning4j_tpu step-watchdog hang report",
+        f"time: {time.strftime('%Y-%m-%d %H:%M:%S')}",
+        "",
+        "WATCHDOG EVENT:",
+    ]
+    lines += [f"  {k}: {v}" for k, v in sorted(context.items())]
+    lines += ["", "event json: " + json.dumps(context, sort_keys=True,
+                                              default=str), ""]
+    frames = sys._current_frames()
+    lines.append(f"threads ({len(frames)}):")
+    for tid, frame in sorted(frames.items()):
+        t = by_ident.get(tid)
+        label = t.name if t is not None else "?"
+        flags = " daemon" if (t is not None and t.daemon) else ""
+        lines.append(f"-- thread {tid} ({label}{flags}):")
+        for entry in traceback.format_stack(frame):
+            lines.extend("  " + ln for ln in entry.rstrip().splitlines())
+    lines.append("")
+    lines.append(
+        "hints: a stack inside a collective means a peer died mid-step "
+        "(elastic respawn recovers); inside device_sync/block_until_ready "
+        "means the device runtime stopped answering (check the PJRT "
+        "transport); inside queue.get means the input pipeline stalled."
+    )
+    with open(path, "w") as f:
+        f.write("\n".join(lines))
+    return path
+
+
 class oom_report_scope:
     """Context manager the models wrap their compiled-step invocation in: a
     device OOM escaping the scope gets the memory report written and a

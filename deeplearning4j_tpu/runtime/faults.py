@@ -19,13 +19,19 @@ Sites wired today:
   ``data.next_batch``    the fit loops' batch pull
   ``data.prefetch``      the PrefetchIterator producer thread, before each
                          base-iterator pull + device staging
+  ``data.decode``        the fit loops' per-batch decode boundary, after
+                         the pull (``corrupt`` ⇒ the site NaN-poisons the
+                         batch — the poison-batch path; ``raise`` ⇒ a
+                         per-record decode failure the quarantine absorbs)
+  ``device.sync``        the fit loops' device_sync barrier (``delay`` ⇒
+                         a simulated wedged step under the watchdog)
 
 Plan grammar (also the ``DL4J_TPU_FAULT_PLAN`` env value, so subprocess
 workers inherit the plan from their spawner's environment)::
 
     plan    := clause (";" clause)*
     clause  := SITE ":" KIND [":" param ("," param)*]
-    KIND    := raise | delay | truncate | kill
+    KIND    := raise | delay | truncate | corrupt | kill
     param   := nth=N     fire exactly once, on the Nth consult (1-based)
              | every=N   fire on every Nth consult
              | p=F       fire with probability F per consult (seeded)
@@ -54,7 +60,7 @@ from typing import Optional
 
 _ENV_VAR = "DL4J_TPU_FAULT_PLAN"
 
-_KINDS = ("raise", "delay", "truncate", "kill")
+_KINDS = ("raise", "delay", "truncate", "corrupt", "kill")
 
 # The site registry: every `maybe_fail("<site>")` call in the package
 # must use a name listed here (machine-checked by tpulint rule RG302 —
@@ -72,6 +78,11 @@ SITES: dict = {
     "data.next_batch": "the fit loops' batch pull",
     "data.prefetch": "the PrefetchIterator producer thread, before each "
                      "base-iterator pull + device staging",
+    "data.decode": "the fit loops' per-batch decode boundary, after the "
+                   "pull ('corrupt' NaN-poisons the batch; 'raise' is a "
+                   "per-record decode failure)",
+    "device.sync": "the fit loops' device_sync barrier ('delay' "
+                   "simulates a wedged step under the watchdog)",
 }
 
 
@@ -269,7 +280,7 @@ class FaultPlan:
             raise _EXC_BY_NAME[fired.exc](
                 f"injected fault at {site} (consult #{n})"
             )
-        return fired.kind                          # cooperative: "truncate"
+        return fired.kind                 # cooperative: "truncate"/"corrupt"
 
 
 def _count_fire(site: str) -> None:

@@ -44,6 +44,13 @@ class Environment:
     # batch is pulled and staged serially on the training thread, the
     # pre-pipelining behavior.
     prefetch_depth: int = 2
+    # Step-deadline watchdog (runtime/watchdog.py): armed around every
+    # dispatched step program; deadline = max(floor, k * EWMA of recent
+    # per-step latency).  Disabled = no watchdog object is created at
+    # fit entry (zero per-step cost).
+    watchdog_enabled: bool = True
+    watchdog_floor_s: float = 30.0
+    watchdog_k: float = 10.0
 
     def set_nan_panic(self, on: bool) -> None:
         self.nan_panic = on
@@ -61,6 +68,11 @@ class Environment:
             prefetch_depth=int(
                 os.environ.get("DL4J_TPU_PREFETCH_DEPTH", "2")
             ),
+            watchdog_enabled=_env_bool("DL4J_TPU_WATCHDOG", True),
+            watchdog_floor_s=float(
+                os.environ.get("DL4J_TPU_WATCHDOG_FLOOR", "30")
+            ),
+            watchdog_k=float(os.environ.get("DL4J_TPU_WATCHDOG_K", "10")),
         )
         if _env_bool("DL4J_TPU_NAN_PANIC"):
             env.set_nan_panic(True)

@@ -317,6 +317,14 @@ class CheckpointStore:
         self._name_re = re.compile(
             re.escape(prefix) + r"(\d+)\.zip$"
         )
+        # steps gc() must never collect: a live RecoveryPolicy pins its
+        # rollback target here for the duration of the fit — otherwise
+        # keep_last rotation could delete the only proven-good state
+        # moments before a divergence needs it
+        self._pins: set[int] = set()
+        # save listeners: callables (step, path) notified after each
+        # publish, BEFORE gc — a RecoveryPolicy advances its pin here
+        self._save_listeners: list = []
 
     # -- naming / scanning -------------------------------------------------
     def path_for(self, step: int) -> str:
@@ -348,17 +356,50 @@ class CheckpointStore:
         step = int(model.iteration if step is None else step)
         os.makedirs(self.directory, exist_ok=True)
         ModelSerializer.write_model(model, self.path_for(step))
+        for cb in list(self._save_listeners):
+            try:
+                cb(step, self.path_for(step))
+            except Exception:
+                log.exception("checkpoint save listener failed")
         self.gc()
         return step
+
+    def add_save_listener(self, cb) -> None:
+        """Register a `(step, path)` callable notified after every
+        publish, before gc runs."""
+        if cb not in self._save_listeners:
+            self._save_listeners.append(cb)
+
+    def remove_save_listener(self, cb) -> None:
+        if cb in self._save_listeners:
+            self._save_listeners.remove(cb)
 
     def wait(self) -> None:
         """PreemptionHandler checkpointer contract — writes are sync."""
 
+    def pin(self, step: int) -> None:
+        """Protect `step`'s checkpoint from gc() until unpinned (the
+        RecoveryPolicy's live rollback target)."""
+        self._pins.add(int(step))
+
+    def unpin(self, step: int) -> None:
+        self._pins.discard(int(step))
+
+    def pinned_steps(self) -> set[int]:
+        return set(self._pins)
+
     def gc(self) -> None:
-        """Delete checkpoints beyond the newest `keep_last` and any
-        ``.tmp`` orphans (a dead writer's torn file — we are the only
-        writer, so any tmp lying around is garbage)."""
-        for _, path in self._scan()[self.keep_last:]:
+        """Delete checkpoints beyond the newest `keep_last` — except
+        pinned steps — and any ``.tmp`` orphans (a dead writer's torn
+        file — we are the only writer, so any tmp lying around is
+        garbage)."""
+        kept = 0
+        for step, path in self._scan():
+            if kept < self.keep_last:
+                kept += 1
+                continue
+            if step in self._pins:
+                continue
             try:
                 os.remove(path)
             except OSError:
@@ -375,18 +416,23 @@ class CheckpointStore:
                     pass
 
     # -- read side ---------------------------------------------------------
-    def latest_valid(self) -> Optional[dict]:
-        """Newest checkpoint that passes verification:
-        ``{"step", "path", "meta"}`` — or None when nothing on disk
-        survives.  Corrupt files are skipped and counted
+    def iter_valid(self):
+        """Yield ``{"step", "path", "meta"}`` for every checkpoint on
+        disk that passes verification, newest step first.  Corrupt
+        files are skipped and counted
         (``dl4jtpu_ckpt_verify_failures_total``), never raised."""
         for step, path in self._scan():
             try:
                 meta = ModelSerializer.verify(path)
             except CheckpointVerifyError:
                 continue
-            return {"step": step, "path": path, "meta": meta}
-        return None
+            yield {"step": step, "path": path, "meta": meta}
+
+    def latest_valid(self) -> Optional[dict]:
+        """Newest checkpoint that passes verification:
+        ``{"step", "path", "meta"}`` — or None when nothing on disk
+        survives."""
+        return next(self.iter_valid(), None)
 
     def restore_latest(self):
         """Restore the newest VALID checkpoint, or None when there is no

@@ -29,6 +29,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from deeplearning4j_tpu.runtime.watchdog import EXIT_STEP_WEDGED
+
 log = logging.getLogger("deeplearning4j_tpu")
 
 EXIT_MEMBERSHIP_CHANGED = 23
@@ -36,6 +38,9 @@ EXIT_MEMBERSHIP_CHANGED = 23
 #: unreachable) — distinct from an eviction: the supervisor does NOT
 #: shrink the world for these, it just respawns the generation
 EXIT_CONTROL_PLANE_LOST = 24
+# EXIT_STEP_WEDGED (25, runtime/watchdog.py, re-exported here): the
+# worker's step watchdog hit its abort stage — a wedged collective or
+# device sync, not a failed worker.  Respawned WITHOUT shrinking.
 
 
 class _HeartbeatThread(threading.Thread):
@@ -232,6 +237,24 @@ class ElasticWorkerLoop:
             model = self._restore_or_build(build_model, reg, world)
             distribute(model, self.parallel_config or ParallelConfig.data_parallel())
 
+            # step-deadline watchdog with the abort stage ENABLED: a
+            # worker wedged in a dead collective exits EXIT_STEP_WEDGED
+            # instead of pinning the generation until the outer timeout;
+            # the supervisor respawns without shrinking
+            from deeplearning4j_tpu.runtime.flags import environment
+            from deeplearning4j_tpu.runtime.watchdog import (
+                StepWatchdog, exit_step_wedged,
+            )
+
+            env_flags = environment()
+            if env_flags.watchdog_enabled and model._watchdog is None:
+                model._watchdog = StepWatchdog(
+                    floor_s=env_flags.watchdog_floor_s,
+                    k=env_flags.watchdog_k,
+                    abort=exit_step_wedged,
+                    name="elastic-worker",
+                )
+
             start = model.iteration
             for step in range(start, total_steps):
                 model.fit_batch(batch_fn(step, rank, world))
@@ -301,6 +324,9 @@ class ElasticSupervisor:
         initial_world: int,
         min_world: int = 1,
         max_generations: int = 5,
+        crash_loop_window: float = 5.0,
+        backoff_base: float = 0.5,
+        backoff_cap: float = 30.0,
     ):
         self.spawn_worker = spawn_worker
         self.server = server
@@ -313,7 +339,20 @@ class ElasticSupervisor:
         # tracked separately from evictions because they do NOT shrink
         # the world: the worker was healthy, the control plane wasn't
         self.control_plane_losses = 0
+        # workers whose step watchdog aborted a wedged step
+        # (EXIT_STEP_WEDGED) — also respawned without shrinking
+        self.step_wedged_respawns = 0
         self.last_exit_codes: list[int] = []
+        # crash-loop damping: a generation dying within
+        # `crash_loop_window` seconds of spawn (a deterministic early
+        # crash — bad checkpoint, import error) respawns after a capped
+        # exponential backoff instead of hot-looping the supervisor
+        self.crash_loop_window = crash_loop_window
+        self.backoff_base = backoff_base
+        self.backoff_cap = backoff_cap
+        self.respawn_backoffs: list[float] = []
+        self._fast_failures = 0
+        self._sleep = time.sleep           # injectable for tests
 
     def run(self, timeout: float = 300.0) -> None:
         world = self.initial_world
@@ -333,6 +372,7 @@ class ElasticSupervisor:
                 # waiting for a process that will never come up)
                 self.server.members = {}
                 self.server.pending = {}
+            gen_t0 = time.time()
             procs = [self.spawn_worker(i, world, generation) for i in range(world)]
             rcs = []
             try:
@@ -352,6 +392,27 @@ class ElasticSupervisor:
             self.last_exit_codes = rcs
             if all(rc == 0 for rc in rcs):
                 return
+            # crash-loop storm damping: a generation that died almost
+            # immediately is deterministically broken (bad ckpt, import
+            # error, poisoned env) — immediate respawn just hot-loops.
+            # Backoff doubles per consecutive fast failure, capped, and
+            # resets the moment a generation survives the window.
+            if time.time() - gen_t0 < self.crash_loop_window:
+                self._fast_failures += 1
+                delay = min(
+                    self.backoff_cap,
+                    self.backoff_base * (2 ** (self._fast_failures - 1)),
+                )
+                self.respawn_backoffs.append(delay)
+                log.warning(
+                    "generation %d died %.1fs after spawn (%d consecutive "
+                    "fast failures) — backing off %.1fs before respawn",
+                    generation, time.time() - gen_t0, self._fast_failures,
+                    delay,
+                )
+                self._sleep(delay)
+            else:
+                self._fast_failures = 0
             lost = sum(1 for rc in rcs if rc == EXIT_CONTROL_PLANE_LOST)
             if lost:
                 self.control_plane_losses += lost
@@ -359,6 +420,14 @@ class ElasticSupervisor:
                     "generation %d: %d worker(s) lost the control plane "
                     "(retry-exhausted, NOT evicted) — respawning same world",
                     generation, lost,
+                )
+            wedged = sum(1 for rc in rcs if rc == EXIT_STEP_WEDGED)
+            if wedged:
+                self.step_wedged_respawns += wedged
+                log.warning(
+                    "generation %d: %d worker(s) aborted a wedged step "
+                    "(watchdog) — respawning same world",
+                    generation, wedged,
                 )
 
             def _evicted():
@@ -369,18 +438,43 @@ class ElasticSupervisor:
                     ]
 
             # a worker killed outright (no fail() call) is only discovered
-            # by heartbeat timeout — give the ledger time to settle.  When
-            # every failure was a control-plane loss there is nobody to
-            # evict, so don't wall-clock the timeout for nothing.
+            # by heartbeat timeout — give the ledger time to settle.
+            # `expect` is how many evictions the dead workers should
+            # post: every hard failure (wedged included — its exit also
+            # silences its heartbeat).  Control-plane losses (healthy
+            # worker, lost contact) and membership-change aborts (which
+            # call leave() on the way out, so no eviction is EVER
+            # posted for them) are excluded — counting either would
+            # wall-clock the settle wait for evictions that cannot
+            # arrive.  Waiting for the EXPECTED count, not just the
+            # first eviction, keeps a wedged worker's collateral
+            # eviction from masking a genuinely dead host whose timeout
+            # lands a beat later.
+            expect = sum(
+                1 for rc in rcs
+                if rc not in (0, EXIT_CONTROL_PLANE_LOST,
+                              EXIT_MEMBERSHIP_CHANGED)
+            )
             evicted = _evicted()
-            if lost != sum(1 for rc in rcs if rc != 0):
+            if expect > wedged:
                 settle_deadline = (
                     time.time() + self.server.heartbeat_timeout + 2
                 )
-                while not evicted and time.time() < settle_deadline:
+                while len(evicted) < expect and time.time() < settle_deadline:
                     time.sleep(0.25)
                     evicted = _evicted()
-            # shrink by actual failures; collateral aborts respawn as-is
-            world -= len(evicted)
+            # shrink by the number of genuinely dead workers,
+            # `expect - wedged` (a wedged STEP is hung hardware, not a
+            # dead host — it respawns as-is), confirmed by however many
+            # evictions actually posted: the ledger is the proof the
+            # failures were real, not an attribution of WHICH worker
+            # each eviction belongs to — if the settle wait expired
+            # with only the dead host's eviction in (or only the wedged
+            # worker's), the dead-worker count is the same.  The
+            # len(evicted) floor keeps a zero-confirmation timeout
+            # conservative, and the `expect - wedged` cap keeps a
+            # straggler eviction of a control-plane-lost worker from
+            # over-shrinking.
+            world -= max(0, min(len(evicted), expect - wedged))
         raise RuntimeError(f"elastic training did not converge in "
                            f"{self.max_generations} generations")

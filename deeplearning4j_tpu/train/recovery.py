@@ -1,0 +1,625 @@
+"""Closed-loop training recovery — detection was PRs 2–3, this is the
+healing.
+
+`RecoveryPolicy` sits at the fit loops' single-step chokepoint
+(`Model._fit_one` / `Model._fit_group`) and turns three run-killing
+failures into bounded, observable recoveries:
+
+- **divergence → rollback + LR backoff + skip-window.**  The attached
+  `HealthListener` (raise_on_divergence=True) raises `DivergenceError`
+  the monitored step a NaN/Inf score, non-finite params or a norm
+  explosion appears; the policy restores the newest VALID checkpoint
+  from its `CheckpointStore` (whose rollback target it keeps *pinned*
+  so keep_last rotation can't eat it), multiplies the effective
+  learning rate by ``lr_backoff`` (a state-preserving facade over the
+  model's optax transformation — the checkpointed opt_state stays
+  loadable), and skips the next ``skip_window`` batches (the data
+  region that blew the run up is usually local).
+
+- **device OOM → microbatch split retry.**  An OOM escaping a step is
+  caught, the batch is split along the example axis and the pieces are
+  stepped individually; the split factor doubles per retry up to
+  ``max_split`` and then *sticks* for the rest of the fit, so every
+  later batch pre-splits instead of re-paying the OOM.  Sub-batch
+  sizes are ceil(B/2^i) — the same quantize-don't-enumerate idea as
+  `flags.bucket_length` — so the retry path adds at most
+  O(log2(max_split)) compiled programs, not one per ragged remainder.
+  Donated buffers invalidated by the failed execution are detected
+  (`jax.Array.is_deleted`) and restored from the checkpoint store
+  before the retry.
+
+- **poison batch → quarantine.**  Decode failures raised at the batch
+  pull and (``scan_inputs=True``) batches with non-finite
+  features/labels are diverted to a bounded on-disk
+  `data.quarantine.QuarantineStore` and counted
+  (``dl4jtpu_quarantined_batches_total``) instead of killing the run;
+  past the cap the policy fails loudly — a fully poisoned feed is not
+  something to paper over.
+
+Scope: single-process models.  Multi-host/sharded fits keep their
+elastic-respawn recovery path (train/elastic.py) — a host-local
+rollback would silently fork the replicas' state.
+"""
+
+from __future__ import annotations
+
+import logging
+import math
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.observe.health import DivergenceError, HealthListener
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: pull/decode failures that are never poison batches: host memory
+#: pressure (absorbing it would quarantine our way through an OOMing
+#: process) and programming errors in iterator/decoder code (a
+#: TypeError in __iter__ is a bug to fix and must fail the run, not be
+#: silently skipped up to the quarantine cap — corrupt DATA raises
+#: ValueError/OSError/RuntimeError flavors)
+NON_POISON_ERRORS = (MemoryError, TypeError, AttributeError, NameError)
+
+
+def _is_oom(exc: BaseException) -> bool:
+    from deeplearning4j_tpu.runtime.crash import is_oom_error
+
+    seen = 0
+    while exc is not None and seen < 8:
+        if is_oom_error(exc):
+            return True
+        exc = exc.__cause__ or exc.__context__
+        seen += 1
+    return False
+
+
+def _num_examples(batch) -> int:
+    try:
+        return int(batch.num_examples)
+    except Exception:
+        return 0
+
+
+def _chunk_batch(batch, chunk: int) -> Optional[list]:
+    """Split a DataSet/MultiDataSet into example-axis chunks of size
+    `chunk` (last chunk ragged).  None when the type is unsplittable."""
+    from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+
+    if isinstance(batch, (DataSet, MultiDataSet)):
+        return batch.split_batches(chunk)
+    return None
+
+
+def _slice_examples(batch, start: int):
+    """The example-axis tail `batch[start:]` of a DataSet/MultiDataSet
+    (masks included) — the not-yet-stepped remainder of a partially
+    fitted split."""
+    from deeplearning4j_tpu.data.dataset import map_batch
+
+    return map_batch(batch, lambda a: a[start:])
+
+
+def _batch_nonfinite(batch) -> bool:
+    """True when any float feature/label array carries NaN/Inf."""
+    from deeplearning4j_tpu.data.dataset import named_arrays
+
+    for a in named_arrays(batch, masks=False).values():
+        if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+            return True
+    return False
+
+
+def _checkpoint_params_nonfinite(path: str) -> bool:
+    """True when the checkpoint's params.npz carries NaN/Inf — read
+    straight from the zip, no model build.  Integrity verification
+    cannot catch this: a save cadence aligned with the divergence
+    iteration checkpoints already-NaN params with perfectly good CRCs,
+    and such a file must never become a rollback target or hold the
+    rollback pin."""
+    import io
+    import zipfile
+
+    with zipfile.ZipFile(path, "r") as zf:
+        npz = np.load(io.BytesIO(zf.read("params.npz")), allow_pickle=False)
+        for name in npz.files:
+            a = npz[name]
+            if (np.issubdtype(a.dtype, np.floating)
+                    and not np.isfinite(a).all()):
+                return True
+    return False
+
+
+class _LrScaledTx:
+    """optax-GradientTransformation facade scaling the inner tx's
+    UPDATES by a constant factor while leaving the state structure
+    identical to the inner's — the checkpointed opt_state keeps
+    restoring.  The factor bakes into the traced step program;
+    `RecoveryPolicy` clears the model's step-fn cache after swapping a
+    new one in (a rollback is rare enough to pay one retrace)."""
+
+    def __init__(self, inner, factor: float):
+        self.inner = inner
+        self.factor = float(factor)
+
+    def init(self, params):
+        return self.inner.init(params)
+
+    def update(self, grads, state, params=None):
+        import jax
+
+        updates, state = self.inner.update(grads, state, params)
+        f = self.factor
+        return jax.tree.map(lambda u: u * f, updates), state
+
+
+class RecoveryPolicy:
+    """Wires divergence/OOM/poison-batch recovery into a model's fit
+    loops.  One policy serves one model:
+
+        store = CheckpointStore(ckpt_dir)
+        policy = RecoveryPolicy(store, quarantine_dir=qdir)
+        policy.attach(model)
+        model.fit(data, ...)        # now self-healing
+
+    store: rollback source; None disables rollback (divergence then
+      re-raises) and OOM buffer-restore.
+    lr_backoff: multiplier applied to the effective LR per rollback.
+    max_rollbacks: per-policy budget; past it the DivergenceError
+      propagates (a run that keeps diverging at floor LR is dead).
+    skip_window: batches skipped after each rollback.
+    max_split: OOM microbatch split cap (power of two recommended).
+    quarantine_dir / quarantine_cap: poison-batch quarantine; dir None
+      keeps metadata-only accounting (nothing written to disk).
+    scan_inputs: pre-dispatch non-finite scan of every batch (one host
+      pass over the bytes — measurable on fat batches; off by default,
+      the HealthListener catches what slips through one step later).
+    """
+
+    def __init__(self, store=None, *, lr_backoff: float = 0.5,
+                 max_rollbacks: int = 3, skip_window: int = 2,
+                 max_split: int = 8, quarantine_dir: Optional[str] = None,
+                 quarantine_cap: int = 16, scan_inputs: bool = False,
+                 health_frequency: int = 1):
+        if not 0.0 < lr_backoff <= 1.0:
+            raise ValueError("lr_backoff must be in (0, 1]")
+        if max_split < 2:
+            raise ValueError("max_split must be >= 2")
+        self.store = store
+        self.lr_backoff = float(lr_backoff)
+        self.max_rollbacks = int(max_rollbacks)
+        self.skip_window = int(skip_window)
+        self.max_split = int(max_split)
+        self.quarantine_cap = int(quarantine_cap)
+        self.scan_inputs = bool(scan_inputs)
+        self.health_frequency = int(health_frequency)
+        self.quarantine = None
+        self.rollbacks = 0
+        self.quarantined = 0
+        if quarantine_dir is not None:
+            from deeplearning4j_tpu.data.quarantine import QuarantineStore
+
+            self.quarantine = QuarantineStore(quarantine_dir,
+                                              cap=quarantine_cap)
+            # a restarted run inherits the directory's spent budget —
+            # the store already refuses writes past its cap, and
+            # silently "absorbing" byteless poison batches on top of a
+            # full quarantine would paper over a poisoned feed
+            self.quarantined = len(self.quarantine)
+        self.lr_scale = 1.0
+        self.split_factor = 1
+        # a grouped program that OOM'd once will OOM again (same program,
+        # same shapes) — after the first, groups route per-batch for the
+        # rest of the fit even when the individual batches fit unsplit
+        # (split_factor stays 1); without this a deterministic grouped
+        # OOM re-fires every flush, and with donated buffers every
+        # re-fire costs a checkpoint restore that rewinds the model
+        self._grouped_oom = False
+        self.events: list[dict] = []
+        self.health: Optional[HealthListener] = None
+        self._skip_remaining = 0
+        self._base_tx = None
+        self._pinned: Optional[int] = None
+
+    # -- lifecycle ---------------------------------------------------------
+    def attach(self, model) -> "RecoveryPolicy":
+        """Install on `model`: route its fit chokepoints through this
+        policy, ensure a raising HealthListener watches every step, and
+        pin the current rollback target in the store."""
+        if getattr(model, "_batch_sharding", None) is not None:
+            raise ValueError(
+                "RecoveryPolicy is single-process only; distributed "
+                "models recover via ElasticWorkerLoop respawn"
+            )
+        model._recovery = self
+        self._base_tx = model._tx
+        hl = next(
+            (l for l in model.listeners if isinstance(l, HealthListener)),
+            None,
+        )
+        if hl is None:
+            hl = HealthListener(
+                frequency=self.health_frequency, raise_on_divergence=True
+            )
+            model.add_listener(hl)
+        else:
+            hl.raise_on_divergence = True
+        self.health = hl
+        if self.store is not None:
+            for entry in self.store.iter_valid():
+                if self._pin_poisoned(entry["step"], entry["path"]):
+                    continue
+                self._repin(entry["step"])
+                break
+            # follow saves: the pin must ADVANCE as training checkpoints,
+            # or keep_last rotation could still eat the only proven-good
+            # state (saves verify before the pin moves — a torn write
+            # leaves the pin on the older good step)
+            self.store.add_save_listener(self._on_save)
+        return self
+
+    def detach(self, model) -> None:
+        if getattr(model, "_recovery", None) is self:
+            model._recovery = None
+        if self.store is not None:
+            self.store.remove_save_listener(self._on_save)
+            if self._pinned is not None:
+                self.store.unpin(self._pinned)
+                self._pinned = None
+
+    def _on_save(self, step: int, path: str) -> None:
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+        try:
+            ModelSerializer.verify(path)
+        except Exception as e:
+            log.warning(
+                "freshly saved checkpoint %s failed verification (%s); "
+                "rollback pin stays at step %s", path, e, self._pinned,
+            )
+            return
+        # integrity is not enough: pinning an intact-but-NaN save (and
+        # advancing past finite steps) would let keep_last rotation eat
+        # the very checkpoints a later rollback needs
+        if self._pin_poisoned(step, path):
+            return
+        self._repin(step)
+
+    def _pin_poisoned(self, step: int, path: str) -> bool:
+        """True when `path` must not hold the rollback pin (non-finite
+        params, or unreadable during the check)."""
+        try:
+            nonfinite = _checkpoint_params_nonfinite(path)
+        except Exception as e:
+            log.warning("could not screen checkpoint step %d for "
+                        "finiteness (%s); not pinning it", step, e)
+            return True
+        if nonfinite:
+            self._event("poisoned_checkpoint_skipped", step=step)
+            log.warning(
+                "checkpoint step %d is intact but holds non-finite "
+                "params (saved mid-divergence?); rollback pin stays "
+                "at step %s", step, self._pinned,
+            )
+            return True
+        return False
+
+    def _repin(self, step: int) -> None:
+        if self.store is None or step == self._pinned:
+            return
+        if self._pinned is not None:
+            self.store.unpin(self._pinned)
+        self.store.pin(step)
+        self._pinned = step
+
+    # -- the chokepoints (Model._fit_one / Model._fit_group) ---------------
+    def run_step(self, model, batch) -> None:
+        """One pulled batch through the full recovery envelope."""
+        if self._skip_remaining > 0:
+            self._skip_remaining -= 1
+            self._event("batch_skipped", skipped_remaining=self._skip_remaining)
+            return
+        if self.scan_inputs and _batch_nonfinite(batch):
+            if not self._absorb(model, "nonfinite_input", batch=batch):
+                raise RuntimeError(
+                    f"quarantine budget exhausted "
+                    f"({self.quarantined}/{self.quarantine_cap}) and the "
+                    "feed keeps producing non-finite batches"
+                )
+            return
+        try:
+            self._fit_split(model, batch)
+        except DivergenceError as exc:
+            self._rollback(model, exc)
+
+    def run_group(self, model, batches, runner) -> None:
+        """A grouped program (steps_per_execution / grouped-TBPTT)
+        through the envelope.  Skip-windows, sticky splits and input
+        scans force per-batch stepping — the grouped program is atomic
+        and cannot skip or split a member."""
+        if (self._skip_remaining > 0 or self.split_factor > 1
+                or self.scan_inputs or self._grouped_oom):
+            for b in batches:
+                self.run_step(model, b)
+            model._multi_iter_dev = None
+            return
+        try:
+            runner(batches)
+        except DivergenceError as exc:
+            self._rollback(model, exc)
+        except Exception as exc:
+            if not _is_oom(exc):
+                raise
+            # the whole group OOM'd in one program: retry its batches
+            # individually (each may further microbatch-split)
+            log.warning(
+                "grouped step program OOM'd; retrying %d batches "
+                "individually (grouped dispatch stays off for the rest "
+                "of the fit)", len(batches),
+            )
+            self._grouped_oom = True
+            self._cold_watchdog(model)   # per-batch program: retrace
+            model._multi_iter_dev = None
+            if self._buffers_deleted(model) and not self._restore_arrays(model):
+                raise
+            for b in batches:
+                self.run_step(model, b)
+            model._multi_iter_dev = None
+
+    # -- poison batches ----------------------------------------------------
+    def quarantine_pull_failure(self, model, exc: BaseException,
+                                batch=None) -> bool:
+        """Called by `_timed_batches` when the batch pull/decode raised:
+        True = absorbed (the feed continues), False = budget spent (the
+        caller re-raises).  `batch` is the pulled data when the failure
+        hit the post-pull decode boundary — the quarantine record then
+        carries replayable bytes; None when the pull itself raised and
+        there is nothing in hand to preserve."""
+        if isinstance(exc, NON_POISON_ERRORS):
+            return False
+        return self._absorb(model, "decode_error", batch=batch, error=exc)
+
+    def _absorb(self, model, reason: str, batch=None,
+                error: Optional[BaseException] = None) -> bool:
+        if self.quarantined >= self.quarantine_cap:
+            return False
+        self.quarantined += 1
+        path = None
+        if self.quarantine is not None:
+            try:
+                path = self.quarantine.put(reason, batch=batch, error=error)
+            except Exception:
+                log.exception("quarantine write failed (batch dropped)")
+        self._count_quarantined(reason)
+        self._event("quarantined", reason=reason, path=path,
+                    error=None if error is None else repr(error))
+        log.warning(
+            "poison batch quarantined (%s, %d/%d absorbed)%s",
+            reason, self.quarantined, self.quarantine_cap,
+            f" -> {path}" if path else "",
+        )
+        return True
+
+    # -- divergence --------------------------------------------------------
+    def _rollback(self, model, exc: DivergenceError) -> None:
+        from deeplearning4j_tpu.observe.trace import tracer
+
+        self.rollbacks += 1
+        if self.rollbacks > self.max_rollbacks:
+            log.error(
+                "divergence after %d rollbacks (budget %d) — giving up",
+                self.rollbacks - 1, self.max_rollbacks,
+            )
+            raise exc
+        if self.store is None:
+            raise exc
+        from_iteration = int(model.iteration)
+        with tracer().span("recovery_rollback", cat="recovery"):
+            entry = self._restore_finite(model)
+        if entry is None:
+            log.error(
+                "divergence with no finite valid checkpoint to roll back to"
+            )
+            raise exc
+        self._repin(entry["step"])
+        self.lr_scale *= self.lr_backoff
+        model._tx = _LrScaledTx(self._base_tx, self.lr_scale)
+        model._step_fns.clear()     # the baked-in LR scale changed
+        self._cold_watchdog(model)  # the next step pays that retrace
+        self._skip_remaining = self.skip_window
+        # the health listener's identity/Δw caches point at pre-rollback
+        # params; a stale identity hit would skip the first post-rollback
+        # reduction
+        if self.health is not None:
+            self.health._last_seen_params = None
+            self.health._prev_params = None
+        self._gauge_lr()
+        self._event(
+            "rollback",
+            divergence_kind=exc.event.get("kind"),
+            from_iteration=from_iteration,
+            restored_step=entry["step"],
+            restored_iteration=int(model.iteration),
+            lr_scale=self.lr_scale,
+            skip_window=self.skip_window,
+        )
+        log.warning(
+            "ROLLBACK: %s at iteration %d -> restored step %d, lr_scale "
+            "%.4g, skipping next %d batches",
+            exc.event.get("kind"), from_iteration, entry["step"],
+            self.lr_scale, self.skip_window,
+        )
+
+    @staticmethod
+    def _cold_watchdog(model) -> None:
+        """The next step will retrace (step-fn cache invalidated, or a
+        new microbatch shape entered the program set); drop the
+        watchdog's latency EWMA so that step gets the cold-compile
+        floor — otherwise the EWMA-scaled deadline, calibrated on warm
+        steps, fires a spurious stall (or worse, a spurious abort) on
+        the recompile."""
+        wd = getattr(model, "_watchdog", None)
+        if wd is not None:
+            wd.ewma = None
+
+    @staticmethod
+    def _install(model, restored) -> None:
+        """Copy a restored model's state into the live model (structure
+        is identical — both were built from the same conf)."""
+        model.params = restored.params
+        model.net_state = restored.net_state
+        if restored.opt_state is not None and model.opt_state is not None:
+            model.opt_state = restored.opt_state
+        model.iteration = restored.iteration
+        model._last_score = None
+        # device-resident grouped/TBPTT step counters are stale now
+        model._multi_iter_dev = None
+        model._tbptt_iter_dev = None
+
+    # -- device OOM --------------------------------------------------------
+    @staticmethod
+    def _buffers_deleted(model) -> bool:
+        """A failed execution of a donate_argnums program may have
+        consumed the live param/opt/net-state buffers."""
+        import jax
+
+        for leaf in jax.tree.leaves(
+            (model.params, model.opt_state, model.net_state)
+        ):
+            deleted = getattr(leaf, "is_deleted", None)
+            if deleted is not None and deleted():
+                return True
+        return False
+
+    def _restore_arrays(self, model) -> bool:
+        """Re-materialize model state from the newest valid checkpoint
+        (no LR change — this is buffer repair, not divergence)."""
+        if self.store is None:
+            return False
+        entry = self._restore_finite(model)
+        if entry is None:
+            return False
+        self._repin(entry["step"])
+        self._event("oom_restore", restored_step=entry["step"])
+        return True
+
+    def _restore_finite(self, model):
+        """Restore the newest checkpoint that is both intact AND holds
+        all-finite params into `model`; returns the store entry, or
+        None when nothing on disk qualifies.  verify() is
+        integrity-only — rolling back to an intact-but-NaN file would
+        re-diverge on the next monitored step and burn the whole
+        rollback budget on the same poisoned checkpoint while older
+        finite ones sit in the store."""
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+        for entry in self.store.iter_valid():
+            try:
+                nonfinite = _checkpoint_params_nonfinite(entry["path"])
+            except Exception as e:
+                log.warning("could not screen checkpoint step %d for "
+                            "finiteness (%s); skipping it as a restore "
+                            "target", entry["step"], e)
+                continue
+            if nonfinite:
+                self._event("poisoned_checkpoint_skipped",
+                            step=entry["step"])
+                log.warning(
+                    "checkpoint step %d is intact but holds non-finite "
+                    "params (saved mid-divergence?); skipping it as a "
+                    "restore target", entry["step"],
+                )
+                continue
+            self._install(model, ModelSerializer.restore(entry["path"],
+                                                         verify=False))
+            return entry
+        return None
+
+    def _fit_split(self, model, batch) -> None:
+        """Fit `batch` under the current sticky split factor, escalating
+        the factor on OOM — WITHOUT ever refitting examples that already
+        stepped (a partially fitted split resumes from its first
+        unfitted example; refitting the leading pieces would double-
+        apply their optimizer updates)."""
+        n = _num_examples(batch)
+        factor = max(1, self.split_factor)
+        start = 0                    # examples [0, start) already stepped
+        while True:
+            rest = batch if start == 0 else _slice_examples(batch, start)
+            chunk = n if factor <= 1 else math.ceil(n / factor)
+            pieces = (
+                _chunk_batch(rest, chunk)
+                if 0 < chunk < _num_examples(rest) else None
+            ) or [rest]
+            try:
+                for p in pieces:
+                    model.fit_batch(p)
+                    start += _num_examples(p)
+                break
+            except DivergenceError:
+                raise                          # run_step rolls back
+            except Exception as exc:
+                if not _is_oom(exc):
+                    raise
+                nxt = max(2, factor * 2)
+                if nxt > self.max_split or chunk <= 1 or n < 2:
+                    log.error(
+                        "OOM not recoverable by splitting (factor cap %d, "
+                        "batch %d examples, %d already stepped)",
+                        self.max_split, n, start,
+                    )
+                    raise
+                if self._buffers_deleted(model):
+                    if not self._restore_arrays(model):
+                        log.error(
+                            "OOM consumed donated buffers and no "
+                            "checkpoint can restore them — cannot retry"
+                        )
+                        raise
+                    # the restore rewound the checkpointed state, which
+                    # discards the leading pieces' applied updates too —
+                    # refit from example 0 (exactly-once RELATIVE TO the
+                    # restored params, not the pre-OOM ones)
+                    start = 0
+                factor = nxt
+                self._cold_watchdog(model)   # new piece shape: retrace
+        if factor > 1 and factor > self.split_factor:
+            self.split_factor = factor    # sticky: later batches pre-split
+            self._event("oom_split", split_factor=factor,
+                        microbatch=math.ceil(n / factor) if n else None)
+            log.warning(
+                "OOM recovered: batch of %d split %dx (microbatch %d); "
+                "split sticks for the rest of the fit", n, factor,
+                math.ceil(n / factor) if n else -1,
+            )
+
+    # -- accounting --------------------------------------------------------
+    def _event(self, kind: str, **fields) -> None:
+        ev = {"kind": kind, **fields}
+        self.events.append(ev)
+        if len(self.events) > 256:
+            del self.events[:-256]
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().counter("dl4jtpu_recovery_events_total").inc(kind=kind)
+        except Exception as e:
+            log.debug("recovery event metric failed: %s", e)
+
+    def _count_quarantined(self, reason: str) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().counter(
+                "dl4jtpu_quarantined_batches_total"
+            ).inc(reason=reason)
+        except Exception as e:
+            log.debug("quarantine metric failed: %s", e)
+
+    def _gauge_lr(self) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().gauge("dl4jtpu_recovery_lr_scale").set(self.lr_scale)
+        except Exception as e:
+            log.debug("lr-scale gauge failed: %s", e)

@@ -288,6 +288,7 @@ class TestTier1Gate:
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",
             "checkpoint.fsync", "data.next_batch", "data.prefetch",
+            "data.decode", "device.sync",
         }
         assert {"slow", "faults"} <= load_declared_marks(REPO)
 

@@ -1,0 +1,873 @@
+"""Self-healing training (ISSUE 6): the step watchdog notices wedged
+steps and escalates warn -> stack dump -> abort; `RecoveryPolicy` rolls
+a diverged model back to the pinned last-good checkpoint with LR
+backoff and a skip-window, splits OOM'd batches into microbatches, and
+quarantines poison batches instead of dying.  Everything is provoked
+deterministically through `runtime.faults` (new sites ``device.sync``
+and ``data.decode``) or injected fakes; no sleep exceeds 0.5s.
+"""
+
+import glob
+import os
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.runtime.watchdog import (
+    EXIT_STEP_WEDGED,
+    STAGES,
+    StepWatchdog,
+)
+
+pytestmark = pytest.mark.faults
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    """Never leak an armed plan into the next test."""
+    yield
+    faults.disarm()
+
+
+def _model(seed=3, n_in=4, n_out=2):
+    from deeplearning4j_tpu.models import SequentialModel
+    from deeplearning4j_tpu.nn.conf import (
+        Dense, InputType, NeuralNetConfiguration, OutputLayer,
+    )
+
+    conf = (
+        NeuralNetConfiguration.builder().seed(seed).list()
+        .layer(Dense(n_out=8)).layer(OutputLayer(n_out=n_out))
+        .set_input_type(InputType.feed_forward(n_in)).build()
+    )
+    return SequentialModel(conf).init()
+
+
+def _feed(n=10, batch=8, n_in=4, n_out=2, seed=0):
+    from deeplearning4j_tpu.data.dataset import DataSet
+    from deeplearning4j_tpu.data.iterator import DataSetIterator
+
+    class Feed(DataSetIterator):
+        def reset(self):
+            pass
+
+        def __iter__(self):
+            rng = np.random.default_rng(seed)
+            for _ in range(n):
+                x = rng.normal(size=(batch, n_in)).astype(np.float32)
+                y = np.eye(n_out, dtype=np.float32)[
+                    rng.integers(0, n_out, batch)
+                ]
+                yield DataSet(x, y)
+
+    return Feed()
+
+
+def _saver(store, every=4):
+    from deeplearning4j_tpu.train.listeners import TrainingListener
+
+    class Saver(TrainingListener):
+        def iteration_done(self, model, iteration, epoch, score):
+            if iteration and iteration % every == 0:
+                store.save(model, step=iteration)
+
+    return Saver()
+
+
+def _counter(name, **labels):
+    from deeplearning4j_tpu.observe.metrics import registry
+
+    return registry().counter(name).value(**labels)
+
+
+def _read(path):
+    with open(path) as f:
+        return f.read()
+
+
+# -- StepWatchdog unit (fake clock, no monitor thread) ----------------------
+
+class TestStepWatchdogUnit:
+    def _wd(self, **kw):
+        self.now = [0.0]
+        kw.setdefault("clock", lambda: self.now[0])
+        kw.setdefault("threaded", False)
+        return StepWatchdog(**kw)
+
+    def test_deadline_is_cold_floor_without_ewma_then_k_times_ewma(self):
+        wd = self._wd(floor_s=1.0, cold_floor_s=100.0, k=10.0)
+        assert wd.deadline_s() == 100.0
+        wd.arm(0)
+        self.now[0] = 2.0
+        wd.disarm(2.0)                      # first sample: ewma = 2.0
+        assert wd.ewma == 2.0
+        assert wd.deadline_s() == 20.0      # k * ewma > floor
+        wd.arm(1)
+        self.now[0] = 2.1
+        wd.disarm(0.0)                      # decays toward 0
+        assert wd.deadline_s() == max(1.0, 10.0 * wd.ewma)
+
+    def test_failed_steps_do_not_feed_the_ewma(self):
+        wd = self._wd()
+        wd.arm(0)
+        wd.disarm(None)
+        assert wd.ewma is None
+
+    def test_escalation_ladder_warn_dump_abort(self, tmp_path, monkeypatch):
+        monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path))
+        aborts = []
+        wd = self._wd(floor_s=1.0, cold_floor_s=1.0, k=10.0,
+                      dump_after=2.0, abort_after=3.0, abort=aborts.append)
+        wd.arm(7, n_steps=1)
+        wd.poll()
+        assert wd.events == []              # nothing due yet
+        self.now[0] = 1.01
+        wd.poll()
+        assert [e["stage"] for e in wd.events] == ["warn"]
+        self.now[0] = 2.01
+        wd.poll()
+        assert [e["stage"] for e in wd.events] == ["warn", "stack_dump"]
+        assert wd.report_paths and os.path.exists(wd.report_paths[0])
+        text = _read(wd.report_paths[0])
+        assert "threads (" in text and "iteration: 7" in text
+        self.now[0] = 3.01
+        wd.poll()
+        assert [e["stage"] for e in wd.events] == list(STAGES)
+        assert aborts and aborts[0]["iteration"] == 7
+
+    def test_escalated_steps_do_not_feed_the_ewma(self):
+        wd = self._wd(floor_s=1.0, cold_floor_s=1.0)
+        wd.arm(0)
+        self.now[0] = 1.01
+        wd.poll()                       # warn fired: the step stalled
+        assert [e["stage"] for e in wd.events] == ["warn"]
+        self.now[0] = 1.2
+        wd.disarm(1.2)                  # completed AFTER escalating
+        # a stall folded into the EWMA would inflate every later
+        # deadline by ~k x the stall, masking the next genuine wedge
+        assert wd.ewma is None
+
+    def test_disarm_cancels_pending_escalation(self):
+        aborts = []
+        wd = self._wd(floor_s=1.0, cold_floor_s=1.0, abort=aborts.append)
+        wd.arm(0)
+        wd.disarm(0.5)
+        self.now[0] = 100.0
+        wd.poll()
+        assert wd.events == [] and not aborts
+
+    def test_raising_abort_does_not_kill_the_shared_monitor(
+        self, tmp_path, monkeypatch
+    ):
+        import sys
+
+        monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path))
+
+        def bad_abort(event):
+            sys.exit(25)    # SystemExit off the main thread
+
+        wd = StepWatchdog(floor_s=0.02, cold_floor_s=0.02,
+                          dump_after=1.5, abort_after=2.0, abort=bad_abort)
+        wd.arm(0)
+        deadline = time.monotonic() + 5.0
+        while (not wd.events or wd.events[-1]["stage"] != "abort"):
+            assert time.monotonic() < deadline, wd.events
+            time.sleep(0.01)
+        wd.disarm(None)
+        # the monitor must survive the raising action and keep serving
+        # every watchdog in the process
+        assert wd._mon.is_alive()
+        wd2 = StepWatchdog(floor_s=0.02, cold_floor_s=0.02)
+        assert wd2._mon is wd._mon
+        wd2.arm(1)
+        deadline = time.monotonic() + 5.0
+        while not wd2.events:
+            assert time.monotonic() < deadline
+            time.sleep(0.01)
+        wd2.disarm(None)
+
+    def test_grouped_programs_scale_the_deadline_by_n_steps(self):
+        wd = self._wd(floor_s=0.1, cold_floor_s=0.1, k=10.0)
+        wd.arm(0)
+        self.now[0] = 0.4
+        wd.disarm(0.4)                      # ewma 0.4/step
+        wd.arm(1, n_steps=8)                # deadline 10 * 0.4 * 8 = 32
+        self.now[0] = 20.0
+        wd.poll()
+        assert wd.events == []
+        wd.disarm(None)
+
+
+# -- hang injection through the real fit loop -------------------------------
+
+class TestWatchdogHangInjection:
+    def test_injected_device_sync_hang_fires_within_deadline(
+        self, tmp_path, monkeypatch
+    ):
+        """device.sync delay 0.4s vs a 0.05s deadline: the watchdog
+        (real monitor thread) must warn AND write the thread-stack dump
+        while the step is still wedged."""
+        monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path))
+        m = _model()
+        m._watchdog = StepWatchdog(floor_s=0.05, cold_floor_s=0.05, k=10.0)
+        warns_before = _counter("dl4jtpu_watchdog_stalls_total", stage="warn")
+        faults.arm("device.sync:delay:nth=2,secs=0.45")
+        m.fit(_feed(4), epochs=1)
+        faults.disarm()
+        wd = m._watchdog
+        stages = [e["stage"] for e in wd.events]
+        assert "warn" in stages and "stack_dump" in stages
+        # fired within the wedged window, not after the step returned
+        assert all(e["stalled_s"] < 0.45 for e in wd.events)
+        reports = glob.glob(str(tmp_path / "dl4jtpu-hang-report-*"))
+        assert reports and wd.report_paths
+        report_text = _read(reports[0])
+        assert "device_sync" in report_text or "maybe_fail" in report_text
+        assert _counter(
+            "dl4jtpu_watchdog_stalls_total", stage="warn"
+        ) >= warns_before + 1
+        # training completed despite the stall (no abort configured)
+        assert m.iteration == 4
+
+    def test_fit_with_empty_plan_leaves_watchdog_silent(self):
+        m = _model()
+        m.fit(_feed(6), epochs=1)
+        assert m._watchdog is not None      # created by default flags
+        assert m._watchdog.events == []
+        assert m._watchdog.ewma is not None  # fed by every step
+
+
+# -- quarantine store --------------------------------------------------------
+
+class TestQuarantineStore:
+    def test_roundtrip_bytes_and_metadata(self, tmp_path):
+        from deeplearning4j_tpu.data.dataset import DataSet
+        from deeplearning4j_tpu.data.quarantine import QuarantineStore
+
+        q = QuarantineStore(str(tmp_path), cap=4)
+        ds = DataSet(np.full((2, 3), np.nan, np.float32),
+                     np.ones((2, 2), np.float32))
+        path = q.put("nonfinite_input", batch=ds)
+        assert path and os.path.exists(path)
+        [rec] = q.entries()
+        assert rec["reason"] == "nonfinite_input" and rec["has_bytes"]
+        loaded = np.load(path.replace(".json", ".npz"))
+        assert np.isnan(loaded["features"]).all()
+        assert loaded["labels"].shape == (2, 2)
+
+    def test_cap_bounds_disk_and_survives_restart(self, tmp_path):
+        from deeplearning4j_tpu.data.quarantine import QuarantineStore
+
+        q = QuarantineStore(str(tmp_path), cap=2)
+        assert q.put("decode_error", error=ValueError("x"))
+        assert q.put("decode_error", error=ValueError("y"))
+        assert q.put("decode_error") is None       # full
+        # a fresh store over the same dir inherits the spent budget
+        q2 = QuarantineStore(str(tmp_path), cap=2)
+        assert q2.full and q2.put("decode_error") is None
+        assert len(q2.entries()) == 2
+
+
+# -- checkpoint pinning ------------------------------------------------------
+
+class TestCheckpointPinning:
+    def test_gc_never_collects_the_pinned_rollback_target(self, tmp_path):
+        from deeplearning4j_tpu.train.checkpoint import CheckpointStore
+
+        store = CheckpointStore(str(tmp_path), keep_last=2)
+        m = _model()
+        for step in (1, 2, 3, 4, 5):
+            store.save(m, step=step)
+        assert store.all_steps() == [4, 5]          # plain rotation
+        store.pin(4)
+        for step in (6, 7, 8):
+            store.save(m, step=step)
+        assert store.all_steps() == [4, 7, 8]       # pinned survives
+        store.unpin(4)
+        store.gc()
+        assert store.all_steps() == [7, 8]
+
+    def test_policy_pins_its_rollback_target_through_rotation(self, tmp_path):
+        from deeplearning4j_tpu.train.checkpoint import CheckpointStore
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        store = CheckpointStore(str(tmp_path / "ck"), keep_last=1)
+        m = _model()
+        store.save(m, step=2)
+        policy = RecoveryPolicy(store).attach(m)
+        assert store.pinned_steps() == {2}
+        # verified saves ADVANCE the pin: last-good tracks the newest
+        # checkpoint that PROVES intact, not the attach-time snapshot
+        store.save(m, step=3)
+        assert store.pinned_steps() == {3}
+        # torn saves do NOT advance it — and the pinned good file
+        # survives keep_last=1 rotation while corrupt ones rotate through
+        from deeplearning4j_tpu.runtime import faults
+
+        faults.arm("checkpoint.write:truncate:every=1")
+        try:
+            for step in (4, 5):
+                store.save(m, step=step)
+        finally:
+            faults.disarm()
+        assert store.pinned_steps() == {3}
+        assert 3 in store.all_steps()               # survives keep_last=1
+        entry = store.latest_valid()
+        assert entry is not None and entry["step"] == 3
+        policy.detach(m)
+        store.gc()
+        assert 3 not in store.all_steps()           # unpinned -> collected
+
+
+# -- divergence -> rollback + LR backoff + skip window -----------------------
+
+class TestRollback:
+    def _healing_model(self, tmp_path, **policy_kw):
+        from deeplearning4j_tpu.train.checkpoint import CheckpointStore
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        store = CheckpointStore(str(tmp_path / "ck"), keep_last=3)
+        m.add_listener(_saver(store, every=4))
+        policy = RecoveryPolicy(
+            store, quarantine_dir=str(tmp_path / "q"), **policy_kw
+        ).attach(m)
+        return m, store, policy
+
+    def test_nan_step_rolls_back_with_lr_backoff_and_finishes_finite(
+        self, tmp_path, monkeypatch
+    ):
+        from deeplearning4j_tpu.train.recovery import _LrScaledTx
+
+        monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path))
+        m, store, policy = self._healing_model(tmp_path, skip_window=2)
+        rb_before = _counter("dl4jtpu_recovery_events_total", kind="rollback")
+        faults.arm("data.decode:corrupt:nth=10")    # NaN step mid-fit
+        m.fit(_feed(16), epochs=1)
+        faults.disarm()
+        assert policy.rollbacks == 1
+        assert policy.lr_scale == 0.5
+        assert isinstance(m._tx, _LrScaledTx)
+        rollback = next(e for e in policy.events if e["kind"] == "rollback")
+        assert rollback["restored_step"] <= rollback["from_iteration"]
+        skipped = [e for e in policy.events if e["kind"] == "batch_skipped"]
+        assert len(skipped) == 2
+        assert np.isfinite(m.score_value)           # healed and trained on
+        assert np.isfinite(
+            np.asarray(list(m.param_table().values())[0])
+        ).all()
+        assert _counter(
+            "dl4jtpu_recovery_events_total", kind="rollback"
+        ) == rb_before + 1
+
+    def test_rollback_budget_exhausts_into_divergence_error(
+        self, tmp_path, monkeypatch
+    ):
+        from deeplearning4j_tpu.observe.health import DivergenceError
+
+        monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path))
+        m, store, policy = self._healing_model(
+            tmp_path, max_rollbacks=1, skip_window=0
+        )
+        # two poisoned batches AFTER the first checkpoint (saved at
+        # iteration 4): rollback #1 spends the budget, #2 is fatal
+        faults.arm("data.decode:corrupt:nth=6;data.decode:corrupt:nth=8")
+        with pytest.raises(DivergenceError):
+            m.fit(_feed(16), epochs=1)
+        faults.disarm()
+        assert policy.rollbacks == 2                # budget 1 + the fatal one
+
+    def test_rollback_skips_a_checkpoint_saved_with_nan_params(
+        self, tmp_path, monkeypatch
+    ):
+        import jax
+
+        monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path))
+        m, store, policy = self._healing_model(tmp_path)
+        m.fit(_feed(10), epochs=1)          # finite saves at steps 4, 8
+        # a save cadence aligned with the divergence iteration can
+        # checkpoint already-NaN params (the saver fires before the
+        # HealthListener raises): fake one as the NEWEST entry — it is
+        # intact, so CRC verification alone would hand it right back
+        good = m.params
+        m.params = jax.tree.map(
+            lambda a: np.full_like(np.asarray(a), np.nan), m.params
+        )
+        store.save(m, step=12)
+        m.params = good
+        # the pin must NOT advance to the NaN save — otherwise keep_last
+        # rotation could eat the finite steps the rollback will need
+        assert policy._pinned == 8
+        faults.arm("data.decode:corrupt:nth=2")
+        m.fit(_feed(8, seed=1), epochs=1)
+        faults.disarm()
+        assert policy.rollbacks == 1
+        rollback = next(e for e in policy.events if e["kind"] == "rollback")
+        assert rollback["restored_step"] == 8       # NaN step-12 file skipped
+        assert any(
+            e["kind"] == "poisoned_checkpoint_skipped" and e["step"] == 12
+            for e in policy.events
+        )
+        assert np.isfinite(m.score_value)
+        assert np.isfinite(
+            np.asarray(list(m.param_table().values())[0])
+        ).all()
+
+    def test_divergence_without_checkpoint_propagates(self, tmp_path,
+                                                      monkeypatch):
+        from deeplearning4j_tpu.observe.health import DivergenceError
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path))
+        m = _model()
+        RecoveryPolicy(None).attach(m)              # no rollback source
+        faults.arm("data.decode:corrupt:nth=3")
+        with pytest.raises(DivergenceError):
+            m.fit(_feed(6), epochs=1)
+        faults.disarm()
+
+
+# -- device OOM -> microbatch split ------------------------------------------
+
+class TestOomMicrobatchSplit:
+    def _oomify(self, m, threshold):
+        real = m.fit_batch
+        sizes = []
+
+        def oomy(batch):
+            sizes.append(batch.num_examples)
+            if batch.num_examples > threshold:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory allocating "
+                    "1234 bytes"
+                )
+            real(batch)
+
+        m.fit_batch = oomy
+        return sizes
+
+    def test_split_doubles_until_it_fits_then_sticks(self):
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        policy = RecoveryPolicy(None, max_split=8).attach(m)
+        sizes = self._oomify(m, threshold=8)
+        m.fit(_feed(4, batch=32), epochs=1)
+        # first batch: 32 OOMs, 16 OOMs, 8 fits; later batches pre-split
+        assert sizes[:3] == [32, 16, 8]
+        assert policy.split_factor == 4
+        assert m.iteration == 16                    # 4 batches x 4 pieces
+        assert set(sizes[2:]) == {8}                # bounded program set
+        assert [e["kind"] for e in policy.events] == ["oom_split"]
+        assert np.isfinite(m.score_value)
+
+    def test_partial_split_resumes_without_refitting(self):
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        policy = RecoveryPolicy(None, max_split=8).attach(m)
+        policy.split_factor = 2
+        real = m.fit_batch
+        calls = []
+
+        def oomy(batch):
+            calls.append(batch.num_examples)
+            if batch.num_examples == 16 and calls.count(16) == 2:
+                raise RuntimeError(
+                    "RESOURCE_EXHAUSTED: Out of memory allocating"
+                )
+            real(batch)
+
+        m.fit_batch = oomy
+        m.fit(_feed(1, batch=32), epochs=1)
+        # piece 0 (16 examples) stepped once; the OOMing remainder was
+        # re-split to 8s WITHOUT refitting the already-stepped leading
+        # examples (a refit would double-apply their updates)
+        assert calls == [16, 16, 8, 8]
+        assert m.iteration == 3
+        assert policy.split_factor == 4
+
+    def test_oom_past_the_split_cap_reraises(self):
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        RecoveryPolicy(None, max_split=4).attach(m)
+        self._oomify(m, threshold=1)                # nothing ever fits
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            m.fit(_feed(2, batch=16), epochs=1)
+
+    def test_grouped_oom_disables_grouped_dispatch_for_the_fit(self):
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        policy = RecoveryPolicy(None).attach(m)
+        batches = list(_feed(4))
+        runner_calls = []
+
+        def oom_runner(bs):
+            runner_calls.append(len(bs))
+            raise RuntimeError(
+                "RESOURCE_EXHAUSTED: Out of memory allocating 1234 bytes"
+            )
+
+        policy.run_group(m, batches[:2], oom_runner)
+        assert runner_calls == [2]
+        assert m.iteration == 2                 # retried individually
+        # a deterministically-OOMing grouped program must not re-fire
+        # on every flush: later groups route per-batch without ever
+        # trying the runner again (split_factor may still be 1 — the
+        # INDIVIDUAL batches fit fine)
+        policy.run_group(m, batches[2:], oom_runner)
+        assert runner_calls == [2]
+        assert m.iteration == 4
+        assert policy.split_factor == 1
+
+    def test_non_oom_errors_pass_straight_through(self):
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        RecoveryPolicy(None).attach(m)
+
+        def broken(batch):
+            raise ValueError("not an OOM")
+
+        m.fit_batch = broken
+        with pytest.raises(ValueError, match="not an OOM"):
+            m.fit(_feed(2), epochs=1)
+
+
+# -- poison batches -> quarantine --------------------------------------------
+
+class TestPoisonBatchQuarantine:
+    def test_corrupt_batch_is_screened_quarantined_and_fit_completes(
+        self, tmp_path
+    ):
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        policy = RecoveryPolicy(
+            None, quarantine_dir=str(tmp_path / "q"), scan_inputs=True
+        ).attach(m)
+        q_before = _counter(
+            "dl4jtpu_quarantined_batches_total", reason="nonfinite_input"
+        )
+        faults.arm("data.decode:corrupt:nth=3")
+        m.fit(_feed(8), epochs=1)
+        faults.disarm()
+        assert policy.quarantined == 1
+        assert m.iteration == 7                     # poisoned batch dropped
+        [rec] = policy.quarantine.entries()
+        assert rec["reason"] == "nonfinite_input" and rec["has_bytes"]
+        assert np.isnan(
+            np.load(rec["path"].replace(".json", ".npz"))["features"]
+        ).all()
+        assert _counter(
+            "dl4jtpu_quarantined_batches_total", reason="nonfinite_input"
+        ) == q_before + 1
+        assert np.isfinite(m.score_value)
+
+    def test_decode_failure_is_quarantined_with_the_pulled_bytes(
+        self, tmp_path
+    ):
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        policy = RecoveryPolicy(
+            None, quarantine_dir=str(tmp_path / "q")
+        ).attach(m)
+        faults.arm("data.decode:raise:nth=2,exc=runtime")
+        m.fit(_feed(6), epochs=1)
+        faults.disarm()
+        assert policy.quarantined == 1 and m.iteration == 5
+        [rec] = policy.quarantine.entries()
+        assert rec["reason"] == "decode_error" and "InjectedError" in rec["error"]
+        # the pull succeeded before the decode boundary raised — the
+        # record must carry the batch for offline replay
+        assert rec["has_bytes"]
+        npz = np.load(rec["path"].replace(".json", ".npz"))
+        assert npz["features"].shape == (8, 4)
+
+    def test_pull_failure_is_quarantined_without_bytes(self, tmp_path):
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        policy = RecoveryPolicy(
+            None, quarantine_dir=str(tmp_path / "q")
+        ).attach(m)
+        # the pull ITSELF raises: nothing was fetched, metadata only
+        # (and the un-pulled batch isn't lost — all 6 still train)
+        faults.arm("data.next_batch:raise:nth=2,exc=runtime")
+        m.fit(_feed(6), epochs=1)
+        faults.disarm()
+        assert policy.quarantined == 1 and m.iteration == 6
+        [rec] = policy.quarantine.entries()
+        assert rec["reason"] == "decode_error" and not rec["has_bytes"]
+        assert "InjectedError" in rec["error"]
+
+    def test_quarantine_budget_exhaustion_fails_loudly(self, tmp_path):
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        RecoveryPolicy(
+            None, quarantine_dir=str(tmp_path / "q"), quarantine_cap=2
+        ).attach(m)
+        faults.arm("data.decode:raise:every=1,exc=runtime")
+        with pytest.raises(faults.InjectedError):
+            m.fit(_feed(8), epochs=1)
+        faults.disarm()
+
+    def test_restarted_run_inherits_spent_quarantine_budget(self, tmp_path):
+        from deeplearning4j_tpu.data.quarantine import QuarantineStore
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        qdir = str(tmp_path / "q")
+        prior = QuarantineStore(qdir, cap=2)
+        prior.put("decode_error")
+        prior.put("decode_error")
+        # a fresh policy over the same directory starts with the budget
+        # already spent — it must fail loudly, not silently drop batches
+        policy = RecoveryPolicy(None, quarantine_dir=qdir, quarantine_cap=2)
+        assert policy.quarantined == 2
+        assert not policy.quarantine_pull_failure(object(), RuntimeError("x"))
+
+    def test_programming_errors_in_the_feed_are_not_quarantined(
+        self, tmp_path
+    ):
+        from deeplearning4j_tpu.data.iterator import DataSetIterator
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        m = _model()
+        policy = RecoveryPolicy(
+            None, quarantine_dir=str(tmp_path / "q")
+        ).attach(m)
+
+        class Broken(DataSetIterator):
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                yield from _feed(2)
+                raise TypeError("a bug in iterator code, not corrupt data")
+
+        # a TypeError is a programming error to surface immediately,
+        # not a poison record to skip up to the quarantine cap
+        with pytest.raises(TypeError, match="a bug"):
+            m.fit(Broken(), epochs=1)
+        assert policy.quarantined == 0
+
+    def test_without_policy_decode_failures_still_raise(self):
+        m = _model()
+        faults.arm("data.decode:raise:nth=2,exc=runtime")
+        with pytest.raises(faults.InjectedError):
+            m.fit(_feed(4), epochs=1)
+        faults.disarm()
+
+
+# -- supervisor hardening ----------------------------------------------------
+
+class _FakeProc:
+    def __init__(self, rc, delay=0.0):
+        self._rc = rc
+        self._delay = delay
+
+    def wait(self, timeout=None):
+        if self._delay:
+            time.sleep(self._delay)
+        return self._rc
+
+    def poll(self):
+        return self._rc
+
+
+class _FakeServer:
+    def __init__(self):
+        self._lock = threading.Condition()
+        self.expected = 0
+        self.members = {}
+        self.pending = {}
+        self.evictions = []
+        self.generation = 1
+        self.heartbeat_timeout = 30.0
+
+
+class TestSupervisorHardening:
+    def test_crash_loop_gets_capped_exponential_backoff(self):
+        from deeplearning4j_tpu.train.elastic import (
+            EXIT_CONTROL_PLANE_LOST,
+            ElasticSupervisor,
+        )
+
+        # control-plane-lost exits: no eviction-settle wall-clocking, so
+        # the test isolates the backoff logic itself
+        rcs = [[EXIT_CONTROL_PLANE_LOST]] * 4 + [[0]]
+
+        def spawn(i, world, gen):
+            return _FakeProc(rcs[gen - 1][i])
+
+        sup = ElasticSupervisor(
+            spawn, _FakeServer(), initial_world=1, min_world=1,
+            max_generations=6, backoff_base=0.5, backoff_cap=2.0,
+        )
+        sleeps = []
+        sup._sleep = sleeps.append
+        sup.run(timeout=60)
+        assert sleeps == [0.5, 1.0, 2.0, 2.0]       # doubled, then capped
+
+    def test_slow_generation_resets_the_backoff_streak(self):
+        from deeplearning4j_tpu.train.elastic import (
+            EXIT_CONTROL_PLANE_LOST,
+            ElasticSupervisor,
+        )
+
+        # fast crash, then a "long" generation (past the window), then ok
+        procs = [[_FakeProc(EXIT_CONTROL_PLANE_LOST)],
+                 [_FakeProc(EXIT_CONTROL_PLANE_LOST, delay=0.3)],
+                 [_FakeProc(0)]]
+
+        def spawn(i, world, gen):
+            return procs[gen - 1][i]
+
+        sup = ElasticSupervisor(
+            spawn, _FakeServer(), initial_world=1, min_world=1,
+            max_generations=4, crash_loop_window=0.2, backoff_base=0.5,
+        )
+        sleeps = []
+        sup._sleep = sleeps.append
+        sup.run(timeout=60)
+        assert sleeps == [0.5]                      # only the fast crash
+
+    def test_wedged_workers_respawn_without_shrinking(self):
+        from deeplearning4j_tpu.train.elastic import ElasticSupervisor
+
+        rcs = [[EXIT_STEP_WEDGED, EXIT_STEP_WEDGED], [0, 0]]
+        worlds = []
+
+        def spawn(i, world, gen):
+            if i == 0:
+                worlds.append(world)
+            return _FakeProc(rcs[gen - 1][i])
+
+        sup = ElasticSupervisor(
+            spawn, _FakeServer(), initial_world=2, min_world=2,
+            max_generations=3,
+        )
+        sup._sleep = lambda s: None
+        t0 = time.perf_counter()
+        sup.run(timeout=60)
+        # no eviction-settle wall-clocking for pure watchdog aborts
+        assert time.perf_counter() - t0 < 5.0
+        assert worlds == [2, 2]
+        assert sup.step_wedged_respawns == 2
+
+    def test_dead_host_shrinks_even_when_an_eviction_is_late(self):
+        from deeplearning4j_tpu.train.elastic import ElasticSupervisor
+
+        # generation 1: worker0's watchdog aborted (respawn, no shrink),
+        # worker1 hard-died — but only ONE (unattributed) eviction lands
+        # before the settle wait expires.  The dead-worker count is
+        # expect - wedged = 1 regardless of WHOSE eviction arrived, so
+        # the world must still shrink by one.
+        server = _FakeServer()
+        server.heartbeat_timeout = 0.1          # short settle wait
+        server.evictions.append(
+            {"generation": 1, "worker": "w1", "reason": "heartbeat",
+             "time": 0.0}
+        )
+        rcs = {1: [EXIT_STEP_WEDGED, 9], 2: [0]}
+        worlds = []
+
+        def spawn(i, world, gen):
+            if i == 0:
+                worlds.append(world)
+            return _FakeProc(rcs[gen][i])
+
+        sup = ElasticSupervisor(
+            spawn, server, initial_world=2, min_world=1, max_generations=3,
+        )
+        sup._sleep = lambda s: None
+        sup.run(timeout=60)
+        assert worlds == [2, 1]
+        assert sup.step_wedged_respawns == 1
+
+
+# -- the chaos acceptance run ------------------------------------------------
+
+class TestChaosEndToEnd:
+    def test_hang_nan_and_poison_batch_in_one_fit(self, tmp_path,
+                                                  monkeypatch):
+        """ISSUE 6 acceptance: one seeded plan injects a device_sync
+        hang, a decode failure and a NaN-poisoned batch into a single
+        fit; training completes with a finite score and the watchdog /
+        rollback / quarantine events all land on /metrics."""
+        from deeplearning4j_tpu.observe.metrics import registry
+        from deeplearning4j_tpu.train.checkpoint import CheckpointStore
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path / "crash"))
+        m = _model()
+        store = CheckpointStore(str(tmp_path / "ck"), keep_last=3)
+        m.add_listener(_saver(store, every=3))
+        policy = RecoveryPolicy(
+            store, skip_window=1, quarantine_dir=str(tmp_path / "q")
+        ).attach(m)
+        m._watchdog = StepWatchdog(floor_s=0.05, cold_floor_s=0.05, k=10.0)
+        before = {
+            "warn": _counter("dl4jtpu_watchdog_stalls_total", stage="warn"),
+            "rollback": _counter("dl4jtpu_recovery_events_total",
+                                 kind="rollback"),
+            "quarantine": _counter("dl4jtpu_quarantined_batches_total",
+                                   reason="decode_error"),
+        }
+        faults.arm(
+            "device.sync:delay:nth=4,secs=0.4;"
+            "data.decode:raise:nth=7,exc=runtime;"
+            "data.decode:corrupt:nth=11"
+        )
+        m.fit(_feed(16), epochs=1)
+        faults.disarm()
+        # hang: watchdog fired and dumped stacks while the step wedged
+        assert "warn" in [e["stage"] for e in m._watchdog.events]
+        # NaN step: rolled back with LR backoff
+        assert policy.rollbacks == 1 and policy.lr_scale == 0.5
+        # poison batch: quarantined, not fatal
+        assert policy.quarantined == 1
+        # the run completed and is numerically healthy
+        assert np.isfinite(m.score_value)
+        # and every event is visible on the scrape path
+        text = registry().to_prometheus_text()
+        assert 'dl4jtpu_watchdog_stalls_total{stage="warn"}' in text
+        assert 'dl4jtpu_recovery_events_total{kind="rollback"}' in text
+        assert 'dl4jtpu_quarantined_batches_total{reason="decode_error"}' \
+            in text
+        assert _counter(
+            "dl4jtpu_watchdog_stalls_total", stage="warn"
+        ) >= before["warn"] + 1
+        assert _counter(
+            "dl4jtpu_recovery_events_total", kind="rollback"
+        ) == before["rollback"] + 1
+        assert _counter(
+            "dl4jtpu_quarantined_batches_total", reason="decode_error"
+        ) == before["quarantine"] + 1
+
+    def test_grouped_fit_routes_through_recovery_chokepoint(
+        self, tmp_path, monkeypatch
+    ):
+        """steps_per_execution fits recover too: a NaN batch inside a
+        group still triggers rollback, and the grouped device-side step
+        counter resyncs after the rewind."""
+        from deeplearning4j_tpu.train.checkpoint import CheckpointStore
+        from deeplearning4j_tpu.train.recovery import RecoveryPolicy
+
+        monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path / "crash"))
+        m = _model()
+        store = CheckpointStore(str(tmp_path / "ck"), keep_last=3)
+        m.add_listener(_saver(store, every=4))
+        policy = RecoveryPolicy(store, skip_window=0).attach(m)
+        faults.arm("data.decode:corrupt:nth=9")
+        m.fit(_feed(16), epochs=1, steps_per_execution=2)
+        faults.disarm()
+        assert policy.rollbacks == 1
+        assert np.isfinite(m.score_value)
