@@ -11,7 +11,9 @@ sync.  Both families therefore need the same first step: find the
 A function body is traced when the function is
 
 - decorated with ``jax.jit`` / ``jax.pmap`` / ``shard_map`` (directly
-  or via ``partial(jax.jit, ...)``), or
+  or via ``partial(jax.jit, ...)``), or with ``device_transform`` (a
+  datavec/device.py fused-decode body — traced into the step program
+  when its chain lowers), or
 - passed to a jit-wrapper or a tracing combinator (``lax.scan`` /
   ``cond`` / ``while_loop`` / ``fori_loop`` / ``switch`` / ``map``,
   ``jax.vjp`` / ``grad`` / ``value_and_grad`` / ``vmap`` /
@@ -46,6 +48,11 @@ from deeplearning4j_tpu.analysis.core import (
 JIT_WRAPPERS = {
     "jax.jit", "jit", "jax.pmap", "pmap", "shard_map",
     "jax.experimental.shard_map.shard_map", "jax.named_call",
+    # datavec/device.py fused-decode bodies: a @device_transform
+    # function is traced into the step program when its chain lowers,
+    # so an impure transform must fail LINT here, not trace later
+    "device_transform", "device.device_transform",
+    "datavec.device.device_transform",
 }
 PARTIAL_NAMES = {"partial", "functools.partial", "_partial"}
 # Calls whose function-valued arguments are traced when invoked.

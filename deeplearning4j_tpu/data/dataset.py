@@ -13,6 +13,26 @@ import dataclasses
 
 import numpy as np
 
+# Routing/attribution tags carried on batch objects (outside the array
+# payload).  Structural batch operations (split, map) and the prefetch
+# staging copy them forward so cache-hit ETL attribution and fused
+# decode routing survive batch surgery (e.g. recovery's OOM microbatch
+# split of a raw-tagged batch; split pieces share the batch's
+# _decode_step, so their augmentation keys match the unsplit run).
+BATCH_TAGS = ("_etl_source", "_raw_for_device_decode", "_decode_step")
+
+
+def copy_tags(src, dst):
+    """Copy the known batch tags from `src` to `dst` (returns `dst`)."""
+    for tag in BATCH_TAGS:
+        v = getattr(src, tag, None)
+        if v is not None:
+            try:
+                setattr(dst, tag, v)
+            except AttributeError:
+                pass              # slotted/foreign batch types
+    return dst
+
 
 @dataclasses.dataclass
 class DataSet:
@@ -30,14 +50,12 @@ class DataSet:
         n = self.num_examples
         for i in range(0, n, batch_size):
             sl = slice(i, min(i + batch_size, n))
-            out.append(
-                DataSet(
-                    self.features[sl],
-                    self.labels[sl],
-                    None if self.features_mask is None else self.features_mask[sl],
-                    None if self.labels_mask is None else self.labels_mask[sl],
-                )
-            )
+            out.append(copy_tags(self, DataSet(
+                self.features[sl],
+                self.labels[sl],
+                None if self.features_mask is None else self.features_mask[sl],
+                None if self.labels_mask is None else self.labels_mask[sl],
+            )))
         return out
 
     def shuffle(self, rng: np.random.Generator) -> "DataSet":
@@ -94,14 +112,12 @@ class MultiDataSet:
                     return None
                 return tuple(None if a is None else a[sl] for a in arrays)
 
-            out.append(
-                MultiDataSet(
-                    cut(self.features),
-                    cut(self.labels),
-                    cut(self.features_masks),
-                    cut(self.labels_masks),
-                )
-            )
+            out.append(copy_tags(self, MultiDataSet(
+                cut(self.features),
+                cut(self.labels),
+                cut(self.features_masks),
+                cut(self.labels_masks),
+            )))
         return out
 
 
@@ -117,11 +133,11 @@ def map_batch(batch, fn, *, masks: bool = True):
         return None if a is None else fn(a)
 
     if isinstance(batch, DataSet):
-        return DataSet(
+        return copy_tags(batch, DataSet(
             ap(batch.features), ap(batch.labels),
             ap(batch.features_mask) if masks else batch.features_mask,
             ap(batch.labels_mask) if masks else batch.labels_mask,
-        )
+        ))
     if isinstance(batch, MultiDataSet):
         def apt(arrays, mask_group=False):
             if arrays is None:
@@ -130,11 +146,11 @@ def map_batch(batch, fn, *, masks: bool = True):
                 return arrays
             return tuple(ap(a) for a in arrays)
 
-        return MultiDataSet(
+        return copy_tags(batch, MultiDataSet(
             apt(batch.features), apt(batch.labels),
             apt(batch.features_masks, mask_group=True),
             apt(batch.labels_masks, mask_group=True),
-        )
+        ))
     return batch
 
 

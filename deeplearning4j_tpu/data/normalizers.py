@@ -13,6 +13,7 @@ from pathlib import Path
 import numpy as np
 
 from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import DataSetIterator
 
 
 class Normalizer:
@@ -36,6 +37,14 @@ class Normalizer:
         Path(path).write_text(
             json.dumps({"type": type(self).__name__, **self.state_dict()})
         )
+
+    def device_spec(self):
+        """The datavec/device.py transform spec this normalizer lowers
+        to (stats baked in as program constants), or None when the
+        normalizer has no device lowering — NormalizingIterator
+        advertises it so fit() can fuse the normalization into the
+        step program."""
+        return None
 
     @staticmethod
     def restore(path: str) -> "Normalizer":
@@ -79,6 +88,13 @@ class NormalizerStandardize(Normalizer):
     def revert_features(self, features):
         return features * self.std + self.mean
 
+    def device_spec(self):
+        if self.mean is None:
+            return None                   # not fitted yet
+        from deeplearning4j_tpu.datavec.device import Standardize
+
+        return Standardize(self.mean, self.std)
+
     def state_dict(self):
         return {"mean": self.mean.tolist(), "std": self.std.tolist()}
 
@@ -116,6 +132,13 @@ class NormalizerMinMaxScaler(Normalizer):
         rng = np.maximum(self.max - self.min, 1e-12)
         return (features - self.lo) / (self.hi - self.lo) * rng + self.min
 
+    def device_spec(self):
+        if self.min is None:
+            return None                   # not fitted yet
+        from deeplearning4j_tpu.datavec.device import MinMaxScale
+
+        return MinMaxScale(self.min, self.max, self.lo, self.hi)
+
     def state_dict(self):
         return {"lo": self.lo, "hi": self.hi,
                 "min": self.min.tolist(), "max": self.max.tolist()}
@@ -152,6 +175,11 @@ class ImagePreProcessingScaler(Normalizer):
         f = x.astype(np.float32) * scale + self.lo
         return DataSet(f, ds.labels, ds.features_mask, ds.labels_mask)
 
+    def device_spec(self):
+        from deeplearning4j_tpu.datavec.device import Scale
+
+        return Scale((self.hi - self.lo) / 255.0, self.lo)
+
     def revert_features(self, features):
         return (features - self.lo) / (self.hi - self.lo) * 255.0
 
@@ -162,9 +190,14 @@ class ImagePreProcessingScaler(Normalizer):
         self.lo, self.hi = d["lo"], d["hi"]
 
 
-class NormalizingIterator:
+class NormalizingIterator(DataSetIterator):
     """Wrap an iterator so every batch passes through a fitted normalizer
-    (the reference's iterator.setPreProcessor(normalizer) pattern)."""
+    (the reference's iterator.setPreProcessor(normalizer) pattern).
+
+    Advertises the normalizer's device lowering (``device_chain`` /
+    ``raw()``): fit() fuses the normalization into the step program and
+    pulls the base iterator's undecoded batches instead, when the
+    lowering exists."""
 
     def __init__(self, base, normalizer: Normalizer):
         self._base = base
@@ -173,6 +206,26 @@ class NormalizingIterator:
     @property
     def batch_size(self):
         return self._base.batch_size
+
+    @property
+    def device_chain(self):
+        spec = self._norm.device_spec()
+        if spec is None:
+            return None
+        from deeplearning4j_tpu.datavec.device import TransformChain
+
+        # memoized per spec fingerprint: a fresh chain every access
+        # would defeat try_lower's on-chain lowering cache (each fit
+        # would re-pay the standalone decode calibration), while a
+        # refitted normalizer changes the fingerprint and invalidates
+        fp = spec.fingerprint()
+        cached = getattr(self, "_chain_cache", None)
+        if cached is None or cached[0] != fp:
+            self._chain_cache = (fp, TransformChain(features=(spec,)))
+        return self._chain_cache[1]
+
+    def raw(self):
+        return self._base
 
     def reset(self):
         self._base.reset()

@@ -44,8 +44,11 @@ from deeplearning4j_tpu.data.iterator import DataSetIterator
 
 # Attributes _timed_batches reads off a staged batch.  Stage functions
 # must copy them from the source batch (tag-preserving staging keeps the
-# cache-hit ETL attribution working through the prefetch wrap).
-BATCH_TAGS = ("_etl_source",)
+# cache-hit ETL attribution — and the fused-decode routing tag — working
+# through the prefetch wrap).  ONE canonical tag list lives in
+# data/dataset.py next to the structural batch operations that also
+# propagate it.
+from deeplearning4j_tpu.data.dataset import BATCH_TAGS
 
 
 def stage_to_device(batch):

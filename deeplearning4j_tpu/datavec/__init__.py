@@ -6,10 +6,13 @@ Role parity with the reference's `datavec/` tree (SURVEY.md §2.2 "DataVec
 `RecordReaderDataSetIterator` bridge into the training pipeline.
 
 TPU-native stance: transforms are pure functions over columnar numpy
-batches (vectorized, host-side — ETL stays off the accelerator), the
-iterator bridge emits fixed-shape `DataSet` batches so the compiled train
-step never recompiles, and async prefetch (`AsyncDataSetIterator`) overlaps
-host ETL with device steps.
+batches (vectorized), the iterator bridge emits fixed-shape `DataSet`
+batches so the compiled train step never recompiles, async prefetch
+(`AsyncDataSetIterator`) overlaps host ETL with device steps, and the
+common decode chain can leave the host entirely: `datavec/device.py`
+lowers a `TransformChain` into the compiled step program so fit()
+stages raw uint8 bytes and XLA runs the decode (`device.py` module
+docstring has the contract).
 """
 
 from deeplearning4j_tpu.datavec.records import (
@@ -46,8 +49,16 @@ from deeplearning4j_tpu.datavec.join_reduce import (
     Reducer,
     ReduceOp,
 )
+from deeplearning4j_tpu.datavec.device import (
+    DeviceTransformIterator,
+    TransformChain,
+    device_transform,
+)
 
 __all__ = [
+    "DeviceTransformIterator",
+    "TransformChain",
+    "device_transform",
     "load_numeric_csv",
     "JDBCRecordReader",
     "CSVSequenceRecordReader",

@@ -1,0 +1,714 @@
+"""Device-compiled data pipeline — DataVec transforms lowered into XLA.
+
+PR 5's `PrefetchIterator` only *hides* host decode behind the running
+step: the producer thread still burns a core per device on cast /
+normalize / resize / one-hot, and the moment there is no spare core
+(BENCH_SCALING's n=2 row) the overlap collapses.  Following the Julia→
+TPU full-compilation paper (PAPERS.md), this module moves the decode
+itself onto the device: the common DataVec-style transform chain is
+lowered to a pure ``device_decode(step_i, raw_features, raw_labels) ->
+(features, labels, features_mask, labels_mask)`` function that the fit
+paths trace INTO the training-step program — one compiled XLA
+computation does decode + forward + backward + update, and the host's
+per-batch job shrinks to slicing raw uint8 bytes.
+
+Three pieces:
+
+- **Transform specs** (`Scale`, `Standardize`, `MinMaxScale`,
+  `CenterCrop`, `RandomCrop`, `RandomFlip`, `MeanPool`, `OneHot`,
+  `PadToBucket`, `Custom`): each knows a numpy **host** application
+  (the fallback path and the parity reference) and a jax **device**
+  application (traced into the step program).  Random transforms
+  (crop/flip) draw from a key folded from the step counter, so the
+  augmentation stream is deterministic per step on BOTH paths.
+- **`TransformChain`** + **`try_lower()`**: the compiler.  A chain
+  whose every spec is device-lowerable compiles to a `DeviceDecode`;
+  anything else (e.g. a `Custom` transform not marked
+  ``@device_transform``) returns a reason and the fit paths fall back
+  to host transforms — same numerics, no fusion.
+- **`DeviceTransformIterator`** + the advertisement protocol
+  (`chain_of` / `raw_feed`): an iterator that *advertises* a chain via
+  a ``device_chain`` attribute and raw batches via ``raw()``.  Its own
+  ``__iter__`` applies the chain on the host, so the iterator works
+  everywhere unchanged; `Model.fit` detects the chain, lowers it, and
+  switches the feed to tagged raw batches when fusion is possible.
+
+Trace-purity contract: every ``device_apply`` body (and any function a
+user marks with ``@device_transform``) is a JIT SCOPE — tpulint's TP
+family lints these bodies exactly like ``@jax.jit`` functions, so an
+impure transform fails lint, not trace.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+import zlib
+from typing import Callable, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.data.dataset import DataSet, copy_tags
+from deeplearning4j_tpu.data.iterator import DataSetIterator
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+def device_transform(fn: Callable) -> Callable:
+    """Mark `fn(x, key)` as safe to trace into the fused decode program.
+
+    The marker is what `try_lower` checks on `Custom` transforms, and
+    what tpulint keys on: a ``@device_transform`` body is a jit scope —
+    the TP trace-purity rules apply to it, so `time.time()` / prints /
+    global mutation inside a transform fail LINT instead of silently
+    freezing at trace time."""
+    fn._dl4jtpu_device_transform = True
+    return fn
+
+
+def _array_fp(a) -> tuple:
+    """Stable fingerprint of a constant array baked into the program."""
+    a = np.asarray(a)
+    return (a.shape, str(a.dtype), zlib.crc32(np.ascontiguousarray(a).tobytes()))
+
+
+class NotLowerable(Exception):
+    """A chain (or one spec of it) has no device lowering; `.reason`
+    says why — the fit paths log it and fall back to host transforms."""
+
+    def __init__(self, reason: str):
+        super().__init__(reason)
+        self.reason = reason
+
+
+class DeviceTransform:
+    """One stage of a decode chain.  Both applications take and return
+    ``(array, mask)`` so mask-producing stages (`PadToBucket`) compose
+    with mask-oblivious ones; `key` is None unless ``needs_key``."""
+
+    needs_key = False
+
+    def host_apply(self, x, mask, key):
+        raise NotImplementedError
+
+    def device_apply(self, x, mask, key):
+        raise NotImplementedError
+
+    def fingerprint(self) -> tuple:
+        return (type(self).__name__,) + self._fp()
+
+    def _fp(self) -> tuple:
+        return ()
+
+    def check_lowerable(self) -> None:
+        """Raise NotLowerable when this spec cannot run on device."""
+
+
+@dataclasses.dataclass
+class Scale(DeviceTransform):
+    """``x.astype(f32) * scale + offset`` — the ImagePreProcessingScaler
+    lowering (uint8 [0,255] -> [lo,hi] floats)."""
+
+    scale: float = 1.0 / 255.0
+    offset: float = 0.0
+
+    @device_transform
+    def device_apply(self, x, mask, key):
+        import jax.numpy as jnp
+
+        return (x.astype(jnp.float32) * jnp.float32(self.scale)
+                + jnp.float32(self.offset)), mask
+
+    def host_apply(self, x, mask, key):
+        return (x.astype(np.float32) * np.float32(self.scale)
+                + np.float32(self.offset)), mask
+
+    def _fp(self):
+        return (float(self.scale), float(self.offset))
+
+
+@dataclasses.dataclass
+class Standardize(DeviceTransform):
+    """``(x - mean) / std`` with per-feature stats — the
+    NormalizerStandardize lowering (stats fit on host, applied on
+    device as baked-in constants)."""
+
+    mean: np.ndarray = None
+    std: np.ndarray = None
+
+    @device_transform
+    def device_apply(self, x, mask, key):
+        import jax.numpy as jnp
+
+        return ((x.astype(jnp.float32) - jnp.asarray(self.mean, jnp.float32))
+                / jnp.asarray(self.std, jnp.float32)), mask
+
+    def host_apply(self, x, mask, key):
+        return ((x.astype(np.float32) - np.asarray(self.mean, np.float32))
+                / np.asarray(self.std, np.float32)), mask
+
+    def _fp(self):
+        return (_array_fp(self.mean), _array_fp(self.std))
+
+
+@dataclasses.dataclass
+class MinMaxScale(DeviceTransform):
+    """Per-feature min/max scale into [lo, hi] — the
+    NormalizerMinMaxScaler lowering (same epsilon + op order)."""
+
+    min: np.ndarray = None
+    max: np.ndarray = None
+    lo: float = 0.0
+    hi: float = 1.0
+
+    @device_transform
+    def device_apply(self, x, mask, key):
+        import jax.numpy as jnp
+
+        mn = jnp.asarray(self.min, jnp.float32)
+        rng = jnp.maximum(jnp.asarray(self.max, jnp.float32) - mn, 1e-12)
+        return ((x.astype(jnp.float32) - mn) / rng
+                * jnp.float32(self.hi - self.lo) + jnp.float32(self.lo)), mask
+
+    def host_apply(self, x, mask, key):
+        mn = np.asarray(self.min, np.float32)
+        rng = np.maximum(np.asarray(self.max, np.float32) - mn, 1e-12)
+        return ((x.astype(np.float32) - mn) / rng
+                * np.float32(self.hi - self.lo) + np.float32(self.lo)), mask
+
+    def _fp(self):
+        return (_array_fp(self.min), _array_fp(self.max),
+                float(self.lo), float(self.hi))
+
+
+@dataclasses.dataclass
+class CenterCrop(DeviceTransform):
+    """Static center crop of the two spatial axes of an NHWC batch."""
+
+    height: int = 0
+    width: int = 0
+
+    @device_transform
+    def device_apply(self, x, mask, key):
+        top = (x.shape[1] - self.height) // 2
+        left = (x.shape[2] - self.width) // 2
+        return x[:, top:top + self.height, left:left + self.width], mask
+
+    def host_apply(self, x, mask, key):
+        top = (x.shape[1] - self.height) // 2
+        left = (x.shape[2] - self.width) // 2
+        return x[:, top:top + self.height, left:left + self.width], mask
+
+    def _fp(self):
+        return (int(self.height), int(self.width))
+
+
+@dataclasses.dataclass
+class RandomCrop(DeviceTransform):
+    """Random crop of the spatial axes (one offset per batch, drawn
+    from the step key — deterministic per step)."""
+
+    height: int = 0
+    width: int = 0
+    needs_key = True
+
+    @device_transform
+    def device_apply(self, x, mask, key):
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+
+        kt, kl = jax.random.split(key)
+        top = jax.random.randint(kt, (), 0, x.shape[1] - self.height + 1)
+        left = jax.random.randint(kl, (), 0, x.shape[2] - self.width + 1)
+        x = lax.dynamic_slice_in_dim(x, top, self.height, axis=1)
+        x = lax.dynamic_slice_in_dim(x, left, self.width, axis=2)
+        return jnp.asarray(x), mask
+
+    def host_apply(self, x, mask, key):
+        # eager jax.random with the SAME key derivation: host fallback
+        # and parity tests draw the exact offsets the device draws
+        import jax
+
+        kt, kl = jax.random.split(key)
+        top = int(jax.random.randint(kt, (), 0, x.shape[1] - self.height + 1))
+        left = int(jax.random.randint(kl, (), 0, x.shape[2] - self.width + 1))
+        return x[:, top:top + self.height, left:left + self.width], mask
+
+    def _fp(self):
+        return (int(self.height), int(self.width))
+
+
+@dataclasses.dataclass
+class RandomFlip(DeviceTransform):
+    """Per-example coin-flip reversal of one axis (horizontal flip
+    augment at the default ``axis=2`` of NHWC)."""
+
+    prob: float = 0.5
+    axis: int = 2
+    needs_key = True
+
+    @device_transform
+    def device_apply(self, x, mask, key):
+        import jax
+        import jax.numpy as jnp
+
+        coin = jax.random.bernoulli(key, self.prob, (x.shape[0],))
+        sel = coin.reshape((-1,) + (1,) * (x.ndim - 1))
+        return jnp.where(sel, jnp.flip(x, self.axis), x), mask
+
+    def host_apply(self, x, mask, key):
+        import jax
+
+        coin = np.asarray(jax.random.bernoulli(key, self.prob, (x.shape[0],)))
+        sel = coin.reshape((-1,) + (1,) * (x.ndim - 1))
+        return np.where(sel, np.flip(x, self.axis), x), mask
+
+    def _fp(self):
+        return (float(self.prob), int(self.axis))
+
+
+@dataclasses.dataclass
+class MeanPool(DeviceTransform):
+    """Average-pool downscale of the spatial axes of an NHWC batch
+    (window (wh, ww) must divide H and W); ``collapse_channels`` also
+    means over C and keeps a singleton channel — the cheap
+    decode-resize used by the camera-wire bench feed."""
+
+    window: tuple = (2, 2)
+    collapse_channels: bool = False
+
+    @device_transform
+    def device_apply(self, x, mask, key):
+        import jax.numpy as jnp
+
+        b, h, w, c = x.shape
+        wh, ww = self.window
+        x = x.astype(jnp.float32).reshape(b, h // wh, wh, w // ww, ww, c)
+        if self.collapse_channels:
+            return x.mean(axis=(2, 4, 5))[..., None], mask
+        return x.mean(axis=(2, 4)), mask
+
+    def host_apply(self, x, mask, key):
+        b, h, w, c = x.shape
+        wh, ww = self.window
+        x = x.astype(np.float32).reshape(b, h // wh, wh, w // ww, ww, c)
+        if self.collapse_channels:
+            return x.mean(axis=(2, 4, 5), dtype=np.float32)[..., None], mask
+        return x.mean(axis=(2, 4), dtype=np.float32), mask
+
+    def _fp(self):
+        return (tuple(self.window), bool(self.collapse_channels))
+
+
+@dataclasses.dataclass
+class OneHot(DeviceTransform):
+    """Integer class ids -> one-hot float32 rows (label-side)."""
+
+    num_classes: int = 0
+
+    @device_transform
+    def device_apply(self, x, mask, key):
+        import jax
+
+        return jax.nn.one_hot(x, self.num_classes, dtype="float32"), mask
+
+    def host_apply(self, x, mask, key):
+        ids = np.asarray(x).astype(np.int64)
+        return np.eye(self.num_classes, dtype=np.float32)[ids], mask
+
+    def _fp(self):
+        return (int(self.num_classes),)
+
+
+@dataclasses.dataclass
+class PadToBucket(DeviceTransform):
+    """Pad the time axis up to the bucketing quantum
+    (`flags.bucket_length`) and emit/extend the mask marking real
+    steps — the recompile-hygiene transform: a mixed-length corpus
+    compiles ceil(max_len/quantum) programs instead of one per length.
+
+    ``quantum=None`` resolves ``flags.sequence_bucket_size`` ONCE at
+    lowering time (host-side), never inside the traced body."""
+
+    quantum: Optional[int] = None
+    axis: int = 1
+    _resolved: Optional[int] = dataclasses.field(default=None, repr=False)
+
+    def resolved_quantum(self) -> int:
+        if self._resolved is None:
+            from deeplearning4j_tpu.runtime.flags import environment
+
+            # only None means "resolve from flags": an explicit 0 must
+            # hit bucket_length's positive-quantum validation, not be
+            # silently replaced by the default
+            self._resolved = (environment().sequence_bucket_size
+                              if self.quantum is None
+                              else int(self.quantum))
+        return self._resolved
+
+    def _target(self, length: int) -> int:
+        from deeplearning4j_tpu.runtime.flags import bucket_length
+
+        return bucket_length(length, self.resolved_quantum())
+
+    @device_transform
+    def device_apply(self, x, mask, key):
+        import jax.numpy as jnp
+
+        t = x.shape[self.axis]
+        pad = self._target(t) - t
+        if mask is None:
+            mask = jnp.ones((x.shape[0], t), jnp.float32)
+        if pad == 0:
+            return x, mask
+        widths = [(0, 0)] * x.ndim
+        widths[self.axis] = (0, pad)
+        return jnp.pad(x, widths), jnp.pad(mask, ((0, 0), (0, pad)))
+
+    def host_apply(self, x, mask, key):
+        t = x.shape[self.axis]
+        pad = self._target(t) - t
+        if mask is None:
+            mask = np.ones((x.shape[0], t), np.float32)
+        if pad == 0:
+            return x, mask
+        widths = [(0, 0)] * x.ndim
+        widths[self.axis] = (0, pad)
+        return np.pad(x, widths), np.pad(mask, ((0, 0), (0, pad)))
+
+    def _fp(self):
+        return (self.resolved_quantum(), int(self.axis))
+
+
+@dataclasses.dataclass
+class Custom(DeviceTransform):
+    """A user transform ``fn(x, key) -> x``.  Lowerable only when the
+    function is marked ``@device_transform`` (the marker is the
+    author's promise the body is pure jax — and tpulint's cue to lint
+    it as a jit scope)."""
+
+    fn: Callable = None
+    needs_key = True
+
+    def check_lowerable(self) -> None:
+        if not getattr(self.fn, "_dl4jtpu_device_transform", False):
+            name = getattr(self.fn, "__qualname__", repr(self.fn))
+            raise NotLowerable(
+                f"custom transform {name} is not marked @device_transform"
+            )
+
+    def device_apply(self, x, mask, key):
+        return self.fn(x, key), mask
+
+    def host_apply(self, x, mask, key):
+        return np.asarray(self.fn(x, key)), mask
+
+    def _fp(self):
+        # qualname alone collides for distinct closures from the same
+        # factory (same code, different captured values) — and the
+        # fused step-fn cache keys on this fingerprint, so a collision
+        # would silently run the FIRST closure's transform.  id(fn) is
+        # sound as the tiebreaker: every cached step program keeps its
+        # DeviceDecode (and therefore this fn) alive through its
+        # closure, so a live cache entry's id can never be reused.
+        code = getattr(self.fn, "__code__", None)
+        return (getattr(self.fn, "__module__", "?"),
+                getattr(self.fn, "__qualname__", repr(self.fn)),
+                zlib.crc32(code.co_code) if code is not None else 0,
+                id(self.fn))
+
+
+@dataclasses.dataclass
+class TransformChain:
+    """An ordered feature-transform list + label-transform list, plus
+    the augmentation seed the per-step keys fold from."""
+
+    features: tuple = ()
+    labels: tuple = ()
+    seed: int = 0
+
+    def __post_init__(self):
+        self.features = tuple(self.features)
+        self.labels = tuple(self.labels)
+
+    @property
+    def specs(self) -> tuple:
+        return self.features + self.labels
+
+    def needs_key(self) -> bool:
+        return any(s.needs_key for s in self.specs)
+
+    def fingerprint(self) -> tuple:
+        return (
+            tuple(s.fingerprint() for s in self.features),
+            tuple(s.fingerprint() for s in self.labels),
+            int(self.seed),
+        )
+
+
+def _apply_chain(chain: TransformChain, step_i, feats, labs, *,
+                 device: bool, fmask0=None, lmask0=None):
+    """Shared traversal of both applications: per-spec keys fold from
+    (seed, step_i, spec position), so host fallback, parity tests and
+    the fused program draw identical augmentation streams.  fmask0 /
+    lmask0 seed the mask threading — the HOST path passes the batch's
+    own masks through (mask-producing specs extend them); the fused
+    device path never sees a masked raw batch (the fit routing refuses
+    fusion there)."""
+    base = None
+    if chain.needs_key():
+        import jax
+
+        base = jax.random.fold_in(jax.random.key(chain.seed), step_i)
+
+    def run(specs, x, salt, mask):
+        import jax
+
+        for i, spec in enumerate(specs):
+            k = (jax.random.fold_in(base, salt + i)
+                 if spec.needs_key else None)
+            if device:
+                x, mask = spec.device_apply(x, mask, k)
+            else:
+                x, mask = spec.host_apply(x, mask, k)
+        return x, mask
+
+    feats, fmask = run(chain.features, feats, 0, fmask0)
+    labs, lmask = run(chain.labels, labs, 1000, lmask0)
+    return feats, labs, fmask, lmask
+
+
+class DeviceDecode:
+    """A lowered chain: ``fn`` is the pure traced decode the fit paths
+    compose in front of the step body; ``host()`` is the numpy
+    reference the parity tests diff against; ``calibrated_seconds``
+    measures the standalone jitted decode once per input signature (the
+    fused program hides the stage, so attribution uses this calibrated
+    per-signature cost)."""
+
+    def __init__(self, chain: TransformChain):
+        self.chain = chain
+        self.fingerprint = chain.fingerprint()
+        self._jit_fn = None
+        self._calib: dict = {}
+
+    def fn(self, step_i, raw_feats, raw_labels):
+        """Traced decode body (called inside the fused step program)."""
+        return _apply_chain(self.chain, step_i, raw_feats, raw_labels,
+                            device=True)
+
+    def host(self, step_i, batch: DataSet) -> DataSet:
+        """Numpy reference application (fallback path semantics).  The
+        batch's own masks thread through the chain — preserved when no
+        spec touches them, extended by mask-producing specs — matching
+        what the pre-chain iterator stack (e.g. NormalizingIterator)
+        would have handed the fit loop."""
+        feats, labs, fmask, lmask = _apply_chain(
+            self.chain, step_i, batch.features, batch.labels,
+            device=False, fmask0=batch.features_mask,
+            lmask0=batch.labels_mask,
+        )
+        out = copy_tags(batch, DataSet(
+            np.asarray(feats), np.asarray(labs),
+            None if fmask is None else np.asarray(fmask),
+            None if lmask is None else np.asarray(lmask),
+        ))
+        # attribution tags (_etl_source) survive the decode; the
+        # raw-routing tag must not — this output IS the decoded batch
+        out._raw_for_device_decode = False
+        return out
+
+    def jitted(self):
+        if self._jit_fn is None:
+            import jax
+
+            self._jit_fn = jax.jit(self.fn)
+        return self._jit_fn
+
+    def calibrated_seconds(self, feats, labs) -> float:
+        """Measured standalone decode seconds for this input signature
+        (cached; first call compiles + times one warm run)."""
+        key = (tuple(np.shape(feats)), str(getattr(feats, "dtype", "")),
+               tuple(np.shape(labs)), str(getattr(labs, "dtype", "")))
+        t = self._calib.get(key)
+        if t is None:
+            import jax
+            import jax.numpy as jnp
+
+            fn = self.jitted()
+            si = jnp.uint32(0)
+            jax.block_until_ready(fn(si, feats, labs))   # compile + warm
+            t0 = time.perf_counter()
+            jax.block_until_ready(fn(si, feats, labs))
+            t = time.perf_counter() - t0
+            self._calib[key] = t
+        return t
+
+
+def try_lower(chain: TransformChain):
+    """Compile `chain` to a DeviceDecode.  Returns ``(decode, None)``
+    or ``(None, reason)`` when any spec refuses to lower — the caller
+    logs the reason and keeps the host path.
+
+    The lowering is memoized on the chain object: every fit() re-runs
+    this decision, and a fresh DeviceDecode per fit would re-pay the
+    standalone decode calibration (an XLA compile + two timed device
+    runs per input signature) for a result that cannot change — the
+    fingerprint, including PadToBucket's flag resolution, is sticky
+    per spec instance."""
+    if not isinstance(chain, TransformChain):
+        return None, f"not a TransformChain: {type(chain).__name__}"
+    cached = getattr(chain, "_lowered", None)
+    if cached is not None:
+        return cached, None
+    try:
+        for spec in chain.specs:
+            if not isinstance(spec, DeviceTransform):
+                raise NotLowerable(
+                    f"unknown transform type {type(spec).__name__}"
+                )
+            spec.check_lowerable()
+    except NotLowerable as e:
+        return None, e.reason
+    decode = DeviceDecode(chain)
+    chain._lowered = decode
+    return decode, None
+
+
+# -- iterator protocol ----------------------------------------------------
+
+class DeviceTransformIterator(DataSetIterator):
+    """Attach a TransformChain to a raw-batch iterator.
+
+    Iterating it applies the chain ON THE HOST (per-batch step index
+    keys) — drop-in anywhere a DataSetIterator goes.  It also
+    advertises the chain (``device_chain``) and the raw feed
+    (``raw()``), which is what `Model.fit` keys on to lower the chain
+    into the step program and pull raw uint8 bytes instead."""
+
+    def __init__(self, base: DataSetIterator, chain: TransformChain):
+        self._base = base
+        self._chain = chain
+        self._decode = DeviceDecode(chain)
+        self._step = 0
+
+    @property
+    def device_chain(self) -> TransformChain:
+        return self._chain
+
+    def raw(self) -> DataSetIterator:
+        return self._base
+
+    def next_decode_step(self) -> int:
+        """The ONE per-batch augmentation counter for this iterator:
+        host iteration and the raw feed both draw from it, so the
+        fused program and the host fallback fold identical keys no
+        matter how fits, evaluates and raw pulls interleave."""
+        s = self._step
+        self._step += 1
+        return s
+
+    @property
+    def batch_size(self) -> int:
+        return getattr(self._base, "batch_size", 0)
+
+    def reset(self) -> None:
+        if hasattr(self._base, "reset"):
+            self._base.reset()
+
+    def __iter__(self):
+        for batch in self._base:
+            # host() copy_tags the attribution tags forward
+            yield self._decode.host(self.next_decode_step(), batch)
+
+
+def chain_of(iterator) -> Optional[TransformChain]:
+    """The TransformChain an iterator advertises, or None.  The
+    protocol is duck-typed: a ``device_chain`` attribute holding a
+    TransformChain plus a ``raw()`` method yielding undecoded
+    batches."""
+    chain = getattr(iterator, "device_chain", None)
+    if isinstance(chain, TransformChain) and hasattr(iterator, "raw"):
+        return chain
+    return None
+
+
+class _RawFeed(DataSetIterator):
+    """The raw-byte feed of an advertising iterator: yields shallow
+    views of the base iterator's batches tagged
+    ``_raw_for_device_decode`` so the fit chokepoints route them to
+    the fused decode+step program.  A batch that is not a plain
+    DataSet (slotted/frozen batch types) is decoded ON THE HOST here
+    instead — once the feed is swapped to raw, an untagged raw batch
+    must never reach the step undecoded.  Reset delegates to the
+    advertising wrapper (which owns the base)."""
+
+    def __init__(self, owner, decode: Optional["DeviceDecode"] = None):
+        self._owner = owner
+        self._raw = owner.raw()
+        self._decode = decode
+        self._step = 0
+
+    def _next_step(self) -> int:
+        """Per-batch augmentation counter: the owner's shared one when
+        it keeps one (DeviceTransformIterator), else feed-local."""
+        nxt = getattr(self._owner, "next_decode_step", None)
+        if nxt is not None:
+            return nxt()
+        s = self._step
+        self._step += 1
+        return s
+
+    @property
+    def batch_size(self) -> int:
+        return getattr(self._owner, "batch_size", 0)
+
+    def reset(self) -> None:
+        if hasattr(self._owner, "reset"):
+            self._owner.reset()
+
+    def _host_decode(self):
+        if self._decode is None:
+            self._decode = DeviceDecode(chain_of(self._owner))
+        return self._decode
+
+    def __iter__(self):
+        for batch in self._raw:
+            i = self._next_step()
+            if (isinstance(batch, DataSet)
+                    and batch.features_mask is None
+                    and batch.labels_mask is None):
+                # tag a shallow view (same arrays), never the base
+                # object: in-memory bases re-yield the same batch
+                # objects across fits, and a sticky tag would
+                # misattribute their bytes to the raw-feed H2D series
+                # on later non-fused runs
+                batch = copy_tags(batch, DataSet(
+                    batch.features, batch.labels,
+                    batch.features_mask, batch.labels_mask,
+                ))
+                batch._raw_for_device_decode = True
+                # the augmentation key index the fused program folds —
+                # carried on the batch so fused and host paths draw
+                # from the SAME counter (model.iteration needn't align
+                # with feed position after evaluate()/reuse)
+                batch._decode_step = i
+            else:
+                # masked raw batches can never fuse (the fused program
+                # stages features/labels only) — decode them here,
+                # while still numpy; a tagged masked batch would be
+                # prefetch-staged to the device raw and then pay a
+                # hidden D2H for its per-step host decode.  Foreign
+                # batch types (slotted/frozen, or not DataSet-shaped)
+                # likewise host-decode: once the feed is raw, an
+                # untagged raw batch must never reach the step
+                # undecoded.
+                batch = self._host_decode().host(i, batch)
+            yield batch
+
+
+def raw_feed(iterator, decode: Optional[DeviceDecode] = None
+             ) -> DataSetIterator:
+    return _RawFeed(iterator, decode)

@@ -174,12 +174,18 @@ class GraphModel(Model):
         )
 
     # -- compiled train step ----------------------------------------------
-    def _get_step_fn(self, n_masks: int):
-        key = ("train", n_masks)
+    def _get_step_fn(self, n_masks: int, decode=None):
+        """The per-batch graph step program.  With `decode` set (the
+        single-input/single-output fused fit), the program takes raw
+        features/labels and runs the lowered transform chain as its
+        first stage — the loss body below is shared, so fused and host
+        training cannot diverge."""
+        key = (("train", n_masks) if decode is None
+               else ("train_fused", decode.fingerprint))
         if key not in self._step_fns:
 
-            @partial(jax.jit, donate_argnums=(0, 1, 2))
-            def step(params, opt_state, net_state, step_i, features, labels, lmasks):
+            def core(params, opt_state, net_state, step_i, features,
+                     labels, masks):
                 rng = SeedStream.fold(self._stream.root, step_i)
                 inputs = dict(zip(self.conf.network_inputs, features))
 
@@ -192,9 +198,7 @@ class GraphModel(Model):
                         self._out_specs,
                         self.conf.network_outputs,
                         labels,
-                        # len() of the label TUPLE is static structure,
-                        # not a tracer read
-                        lmasks if n_masks else [None] * len(labels),  # tpulint: disable=RH101
+                        masks,
                     ):
                         out = outs[oname]
                         if custom is not None:
@@ -218,8 +222,73 @@ class GraphModel(Model):
                 merged_state = {**net_state, **new_state}
                 return params, opt_state, merged_state, loss
 
+            if decode is None:
+
+                @partial(jax.jit, donate_argnums=(0, 1, 2))
+                def step(params, opt_state, net_state, step_i, features,
+                         labels, lmasks):
+                    # len() of the label TUPLE is static structure,
+                    # not a tracer read
+                    masks = lmasks if n_masks else [None] * len(labels)  # tpulint: disable=RH101
+                    return core(params, opt_state, net_state, step_i,
+                                features, labels, masks)
+
+            else:
+                dec = decode.fn
+
+                @partial(jax.jit, donate_argnums=(0, 1, 2))
+                def step(params, opt_state, net_state, step_i, dec_step,
+                         raw_feats, raw_labels):
+                    # fused decode stage: raw single-input bytes in;
+                    # the decode's label mask feeds the one output loss
+                    # (graph steps have no features-mask path).
+                    # dec_step is the feed's augmentation index (the
+                    # batch's _decode_step) — the host fallback folds
+                    # keys from the same feed counter
+                    feats, labs, _fmask, lmask = dec(
+                        dec_step, raw_feats, raw_labels
+                    )
+                    return core(params, opt_state, net_state, step_i,
+                                (feats,), (labs,), (lmask,))
+
             self._step_fns[key] = step
         return self._step_fns[key]
+
+    def _fit_batch_fused(self, batch: DataSet, decode) -> None:
+        """Dispatch one fused decode+train graph program over a raw
+        single-input batch (see SequentialModel._run_step_fused)."""
+        from deeplearning4j_tpu.parallel.data_parallel import place_batch
+        from deeplearning4j_tpu.runtime import faults
+        from deeplearning4j_tpu.runtime.crash import oom_report_scope
+        from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
+
+        step = self._get_step_fn(0, decode)
+        with self._observe_step() as obs:
+            with oom_report_scope(), active_mesh_scope(
+                getattr(self, "_mesh", None)
+            ):
+                with obs.phase("host_stage"):
+                    # fused-decode host boundary fault site
+                    faults.maybe_fail("data.device_decode")
+                    feats = place_batch(self, batch.features)
+                    labs = place_batch(self, batch.labels, is_label=True)
+                with obs.phase("dispatch"):
+                    (self.params, self.opt_state, self.net_state,
+                     loss) = step(
+                        self.params, self.opt_state, self.net_state,
+                        jnp.uint32(self.iteration),
+                        jnp.uint32(getattr(batch, "_decode_step",
+                                           self.iteration)),
+                        feats, labs,
+                    )
+                with obs.phase("device_sync"):
+                    obs.sync(loss)
+            self._last_score = loss
+            self.last_batch_size = batch.num_examples
+            self.iteration += 1
+            self._count_device_decode(decode, feats, labs)
+            with obs.phase("listeners"):
+                self._dispatch_iteration(loss)
 
     # -- data plumbing -----------------------------------------------------
     @staticmethod
@@ -264,9 +333,20 @@ class GraphModel(Model):
             steps_per_execution > 1
             and getattr(self, "_batch_sharding", None) is None
         )
+        # device-compiled data pipeline (datavec/device.py): fused
+        # decode is wired for the single-input/single-output per-batch
+        # graph program; other graph shapes keep host transforms
+        reason = None
+        if use_multi:
+            reason = "graph grouped (steps_per_execution) fit path"
+        elif (len(self.conf.network_inputs) != 1
+                or len(self.conf.network_outputs) != 1):
+            reason = "multi-input/output graph"
+        feed_src, decode = self._device_decode_feed(iterator, reason)
+        self._device_decode = decode
         # software pipelining, same contract as SequentialModel.fit:
         # pull + device staging for batch N+1 overlap step N's compute
-        feed = self._prefetch_feed(iterator)
+        feed = self._prefetch_feed(feed_src)
         try:
             for _ in range(epochs):
                 for lst in self.listeners:
@@ -282,7 +362,8 @@ class GraphModel(Model):
                 if hasattr(iterator, "reset"):
                     iterator.reset()
         finally:
-            if feed is not iterator:
+            self._device_decode = None
+            if feed is not feed_src:
                 feed.close()
         for lst in self.listeners:
             # getattr: on_fit_end is newer than the SPI — tolerate
@@ -426,6 +507,29 @@ class GraphModel(Model):
     def fit_batch(self, batch) -> None:
         if self.params is None:
             self.init()
+        if (self._device_decode is not None
+                and getattr(batch, "_raw_for_device_decode", False)):
+            # raw-tagged batch BEFORE the MultiDataSet conversion (the
+            # conversion would drop the routing tag)
+            if not isinstance(batch, DataSet):
+                # the transform-chain protocol is single-input and
+                # DataSet-shaped; a tagged batch of any other type has
+                # no decode route and must never reach the step
+                # undecoded
+                raise TypeError(
+                    "raw device-decode batch must be a DataSet, got "
+                    f"{type(batch).__name__}"
+                )
+            if batch.features_mask is None and batch.labels_mask is None:
+                self._fit_batch_fused(batch, self._device_decode)
+                return
+            # masked raw batch: host-decode (masks thread through the
+            # chain) and take the normal masked step below.  (_RawFeed
+            # host-decodes masked batches itself; this is the defensive
+            # net for hand-tagged batches.)
+            batch = self._device_decode.host(
+                getattr(batch, "_decode_step", self.iteration), batch
+            )
         mds = self._as_mds(batch)
         self._check_mds(mds)
         masks = mds.labels_masks

@@ -125,6 +125,29 @@ class _LazyScore:
         return abs(float(self))
 
 
+def _batch_nbytes(batch) -> int:
+    """Total array bytes of a DataSet/MultiDataSet WITHOUT materializing
+    anything: prefetch-staged batches hold device arrays, and an
+    np.asarray here would be a D2H sync in the hot loop.  `nbytes` is a
+    metadata read on both numpy and jax arrays."""
+    from deeplearning4j_tpu.data.dataset import DataSet, MultiDataSet
+
+    def nb(a):
+        return int(getattr(a, "nbytes", 0) or 0) if a is not None else 0
+
+    if isinstance(batch, DataSet):
+        return (nb(batch.features) + nb(batch.labels)
+                + nb(batch.features_mask) + nb(batch.labels_mask))
+    if isinstance(batch, MultiDataSet):
+        total = sum(nb(a) for a in batch.features)
+        total += sum(nb(a) for a in batch.labels)
+        for group in (batch.features_masks, batch.labels_masks):
+            if group is not None:
+                total += sum(nb(a) for a in group)
+        return total
+    return 0
+
+
 def _poison_batch(batch):
     """The injected ``data.decode`` 'corrupt' action: a copy of the
     batch with every FLOAT feature/label array NaN-filled — same
@@ -167,6 +190,10 @@ class Model:
         # RecoveryPolicy the fit chokepoints route through when attached
         self._watchdog = None
         self._recovery = None
+        # device-compiled data pipeline: the lowered DeviceDecode the
+        # fused fit chokepoints compose in front of the step program
+        # (set for the duration of a fit over an advertising iterator)
+        self._device_decode = None
         # device-resident step counters of the grouped/TBPTT programs
         # (recovery resets them after a rollback rewinds `iteration`)
         self._multi_iter_dev = None
@@ -196,6 +223,7 @@ class Model:
         overlap_total = reg.counter(
             "dl4jtpu_prefetch_overlap_seconds_total"
         )
+        h2d_total = reg.counter("dl4jtpu_h2d_bytes_total")
         rec = tracer()
         it = iter(iterator)
         absorbed_pull_failure = False
@@ -263,6 +291,18 @@ class Model:
                 self.etl_wait_s += wait
                 wait_total.inc(wait)
                 rec.add_complete("etl_wait", t0, wait, cat="step_phase")
+            nbytes = _batch_nbytes(batch)
+            if nbytes:
+                # what this batch costs to cross host->HBM: raw uint8
+                # bytes on the fused-decode feed, host-transformed
+                # floats otherwise — the attributable H2D delta of
+                # moving the decode onto the device
+                h2d_total.inc(
+                    nbytes,
+                    feed="raw" if getattr(
+                        batch, "_raw_for_device_decode", False
+                    ) else "decoded",
+                )
             stage_s = getattr(batch, "_prefetch_stage_s", None)
             if stage_s is not None:
                 # producer work not re-paid as consumer wait = the
@@ -326,6 +366,62 @@ class Model:
         from deeplearning4j_tpu.observe.trace import step_scope
 
         return step_scope(self, n_steps)
+
+    def _device_decode_feed(self, iterator, unsupported_reason=None):
+        """The device-compiled data pipeline's fit-entry decision: when
+        `iterator` advertises a device-lowerable transform chain
+        (datavec/device.py) and flags.device_decode is on, switch the
+        feed to tagged raw batches and return the lowered DeviceDecode
+        the fused fit chokepoints compose in front of the step program.
+
+        Returns ``(feed, decode|None)``.  Every fallback — flag off is
+        silent; a non-lowerable chain or an unsupported fit variant
+        logs its reason and counts on
+        ``dl4jtpu_device_decode_fallbacks_total`` — keeps the original
+        iterator, whose own ``__iter__`` applies the chain on the host
+        (same numerics, no fusion)."""
+        from deeplearning4j_tpu.datavec import device as dv
+        from deeplearning4j_tpu.runtime.flags import environment
+
+        if not environment().device_decode:
+            return iterator, None
+        chain = dv.chain_of(iterator)
+        if chain is None:
+            return iterator, None
+        reason = unsupported_reason
+        decode = None
+        if reason is None:
+            decode, reason = dv.try_lower(chain)
+        if decode is None:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            log.info(
+                "device decode fallback (transforms stay on the host): %s",
+                reason,
+            )
+            registry().counter(
+                "dl4jtpu_device_decode_fallbacks_total"
+            ).inc(reason=reason)
+            return iterator, None
+        return dv.raw_feed(iterator, decode), decode
+
+    def _count_device_decode(self, decode, feats, labs, k: int = 1) -> None:
+        """Per-dispatch accounting of the fused decode stage: batch
+        count plus the calibrated per-signature device seconds (the
+        fused program hides the stage, so attribution uses a standalone
+        jitted decode timed once per input signature)."""
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        reg = registry()
+        reg.counter("dl4jtpu_device_decode_batches_total").inc(k)
+        try:
+            secs = decode.calibrated_seconds(feats, labs)
+        except Exception as e:
+            # calibration is attribution, never a failure: a signature
+            # that refuses to time standalone still trains fused
+            log.debug("device-decode calibration skipped: %s", e)
+            return
+        reg.counter("dl4jtpu_device_decode_seconds_total").inc(secs * k)
 
     def _prefetch_feed(self, iterator):
         """Wrap a fit iterator in the pipelining PrefetchIterator

@@ -517,20 +517,26 @@ class SequentialModel(Model):
         )
         return params, opt_state
 
-    def _get_step_fn(self, has_lmask: bool, has_fmask: bool, with_carries: bool):
-        key = ("train", has_lmask, has_fmask, with_carries)
+    def _get_step_fn(self, has_lmask: bool, has_fmask: bool, with_carries: bool,
+                     decode=None):
+        """The single-batch step program.  With `decode` set (the
+        fused-decode fit), the program takes raw bytes and runs the
+        lowered transform chain as its first stage — the chain, not
+        the batch, produces the masks (sequence padding), and the loss
+        body below is shared so fused and host training cannot
+        diverge."""
+        key = (("train", has_lmask, has_fmask, with_carries)
+               if decode is None else ("train_fused", decode.fingerprint))
         if key not in self._step_fns:
 
-            @partial(jax.jit, donate_argnums=(0, 1, 2))
-            def step(params, opt_state, net_state, step_i, features, labels, lmask, fmask, carries):
+            def core(params, opt_state, net_state, step_i, features,
+                     labels, lm, fm, carries):
                 rng = SeedStream.fold(self._stream.root, step_i)
 
                 def loss_fn(p):
                     loss, new_state, new_carries = self._step_loss(
                         p, net_state, features, labels,
-                        lmask=lmask if has_lmask else None,
-                        fmask=fmask if has_fmask else None,
-                        rng=rng,
+                        lmask=lm, fmask=fm, rng=rng,
                         carries=carries if with_carries else None,
                     )
                     return loss, (new_state, new_carries)
@@ -543,8 +549,50 @@ class SequentialModel(Model):
                 merged_state = {**net_state, **new_state}
                 return params, opt_state, merged_state, loss, new_carries
 
+            if decode is None:
+
+                @partial(jax.jit, donate_argnums=(0, 1, 2))
+                def step(params, opt_state, net_state, step_i, features,
+                         labels, lmask, fmask, carries):
+                    return core(
+                        params, opt_state, net_state, step_i, features,
+                        labels,
+                        lmask if has_lmask else None,
+                        fmask if has_fmask else None,
+                        carries,
+                    )
+
+            else:
+                dec = decode.fn
+
+                @partial(jax.jit, donate_argnums=(0, 1, 2))
+                def step(params, opt_state, net_state, step_i, dec_step,
+                         raw_feats, raw_labels):
+                    # dec_step is the feed's augmentation index (the
+                    # batch's _decode_step), NOT model.iteration: the
+                    # host fallback folds keys from the same feed
+                    # counter, keeping the two paths numerically equal
+                    feats, labs, fm, lm = dec(dec_step, raw_feats,
+                                              raw_labels)
+                    return core(params, opt_state, net_state, step_i,
+                                feats, labs, lm, fm, {})
+
             self._step_fns[key] = step
         return self._step_fns[key]
+
+    def _fused_decode_reason(self) -> str | None:
+        """Why THIS model's fit cannot fuse a device decode, or None.
+        The variants with their own step programs (compressed, 1F1B,
+        TBPTT) keep host transforms — their programs were not built to
+        compose a decode stage."""
+        if getattr(self, "_grad_compression", None):
+            return "grad-compression fit path"
+        if (getattr(self, "_pipeline_schedule", "gpipe") == "1f1b"
+                and getattr(self, "_pipeline_plan", None) is not None):
+            return "1F1B pipeline fit path"
+        if self.conf.backprop_type == "tbptt" and self.conf.tbptt_length > 0:
+            return "TBPTT fit path"
+        return None
 
     def _get_step_fn_tbptt(self, has_lmask: bool, has_fmask: bool):
         """Whole-batch TBPTT as ONE compiled XLA program: a lax.scan over
@@ -868,11 +916,19 @@ class SequentialModel(Model):
             and getattr(self, "_pipeline_schedule", "gpipe") != "1f1b"
             and getattr(self, "_batch_sharding", None) is None
         )
+        # device-compiled data pipeline: an iterator advertising a
+        # lowerable transform chain feeds RAW bytes and the chain runs
+        # inside the step program (datavec/device.py); unsupported fit
+        # variants and non-lowerable chains keep host transforms
+        feed_src, decode = self._device_decode_feed(
+            iterator, self._fused_decode_reason()
+        )
+        self._device_decode = decode
         # software pipelining: batch N+1 is pulled + staged to device on
         # a background thread while step N computes (flags.prefetch_depth
         # deep; 0 = serial).  close() in the finally stops the producer
         # even when a step raises mid-epoch.
-        feed = self._prefetch_feed(iterator)
+        feed = self._prefetch_feed(feed_src)
         try:
             for _ in range(epochs):
                 for lst in self.listeners:
@@ -887,7 +943,8 @@ class SequentialModel(Model):
                 self.epoch += 1
                 iterator.reset()
         finally:
-            if feed is not iterator:
+            self._device_decode = None
+            if feed is not feed_src:
                 feed.close()
         for lst in self.listeners:
             # getattr: on_fit_end is newer than the SPI — tolerate
@@ -897,11 +954,17 @@ class SequentialModel(Model):
     def _fit_epoch_multi(self, iterator, spe: int) -> None:
         def group_ok(buf):
             f0, l0 = buf[0].features, buf[0].labels
+            # raw-tag uniformity: a group mixing raw-tagged and
+            # host-decoded batches must degrade to the per-batch path
+            # (which routes tags correctly) — the grouped program would
+            # stack the tagged batches' undecoded bytes into the loss
+            raw0 = bool(getattr(buf[0], "_raw_for_device_decode", False))
             return all(
                 b.features.shape == f0.shape
                 and b.labels.shape == l0.shape
                 and b.features_mask is None
                 and b.labels_mask is None
+                and bool(getattr(b, "_raw_for_device_decode", False)) == raw0
                 for b in buf
             )
 
@@ -945,22 +1008,35 @@ class SequentialModel(Model):
             self._fit_one(b)
             self._multi_iter_dev = None
 
-    def _get_step_fn_multi(self):
+    def _get_step_fn_multi(self, decode=None):
         """k optimizer steps in one program: lax.scan over the stacked
-        batch axis, same body as the single step."""
-        key = ("train_multi",)
+        batch axis, same body as the single step.  With `decode` set,
+        each scan iteration runs the lowered transform chain first —
+        raw stacked bytes in, k losses out."""
+        key = (("train_multi",) if decode is None
+               else ("train_multi_fused", decode.fingerprint))
         if key not in self._step_fns:
+            dec = None if decode is None else decode.fn
 
             @partial(jax.jit, donate_argnums=(0, 1, 2))
-            def step(params, opt_state, net_state, step_i, features_k, labels_k):
+            def step(params, opt_state, net_state, step_i, features_k,
+                     labels_k, dec_steps_k=None):
                 def one(carry, inp):
                     params, opt_state, net_state, si = carry
-                    feats, labs = inp
+                    fmask = lmask = None
+                    if dec is not None:
+                        # per-batch feed augmentation indices, not si:
+                        # see _get_step_fn's fused signature
+                        feats, labs, ds = inp
+                        feats, labs, fmask, lmask = dec(ds, feats, labs)
+                    else:
+                        feats, labs = inp
                     rng = SeedStream.fold(self._stream.root, si)
 
                     def loss_fn(p):
                         loss, new_state, _ = self._step_loss(
-                            p, net_state, feats, labs, rng=rng
+                            p, net_state, feats, labs,
+                            lmask=lmask, fmask=fmask, rng=rng,
                         )
                         return loss, new_state
 
@@ -971,10 +1047,12 @@ class SequentialModel(Model):
                     merged = {**net_state, **new_state}
                     return (params, opt_state, merged, si + 1), loss
 
+                xs = ((features_k, labels_k) if dec is None
+                      else (features_k, labels_k, dec_steps_k))
                 (params, opt_state, net_state, si), losses = jax.lax.scan(
                     one,
                     (params, opt_state, net_state, step_i),
-                    (features_k, labels_k),
+                    xs,
                 )
                 return params, opt_state, net_state, losses, si
 
@@ -1025,13 +1103,27 @@ class SequentialModel(Model):
         self._tbptt_iter_dev = None
 
     def _run_steps_grouped(self, batches: list) -> None:
+        from deeplearning4j_tpu.runtime import faults
         from deeplearning4j_tpu.runtime.crash import oom_report_scope
 
-        step = self._get_step_fn_multi()
+        decode = self._device_decode if (
+            self._device_decode is not None
+            and all(getattr(b, "_raw_for_device_decode", False)
+                    for b in batches)
+        ) else None
+        step = self._get_step_fn_multi(decode)
         k = len(batches)
         with self._observe_step(k) as obs:
             with oom_report_scope():
                 with obs.phase("host_stage"):
+                    extra = ()
+                    if decode is not None:
+                        # fused-decode host boundary (see _run_step_fused)
+                        faults.maybe_fail("data.device_decode")
+                        extra = (jnp.asarray(
+                            [getattr(b, "_decode_step", self.iteration + i)
+                             for i, b in enumerate(batches)], jnp.uint32,
+                        ),)
                     feats = jnp.stack(
                         [jnp.asarray(b.features) for b in batches]
                     )
@@ -1044,11 +1136,15 @@ class SequentialModel(Model):
                     (self.params, self.opt_state, self.net_state, losses,
                      self._multi_iter_dev) = step(
                         self.params, self.opt_state, self.net_state,
-                        self._multi_iter_dev, feats, labs,
+                        self._multi_iter_dev, feats, labs, *extra,
                     )
                 with obs.phase("device_sync"):
                     obs.sync(losses)
             self.last_batch_size = batches[-1].num_examples
+            if decode is not None:
+                self._count_device_decode(
+                    decode, batches[0].features, batches[0].labels, k=k
+                )
             # listeners span lives in _finish_grouped_steps
             self._finish_grouped_steps(losses, k)
 
@@ -1084,6 +1180,19 @@ class SequentialModel(Model):
         from deeplearning4j_tpu.parallel.data_parallel import place_batch
         from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
 
+        if (self._device_decode is not None and carries is None
+                and getattr(batch, "_raw_for_device_decode", False)):
+            if batch.features_mask is None and batch.labels_mask is None:
+                return self._run_step_fused(batch, self._device_decode)
+            # a raw batch carrying its OWN masks: the fused program
+            # cannot see them (it stages features/labels only), so
+            # decode on the host — masks thread through the chain —
+            # and fall through to the normal masked step.  (_RawFeed
+            # host-decodes masked batches itself; this is the defensive
+            # net for hand-tagged batches.)
+            batch = self._device_decode.host(
+                getattr(batch, "_decode_step", self.iteration), batch
+            )
         has_lmask = batch.labels_mask is not None
         has_fmask = batch.features_mask is not None
         with_carries = carries is not None
@@ -1122,6 +1231,46 @@ class SequentialModel(Model):
             with obs.phase("listeners"):
                 self._dispatch_iteration(loss)
         return new_carries
+
+    def _run_step_fused(self, batch: DataSet, decode) -> None:
+        """Dispatch one fused decode+train program over a raw batch:
+        the host stages undecoded bytes (smaller or cheaper transfers,
+        zero per-batch transform work) and the chain runs as the first
+        stage of the compiled step."""
+        from deeplearning4j_tpu.parallel.data_parallel import place_batch
+        from deeplearning4j_tpu.runtime import faults
+        from deeplearning4j_tpu.runtime.crash import oom_report_scope
+        from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
+
+        step = self._get_step_fn(False, False, False, decode)
+        with self._observe_step() as obs:
+            with oom_report_scope(), active_mesh_scope(
+                getattr(self, "_mesh", None)
+            ):
+                with obs.phase("host_stage"):
+                    # fault site: the fused-decode host boundary (armed
+                    # plans provoke decode-stage failures; disarmed this
+                    # is one attribute check)
+                    faults.maybe_fail("data.device_decode")
+                    feats = place_batch(self, batch.features)
+                    labs = place_batch(self, batch.labels, is_label=True)
+                with obs.phase("dispatch"):
+                    (self.params, self.opt_state, self.net_state,
+                     loss, _) = step(
+                        self.params, self.opt_state, self.net_state,
+                        jnp.uint32(self.iteration),
+                        jnp.uint32(getattr(batch, "_decode_step",
+                                           self.iteration)),
+                        feats, labs,
+                    )
+                with obs.phase("device_sync"):
+                    obs.sync(loss)
+            self._last_score = loss
+            self.last_batch_size = batch.num_examples
+            self.iteration += 1
+            self._count_device_decode(decode, feats, labs)
+            with obs.phase("listeners"):
+                self._dispatch_iteration(loss)
 
     def _fit_batch_tbptt(self, batch: DataSet) -> None:
         """Truncated BPTT: split the time axis into tbptt_length windows;

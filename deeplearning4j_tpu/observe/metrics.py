@@ -393,6 +393,21 @@ def _declare_core(reg: MetricsRegistry) -> None:
     reg.counter("dl4jtpu_prefetch_overlap_seconds_total",
                 "Producer-thread staging seconds hidden behind device "
                 "compute (stage time not re-paid as consumer wait)")
+    # device-compiled data pipeline (datavec/device.py)
+    reg.counter("dl4jtpu_device_decode_batches_total",
+                "Batches decoded inside the fused decode+step program")
+    reg.counter("dl4jtpu_device_decode_seconds_total",
+                "Device seconds attributed to the fused decode stage "
+                "(calibrated per input signature: the fused program "
+                "hides the stage, so a standalone jitted decode is "
+                "timed once per signature and charged per batch)")
+    reg.counter("dl4jtpu_device_decode_fallbacks_total",
+                "Transform chains that fell back to host application, "
+                "by reason")
+    reg.counter("dl4jtpu_h2d_bytes_total",
+                "Bytes of batch data crossing host->device, by feed "
+                "(raw=undecoded bytes for the fused decode path, "
+                "decoded=host-transformed arrays)")
     # step engine
     reg.histogram("dl4jtpu_step_latency_seconds",
                   "Host wall time per dispatched training-step program "

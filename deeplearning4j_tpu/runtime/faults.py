@@ -83,6 +83,9 @@ SITES: dict = {
                    "per-record decode failure)",
     "device.sync": "the fit loops' device_sync barrier ('delay' "
                    "simulates a wedged step under the watchdog)",
+    "data.device_decode": "the fused-decode fit paths' host boundary, "
+                          "before staging raw bytes and dispatching the "
+                          "decode+step program",
 }
 
 

@@ -44,6 +44,11 @@ class Environment:
     # batch is pulled and staged serially on the training thread, the
     # pre-pipelining behavior.
     prefetch_depth: int = 2
+    # Device-compiled data pipeline (datavec/device.py): fit() lowers an
+    # iterator's advertised transform chain into the step program and
+    # stages raw uint8 bytes instead of host-decoded floats.  Off = the
+    # advertising iterators always apply their transforms on the host.
+    device_decode: bool = True
     # Step-deadline watchdog (runtime/watchdog.py): armed around every
     # dispatched step program; deadline = max(floor, k * EWMA of recent
     # per-step latency).  Disabled = no watchdog object is created at
@@ -68,6 +73,7 @@ class Environment:
             prefetch_depth=int(
                 os.environ.get("DL4J_TPU_PREFETCH_DEPTH", "2")
             ),
+            device_decode=_env_bool("DL4J_TPU_DEVICE_DECODE", True),
             watchdog_enabled=_env_bool("DL4J_TPU_WATCHDOG", True),
             watchdog_floor_s=float(
                 os.environ.get("DL4J_TPU_WATCHDOG_FLOOR", "30")

@@ -37,3 +37,8 @@ def kw_operand_body(carry, item):
 
 def run_keyword_scan(xs):
     return jax.lax.scan(f=kw_operand_body, init=0, xs=xs)
+
+
+@device_transform                        # fused-decode body = jit scope
+def impure_device_transform(x, key):
+    return x * time.time()              # line 44: TP001

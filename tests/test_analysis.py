@@ -46,6 +46,7 @@ class TestGoldenFixtures:
             ("TP003", 17),       # global mutation in jitted body
             ("TP004", 24),       # registry() via one-level helper
             ("TP002", 34),       # print() in a keyword-passed scan body
+            ("TP001", 44),       # time.time() in a @device_transform body
         ]
         # helper findings say how the traced context reached them
         assert "telemetry_step -> bump_metrics" in got[3].message
@@ -147,7 +148,7 @@ class TestSuppressions:
 
     def test_select_filter(self):
         got = lint_fixture("tp_violations.py", select={"TP001"})
-        assert [f.rule for f in got] == ["TP001"]
+        assert [f.rule for f in got] == ["TP001", "TP001"]
 
 
 # -- baseline ----------------------------------------------------------
@@ -207,7 +208,7 @@ class TestReporters:
     def test_text_summary(self):
         findings = lint_fixture("tp_violations.py")
         text = render_text(findings, [], [], [])
-        assert "tpulint: 5 findings" in text
+        assert "tpulint: 6 findings" in text
         assert "tp_violations.py:15:" in text
         clean = render_text([], [], [], [])
         assert clean == "tpulint: clean"
@@ -288,7 +289,7 @@ class TestTier1Gate:
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",
             "checkpoint.fsync", "data.next_batch", "data.prefetch",
-            "data.decode", "device.sync",
+            "data.decode", "device.sync", "data.device_decode",
         }
         assert {"slow", "faults"} <= load_declared_marks(REPO)
 

@@ -15,6 +15,10 @@ def test_scaling_bench_runs_on_cpu_mesh():
     env.pop("XLA_FLAGS", None)
     env["BENCH_SCALING_DEVICES"] = "8"
     env["JAX_PLATFORMS"] = ""  # bench decides; avoid conftest leakage
+    # quick mode: the tier-1 gate checks the sweep RUNS and the schema
+    # holds; quick runs deliberately do not rewrite BENCH_SCALING.json
+    # (the committed table comes from a full run)
+    env["BENCH_QUICK"] = "1"
     proc = subprocess.run(
         [sys.executable, os.path.join(REPO, "bench.py"), "--scaling"],
         capture_output=True, text=True, timeout=1800, env=env, cwd=REPO,
@@ -35,6 +39,14 @@ def test_scaling_bench_runs_on_cpu_mesh():
     for r in fw:
         assert r["samples_per_sec"] > 0
         assert "mechanism_efficiency" in r
+        # device-compiled decode columns (PR 7): every row carries the
+        # fused measurement, its H2D transfer size and the calibrated
+        # decode-stage cost
+        assert r["fused"] > 0
+        assert r["h2d_mb_per_step"] > 0
+        assert r["device_decode_ms"] is not None
+        assert "fused_etl_wait_fraction" in r
+        assert "fused_speedup_vs_pipelined" in r
     assert fw[0]["mechanism_efficiency"] == 1.0
     ip = out["input_pipeline"]
     assert ip["async_feed_samples_per_sec"] > 0

@@ -1,0 +1,594 @@
+"""Device-compiled data pipeline (datavec/device.py): host-vs-device
+transform parity, chain lowering + fallback semantics, and the fused
+fit paths staging raw bytes."""
+
+import logging
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.data.dataset import DataSet
+from deeplearning4j_tpu.data.iterator import DataSetIterator
+from deeplearning4j_tpu.data.normalizers import (
+    ImagePreProcessingScaler,
+    NormalizerMinMaxScaler,
+    NormalizerStandardize,
+    NormalizingIterator,
+)
+from deeplearning4j_tpu.datavec.device import (
+    CenterCrop,
+    Custom,
+    DeviceDecode,
+    DeviceTransformIterator,
+    MeanPool,
+    MinMaxScale,
+    OneHot,
+    PadToBucket,
+    RandomCrop,
+    RandomFlip,
+    Scale,
+    Standardize,
+    TransformChain,
+    chain_of,
+    device_transform,
+    raw_feed,
+    try_lower,
+)
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn.activations import Activation
+from deeplearning4j_tpu.nn.conf import (
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.nn.updaters import Sgd
+from deeplearning4j_tpu.observe.metrics import registry
+
+RNG = np.random.default_rng(7)
+IMG_U8 = RNG.integers(0, 256, (8, 32, 32, 3)).astype(np.uint8)
+IMG_F32 = RNG.normal(0, 1, (8, 32, 32, 3)).astype(np.float32)
+IDS = RNG.integers(0, 5, 8)
+
+
+def device_vs_host(chain, feats, labs, step=3):
+    dec = DeviceDecode(chain)
+    host = dec.host(step, DataSet(np.asarray(feats), np.asarray(labs)))
+    df, dl, dfm, dlm = jax.jit(dec.fn)(jnp.uint32(step), feats, labs)
+    return host, (np.asarray(df), np.asarray(dl),
+                  None if dfm is None else np.asarray(dfm),
+                  None if dlm is None else np.asarray(dlm))
+
+
+def assert_parity(chain, feats, labs, step=3):
+    host, (df, dl, dfm, dlm) = device_vs_host(chain, feats, labs, step)
+    np.testing.assert_allclose(df, host.features, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(dl, host.labels, rtol=1e-6, atol=1e-6)
+    if host.features_mask is None:
+        assert dfm is None
+    else:
+        np.testing.assert_allclose(dfm, host.features_mask,
+                                   rtol=1e-6, atol=1e-6)
+    if host.labels_mask is None:
+        assert dlm is None
+    else:
+        np.testing.assert_allclose(dlm, host.labels_mask,
+                                   rtol=1e-6, atol=1e-6)
+
+
+class TestParity:
+    """Every lowered transform must produce numerically matching host
+    and device outputs (1e-6 f32 tolerance; random transforms draw the
+    same stream from the same fixed key)."""
+
+    @pytest.mark.parametrize("feats", [IMG_U8, IMG_F32],
+                             ids=["uint8", "f32"])
+    def test_scale(self, feats):
+        assert_parity(TransformChain((Scale(1 / 127.5, -1.0),),
+                                     (OneHot(5),)), feats, IDS)
+
+    @pytest.mark.parametrize("feats", [IMG_U8, IMG_F32],
+                             ids=["uint8", "f32"])
+    def test_standardize(self, feats):
+        mean = np.float32([100.0, 120.0, 90.0])
+        std = np.float32([40.0, 35.0, 50.0])
+        assert_parity(TransformChain((Standardize(mean, std),), ()),
+                      feats, IDS)
+
+    @pytest.mark.parametrize("feats", [IMG_U8, IMG_F32],
+                             ids=["uint8", "f32"])
+    def test_minmax(self, feats):
+        mn = np.zeros(3, np.float32)
+        mx = np.full(3, 255.0, np.float32)
+        assert_parity(TransformChain((MinMaxScale(mn, mx, -1, 1),), ()),
+                      feats, IDS)
+
+    @pytest.mark.parametrize("feats", [IMG_U8, IMG_F32],
+                             ids=["uint8", "f32"])
+    def test_crop_flip_fixed_key(self, feats):
+        chain = TransformChain(
+            (RandomCrop(24, 24), RandomFlip(0.5), CenterCrop(16, 16)),
+            (OneHot(5),), seed=11,
+        )
+        assert_parity(chain, feats, IDS, step=5)
+
+    def test_random_transforms_vary_by_step_not_by_path(self):
+        chain = TransformChain((RandomCrop(24, 24), RandomFlip(0.5)),
+                               (), seed=11)
+        dec = DeviceDecode(chain)
+        a = np.asarray(jax.jit(dec.fn)(jnp.uint32(1), IMG_U8, IDS)[0])
+        b = np.asarray(jax.jit(dec.fn)(jnp.uint32(2), IMG_U8, IDS)[0])
+        assert not np.array_equal(a, b)   # per-step augmentation stream
+        h = dec.host(1, DataSet(IMG_U8, IDS)).features
+        np.testing.assert_array_equal(a, h)   # same step = same draw
+
+    def test_mean_pool_resize(self):
+        chain = TransformChain(
+            (Scale(1 / 127.5, -1.0),
+             MeanPool((8, 8), collapse_channels=True)),
+            (OneHot(5),),
+        )
+        assert_parity(chain, IMG_U8, IDS)
+        host, (df, _, _, _) = device_vs_host(chain, IMG_U8, IDS)
+        assert df.shape == (8, 4, 4, 1)
+
+    def test_one_hot(self):
+        host, (_, dl, _, _) = device_vs_host(
+            TransformChain((), (OneHot(5),)), IMG_U8, IDS
+        )
+        assert dl.shape == (8, 5)
+        np.testing.assert_array_equal(dl, np.eye(5, dtype=np.float32)[IDS])
+
+    def test_sequence_pad_and_mask(self):
+        seq = RNG.normal(0, 1, (4, 37, 6)).astype(np.float32)
+        seq_labels = RNG.normal(0, 1, (4, 37, 2)).astype(np.float32)
+        chain = TransformChain((PadToBucket(16),), (PadToBucket(16),))
+        host, (df, dl, dfm, dlm) = device_vs_host(chain, seq, seq_labels)
+        assert df.shape == (4, 48, 6) and dl.shape == (4, 48, 2)
+        assert dfm.shape == (4, 48) and dlm.shape == (4, 48)
+        np.testing.assert_array_equal(dfm[:, :37], 1.0)
+        np.testing.assert_array_equal(dfm[:, 37:], 0.0)
+        np.testing.assert_array_equal(df, host.features)
+        np.testing.assert_array_equal(dfm, host.features_mask)
+
+    def test_pad_aligned_length_is_identity(self):
+        seq = RNG.normal(0, 1, (2, 32, 3)).astype(np.float32)
+        chain = TransformChain((PadToBucket(16),), ())
+        _, (df, _, dfm, _) = device_vs_host(chain, seq, IDS[:2])
+        assert df.shape == (2, 32, 3)
+        np.testing.assert_array_equal(dfm, 1.0)
+
+    def test_marked_custom_transform_lowers_and_matches(self):
+        @device_transform
+        def double(x, key):
+            return x.astype(jnp.float32) * 2.0
+
+        chain = TransformChain((Custom(double),), ())
+        dec, reason = try_lower(chain)
+        assert dec is not None, reason
+        assert_parity(chain, IMG_F32, IDS)
+
+
+class TestLowering:
+    def test_unmarked_custom_refuses_with_reason(self):
+        def opaque(x, key):
+            return x
+
+        dec, reason = try_lower(TransformChain((Custom(opaque),), ()))
+        assert dec is None
+        assert "not marked @device_transform" in reason
+
+    def test_unknown_spec_type_refuses(self):
+        dec, reason = try_lower(TransformChain(("not a transform",), ()))
+        assert dec is None
+        assert "unknown transform" in reason
+
+    def test_fingerprint_distinguishes_custom_closures(self):
+        # two closures from the same factory share a qualname but
+        # capture different values — their fingerprints must differ, or
+        # the fused step-fn cache would replay the first one's program
+        def make(c):
+            @device_transform
+            def adjust(x, key):
+                return x * c
+
+            return adjust
+
+        a = TransformChain((Custom(make(0.5)),), ())
+        b = TransformChain((Custom(make(0.9)),), ())
+        assert a.fingerprint() != b.fingerprint()
+        f = make(0.5)
+        assert (TransformChain((Custom(f),), ()).fingerprint()
+                == TransformChain((Custom(f),), ()).fingerprint())
+
+    def test_fingerprint_distinguishes_stats(self):
+        a = TransformChain((Standardize(np.float32([1.0]),
+                                        np.float32([2.0])),), ())
+        b = TransformChain((Standardize(np.float32([1.5]),
+                                        np.float32([2.0])),), ())
+        assert a.fingerprint() != b.fingerprint()
+
+    def test_normalizers_advertise_their_lowering(self):
+        std = NormalizerStandardize()
+        assert std.device_spec() is None          # not fitted
+        std.mean = np.float32([1.0])
+        std.std = np.float32([2.0])
+        assert isinstance(std.device_spec(), Standardize)
+        mm = NormalizerMinMaxScaler()
+        mm.min, mm.max = np.float32([0.0]), np.float32([1.0])
+        assert isinstance(mm.device_spec(), MinMaxScale)
+        assert isinstance(ImagePreProcessingScaler().device_spec(), Scale)
+
+
+class _RawImageFeed(DataSetIterator):
+    """Undecoded camera-wire batches: uint8 images + int class ids."""
+
+    def __init__(self, n_batches=6, batch=16, hw=(16, 16, 3), n_cls=3):
+        rng = np.random.default_rng(3)
+        self._n, self._b = n_batches, batch
+        self._x = rng.integers(
+            0, 256, (n_batches * batch,) + hw
+        ).astype(np.uint8)
+        self._y = rng.integers(0, n_cls, n_batches * batch)
+
+    @property
+    def batch_size(self):
+        return self._b
+
+    def reset(self):
+        pass
+
+    def __iter__(self):
+        for i in range(self._n):
+            sl = slice(i * self._b, (i + 1) * self._b)
+            yield DataSet(self._x[sl], self._y[sl])
+
+
+def _mlp(n_in, n_cls=3, seed=5):
+    conf = (
+        NeuralNetConfiguration.builder().seed(seed).updater(Sgd(0.05))
+        .list()
+        .layer(Dense(n_out=16, activation=Activation.RELU))
+        .layer(OutputLayer(n_out=n_cls, loss=Loss.MCXENT,
+                           activation=Activation.SOFTMAX))
+        .set_input_type(InputType.convolutional(*n_in))
+        .build()
+    )
+    return SequentialModel(conf).init()
+
+
+CHAIN = TransformChain(
+    (Scale(1 / 127.5, -1.0), MeanPool((4, 4), collapse_channels=True)),
+    (OneHot(3),),
+)
+
+
+class TestFusedFit:
+    def test_iterator_protocol(self):
+        it = DeviceTransformIterator(_RawImageFeed(), CHAIN)
+        assert chain_of(it) is CHAIN
+        raw = raw_feed(it)
+        batches = list(raw)
+        assert len(batches) == 6
+        assert all(b._raw_for_device_decode for b in batches)
+        assert batches[0].features.dtype == np.uint8
+        # the host path decodes
+        host = next(iter(it))
+        assert host.features.shape == (16, 4, 4, 1)
+        assert host.labels.shape == (16, 3)
+
+    def test_fused_fit_stages_raw_and_counts(self):
+        reg = registry()
+        dec_batches = reg.counter("dl4jtpu_device_decode_batches_total")
+        dec_secs = reg.counter("dl4jtpu_device_decode_seconds_total")
+        h2d_raw = reg.counter("dl4jtpu_h2d_bytes_total")
+        b0, s0 = dec_batches.value(), dec_secs.value()
+        r0 = h2d_raw.value(feed="raw")
+        m = _mlp((4, 4, 1))
+        m.fit(DeviceTransformIterator(_RawImageFeed(), CHAIN), epochs=2)
+        assert m.iteration == 12
+        assert np.isfinite(m.score_value)
+        assert dec_batches.value() - b0 == 12
+        assert dec_secs.value() > s0
+        # 12 raw uint8 batches crossed H2D: 16 * 16*16*3 u8 + 16 * 8B ids
+        assert h2d_raw.value(feed="raw") - r0 >= 12 * 16 * 16 * 16 * 3
+
+    def test_fused_matches_host_path_loss(self):
+        # identical feed, transforms on device vs on host: same shapes,
+        # comparable converged loss (no augmentation in this chain, so
+        # the two runs see byte-identical decoded batches)
+        from deeplearning4j_tpu.runtime.flags import environment
+
+        m_dev = _mlp((4, 4, 1))
+        m_dev.fit(DeviceTransformIterator(_RawImageFeed(), CHAIN),
+                  epochs=2)
+        env = environment()
+        env.device_decode = False
+        try:
+            m_host = _mlp((4, 4, 1))
+            m_host.fit(DeviceTransformIterator(_RawImageFeed(), CHAIN),
+                       epochs=2)
+        finally:
+            env.device_decode = True
+        np.testing.assert_allclose(
+            float(m_dev.score_value), float(m_host.score_value),
+            rtol=1e-4, atol=1e-5,
+        )
+
+    def test_grouped_fused_fit(self):
+        reg = registry()
+        dec_batches = reg.counter("dl4jtpu_device_decode_batches_total")
+        b0 = dec_batches.value()
+        m = _mlp((4, 4, 1))
+        m.fit(DeviceTransformIterator(_RawImageFeed(), CHAIN),
+              epochs=1, steps_per_execution=3)
+        assert m.iteration == 6
+        assert dec_batches.value() - b0 == 6
+        # the grouped fused program is ONE compiled step program
+        assert m.compile_stats()["step_programs"] <= 2
+
+    def test_unlowerable_chain_falls_back_and_logs(self, caplog):
+        def opaque(x, key):
+            return np.asarray(x, np.float32) / 255.0
+
+        chain = TransformChain((Custom(opaque), MeanPool((4, 4),
+                                                         True)),
+                               (OneHot(3),))
+        reg = registry()
+        fallbacks = reg.counter("dl4jtpu_device_decode_fallbacks_total")
+        dec_batches = reg.counter("dl4jtpu_device_decode_batches_total")
+        b0 = dec_batches.value()
+        m = _mlp((4, 4, 1))
+        with caplog.at_level(logging.INFO, logger="deeplearning4j_tpu"):
+            m.fit(DeviceTransformIterator(_RawImageFeed(), chain),
+                  epochs=1)
+        assert m.iteration == 6                  # host path still trains
+        assert dec_batches.value() == b0         # nothing fused
+        _, reason = try_lower(chain)
+        assert "not marked @device_transform" in reason
+        assert fallbacks.value(reason=reason) >= 1
+        assert any("device decode fallback" in r.message
+                   for r in caplog.records)
+
+    def test_flag_off_keeps_host_path(self):
+        from deeplearning4j_tpu.runtime.flags import environment
+
+        reg = registry()
+        dec_batches = reg.counter("dl4jtpu_device_decode_batches_total")
+        b0 = dec_batches.value()
+        env = environment()
+        env.device_decode = False
+        try:
+            m = _mlp((4, 4, 1))
+            m.fit(DeviceTransformIterator(_RawImageFeed(), CHAIN),
+                  epochs=1)
+        finally:
+            env.device_decode = True
+        assert m.iteration == 6
+        assert dec_batches.value() == b0
+
+    def test_normalizing_iterator_fuses(self):
+        rng = np.random.default_rng(1)
+        x = rng.normal(0, 1, (64, 8)).astype(np.float32)
+        y = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 64)]
+
+        class Feed(DataSetIterator):
+            @property
+            def batch_size(self):
+                return 16
+
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                for b in DataSet(x, y).split_batches(16):
+                    yield b
+
+        norm = NormalizerStandardize().fit(Feed())
+        reg = registry()
+        dec_batches = reg.counter("dl4jtpu_device_decode_batches_total")
+        b0 = dec_batches.value()
+        conf = (
+            NeuralNetConfiguration.builder().seed(5).updater(Sgd(0.05))
+            .list()
+            .layer(Dense(n_out=8, activation=Activation.RELU))
+            .layer(OutputLayer(n_out=3, loss=Loss.MCXENT,
+                               activation=Activation.SOFTMAX))
+            .set_input_type(InputType.feed_forward(8))
+            .build()
+        )
+        m = SequentialModel(conf).init()
+        m.fit(NormalizingIterator(Feed(), norm), epochs=1)
+        assert m.iteration == 4
+        assert dec_batches.value() - b0 == 4
+
+
+class TestMaskedAndFrozenBatches:
+    def test_host_application_threads_batch_masks_through(self):
+        # the host path must hand the fit loop what the pre-chain
+        # iterator stack would have: batch masks preserved (and
+        # extended by mask-producing specs), not dropped
+        seq = RNG.normal(0, 1, (4, 37, 6)).astype(np.float32)
+        labs = RNG.normal(0, 1, (4, 37, 2)).astype(np.float32)
+        fmask = np.ones((4, 37), np.float32)
+        fmask[:, 30:] = 0.0
+        dec = DeviceDecode(TransformChain((Scale(2.0),), ()))
+        out = dec.host(0, DataSet(seq, labs, fmask, None))
+        np.testing.assert_array_equal(out.features_mask, fmask)
+        # a padding spec EXTENDS the incoming mask
+        dec2 = DeviceDecode(TransformChain((PadToBucket(16),), ()))
+        out2 = dec2.host(0, DataSet(seq, labs, fmask, None))
+        assert out2.features_mask.shape == (4, 48)
+        np.testing.assert_array_equal(out2.features_mask[:, :37], fmask)
+        np.testing.assert_array_equal(out2.features_mask[:, 37:], 0.0)
+
+    def test_masked_raw_batch_declines_fusion_and_keeps_masks(self):
+        # raw batches carrying their own masks cannot fuse (the fused
+        # program stages features/labels only): the raw feed
+        # host-decodes them while still numpy — a tagged masked batch
+        # would be prefetch-staged to the device raw and pay a hidden
+        # D2H for its per-step decode, with its bytes misattributed to
+        # the raw-feed H2D series
+        class MaskedRawFeed(_RawImageFeed):
+            def __iter__(self):
+                for b in super().__iter__():
+                    yield DataSet(b.features, b.labels,
+                                  None,
+                                  np.ones(b.num_examples, np.float32))
+
+        reg = registry()
+        dec_batches = reg.counter("dl4jtpu_device_decode_batches_total")
+        h2d = reg.counter("dl4jtpu_h2d_bytes_total")
+        b0 = dec_batches.value()
+        r0 = h2d.value(feed="raw")
+        m = _mlp((4, 4, 1))
+        m.fit(DeviceTransformIterator(MaskedRawFeed(), CHAIN), epochs=1)
+        assert m.iteration == 6
+        assert np.isfinite(m.score_value)
+        assert dec_batches.value() == b0          # nothing fused
+        assert h2d.value(feed="raw") == r0        # no bytes fed raw
+
+    def test_augment_keys_follow_feed_counter_not_iteration(self):
+        # the fused program folds augmentation keys from the feed's
+        # counter (batch._decode_step), NOT model.iteration: an
+        # evaluate() between fits advances only the feed counter, so
+        # keying off iteration would desync the fused path from the
+        # host fallback and break the flag's numerics-neutrality
+        aug_chain = TransformChain(
+            (Scale(1 / 127.5, -1.0), RandomFlip(0.5),
+             MeanPool((4, 4), collapse_channels=True)),
+            (OneHot(3),), seed=9,
+        )
+        from deeplearning4j_tpu.runtime.flags import environment
+
+        def run(device_decode):
+            env = environment()
+            env.device_decode = device_decode
+            try:
+                it = DeviceTransformIterator(_RawImageFeed(), aug_chain)
+                m = _mlp((4, 4, 1))
+                m.fit(it, epochs=1)      # feed counter 0..5
+                m.evaluate(it)           # host pass: counter 6..11
+                m.fit(it, epochs=1)      # second fit draws keys 12..17
+                return float(m.score_value)
+            finally:
+                env.device_decode = True
+
+        np.testing.assert_allclose(run(True), run(False),
+                                   rtol=1e-4, atol=1e-5)
+
+    def test_mixed_tag_group_degrades_to_per_batch(self):
+        # a grouped (steps_per_execution) buffer mixing raw-tagged
+        # DataSets with host-decoded foreign batches must NOT dispatch
+        # the grouped program — it would stack the tagged batches'
+        # undecoded bytes into the loss.  The group degrades to
+        # per-batch steps, where every raw batch is decoded (fused).
+        class SlottedDS:
+            __slots__ = ("features", "labels", "features_mask",
+                         "labels_mask")
+
+            def __init__(self, f, l):
+                self.features, self.labels = f, l
+                self.features_mask = self.labels_mask = None
+
+            @property
+            def num_examples(self):
+                return int(self.features.shape[0])
+
+        # shape/dtype-preserving chain + pre-one-hot labels: raw f32
+        # and host-decoded f32 batches look identical to the group's
+        # shape checks, only the raw tag tells them apart
+        chain = TransformChain((Scale(2.0, 0.0),), ())
+
+        class MixedFeed(DataSetIterator):
+            @property
+            def batch_size(self):
+                return 8
+
+            def reset(self):
+                pass
+
+            def __iter__(self):
+                rng = np.random.default_rng(11)
+                for i in range(4):
+                    f = rng.normal(0, 1, (8, 4, 4, 1)).astype(np.float32)
+                    l = np.eye(3, dtype=np.float32)[rng.integers(0, 3, 8)]
+                    yield (SlottedDS(f, l) if i % 2 else DataSet(f, l))
+
+        reg = registry()
+        dec_batches = reg.counter("dl4jtpu_device_decode_batches_total")
+        b0 = dec_batches.value()
+        m = _mlp((4, 4, 1))
+        m.fit(DeviceTransformIterator(MixedFeed(), chain), epochs=1,
+              steps_per_execution=4)
+        assert m.iteration == 4
+        assert np.isfinite(m.score_value)
+        # the 2 raw-tagged batches were decoded per-batch (fused),
+        # never handed undecoded to the grouped program
+        assert dec_batches.value() - b0 == 2
+
+    def test_normalizing_iterator_chain_is_stable(self):
+        # device_chain must hand back the SAME chain object across
+        # accesses: try_lower memoizes the lowering (and its decode
+        # calibration) ON the chain, so a fresh chain per access would
+        # re-pay the calibration on every fit.  Re-parameterizing the
+        # normalizer changes the spec fingerprint and invalidates.
+        norm = ImagePreProcessingScaler(0.0, 1.0)
+        it = NormalizingIterator(_RawImageFeed(), norm)
+        c1 = it.device_chain
+        assert it.device_chain is c1
+        d1, _ = try_lower(c1)
+        d2, _ = try_lower(it.device_chain)
+        assert d1 is d2
+        norm.lo = 0.5
+        assert it.device_chain is not c1
+
+    def test_untaggable_raw_batch_is_host_decoded_not_fed_raw(self):
+        # a slotted batch type cannot carry the routing tag — the raw
+        # feed must host-decode it, never hand undecoded bytes to the
+        # non-fused step
+        class SlottedDS:
+            __slots__ = ("features", "labels", "features_mask",
+                         "labels_mask")
+
+            def __init__(self, f, l):
+                self.features, self.labels = f, l
+                self.features_mask = self.labels_mask = None
+
+            @property
+            def num_examples(self):
+                return int(self.features.shape[0])
+
+        class FrozenRawFeed(_RawImageFeed):
+            def __iter__(self):
+                for b in super().__iter__():
+                    yield SlottedDS(b.features, b.labels)
+
+        reg = registry()
+        dec_batches = reg.counter("dl4jtpu_device_decode_batches_total")
+        b0 = dec_batches.value()
+        m = _mlp((4, 4, 1))
+        m.fit(DeviceTransformIterator(FrozenRawFeed(), CHAIN), epochs=1)
+        assert m.iteration == 6
+        assert np.isfinite(m.score_value)
+        assert dec_batches.value() == b0          # nothing fused
+
+
+@pytest.mark.faults
+class TestFaultSite:
+    def test_device_decode_fault_site_fires(self):
+        from deeplearning4j_tpu.runtime import faults
+
+        faults.arm("data.device_decode:raise:nth=2")
+        try:
+            m = _mlp((4, 4, 1))
+            with pytest.raises(faults.InjectedFault):
+                m.fit(DeviceTransformIterator(_RawImageFeed(), CHAIN),
+                      epochs=1)
+            assert m.iteration == 1      # step 1 trained, step 2 raised
+        finally:
+            faults.disarm()

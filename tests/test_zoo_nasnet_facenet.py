@@ -166,6 +166,10 @@ def test_center_loss_evaluate_uses_logits_half():
         .build()
     )
     m = SequentialModel(conf).init()
-    m.fit((x, y), epochs=25, batch_size=64)
+    m.fit((x, y), epochs=40, batch_size=64)
     acc = m.evaluate(DataSet(x, y)).accuracy()
-    assert acc > 0.95, acc
+    # argmax over the raw concat (logits ++ embedding) scores near
+    # chance on this 2-class task; the logits half scores near-perfect.
+    # 0.9 discriminates the bug with margin — the old 0.95 bound sat
+    # within training noise of the converged accuracy and flaked.
+    assert acc > 0.9, acc
