@@ -409,6 +409,40 @@ def _metrics_snapshot():
         return None
 
 
+def _env_provenance():
+    """Environment identity stamped into every bench row so the perf
+    trajectory stays comparable across regenerations: jax/jaxlib
+    versions, the devices the numbers came from, and the runtime flags
+    that change the measured path."""
+    try:
+        import jax
+        import jaxlib
+
+        from deeplearning4j_tpu.runtime.flags import environment
+        from deeplearning4j_tpu.version import __version__
+
+        devs = jax.devices()
+        env = environment()
+        return {
+            "version": __version__,
+            "jax": jax.__version__,
+            "jaxlib": jaxlib.__version__,
+            "platform": devs[0].platform,
+            "device_kind": str(getattr(devs[0], "device_kind", "")),
+            "device_count": len(devs),
+            "flags": {
+                "bf16_compute": env.use_bfloat16_compute,
+                "sequence_bucket_size": env.sequence_bucket_size,
+                "prefetch_depth": env.prefetch_depth,
+                "device_decode": env.device_decode,
+                "watchdog_enabled": env.watchdog_enabled,
+            },
+        }
+    except Exception as e:
+        # provenance is evidence, never a bench failure
+        return {"error": f"{type(e).__name__}: {e}"}
+
+
 def _entry(name, sps, fwd_flops_per_example, peak, batch, note=None,
            timing=None, **extra):
     train_flops = 3.0 * fwd_flops_per_example if fwd_flops_per_example else None
@@ -425,6 +459,7 @@ def _entry(name, sps, fwd_flops_per_example, peak, batch, note=None,
         "train_flops_per_example_est": train_flops,
         "mfu_vs_bf16_peak": mfu,
         "metrics": _metrics_snapshot(),
+        "env": _env_provenance(),
     }
     if timing:
         e["timing"] = timing
@@ -1265,6 +1300,22 @@ def bench_scaling() -> None:
         h2d_bytes = (h2d.value(feed="raw") + h2d.value(feed="decoded")
                      - h0)
         dec_n = dec_batches.value() - b0
+        # performance attribution (observe/cost.py): the train program's
+        # XLA-analyzed model FLOPs, the MFU that throughput achieves
+        # against the n-device peak, and the program's roofline class
+        from deeplearning4j_tpu.observe import cost as _cost
+
+        flops = mfu = roofline = None
+        train_recs = [r for r in _cost.analyze_model(model)
+                      if r.kind.startswith("train")]
+        if train_recs:
+            rec = max(train_recs, key=lambda r: r.dispatches)
+            flops = rec.flops
+            roofline = rec.roofline()
+            if flops and bps:
+                pk_f, _pk_b = _cost.peaks()
+                per_dev = pk_f / max(1, _jax.local_device_count())
+                mfu = round(flops * bps / (per_dev * n), 4)
         return {
             "samples_per_sec": round(sps, 1),
             "step_latency_ms": round(1000.0 / bps, 3) if bps else None,
@@ -1274,6 +1325,9 @@ def bench_scaling() -> None:
                 round((dec_secs.value() - s0) / dec_n * 1000.0, 3)
                 if dec_n else None
             ),
+            "model_flops_per_step": flops,
+            "mfu": mfu,
+            "roofline": roofline,
         }
 
     for r in fixed_rows:
@@ -1304,6 +1358,11 @@ def bench_scaling() -> None:
         r["h2d_mb_per_step"] = fused["h2d_mb_per_step"]
         r["h2d_mb_per_step_host_decoded"] = piped["h2d_mb_per_step"]
         r["device_decode_ms"] = fused["device_decode_ms"]
+        # where the FLOPs go: the train program's XLA model FLOPs, the
+        # MFU the pipelined row achieves, and its roofline class
+        r["model_flops_per_step"] = piped["model_flops_per_step"]
+        r["mfu"] = piped["mfu"]
+        r["roofline"] = piped["roofline"]
         print(f"[scaling pipelined] devices={n} "
               f"pipelined={r['pipelined']} serial={r['serial_fit']} "
               f"speedup={r['pipelined_speedup']} fused={r['fused']} "
@@ -1328,7 +1387,12 @@ def bench_scaling() -> None:
     step_rate = rows[-1]["samples_per_sec"]
 
     out = {
+        # schema 2 (ISSUE 8): fixed-work rows grew model_flops_per_step /
+        # mfu / roofline (XLA cost analysis via observe/cost.py) and the
+        # document carries environment provenance
+        "schema": "bench-scaling/2",
         "metric": "DP scaling: per-chip samples/sec at 1..N devices",
+        "env": _env_provenance(),
         "note": None if on_tpu else (
             "virtual CPU devices share one host's cores, so per-chip rate "
             "FALLS with n — this run validates the distribute()/GSPMD "
@@ -1365,6 +1429,16 @@ def bench_scaling() -> None:
             "no spare core; device_decode_ms is the calibrated "
             "standalone cost of the decode stage, h2d_mb_per_step the "
             "raw-byte transfer vs h2d_mb_per_step_host_decoded"
+        ),
+        "flops_note": (
+            "model_flops_per_step is the train step program's XLA "
+            "cost_analysis flops (forward + param grads + updater; "
+            "dead-coded input grads excluded by XLA); mfu is the "
+            "pipelined row's achieved FLOP/s over the n-device peak "
+            "from observe/cost.py's per-backend table (CPU peak is a "
+            "nominal — override DL4J_TPU_PEAK_FLOPS); roofline "
+            "classifies the program's arithmetic intensity against the "
+            "machine ridge point"
         ),
         "warmup_steps": WARMUP_STEPS,
         "input_pipeline": {

@@ -524,7 +524,15 @@ class DeviceDecode:
         if self._jit_fn is None:
             import jax
 
-            self._jit_fn = jax.jit(self.fn)
+            from deeplearning4j_tpu.observe import cost
+
+            # the standalone lowered decode joins the compiled-program
+            # registry too (kind="decode"), so /api/programs attributes
+            # the decode stage's FLOPs/bytes next to the step programs
+            self._jit_fn = cost.register_attr_program(
+                self, "_jit_fn", "decode", ("decode", self.fingerprint),
+                jax.jit(self.fn),
+            )
         return self._jit_fn
 
     def calibrated_seconds(self, feats, labs) -> float:

@@ -251,7 +251,7 @@ class GraphModel(Model):
                     return core(params, opt_state, net_state, step_i,
                                 (feats,), (labs,), (lmask,))
 
-            self._step_fns[key] = step
+            self._step_fns[key] = self._register_program(key, step)
         return self._step_fns[key]
 
     def _fit_batch_fused(self, batch: DataSet, decode) -> None:
@@ -451,7 +451,7 @@ class GraphModel(Model):
                 )
                 return params, opt_state, net_state, losses, si
 
-            self._step_fns[key] = step
+            self._step_fns[key] = self._register_program(key, step)
         return self._step_fns[key]
 
     def _run_steps_grouped(self, group) -> None:
@@ -698,7 +698,11 @@ class GraphModel(Model):
                     result.append(act(outs[oname].astype(jnp.float32)))
                 return tuple(result)
 
-            self._infer_fn = infer
+            from deeplearning4j_tpu.observe import cost
+
+            self._infer_fn = cost.register_attr_program(
+                self, "_infer_fn", "infer", ("infer",), infer
+            )
         return self._infer_fn
 
     def output(self, *features) -> tuple[jax.Array, ...]:

@@ -198,6 +198,10 @@ class Model:
         # (recovery resets them after a rollback rewinds `iteration`)
         self._multi_iter_dev = None
         self._tbptt_iter_dev = None
+        # performance attribution: the cost-registry record of the last
+        # program this model dispatched (set by the registration wrapper
+        # during the call; StepScope.sync() snapshots it)
+        self._cost_program = None
         from deeplearning4j_tpu.runtime import compile_stats as _cs
 
         self._compile_snap = _cs.snapshot()   # baseline at model creation
@@ -508,6 +512,16 @@ class Model:
                             "or snapshot via train.listeners."
                             "_host_snapshot."
                         )
+
+    def _register_program(self, key, fn):
+        """Register a freshly built step program with the cost registry
+        (observe/cost.py) and return the instrumented wrapper the
+        builder caches in ``_step_fns``.  The registry entry lives
+        exactly as long as the cache entry — ``_step_fns.clear()``
+        (recovery's LR retrace, re-distribute) evicts it."""
+        from deeplearning4j_tpu.observe import cost
+
+        return cost.register_step_program(self, key, fn)
 
     def compile_stats(self) -> dict:
         """Compile-tax counters since this model was constructed, plus

@@ -397,7 +397,7 @@ class SequentialModel(Model):
                 merged_state = {**net_state, **st_pre}
                 return params, opt_state, merged_state, loss
 
-            self._step_fns[key] = step
+            self._step_fns[key] = self._register_program(key, step)
         return self._step_fns[key]
 
     def _run_step_1f1b(self, batch: DataSet) -> None:
@@ -577,7 +577,7 @@ class SequentialModel(Model):
                     return core(params, opt_state, net_state, step_i,
                                 feats, labs, lm, fm, {})
 
-            self._step_fns[key] = step
+            self._step_fns[key] = self._register_program(key, step)
         return self._step_fns[key]
 
     def _fused_decode_reason(self) -> str | None:
@@ -671,7 +671,7 @@ class SequentialModel(Model):
                 )
                 return params, opt_state, net_state, losses, carries, si
 
-            self._step_fns[key] = step
+            self._step_fns[key] = self._register_program(key, step)
         return self._step_fns[key]
 
     def _get_step_fn_tbptt_grouped(self):
@@ -752,7 +752,7 @@ class SequentialModel(Model):
                 )
                 return params, opt_state, net_state, losses.reshape(-1), si
 
-            self._step_fns[key] = step
+            self._step_fns[key] = self._register_program(key, step)
         return self._step_fns[key]
 
     # -- compressed-gradient DP step (int8 allreduce over the data axis) ---
@@ -849,7 +849,7 @@ class SequentialModel(Model):
                 )(params, opt_state, net_state, resid, step_i,
                   features, labels, lmask, fmask)
 
-            self._step_fns[key] = step
+            self._step_fns[key] = self._register_program(key, step)
         return self._step_fns[key]
 
     def _run_step_compressed(self, batch: DataSet):
@@ -1056,7 +1056,7 @@ class SequentialModel(Model):
                 )
                 return params, opt_state, net_state, losses, si
 
-            self._step_fns[key] = step
+            self._step_fns[key] = self._register_program(key, step)
         return self._step_fns[key]
 
     def _run_steps_grouped_tbptt(self, batches: list) -> None:
@@ -1461,7 +1461,7 @@ class SequentialModel(Model):
                 )
                 return self._out_activation(out.astype(jnp.float32))
 
-            self._step_fns[key] = infer
+            self._step_fns[key] = self._register_program(key, infer)
         return self._step_fns[key]
 
     def output(self, features, features_mask=None) -> jax.Array:
@@ -1517,7 +1517,7 @@ class SequentialModel(Model):
                 )
                 return self._out_activation(out.astype(jnp.float32)), new_carries
 
-            self._step_fns[key] = rnn_step
+            self._step_fns[key] = self._register_program(key, rnn_step)
         out, self._rnn_stream_state = self._step_fns[key](
             self.params, self.net_state, jnp.asarray(features), self._rnn_stream_state
         )

@@ -18,6 +18,15 @@ lazily and only where a signal actually comes from a device):
   all-finite + global-norm reduction over params at a configurable
   cadence; divergence events are counted, logged structurally, and
   routed into `runtime/crash.py`'s report writer.
+- `observe.cost`: performance attribution — the compiled-program
+  registry (every jitted step/decode/eval program registered at build
+  time), lazy XLA cost/memory analysis, and per-step MFU / roofline
+  gauges against a per-backend peak table.  `UIServer` serves the
+  program table at ``GET /api/programs``.
+- `observe.fleet`: fleet-wide aggregation — elastic workers push
+  registry snapshots + traces to the coordinator, which serves a merged
+  worker-labeled ``/metrics/cluster``, per-worker skew/straggler
+  gauges, and one merged cluster timeline at ``GET /api/trace/cluster``.
 
     from deeplearning4j_tpu.observe import registry, tracer, HealthListener
 
@@ -38,6 +47,7 @@ from deeplearning4j_tpu.observe.metrics import (
 from deeplearning4j_tpu.observe.trace import (
     StepScope,
     TraceRecorder,
+    merge_chrome_traces,
     step_scope,
     tracer,
 )
@@ -51,6 +61,7 @@ __all__ = [
     "MetricsRegistry",
     "StepScope",
     "TraceRecorder",
+    "merge_chrome_traces",
     "registry",
     "step_scope",
     "tracer",

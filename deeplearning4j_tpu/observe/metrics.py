@@ -357,6 +357,7 @@ def registry() -> MetricsRegistry:
             _declare_core(reg)
             reg.register_collector(_compile_stats_collector)
             reg.register_collector(_device_memory_collector)
+            reg.register_collector(_build_info_collector)
             _REGISTRY = reg
     return _REGISTRY
 
@@ -461,6 +462,52 @@ def _declare_core(reg: MetricsRegistry) -> None:
     reg.gauge("dl4jtpu_recovery_lr_scale",
               "Cumulative LR backoff factor applied by the active "
               "RecoveryPolicy (1.0 = no rollback yet)")
+    # performance attribution (observe/cost.py): per-step derivations
+    # from the compiled-program registry's XLA cost analysis.  The
+    # gauges stay unset until a program has been cost-analyzed
+    # (/api/programs, bench --scaling, cost.analyze_model).
+    reg.counter("dl4jtpu_step_model_flops_total",
+                "Model FLOPs executed by dispatched step programs "
+                "(program cost_analysis flops x optimizer steps per "
+                "dispatch — XLA counts a scanned group's body once)")
+    reg.gauge("dl4jtpu_step_achieved_flops_per_sec",
+              "Last dispatched program's model FLOPs / host wall "
+              "seconds")
+    reg.gauge("dl4jtpu_step_mfu",
+              "Last step's achieved FLOP/s over the backend peak table "
+              "(DL4J_TPU_PEAK_FLOPS override; CPU peak is a rough "
+              "nominal)")
+    reg.gauge("dl4jtpu_step_bytes_per_sec",
+              "Last step's XLA bytes-accessed / host wall seconds")
+    reg.gauge("dl4jtpu_step_membw_util",
+              "Last step's bytes/s over the backend peak memory "
+              "bandwidth (DL4J_TPU_PEAK_MEMBW override)")
+    reg.gauge("dl4jtpu_programs_registered",
+              "Live compiled programs in the cost registry (dead "
+              "models / cleared step-fn caches pruned)")
+    # step-timeline ring buffer (observe/trace.py)
+    reg.counter("dl4jtpu_trace_spans_dropped_total",
+                "Spans evicted by trace ring-buffer wrap-around (the "
+                "Chrome export's metadata carries the same count)")
+    # build/environment identity: value is always 1, the labels are the
+    # payload — every scrape and crash report is self-describing
+    reg.gauge("dl4jtpu_build_info",
+              "Constant 1; labels carry package/jax/jaxlib versions, "
+              "backend and device count")
+    # fleet aggregation (observe/fleet.py; the coordinator's collector
+    # refreshes these from pushed worker snapshots at scrape time)
+    reg.gauge("dl4jtpu_fleet_workers",
+              "Workers that have pushed a telemetry snapshot")
+    reg.counter("dl4jtpu_fleet_snapshots_total",
+                "Telemetry snapshots ingested from workers")
+    reg.gauge("dl4jtpu_fleet_step_latency_seconds",
+              "Recent mean step latency per worker (windowed between "
+              "pushes)")
+    reg.gauge("dl4jtpu_fleet_step_latency_skew",
+              "Slowest/fastest worker recent mean step latency")
+    reg.gauge("dl4jtpu_fleet_stragglers",
+              "Workers whose recent mean step latency exceeds "
+              "DL4J_TPU_STRAGGLER_FACTOR x the fleet median")
 
 
 def _compile_stats_collector() -> None:
@@ -481,6 +528,39 @@ def _compile_stats_collector() -> None:
         ("dl4jtpu_compile_seconds_saved_total", snap.compile_secs_saved),
     ):
         reg.counter(family).set_total(value)
+
+
+def _build_info_collector() -> None:
+    """dl4jtpu_build_info: a constant-1 info gauge whose labels carry
+    the process identity (package/jax/jaxlib versions, backend, device
+    count).  Version labels are always present; backend/device labels
+    appear once the jax backend is up (the sibling device-memory
+    collector initializes it on the same scrape, so a scraped process
+    is always fully described)."""
+    import jax
+    import jaxlib
+
+    from deeplearning4j_tpu.version import __version__
+
+    try:
+        backend = jax.default_backend()
+        device_count = jax.local_device_count()
+    except Exception:
+        # backend bring-up failed (e.g. dead TPU tunnel): the scrape
+        # must still carry the version identity
+        backend = "unavailable"
+        device_count = 0
+    reg = registry()
+    info = reg.gauge("dl4jtpu_build_info")
+    info.clear()        # labels changed (backend came up): one live series
+    info.set(
+        1,
+        version=__version__,
+        jax=jax.__version__,
+        jaxlib=jaxlib.__version__,
+        backend=str(backend),
+        device_count=str(device_count),
+    )
 
 
 def _device_memory_collector() -> None:

@@ -29,12 +29,15 @@ path keeps full host/device overlap.
 from __future__ import annotations
 
 import json
+import logging
 import os
 import threading
 import time
 from collections import deque
 from functools import wraps
 from typing import Optional
+
+log = logging.getLogger("deeplearning4j_tpu")
 
 
 class _NullSpan:
@@ -81,6 +84,12 @@ class TraceRecorder:
         self._spans: deque = deque(maxlen=self.capacity)
         self._enabled = False
         self._pid = os.getpid()
+        # spans evicted by ring wrap-around, process lifetime.  A wrapped
+        # ring silently truncates the timeline's past — this count is the
+        # reader's "how much is missing" signal (exported as
+        # dl4jtpu_trace_spans_dropped_total and stamped into the Chrome
+        # trace metadata).
+        self.spans_dropped = 0
 
     # -- control -----------------------------------------------------------
     @property
@@ -119,7 +128,12 @@ class TraceRecorder:
         fit loops' ETL-wait accounting)."""
         if not self._enabled:
             return
-        # deque.append is GIL-atomic; no lock on the hot path
+        # deque.append is GIL-atomic; no lock on the hot path.  A full
+        # ring evicts its oldest span — count the loss (plain int +=,
+        # bridged to the metrics counter by a pull collector so the hot
+        # path never takes the registry lock).
+        if len(self._spans) >= self.capacity:
+            self.spans_dropped += 1
         self._spans.append((
             name, cat, t0, dur, threading.get_ident(), args or None,
         ))
@@ -142,25 +156,76 @@ class TraceRecorder:
         return deco
 
     # -- exposition --------------------------------------------------------
+    def _event(self, span) -> dict:
+        name, cat, t0, dur, tid, args = span
+        ev = {
+            "name": name,
+            "cat": cat,
+            "ph": "X",
+            "ts": round(t0 * 1e6, 3),
+            "dur": round(dur * 1e6, 3),
+            "pid": self._pid,
+            "tid": tid,
+        }
+        if args:
+            ev["args"] = args
+        return ev
+
+    def appended_total(self) -> int:
+        """Spans ever appended (ring contents + wrap evictions) — the
+        monotonic cursor base for incremental consumers (the fleet
+        reporter ships only spans appended since its last push).
+        APPEND order, not timestamp order: an umbrella span starts
+        before but completes after its sub-spans, so a timestamp cursor
+        would silently drop any span straddling a push."""
+        return len(self._spans) + self.spans_dropped
+
+    def events_since(self, cursor: int, limit: int) -> tuple:
+        """(chrome events, new_cursor) for spans appended after
+        append-order position `cursor`, newest `limit` of them.  ONE
+        coherent read: deriving the total and the events from separate
+        reads of a live ring would shift the window under a concurrent
+        recorder — the oldest unacked spans would be skipped forever.
+        The drop count is read BEFORE the ring snapshot, so a racing
+        wrap at worst re-sends a span (the aggregator tolerates
+        duplicates), never loses one."""
+        dropped = self.spans_dropped
+        spans = list(self._spans)
+        total = dropped + len(spans)
+        new_n = total - cursor
+        if new_n <= 0:
+            return [], max(cursor, total)
+        events = [
+            self._event(s) for s in spans[-min(new_n, limit, len(spans)):]
+        ]
+        events.sort(key=lambda e: e["ts"])
+        return events, total
+
+    def tail_events(self, n: int) -> list:
+        """Chrome events for the last `n` appended spans (ts-sorted
+        among themselves)."""
+        if n <= 0:
+            return []
+        events = [self._event(s) for s in list(self._spans)[-n:]]
+        events.sort(key=lambda e: e["ts"])
+        return events
+
     def to_chrome_trace(self) -> dict:
         """Chrome trace-event JSON object (the Perfetto-loadable schema:
         phase "X" complete events, microsecond timestamps)."""
-        events = []
-        for name, cat, t0, dur, tid, args in list(self._spans):
-            ev = {
-                "name": name,
-                "cat": cat,
-                "ph": "X",
-                "ts": round(t0 * 1e6, 3),
-                "dur": round(dur * 1e6, 3),
-                "pid": self._pid,
-                "tid": tid,
-            }
-            if args:
-                ev["args"] = args
-            events.append(ev)
+        events = [self._event(s) for s in list(self._spans)]
         events.sort(key=lambda e: e["ts"])
-        return {"traceEvents": events, "displayTimeUnit": "ms"}
+        return {
+            "traceEvents": events,
+            "displayTimeUnit": "ms",
+            # a wrapped ring silently truncated the timeline's past;
+            # readers (and the cluster merge) get the loss count here
+            "metadata": {
+                "spans_dropped": self.spans_dropped,
+                "capacity": self.capacity,
+                "pid": self._pid,
+            },
+        }
 
     def save(self, path: str) -> str:
         with open(path, "w") as f:
@@ -175,12 +240,73 @@ _TRACER_LOCK = threading.Lock()
 
 
 def tracer() -> TraceRecorder:
-    """The process-global recorder (created disabled)."""
+    """The process-global recorder (created disabled).  Its ring-wrap
+    loss count is bridged to ``dl4jtpu_trace_spans_dropped_total`` by a
+    pull collector installed here — the recording hot path stays
+    lock-free."""
     global _TRACER
     with _TRACER_LOCK:
         if _TRACER is None:
             _TRACER = TraceRecorder()
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            reg = registry()
+            dropped = reg.counter("dl4jtpu_trace_spans_dropped_total")
+
+            def _collect(t=_TRACER, c=dropped):
+                c.set_total(t.spans_dropped)
+
+            reg.register_collector(_collect)
     return _TRACER
+
+
+def merge_chrome_traces(traces: dict, pids: Optional[dict] = None) -> dict:
+    """Merge per-worker Chrome traces into ONE cluster timeline:
+    ``traces`` maps worker id -> a `to_chrome_trace()` document; every
+    worker's events land under its own pid (``pids[worker]`` — normally
+    the worker's rank — else a stable sorted index), with a
+    ``process_name`` metadata event so Perfetto shows the worker id.
+    Per-worker drop counts are summed into the merged metadata."""
+    events: list = []
+    dropped_total = 0
+    per_worker: dict = {}
+    # every worker gets its OWN pid: fallback pids stay disjoint from
+    # the explicit ranks, and a DUPLICATE explicit rank (an elastic
+    # respawn reusing a dead worker's rank inside the fleet TTL) is
+    # honored only for the first worker carrying it — anything else
+    # silently fuses two timelines under one Perfetto process
+    desired = set(pids.values()) if pids else set()
+    used: set = set()
+    next_free = 0
+    for worker in sorted(traces):
+        doc = traces[worker] or {}
+        pid = pids.get(worker) if pids else None
+        if pid is None or pid in used:
+            while next_free in desired or next_free in used:
+                next_free += 1
+            pid = next_free
+        used.add(pid)
+        events.append({
+            "name": "process_name", "ph": "M", "pid": pid, "tid": 0,
+            "args": {"name": str(worker)},
+        })
+        for ev in doc.get("traceEvents", []):
+            ev = dict(ev)
+            ev["pid"] = pid
+            events.append(ev)
+        meta = doc.get("metadata") or {}
+        d = int(meta.get("spans_dropped", 0) or 0)
+        dropped_total += d
+        per_worker[str(worker)] = {"pid": pid, "spans_dropped": d}
+    events.sort(key=lambda e: e.get("ts", 0))
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "workers": per_worker,
+            "spans_dropped": dropped_total,
+        },
+    }
 
 
 # -- fit-loop step instrumentation ------------------------------------------
@@ -216,10 +342,11 @@ class StepScope:
     """
 
     __slots__ = ("_rec", "_hist", "_steps", "_n", "_iteration", "_t0",
-                 "_dispatched", "_overlap", "_watchdog")
+                 "_dispatched", "_overlap", "_watchdog", "_model",
+                 "_cost_rec")
 
     def __init__(self, iteration: int, n_steps: int = 1,
-                 overlap_s: float = 0.0, watchdog=None):
+                 overlap_s: float = 0.0, watchdog=None, model=None):
         self._rec = tracer()
         self._hist, self._steps = _step_families()
         self._n = n_steps
@@ -227,6 +354,11 @@ class StepScope:
         self._dispatched = False
         self._overlap = overlap_s
         self._watchdog = watchdog
+        # performance attribution: sync() snapshots the ProgramRecord the
+        # dispatch wrapper routed through the model (a listener running
+        # evaluate() later in the step must not overwrite attribution)
+        self._model = model
+        self._cost_rec = None
 
     def __enter__(self) -> "StepScope":
         self._t0 = time.perf_counter()
@@ -253,6 +385,16 @@ class StepScope:
             self._hist.observe(dur)
             self._steps.inc(self._n)
         args = {"iteration": self._iteration, "n_steps": self._n}
+        if self._cost_rec is not None and (not failed or self._dispatched):
+            # MFU / roofline attribution for the program this scope
+            # dispatched (no-op until the record has been cost-analyzed;
+            # a telemetry failure must never fail the step)
+            try:
+                from deeplearning4j_tpu.observe import cost
+
+                cost.note_step(self._cost_rec, dur, args, self._n)
+            except Exception as e:
+                log.debug("step cost attribution failed: %s", e)
         if self._overlap > 0:
             # the prefetch pipeline's win for this step: producer-thread
             # staging seconds that ran concurrently with compute
@@ -278,6 +420,11 @@ class StepScope:
         # against (disarmed: one global load + None check)
         faults.maybe_fail("device.sync")
         self._dispatched = True
+        if self._model is not None:
+            # the dispatch wrapper (observe/cost.py) set this during the
+            # step call just above; snapshot it HERE, before a listener's
+            # evaluate() can route a different (inference) program
+            self._cost_rec = getattr(self._model, "_cost_program", None)
         if self._rec.enabled and x is not None:
             import jax
 
@@ -292,4 +439,5 @@ def step_scope(model, n_steps: int = 1) -> StepScope:
     if overlap:
         model._overlap_accum = 0.0
     return StepScope(getattr(model, "iteration", 0), n_steps, overlap,
-                     watchdog=getattr(model, "_watchdog", None))
+                     watchdog=getattr(model, "_watchdog", None),
+                     model=model)

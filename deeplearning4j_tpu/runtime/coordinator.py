@@ -21,6 +21,9 @@ only — the gRPC-shaped role without a codegen dependency) plus a
   report_ckpt(...)   -> single-writer checkpoint registry; survivors learn
                         the restore point for the next generation
   set_expected(n)    -> supervisor shrinks/grows the next generation
+  push_metrics(...)  -> fleet telemetry ingestion: workers push registry
+                        snapshots + traces; the FleetAggregator serves the
+                        merged cluster view (observe/fleet.py)
 
 Worker processes exit on abort (JAX's fail-the-world model); a supervisor
 (`train.elastic.ElasticSupervisor`) respawns the new world.  The
@@ -211,12 +214,31 @@ class CoordinatorServer:
         self._stopped = False
         self._metrics_collector = None
         self._metrics_cleanup = None
+        # fleet-wide telemetry: workers push registry snapshots + traces
+        # (op "push_metrics"); the aggregator serves the merged cluster
+        # view through the UIServer's /metrics/cluster + /api/trace/cluster
+        from deeplearning4j_tpu.observe.fleet import FleetAggregator
+
+        self.fleet = FleetAggregator()
+        self._fleet_collector = None
+        self._fleet_cleanup = None
 
     # -- lifecycle ---------------------------------------------------------
     def start(self) -> "CoordinatorServer":
         for t in self._threads:
             t.start()
         self._register_metrics()
+        # fleet aggregation: skew/straggler gauges land in the LOCAL
+        # registry (plain /metrics carries them) and the aggregator
+        # becomes the process's active one (UIServer cluster endpoints)
+        from deeplearning4j_tpu.observe import fleet as fleet_mod
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        self._fleet_collector, self._fleet_cleanup = (
+            self.fleet.make_collector()
+        )
+        registry().register_collector(self._fleet_collector)
+        fleet_mod.set_active_aggregator(self.fleet)
         return self
 
     def stop(self) -> None:
@@ -233,6 +255,16 @@ class CoordinatorServer:
                 # stale age
                 self._metrics_cleanup()
                 self._metrics_cleanup = None
+        if self._fleet_collector is not None:
+            from deeplearning4j_tpu.observe import fleet as fleet_mod
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().unregister_collector(self._fleet_collector)
+            self._fleet_collector = None
+            if self._fleet_cleanup is not None:
+                self._fleet_cleanup()
+                self._fleet_cleanup = None
+            fleet_mod.clear_active_aggregator(self.fleet)
         self._server.shutdown()
         self._server.server_close()
         if self._port_hold is not None:
@@ -319,6 +351,11 @@ class CoordinatorServer:
                 return {"ok": True}
             if op == "latest_ckpt":
                 return {"ok": True, "ckpt": self.latest_ckpt}
+            if op == "push_metrics":
+                # fleet telemetry ingestion (the aggregator has its own
+                # lock; it never takes this server's)
+                self.fleet.ingest(req["worker"], req.get("payload") or {})
+                return {"ok": True}
             if op == "fail":
                 self._evict(req["worker"], reason=req.get("reason", "fail()"))
                 return {"ok": True}
@@ -507,9 +544,11 @@ class CoordinatorClient:
         if retry:
             self._retry.update(retry)
 
-    def _rpc_once(self, obj: dict) -> dict:
+    def _rpc_once(self, obj: dict, timeout: Optional[float] = None) -> dict:
         faults.maybe_fail("coordinator.rpc")
-        with socket.create_connection(self._addr, timeout=self.timeout) as s:
+        if timeout is None:
+            timeout = self.timeout
+        with socket.create_connection(self._addr, timeout=timeout) as s:
             _send_json(s, obj)
             # close the makefile wrapper explicitly: a GC'd-but-unclosed
             # wrapper raises ResourceWarning at an arbitrary later point
@@ -551,6 +590,22 @@ class CoordinatorClient:
     def report_ckpt(self, step: int, path: str) -> None:
         self._rpc({"op": "report_ckpt", "worker": self.worker_id,
                    "step": step, "path": path})
+
+    #: push_metrics socket timeout: the push rides the HEARTBEAT thread,
+    #: so a stalled transfer must fail fast — a heartbeat-starving push
+    #: would get a healthy worker evicted for telemetry's sake
+    PUSH_TIMEOUT_S = 5.0
+
+    def push_metrics(self, payload: dict) -> None:
+        """Push a fleet telemetry snapshot (observe.fleet.FleetReporter
+        builds the payload) — SINGLE try, short socket timeout, same
+        rationale as heartbeat: it repeats every interval anyway, losing
+        one push is harmless (the next re-carries the totals), and the
+        heartbeat thread it rides must never block minutes on a wedged
+        transfer."""
+        self._rpc_once({"op": "push_metrics", "worker": self.worker_id,
+                        "payload": payload},
+                       timeout=self.PUSH_TIMEOUT_S)
 
     def latest_ckpt(self) -> Optional[dict]:
         return self._rpc({"op": "latest_ckpt", "worker": self.worker_id}).get("ckpt")

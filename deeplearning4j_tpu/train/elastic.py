@@ -58,11 +58,13 @@ class _HeartbeatThread(threading.Thread):
         self.generation = generation
         self.interval = interval
         self.aborted = threading.Event()
-        self._stop = threading.Event()
+        # same naming caveat as _ReporterThread: Thread.join() calls an
+        # internal self._stop() — an Event there breaks any joiner
+        self._halt = threading.Event()
         self.step = 0
 
     def run(self):
-        while not self._stop.wait(self.interval):
+        while not self._halt.wait(self.interval):
             try:
                 hb = self.client.heartbeat(step=self.step)
             except Exception:
@@ -79,7 +81,38 @@ class _HeartbeatThread(threading.Thread):
                     return                   # membership already gone
 
     def stop(self):
-        self._stop.set()
+        self._halt.set()
+
+
+class _ReporterThread(threading.Thread):
+    """Dedicated fleet-telemetry pusher.
+
+    Deliberately NOT on the heartbeat thread: a push ships a much
+    bigger payload than a heartbeat, and even with a short per-socket
+    timeout a dribbling link can stretch one transfer past the
+    heartbeat interval — a starved heartbeat gets a HEALTHY worker
+    evicted for telemetry's sake.  Wedged here, only telemetry lags.
+    """
+
+    def __init__(self, reporter, interval: float):
+        super().__init__(daemon=True)
+        self.reporter = reporter
+        self.interval = max(0.2, float(interval))
+        # NOT named _stop: Thread.join() invokes an internal self._stop()
+        # on completion, and an Event shadowing it is not callable
+        self._halt = threading.Event()
+
+    def run(self):
+        while not self._halt.wait(self.interval):
+            self.reporter.push()             # absorbs its own failures
+
+    def stop_and_join(self, timeout: float = 10.0) -> bool:
+        """Signal stop and wait for any in-flight push; returns False if
+        the thread is still wedged in a transfer — the caller must then
+        SKIP its final push (FleetReporter is not thread-safe)."""
+        self._halt.set()
+        self.join(timeout)
+        return not self.is_alive()
 
 
 class ElasticWorkerLoop:
@@ -102,6 +135,7 @@ class ElasticWorkerLoop:
         parallel_config=None,
         jax_heartbeat_timeout_seconds: Optional[int] = None,
         keep_last: int = 3,
+        metrics_push_every: float = 2.0,   # fleet snapshot interval; 0 = off
     ):
         from deeplearning4j_tpu.train.checkpoint import CheckpointStore
 
@@ -114,6 +148,7 @@ class ElasticWorkerLoop:
         self.parallel_config = parallel_config
         self.jax_heartbeat_timeout_seconds = jax_heartbeat_timeout_seconds
         self.store = CheckpointStore(ckpt_dir, keep_last=keep_last)
+        self.metrics_push_every = metrics_push_every
 
     def _ckpt_path(self, step: int) -> str:
         return self.store.path_for(step)
@@ -220,8 +255,18 @@ class ElasticWorkerLoop:
         # eviction timeout on real models, and a silent bootstrap would get
         # every healthy worker evicted before its first beat
         hb_interval = max(0.2, min(2.0, self.heartbeat_every))
+        reporter = rt = None
+        if self.metrics_push_every > 0:
+            from deeplearning4j_tpu.observe.fleet import FleetReporter
+
+            reporter = FleetReporter(
+                self.client, rank=rank, every_s=self.metrics_push_every,
+            )
+            rt = _ReporterThread(reporter, self.metrics_push_every)
         hb = _HeartbeatThread(self.client, generation, hb_interval)
         hb.start()
+        if rt is not None:
+            rt.start()
         try:
             distributed.initialize(
                 distributed.DistributedConfig(
@@ -296,6 +341,20 @@ class ElasticWorkerLoop:
             # never leak the heartbeat: a raised bootstrap/step error would
             # otherwise keep this dead worker "alive" in membership forever
             hb.stop()
+        if rt is not None:
+            # final snapshot before leaving: even a fit shorter than the
+            # push interval lands its totals (and trace) on the cluster
+            # view.  The reporter thread must be JOINED first — a push
+            # still in flight would race the final one on the shared
+            # span cursor; if it is wedged in a transfer, skip the final
+            # push rather than corrupt the cursor.
+            if rt.stop_and_join():
+                reporter.push()
+            else:
+                log.warning(
+                    "fleet reporter thread wedged in a push; skipping "
+                    "the final telemetry snapshot"
+                )
         try:
             self.client.leave()
         except Exception:

@@ -49,7 +49,11 @@ from typing import Optional
 
 import numpy as np
 
-from deeplearning4j_tpu.observe.health import DivergenceError, HealthListener
+# NOTE: observe.health is imported LAZILY (inside the functions that
+# need DivergenceError / HealthListener).  A module-level import here
+# closes the cycle observe/__init__ -> health -> train.listeners ->
+# train/__init__ -> recovery -> observe.health, which breaks any
+# process whose FIRST deeplearning4j_tpu import is the observe package.
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -226,6 +230,8 @@ class RecoveryPolicy:
         """Install on `model`: route its fit chokepoints through this
         policy, ensure a raising HealthListener watches every step, and
         pin the current rollback target in the store."""
+        from deeplearning4j_tpu.observe.health import HealthListener
+
         if getattr(model, "_batch_sharding", None) is not None:
             raise ValueError(
                 "RecoveryPolicy is single-process only; distributed "
@@ -315,6 +321,8 @@ class RecoveryPolicy:
     # -- the chokepoints (Model._fit_one / Model._fit_group) ---------------
     def run_step(self, model, batch) -> None:
         """One pulled batch through the full recovery envelope."""
+        from deeplearning4j_tpu.observe.health import DivergenceError
+
         if self._skip_remaining > 0:
             self._skip_remaining -= 1
             self._event("batch_skipped", skipped_remaining=self._skip_remaining)
@@ -337,6 +345,8 @@ class RecoveryPolicy:
         through the envelope.  Skip-windows, sticky splits and input
         scans force per-batch stepping — the grouped program is atomic
         and cannot skip or split a member."""
+        from deeplearning4j_tpu.observe.health import DivergenceError
+
         if (self._skip_remaining > 0 or self.split_factor > 1
                 or self.scan_inputs or self._grouped_oom):
             for b in batches:
@@ -541,6 +551,8 @@ class RecoveryPolicy:
         stepped (a partially fitted split resumes from its first
         unfitted example; refitting the leading pieces would double-
         apply their optimizer updates)."""
+        from deeplearning4j_tpu.observe.health import DivergenceError
+
         n = _num_examples(batch)
         factor = max(1, self.split_factor)
         start = 0                    # examples [0, start) already stepped

@@ -11,10 +11,13 @@ charts, auto-refresh, JSON API.
     print(server.url)                     # http://127.0.0.1:<port>/
 
 JSON API: /api/sessions, /api/stats?session=<id>, /api/trace (Chrome
-trace-event JSON of the step-timeline ring buffer).  Scrape API:
+trace-event JSON of the step-timeline ring buffer), /api/programs (the
+compiled-program registry with XLA cost analysis + roofline),
+/api/trace/cluster (merged per-worker cluster timeline).  Scrape API:
 /metrics (Prometheus text exposition of the process-global
 `observe.metrics` registry — compile taxes, ETL wait, cache hits, step
-latency histogram, health counters, device memory).
+latency histogram, health counters, device memory) and /metrics/cluster
+(the fleet aggregator's merged worker-labeled exposition).
 """
 
 from __future__ import annotations
@@ -232,6 +235,19 @@ class UIServer:
                 self.end_headers()
                 self.wfile.write(body)
 
+            def _text(self, text: str, code=200):
+                # the Prometheus exposition content type, shared by
+                # /metrics and /metrics/cluster
+                body = text.encode()
+                self.send_response(code)
+                self.send_header(
+                    "Content-Type",
+                    "text/plain; version=0.0.4; charset=utf-8",
+                )
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
             def do_GET(self):
                 u = urlparse(self.path)
                 if u.path in ("/", "/index.html"):
@@ -268,21 +284,57 @@ class UIServer:
                     # memory, coordinator ages at scrape time)
                     from deeplearning4j_tpu.observe.metrics import registry
 
-                    body = registry().to_prometheus_text().encode()
-                    self.send_response(200)
-                    self.send_header(
-                        "Content-Type",
-                        "text/plain; version=0.0.4; charset=utf-8",
-                    )
-                    self.send_header("Content-Length", str(len(body)))
-                    self.end_headers()
-                    self.wfile.write(body)
+                    self._text(registry().to_prometheus_text())
                 elif u.path == "/api/trace":
                     # the step-timeline ring buffer as Chrome trace-event
                     # JSON — save the response and load it in Perfetto
                     from deeplearning4j_tpu.observe.trace import tracer
 
                     self._json(tracer().to_chrome_trace())
+                elif u.path == "/api/programs":
+                    # the compiled-program registry: per-program compile
+                    # tax, XLA flops/bytes, roofline class.  ?analyze=0
+                    # lists without triggering the (re-trace) cost
+                    # analysis; ?memory=1 adds peak/argument/output bytes
+                    # at the price of one AOT compile per program.
+                    from deeplearning4j_tpu.observe import cost
+
+                    q = parse_qs(u.query)
+                    self._json(cost.program_table(
+                        analyze=q.get("analyze", ["1"])[0] != "0",
+                        memory=q.get("memory", ["0"])[0] == "1",
+                    ))
+                elif u.path == "/metrics/cluster":
+                    # merged fleet exposition: every pushed worker's
+                    # families re-labeled worker="...", plus the fleet
+                    # skew/straggler meta-families.  Served when this
+                    # process hosts a CoordinatorServer (its aggregator
+                    # registers itself as the active one).
+                    from deeplearning4j_tpu.observe import fleet
+
+                    agg = fleet.active_aggregator()
+                    if agg is None:
+                        self._json(
+                            {"error": "no fleet aggregator in this "
+                                      "process (start a "
+                                      "CoordinatorServer)"}, 404,
+                        )
+                    else:
+                        self._text(agg.to_prometheus_text())
+                elif u.path == "/api/trace/cluster":
+                    # one merged cluster timeline: every worker's pushed
+                    # Chrome trace under its own pid (= worker rank)
+                    from deeplearning4j_tpu.observe import fleet
+
+                    agg = fleet.active_aggregator()
+                    if agg is None:
+                        self._json(
+                            {"error": "no fleet aggregator in this "
+                                      "process (start a "
+                                      "CoordinatorServer)"}, 404,
+                        )
+                    else:
+                        self._json(agg.to_cluster_trace())
                 else:
                     self._json({"error": "not found"}, 404)
 

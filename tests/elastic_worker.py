@@ -171,6 +171,13 @@ def main_elastic():
     from deeplearning4j_tpu.runtime.coordinator import CoordinatorClient
     from deeplearning4j_tpu.train.elastic import ElasticWorkerLoop
 
+    if os.environ.get("DL4JTPU_TEST_TRACE"):
+        # fleet-trace tests: record the step timeline so the final
+        # metrics push carries this worker's Chrome trace to the
+        # coordinator's cluster aggregator
+        from deeplearning4j_tpu.observe import tracer
+
+        tracer().enable()
     total_steps = int(os.environ["DL4JTPU_TEST_TOTAL_STEPS"])
     die_at = int(os.environ.get("DL4JTPU_TEST_DIE_AT_STEP", "-1"))
     victim = os.environ.get("DL4JTPU_TEST_VICTIM", "")

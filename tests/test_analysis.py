@@ -285,6 +285,14 @@ class TestTier1Gate:
         fams = load_declared_families(REPO)
         assert "dl4jtpu_train_steps_total" in fams
         assert "dl4jtpu_coordinator_members" in fams     # PR-4 addition
+        # ISSUE-8 performance-attribution / fleet / identity families
+        assert {
+            "dl4jtpu_step_model_flops_total", "dl4jtpu_step_mfu",
+            "dl4jtpu_programs_registered",
+            "dl4jtpu_trace_spans_dropped_total", "dl4jtpu_build_info",
+            "dl4jtpu_fleet_workers", "dl4jtpu_fleet_step_latency_skew",
+            "dl4jtpu_fleet_stragglers",
+        } <= fams
         sites = load_fault_sites(REPO)
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",

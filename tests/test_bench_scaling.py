@@ -26,6 +26,10 @@ def test_scaling_bench_runs_on_cpu_mesh():
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["platform"] == "cpu"
+    # schema 2 (ISSUE 8): environment provenance + flops/mfu columns
+    assert out["schema"] == "bench-scaling/2"
+    assert out["env"]["jax"] and out["env"]["device_count"] == 8
+    assert "flags" in out["env"]
     assert [r["devices"] for r in out["rows"]] == [1, 2, 4, 8]
     for r in out["rows"]:
         assert r["samples_per_sec"] > 0
@@ -47,6 +51,12 @@ def test_scaling_bench_runs_on_cpu_mesh():
         assert r["device_decode_ms"] is not None
         assert "fused_etl_wait_fraction" in r
         assert "fused_speedup_vs_pipelined" in r
+        # performance attribution columns (ISSUE 8): XLA-analyzed model
+        # FLOPs per step program, the MFU the pipelined row achieved,
+        # and a roofline classification
+        assert r["model_flops_per_step"] > 0
+        assert r["mfu"] > 0
+        assert r["roofline"] in ("compute-bound", "memory-bound")
     assert fw[0]["mechanism_efficiency"] == 1.0
     ip = out["input_pipeline"]
     assert ip["async_feed_samples_per_sec"] > 0

@@ -1,0 +1,389 @@
+"""Fleet-wide metrics & trace aggregation (observe/fleet.py + the
+coordinator's push_metrics op): the Prometheus merge, skew/straggler
+accounting, the UIServer cluster endpoints, and a real 2-worker elastic
+fit producing one merged cluster trace + per-worker skew gauges."""
+
+import json
+import os
+import urllib.request
+
+import pytest
+
+from deeplearning4j_tpu.observe.fleet import (
+    FleetAggregator,
+    merge_prometheus_texts,
+)
+from deeplearning4j_tpu.runtime.coordinator import (
+    CoordinatorClient,
+    CoordinatorServer,
+)
+
+pytestmark = pytest.mark.observe
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+WORKER_TEXT = """\
+# HELP dl4jtpu_train_steps_total Optimizer steps run
+# TYPE dl4jtpu_train_steps_total counter
+dl4jtpu_train_steps_total {steps}
+# HELP dl4jtpu_rpc_retries_total Retries
+# TYPE dl4jtpu_rpc_retries_total counter
+dl4jtpu_rpc_retries_total{{op="register"}} {retries}
+# HELP dl4jtpu_step_latency_seconds Step latency
+# TYPE dl4jtpu_step_latency_seconds histogram
+dl4jtpu_step_latency_seconds_bucket{{le="0.1"}} {steps}
+dl4jtpu_step_latency_seconds_bucket{{le="+Inf"}} {steps}
+dl4jtpu_step_latency_seconds_sum {lat_sum}
+dl4jtpu_step_latency_seconds_count {steps}
+"""
+
+
+def worker_payload(rank, steps=4, mean_lat=0.01, retries=1, trace=None):
+    return {
+        "rank": rank,
+        "prom": WORKER_TEXT.format(steps=steps, retries=retries,
+                                   lat_sum=steps * mean_lat),
+        "step_latency_sum": steps * mean_lat,
+        "step_latency_count": steps,
+        "trace": trace,
+    }
+
+
+class TestPrometheusMerge:
+    def test_worker_label_injected_and_families_grouped(self):
+        merged = merge_prometheus_texts({
+            "w0": WORKER_TEXT.format(steps=3, retries=1, lat_sum=0.03),
+            "w1": WORKER_TEXT.format(steps=5, retries=2, lat_sum=0.10),
+        })
+        lines = merged.splitlines()
+        assert 'dl4jtpu_train_steps_total{worker="w0"} 3' in lines
+        assert 'dl4jtpu_train_steps_total{worker="w1"} 5' in lines
+        # existing labels keep their place, worker is appended
+        assert ('dl4jtpu_rpc_retries_total{op="register",worker="w1"} 2'
+                in lines)
+        # histogram samples group under the ONE family block
+        assert merged.count("# TYPE dl4jtpu_step_latency_seconds "
+                            "histogram") == 1
+        assert ('dl4jtpu_step_latency_seconds_sum{worker="w0"} 0.03'
+                in lines)
+        # families are never interleaved: every sample sits after its
+        # family's TYPE line and before the next family's HELP line
+        ti = lines.index("# TYPE dl4jtpu_train_steps_total counter")
+        next_help = min(
+            i for i, l in enumerate(lines)
+            if i > ti and l.startswith("# HELP")
+        )
+        fam_lines = lines[ti + 1:next_help]
+        assert all(l.startswith("dl4jtpu_train_steps_total")
+                   for l in fam_lines)
+        assert len(fam_lines) == 2
+
+
+class TestFleetAggregator:
+    def test_skew_and_straggler_accounting(self):
+        agg = FleetAggregator()
+        agg.ingest("w0", worker_payload(0, steps=10, mean_lat=0.01))
+        agg.ingest("w1", worker_payload(1, steps=10, mean_lat=0.01))
+        agg.ingest("w2", worker_payload(2, steps=10, mean_lat=0.05))
+        view = agg.latency_view()
+        assert view["skew"] == pytest.approx(5.0)
+        assert view["stragglers"] == ["w2"]       # 0.05 > 1.5 * 0.01
+        text = agg.to_prometheus_text()
+        assert "dl4jtpu_fleet_workers 3" in text
+        assert ('dl4jtpu_fleet_step_latency_seconds{worker="w2"} 0.05'
+                in text)
+        assert "dl4jtpu_fleet_step_latency_skew 5" in text
+        assert "dl4jtpu_fleet_stragglers 1" in text
+        # per-worker families with worker labels ride along
+        assert 'dl4jtpu_train_steps_total{worker="w0"} 10' in text
+
+    def test_two_worker_fleet_can_flag_a_straggler(self):
+        """True median (mean of the two middles): with the upper median
+        a 2-worker fleet could NEVER flag its slow worker — the slow
+        worker was the median."""
+        agg = FleetAggregator()
+        agg.ingest("w0", worker_payload(0, steps=10, mean_lat=0.01))
+        agg.ingest("w1", worker_payload(1, steps=10, mean_lat=0.10))
+        view = agg.latency_view()
+        # median 0.055, threshold 0.0825 < 0.10
+        assert view["stragglers"] == ["w1"]
+
+    def test_expired_workers_drop_out_of_the_fleet_view(self, monkeypatch):
+        monkeypatch.setenv("DL4J_TPU_FLEET_WORKER_TTL", "60")
+        agg = FleetAggregator()
+        agg.ingest("dead", worker_payload(0, steps=10, mean_lat=0.09))
+        agg.ingest("live", worker_payload(1, steps=10, mean_lat=0.01))
+        with agg._lock:
+            agg._workers["dead"]["last_push"] -= 120   # silent past TTL
+        view = agg.latency_view()
+        assert set(view["workers"]) == {"live"}
+        assert view["skew"] == pytest.approx(1.0)
+        assert agg.workers() == ["live"]
+        assert 'worker="dead"' not in agg.to_prometheus_text()
+        from deeplearning4j_tpu.observe import registry
+
+        collect, cleanup = agg.make_collector()
+        collect()
+        reg = registry()
+        assert reg.gauge("dl4jtpu_fleet_workers").value() == 1
+        # the whole fleet expires: the collector DROPS the skew series
+        # instead of freezing the dead fleet's last value as an alarm
+        with agg._lock:
+            agg._workers["live"]["last_push"] -= 120
+        collect()
+        text = reg.to_prometheus_text()
+        assert not any(
+            l.startswith("dl4jtpu_fleet_step_latency_skew ")
+            and not l.startswith("dl4jtpu_fleet_step_latency_skew{")
+            for l in text.splitlines()
+        )
+        assert reg.gauge("dl4jtpu_fleet_workers").value() == 0
+        cleanup()
+
+    def test_trace_pushes_accumulate_incrementally(self):
+        agg = FleetAggregator()
+
+        def doc(names):
+            return {"traceEvents": [
+                {"name": n, "ph": "X", "ts": float(i), "dur": 1.0,
+                 "pid": 1, "tid": 1} for i, n in enumerate(names)
+            ], "metadata": {"spans_dropped": 0}}
+
+        agg.ingest("w0", {"rank": 0, "trace": doc(["a", "b"])})
+        agg.ingest("w0", {"rank": 0, "trace": doc(["c"])})
+        merged = agg.to_cluster_trace()
+        names = [e["name"] for e in merged["traceEvents"]
+                 if e.get("ph") == "X"]
+        assert sorted(names) == ["a", "b", "c"]
+
+    def test_reporter_span_cursor_only_ships_new_events(self):
+        from deeplearning4j_tpu.observe import tracer
+        from deeplearning4j_tpu.observe.fleet import FleetReporter
+
+        sent = []
+
+        class FakeClient:
+            def push_metrics(self, payload):
+                sent.append(payload)
+
+        t = tracer()
+        was = t.enabled
+        t.enable()
+        t.clear()
+        try:
+            rep = FleetReporter(FakeClient(), rank=0, every_s=0.0)
+            t.add_complete("first", 1.0, 0.001)
+            assert rep.push()
+            t.add_complete("second", 2.0, 0.001)
+            assert rep.push()
+            assert rep.push()          # nothing new: no trace attached
+        finally:
+            t.clear()
+            if not was:
+                t.disable()
+        names = [[e["name"] for e in p["trace"]["traceEvents"]]
+                 for p in sent if "trace" in p]
+        assert names == [["first"], ["second"]]
+        assert "trace" not in sent[2]
+
+    def test_events_since_is_one_coherent_window(self):
+        """The cursor total and the event window must come from ONE ring
+        snapshot: separate reads under a concurrent recorder skip the
+        oldest unacked spans forever."""
+        from deeplearning4j_tpu.observe.trace import TraceRecorder
+
+        t = TraceRecorder(capacity=64)
+        t.enable()
+        for i in range(5):
+            t.add_complete(f"s{i}", float(i), 0.001)
+        events, cur = t.events_since(0, limit=100)
+        assert [e["name"] for e in events] == [f"s{i}" for i in range(5)]
+        assert cur == 5
+        events, cur2 = t.events_since(cur, limit=100)
+        assert events == [] and cur2 == 5
+        t.add_complete("s5", 5.0, 0.001)
+        events, cur3 = t.events_since(cur2, limit=100)
+        assert [e["name"] for e in events] == ["s5"] and cur3 == 6
+        # limit truncation drops the OLDEST of the window, cursor still
+        # advances past them (the truncation is flagged by the caller)
+        for i in range(6, 16):
+            t.add_complete(f"s{i}", float(i), 0.001)
+        events, cur4 = t.events_since(cur3, limit=4)
+        assert [e["name"] for e in events] == ["s12", "s13", "s14", "s15"]
+        assert cur4 == 16
+
+    def test_recent_mean_is_windowed_between_pushes(self):
+        agg = FleetAggregator()
+        agg.ingest("w0", worker_payload(0, steps=10, mean_lat=0.01))
+        # second push: 10 more steps at 0.03 -> recent mean reflects the
+        # WINDOW, not the lifetime mean
+        agg.ingest("w0", {
+            "rank": 0,
+            "step_latency_sum": 10 * 0.01 + 10 * 0.03,
+            "step_latency_count": 20,
+        })
+        assert agg.latency_view()["workers"]["w0"] == pytest.approx(0.03)
+
+    def test_collector_bridges_gauges_into_local_registry(self):
+        from deeplearning4j_tpu.observe import registry
+
+        agg = FleetAggregator()
+        agg.ingest("wa", worker_payload(0, steps=4, mean_lat=0.02))
+        collect, cleanup = agg.make_collector()
+        reg = registry()
+        collect()
+        assert reg.gauge("dl4jtpu_fleet_workers").value() == 1
+        assert reg.gauge(
+            "dl4jtpu_fleet_step_latency_seconds"
+        ).value(worker="wa") == pytest.approx(0.02)
+        cleanup()
+        assert reg.gauge("dl4jtpu_fleet_workers").value() == 0
+
+    def test_cluster_trace_merges_under_worker_rank_pids(self):
+        agg = FleetAggregator()
+        trace = {
+            "traceEvents": [{"name": "train_step", "ph": "X", "ts": 1.0,
+                             "dur": 2.0, "pid": 999, "tid": 7}],
+            "metadata": {"spans_dropped": 2},
+        }
+        agg.ingest("w0", worker_payload(0, trace=trace))
+        agg.ingest("w1", worker_payload(1, trace=trace))
+        merged = agg.to_cluster_trace()
+        pids = {e["pid"] for e in merged["traceEvents"]
+                if e.get("ph") == "X"}
+        assert pids == {0, 1}
+        assert merged["metadata"]["spans_dropped"] == 4
+
+
+class TestCoordinatorFleetPlumbing:
+    def test_push_metrics_op_feeds_the_server_aggregator(self):
+        srv = CoordinatorServer(expected_workers=1,
+                                heartbeat_timeout=30).start()
+        try:
+            c = CoordinatorClient(srv.address, "w0")
+            c.push_metrics(worker_payload(0, steps=6, mean_lat=0.02))
+            assert srv.fleet.workers() == ["w0"]
+            assert srv.fleet.snapshots == 1
+            # the server's LOCAL /metrics carries the fleet gauges via
+            # the collector registered in start()
+            from deeplearning4j_tpu.observe import registry
+
+            text = registry().to_prometheus_text()
+            assert "dl4jtpu_fleet_workers 1" in text
+        finally:
+            srv.stop()
+
+    def test_uiserver_cluster_endpoints(self):
+        from deeplearning4j_tpu.ui import UIServer
+
+        srv = CoordinatorServer(expected_workers=1,
+                                heartbeat_timeout=30).start()
+        server = UIServer(port=0)
+        try:
+            CoordinatorClient(srv.address, "w0").push_metrics(
+                worker_payload(0, steps=4, mean_lat=0.01, trace={
+                    "traceEvents": [{"name": "train_step", "ph": "X",
+                                     "ts": 1.0, "dur": 2.0, "pid": 9,
+                                     "tid": 1}],
+                })
+            )
+            with urllib.request.urlopen(
+                server.url + "metrics/cluster"
+            ) as r:
+                body = r.read().decode()
+                assert r.headers["Content-Type"].startswith("text/plain")
+            assert 'dl4jtpu_train_steps_total{worker="w0"} 4' in body
+            assert "dl4jtpu_fleet_workers 1" in body
+            with urllib.request.urlopen(
+                server.url + "api/trace/cluster"
+            ) as r:
+                doc = json.loads(r.read())
+            assert {e["pid"] for e in doc["traceEvents"]} == {0}
+        finally:
+            server.stop()
+            srv.stop()
+
+    def test_cluster_endpoints_404_without_aggregator(self):
+        from deeplearning4j_tpu.observe import fleet
+        from deeplearning4j_tpu.ui import UIServer
+
+        assert fleet.active_aggregator() is None
+        server = UIServer(port=0)
+        try:
+            with pytest.raises(urllib.error.HTTPError) as e:
+                urllib.request.urlopen(server.url + "metrics/cluster")
+            assert e.value.code == 404
+            e.value.close()    # HTTPError is file-like; its socket must
+            #                    not leak into a GC-attributed warning
+        finally:
+            server.stop()
+
+
+class TestTwoWorkerElasticFleet:
+    def test_elastic_fit_produces_merged_trace_and_skew_gauges(
+        self, tmp_path
+    ):
+        """Acceptance: a 2-worker elastic fit produces ONE merged
+        cluster trace plus per-worker skew gauges on the coordinator's
+        merged /metrics."""
+        from test_distributed import fail_with_logs, spawn, wait_all
+
+        ckpt_dir = str(tmp_path / "ckpts")
+        srv = CoordinatorServer(expected_workers=2,
+                                heartbeat_timeout=60).start()
+        procs = []
+        try:
+            for i in range(2):
+                procs.append(spawn(
+                    "elastic", f"w{i}", srv.address,
+                    extra={
+                        "DL4JTPU_TEST_TOTAL_STEPS": 6,
+                        "DL4JTPU_TEST_CKPT_DIR": ckpt_dir,
+                        "DL4JTPU_TEST_TRACE": 1,
+                    },
+                ))
+            rcs = wait_all(procs, timeout=240)
+            if rcs != [0, 0]:
+                fail_with_logs(procs, rcs, "fleet workers failed")
+
+            assert set(srv.fleet.workers()) == {"w0", "w1"}
+            assert srv.fleet.snapshots >= 2
+
+            # merged /metrics: per-worker labeled series + fleet gauges
+            merged = srv.fleet.to_prometheus_text()
+            assert "dl4jtpu_fleet_workers 2" in merged
+            assert ('dl4jtpu_fleet_step_latency_seconds{worker="w0"}'
+                    in merged)
+            assert ('dl4jtpu_fleet_step_latency_seconds{worker="w1"}'
+                    in merged)
+            assert "dl4jtpu_fleet_step_latency_skew " in merged
+            for w in ("w0", "w1"):
+                assert f'dl4jtpu_train_steps_total{{worker="{w}"}} 6' \
+                    in merged
+            # per-worker skew gauges on the coordinator's LOCAL /metrics
+            from deeplearning4j_tpu.observe import registry
+
+            local = registry().to_prometheus_text()
+            assert ('dl4jtpu_fleet_step_latency_seconds{worker="w0"}'
+                    in local)
+            assert "dl4jtpu_fleet_workers 2" in local
+
+            # ONE merged cluster trace: both workers' step spans under
+            # their rank pids, process_name metadata per worker
+            trace = srv.fleet.to_cluster_trace()
+            by_pid = {}
+            for ev in trace["traceEvents"]:
+                if ev.get("ph") == "X" and ev["name"] == "train_step":
+                    by_pid.setdefault(ev["pid"], 0)
+                    by_pid[ev["pid"]] += 1
+            assert set(by_pid) == {0, 1}
+            assert all(n >= 6 for n in by_pid.values())
+            names = {e["args"]["name"] for e in trace["traceEvents"]
+                     if e.get("ph") == "M"}
+            assert names == {"w0", "w1"}
+        finally:
+            srv.stop()
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                p.communicate()

@@ -32,6 +32,9 @@ from deeplearning4j_tpu.observe import (
 from deeplearning4j_tpu.observe.trace import TraceRecorder
 
 
+pytestmark = pytest.mark.observe
+
+
 def small_model():
     conf = (
         NeuralNetConfiguration.builder()

@@ -241,6 +241,30 @@ class TestUIServer:
         finally:
             server.stop()
 
+    def test_programs_endpoint_serves_the_cost_registry(self):
+        """/api/programs: the compiled-program table with XLA cost
+        analysis (the registry's own behavior is covered in
+        tests/test_cost.py)."""
+        m = small_model()
+        m.fit([batch(0)], epochs=1)
+        server = UIServer(port=0)
+        try:
+            # analyze=0 lists without triggering the XLA re-trace
+            with urllib.request.urlopen(
+                server.url + "api/programs?analyze=0"
+            ) as r:
+                rows = json.load(r)
+            mine = [x for x in rows if x["kind"] == "train"]
+            assert mine and mine[-1]["dispatches"] >= 1
+            with urllib.request.urlopen(server.url + "api/programs") as r:
+                rows = json.load(r)
+            mine = [x for x in rows if x["kind"] == "train"]
+            assert mine[-1]["flops"] > 0
+            assert mine[-1]["roofline"] in ("compute-bound",
+                                            "memory-bound")
+        finally:
+            server.stop()
+
     def test_singleton_attach_detach(self):
         server = UIServer.get_instance()
         try:
