@@ -1369,6 +1369,94 @@ def bench_scaling() -> None:
               f"fused_vs_pipelined={r['fused_speedup_vs_pipelined']}",
               file=sys.stderr)
 
+    # ZeRO-1 sharded weight update columns (ISSUE 10): opt state + the
+    # update computation sharded over the data axis vs the classic
+    # replicated DP update, at every mesh width.  The proxy is an MLP
+    # whose dims divide every sweep width (784/512/256) — ZeRO-1 on
+    # jax 0.4.x shards only evenly-divisible dims (parallel/strategy
+    # .zero1_spec_for_leaf), and LeNet's conv shapes divide nothing.
+    from deeplearning4j_tpu.nn import Adam as _Adam
+    from deeplearning4j_tpu.nn.activations import Activation as _Act
+    from deeplearning4j_tpu.nn.conf import (
+        Dense as _Dense,
+        InputType as _InputType,
+        NeuralNetConfiguration as _NNConf,
+        OutputLayer as _OutputLayer,
+    )
+    from deeplearning4j_tpu.nn.losses import Loss as _Loss
+    from deeplearning4j_tpu.parallel import zero as zero_mod
+
+    def make_zero_model():
+        conf = (
+            _NNConf.builder()
+            .seed(7)
+            .updater(_Adam(1e-3))
+            .activation(_Act.RELU)
+            .list()
+            .layer(_Dense(n_out=512))
+            .layer(_Dense(n_out=256))
+            .layer(_OutputLayer(n_out=n_cls, loss=_Loss.MCXENT,
+                                activation=_Act.SOFTMAX))
+            .set_input_type(_InputType.convolutional(*in_shape))
+            .build()
+        )
+        from deeplearning4j_tpu.models import SequentialModel
+
+        return SequentialModel(conf).init()
+
+    def measure_zero(n: int, batch: int) -> dict:
+        out = {}
+        zbatches = [
+            DataSet(
+                rng.normal(0, 1, (batch,) + in_shape).astype(np.float32),
+                np.eye(n_cls, dtype=np.float32)[
+                    rng.integers(0, n_cls, batch)
+                ],
+            )
+            for _ in range(2)
+        ]
+        for mode, stage in (("replicated", 0), ("zero1", 1)):
+            model = make_zero_model()
+            distribute(model, ParallelConfig(data=n, zero=stage),
+                       devices=devices[:n])
+            warm, iters = (2, 6) if QUICK else (3, 16)
+            sps, _meta = _timed_fit(model, zbatches, warmup=warm,
+                                    iters=iters)
+            out[mode] = {
+                "samples_per_sec": sps,
+                "opt_bytes": zero_mod.opt_state_bytes_per_replica(
+                    model.opt_state
+                ),
+                "update_ms": zero_mod.measure_update_seconds(
+                    model, iters=2 if QUICK else 5
+                ) * 1e3,
+            }
+        return out
+
+    for r in fixed_rows:
+        n = r["devices"]
+        zres = measure_zero(n, fixed_batch)
+        rep_m, z_m = zres["replicated"], zres["zero1"]
+        r["zero1_samples_per_sec"] = round(z_m["samples_per_sec"], 1)
+        r["replicated_samples_per_sec"] = round(
+            rep_m["samples_per_sec"], 1
+        )
+        r["zero1_speedup"] = (
+            round(z_m["samples_per_sec"] / rep_m["samples_per_sec"], 3)
+            if rep_m["samples_per_sec"] else None
+        )
+        r["peak_opt_state_bytes_per_replica"] = z_m["opt_bytes"]
+        r["peak_opt_state_bytes_per_replica_replicated"] = rep_m[
+            "opt_bytes"
+        ]
+        r["update_time_ms"] = round(z_m["update_ms"], 3)
+        r["update_time_ms_replicated"] = round(rep_m["update_ms"], 3)
+        print(f"[scaling zero1] devices={n} "
+              f"opt_bytes {rep_m['opt_bytes']}→{z_m['opt_bytes']} "
+              f"update_ms {r['update_time_ms_replicated']}→"
+              f"{r['update_time_ms']} speedup={r['zero1_speedup']}",
+              file=sys.stderr)
+
     # host-input overlap: can the async host pipeline feed faster than the
     # device consumes?  (AsyncDataSetIterator producer-thread rate vs the
     # measured step rate at full mesh width.)
@@ -1390,7 +1478,10 @@ def bench_scaling() -> None:
         # schema 2 (ISSUE 8): fixed-work rows grew model_flops_per_step /
         # mfu / roofline (XLA cost analysis via observe/cost.py) and the
         # document carries environment provenance
-        "schema": "bench-scaling/2",
+        # schema 3 (ISSUE 10): fixed-work rows grew the ZeRO-1 columns
+        # (peak_opt_state_bytes_per_replica[_replicated] /
+        # update_time_ms[_replicated] / zero1_speedup)
+        "schema": "bench-scaling/3",
         "metric": "DP scaling: per-chip samples/sec at 1..N devices",
         "env": _env_provenance(),
         "note": None if on_tpu else (
@@ -1429,6 +1520,17 @@ def bench_scaling() -> None:
             "no spare core; device_decode_ms is the calibrated "
             "standalone cost of the decode stage, h2d_mb_per_step the "
             "raw-byte transfer vs h2d_mb_per_step_host_decoded"
+        ),
+        "zero1_note": (
+            "zero1 columns compare distribute(zero=1) — opt state and "
+            "the weight update sharded over the data axis "
+            "(reduce-scatter grads -> per-shard update -> all-gather "
+            "params, parallel/zero.py) — against the replicated DP "
+            "update on an MLP proxy whose dims divide every sweep "
+            "width; peak_opt_state_bytes_per_replica is the per-chip "
+            "opt-state footprint (sharded ~1/n of replicated), "
+            "update_time_ms the calibrated standalone update-epilogue "
+            "cost, zero1_speedup the whole-step throughput ratio"
         ),
         "flops_note": (
             "model_flops_per_step is the train step program's XLA "

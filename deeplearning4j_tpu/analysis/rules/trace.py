@@ -677,6 +677,19 @@ def _read_refs(node: ast.AST, skip: Optional[set] = None) -> dict:
     return out
 
 
+# Attribute reads THROUGH a donated reference that touch only array
+# METADATA stay legal after donation (jax keeps aval/sharding on a
+# deleted Array); anything else — shard views, buffer pointers, device
+# enumeration — reads the freed buffers and must be flagged.  This is
+# what makes RH105 shard-aware: a ZeRO-sharded donated tree is most
+# naturally mis-read through `donated.addressable_shards[i].data`, a
+# LONGER chain than the donated name itself.
+DONATED_METADATA_OK = {
+    "shape", "dtype", "ndim", "size", "nbytes", "sharding", "aval",
+    "is_deleted", "committed", "weak_type",
+}
+
+
 class _DonationScanner:
     """Linear source-order walk of ONE function body tracking which
     references were donated to a jitted call and not rebound since."""
@@ -686,6 +699,23 @@ class _DonationScanner:
         self.donating = donating
         self.findings: list[Finding] = []
         self.donated: dict[str, int] = {}    # ref -> donating call line
+
+    def _donated_prefix(self, ref: str) -> Optional[str]:
+        """The donated entry `ref` reads through, or None.  An exact
+        match always hits; a LONGER chain hits when the attribute step
+        immediately past the donated prefix is not pure metadata
+        (``opt.addressable_shards`` with ``opt`` donated reads freed
+        buffers; ``opt.shape`` does not)."""
+        if ref in self.donated:
+            return ref
+        parts = ref.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            prefix = ".".join(parts[:i])
+            if prefix in self.donated:
+                if parts[i] in DONATED_METADATA_OK:
+                    return None
+                return prefix
+        return None
 
     def scan(self, func: ast.AST) -> None:
         for stmt in func.body:
@@ -739,15 +769,17 @@ class _DonationScanner:
         statement's own donations and rebinds apply."""
         skip = {id(n) for n in ast.walk(node) if isinstance(n, FuncNode)}
         for ref, line in sorted(_read_refs(node, skip).items()):
-            if ref in self.donated:
+            hit = self._donated_prefix(ref)
+            if hit is not None:
                 self.findings.append(Finding(
                     "RH105", self.unit.relpath, line, stmt.col_offset,
-                    f"`{ref}` read after being donated to a jitted call "
-                    f"on line {self.donated[ref]} (donate_argnums): the "
-                    "buffer is freed by the dispatch — rebind the name "
-                    "from the call's results or drop the donation",
+                    f"`{ref}` read after `{hit}` was donated to a "
+                    f"jitted call on line {self.donated[hit]} "
+                    "(donate_argnums): the buffer is freed by the "
+                    "dispatch — rebind the name from the call's "
+                    "results or drop the donation",
                 ))
-                del self.donated[ref]          # one report per donation
+                del self.donated[hit]          # one report per donation
         pending: dict[str, int] = {}
         for call in ast.walk(node):
             if id(call) in skip or not isinstance(call, ast.Call):

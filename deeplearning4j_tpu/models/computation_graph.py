@@ -182,6 +182,7 @@ class GraphModel(Model):
         training cannot diverge."""
         key = (("train", n_masks) if decode is None
                else ("train_fused", decode.fingerprint))
+        key = key + self._step_key_suffix()
         if key not in self._step_fns:
 
             def core(params, opt_state, net_state, step_i, features,
@@ -217,8 +218,7 @@ class GraphModel(Model):
                 (loss, new_state), grads = jax.value_and_grad(loss_fn, has_aux=True)(
                     params
                 )
-                updates, opt_state = self._tx.update(grads, opt_state, params)
-                params = jax.tree.map(lambda p, u: p + u.astype(p.dtype), params, updates)
+                params, opt_state = self._apply_grads(params, opt_state, grads)
                 merged_state = {**net_state, **new_state}
                 return params, opt_state, merged_state, loss
 
@@ -399,7 +399,7 @@ class GraphModel(Model):
             self._multi_iter_dev = None
 
     def _get_step_fn_multi(self):
-        key = ("train_multi",)
+        key = ("train_multi",) + self._step_key_suffix()
         if key not in self._step_fns:
 
             @partial(jax.jit, donate_argnums=(0, 1, 2))
@@ -437,10 +437,7 @@ class GraphModel(Model):
                     (loss, new_state), grads = jax.value_and_grad(
                         loss_fn, has_aux=True
                     )(params)
-                    updates, opt_state = self._tx.update(grads, opt_state, params)
-                    params = jax.tree.map(
-                        lambda p, u: p + u.astype(p.dtype), params, updates
-                    )
+                    params, opt_state = self._apply_grads(params, opt_state, grads)
                     merged = {**net_state, **new_state}
                     return (params, opt_state, merged, si + 1), loss
 

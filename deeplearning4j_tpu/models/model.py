@@ -194,6 +194,12 @@ class Model:
         # fused fit chokepoints compose in front of the step program
         # (set for the duration of a fit over an advertising iterator)
         self._device_decode = None
+        # ZeRO-1 sharded weight update: the Zero1Placement installed by
+        # distribute(zero=1) (parallel/zero.py); None = the replicated
+        # update epilogue.  _placements remembers every tree's leaf
+        # shardings so recovery can re-place restored checkpoints.
+        self._zero_placement = None
+        self._placements = None
         # device-resident step counters of the grouped/TBPTT programs
         # (recovery resets them after a rollback rewinds `iteration`)
         self._multi_iter_dev = None
@@ -484,10 +490,29 @@ class Model:
         both safe by construction)."""
         import jax
 
+        def buffer_keys(leaf):
+            """Aliasing keys for one leaf: its Python identity plus —
+            for jax Arrays — every addressable shard's device-buffer
+            pointer.  A SHARDED tree (ZeRO-1 opt state) can be aliased
+            through a different Python object (a shard view pulled off
+            ``addressable_shards``, a re-wrapped jax.Array over the
+            same buffers), which plain id() tracking would miss."""
+            keys = [id(leaf)]
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards is not None:
+                for s in shards:
+                    try:
+                        keys.append(s.data.unsafe_buffer_pointer())
+                    except Exception:
+                        break     # backend without pointer introspection
+            return keys
+
         live = {
-            id(leaf) for leaf in jax.tree.leaves(
+            k
+            for leaf in jax.tree.leaves(
                 (self.params, self.opt_state, self.net_state)
             )
+            for k in buffer_keys(leaf)
         }
         for lst in self.listeners:
             attrs = getattr(lst, "__dict__", None)
@@ -501,7 +526,7 @@ class Model:
                 except Exception:
                     continue      # exotic containers: not our trees
                 for leaf in leaves:
-                    if id(leaf) in live:
+                    if any(k in live for k in buffer_keys(leaf)):
                         raise RuntimeError(
                             f"listener {type(lst).__name__}.{attr} "
                             "aliases the model's live param/opt-state "
@@ -512,6 +537,32 @@ class Model:
                             "or snapshot via train.listeners."
                             "_host_snapshot."
                         )
+
+    def _apply_grads(self, params, opt_state, grads):
+        """The SHARED update epilogue every step program traces (single,
+        grouped scan, TBPTT window, fused decode — Sequential and
+        Graph): optax update + param apply.  With the Zero1Placement
+        distribute(zero=1) installs, the same call becomes the sharded
+        epilogue — reduce-scatter grads, per-shard update against the
+        sharded opt state, all-gather params — so every step variant
+        differs from its replicated twin ONLY in update layout."""
+        import jax
+
+        zero = self._zero_placement
+        if zero is not None:
+            return zero.apply(self._tx, params, opt_state, grads)
+        updates, opt_state = self._tx.update(grads, opt_state, params)
+        params = jax.tree.map(
+            lambda p, u: (p + u.astype(p.dtype)), params, updates
+        )
+        return params, opt_state
+
+    def _step_key_suffix(self) -> tuple:
+        """Step-fn cache/program-registry key marker for the active
+        update epilogue: ZeRO-1 programs are DIFFERENT XLA programs
+        (sharded update + collectives), and the cost registry must not
+        attribute one's analysis to the other."""
+        return ("zero1",) if self._zero_placement is not None else ()
 
     def _register_program(self, key, fn):
         """Register a freshly built step program with the cost registry

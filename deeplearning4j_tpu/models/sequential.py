@@ -281,7 +281,7 @@ class SequentialModel(Model):
                 pipeline_train_1f1b,
                 split_microbatches,
             )
-            from deeplearning4j_tpu.runtime.mesh import PIPE_AXIS as _PA
+            from deeplearning4j_tpu.runtime.mesh import PIPE_AXIS as _PA, shard_map
 
             plan = self._pipeline_plan
             mesh = self._mesh
@@ -366,7 +366,7 @@ class SequentialModel(Model):
                         axis=_PA,
                     )
 
-                loss, seg_grads, dx_micro, post_grads = jax.shard_map(
+                loss, seg_grads, dx_micro, post_grads = shard_map(
                     inner,
                     mesh=mesh,
                     in_specs=(P(_PA), P(), P()),
@@ -390,10 +390,7 @@ class SequentialModel(Model):
                 )
                 loss = loss + self._reg_loss(params)
 
-                updates, opt_state = self._tx.update(grads, opt_state, params)
-                params = jax.tree.map(
-                    lambda p, u: p + u.astype(p.dtype), params, updates
-                )
+                params, opt_state = self._apply_grads(params, opt_state, grads)
                 merged_state = {**net_state, **st_pre}
                 return params, opt_state, merged_state, loss
 
@@ -510,12 +507,8 @@ class SequentialModel(Model):
         aux, new_state = pop_aux_losses(new_state)
         return data_loss + self._reg_loss(p) + aux, new_state, new_carries
 
-    def _apply_grads(self, params, opt_state, grads):
-        updates, opt_state = self._tx.update(grads, opt_state, params)
-        params = jax.tree.map(
-            lambda p, u: (p + u.astype(p.dtype)), params, updates
-        )
-        return params, opt_state
+    # _apply_grads — the shared update epilogue (replicated or ZeRO-1
+    # sharded) — lives on the Model base; every builder below calls it.
 
     def _get_step_fn(self, has_lmask: bool, has_fmask: bool, with_carries: bool,
                      decode=None):
@@ -527,6 +520,7 @@ class SequentialModel(Model):
         diverge."""
         key = (("train", has_lmask, has_fmask, with_carries)
                if decode is None else ("train_fused", decode.fingerprint))
+        key = key + self._step_key_suffix()
         if key not in self._step_fns:
 
             def core(params, opt_state, net_state, step_i, features,
@@ -602,7 +596,7 @@ class SequentialModel(Model):
         dispatch on a tunneled chip costs more than the window's compute
         (measured ~4ms dispatch vs ~1.4ms compute at BASELINE config 3),
         so the window loop belongs inside the program."""
-        key = ("train_tbptt", has_lmask, has_fmask)
+        key = ("train_tbptt", has_lmask, has_fmask) + self._step_key_suffix()
         if key not in self._step_fns:
             from deeplearning4j_tpu.nn.conf.recurrent import (
                 RecurrentLayerConfig,
@@ -679,7 +673,7 @@ class SequentialModel(Model):
         stacked batches, each iteration running the full window loop with
         freshly-zeroed RNN carries (batch boundaries reset state; window
         boundaries carry it) — k*W optimizer steps, ONE dispatch."""
-        key = ("train_tbptt_grouped",)
+        key = ("train_tbptt_grouped",) + self._step_key_suffix()
         if key not in self._step_fns:
             from deeplearning4j_tpu.nn.conf.recurrent import (
                 RecurrentLayerConfig,
@@ -784,7 +778,7 @@ class SequentialModel(Model):
             from deeplearning4j_tpu.parallel.compression import (
                 quantized_allreduce_tree,
             )
-            from deeplearning4j_tpu.runtime.mesh import DATA_AXIS
+            from deeplearning4j_tpu.runtime.mesh import DATA_AXIS, shard_map
 
             mesh = self._mesh
 
@@ -837,7 +831,7 @@ class SequentialModel(Model):
             @partial(jax.jit, donate_argnums=(0, 1, 2, 3))
             def step(params, opt_state, net_state, resid, step_i,
                      features, labels, lmask, fmask):
-                return jax.shard_map(
+                return shard_map(
                     shard_body,
                     mesh=mesh,
                     in_specs=(P(), P(), P(), P(DATA_AXIS), P(),
@@ -1015,6 +1009,7 @@ class SequentialModel(Model):
         raw stacked bytes in, k losses out."""
         key = (("train_multi",) if decode is None
                else ("train_multi_fused", decode.fingerprint))
+        key = key + self._step_key_suffix()
         if key not in self._step_fns:
             dec = None if decode is None else decode.fn
 

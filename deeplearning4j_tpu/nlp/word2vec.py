@@ -25,6 +25,7 @@ import numpy as np
 
 from deeplearning4j_tpu.nlp.tokenizer import CommonPreprocessor, DefaultTokenizerFactory
 from deeplearning4j_tpu.nlp.vocab import VocabCache
+from deeplearning4j_tpu.runtime.mesh import shard_map
 
 
 @functools.partial(jax.jit, donate_argnums=(0, 1))
@@ -83,7 +84,7 @@ def _make_ns_step_dp(mesh):
         )
         return syn0 + d0, syn1neg + d1, jax.lax.pmean(loss, "data")
 
-    mapped = jax.shard_map(
+    mapped = shard_map(
         body,
         mesh=mesh,
         in_specs=(P(), P(), P("data"), P("data"), P("data"), P()),

@@ -38,7 +38,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
 )
 from deeplearning4j_tpu.nn.weights import WeightInit
 from deeplearning4j_tpu.ops.attention import mha, ring_attention, ulysses_attention
-from deeplearning4j_tpu.runtime.mesh import SEQ_AXIS, active_mesh
+from deeplearning4j_tpu.runtime.mesh import SEQ_AXIS, active_mesh, shard_map
 from deeplearning4j_tpu.utils import serde
 
 _SEQ_MODES = ("none", "ring", "ulysses")
@@ -79,7 +79,7 @@ def _attend(q, k, v, *, causal: bool, mask, seq_parallel: str):
     spec = P(None, SEQ_AXIS)
     if mask is not None:
         fn = lambda q, k, v, m: core(q, k, v, axis=SEQ_AXIS, causal=causal, mask=m)
-        return jax.shard_map(
+        return shard_map(
             fn,
             mesh=mesh,
             in_specs=(spec, spec, spec, spec),
@@ -88,7 +88,7 @@ def _attend(q, k, v, *, causal: bool, mask, seq_parallel: str):
             check_vma=False,
         )(q, k, v, mask)
     fn = lambda q, k, v: core(q, k, v, axis=SEQ_AXIS, causal=causal)
-    return jax.shard_map(
+    return shard_map(
         fn,
         mesh=mesh,
         in_specs=(spec, spec, spec),

@@ -485,6 +485,19 @@ def _declare_core(reg: MetricsRegistry) -> None:
     reg.gauge("dl4jtpu_programs_registered",
               "Live compiled programs in the cost registry (dead "
               "models / cleared step-fn caches pruned)")
+    # ZeRO-1 sharded weight update (parallel/zero.py)
+    reg.gauge("dl4jtpu_opt_state_bytes",
+              "Per-replica optimizer-state bytes of the last "
+              "distribute()d model, by mode (sharded=ZeRO-1 data-axis "
+              "shards, replicated=classic DP) — the quantity zero=1 "
+              "shrinks ~1/n")
+    reg.counter("dl4jtpu_update_seconds_total",
+                "Calibrated standalone weight-update-epilogue seconds, "
+                "by mode (sharded/replicated).  The fused step program "
+                "hides the epilogue, so attribution times an "
+                "equivalent jitted update once per measurement "
+                "(parallel/zero.py measure_update_seconds; bench "
+                "--scaling's update_time_ms columns)")
     # step-timeline ring buffer (observe/trace.py)
     reg.counter("dl4jtpu_trace_spans_dropped_total",
                 "Spans evicted by trace ring-buffer wrap-around (the "

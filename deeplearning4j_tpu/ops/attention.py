@@ -26,6 +26,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deeplearning4j_tpu.runtime.mesh import axis_size
+
 
 def _scale(d: int) -> float:
     return 1.0 / (d**0.5)
@@ -107,7 +109,7 @@ def ring_attention(
     an inner scan — peak logits memory is O(B*H*T_local*block) instead of
     O(B*H*T_local*T_local).  block_size=None disables inner chunking.
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     idx = lax.axis_index(axis)
     b, t_local, h, d = q.shape
     scale = _scale(d)
@@ -174,8 +176,12 @@ def ring_attention(
     # axis — scan requires carry in/out types (incl. vma) to match
     if hasattr(lax, "pcast"):
         _vary = lambda x: lax.pcast(x, (axis,), to="varying")
-    else:  # older jax
+    elif hasattr(lax, "pvary"):
         _vary = lambda x: lax.pvary(x, (axis,))
+    else:
+        # 0.4.x shard_map has no varying-manual-axes typing at all
+        # (check_rep=False is the only mode we run): nothing to cast
+        _vary = lambda x: x
     o0 = _vary(jnp.zeros((b, h, t_local, d), jnp.float32))
     m0 = _vary(jnp.full((b, h, t_local), -jnp.inf, jnp.float32))
     l0 = _vary(jnp.zeros((b, h, t_local), jnp.float32))
@@ -203,7 +209,7 @@ def ulysses_attention(
     q,k,v local: (B, T_local, H, D) -> returns (B, T_local, H, D).
     mask: local (B, T_local) keep-mask (all-gathered internally).
     """
-    n = lax.axis_size(axis)
+    n = axis_size(axis)
     h = q.shape[2]
     if h % n:
         raise ValueError(f"ulysses needs heads ({h}) divisible by axis size ({n})")

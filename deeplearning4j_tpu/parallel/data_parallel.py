@@ -27,6 +27,7 @@ from deeplearning4j_tpu.parallel.strategy import (
     param_specs,
     replicate,
     shard_params,
+    shard_zero1,
 )
 from deeplearning4j_tpu.runtime.mesh import (
     DATA_AXIS,
@@ -47,6 +48,29 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
 
     tp = MODEL_AXIS in mesh.axis_names and mesh.shape[MODEL_AXIS] > 1
     ep = EXPERT_AXIS in mesh.axis_names and mesh.shape[EXPERT_AXIS] > 1
+    pp = PIPE_AXIS in mesh.axis_names and mesh.shape[PIPE_AXIS] > 1
+    sp_on = SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1
+
+    # ZeRO stage: config wins, else the env knob (DL4J_TPU_ZERO)
+    zero = config.zero
+    if zero is None:
+        from deeplearning4j_tpu.runtime.flags import environment
+
+        zero = environment().zero
+    if zero not in (0, 1):
+        raise ValueError(
+            f"unknown zero stage {zero!r}; options: 0 (replicated "
+            "update), 1 (sharded opt state + update)"
+        )
+    if zero == 1 and (tp or ep or pp or sp_on
+                      or config.grad_compression != "none"):
+        raise ValueError(
+            "zero=1 composes with pure data parallelism only (the "
+            "weight-update shards ride the data axis); drop the "
+            "model/pipe/seq/expert axes and grad_compression, or the "
+            "zero stage"
+        )
+
     if tp or ep:
         specs = param_specs(
             model.params, model.conf,
@@ -58,9 +82,25 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
     else:
         model.params = replicate(model.params, mesh)
     model.net_state = replicate(model.net_state, mesh)
-    model.opt_state = replicate(model.opt_state, mesh)
+    from deeplearning4j_tpu.parallel import zero as zero_mod
 
-    pp = PIPE_AXIS in mesh.axis_names and mesh.shape[PIPE_AXIS] > 1
+    if zero == 1:
+        # ZeRO-1: opt state lives sharded over the data axis; the step
+        # programs' update epilogue (Zero1Placement.apply via
+        # Model._apply_grads) reduce-scatters grads, updates per shard
+        # and all-gathers params
+        model.opt_state = shard_zero1(model.opt_state, mesh)
+        model._zero_placement = zero_mod.Zero1Placement.build(
+            model.params, model.opt_state, mesh
+        )
+    else:
+        model.opt_state = replicate(model.opt_state, mesh)
+        # a prior distribute(zero=1) must not leak its epilogue into
+        # the re-placed replicated state
+        model._zero_placement = None
+    zero_mod.gauge_opt_state_bytes(
+        model, "sharded" if zero == 1 else "replicated"
+    )
     if pp:
         if not hasattr(model, "_setup_pipeline"):
             raise NotImplementedError(
@@ -82,7 +122,6 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
         model._grad_compression = None
         model._grad_residual = None
     if config.grad_compression != "none":
-        sp_on = SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1
         if tp or ep or pp or sp_on:
             raise ValueError(
                 "grad_compression composes with pure data parallelism only "
@@ -96,8 +135,17 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
             )
         model._setup_grad_compression(mesh)
 
-    sp = SEQ_AXIS if SEQ_AXIS in mesh.axis_names and mesh.shape[SEQ_AXIS] > 1 else None
+    sp = SEQ_AXIS if sp_on else None
     model._mesh = mesh
+    # remember each tree's leaf placements: recovery's rollback restores
+    # host arrays from a checkpoint and must RE-PLACE them identically
+    # (replicated params + ZeRO-sharded opt state), or the next donated
+    # step would silently run single-device
+    model._placements = {
+        "params": jax.tree.map(lambda a: a.sharding, model.params),
+        "opt_state": jax.tree.map(lambda a: a.sharding, model.opt_state),
+        "net_state": jax.tree.map(lambda a: a.sharding, model.net_state),
+    }
     # drop any step functions compiled before distribution: mesh-dependent
     # layer lowerings (seq-parallel attention) and shardings are baked in
     # at trace time

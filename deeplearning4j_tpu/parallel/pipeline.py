@@ -22,6 +22,8 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
+from deeplearning4j_tpu.runtime.mesh import axis_size, shard_map
+
 
 def pipeline_apply(
     stage_fn: Callable,
@@ -40,7 +42,7 @@ def pipeline_apply(
     Returns (n_micro, B_micro, ...) outputs valid on the LAST stage
     (read them with an out_spec that takes the last pipe shard).
     """
-    n_stages = lax.axis_size(axis)
+    n_stages = axis_size(axis)
     stage = lax.axis_index(axis)
     n_micro = x_micro.shape[0]
     total = n_micro + n_stages - 1
@@ -125,7 +127,7 @@ def pipeline_train_1f1b(
     segment), and — iff loss_grad_fn returns a third element — the
     accumulated extra grads, averaged over microbatches.
     """
-    n_stages = lax.axis_size(axis)
+    n_stages = axis_size(axis)
     stage = lax.axis_index(axis)
     n_micro = x_micro.shape[0]
     total = n_micro + 2 * n_stages - 2
@@ -384,7 +386,7 @@ def run_pipelined_segment(plan: PipelinePlan, params, x, *, mesh, axis: str,
         return h
 
     x_micro = split_microbatches(x, plan.n_micro)
-    out = jax.shard_map(
+    out = shard_map(
         lambda sp, xm: pipeline_apply(
             stage_fn, jax.tree.map(lambda a: a[0], sp), xm, axis=axis
         ),

@@ -54,6 +54,14 @@ class ParallelConfig:
     # axis (the DCN-bandwidth play; see parallel/compression.py).
     # "none": full-precision GSPMD AllReduce (always right over ICI).
     grad_compression: str = "none"
+    # ZeRO weight-update sharding stage ("Automatic Cross-Replica
+    # Sharding of Weight Update in Data-Parallel Training"):
+    #   0    -> replicated opt state + update (classic DP)
+    #   1    -> opt state and the update computation sharded over the
+    #           data axis: reduce-scatter grads -> per-shard optimizer
+    #           update -> all-gather params (parallel/zero.py)
+    #   None -> read flags.environment().zero (env DL4J_TPU_ZERO)
+    zero: int | None = None
 
     def mesh_spec(self) -> MeshSpec:
         # the data axis is ALWAYS present (size 1 degrades gracefully) so
@@ -205,6 +213,60 @@ def shard_params(params, mesh: Mesh, specs) -> object:
         return put_global(p, NamedSharding(mesh, s), full_value=True)
 
     return jax.tree.map(place, params, specs)
+
+
+# -- ZeRO-1 weight-update sharding rules ------------------------------------
+
+def zero1_spec_for_leaf(leaf, n: int, data_axis: str = DATA_AXIS) -> P:
+    """PartitionSpec for ONE param/grad/opt-state leaf under ZeRO-1:
+    shard the LARGEST evenly-divisible dim over the data axis (SNIPPETS
+    [3]'s naive-sharding shape generalized past dim 0 — conv HWIO
+    kernels' big dim is the trailing output-feature one).  Scalars and
+    leaves with no dim divisible by n replicate — the memory win lives
+    in the big tensors, and an uneven split would force GSPMD into
+    padded collectives for no gain."""
+    ndim = getattr(leaf, "ndim", 0)
+    shape = tuple(getattr(leaf, "shape", ()))
+    best = -1
+    for i, d in enumerate(shape):
+        if d >= n and d % n == 0 and (best < 0 or d > shape[best]):
+            best = i
+    if ndim >= 1 and best >= 0:
+        return P(*([None] * best + [data_axis]))
+    return P()
+
+
+def zero1_specs(tree, n: int, data_axis: str = DATA_AXIS):
+    """PartitionSpec pytree for a param-shaped tree (params, grads, or
+    an optax opt_state whose momentum/variance leaves mirror params)
+    under ZeRO-1 update sharding over `data_axis` with n shards."""
+    return jax.tree.map(
+        lambda leaf: zero1_spec_for_leaf(leaf, n, data_axis), tree
+    )
+
+
+def zero1_shardings(tree, mesh: Mesh, data_axis: str = DATA_AXIS):
+    """NamedSharding pytree matching `tree` for ZeRO-1 placement."""
+    n = mesh.shape[data_axis]
+    return jax.tree.map(
+        lambda leaf: NamedSharding(
+            mesh, zero1_spec_for_leaf(leaf, n, data_axis)
+        ),
+        tree,
+    )
+
+
+def shard_zero1(tree, mesh: Mesh, data_axis: str = DATA_AXIS):
+    """Place a param-shaped tree (typically the optimizer state) with
+    each leaf's ZeRO-1 sharding — the distribute(zero=1) placement that
+    replaces replicate() for opt_state.  Multi-process meshes stitch
+    global arrays from identical host copies, same as shard_params."""
+    from deeplearning4j_tpu.runtime.distributed import put_global
+
+    shardings = zero1_shardings(tree, mesh, data_axis)
+    return jax.tree.map(
+        lambda p, s: put_global(p, s, full_value=True), tree, shardings
+    )
 
 
 def replicate(tree, mesh: Mesh):

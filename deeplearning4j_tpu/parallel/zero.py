@@ -1,0 +1,180 @@
+"""ZeRO-1 sharded weight update for the data-parallel path.
+
+"Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+Training" (PAPERS.md) observes that classic DP wastes O(model) memory
+and compute per replica: every chip holds the full optimizer state and
+runs the full weight update after an AllReduce already made the summed
+gradient identical everywhere.  GSPMD makes the fix expressible as
+sharding annotations alone — no manual collectives:
+
+    reduce-scatter grads  ->  per-shard optimizer update  ->  all-gather params
+
+`distribute(model, ParallelConfig(zero=1))` (parallel/data_parallel.py)
+places ``model.opt_state`` with each leaf's leading dim sharded over the
+data axis (strategy.shard_zero1) and installs a `Zero1Placement` whose
+`apply()` is the models' shared update epilogue
+(`Model._apply_grads`): it pins gradients to the same shards, runs the
+optax update on 1/n of every big leaf, and constrains the new params
+back to replicated.  XLA's SPMD partitioner turns the annotations into
+the reduce-scatter / all-gather pair (on backends without a fused
+reduce-scatter it emits the equivalent all-reduce + dynamic-slice).
+
+Per-replica optimizer-state memory and update compute both drop to
+~1/n for every leaf whose leading dim divides the data-axis size;
+ragged/small leaves stay replicated (strategy.zero1_spec_for_leaf).
+
+Composition: pure data parallelism only — tensor/pipeline/sequence/
+expert axes and gradient compression raise at distribute() time, the
+same contract grad_compression declares.  Params themselves stay
+replicated (ZeRO-1, not ZeRO-3): inference, evaluate() and the
+checkpoint format are unchanged.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import time
+from typing import Any
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from deeplearning4j_tpu.runtime.mesh import DATA_AXIS
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+@dataclasses.dataclass
+class Zero1Placement:
+    """The sharding trees one distribute(zero=1) call derives, closed
+    over by every step program the model builds afterwards.  `apply` is
+    traced INSIDE the jitted step — it must stay pure."""
+
+    mesh: Mesh
+    n: int
+    # PartitionSpec-bearing NamedSharding pytrees
+    grad_shardings: Any       # params-shaped: grads + updates pin here
+    opt_shardings: Any        # opt_state-shaped
+    param_shardings: Any      # params-shaped, all replicated
+
+    @staticmethod
+    def build(params, opt_state, mesh: Mesh,
+              data_axis: str = DATA_AXIS) -> "Zero1Placement":
+        from deeplearning4j_tpu.parallel.strategy import zero1_shardings
+
+        n = mesh.shape[data_axis]
+        rep = NamedSharding(mesh, P())
+        return Zero1Placement(
+            mesh=mesh,
+            n=n,
+            grad_shardings=zero1_shardings(params, mesh, data_axis),
+            opt_shardings=zero1_shardings(opt_state, mesh, data_axis),
+            param_shardings=jax.tree.map(lambda _: rep, params),
+        )
+
+    def apply(self, tx, params, opt_state, grads):
+        """The sharded update epilogue (traced): constrain grads to the
+        update shards (GSPMD lowers the DP gradient sum into a
+        reduce-scatter), run the optax update per-shard against the
+        sharded opt state, and gather the updated params back to
+        replicated.  Numerics are the replicated epilogue's exactly —
+        only the layout of the update computation changes."""
+        wsc = jax.lax.with_sharding_constraint
+        grads = wsc(grads, self.grad_shardings)
+        updates, opt_state = tx.update(grads, opt_state, params)
+        updates = wsc(updates, self.grad_shardings)
+        params = jax.tree.map(
+            lambda p, u: p + u.astype(p.dtype), params, updates
+        )
+        params = wsc(params, self.param_shardings)
+        opt_state = wsc(opt_state, self.opt_shardings)
+        return params, opt_state
+
+
+# -- accounting --------------------------------------------------------------
+
+def leaf_bytes_per_replica(leaf) -> int:
+    """Bytes ONE replica holds for `leaf`: the shard size for arrays
+    carrying a NamedSharding, full nbytes otherwise."""
+    sharding = getattr(leaf, "sharding", None)
+    shape = getattr(leaf, "shape", None)
+    if shape is None:
+        return 0
+    itemsize = np.dtype(leaf.dtype).itemsize
+    if sharding is not None:
+        try:
+            shard_shape = sharding.shard_shape(tuple(shape))
+            return int(np.prod(shard_shape, dtype=np.int64)) * itemsize
+        except Exception:
+            pass
+    return int(np.prod(shape, dtype=np.int64)) * itemsize
+
+
+def opt_state_bytes_per_replica(opt_state) -> int:
+    """Per-replica bytes of an optimizer-state pytree — the quantity
+    ZeRO-1 shrinks ~1/n (and the `dl4jtpu_opt_state_bytes` gauge)."""
+    return sum(
+        leaf_bytes_per_replica(leaf) for leaf in jax.tree.leaves(opt_state)
+    )
+
+
+def gauge_opt_state_bytes(model, mode: str) -> int:
+    """Refresh the `dl4jtpu_opt_state_bytes` gauge for this model's
+    current opt-state placement.  mode: "sharded" | "replicated"."""
+    total = opt_state_bytes_per_replica(model.opt_state)
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        g = registry().gauge("dl4jtpu_opt_state_bytes")
+        g.clear()       # one live series: the model's current placement
+        g.set(total, mode=mode)
+    except Exception as e:      # telemetry must never fail placement
+        log.debug("opt-state bytes gauge failed: %s", e)
+    return total
+
+
+# -- update-epilogue attribution ---------------------------------------------
+
+def measure_update_seconds(model, iters: int = 5) -> float:
+    """Calibrated wall seconds of ONE standalone weight-update epilogue
+    (grads + opt state + params -> new params/opt state) under the
+    model's CURRENT placement — the fused step program hides the
+    epilogue, so attribution times an equivalent standalone jitted
+    program, exactly like datavec's device-decode calibration.  The
+    measured seconds are added to `dl4jtpu_update_seconds_total`
+    (labeled by mode) and returned.
+
+    Zero-gradient inputs are used: the epilogue's cost is layout +
+    collectives + elementwise math, none of it data-dependent."""
+    zero = getattr(model, "_zero_placement", None)
+    params = model.params
+    opt_state = model.opt_state
+    grads = jax.tree.map(
+        lambda p: jax.numpy.zeros(p.shape, p.dtype), params
+    )
+    if zero is not None:
+        grads = jax.device_put(grads, zero.param_shardings)
+
+    # jit the model's OWN epilogue — the exact code every step program
+    # traces — so the attribution cannot drift from what training runs
+    fn = jax.jit(lambda p, o, g: model._apply_grads(p, o, g))
+    # warm (compile) outside the timed window
+    out = fn(params, opt_state, grads)
+    jax.block_until_ready(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(params, opt_state, grads)
+    jax.block_until_ready(out)
+    secs = (time.perf_counter() - t0) / iters
+    mode = "sharded" if zero is not None else "replicated"
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        registry().counter("dl4jtpu_update_seconds_total").inc(
+            secs * iters, mode=mode
+        )
+    except Exception as e:
+        log.debug("update-seconds counter failed: %s", e)
+    return secs

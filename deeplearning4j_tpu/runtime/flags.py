@@ -56,6 +56,12 @@ class Environment:
     watchdog_enabled: bool = True
     watchdog_floor_s: float = 30.0
     watchdog_k: float = 10.0
+    # ZeRO weight-update sharding stage for distribute()'s data-parallel
+    # path (parallel/zero.py): 0 = replicated optimizer state + update
+    # (the classic DP step), 1 = opt state and the update computation
+    # sharded over the data axis (reduce-scatter grads -> per-shard
+    # update -> all-gather params).  ParallelConfig(zero=...) overrides.
+    zero: int = 0
 
     def set_nan_panic(self, on: bool) -> None:
         self.nan_panic = on
@@ -79,6 +85,7 @@ class Environment:
                 os.environ.get("DL4J_TPU_WATCHDOG_FLOOR", "30")
             ),
             watchdog_k=float(os.environ.get("DL4J_TPU_WATCHDOG_K", "10")),
+            zero=int(os.environ.get("DL4J_TPU_ZERO", "0")),
         )
         if _env_bool("DL4J_TPU_NAN_PANIC"):
             env.set_nan_panic(True)

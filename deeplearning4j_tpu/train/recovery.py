@@ -233,10 +233,18 @@ class RecoveryPolicy:
         from deeplearning4j_tpu.observe.health import HealthListener
 
         if getattr(model, "_batch_sharding", None) is not None:
-            raise ValueError(
-                "RecoveryPolicy is single-process only; distributed "
-                "models recover via ElasticWorkerLoop respawn"
-            )
+            # single-PROCESS multi-device meshes (distribute() over
+            # local chips, incl. ZeRO-1) roll back fine — _install
+            # re-places restored state onto the recorded shardings.
+            # Multi-host worlds keep elastic respawn: a host-local
+            # rollback would fork the replicas' state.
+            import jax
+
+            if jax.process_count() > 1:
+                raise ValueError(
+                    "RecoveryPolicy is single-process only; multi-host "
+                    "models recover via ElasticWorkerLoop respawn"
+                )
         model._recovery = self
         self._base_tx = model._tx
         hl = next(
@@ -473,9 +481,36 @@ class RecoveryPolicy:
             wd.ewma = None
 
     @staticmethod
+    def _place_like(tree, shardings):
+        """Re-place a restored (host/default-device) tree onto the
+        shardings distribute() recorded — without this, a rollback on a
+        distributed model would hand the next donated step unplaced
+        arrays and training would silently decay to one device (and,
+        under ZeRO-1, mismatch the program's sharded opt-state layout)."""
+        import jax
+
+        return jax.tree.map(
+            lambda a, s: jax.device_put(a, s), tree, shardings
+        )
+
+    @staticmethod
     def _install(model, restored) -> None:
         """Copy a restored model's state into the live model (structure
-        is identical — both were built from the same conf)."""
+        is identical — both were built from the same conf).  Distributed
+        models re-place every tree onto its recorded shardings
+        (replicated params, ZeRO-sharded opt state)."""
+        placements = getattr(model, "_placements", None)
+        if placements is not None:
+            restored.params = RecoveryPolicy._place_like(
+                restored.params, placements["params"]
+            )
+            restored.net_state = RecoveryPolicy._place_like(
+                restored.net_state, placements["net_state"]
+            )
+            if restored.opt_state is not None:
+                restored.opt_state = RecoveryPolicy._place_like(
+                    restored.opt_state, placements["opt_state"]
+                )
         model.params = restored.params
         model.net_state = restored.net_state
         if restored.opt_state is not None and model.opt_state is not None:

@@ -13,6 +13,13 @@ orbax-style" checkpointing SURVEY calls for once multi-host exists.
 The model's config/counters ride along as JSON metadata, so
 `ShardedCheckpointer.restore_model()` can rebuild the model object the
 same way ModelSerializer.restore does.
+
+ZeRO-1 (distribute(zero=1), parallel/zero.py): the opt-state leaves
+arrive here SHARDED over the data axis and stay that way end to end —
+save() writes each process's shards without a host gather, and
+`_abstract_like` pins restore targets to the model's live shardings, so
+restore_into() lands every shard directly back on its devices
+(gather-free round-trip; tests/test_zero1.py).
 """
 
 from __future__ import annotations

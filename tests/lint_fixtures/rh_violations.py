@@ -69,3 +69,21 @@ def donation_loop_no_rebind(params, opt, xs):
         _, _, y = donated_step(params, opt, x)  # line 69: RH105
         out.append(y)
     return out
+
+
+def donation_shard_view(params, opt, xs):
+    new_p, new_o, y = donated_step(params, opt, xs)
+    # shard-aware: a LONGER chain through the donated name still reads
+    # the freed buffers (ZeRO-sharded opt state pulled apart via
+    # addressable_shards)
+    shards = opt.addressable_shards      # line 79: RH105 (through opt)
+    return new_p, new_o, shards
+
+
+def donation_metadata_ok(params, opt, xs):
+    new_p, new_o, y = donated_step(params, opt, xs)
+    # metadata survives donation (jax keeps aval/sharding on a deleted
+    # Array) — NOT findings
+    shape = params.shape
+    spec = opt.sharding
+    return new_p, new_o, shape, spec

@@ -66,6 +66,7 @@ class TestGoldenFixtures:
             ("RH105", 52),       # params read after donation
             ("RH105", 53),       # opt read after donation
             ("RH105", 69),       # loop back-edge: re-donation, no rebind
+            ("RH105", 79),       # shard view through a donated tree
         ]
         # the negative space: static_argnames params, .ndim/.shape
         # branches (lines 27/29), and donated args REBOUND from the
@@ -292,6 +293,10 @@ class TestTier1Gate:
             "dl4jtpu_trace_spans_dropped_total", "dl4jtpu_build_info",
             "dl4jtpu_fleet_workers", "dl4jtpu_fleet_step_latency_skew",
             "dl4jtpu_fleet_stragglers",
+        } <= fams
+        # ISSUE-10 ZeRO-1 sharded-update families
+        assert {
+            "dl4jtpu_opt_state_bytes", "dl4jtpu_update_seconds_total",
         } <= fams
         sites = load_fault_sites(REPO)
         assert sites == {

@@ -26,8 +26,9 @@ def test_scaling_bench_runs_on_cpu_mesh():
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
     assert out["platform"] == "cpu"
-    # schema 2 (ISSUE 8): environment provenance + flops/mfu columns
-    assert out["schema"] == "bench-scaling/2"
+    # schema 3 (ISSUE 10): schema 2's provenance + flops/mfu columns
+    # plus the ZeRO-1 sharded-update columns
+    assert out["schema"] == "bench-scaling/3"
     assert out["env"]["jax"] and out["env"]["device_count"] == 8
     assert "flags" in out["env"]
     assert [r["devices"] for r in out["rows"]] == [1, 2, 4, 8]
@@ -57,6 +58,21 @@ def test_scaling_bench_runs_on_cpu_mesh():
         assert r["model_flops_per_step"] > 0
         assert r["mfu"] > 0
         assert r["roofline"] in ("compute-bound", "memory-bound")
+        # ZeRO-1 sharded weight update columns (ISSUE 10): per-replica
+        # opt-state footprint shrinks ~1/n vs replicated on n>1 meshes,
+        # and the sharded update epilogue is measured next to the
+        # replicated one
+        assert r["peak_opt_state_bytes_per_replica"] > 0
+        assert r["peak_opt_state_bytes_per_replica_replicated"] > 0
+        assert r["update_time_ms"] > 0
+        assert r["update_time_ms_replicated"] > 0
+        assert r["zero1_speedup"] is not None
+        if r["devices"] > 1:
+            shrink = (r["peak_opt_state_bytes_per_replica"]
+                      / r["peak_opt_state_bytes_per_replica_replicated"])
+            # ~1/n with a small replicated remainder (tiny biases,
+            # optax counters)
+            assert shrink < 1.5 / r["devices"] + 0.05
     assert fw[0]["mechanism_efficiency"] == 1.0
     ip = out["input_pipeline"]
     assert ip["async_feed_samples_per_sec"] > 0

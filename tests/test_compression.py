@@ -14,7 +14,7 @@ from deeplearning4j_tpu.parallel.compression import (
     quantized_psum,
     zeros_residual,
 )
-from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh, shard_map
 
 N = 4
 
@@ -26,7 +26,7 @@ def mesh():
 
 def _psum_mean(mesh, x_shards, key_seed=0):
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda x: quantized_psum(
                 x[0], axis="data", key=jax.random.key(key_seed)
             )[0][None],
@@ -74,7 +74,7 @@ def test_error_feedback_residual_bounded(mesh):
         )
         return synced["w"][None], new_r["w"][None]
 
-    f = jax.jit(jax.shard_map(
+    f = jax.jit(shard_map(
         body, mesh=mesh, in_specs=(P("data"), P("data")),
         out_specs=(P("data"), P("data")), check_vma=False,
     ))
@@ -106,7 +106,7 @@ def test_compressed_sgd_matches_exact_convergence(mesh):
                 return w - lr * synced["w"], new_r["w"][None]
             return w - lr * jax.lax.pmean(g, "data"), r
 
-        f = jax.jit(jax.shard_map(
+        f = jax.jit(shard_map(
             body, mesh=mesh,
             in_specs=(P(), P("data"), P("data"), P("data"), P("data")),
             out_specs=(P(), P("data")), check_vma=False,

@@ -23,7 +23,7 @@ from deeplearning4j_tpu.parallel import (
     ParallelWrapper,
     distribute,
 )
-from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh, shard_map
 
 
 def two_class_data(n=512, seed=0):
@@ -140,7 +140,7 @@ def test_pipeline_matches_sequential_stack():
         ref = stage(ws[s], ref)
 
     piped = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda w, xm: pipeline_apply(stage, w[0], xm, axis="pipe"),
             mesh=mesh,
             in_specs=(P("pipe"), P()),
@@ -171,7 +171,7 @@ def test_pipeline_is_differentiable():
         return jnp.tanh(h @ w)
 
     def loss(ws, x):
-        piped = jax.shard_map(
+        piped = shard_map(
             lambda w, xm: pipeline_apply(stage, w[0], xm, axis="pipe"),
             mesh=mesh,
             in_specs=(P("pipe"), P()),

@@ -19,7 +19,7 @@ from deeplearning4j_tpu.parallel.pipeline import (
     pipeline_train_1f1b,
     split_microbatches,
 )
-from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh
+from deeplearning4j_tpu.runtime.mesh import MeshSpec, make_mesh, shard_map
 
 K = 4          # stages
 D = 8
@@ -75,7 +75,7 @@ def _run_1f1b(mesh, ws, x, labels):
         return loss, jax.tree.map(lambda g: g[None], grads), dx
 
     f = jax.jit(
-        jax.shard_map(
+        shard_map(
             inner, mesh=mesh,
             in_specs=(P("pipe"), P(), P()),
             out_specs=(P(), P("pipe"), P()),
@@ -118,7 +118,7 @@ def test_1f1b_matches_gpipe_forward(mesh):
     ws, x, labels = _setup(3)
     x_micro = split_microbatches(x, N_MICRO)
     piped = jax.jit(
-        jax.shard_map(
+        shard_map(
             lambda w, xm: pipeline_apply(stage_fn, w[0], xm, axis="pipe"),
             mesh=mesh, in_specs=(P("pipe"), P()), out_specs=P(),
             check_vma=False,
