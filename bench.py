@@ -1883,6 +1883,300 @@ def bench_chaos() -> None:
     }))
 
 
+def bench_serving() -> None:
+    """bench.py --serving: the serving plane under load and under chaos
+    -> BENCH_SERVING.json.
+
+    Three phases over one small model:
+
+      1. **curve** — closed-loop throughput-vs-latency at increasing
+         client counts (achieved rps, p50/p99, batch occupancy, sheds);
+      2. **warm start** — a FRESH replica warm-starts its bucket set,
+         and its first request must land within 1.5x of steady-state
+         (the AOT-at-boot acceptance);
+      3. **chaos** — a seeded fault plan injects admit delays, a burst
+         of infer hangs (blowing the per-batch watchdog deadline and
+         tripping the breaker) and a torn hot-swap push, under an
+         overload of short-deadline clients against a small queue.  The
+         server must complete the run: every overloaded request is shed
+         with an explicit rejection (client-side accounting proves no
+         silent drops), the breaker trips AND recovers, a good swap
+         installs after the torn one rolls back, and post-chaos p99
+         returns to within 2x of the unfaulted baseline.
+
+    CPU by default (the subject is the serving control plane, not
+    device throughput); BENCH_SERVING_PLATFORM overrides.  Quick mode
+    (BENCH_QUICK=1) shrinks the windows and does NOT rewrite the
+    committed BENCH_SERVING.json."""
+    import tempfile
+    import threading
+
+    import jax
+
+    jax.config.update(
+        "jax_platforms", os.environ.get("BENCH_SERVING_PLATFORM", "cpu")
+    )
+    import numpy as np
+
+    from deeplearning4j_tpu.models import SequentialModel
+    from deeplearning4j_tpu.nn.conf import (
+        Dense, InputType, NeuralNetConfiguration, OutputLayer,
+    )
+    from deeplearning4j_tpu.runtime import faults
+    from deeplearning4j_tpu.serving import (
+        InferenceServer, ServingConfig, ServingError, ServingRejected,
+        ServingTimeout, weights_checksum,
+    )
+
+    os.environ.setdefault(
+        "DL4JTPU_CRASH_DIR",
+        os.path.join(tempfile.mkdtemp(prefix="dl4jtpu-serving-"), "crash"),
+    )
+    n_in, n_out = 16, 4
+    conf = (
+        NeuralNetConfiguration.builder().seed(7).list()
+        .layer(Dense(n_out=32)).layer(OutputLayer(n_out=n_out))
+        .set_input_type(InputType.feed_forward(n_in)).build()
+    )
+    example = np.zeros((n_in,), np.float32)
+
+    def make_server(max_queue=64):
+        model = SequentialModel(conf).init()
+        return InferenceServer(model, ServingConfig(
+            max_batch=8, max_queue=max_queue, linger_s=0.001,
+            breaker_threshold=3, breaker_probe_after_s=0.2,
+        ))
+
+    def run_load(srv, clients, duration_s, deadline_s):
+        """Closed-loop load: every request's outcome is recorded from
+        the CLIENT side — ok/shed/error/timeout must add up to issued,
+        which is the no-silent-drops proof."""
+        stop = threading.Event()
+        lock = threading.Lock()
+        tally = {"issued": 0, "ok": 0, "errors": 0, "timeouts": 0}
+        shed: dict = {}
+        lats: list = []
+
+        def client(cid):
+            rng = np.random.default_rng(cid)
+            local_lats = []
+            while not stop.is_set():
+                x = rng.normal(size=(n_in,)).astype(np.float32)
+                t0 = time.monotonic()
+                outcome, reason = "ok", None
+                try:
+                    srv.infer(x, deadline_s=deadline_s)
+                    local_lats.append(time.monotonic() - t0)
+                except ServingRejected as e:
+                    outcome, reason = "shed", e.reason
+                except ServingTimeout:
+                    outcome = "timeouts"
+                except ServingError:
+                    outcome = "errors"
+                with lock:
+                    tally["issued"] += 1
+                    if outcome == "ok":
+                        tally["ok"] += 1
+                    elif outcome == "shed":
+                        shed[reason] = shed.get(reason, 0) + 1
+                    else:
+                        tally[outcome] += 1
+            with lock:
+                lats.extend(local_lats)
+
+        threads = [
+            threading.Thread(target=client, args=(i,)) for i in range(clients)
+        ]
+        t0 = time.time()
+        for t in threads:
+            t.start()
+        time.sleep(duration_s)
+        stop.set()
+        for t in threads:
+            t.join(30)
+        wall = time.time() - t0
+        lats.sort()
+
+        def pct(p):
+            return (
+                round(lats[min(len(lats) - 1, int(p * len(lats)))] * 1000, 3)
+                if lats else None
+            )
+
+        return {
+            **tally,
+            "shed_by_reason": shed,
+            "shed": sum(shed.values()),
+            "achieved_rps": round(tally["ok"] / wall, 1),
+            "p50_ms": pct(0.50),
+            "p99_ms": pct(0.99),
+            "wall_s": round(wall, 2),
+        }
+
+    window = 0.6 if QUICK else 2.5
+    client_points = (2, 8) if QUICK else (1, 2, 4, 8, 16)
+
+    # -- phase 1: throughput-vs-latency curve ------------------------------
+    srv = make_server()
+    srv.warm_start(example)
+    srv.start()
+    curve = []
+    for clients in client_points:
+        srv.reset_latency_window()
+        row = run_load(srv, clients, window, deadline_s=2.0)
+        row["clients"] = clients
+        row["batch_occupancy"] = srv.stats()["batch_occupancy"]
+        curve.append(row)
+        print(f"[bench] serving curve clients={clients}: "
+              f"{json.dumps(row)}", file=sys.stderr)
+
+    # -- phase 2: AOT warm start on a FRESH replica ------------------------
+    replica = make_server()
+    warmed = replica.warm_start(example)
+    replica.start()
+    t0 = time.monotonic()
+    replica.infer(example, deadline_s=30.0)
+    first_ms = (time.monotonic() - t0) * 1000.0
+    steady = []
+    for _ in range(40 if QUICK else 200):
+        t0 = time.monotonic()
+        replica.infer(example, deadline_s=30.0)
+        steady.append((time.monotonic() - t0) * 1000.0)
+    steady.sort()
+    steady_p50 = steady[len(steady) // 2]
+    warm_row = {
+        "warmed_programs": len(warmed),
+        "first_request_ms": round(first_ms, 3),
+        "steady_p50_ms": round(steady_p50, 3),
+        "first_request_ratio": round(first_ms / steady_p50, 3),
+    }
+    replica.stop()
+    print(f"[bench] serving warm start: {json.dumps(warm_row)}",
+          file=sys.stderr)
+
+    # -- phase 3: chaos ----------------------------------------------------
+    # a burst of three CONSECUTIVE infer hangs (nth clauses share the
+    # site's consult counter) blows the shrunken per-batch deadline and
+    # trips the threshold-3 breaker; admit delays slow the front door;
+    # the first hot-swap push is torn and must roll back
+    hang_at = 8 if QUICK else 20
+    plan = (
+        "serving.admit:delay:every=5,secs=0.01;"
+        f"serving.infer:delay:nth={hang_at},secs=0.3;"
+        f"serving.infer:delay:nth={hang_at + 1},secs=0.3;"
+        f"serving.infer:delay:nth={hang_at + 2},secs=0.3;"
+        "serving.hotswap:truncate:nth=1"
+    )
+    chaos_srv = make_server(max_queue=8)
+    chaos_srv.warm_start(example)
+    chaos_srv.start()
+    baseline = run_load(chaos_srv, 4, window, deadline_s=2.0)
+    model = chaos_srv.model
+    good_params = jax.tree.map(lambda a: a + 0.01, model.params)
+    chaos_srv.config.dispatch_timeout_s = 0.05
+    chaos_srv._watchdog.floor_s = 0.05
+    faults.arm(plan)
+    swap_results = {}
+    try:
+        # overload: 12 short-deadline clients against a queue of 8
+        loader = threading.Thread(
+            target=lambda: swap_results.update(
+                chaos_window=run_load(
+                    chaos_srv, 12, window * 2, deadline_s=0.08,
+                )
+            )
+        )
+        loader.start()
+        time.sleep(window * 0.5)
+        swap_results["torn_push_installed"] = chaos_srv.push_weights(
+            jax.tree.map(lambda a: a * 2.0, model.params)
+        )
+        loader.join(120)
+    finally:
+        faults.disarm()
+        chaos_srv.config.dispatch_timeout_s = 10.0
+        chaos_srv._watchdog.floor_s = 10.0
+    # after the storm: a clean push must install...
+    swap_results["good_push_installed"] = chaos_srv.push_weights(
+        good_params, checksum=weights_checksum(good_params),
+    )
+    # ...the breaker must close (ride through the probe window)...
+    recover_deadline = time.time() + 30
+    while (chaos_srv.breaker.state != "closed"
+           and time.time() < recover_deadline):
+        try:
+            chaos_srv.infer(example, deadline_s=2.0)
+        except Exception:
+            time.sleep(0.05)
+    # ...and p99 must return to within 2x of the unfaulted baseline
+    chaos_srv.reset_latency_window()
+    post = run_load(chaos_srv, 4, window, deadline_s=2.0)
+    breaker = chaos_srv.breaker.stats()
+    stats = chaos_srv.stats()
+    cw = swap_results.get("chaos_window", {})
+    accounted = (
+        cw.get("issued", 0)
+        == cw.get("ok", 0) + cw.get("shed", 0)
+        + cw.get("errors", 0) + cw.get("timeouts", 0)
+    )
+    p99_ratio = (
+        round(post["p99_ms"] / baseline["p99_ms"], 3)
+        if post["p99_ms"] and baseline["p99_ms"] else None
+    )
+    chaos_row = {
+        "plan": plan,
+        "baseline": baseline,
+        "chaos_window": cw,
+        "post": post,
+        "p99_post_ratio": p99_ratio,
+        "all_requests_accounted": accounted,
+        "breaker_tripped": breaker["trips"] >= 1,
+        "breaker_recovered": (
+            breaker["recoveries"] >= 1 and breaker["state"] == "closed"
+        ),
+        "hotswap_rolled_back": not swap_results["torn_push_installed"],
+        "hotswap_installed_after": swap_results["good_push_installed"],
+        "weights_generation": chaos_srv.generation,
+        "wedged_batches": stats["wedged_batches"],
+        "watchdog_events": [
+            (e["stage"], e["stalled_s"])
+            for e in chaos_srv._watchdog.events
+        ],
+        "completed": bool(
+            accounted
+            and breaker["trips"] >= 1
+            and breaker["state"] == "closed"
+            and not swap_results["torn_push_installed"]
+            and swap_results["good_push_installed"]
+            and post["ok"] > 0
+            and (p99_ratio is not None and p99_ratio <= 2.0)
+        ),
+    }
+    chaos_srv.stop()
+    srv.stop()
+
+    doc = {
+        "schema": "bench-serving/1",
+        "platform": jax.default_backend(),
+        "env": _env_provenance(),
+        "quick": QUICK,
+        "config": {
+            "max_batch": 8, "linger_s": 0.001, "breaker_threshold": 3,
+            "model": f"dense32-out{n_out} (in={n_in})",
+        },
+        "curve": curve,
+        "warm_start": warm_row,
+        "chaos": chaos_row,
+    }
+    if not QUICK:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_SERVING.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"[bench] serving table -> {path}", file=sys.stderr)
+    print(json.dumps(doc))
+
+
 def main() -> None:
     global QUICK
     t_start = time.time()
@@ -2042,6 +2336,8 @@ if __name__ == "__main__":
         del sys.argv[_i:_i + 2]
     if "--chaos" in sys.argv:
         sys.exit(bench_chaos())
+    if "--serving" in sys.argv:
+        sys.exit(bench_serving())
     if "--scaling" in sys.argv:
         sys.exit(bench_scaling())
     if "--decode-scaling" in sys.argv:

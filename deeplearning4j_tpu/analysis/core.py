@@ -136,7 +136,8 @@ class LintContext:
     select: Optional[set] = None                 # rule-ID prefix filter
     # EH rules apply to these package subpackages (plus any file outside
     # the package, e.g. tests/ entrypoints and lint fixtures).
-    eh_scope: tuple = ("runtime", "train", "observe", "analysis")
+    eh_scope: tuple = ("runtime", "train", "observe", "analysis",
+                       "serving")
 
     def wants(self, rule_id: str) -> bool:
         if not self.select:

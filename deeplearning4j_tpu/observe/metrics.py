@@ -448,7 +448,10 @@ def _declare_core(reg: MetricsRegistry) -> None:
     reg.counter("dl4jtpu_faults_injected_total",
                 "Faults fired by the armed FaultPlan, by site")
     reg.counter("dl4jtpu_ckpt_verify_failures_total",
-                "Checkpoints that failed manifest/CRC verification")
+                "Checkpoints rejected as restore/rollback/serve "
+                "targets, by reason (corrupt = manifest/CRC/zip "
+                "defects; nonfinite = intact bytes holding NaN/Inf "
+                "params)")
     # self-healing (runtime/watchdog.py, train/recovery.py)
     reg.counter("dl4jtpu_watchdog_stalls_total",
                 "Step-watchdog escalations, by stage (warn, stack_dump, "
@@ -498,6 +501,45 @@ def _declare_core(reg: MetricsRegistry) -> None:
                 "equivalent jitted update once per measurement "
                 "(parallel/zero.py measure_update_seconds; bench "
                 "--scaling's update_time_ms columns)")
+    # serving plane (serving/): admission, batching, degradation and
+    # weight hot-swap telemetry — p50/p99 come from the latency
+    # histogram's buckets, queue/breaker state from the gauges
+    reg.counter("dl4jtpu_serving_requests_total",
+                "Admitted serving requests by final outcome (ok, "
+                "error, timeout)")
+    reg.counter("dl4jtpu_serving_shed_total",
+                "Requests rejected EXPLICITLY by the serving plane, by "
+                "reason (queue_full backpressure, deadline shed, "
+                "breaker_open, admit_fault, shutdown) — overload is "
+                "never a silent drop")
+    reg.histogram("dl4jtpu_serving_request_latency_seconds",
+                  "Admission-to-completion latency per served request")
+    reg.gauge("dl4jtpu_serving_queue_depth",
+              "Requests waiting in the serving admission queue")
+    reg.gauge("dl4jtpu_serving_batch_occupancy",
+              "Real requests / padded bucket size of the last "
+              "dispatched serving batch")
+    reg.counter("dl4jtpu_serving_batches_total",
+                "Batched inference programs dispatched by the serving "
+                "plane")
+    reg.gauge("dl4jtpu_serving_breaker_state",
+              "Serving circuit breaker state (0=closed, 0.5=half-open "
+              "probe, 1=open)")
+    reg.counter("dl4jtpu_serving_breaker_transitions_total",
+                "Serving circuit breaker transitions, by target state")
+    reg.counter("dl4jtpu_serving_hotswap_total",
+                "Weight hot-swap pushes, by result (installed, "
+                "rolled_back — a rolled-back push leaves the serving "
+                "params untouched)")
+    reg.gauge("dl4jtpu_serving_weights_generation",
+              "Monotonic generation of the serving params (bumps on "
+              "every installed hot-swap)")
+    # elastic supervisor crash-loop damping (train/elastic.py): nonzero
+    # while the supervisor is backing off before a respawn — respawn
+    # storms become visible on /metrics instead of only in logs
+    reg.gauge("dl4jtpu_supervisor_backoff_seconds",
+              "Crash-loop backoff the ElasticSupervisor is currently "
+              "sleeping before respawning (0 = not backing off)")
     # step-timeline ring buffer (observe/trace.py)
     reg.counter("dl4jtpu_trace_spans_dropped_total",
                 "Spans evicted by trace ring-buffer wrap-around (the "

@@ -25,6 +25,14 @@ Sites wired today:
                          per-record decode failure the quarantine absorbs)
   ``device.sync``        the fit loops' device_sync barrier (``delay`` ⇒
                          a simulated wedged step under the watchdog)
+  ``serving.admit``      InferenceServer.submit entry (``delay`` ⇒ slow
+                         admission; other kinds ⇒ explicit admit_fault
+                         rejection)
+  ``serving.infer``      the serving batcher's per-batch dispatch
+                         (``delay`` ⇒ wedged dispatch under the serving
+                         watchdog, ``corrupt`` ⇒ NaN outputs)
+  ``serving.hotswap``    the weight-push path (``truncate``/``corrupt``
+                         ⇒ torn/poisoned push that must roll back)
 
 Plan grammar (also the ``DL4J_TPU_FAULT_PLAN`` env value, so subprocess
 workers inherit the plan from their spawner's environment)::
@@ -86,6 +94,18 @@ SITES: dict = {
     "data.device_decode": "the fused-decode fit paths' host boundary, "
                           "before staging raw bytes and dispatching the "
                           "decode+step program",
+    "serving.admit": "InferenceServer.submit entry ('delay' = a slow "
+                     "admission path; 'raise'/other kinds reject the "
+                     "request explicitly as admit_fault)",
+    "serving.infer": "the serving batcher, before each batched infer "
+                     "dispatch ('delay' = a wedged dispatch under the "
+                     "serving watchdog; 'raise' = a failed dispatch; "
+                     "'corrupt' NaN-poisons the outputs — the "
+                     "finiteness screen + breaker path)",
+    "serving.hotswap": "InferenceServer.push_weights entry ('truncate' "
+                       "= a torn push that dropped leaves; 'corrupt' "
+                       "NaN-poisons the staged params; both must roll "
+                       "back to the serving weights)",
 }
 
 

@@ -1,0 +1,22 @@
+"""Serving plane — a continuous-batching inference server that degrades
+instead of dying (docs/serving.md).
+
+    from deeplearning4j_tpu.serving import InferenceServer, ServingConfig
+
+    server = InferenceServer(model).start()
+    server.warm_start(example)                # AOT: bucket set compiled
+    out = server.infer(features, deadline_s=0.25)
+    server.push_checkpoint(path)              # verified hot-swap
+"""
+
+from deeplearning4j_tpu.serving.admission import (        # noqa: F401
+    ServingError, ServingRejected, ServingTimeout,
+)
+from deeplearning4j_tpu.serving.breaker import CircuitBreaker  # noqa: F401
+from deeplearning4j_tpu.serving.hotswap import (          # noqa: F401
+    SwapVerifyError, weights_checksum,
+)
+from deeplearning4j_tpu.serving.http import ServingHTTPServer  # noqa: F401
+from deeplearning4j_tpu.serving.server import (           # noqa: F401
+    InferenceServer, ServingConfig, active_servers,
+)

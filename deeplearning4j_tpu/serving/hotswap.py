@@ -1,0 +1,114 @@
+"""Verified weight hot-swap — new params into a live server, or nothing.
+
+The fine-tune-and-serve loop's last step is the dangerous one: a trainer
+pushes fresh params into a replica that is mid-traffic.  A torn transfer
+(half the leaves), a poisoned checkpoint (saved mid-divergence, finite
+CRC but NaN weights) or a shape drift (the trainer grew a layer) must
+all leave the server exactly where it was — serving the old params —
+with the rejection visible on the telemetry spine, never a crash and
+never a silently-wrong model.
+
+This module is the pure verification half: the server stages the pushed
+tree, calls `verify_weights(staged, live, checksum=...)`, and only a
+clean pass reaches the atomic install.  Checks, in rejection-cost
+order:
+
+1. **structure** — staged treedef == live treedef (a torn push that
+   dropped leaves, or a different architecture entirely);
+2. **shape/dtype** — leaf-by-leaf (the programs are compiled against
+   the live shapes; installing a mismatch would recompile at best and
+   mis-execute at worst);
+3. **checksum** — optional CRC32 over the leaf bytes, computed at the
+   SOURCE (`weights_checksum`) and carried with the push: bit rot in
+   transit fails here (checkpoint pushes get this via the manifest CRC
+   in `ModelSerializer.verify` instead);
+4. **finiteness** — every float leaf all-finite, the `iter_valid`
+   lesson from the recovery plane: integrity proves the bytes arrived,
+   not that they are worth serving.
+"""
+
+from __future__ import annotations
+
+import zlib
+
+import jax
+import numpy as np
+
+
+class SwapVerifyError(RuntimeError):
+    """The pushed weights failed verification; `reason` is one of
+    structure / shape / checksum / nonfinite / fault."""
+
+    def __init__(self, reason: str, detail: str):
+        self.reason = reason
+        super().__init__(f"hot-swap rejected ({reason}): {detail}")
+
+
+def weights_checksum(tree) -> int:
+    """CRC32 over every leaf's raw bytes in flattened-tree order.
+    Compute at the push SOURCE and pass to ``push_weights`` — a torn or
+    bit-flipped transfer then fails verification instead of serving."""
+    crc = 0
+    for leaf in jax.tree.leaves(tree):
+        a = np.asarray(leaf)
+        crc = zlib.crc32(np.ascontiguousarray(a).tobytes(), crc)
+    return crc
+
+
+def verify_weights(staged, live, checksum: int | None = None) -> None:
+    """Raise `SwapVerifyError` unless `staged` can safely replace
+    `live` (see module docstring for the check order)."""
+    staged_leaves, staged_def = jax.tree.flatten(staged)
+    live_leaves, live_def = jax.tree.flatten(live)
+    if staged_def != live_def:
+        raise SwapVerifyError(
+            "structure",
+            f"staged tree has {len(staged_leaves)} leaves / def "
+            f"{staged_def}, live model expects {len(live_leaves)}",
+        )
+    for i, (s, l) in enumerate(zip(staged_leaves, live_leaves)):
+        s_arr, l_arr = np.asarray(s), np.asarray(l)
+        if s_arr.shape != l_arr.shape or s_arr.dtype != l_arr.dtype:
+            raise SwapVerifyError(
+                "shape",
+                f"leaf {i}: staged {s_arr.shape}/{s_arr.dtype} vs live "
+                f"{l_arr.shape}/{l_arr.dtype}",
+            )
+    if checksum is not None:
+        got = weights_checksum(staged)
+        if got != checksum:
+            raise SwapVerifyError(
+                "checksum",
+                f"CRC32 {got:#010x} != pushed {checksum:#010x} "
+                "(torn or corrupted transfer)",
+            )
+    for i, s in enumerate(staged_leaves):
+        a = np.asarray(s)
+        if np.issubdtype(a.dtype, np.floating) and not np.isfinite(a).all():
+            raise SwapVerifyError(
+                "nonfinite",
+                f"leaf {i} holds NaN/Inf (pushed mid-divergence?)",
+            )
+
+
+def apply_fault_action(action: str, staged):
+    """Cooperative fault-site mutations for ``serving.hotswap``: the
+    armed plan asks the push path to corrupt its OWN staged copy, the
+    same pattern as ``checkpoint.write``'s truncate.  ``truncate``
+    simulates a torn transfer (the last leaf is dropped -> structure
+    check fails); ``corrupt`` NaN-poisons the first float leaf
+    (finiteness check fails).  Returns the mutated tree."""
+    leaves, treedef = jax.tree.flatten(staged)
+    if action == "truncate":
+        return leaves[:-1]                # no longer the live structure
+    if action == "corrupt":
+        out = []
+        poisoned = False
+        for leaf in leaves:
+            a = np.array(np.asarray(leaf), copy=True)
+            if not poisoned and np.issubdtype(a.dtype, np.floating):
+                a.reshape(-1)[0] = np.nan
+                poisoned = True
+            out.append(a)
+        return jax.tree.unflatten(treedef, out)
+    return staged

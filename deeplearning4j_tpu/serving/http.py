@@ -1,0 +1,188 @@
+"""HTTP frontend for the serving plane — stdlib, like the UIServer.
+
+Every rejection the `InferenceServer` produces maps to an explicit
+status code (the docs/serving.md table); an overloaded or degraded
+server answers fast with a reason, never hangs the socket:
+
+  POST /v1/infer    {"features": [...], "deadline_ms": 250}
+                    -> 200 {"outputs": ..., "latency_ms", "generation"}
+                    -> 400 bad request  (malformed JSON / wrong shape)
+                    -> 429 queue_full   (backpressure: retry later)
+                    -> 503 breaker_open | deadline | admit_fault
+                    -> 504 deadline expired after admission
+                    -> 500 dispatch failed (wedged / non-finite)
+  POST /v1/reload   {"path": "/ckpts/ckpt_00000042.zip"}
+                    -> 200 installed {"generation"}
+                    -> 409 rolled_back (verification failed; old params
+                           keep serving)
+  GET  /healthz     -> 200 serving | 503 breaker open (load balancers
+                       pull the replica while it probes recovery)
+  GET  /v1/status   -> 200 stats JSON (queue depth, p50/p99, breaker,
+                       swap generation, shed counts)
+
+Multi-input graphs POST ``{"inputs": [[...], [...]]}`` — one nested
+array per network input.  Features arrive as ONE example (no batch
+dim); the server does the batching.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Optional
+from urllib.parse import urlparse
+
+import numpy as np
+
+from deeplearning4j_tpu.serving.admission import (
+    ServingError, ServingRejected, ServingTimeout,
+)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class ServingHTTPServer:
+    """Thin HTTP shell around an `InferenceServer`."""
+
+    def __init__(self, server, port: int = 0, host: str = "127.0.0.1"):
+        self.server = server
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            # per-connection socket timeout: a client that sends headers
+            # and then dribbles (or never sends) its body must not pin
+            # a handler thread forever — bounded admission starts at
+            # the socket
+            timeout = 30
+
+            def log_message(self, *a):          # quiet
+                pass
+
+            def _json(self, obj, code=200):
+                body = json.dumps(obj).encode()
+                self.send_response(code)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_GET(self):
+                u = urlparse(self.path)
+                if u.path == "/healthz":
+                    state = outer.server.breaker.state
+                    if state == "open":
+                        self._json({"status": "breaker_open"}, 503)
+                    else:
+                        self._json({"status": "serving",
+                                    "breaker": state})
+                elif u.path == "/v1/status":
+                    self._json(outer.server.stats())
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def do_POST(self):
+                u = urlparse(self.path)
+                try:
+                    n = int(self.headers.get("Content-Length", 0))
+                    payload = json.loads(self.rfile.read(n) or b"{}")
+                except (ValueError, json.JSONDecodeError):
+                    self._json({"error": "bad json"}, 400)
+                    return
+                if u.path == "/v1/infer":
+                    self._infer(payload)
+                elif u.path == "/v1/reload":
+                    self._reload(payload)
+                else:
+                    self._json({"error": "not found"}, 404)
+
+            def _infer(self, payload):
+                try:
+                    if "inputs" in payload:
+                        feats = tuple(
+                            np.asarray(a, np.float32)
+                            for a in payload["inputs"]
+                        )
+                    else:
+                        feats = np.asarray(
+                            payload.get("features"), np.float32,
+                        )
+                    deadline_ms = payload.get("deadline_ms")
+                    deadline_s = (
+                        float(deadline_ms) / 1000.0
+                        if deadline_ms is not None else None
+                    )
+                except (TypeError, ValueError) as exc:
+                    self._json({"error": f"bad features: {exc}"}, 400)
+                    return
+                import time
+
+                t0 = time.monotonic()
+                try:
+                    req = outer.server.submit(feats, deadline_s=deadline_s)
+                    result = req.result()
+                except ServingRejected as exc:
+                    self._json(
+                        {"error": str(exc), "reason": exc.reason},
+                        exc.status,
+                    )
+                    return
+                except ServingTimeout as exc:
+                    self._json({"error": str(exc),
+                                "reason": "deadline_expired"}, exc.status)
+                    return
+                except ServingError as exc:
+                    self._json({"error": str(exc),
+                                "reason": "dispatch_failed"}, exc.status)
+                    return
+                except ValueError as exc:      # wrong arity/shape
+                    self._json({"error": str(exc)}, 400)
+                    return
+                outs = (
+                    [np.asarray(o).tolist() for o in result]
+                    if isinstance(result, tuple)
+                    else np.asarray(result).tolist()
+                )
+                self._json({
+                    "outputs": outs,
+                    "latency_ms": round(
+                        (time.monotonic() - t0) * 1000.0, 3,
+                    ),
+                    "generation": outer.server.generation,
+                })
+
+            def _reload(self, payload):
+                path = payload.get("path")
+                if not path:
+                    self._json({"error": "missing 'path'"}, 400)
+                    return
+                if outer.server.push_checkpoint(path):
+                    self._json({"installed": True,
+                                "generation": outer.server.generation})
+                else:
+                    self._json(
+                        {"installed": False,
+                         "error": "verification failed; previous "
+                                  "weights keep serving"},
+                        409,
+                    )
+
+        self._httpd = ThreadingHTTPServer((host, port), Handler)
+        self._httpd.daemon_threads = True
+        self.port = self._httpd.server_address[1]
+        self.url = f"http://{host}:{self.port}/"
+        self._thread: Optional[threading.Thread] = None
+
+    def start(self) -> "ServingHTTPServer":
+        if self._thread is None or not self._thread.is_alive():
+            self._thread = threading.Thread(
+                target=self._httpd.serve_forever, daemon=True,
+                name="dl4jtpu-serving-http",
+            )
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._httpd.shutdown()
+        self._httpd.server_close()

@@ -1,0 +1,812 @@
+"""InferenceServer — continuous batching over compiled programs, built to
+degrade instead of die.
+
+The serving counterpart of the training plane: concurrent requests
+coalesce into bucketed batches (`batching.py` — a bounded compiled
+program set), dispatch through the SAME jitted/registered infer
+programs `output()` uses (so the cost registry, compile cache and MFU
+attribution all see serving traffic), and params stay device-resident
+between requests.  Engineering priority is the unhappy path:
+
+- admission is BOUNDED (`admission.py`): queue full -> explicit 429,
+  deadline unmeetable -> shed at the door, breaker open -> 503;
+- every batch dispatch runs under the PR 6 `StepWatchdog` (one shared
+  monitor thread): a wedged device fails the batch's requests
+  explicitly and trips the breaker instead of pinning the server;
+- outputs are screened for NaN/Inf — a diverged weight push cannot
+  silently serve garbage;
+- weight hot-swap (`hotswap.py`) verifies structure + checksum +
+  finiteness and installs ATOMICALLY between batches; a torn push
+  rolls back with zero dropped in-flight requests;
+- `warm_start()` precompiles the whole bucket set at boot, so a
+  restarted replica (persistent XLA compile cache, PR 1) serves its
+  first request at full speed.
+
+Every signal lands on the telemetry spine (`observe/metrics`): latency
+histogram (p50/p99 via buckets), queue depth, batch occupancy,
+shed/breaker/hot-swap counters — scraped at `/metrics`, pushed to the
+fleet endpoints by `FleetReporter` like any other worker metric.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import threading
+import time
+import weakref
+from collections import deque
+from typing import Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.serving import batching
+from deeplearning4j_tpu.serving.admission import (
+    AdmissionQueue, PendingRequest, ServingError, ServingRejected,
+)
+from deeplearning4j_tpu.serving.breaker import CircuitBreaker
+from deeplearning4j_tpu.serving.hotswap import (
+    SwapVerifyError, apply_fault_action, verify_weights,
+)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+@dataclasses.dataclass
+class ServingConfig:
+    """Knobs of the serving plane (docs/serving.md has the full table)."""
+
+    max_batch: int = 8             # coalescing cap; also the top bucket
+    max_queue: int = 256           # admission bound (backpressure past it)
+    linger_s: float = 0.002        # wait for stragglers once a batch opens
+    default_deadline_s: float = 1.0
+    admit_safety: float = 1.5      # shed-estimate multiplier (conservative)
+    breaker_threshold: int = 3     # consecutive dispatch failures to trip
+    breaker_probe_after_s: float = 0.5
+    dispatch_timeout_s: float = 10.0   # per-batch watchdog floor (warm)
+    cold_dispatch_timeout_s: float = 600.0  # first dispatch may compile
+    bucket_sequences: bool = False  # time-axis bucketing (sequence models)
+    sequence_quantum: Optional[int] = None  # None = flags.sequence_bucket_size
+
+
+class InferenceServer:
+    """Continuous-batching server over one `SequentialModel`/`GraphModel`
+    (zoo and modelimport models are these classes too).
+
+        server = InferenceServer(model, config=ServingConfig(max_batch=16))
+        server.warm_start(example)          # AOT: compile the bucket set
+        server.start()
+        out = server.submit(features).result()
+        server.push_weights(new_params, checksum=crc)   # verified hot-swap
+        server.stop()
+    """
+
+    def __init__(self, model, config: Optional[ServingConfig] = None):
+        if model.params is None:
+            model.init()
+        self.model = model
+        self.config = config or ServingConfig()
+        self.n_inputs = len(getattr(
+            getattr(model, "conf", None), "network_inputs", (),
+        )) or 1
+        self.n_outputs = len(getattr(
+            getattr(model, "conf", None), "network_outputs", (),
+        )) or 1
+        self.queue = AdmissionQueue(self.config.max_queue)
+        self.breaker = CircuitBreaker(
+            threshold=self.config.breaker_threshold,
+            probe_after_s=self.config.breaker_probe_after_s,
+        )
+        # hot-swap atomicity: dispatch SNAPSHOTS (params, net_state)
+        # under this lock and runs the program against the snapshot;
+        # an install takes the same lock to assign.  Swaps land exactly
+        # between snapshot reads, in-flight requests always complete on
+        # the weights they dispatched with, and a wedged device call
+        # can never pin the lock (pushes stay possible while the
+        # watchdog deals with the wedge)
+        self._weights_lock = threading.Lock()
+        self.generation = 0            # bumps on every installed swap
+        # batch-latency EWMA drives the admission shed estimate and the
+        # stats view; the watchdog keeps its own for deadlines
+        self._stats_lock = threading.Lock()
+        self._batch_ewma: Optional[float] = None
+        self._latencies: deque = deque(maxlen=4096)   # recent request secs
+        self._counts: dict[str, int] = {
+            "admitted": 0, "completed": 0, "errors": 0, "timeouts": 0,
+            "shed": 0, "batches": 0, "wedged_batches": 0,
+            "swaps_installed": 0, "swaps_rolled_back": 0,
+        }
+        self._last_occupancy = 0.0
+        # per-batch watchdog: floor = the configured dispatch timeout,
+        # cold floor = the compile allowance; abort fails the in-flight
+        # batch and trips the breaker (the wedged call's eventual return
+        # value is discarded by token)
+        from deeplearning4j_tpu.runtime.watchdog import StepWatchdog
+
+        self._watchdog = StepWatchdog(
+            floor_s=self.config.dispatch_timeout_s,
+            cold_floor_s=max(self.config.cold_dispatch_timeout_s,
+                             self.config.dispatch_timeout_s),
+            k=1.0,                      # deadline IS the configured timeout
+            abort=self._on_wedged,
+            name="serving",
+        )
+        self._inflight_lock = threading.Lock()
+        self._inflight: Optional[dict] = None      # {"token", "reqs"}
+        self._dispatch_token = 0
+        # batcher generation: bumped ATOMICALLY with the inflight pop in
+        # _on_wedged, so an abandoned (wedge-respawned) thread whose
+        # claim failed always observes the bump at its next loop check
+        # and exits — two batchers can never take from the queue
+        # concurrently
+        self._batcher_gen = 0
+        # the watchdog is SHARED across batcher generations: after a
+        # wedge-respawn, the abandoned thread eventually wakes inside
+        # its old dispatch and must NOT disarm the deadline the
+        # replacement batcher armed for ITS dispatch — disarm is gated
+        # on still owning the arm
+        self._wd_lock = threading.Lock()
+        self._wd_owner: Optional[int] = None
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.warmed_signatures: list[tuple] = []
+        _register_server(self)
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "InferenceServer":
+        if self._thread is None or not self._thread.is_alive():
+            self._stop.clear()
+            with self._inflight_lock:
+                gen = self._batcher_gen
+            self._thread = threading.Thread(
+                target=self._batcher_loop, args=(gen,),
+                name="dl4jtpu-serving", daemon=True,
+            )
+            self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        """Stop the batcher and fail every still-queued request with an
+        explicit `shutdown` rejection (never a silent drop)."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout)
+            self._thread = None
+        for req in self.queue.drain():
+            self._shed(req, "shutdown")
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, features, deadline_s: Optional[float] = None,
+               features_mask=None) -> PendingRequest:
+        """Admit ONE example (no batch dim; a tuple of arrays for
+        multi-input graphs).  Returns a `PendingRequest` whose
+        ``result()`` blocks until completion or the deadline.  Raises
+        `ServingRejected` synchronously when the request cannot be
+        admitted — queue full, breaker open, or the deadline is already
+        unmeetable at the current queue depth."""
+        try:
+            action = faults.maybe_fail("serving.admit")
+        except Exception as exc:
+            # an admission path that raises (injected or real) is a
+            # failing FRONT DOOR, not a failing request: convert it to
+            # an explicit rejection the client can retry against
+            self._count_shed("admit_fault")
+            raise ServingRejected("admit_fault", str(exc)) from exc
+        if action is not None:
+            # cooperative kinds at admit mean the same thing — reject
+            # explicitly, count the shed
+            self._count_shed("admit_fault")
+            raise ServingRejected("admit_fault", f"injected {action}")
+        if not self.breaker.admits():
+            self._count_shed("breaker_open")
+            raise ServingRejected(
+                "breaker_open",
+                f"circuit breaker is {self.breaker.state}",
+            )
+        try:
+            return self._admit(features, deadline_s, features_mask)
+        except BaseException:
+            # admits() may have consumed the HALF_OPEN probe slot; a
+            # rejection on the way to the queue (deadline shed, queue
+            # full, bad arity) means that probe will never dispatch —
+            # release it or the breaker waits forever on a dead probe
+            self.breaker.probe_reset()
+            raise
+
+    def _admit(self, features, deadline_s, features_mask) -> PendingRequest:
+        feats = self._as_feature_tuple(features)
+        deadline_s = (self.config.default_deadline_s
+                      if deadline_s is None else float(deadline_s))
+        fmask = features_mask
+        orig_len = padded_len = None
+        if self._sequence_mode(feats):
+            orig_len = int(feats[0].shape[0])
+            padded, seq_mask = batching.pad_sequence(
+                feats[0], self.config.sequence_quantum
+            )
+            padded_len = int(padded.shape[0])
+            feats = (padded,)
+            if fmask is None:
+                fmask = seq_mask
+            else:
+                m = np.zeros_like(seq_mask)
+                m[: len(fmask)] = np.asarray(fmask, np.float32)
+                fmask = m
+        sig = batching.bucket_signature(
+            feats, self.config.sequence_quantum,
+            self._sequence_mode(feats),
+        )
+        # deadline-aware shedding AT ADMIT: with `depth` requests ahead,
+        # this one completes after ~floor(depth / max_batch) + 1
+        # dispatches (the +1 is its own batch); if that (times a safety
+        # factor) already exceeds its deadline, it would only burn a
+        # batch slot to time out in — reject now
+        est = self._estimated_wait(self.queue.depth)
+        if est is not None and est > deadline_s:
+            self._count_shed("deadline")
+            raise ServingRejected(
+                "deadline",
+                f"estimated wait {est:.3f}s exceeds deadline "
+                f"{deadline_s:.3f}s at queue depth {self.queue.depth}",
+            )
+        req = PendingRequest(
+            feats, sig, time.monotonic() + deadline_s, fmask=fmask,
+            orig_len=orig_len, padded_len=padded_len,
+        )
+        if not self.queue.offer(req):
+            self._count_shed("queue_full")
+            raise ServingRejected(
+                "queue_full", f"admission queue at {self.queue.max_queue}"
+            )
+        with self._stats_lock:
+            self._counts["admitted"] += 1
+        self._gauge_depth()
+        return req
+
+    def infer(self, features, deadline_s: Optional[float] = None,
+              features_mask=None):
+        """Blocking convenience: ``submit(...).result()``."""
+        return self.submit(
+            features, deadline_s=deadline_s, features_mask=features_mask,
+        ).result()
+
+    def _as_feature_tuple(self, features) -> tuple:
+        if isinstance(features, (tuple, list)):
+            feats = tuple(np.asarray(f) for f in features)
+        else:
+            feats = (np.asarray(features),)
+        if len(feats) != self.n_inputs:
+            raise ValueError(
+                f"model has {self.n_inputs} input(s), request carries "
+                f"{len(feats)}"
+            )
+        return feats
+
+    def _sequence_mode(self, feats: tuple) -> bool:
+        return (self.config.bucket_sequences and self.n_inputs == 1
+                and feats[0].ndim >= 2)
+
+    def _estimated_wait(self, depth: int) -> Optional[float]:
+        with self._stats_lock:
+            ewma = self._batch_ewma
+        if ewma is None:
+            return None                  # no sample yet: admit optimistically
+        dispatches = depth // self.config.max_batch + 1
+        return self.config.admit_safety * ewma * dispatches
+
+    # -- the batcher thread ------------------------------------------------
+    def _batcher_loop(self, my_gen: int) -> None:
+        while not self._stop.is_set():
+            with self._inflight_lock:
+                alive = self._batcher_gen == my_gen
+            if not alive:
+                # replaced after a wedged dispatch (_on_wedged bumped
+                # the generation atomically with discarding our batch);
+                # bow out before touching the queue
+                return
+            reqs = self.queue.take_batch(
+                self.config.max_batch, self.config.linger_s, self._stop,
+            )
+            self._gauge_depth()
+            if not reqs:
+                continue
+            live = []
+            now = time.monotonic()
+            for r in reqs:
+                if r.cancelled:
+                    # the client already timed out waiting; counting it
+                    # keeps "admitted == completed+errors+timeouts+shed"
+                    with self._stats_lock:
+                        self._counts["timeouts"] += 1
+                    self._count_outcome("timeout")
+                elif r.deadline <= now:
+                    # backstop shed: admitted when it looked meetable,
+                    # doomed by the time a slot opened — reject
+                    # explicitly instead of dispatching a corpse
+                    self._shed(r, "deadline")
+                else:
+                    live.append(r)
+            if not live:
+                # a fully-shed take must not wedge a half-open breaker
+                # waiting on a probe that will never dispatch
+                self.breaker.probe_reset()
+                continue
+            self._dispatch(live)
+
+    def _dispatch(self, reqs: list[PendingRequest]) -> None:
+        bucket = batching.batch_bucket(len(reqs), self.config.max_batch)
+        with self._inflight_lock:
+            self._dispatch_token += 1
+            token = self._dispatch_token
+            self._inflight = {"token": token, "reqs": reqs}
+        t0 = time.monotonic()
+        try:
+            outs = self._run_program(reqs, bucket, token)
+        except Exception as exc:
+            self._finish_failed(token, reqs, exc)
+            return
+        self._finish_ok(token, reqs, outs, bucket, time.monotonic() - t0)
+
+    def _run_program(self, reqs: list[PendingRequest], bucket: int,
+                     token: int):
+        """Stack -> (maybe injected fault) -> jitted program -> rows.
+        Raises on dispatch failure OR non-finite outputs; the watchdog
+        is armed across the device call under `token` — the one
+        _dispatch allocated, NOT a re-read of the counter (a concurrent
+        warm_start() also draws from it, and a desynced owner would
+        leave one of the two device calls deadline-less)."""
+        cols = batching.stack_batch(
+            [r.features for r in reqs], self.n_inputs, bucket,
+        )
+        fmask_col = None
+        if any(r.fmask is not None for r in reqs):
+            # unmasked requests in a masked batch get all-ones masks,
+            # shaped like the first request that HAS one (the first
+            # request overall may be the unmasked one)
+            ref = next(r.fmask for r in reqs if r.fmask is not None)
+            masks = [
+                r.fmask if r.fmask is not None
+                else np.ones(ref.shape, np.float32)
+                for r in reqs
+            ]
+            fmask_col = np.stack(masks)
+            if bucket > len(reqs):
+                pad = np.zeros(
+                    (bucket - len(reqs),) + fmask_col.shape[1:], np.float32,
+                )
+                fmask_col = np.concatenate([fmask_col, pad])
+        # snapshot the weights UNDER the lock, dispatch OUTSIDE it: a
+        # truly wedged device call must not pin the lock (push_weights
+        # would deadlock and a replacement batcher could never dispatch)
+        with self._weights_lock:
+            params, net_state = self.model.params, self.model.net_state
+        self._wd_arm(token)
+        t0 = time.monotonic()
+        try:
+            action = faults.maybe_fail("serving.infer")
+            out = self._call_model(cols, fmask_col, params, net_state)
+            rows = [np.asarray(o) for o in out]
+            if action == "corrupt":
+                # injected divergence: the device answered NaN — the
+                # finiteness screen below must catch it
+                rows = [np.full_like(r, np.nan) for r in rows]
+        finally:
+            self._wd_disarm(token, time.monotonic() - t0)
+        n = len(reqs)
+        for r in rows:
+            if not np.isfinite(r[:n]).all():
+                raise ServingError(
+                    "non-finite values in inference output "
+                    "(diverged weights or corrupted dispatch)"
+                )
+        return rows
+
+    def _wd_arm(self, token: int) -> None:
+        with self._wd_lock:
+            self._wd_owner = token
+            self._watchdog.arm(token)
+
+    def _wd_disarm(self, token: int, dur: Optional[float]) -> None:
+        """Disarm only if this dispatch still owns the watchdog.  An
+        abandoned (wedge-respawned) thread waking after the replacement
+        batcher armed for a NEWER dispatch must leave that deadline in
+        place — clobbering it let a follow-on hang run unwatched.
+        disarm() itself drops the duration when the ladder escalated on
+        the arm (a stall must not inflate the EWMA)."""
+        with self._wd_lock:
+            if self._wd_owner == token:
+                self._wd_owner = None
+                self._watchdog.disarm(dur)
+
+    def _call_model(self, cols: list, fmask_col, params,
+                    net_state) -> tuple:
+        """One batched forward through the model's own jitted infer
+        program (the same cost-registry-registered program `output()`
+        builds), against an explicit weights SNAPSHOT — the model's
+        live trees are only touched under the weights lock, never from
+        inside the (possibly long) device call."""
+        from deeplearning4j_tpu.runtime.mesh import active_mesh_scope
+
+        model = self.model
+        with active_mesh_scope(getattr(model, "_mesh", None)):
+            if self.n_inputs > 1 or hasattr(model.conf, "network_inputs"):
+                out = model._get_infer_fn()(params, net_state, tuple(cols))
+                return tuple(out)
+            has_fmask = fmask_col is not None
+            out = model._get_infer_fn(has_fmask)(
+                params, net_state, cols[0],
+                fmask_col if has_fmask else np.zeros((0,), np.float32),
+            )
+            return (out,)
+
+    def _finish_ok(self, token: int, reqs: list[PendingRequest],
+                   rows: list[np.ndarray], bucket: int,
+                   dur: float) -> None:
+        if not self._claim_inflight(token):
+            return          # the watchdog already failed this batch
+        self.breaker.record_success()
+        now = time.monotonic()
+        with self._stats_lock:
+            a = 0.3
+            self._batch_ewma = dur if self._batch_ewma is None else (
+                (1 - a) * self._batch_ewma + a * dur
+            )
+            self._counts["batches"] += 1
+            self._counts["completed"] += len(reqs)
+            self._last_occupancy = len(reqs) / bucket
+            for r in reqs:
+                self._latencies.append(now - r.t_admit)
+        for i, r in enumerate(reqs):
+            result = tuple(
+                self._slice_sequence(rows[j][i], r)
+                for j in range(len(rows))
+            )
+            r.complete(result if len(result) > 1 else result[0])
+            self._observe_latency(now - r.t_admit)
+            self._count_outcome("ok")
+        self._gauge_batch(len(reqs), bucket)
+
+    @staticmethod
+    def _slice_sequence(row: np.ndarray, req: PendingRequest) -> np.ndarray:
+        """Undo the time-axis padding on time-distributed outputs: a
+        bucketed (T_pad, C) row is sliced back to the request's real
+        length.  Rank-1 rows (e.g. LastTimeStep heads) and rows whose
+        leading dim is not the padded length pass through untouched."""
+        if (req.orig_len is not None and req.orig_len != req.padded_len
+                and row.ndim >= 2 and row.shape[0] == req.padded_len):
+            return row[: req.orig_len]
+        return row
+
+    def _finish_failed(self, token: int, reqs: list[PendingRequest],
+                       exc: Exception) -> None:
+        if not self._claim_inflight(token):
+            return
+        self.breaker.record_failure()
+        log.warning("serving dispatch failed (%d request(s)): %s",
+                    len(reqs), exc)
+        err = exc if isinstance(exc, ServingError) else ServingError(
+            f"dispatch failed: {type(exc).__name__}: {exc}"
+        )
+        with self._stats_lock:
+            self._counts["errors"] += len(reqs)
+        for r in reqs:
+            r.fail(err)
+            self._count_outcome("error")
+
+    def _claim_inflight(self, token: int) -> bool:
+        with self._inflight_lock:
+            if self._inflight is None or self._inflight["token"] != token:
+                return False
+            self._inflight = None
+            return True
+
+    def _on_wedged(self, event: dict) -> None:
+        """Watchdog abort stage (monitor thread): the dispatch blew
+        `dispatch_timeout_s` x abort_after.  Fail the batch's requests
+        explicitly, trip the breaker, and leave a token behind so the
+        wedged call's eventual return is discarded."""
+        with self._inflight_lock:
+            inflight, self._inflight = self._inflight, None
+            if inflight is not None:
+                # atomic with the pop: the abandoned batcher's claim
+                # fails under this same lock, so its next loop check
+                # MUST see the new generation and exit — never two
+                # batchers on the queue at once
+                self._batcher_gen += 1
+        if inflight is None:
+            return
+        log.error("serving dispatch wedged (%.3fs past deadline); "
+                  "failing %d request(s)",
+                  event["stalled_s"] - event["deadline_s"],
+                  len(inflight["reqs"]))
+        self.breaker.record_failure()
+        err = ServingError(
+            f"dispatch wedged past {event['deadline_s']:.3f}s deadline"
+        )
+        with self._stats_lock:
+            self._counts["wedged_batches"] += 1
+            self._counts["errors"] += len(inflight["reqs"])
+        for r in inflight["reqs"]:
+            r.fail(err)
+            self._count_outcome("error")
+        # the wedged call may NEVER return: abandon its (daemon) thread
+        # and hand the queue to a fresh batcher, or the server would be
+        # pinned — no dispatches, no breaker probe, no recovery
+        self._respawn_batcher()
+
+    def _respawn_batcher(self) -> None:
+        if self._stop.is_set():
+            return
+        with self._inflight_lock:
+            gen = self._batcher_gen
+        t = threading.Thread(
+            target=self._batcher_loop, args=(gen,),
+            name="dl4jtpu-serving", daemon=True,
+        )
+        self._thread = t
+        t.start()
+
+    # -- weight hot-swap ---------------------------------------------------
+    def push_weights(self, params, net_state=None,
+                     checksum: Optional[int] = None,
+                     source: str = "api") -> bool:
+        """Verified atomic weight swap: stage -> verify (structure,
+        shape, optional CRC, finiteness) -> install between batches.
+        Returns True on install; False = rolled back (the server keeps
+        serving its current params untouched)."""
+        try:
+            action = faults.maybe_fail("serving.hotswap")
+        except Exception as exc:
+            return self._swap_rejected(source, "fault", str(exc))
+        staged = params
+        if action is not None:
+            staged = apply_fault_action(action, staged)
+        staged_net = net_state
+        try:
+            verify_weights(staged, self.model.params, checksum=checksum)
+            if staged_net is not None:
+                verify_weights(staged_net, self.model.net_state)
+        except SwapVerifyError as exc:
+            return self._swap_rejected(source, exc.reason, str(exc))
+        with self._weights_lock:
+            # between batches by construction: dispatch snapshots the
+            # trees under this lock before every program call
+            self.model.params = staged
+            if staged_net is not None:
+                self.model.net_state = staged_net
+            self.generation += 1
+            gen = self.generation
+        with self._stats_lock:
+            self._counts["swaps_installed"] += 1
+        log.info("serving weights swapped (generation %d, source=%s)",
+                 gen, source)
+        self._count_swap("installed")
+        self._gauge_generation(gen)
+        return True
+
+    def push_checkpoint(self, path: str, source: Optional[str] = None,
+                        include_net_state: bool = True) -> bool:
+        """Hot-swap from a checkpoint file: the manifest CRC check
+        (`ModelSerializer.verify`) rejects torn/corrupt files BEFORE the
+        params are even staged, then the tree goes through the same
+        verified install as `push_weights`."""
+        from deeplearning4j_tpu.train.checkpoint import (
+            CheckpointVerifyError, ModelSerializer,
+        )
+
+        source = source or f"checkpoint:{path}"
+        try:
+            restored = ModelSerializer.restore(path, verify=True)
+        except CheckpointVerifyError as exc:
+            return self._swap_rejected(source, "checkpoint", str(exc))
+        except Exception as exc:
+            # unreadable file, class mismatch, leaf-count drift — same
+            # contract: the live params keep serving
+            return self._swap_rejected(source, "restore", str(exc))
+        return self.push_weights(
+            restored.params,
+            net_state=restored.net_state if include_net_state else None,
+            source=source,
+        )
+
+    def _swap_rejected(self, source: str, reason: str,
+                       detail: str) -> bool:
+        log.warning(
+            "hot-swap from %s ROLLED BACK (%s): %s — serving params "
+            "generation %d unchanged", source, reason, detail,
+            self.generation,
+        )
+        with self._stats_lock:
+            self._counts["swaps_rolled_back"] += 1
+        self._count_swap("rolled_back")
+        return False
+
+    # -- AOT warm start ----------------------------------------------------
+    def warm_start(self, example=None, lengths=None) -> list[tuple]:
+        """Precompile the whole bucketed program set at boot by
+        dispatching a zero batch through every (batch bucket [x time
+        bucket]) signature.  `example` is one request's features (no
+        batch dim; tuple for multi-input graphs); `lengths` optionally
+        lists sequence lengths to cover when `bucket_sequences` is on.
+        Programs register with the observe/cost registry as they build,
+        and land in the persistent XLA compile cache — a RESTARTED
+        replica re-runs this in retrieval time, not compile time, and
+        serves its first request at steady-state latency.  Returns the
+        warmed signatures."""
+        feats = self._as_feature_tuple(example)
+        variants = [feats]
+        if self._sequence_mode(feats) and lengths:
+            variants = []
+            for t in lengths:
+                a = feats[0]
+                v = np.zeros((int(t),) + a.shape[1:], a.dtype)
+                variants.append((v,))
+        warmed = []
+        buckets, b = [], 1
+        while b < self.config.max_batch:
+            buckets.append(b)
+            b <<= 1
+        buckets.append(self.config.max_batch)
+        for var in variants:
+            var_f, fmask = var, None
+            if self._sequence_mode(var):
+                padded, fmask = batching.pad_sequence(
+                    var[0], self.config.sequence_quantum
+                )
+                var_f = (padded,)
+            sig = batching.bucket_signature(
+                var_f, self.config.sequence_quantum,
+                self._sequence_mode(var_f),
+            )
+            for bucket in buckets:
+                cols = [
+                    np.zeros((bucket,) + a.shape, a.dtype) for a in var_f
+                ]
+                fcol = (
+                    np.tile(fmask, (bucket, 1)) if fmask is not None
+                    else None
+                )
+                with self._weights_lock:
+                    params, net_state = (
+                        self.model.params, self.model.net_state,
+                    )
+                with self._inflight_lock:
+                    self._dispatch_token += 1
+                    token = self._dispatch_token
+                self._wd_arm(token)
+                try:
+                    self._call_model(cols, fcol, params, net_state)
+                finally:
+                    # dur=None: compile-inclusive warm-up durations must
+                    # NOT seed the watchdog EWMA — with k=1 they would
+                    # stretch the wedge-abort deadline far past
+                    # dispatch_timeout_s for the first real batches
+                    self._wd_disarm(token, None)
+                warmed.append((sig, bucket))
+        with self._stats_lock:
+            self.warmed_signatures = warmed
+        log.info("serving warm start: %d program signature(s) compiled",
+                 len(warmed))
+        return warmed
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        with self._stats_lock:
+            lats = sorted(self._latencies)
+            counts = dict(self._counts)
+            ewma = self._batch_ewma
+            occupancy = self._last_occupancy
+
+        def pct(p: float):
+            if not lats:
+                return None
+            return lats[min(len(lats) - 1, int(p * len(lats)))]
+
+        return {
+            "queue_depth": self.queue.depth,
+            "generation": self.generation,
+            "batch_latency_ewma_s": ewma,
+            "batch_occupancy": occupancy,
+            "p50_s": pct(0.50),
+            "p99_s": pct(0.99),
+            "breaker": self.breaker.stats(),
+            "warmed_programs": len(self.warmed_signatures),
+            **counts,
+        }
+
+    def reset_latency_window(self) -> None:
+        """Drop the percentile reservoir (bench phase boundaries)."""
+        with self._stats_lock:
+            self._latencies.clear()
+
+    # -- telemetry helpers (never on the request's critical error path) ---
+    def _shed(self, req: PendingRequest, reason: str) -> None:
+        req.fail(ServingRejected(reason))
+        self._count_shed(reason)
+
+    def _count_shed(self, reason: str) -> None:
+        with self._stats_lock:
+            self._counts["shed"] += 1
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().counter("dl4jtpu_serving_shed_total").inc(
+                reason=reason
+            )
+        except Exception as e:
+            log.debug("serving shed metric failed: %s", e)
+
+    def _count_outcome(self, outcome: str) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().counter("dl4jtpu_serving_requests_total").inc(
+                outcome=outcome
+            )
+        except Exception as e:
+            log.debug("serving outcome metric failed: %s", e)
+
+    def _count_swap(self, result: str) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().counter("dl4jtpu_serving_hotswap_total").inc(
+                result=result
+            )
+        except Exception as e:
+            log.debug("serving hotswap metric failed: %s", e)
+
+    def _observe_latency(self, secs: float) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().histogram(
+                "dl4jtpu_serving_request_latency_seconds"
+            ).observe(secs)
+        except Exception as e:
+            log.debug("serving latency metric failed: %s", e)
+
+    def _gauge_depth(self) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().gauge("dl4jtpu_serving_queue_depth").set(
+                self.queue.depth
+            )
+        except Exception as e:
+            log.debug("serving depth gauge failed: %s", e)
+
+    def _gauge_batch(self, real: int, bucket: int) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            reg = registry()
+            reg.counter("dl4jtpu_serving_batches_total").inc()
+            reg.gauge("dl4jtpu_serving_batch_occupancy").set(real / bucket)
+        except Exception as e:
+            log.debug("serving batch metric failed: %s", e)
+
+    def _gauge_generation(self, gen: int) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().gauge("dl4jtpu_serving_weights_generation").set(gen)
+        except Exception as e:
+            log.debug("serving generation gauge failed: %s", e)
+
+
+# -- process-global server listing (the UI's /api/serving) -----------------
+
+_SERVERS_LOCK = threading.Lock()
+_SERVERS: "weakref.WeakSet[InferenceServer]" = weakref.WeakSet()
+
+
+def _register_server(server: InferenceServer) -> None:
+    with _SERVERS_LOCK:
+        _SERVERS.add(server)
+
+
+def active_servers() -> list[InferenceServer]:
+    with _SERVERS_LOCK:
+        return list(_SERVERS)

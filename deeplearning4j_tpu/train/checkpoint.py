@@ -57,16 +57,56 @@ class CheckpointVerifyError(RuntimeError):
     CRC mismatch, missing entries, leaf-count drift)."""
 
 
-def _count_verify_failure(path: str, reason: str) -> None:
+def _count_verify_failure(path: str, reason: str,
+                          kind: str = "corrupt") -> None:
     log.warning("checkpoint %s failed verification: %s", path, reason)
     try:
         from deeplearning4j_tpu.observe.metrics import registry
 
-        registry().counter("dl4jtpu_ckpt_verify_failures_total").inc()
+        registry().counter(
+            "dl4jtpu_ckpt_verify_failures_total"
+        ).inc(reason=kind)
     except Exception as e:
         # best-effort metric: the verify failure itself (already logged
         # above) must propagate even when telemetry is broken
         log.debug("ckpt verify-failure metric failed: %s", e)
+
+
+def params_nonfinite(path: str) -> bool:
+    """True when the checkpoint's params.npz carries NaN/Inf — read
+    straight from the zip, no model build.  Integrity verification
+    cannot catch this: a save cadence aligned with the divergence
+    iteration checkpoints already-NaN params with perfectly good CRCs,
+    and such a file must never become a rollback or serving target."""
+    with zipfile.ZipFile(path, "r") as zf:
+        npz = np.load(io.BytesIO(zf.read("params.npz")), allow_pickle=False)
+        for name in npz.files:
+            a = npz[name]
+            if (np.issubdtype(a.dtype, np.floating)
+                    and not np.isfinite(a).all()):
+                return True
+    return False
+
+
+def count_skipped_checkpoint(path: str, reason: str) -> None:
+    """Ledger entry for a checkpoint passed over as a restore /
+    rollback / serving target for a reason verify() itself cannot see
+    (today: ``nonfinite`` — intact bytes holding NaN/Inf params): log
+    WHICH file and WHY, and count it under
+    ``dl4jtpu_ckpt_verify_failures_total{reason=...}``.  Corrupt files
+    are logged+counted (reason="corrupt") by `ModelSerializer.verify`
+    at detection time; callers skipping those add a context line, not
+    a second count."""
+    log.warning("checkpoint %s skipped as a restore target: %s",
+                path, reason)
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        registry().counter(
+            "dl4jtpu_ckpt_verify_failures_total"
+        ).inc(reason=reason)
+    except Exception as e:
+        log.debug("ckpt skip metric failed: %s", e)
 
 
 def _npz_bytes(tree) -> tuple[bytes, int]:
@@ -416,31 +456,71 @@ class CheckpointStore:
                     pass
 
     # -- read side ---------------------------------------------------------
-    def iter_valid(self):
+    def iter_valid(self, check_finite: bool = False):
         """Yield ``{"step", "path", "meta"}`` for every checkpoint on
         disk that passes verification, newest step first.  Corrupt
-        files are skipped and counted
-        (``dl4jtpu_ckpt_verify_failures_total``), never raised."""
+        files are skipped — each skip is logged WITH the file and the
+        defect, and counted under
+        ``dl4jtpu_ckpt_verify_failures_total{reason="corrupt"}`` —
+        never raised.  ``check_finite=True`` additionally screens
+        params for NaN/Inf (the `iter_valid` lesson: integrity proves
+        the bytes, not that they are worth restoring) and skips
+        poisoned files the same visible way (reason="nonfinite")."""
         for step, path in self._scan():
             try:
                 meta = ModelSerializer.verify(path)
-            except CheckpointVerifyError:
+            except CheckpointVerifyError as e:
+                # verify() counted reason="corrupt"; this line adds the
+                # skip CONTEXT an operator greps for during a recovery
+                log.warning(
+                    "CheckpointStore skipping step %d (%s): %s",
+                    step, path, e,
+                )
                 continue
+            if check_finite:
+                try:
+                    nonfinite = params_nonfinite(path)
+                except Exception as e:
+                    count_skipped_checkpoint(
+                        path, f"unreadable_params:{type(e).__name__}"
+                    )
+                    continue
+                if nonfinite:
+                    count_skipped_checkpoint(path, "nonfinite")
+                    continue
             yield {"step": step, "path": path, "meta": meta}
 
-    def latest_valid(self) -> Optional[dict]:
-        """Newest checkpoint that passes verification:
+    def latest_valid(self, check_finite: bool = False) -> Optional[dict]:
+        """Newest checkpoint that passes verification (and, with
+        ``check_finite=True``, the NaN/Inf screen):
         ``{"step", "path", "meta"}`` — or None when nothing on disk
         survives."""
-        return next(self.iter_valid(), None)
+        return next(self.iter_valid(check_finite=check_finite), None)
 
-    def restore_latest(self):
+    def restore_latest(self, check_finite: bool = False):
         """Restore the newest VALID checkpoint, or None when there is no
-        valid checkpoint to restore."""
-        entry = self.latest_valid()
+        valid checkpoint to restore.  Skipped candidates are logged and
+        counted by `iter_valid`."""
+        entry = self.latest_valid(check_finite=check_finite)
         if entry is None:
             return None
         return ModelSerializer.restore(entry["path"], verify=False)
+
+    # -- serving hook ------------------------------------------------------
+    def serve_into(self, server):
+        """Close the fine-tune-and-serve loop: register a save listener
+        that pushes every newly published checkpoint into a live
+        `serving.InferenceServer` as a VERIFIED hot-swap (manifest CRC
+        + finiteness checks run inside ``push_checkpoint``; a torn or
+        poisoned save rolls back and the server keeps its params).
+        Returns the listener — pass it to `remove_save_listener` to
+        detach."""
+
+        def _push(step: int, path: str) -> None:
+            server.push_checkpoint(path, source=f"ckpt_step_{step}")
+
+        self.add_save_listener(_push)
+        return _push
 
     def restore_model(self, step: int):
         """Restore a specific step (verifying it first)."""

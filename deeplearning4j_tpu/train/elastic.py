@@ -413,6 +413,19 @@ class ElasticSupervisor:
         self._fast_failures = 0
         self._sleep = time.sleep           # injectable for tests
 
+    def _gauge_backoff(self, seconds: float) -> None:
+        """dl4jtpu_supervisor_backoff_seconds: nonzero exactly while the
+        supervisor sleeps off a crash loop."""
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().gauge(
+                "dl4jtpu_supervisor_backoff_seconds"
+            ).set(float(seconds))
+        except Exception as e:
+            # the respawn decision must never hinge on telemetry
+            log.debug("supervisor backoff gauge failed: %s", e)
+
     def run(self, timeout: float = 300.0) -> None:
         world = self.initial_world
         deadline = time.time() + timeout
@@ -469,9 +482,17 @@ class ElasticSupervisor:
                     generation, time.time() - gen_t0, self._fast_failures,
                     delay,
                 )
-                self._sleep(delay)
+                # visible on /metrics while the sleep lasts: a respawn
+                # storm shows as a sawtooth on this gauge instead of
+                # hiding in supervisor logs
+                self._gauge_backoff(delay)
+                try:
+                    self._sleep(delay)
+                finally:
+                    self._gauge_backoff(0.0)
             else:
                 self._fast_failures = 0
+                self._gauge_backoff(0.0)
             lost = sum(1 for rc in rcs if rc == EXIT_CONTROL_PLANE_LOST)
             if lost:
                 self.control_plane_losses += lost

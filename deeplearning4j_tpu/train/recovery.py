@@ -115,23 +115,14 @@ def _batch_nonfinite(batch) -> bool:
 
 
 def _checkpoint_params_nonfinite(path: str) -> bool:
-    """True when the checkpoint's params.npz carries NaN/Inf — read
-    straight from the zip, no model build.  Integrity verification
-    cannot catch this: a save cadence aligned with the divergence
-    iteration checkpoints already-NaN params with perfectly good CRCs,
-    and such a file must never become a rollback target or hold the
-    rollback pin."""
-    import io
-    import zipfile
+    """True when the checkpoint's params.npz carries NaN/Inf (shared
+    with `CheckpointStore.iter_valid(check_finite=True)` — the serving
+    plane's hot-swap screen uses the same lesson).  Lazy import: this
+    module is reached via train/__init__ before train.checkpoint on
+    some import orders."""
+    from deeplearning4j_tpu.train.checkpoint import params_nonfinite
 
-    with zipfile.ZipFile(path, "r") as zf:
-        npz = np.load(io.BytesIO(zf.read("params.npz")), allow_pickle=False)
-        for name in npz.files:
-            a = npz[name]
-            if (np.issubdtype(a.dtype, np.floating)
-                    and not np.isfinite(a).all()):
-                return True
-    return False
+    return params_nonfinite(path)
 
 
 class _LrScaledTx:
@@ -309,7 +300,12 @@ class RecoveryPolicy:
                         "finiteness (%s); not pinning it", step, e)
             return True
         if nonfinite:
+            from deeplearning4j_tpu.train.checkpoint import (
+                count_skipped_checkpoint,
+            )
+
             self._event("poisoned_checkpoint_skipped", step=step)
+            count_skipped_checkpoint(path, "nonfinite")
             log.warning(
                 "checkpoint step %d is intact but holds non-finite "
                 "params (saved mid-divergence?); rollback pin stays "
@@ -567,8 +563,13 @@ class RecoveryPolicy:
                             "target", entry["step"], e)
                 continue
             if nonfinite:
+                from deeplearning4j_tpu.train.checkpoint import (
+                    count_skipped_checkpoint,
+                )
+
                 self._event("poisoned_checkpoint_skipped",
                             step=entry["step"])
+                count_skipped_checkpoint(entry["path"], "nonfinite")
                 log.warning(
                     "checkpoint step %d is intact but holds non-finite "
                     "params (saved mid-divergence?); skipping it as a "

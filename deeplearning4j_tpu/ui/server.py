@@ -13,7 +13,9 @@ charts, auto-refresh, JSON API.
 JSON API: /api/sessions, /api/stats?session=<id>, /api/trace (Chrome
 trace-event JSON of the step-timeline ring buffer), /api/programs (the
 compiled-program registry with XLA cost analysis + roofline),
-/api/trace/cluster (merged per-worker cluster timeline).  Scrape API:
+/api/trace/cluster (merged per-worker cluster timeline), /api/serving
+(live inference servers: queue depth, p50/p99, breaker, swap
+generation).  Scrape API:
 /metrics (Prometheus text exposition of the process-global
 `observe.metrics` registry — compile taxes, ETL wait, cache hits, step
 latency histogram, health counters, device memory) and /metrics/cluster
@@ -304,6 +306,13 @@ class UIServer:
                         analyze=q.get("analyze", ["1"])[0] != "0",
                         memory=q.get("memory", ["0"])[0] == "1",
                     ))
+                elif u.path == "/api/serving":
+                    # live inference servers in this process: queue
+                    # depth, p50/p99, breaker state, swap generation —
+                    # the serving plane's dashboard view
+                    from deeplearning4j_tpu.serving import active_servers
+
+                    self._json([s.stats() for s in active_servers()])
                 elif u.path == "/metrics/cluster":
                     # merged fleet exposition: every pushed worker's
                     # families re-labeled worker="...", plus the fleet

@@ -298,13 +298,28 @@ class TestTier1Gate:
         assert {
             "dl4jtpu_opt_state_bytes", "dl4jtpu_update_seconds_total",
         } <= fams
+        # ISSUE-11 serving-plane + supervisor-backoff families
+        assert {
+            "dl4jtpu_serving_requests_total",
+            "dl4jtpu_serving_shed_total",
+            "dl4jtpu_serving_request_latency_seconds",
+            "dl4jtpu_serving_queue_depth",
+            "dl4jtpu_serving_batch_occupancy",
+            "dl4jtpu_serving_batches_total",
+            "dl4jtpu_serving_breaker_state",
+            "dl4jtpu_serving_breaker_transitions_total",
+            "dl4jtpu_serving_hotswap_total",
+            "dl4jtpu_serving_weights_generation",
+            "dl4jtpu_supervisor_backoff_seconds",
+        } <= fams
         sites = load_fault_sites(REPO)
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",
             "checkpoint.fsync", "data.next_batch", "data.prefetch",
             "data.decode", "device.sync", "data.device_decode",
+            "serving.admit", "serving.infer", "serving.hotswap",
         }
-        assert {"slow", "faults"} <= load_declared_marks(REPO)
+        assert {"slow", "faults", "serving"} <= load_declared_marks(REPO)
 
 
 # -- CLI ---------------------------------------------------------------

@@ -1,0 +1,81 @@
+"""bench.py --serving must stay runnable in tier-1 (the BENCH_QUICK
+pattern from the scaling bench): the gate proves the sweep RUNS and the
+schema holds — quick runs deliberately do not rewrite the committed
+BENCH_SERVING.json, whose acceptance numbers come from a full run."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = pytest.mark.serving
+
+
+def test_serving_bench_quick_run_and_schema():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = ""          # bench decides; avoid conftest leak
+    env["BENCH_QUICK"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--serving"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["schema"] == "bench-serving/1"
+    assert out["platform"] == "cpu"
+    assert out["env"]["jax"]
+    for row in out["curve"]:
+        assert row["achieved_rps"] > 0
+        assert row["p50_ms"] is not None and row["p99_ms"] is not None
+        assert row["issued"] == (row["ok"] + row["shed"] + row["errors"]
+                                 + row["timeouts"])
+    # AOT warm start ran and recorded its ratio
+    ws = out["warm_start"]
+    assert ws["warmed_programs"] >= 3
+    assert ws["first_request_ms"] > 0 and ws["steady_p50_ms"] > 0
+    chaos = out["chaos"]
+    # deterministic chaos invariants (timing-independent): the nth-burst
+    # of infer hangs MUST wedge three consecutive dispatches and trip
+    # the breaker; the torn push MUST roll back; the clean one installs
+    assert chaos["wedged_batches"] >= 3
+    assert chaos["breaker_tripped"]
+    assert chaos["breaker_recovered"]
+    assert chaos["hotswap_rolled_back"]
+    assert chaos["hotswap_installed_after"]
+    assert chaos["weights_generation"] == 1
+    # no silent drops: the overload window's client-side ledger balances
+    assert chaos["all_requests_accounted"]
+    cw = chaos["chaos_window"]
+    assert cw["issued"] == (cw["ok"] + cw["shed"] + cw["errors"]
+                            + cw["timeouts"])
+    assert cw["shed"] > 0              # overload WAS shed, explicitly
+    assert chaos["post"]["ok"] > 0     # still serving after the storm
+    assert chaos["p99_post_ratio"] is not None
+    stages = [s for s, _ in chaos["watchdog_events"]]
+    assert "abort" in stages           # per-batch deadline escalated
+
+
+def test_committed_serving_table_meets_acceptance():
+    """The COMMITTED BENCH_SERVING.json (full, non-quick run) carries
+    the ISSUE 11 acceptance: chaos completed, p99 back within 2x after
+    injection stops, warm-started first request within 1.5x of
+    steady-state."""
+    path = os.path.join(REPO, "BENCH_SERVING.json")
+    assert os.path.exists(path), "BENCH_SERVING.json not committed"
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["schema"] == "bench-serving/1"
+    assert not doc["quick"]
+    assert len(doc["curve"]) >= 4
+    chaos = doc["chaos"]
+    assert chaos["completed"]
+    assert chaos["all_requests_accounted"]
+    assert chaos["breaker_tripped"] and chaos["breaker_recovered"]
+    assert chaos["hotswap_rolled_back"] and chaos["hotswap_installed_after"]
+    assert chaos["p99_post_ratio"] <= 2.0
+    assert doc["warm_start"]["first_request_ratio"] <= 1.5

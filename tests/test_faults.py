@@ -445,11 +445,12 @@ class TestCheckpointIntegrity:
         store.save(m)                              # publishes corrupt bytes
         faults.disarm()
         before = registry().counter(
-            "dl4jtpu_ckpt_verify_failures_total").value()
+            "dl4jtpu_ckpt_verify_failures_total").value(reason="corrupt")
         entry = store.latest_valid()
         assert entry["step"] == 1                  # last GOOD, not newest
         assert registry().counter(
-            "dl4jtpu_ckpt_verify_failures_total").value() > before
+            "dl4jtpu_ckpt_verify_failures_total"
+        ).value(reason="corrupt") > before
         restored = store.restore_latest()
         assert restored.iteration == 1
 

@@ -716,6 +716,32 @@ class TestSupervisorHardening:
         sup.run(timeout=60)
         assert sleeps == [0.5, 1.0, 2.0, 2.0]       # doubled, then capped
 
+    def test_backoff_is_visible_on_the_metrics_spine(self):
+        """ISSUE 11 satellite: the crash-loop backoff state must show on
+        /metrics (dl4jtpu_supervisor_backoff_seconds nonzero during the
+        sleep, zero after) — respawn storms were log-only before."""
+        from deeplearning4j_tpu.observe.metrics import registry
+        from deeplearning4j_tpu.train.elastic import (
+            EXIT_CONTROL_PLANE_LOST,
+            ElasticSupervisor,
+        )
+
+        rcs = [[EXIT_CONTROL_PLANE_LOST], [0]]
+
+        def spawn(i, world, gen):
+            return _FakeProc(rcs[gen - 1][i])
+
+        sup = ElasticSupervisor(
+            spawn, _FakeServer(), initial_world=1, min_world=1,
+            max_generations=3, backoff_base=0.7,
+        )
+        gauge = registry().gauge("dl4jtpu_supervisor_backoff_seconds")
+        seen_during_sleep = []
+        sup._sleep = lambda s: seen_during_sleep.append(gauge.value())
+        sup.run(timeout=60)
+        assert seen_during_sleep == [0.7]
+        assert gauge.value() == 0.0          # reset once the sleep ends
+
     def test_slow_generation_resets_the_backoff_streak(self):
         from deeplearning4j_tpu.train.elastic import (
             EXIT_CONTROL_PLANE_LOST,

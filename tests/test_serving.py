@@ -1,0 +1,799 @@
+"""Serving plane (ISSUE 11): continuous batching, bounded admission,
+deadline shedding, circuit breaker, watchdog-backed dispatch timeouts,
+verified weight hot-swap and AOT warm start — the unhappy paths are the
+product, so most tests here run under an armed FaultPlan."""
+
+import json
+import os
+import threading
+import time
+import urllib.error
+import urllib.request
+from concurrent.futures import ThreadPoolExecutor
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import GraphModel, SequentialModel
+from deeplearning4j_tpu.nn.conf import (
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.nn.conf.graph_conf import GraphBuilder
+from deeplearning4j_tpu.nn.losses import Loss
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.serving import (
+    InferenceServer,
+    ServingConfig,
+    ServingError,
+    ServingRejected,
+    ServingTimeout,
+    weights_checksum,
+)
+
+pytestmark = pytest.mark.serving
+
+N_IN, N_OUT = 6, 4
+
+
+def _conf(seed=7):
+    return (
+        NeuralNetConfiguration.builder().seed(seed).list()
+        .layer(Dense(n_out=8)).layer(OutputLayer(n_out=N_OUT))
+        .set_input_type(InputType.feed_forward(N_IN)).build()
+    )
+
+
+def _model(seed=7):
+    return SequentialModel(_conf(seed)).init()
+
+
+def _server(model=None, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("linger_s", 0.002)
+    kw.setdefault("dispatch_timeout_s", 10.0)
+    return InferenceServer(model or _model(), ServingConfig(**kw))
+
+
+def _x(seed=0, n=N_IN):
+    return np.random.default_rng(seed).normal(size=(n,)).astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _crash_dir(tmp_path, monkeypatch):
+    # watchdog stack dumps from wedged-dispatch tests land in tmp, not cwd
+    monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path / "crash"))
+
+
+# -- request path ------------------------------------------------------------
+
+
+class TestRequestPath:
+    def test_single_request_matches_direct_output(self):
+        m = _model()
+        srv = _server(m).start()
+        try:
+            x = _x(1)
+            out = srv.infer(x, deadline_s=60.0)
+            direct = np.asarray(m.output(x[None]))[0]
+            np.testing.assert_allclose(out, direct, rtol=1e-5, atol=1e-6)
+        finally:
+            srv.stop()
+
+    def test_concurrent_requests_coalesce_into_batches(self):
+        m = _model()
+        srv = _server(m, linger_s=0.02).start()
+        try:
+            xs = [_x(i) for i in range(12)]
+            with ThreadPoolExecutor(12) as ex:
+                outs = list(ex.map(
+                    lambda a: np.asarray(srv.infer(a, deadline_s=60.0)), xs,
+                ))
+            for x, out in zip(xs, outs):
+                np.testing.assert_allclose(
+                    out, np.asarray(m.output(x[None]))[0],
+                    rtol=1e-5, atol=1e-6,
+                )
+            st = srv.stats()
+            assert st["completed"] == 12
+            # coalescing happened: fewer dispatches than requests
+            assert st["batches"] < 12
+        finally:
+            srv.stop()
+
+    def test_batch_buckets_bound_the_program_set(self):
+        m = _model()
+        srv = _server(m, max_batch=8, linger_s=0.02).start()
+        try:
+            for n in (1, 2, 3, 5, 6, 7, 8):
+                with ThreadPoolExecutor(n) as ex:
+                    list(ex.map(
+                        lambda a: srv.infer(a, deadline_s=60.0),
+                        [_x(i) for i in range(n)],
+                    ))
+            # every coalesced size quantized onto {1,2,4,8}: at most 4
+            # compiled shapes for the one infer program
+            infer_fn = m._step_fns[("infer", False)]
+            assert infer_fn._cache_size() <= 4
+        finally:
+            srv.stop()
+
+    def test_graph_model_serving(self):
+        conf = (
+            GraphBuilder().add_inputs("in")
+            .add_layer("fc1", Dense(n_out=8), "in")
+            .add_layer("out", OutputLayer(n_out=3, loss=Loss.MCXENT), "fc1")
+            .set_outputs("out")
+            .set_input_types(InputType.feed_forward(5)).build()
+        )
+        gm = GraphModel(conf).init()
+        srv = _server(gm).start()
+        try:
+            x = _x(3, n=5)
+            out = srv.infer(x, deadline_s=60.0)
+            np.testing.assert_allclose(
+                out, np.asarray(gm.output(x[None]))[0],
+                rtol=1e-5, atol=1e-6,
+            )
+        finally:
+            srv.stop()
+
+    def test_sequence_bucketing_bounds_programs_and_slices_output(self):
+        from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+
+        conf = (
+            NeuralNetConfiguration.builder().seed(3).list()
+            .layer(LSTM(n_out=6)).layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(4)).build()
+        )
+        m = SequentialModel(conf).init()
+        srv = _server(
+            m, bucket_sequences=True, sequence_quantum=8, max_batch=2,
+        ).start()
+        try:
+            for t in (5, 7, 8, 11):
+                x = np.random.default_rng(t).normal(
+                    size=(t, 4)).astype(np.float32)
+                out = np.asarray(srv.infer(x, deadline_s=60.0))
+                # time-distributed output sliced back to the REAL length
+                assert out.shape == (t, 2)
+                assert np.isfinite(out).all()
+            # lengths 5/7/8 share the 8-bucket, 11 lands in 16: two time
+            # shapes x one batch bucket
+            infer_fn = m._step_fns[("infer", True)]
+            assert infer_fn._cache_size() <= 2
+        finally:
+            srv.stop()
+
+
+# -- admission: backpressure + deadline shedding -----------------------------
+
+
+class TestAdmission:
+    def test_queue_full_is_explicit_backpressure(self):
+        srv = _server(max_queue=2)        # batcher NOT started
+        srv.submit(_x(0), deadline_s=60.0)
+        srv.submit(_x(1), deadline_s=60.0)
+        with pytest.raises(ServingRejected) as ei:
+            srv.submit(_x(2), deadline_s=60.0)
+        assert ei.value.reason == "queue_full"
+        assert ei.value.status == 429
+        # shutdown fails the queued requests explicitly too
+        srv.stop()
+
+    def test_unmeetable_deadline_shed_at_admit(self):
+        srv = _server()                   # not started: nothing dispatches
+        with srv._stats_lock:
+            srv._batch_ewma = 1.0         # "batches take a second"
+        with pytest.raises(ServingRejected) as ei:
+            srv.submit(_x(0), deadline_s=0.05)
+        assert ei.value.reason == "deadline"
+        assert ei.value.status == 503
+        # a meetable deadline still admits
+        req = srv.submit(_x(0), deadline_s=60.0)
+        assert not req.done
+        srv.stop()
+
+    def test_expired_request_shed_at_dispatch_not_silently_dropped(self):
+        srv = _server()
+        req = srv.submit(_x(0), deadline_s=0.05)
+        time.sleep(0.1)                   # deadline passes while queued
+        srv.start()
+        deadline = time.time() + 5
+        while not req.done and time.time() < deadline:
+            time.sleep(0.01)
+        assert req.done
+        with pytest.raises(ServingRejected) as ei:
+            req.result()
+        assert ei.value.reason == "deadline"
+        srv.stop()
+
+    def test_client_timeout_raises_serving_timeout(self):
+        srv = _server()                   # not started: never completes
+        req = srv.submit(_x(0), deadline_s=0.05)
+        with pytest.raises(ServingTimeout):
+            req.result()
+        srv.stop()
+
+    @pytest.mark.faults
+    def test_admit_fault_site_rejects_explicitly(self):
+        srv = _server().start()
+        try:
+            faults.arm("serving.admit:raise:nth=1")
+            with pytest.raises(ServingRejected) as ei:
+                srv.submit(_x(0))
+            assert ei.value.reason == "admit_fault"
+            faults.disarm()
+            # the plane keeps serving after the injected admit failure
+            assert np.isfinite(
+                np.asarray(srv.infer(_x(1), deadline_s=60.0))
+            ).all()
+        finally:
+            srv.stop()
+
+
+# -- circuit breaker ---------------------------------------------------------
+
+
+class TestBreaker:
+    @pytest.mark.faults
+    def test_consecutive_failures_trip_then_probe_recovers(self):
+        srv = _server(
+            breaker_threshold=2, breaker_probe_after_s=0.15,
+        ).start()
+        try:
+            srv.infer(_x(0), deadline_s=60.0)     # healthy first
+            faults.arm("serving.infer:raise:every=1,exc=runtime")
+            for _ in range(2):
+                with pytest.raises(ServingError):
+                    srv.infer(_x(1), deadline_s=60.0)
+            assert srv.breaker.state == "open"
+            # open breaker = explicit 503 at ADMISSION, not a queued wait
+            with pytest.raises(ServingRejected) as ei:
+                srv.submit(_x(2))
+            assert ei.value.reason == "breaker_open"
+            faults.disarm()
+            time.sleep(0.2)               # past the probe window
+            out = srv.infer(_x(3), deadline_s=60.0)   # the half-open probe
+            assert np.isfinite(np.asarray(out)).all()
+            assert srv.breaker.state == "closed"
+            assert srv.breaker.stats()["trips"] == 1
+            assert srv.breaker.stats()["recoveries"] == 1
+        finally:
+            srv.stop()
+
+    @pytest.mark.faults
+    def test_nonfinite_outputs_are_failures_not_results(self):
+        srv = _server(breaker_threshold=2).start()
+        try:
+            faults.arm("serving.infer:corrupt:every=1")
+            for _ in range(2):
+                with pytest.raises(ServingError) as ei:
+                    srv.infer(_x(0), deadline_s=60.0)
+                assert "non-finite" in str(ei.value)
+            assert srv.breaker.state == "open"
+        finally:
+            srv.stop()
+
+    @pytest.mark.faults
+    def test_failed_probe_reopens(self):
+        srv = _server(
+            breaker_threshold=1, breaker_probe_after_s=0.1,
+        ).start()
+        try:
+            faults.arm("serving.infer:raise:every=1,exc=runtime")
+            with pytest.raises(ServingError):
+                srv.infer(_x(0), deadline_s=60.0)
+            assert srv.breaker.state == "open"
+            time.sleep(0.15)
+            with pytest.raises(ServingError):      # probe fails too
+                srv.infer(_x(1), deadline_s=60.0)
+            assert srv.breaker.state == "open"
+        finally:
+            srv.stop()
+
+
+# -- watchdog-backed dispatch timeout ----------------------------------------
+
+
+class TestDispatchTimeout:
+    @pytest.mark.faults
+    def test_wedged_dispatch_fails_batch_and_keeps_serving(self):
+        srv = _server(breaker_threshold=3).start()
+        try:
+            srv.infer(_x(0), deadline_s=60.0)     # warm the program
+            # shrink the per-batch deadline so the injected 0.4s hang
+            # blows it (abort fires at 2x the base deadline)
+            srv.config.dispatch_timeout_s = 0.05
+            srv._watchdog.floor_s = 0.05
+            faults.arm("serving.infer:delay:nth=1,secs=0.4")
+            with pytest.raises(ServingError) as ei:
+                srv.infer(_x(1), deadline_s=60.0)
+            assert "wedged" in str(ei.value)
+            faults.disarm()
+            st = srv.stats()
+            assert st["wedged_batches"] == 1
+            assert srv.breaker.stats()["consecutive_failures"] >= 1
+            # the wedged call's late return was discarded; fresh
+            # requests dispatch normally
+            out = srv.infer(_x(2), deadline_s=60.0)
+            assert np.isfinite(np.asarray(out)).all()
+        finally:
+            srv.stop()
+
+
+class TestReviewRegressions:
+    """Fixes from the PR 10 review pass."""
+
+    @pytest.mark.faults
+    def test_probe_slot_survives_an_admit_side_rejection(self):
+        """A HALF_OPEN probe slot consumed by a request that is then
+        shed AT ADMIT (queue full / deadline / bad arity) must be
+        released — the leak made the breaker reject 100% of traffic
+        forever."""
+        srv = _server(
+            breaker_threshold=1, breaker_probe_after_s=0.05, max_queue=1,
+        ).start()
+        try:
+            faults.arm("serving.infer:raise:nth=1,exc=runtime")
+            with pytest.raises(ServingError):
+                srv.infer(_x(0), deadline_s=60.0)
+            faults.disarm()
+            assert srv.breaker.state == "open"
+            time.sleep(0.1)               # probe window open
+            # consume the probe slot with a request that is rejected at
+            # admit (wrong input arity raises before it ever enqueues)
+            with pytest.raises(ValueError):
+                srv.submit((_x(0), _x(1)), deadline_s=60.0)
+            # the slot must be free again: a clean request probes and
+            # closes the breaker instead of deadlocking it half-open
+            out = srv.infer(_x(2), deadline_s=60.0)
+            assert np.isfinite(np.asarray(out)).all()
+            assert srv.breaker.state == "closed"
+        finally:
+            srv.stop()
+
+    @pytest.mark.faults
+    def test_long_wedge_does_not_pin_the_server(self):
+        """While a dispatch is STILL wedged (thread blocked in the
+        device call), a replacement batcher keeps serving and a weight
+        push still installs — the old design held the weights lock
+        across the call and pinned both."""
+        m = _model()
+        srv = _server(m, breaker_threshold=10).start()
+        try:
+            srv.infer(_x(0), deadline_s=60.0)
+            srv.config.dispatch_timeout_s = 0.05
+            srv._watchdog.floor_s = 0.05
+            faults.arm("serving.infer:delay:nth=1,secs=2.0")
+            with pytest.raises(ServingError):
+                srv.infer(_x(1), deadline_s=60.0)   # aborted at ~0.1s
+            faults.disarm()
+            # the wedged thread is STILL sleeping inside the old
+            # dispatch; the replacement batcher must serve this
+            out = srv.infer(_x(2), deadline_s=1.5)
+            assert np.isfinite(np.asarray(out)).all()
+            # and a hot-swap must not deadlock on the weights lock
+            good = jax.tree.map(lambda a: a + 0.5, m.params)
+            assert srv.push_weights(good, checksum=weights_checksum(good))
+        finally:
+            time.sleep(0)                 # let the wedged thread die off
+            srv.stop()
+
+    def test_masked_and_unmasked_requests_share_a_batch(self):
+        """A batch whose FIRST request has no mask and a later one does
+        must not crash the mask backfill (AttributeError on None)."""
+        from deeplearning4j_tpu.nn.conf.recurrent import LSTM, RnnOutputLayer
+        from deeplearning4j_tpu.serving.admission import PendingRequest
+        from deeplearning4j_tpu.serving.batching import bucket_signature
+
+        conf = (
+            NeuralNetConfiguration.builder().seed(3).list()
+            .layer(LSTM(n_out=6)).layer(RnnOutputLayer(n_out=2))
+            .set_input_type(InputType.recurrent(4)).build()
+        )
+        m = SequentialModel(conf).init()
+        srv = _server(m, max_batch=2)
+        x = np.random.default_rng(0).normal(size=(8, 4)).astype(np.float32)
+        sig = bucket_signature((x,), None, False)
+        deadline = time.monotonic() + 60
+        unmasked = PendingRequest((x,), sig, deadline)          # no fmask
+        masked = PendingRequest(
+            (x,), sig, deadline, fmask=np.ones((8,), np.float32),
+        )
+        rows = srv._run_program([unmasked, masked], bucket=2, token=1)
+        assert rows[0].shape[0] == 2
+        assert np.isfinite(rows[0]).all()
+
+    def test_warm_start_does_not_seed_the_watchdog_ewma(self):
+        """Compile-inclusive warm-up durations must not inflate the
+        wedge-abort deadline (k=1: deadline would become the compile
+        time, not dispatch_timeout_s)."""
+        srv = _server(max_batch=2)
+        srv.warm_start(np.zeros((N_IN,), np.float32))
+        assert srv._watchdog.ewma is None
+
+    def test_drained_signatures_are_pruned_from_the_queue(self):
+        srv = _server(linger_s=0.0).start()
+        try:
+            for seed, n in ((0, N_IN), (1, N_IN)):
+                srv.infer(_x(seed, n=n), deadline_s=60.0)
+            # two float32 signatures went through; drained deques must
+            # not accumulate (long-lived replicas, many shapes)
+            deadline = time.time() + 5
+            while srv.queue._by_sig and time.time() < deadline:
+                time.sleep(0.01)
+            assert srv.queue._by_sig == {}
+        finally:
+            srv.stop()
+
+
+# -- verified weight hot-swap ------------------------------------------------
+
+
+class TestHotSwap:
+    def test_installed_swap_changes_outputs_atomically(self):
+        m = _model()
+        srv = _server(m).start()
+        try:
+            x = _x(5)
+            before = np.asarray(srv.infer(x, deadline_s=60.0))
+            new_params = jax.tree.map(lambda a: a + 0.25, m.params)
+            crc = weights_checksum(new_params)
+            assert srv.push_weights(new_params, checksum=crc)
+            assert srv.generation == 1
+            after = np.asarray(srv.infer(x, deadline_s=60.0))
+            assert not np.allclose(before, after)
+            # same shapes -> same compiled program: no recompile on swap
+            np.testing.assert_allclose(
+                after, np.asarray(m.output(x[None]))[0],
+                rtol=1e-5, atol=1e-6,
+            )
+        finally:
+            srv.stop()
+
+    @pytest.mark.faults
+    def test_torn_push_rolls_back_and_old_params_keep_serving(self):
+        m = _model()
+        srv = _server(m).start()
+        try:
+            x = _x(6)
+            before = np.asarray(srv.infer(x, deadline_s=60.0))
+            faults.arm("serving.hotswap:truncate:nth=1")
+            ok = srv.push_weights(jax.tree.map(lambda a: a + 1.0, m.params))
+            faults.disarm()
+            assert not ok
+            assert srv.generation == 0
+            assert srv.stats()["swaps_rolled_back"] == 1
+            after = np.asarray(srv.infer(x, deadline_s=60.0))
+            np.testing.assert_allclose(before, after)
+        finally:
+            srv.stop()
+
+    @pytest.mark.faults
+    def test_poisoned_push_rolls_back(self):
+        m = _model()
+        srv = _server(m).start()
+        try:
+            faults.arm("serving.hotswap:corrupt:nth=1")
+            ok = srv.push_weights(jax.tree.map(lambda a: a + 1.0, m.params))
+            faults.disarm()
+            assert not ok
+            out = srv.infer(_x(0), deadline_s=60.0)
+            assert np.isfinite(np.asarray(out)).all()
+        finally:
+            srv.stop()
+
+    def test_checksum_mismatch_rolls_back(self):
+        m = _model()
+        srv = _server(m).start()
+        try:
+            new_params = jax.tree.map(lambda a: a + 0.5, m.params)
+            assert not srv.push_weights(new_params, checksum=0xDEAD)
+            assert srv.generation == 0
+        finally:
+            srv.stop()
+
+    @pytest.mark.faults
+    def test_swap_under_load_drops_zero_inflight_requests(self):
+        """The acceptance property: a stream of requests spanning
+        several swaps (one of them torn) all complete successfully —
+        atomic install between batches, rollback on the torn one."""
+        m = _model()
+        srv = _server(m, linger_s=0.001).start()
+        try:
+            stop = threading.Event()
+            errors: list = []
+            done = []
+
+            def client(i):
+                k = 0
+                while not stop.is_set():
+                    try:
+                        out = srv.infer(_x(i * 100 + k), deadline_s=60.0)
+                        assert np.isfinite(np.asarray(out)).all()
+                        done.append(1)
+                    except Exception as exc:      # any failure is a drop
+                        errors.append(exc)
+                    k += 1
+
+            threads = [
+                threading.Thread(target=client, args=(i,)) for i in range(4)
+            ]
+            for t in threads:
+                t.start()
+            time.sleep(0.05)
+            good = jax.tree.map(lambda a: a + 0.125, m.params)
+            assert srv.push_weights(good, checksum=weights_checksum(good))
+            faults.arm("serving.hotswap:truncate:nth=1")
+            assert not srv.push_weights(
+                jax.tree.map(lambda a: a * 3.0, m.params)
+            )
+            faults.disarm()
+            time.sleep(0.05)
+            stop.set()
+            for t in threads:
+                t.join(30)
+            assert not errors
+            assert len(done) > 0
+            assert srv.generation == 1
+        finally:
+            srv.stop()
+
+    def test_push_checkpoint_and_store_serve_into(self, tmp_path):
+        from deeplearning4j_tpu.train.checkpoint import CheckpointStore
+
+        m = _model()
+        srv = _server(m).start()
+        try:
+            trainer = _model(seed=99)     # same architecture, new weights
+            store = CheckpointStore(str(tmp_path), keep_last=3)
+            store.serve_into(srv)
+            x = _x(7)
+            expect = np.asarray(trainer.output(x[None]))[0]
+            store.save(trainer, step=1)   # save listener pushes the swap
+            assert srv.generation == 1
+            np.testing.assert_allclose(
+                np.asarray(srv.infer(x, deadline_s=60.0)), expect,
+                rtol=1e-5, atol=1e-6,
+            )
+            # a corrupt checkpoint push rolls back (manifest CRC)
+            path = store.path_for(2)
+            from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+            ModelSerializer.write_model(trainer, path)
+            with open(path, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(path) // 2))
+            assert not srv.push_checkpoint(path)
+            assert srv.generation == 1
+        finally:
+            srv.stop()
+
+
+# -- AOT warm start ----------------------------------------------------------
+
+
+class TestWarmStart:
+    def test_warm_start_precompiles_the_bucket_set(self):
+        from deeplearning4j_tpu.runtime import compile_stats
+
+        m = _model(seed=11)
+        srv = _server(m, max_batch=4, linger_s=0.02).start()
+        try:
+            warmed = srv.warm_start(np.zeros((N_IN,), np.float32))
+            assert len(warmed) == 3               # buckets 1, 2, 4
+            snap = compile_stats.snapshot()
+            # every coalesced size now hits a warmed program: NO fresh
+            # jit trace on the serving path
+            for n in (1, 2, 3, 4):
+                with ThreadPoolExecutor(n) as ex:
+                    list(ex.map(
+                        lambda a: srv.infer(a, deadline_s=60.0),
+                        [_x(i) for i in range(n)],
+                    ))
+            delta = compile_stats.snapshot() - snap
+            assert delta.jit_cache_misses == 0
+        finally:
+            srv.stop()
+
+
+# -- telemetry / endpoints ---------------------------------------------------
+
+
+class TestTelemetry:
+    def test_serving_families_land_on_the_metrics_spine(self):
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        srv = _server().start()
+        try:
+            reg = registry()
+            before = reg.counter(
+                "dl4jtpu_serving_requests_total").value(outcome="ok")
+            srv.infer(_x(0), deadline_s=60.0)
+            assert reg.counter(
+                "dl4jtpu_serving_requests_total"
+            ).value(outcome="ok") == before + 1
+            text = reg.to_prometheus_text()
+            for family in (
+                "dl4jtpu_serving_request_latency_seconds",
+                "dl4jtpu_serving_queue_depth",
+                "dl4jtpu_serving_batch_occupancy",
+                "dl4jtpu_serving_breaker_state",
+            ):
+                assert family in text
+        finally:
+            srv.stop()
+
+    def test_ui_api_serving_endpoint(self):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        srv = _server().start()
+        ui = UIServer(port=0)
+        try:
+            srv.infer(_x(0), deadline_s=60.0)
+            with urllib.request.urlopen(ui.url + "api/serving") as r:
+                rows = json.load(r)
+            assert any(r.get("completed", 0) >= 1 for r in rows)
+            assert all("breaker" in r for r in rows)
+        finally:
+            ui.stop()
+            srv.stop()
+
+
+class TestHTTPFrontend:
+    def test_infer_status_health_and_errors(self):
+        from deeplearning4j_tpu.serving import ServingHTTPServer
+
+        m = _model()
+        srv = _server(m).start()
+        http = ServingHTTPServer(srv).start()
+        try:
+            req = urllib.request.Request(
+                http.url + "v1/infer",
+                data=json.dumps({
+                    "features": [0.1] * N_IN, "deadline_ms": 60000,
+                }).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req) as r:
+                resp = json.load(r)
+            assert len(resp["outputs"]) == N_OUT
+            assert resp["generation"] == 0
+            with urllib.request.urlopen(http.url + "healthz") as r:
+                assert r.status == 200
+            with urllib.request.urlopen(http.url + "v1/status") as r:
+                status = json.load(r)
+            assert status["completed"] >= 1
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    http.url + "v1/infer", data=b"not json",
+                ))
+            assert ei.value.code == 400
+            ei.value.close()
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    http.url + "v1/reload", data=b"{}",
+                ))
+            assert ei.value.code == 400
+            ei.value.close()
+        finally:
+            http.stop()
+            srv.stop()
+
+    def test_healthz_503_while_breaker_open(self):
+        from deeplearning4j_tpu.serving import ServingHTTPServer
+
+        srv = _server(breaker_threshold=1).start()
+        http = ServingHTTPServer(srv).start()
+        try:
+            faults.arm("serving.infer:raise:every=1,exc=runtime")
+            with pytest.raises(ServingError):
+                srv.infer(_x(0), deadline_s=60.0)
+            faults.disarm()
+            assert srv.breaker.state == "open"
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(http.url + "healthz")
+            assert ei.value.code == 503
+            ei.value.close()
+            # an open breaker maps to 503 on infer too — explicit, fast
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(urllib.request.Request(
+                    http.url + "v1/infer",
+                    data=json.dumps({"features": [0.0] * N_IN}).encode(),
+                ))
+            assert ei.value.code == 503
+            ei.value.close()
+        finally:
+            http.stop()
+            srv.stop()
+
+
+# -- checkpoint-store skip visibility (ISSUE 11 satellite) -------------------
+
+
+class TestCheckpointSkipVisibility:
+    def test_iter_valid_logs_and_counts_corrupt_and_nonfinite(
+        self, tmp_path, caplog,
+    ):
+        from deeplearning4j_tpu.observe.metrics import registry
+        from deeplearning4j_tpu.train.checkpoint import (
+            CheckpointStore, ModelSerializer,
+        )
+
+        store = CheckpointStore(str(tmp_path), keep_last=10)
+        good = _model(seed=1)
+        good.iteration = 1
+        store.save(good)
+        # an intact-but-NaN checkpoint (saved mid-divergence)
+        poisoned = _model(seed=2)
+        poisoned.params = jax.tree.map(
+            lambda a: np.asarray(a) * np.nan, poisoned.params
+        )
+        poisoned.iteration = 2
+        store.save(poisoned)
+        # a corrupt (truncated) checkpoint
+        bad = _model(seed=3)
+        bad.iteration = 3
+        store.save(bad)
+        path3 = store.path_for(3)
+        with open(path3, "r+b") as f:
+            f.truncate(max(1, os.path.getsize(path3) // 2))
+
+        reg = registry()
+        corrupt_before = reg.counter(
+            "dl4jtpu_ckpt_verify_failures_total").value(reason="corrupt")
+        nonfinite_before = reg.counter(
+            "dl4jtpu_ckpt_verify_failures_total").value(reason="nonfinite")
+        import logging
+
+        with caplog.at_level(logging.WARNING, logger="deeplearning4j_tpu"):
+            entries = list(store.iter_valid(check_finite=True))
+        assert [e["step"] for e in entries] == [1]
+        assert reg.counter(
+            "dl4jtpu_ckpt_verify_failures_total"
+        ).value(reason="corrupt") == corrupt_before + 1
+        assert reg.counter(
+            "dl4jtpu_ckpt_verify_failures_total"
+        ).value(reason="nonfinite") == nonfinite_before + 1
+        # WHICH file and WHY are in the logs now
+        assert any(
+            "skipping step 3" in r.getMessage() for r in caplog.records
+        )
+        assert any(
+            "nonfinite" in r.getMessage()
+            and store.path_for(2) in r.getMessage()
+            for r in caplog.records
+        )
+        # restore_latest(check_finite=True) lands on the finite one
+        restored = store.restore_latest(check_finite=True)
+        assert restored.iteration == 1
+        # sanity: without the finite screen the poisoned newest wins
+        assert ModelSerializer.verify(store.path_for(2))
+
+
+# -- zoo model through the serving plane -------------------------------------
+
+
+class TestZooServing:
+    def test_zoo_model_serves(self):
+        from deeplearning4j_tpu.zoo.lenet import LeNet
+
+        m = LeNet(num_classes=10, seed=5).init_model()
+        srv = _server(m, max_batch=2, linger_s=0.0).start()
+        try:
+            x = np.random.default_rng(0).normal(
+                size=(28, 28, 1)).astype(np.float32)
+            out = np.asarray(srv.infer(x, deadline_s=120.0))
+            assert out.shape == (10,)
+            assert np.isfinite(out).all()
+        finally:
+            srv.stop()
