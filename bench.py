@@ -1883,6 +1883,83 @@ def bench_chaos() -> None:
     }))
 
 
+def _serving_closed_loop(target, clients, duration_s, deadline_s, n_in):
+    """Closed-loop load against anything speaking ``infer(x,
+    deadline_s=...)`` — an `InferenceServer` or a `ServingFleet` front
+    door.  Every request's outcome is recorded from the CLIENT side:
+    ok/shed/error/timeout must add up to issued, which is the
+    no-silent-drops proof shared by --serving and --serving-fleet."""
+    import threading
+
+    import numpy as np
+
+    from deeplearning4j_tpu.serving import (
+        ServingError, ServingRejected, ServingTimeout,
+    )
+
+    stop = threading.Event()
+    lock = threading.Lock()
+    tally = {"issued": 0, "ok": 0, "errors": 0, "timeouts": 0}
+    shed: dict = {}
+    lats: list = []
+
+    def client(cid):
+        rng = np.random.default_rng(cid)
+        local_lats = []
+        while not stop.is_set():
+            x = rng.normal(size=(n_in,)).astype(np.float32)
+            t0 = time.monotonic()
+            outcome, reason = "ok", None
+            try:
+                target.infer(x, deadline_s=deadline_s)
+                local_lats.append(time.monotonic() - t0)
+            except ServingRejected as e:
+                outcome, reason = "shed", e.reason
+            except ServingTimeout:
+                outcome = "timeouts"
+            except ServingError:
+                outcome = "errors"
+            with lock:
+                tally["issued"] += 1
+                if outcome == "ok":
+                    tally["ok"] += 1
+                elif outcome == "shed":
+                    shed[reason] = shed.get(reason, 0) + 1
+                else:
+                    tally[outcome] += 1
+        with lock:
+            lats.extend(local_lats)
+
+    threads = [
+        threading.Thread(target=client, args=(i,)) for i in range(clients)
+    ]
+    t0 = time.time()
+    for t in threads:
+        t.start()
+    time.sleep(duration_s)
+    stop.set()
+    for t in threads:
+        t.join(30)
+    wall = time.time() - t0
+    lats.sort()
+
+    def pct(p):
+        return (
+            round(lats[min(len(lats) - 1, int(p * len(lats)))] * 1000, 3)
+            if lats else None
+        )
+
+    return {
+        **tally,
+        "shed_by_reason": shed,
+        "shed": sum(shed.values()),
+        "achieved_rps": round(tally["ok"] / wall, 1),
+        "p50_ms": pct(0.50),
+        "p99_ms": pct(0.99),
+        "wall_s": round(wall, 2),
+    }
+
+
 def bench_serving() -> None:
     """bench.py --serving: the serving plane under load and under chaos
     -> BENCH_SERVING.json.
@@ -1924,8 +2001,7 @@ def bench_serving() -> None:
     )
     from deeplearning4j_tpu.runtime import faults
     from deeplearning4j_tpu.serving import (
-        InferenceServer, ServingConfig, ServingError, ServingRejected,
-        ServingTimeout, weights_checksum,
+        InferenceServer, ServingConfig, weights_checksum,
     )
 
     os.environ.setdefault(
@@ -1948,70 +2024,8 @@ def bench_serving() -> None:
         ))
 
     def run_load(srv, clients, duration_s, deadline_s):
-        """Closed-loop load: every request's outcome is recorded from
-        the CLIENT side — ok/shed/error/timeout must add up to issued,
-        which is the no-silent-drops proof."""
-        stop = threading.Event()
-        lock = threading.Lock()
-        tally = {"issued": 0, "ok": 0, "errors": 0, "timeouts": 0}
-        shed: dict = {}
-        lats: list = []
-
-        def client(cid):
-            rng = np.random.default_rng(cid)
-            local_lats = []
-            while not stop.is_set():
-                x = rng.normal(size=(n_in,)).astype(np.float32)
-                t0 = time.monotonic()
-                outcome, reason = "ok", None
-                try:
-                    srv.infer(x, deadline_s=deadline_s)
-                    local_lats.append(time.monotonic() - t0)
-                except ServingRejected as e:
-                    outcome, reason = "shed", e.reason
-                except ServingTimeout:
-                    outcome = "timeouts"
-                except ServingError:
-                    outcome = "errors"
-                with lock:
-                    tally["issued"] += 1
-                    if outcome == "ok":
-                        tally["ok"] += 1
-                    elif outcome == "shed":
-                        shed[reason] = shed.get(reason, 0) + 1
-                    else:
-                        tally[outcome] += 1
-            with lock:
-                lats.extend(local_lats)
-
-        threads = [
-            threading.Thread(target=client, args=(i,)) for i in range(clients)
-        ]
-        t0 = time.time()
-        for t in threads:
-            t.start()
-        time.sleep(duration_s)
-        stop.set()
-        for t in threads:
-            t.join(30)
-        wall = time.time() - t0
-        lats.sort()
-
-        def pct(p):
-            return (
-                round(lats[min(len(lats) - 1, int(p * len(lats)))] * 1000, 3)
-                if lats else None
-            )
-
-        return {
-            **tally,
-            "shed_by_reason": shed,
-            "shed": sum(shed.values()),
-            "achieved_rps": round(tally["ok"] / wall, 1),
-            "p50_ms": pct(0.50),
-            "p99_ms": pct(0.99),
-            "wall_s": round(wall, 2),
-        }
+        return _serving_closed_loop(srv, clients, duration_s, deadline_s,
+                                    n_in)
 
     window = 0.6 if QUICK else 2.5
     client_points = (2, 8) if QUICK else (1, 2, 4, 8, 16)
@@ -2177,6 +2191,237 @@ def bench_serving() -> None:
     print(json.dumps(doc))
 
 
+def bench_serving_fleet() -> None:
+    """bench.py --serving-fleet: N replicas behind the Router front door
+    -> BENCH_SERVING_FLEET.json.
+
+    Three phases over one small model:
+
+      1. **scale** — closed-loop throughput at replica counts 1/2/4
+         (achieved rps, p50/p99, client-side accounting: zero silent
+         drops at every width);
+      2. **deploy** — p99 during a rolling canary weight deploy vs the
+         steady state on the same fleet: the deploy must install
+         fleet-wide while traffic keeps flowing;
+      3. **chaos** — one replica HARD-KILLED mid-traffic plus one torn
+         canary deploy (``serving.canary:corrupt``) under concurrent
+         load: every client request accounted (served / explicitly
+         shed / retried-then-served), the torn deploy rolls back with
+         at most ONE replica ever on the pushed weights, a clean
+         deploy installs on the survivors after the storm, and
+         post-chaos p99 returns to within 2x of baseline.
+
+    CPU by default (the subject is the fleet control plane);
+    BENCH_SERVING_PLATFORM overrides.  Quick mode (BENCH_QUICK=1)
+    shrinks windows/widths and does NOT rewrite the committed table."""
+    import threading
+
+    import jax
+
+    jax.config.update(
+        "jax_platforms", os.environ.get("BENCH_SERVING_PLATFORM", "cpu")
+    )
+    import tempfile
+
+    import numpy as np
+
+    from deeplearning4j_tpu.models import SequentialModel
+    from deeplearning4j_tpu.nn.conf import (
+        Dense, InputType, NeuralNetConfiguration, OutputLayer,
+    )
+    from deeplearning4j_tpu.runtime import faults
+    from deeplearning4j_tpu.serving import (
+        RouterConfig, ServingConfig, ServingFleet,
+    )
+
+    os.environ.setdefault(
+        "DL4JTPU_CRASH_DIR",
+        os.path.join(tempfile.mkdtemp(prefix="dl4jtpu-fleet-"), "crash"),
+    )
+    n_in, n_out = 16, 4
+    conf = (
+        NeuralNetConfiguration.builder().seed(7).list()
+        .layer(Dense(n_out=32)).layer(OutputLayer(n_out=n_out))
+        .set_input_type(InputType.feed_forward(n_in)).build()
+    )
+    example = np.zeros((n_in,), np.float32)
+
+    def make_fleet(n, **router_kw):
+        router_kw.setdefault("retry_budget", 1)
+        router_kw.setdefault("eject_threshold", 2)
+        router_kw.setdefault("try_timeout_s", 0.25)
+        router_kw.setdefault("probation_s", 30.0)
+        fleet = ServingFleet(
+            lambda: SequentialModel(conf).init(), n_replicas=n,
+            config=ServingConfig(
+                max_batch=8, max_queue=64, linger_s=0.001,
+                breaker_threshold=3, breaker_probe_after_s=0.2,
+            ),
+            router_config=RouterConfig(**router_kw),
+            golden_inputs=[example],
+        )
+        fleet.warm_start(example)
+        return fleet.start()
+
+    def run_load(fleet, clients, duration_s, deadline_s):
+        # the shared closed loop drives the FRONT DOOR: its accounting
+        # covers routing, retries and hedges too
+        return _serving_closed_loop(fleet, clients, duration_s,
+                                    deadline_s, n_in)
+
+    window = 0.6 if QUICK else 2.5
+    widths = (1, 2) if QUICK else (1, 2, 4)
+
+    # -- phase 1: throughput vs replica count ------------------------------
+    scale = []
+    for n in widths:
+        fleet = make_fleet(n)
+        row = run_load(fleet, clients=8, duration_s=window,
+                       deadline_s=2.0)
+        row["replicas"] = n
+        rstats = fleet.router.stats()
+        row["router"] = {
+            k: rstats[k] for k in ("retries", "hedges", "ejections")
+        }
+        fleet.stop()
+        scale.append(row)
+        print(f"[bench] fleet scale n={n}: {json.dumps(row)}",
+              file=sys.stderr)
+
+    # -- phase 2: p99 during a rolling deploy vs steady state --------------
+    n_deploy = 2 if QUICK else 4
+    fleet = make_fleet(n_deploy)
+    steady = run_load(fleet, clients=6, duration_s=window,
+                      deadline_s=2.0)
+    model = fleet.replicas[0].model
+    new_params = jax.tree.map(lambda a: a + 0.01, model.params)
+    deploy_result = {}
+    loader = threading.Thread(
+        target=lambda: deploy_result.update(
+            window=run_load(fleet, clients=6, duration_s=window * 2,
+                            deadline_s=2.0)
+        )
+    )
+    loader.start()
+    time.sleep(window * 0.5)
+    res = fleet.deployer.deploy(new_params, source="bench-rolling")
+    loader.join(120)
+    dw = deploy_result.get("window", {})
+    deploy_row = {
+        "replicas": n_deploy,
+        "steady": steady,
+        "during_deploy": dw,
+        "deploy_installed": res["installed"],
+        "replicas_updated": res["replicas_updated"],
+        "deploy_generation": fleet.deployer.generation,
+        "p99_deploy_ratio": (
+            round(dw["p99_ms"] / steady["p99_ms"], 3)
+            if dw.get("p99_ms") and steady.get("p99_ms") else None
+        ),
+    }
+    fleet.stop()
+    print(f"[bench] fleet deploy: {json.dumps(deploy_row)}",
+          file=sys.stderr)
+
+    # -- phase 3: chaos -----------------------------------------------------
+    # one replica hard-killed mid-traffic + one torn canary deploy (the
+    # canary's observed outputs are corrupted -> golden mismatch -> the
+    # whole deploy rolls back, at most ONE replica ever on the pushed
+    # weights) under concurrent load
+    n_chaos = 2 if QUICK else 3
+    fleet = make_fleet(n_chaos)
+    baseline = run_load(fleet, clients=6, duration_s=window,
+                        deadline_s=2.0)
+    model = fleet.replicas[0].model
+    good_params = jax.tree.map(lambda a: a + 0.005, model.params)
+    chaos_result = {}
+    faults.arm("serving.canary:corrupt:nth=1")
+    torn_res = {}
+    try:
+        loader = threading.Thread(
+            target=lambda: chaos_result.update(
+                window=run_load(fleet, clients=8,
+                                duration_s=window * 2, deadline_s=1.0)
+            )
+        )
+        loader.start()
+        time.sleep(window * 0.4)
+        fleet.kill_replica(0)
+        time.sleep(window * 0.3)
+        torn_res.update(fleet.deployer.deploy(
+            jax.tree.map(lambda a: a * 2.0, model.params),
+            source="bench-torn-canary",
+        ))
+        loader.join(120)
+    finally:
+        faults.disarm()
+    # after the storm: a clean deploy must install on the survivors
+    good_res = fleet.deployer.deploy(good_params, source="bench-good")
+    post = run_load(fleet, clients=6, duration_s=window, deadline_s=2.0)
+    cw = chaos_result.get("window", {})
+    accounted = (
+        cw.get("issued", 0)
+        == cw.get("ok", 0) + cw.get("shed", 0)
+        + cw.get("errors", 0) + cw.get("timeouts", 0)
+    )
+    p99_ratio = (
+        round(post["p99_ms"] / baseline["p99_ms"], 3)
+        if post.get("p99_ms") and baseline.get("p99_ms") else None
+    )
+    router_stats = fleet.router.stats()
+    chaos_row = {
+        "replicas": n_chaos,
+        "plan": "kill r0 mid-traffic + serving.canary:corrupt:nth=1",
+        "baseline": baseline,
+        "chaos_window": cw,
+        "post": post,
+        "p99_post_ratio": p99_ratio,
+        "all_requests_accounted": accounted,
+        "replica_killed": "r0",
+        "ejections": router_stats["ejections"],
+        "retries": router_stats["retries"],
+        "torn_deploy_rolled_back": not torn_res["installed"],
+        "replicas_ever_on_bad_weights": torn_res["rolled_back"],
+        "good_deploy_installed_after": good_res["installed"],
+        "deploy_generation": fleet.deployer.generation,
+        "completed": bool(
+            accounted
+            and cw.get("ok", 0) > 0
+            and router_stats["ejections"] >= 1
+            and not torn_res["installed"]
+            and torn_res["rolled_back"] <= 1
+            and good_res["installed"]
+            and post.get("ok", 0) > 0
+            and (p99_ratio is not None and p99_ratio <= 2.0)
+        ),
+    }
+    fleet.stop()
+    print(f"[bench] fleet chaos: {json.dumps(chaos_row)}",
+          file=sys.stderr)
+
+    doc = {
+        "schema": "bench-serving-fleet/1",
+        "platform": jax.default_backend(),
+        "env": _env_provenance(),
+        "quick": QUICK,
+        "config": {
+            "max_batch": 8, "max_queue": 64, "retry_budget": 1,
+            "eject_threshold": 2, "try_timeout_s": 0.25,
+            "model": f"dense32-out{n_out} (in={n_in})",
+        },
+        "scale": scale,
+        "deploy": deploy_row,
+        "chaos": chaos_row,
+    }
+    if not QUICK:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_SERVING_FLEET.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"[bench] fleet table -> {path}", file=sys.stderr)
+    print(json.dumps(doc))
+
+
 def main() -> None:
     global QUICK
     t_start = time.time()
@@ -2336,6 +2581,8 @@ if __name__ == "__main__":
         del sys.argv[_i:_i + 2]
     if "--chaos" in sys.argv:
         sys.exit(bench_chaos())
+    if "--serving-fleet" in sys.argv:
+        sys.exit(bench_serving_fleet())
     if "--serving" in sys.argv:
         sys.exit(bench_serving())
     if "--scaling" in sys.argv:

@@ -167,11 +167,12 @@ class FleetAggregator:
     def ingest(self, worker: str, payload: dict) -> None:
         """Accept one pushed snapshot.  Payload keys (all optional):
         ``rank``, ``prom`` (text exposition), ``step_latency_sum``,
-        ``step_latency_count``, ``trace`` (Chrome trace doc)."""
+        ``step_latency_count``, ``trace`` (Chrome trace doc),
+        ``serving`` (replica/router health summary)."""
         now = time.time()
         with self._lock:
             st = self._workers.setdefault(worker, {
-                "rank": None, "prom": "", "trace": None,
+                "rank": None, "prom": "", "trace": None, "serving": None,
                 "sum": 0.0, "count": 0, "recent_mean": None,
                 "first_push": now, "last_push": now,
             })
@@ -179,6 +180,8 @@ class FleetAggregator:
                 st["rank"] = int(payload["rank"])
             if payload.get("prom") is not None:
                 st["prom"] = str(payload["prom"])
+            if payload.get("serving") is not None:
+                st["serving"] = payload["serving"]
             if payload.get("trace") is not None:
                 doc = payload["trace"]
                 prev = st["trace"]
@@ -253,6 +256,16 @@ class FleetAggregator:
             w for w, m in means.items() if m > factor * median
         )
         return out
+
+    def serving_view(self) -> dict:
+        """{worker: last pushed serving summary} — the cluster's
+        replica/router health in one place (each worker's router
+        metrics already ride its ``prom`` text into the merged scrape;
+        this is the structured view the dashboard joins on)."""
+        with self._lock:
+            self._prune_locked()
+            return {w: st["serving"] for w, st in self._workers.items()
+                    if st.get("serving") is not None}
 
     # -- merged expositions -------------------------------------------------
     def _fleet_text(self) -> str:
@@ -416,6 +429,28 @@ def active_aggregator() -> Optional[FleetAggregator]:
 
 # -- worker side ------------------------------------------------------------
 
+def _serving_summary() -> Optional[dict]:
+    """Compact serving-plane summary for the worker push: per-replica
+    health payloads + per-router routing state.  None when this
+    process serves nothing (training-only workers pay zero)."""
+    try:
+        from deeplearning4j_tpu.serving.router import active_routers
+        from deeplearning4j_tpu.serving.server import active_servers
+
+        servers = active_servers()
+        routers = active_routers()
+        if not servers and not routers:
+            return None
+        return {
+            "servers": [s.health() for s in servers],
+            "routers": [r.stats() for r in routers],
+        }
+    except Exception as e:
+        # a broken serving plane must not take the telemetry push down
+        log.debug("serving summary failed: %s", e)
+        return None
+
+
 #: cap on trace events shipped per push — the control-plane transport is
 #: JSON-lines; a full 16k ring would be a multi-MB line
 TRACE_EVENTS_PER_PUSH = 4096
@@ -457,6 +492,9 @@ class FleetReporter:
             "step_latency_sum": hist.sum,
             "step_latency_count": hist.count,
         }
+        serving = _serving_summary()
+        if serving is not None:
+            out["serving"] = serving
         self._pending_cursor = None
         t = tracer()
         if t.enabled:

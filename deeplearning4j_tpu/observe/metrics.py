@@ -530,10 +530,42 @@ def _declare_core(reg: MetricsRegistry) -> None:
     reg.counter("dl4jtpu_serving_hotswap_total",
                 "Weight hot-swap pushes, by result (installed, "
                 "rolled_back — a rolled-back push leaves the serving "
-                "params untouched)")
+                "params untouched; push_error = a serve_into fan-out "
+                "target's push raised and was isolated)")
     reg.gauge("dl4jtpu_serving_weights_generation",
               "Monotonic generation of the serving params (bumps on "
               "every installed hot-swap)")
+    # serving fleet front door (serving/router.py, serving/fleet.py):
+    # health-aware routing, cross-replica retries, hedges, replica
+    # ejection and rolling canary weight deploys
+    reg.counter("dl4jtpu_router_requests_total",
+                "Router-dispatched request tries by router, replica "
+                "and outcome (ok, rejected, error, timeout) — one "
+                "request may count several tries (retries/hedges), "
+                "never zero; the router label keeps two fleets in one "
+                "process apart (replica names repeat across fleets)")
+    reg.counter("dl4jtpu_router_retries_total",
+                "Cross-replica retries the router issued (idempotent "
+                "failures re-routed under the explicit retry budget)")
+    reg.counter("dl4jtpu_router_hedges_total",
+                "Latency hedges the router issued (duplicate dispatch "
+                "on a second replica; the slower result is discarded)")
+    reg.counter("dl4jtpu_replica_ejections_total",
+                "Replicas ejected into probation by the router, by "
+                "reason (consecutive_failures, wedged, dead)")
+    reg.gauge("dl4jtpu_fleet_deploy_generation",
+              "Monotonic generation of the last COMPLETED rolling "
+              "fleet weight deploy (a rolled-back deploy does not "
+              "bump it)")
+    reg.counter("dl4jtpu_canary_failures_total",
+                "Canary verifications that failed during a rolling "
+                "deploy (golden output mismatch / non-finite / probe "
+                "error) — each one rolled the deploy back")
+    reg.gauge("dl4jtpu_router_replica_pressure",
+              "Last pulled shed pressure per replica (labels: router, "
+              "replica), refreshed by the router's registry collector "
+              "at scrape time so the fleet scrape carries per-replica "
+              "headroom")
     # elastic supervisor crash-loop damping (train/elastic.py): nonzero
     # while the supervisor is backing off before a respawn — respawn
     # storms become visible on /metrics instead of only in logs

@@ -33,6 +33,12 @@ Sites wired today:
                          watchdog, ``corrupt`` ⇒ NaN outputs)
   ``serving.hotswap``    the weight-push path (``truncate``/``corrupt``
                          ⇒ torn/poisoned push that must roll back)
+  ``serving.route``      the fleet Router's submit entry (``raise`` ⇒
+                         explicit route_fault rejection, ``delay`` ⇒
+                         slow front door)
+  ``serving.canary``     FleetDeployer's canary verification
+                         (``corrupt`` ⇒ canary output mismatch ⇒ the
+                         deploy rolls back)
 
 Plan grammar (also the ``DL4J_TPU_FAULT_PLAN`` env value, so subprocess
 workers inherit the plan from their spawner's environment)::
@@ -106,6 +112,14 @@ SITES: dict = {
                        "= a torn push that dropped leaves; 'corrupt' "
                        "NaN-poisons the staged params; both must roll "
                        "back to the serving weights)",
+    "serving.route": "the fleet Router's submit entry ('raise' = a "
+                     "misrouted request the front door rejects "
+                     "explicitly as route_fault; 'delay' = a slow "
+                     "front door)",
+    "serving.canary": "FleetDeployer's per-replica canary verification "
+                      "('corrupt' perturbs the observed canary outputs "
+                      "— the golden mismatch must roll the whole "
+                      "deploy back)",
 }
 
 

@@ -16,7 +16,13 @@ from deeplearning4j_tpu.serving.breaker import CircuitBreaker  # noqa: F401
 from deeplearning4j_tpu.serving.hotswap import (          # noqa: F401
     SwapVerifyError, weights_checksum,
 )
+from deeplearning4j_tpu.serving.fleet import (            # noqa: F401
+    CanaryError, FleetDeployer, ServingFleet,
+)
 from deeplearning4j_tpu.serving.http import ServingHTTPServer  # noqa: F401
+from deeplearning4j_tpu.serving.router import (           # noqa: F401
+    ReplicaHandle, Router, RouterConfig, active_routers,
+)
 from deeplearning4j_tpu.serving.server import (           # noqa: F401
     InferenceServer, ServingConfig, active_servers,
 )

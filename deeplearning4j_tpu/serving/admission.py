@@ -40,6 +40,10 @@ REJECT_STATUS = {
     "breaker_open": 503,
     "admit_fault": 503,
     "shutdown": 503,
+    # front-door (serving.router) rejections
+    "no_replicas": 503,      # every replica ejected/dead/stopped
+    "route_fault": 503,      # injected serving.route failure
+    "replica_dead": 503,     # routed to a replica that died mid-flight
 }
 
 

@@ -1,0 +1,453 @@
+"""ServingFleet — N `InferenceServer` replicas behind one front door.
+
+The horizontal step of the serving plane: the fleet owns the replicas
+(each one the full PR 10 machinery — continuous batching, bounded
+admission, breaker, watchdog, verified hot-swap), a `Router` front door
+routes by pulled health, and a `FleetDeployer` rolls weight pushes out
+replica-by-replica with canary verification.  The fault model is the
+TensorFlow-system paper's: replicas fail ROUTINELY and the system, not
+the operator, absorbs it — a replica failure costs the client at most
+one counted retry, never an error they didn't opt into.
+
+    fleet = ServingFleet(lambda: SequentialModel(conf).init(), n_replicas=4)
+    fleet.warm_start(example)
+    fleet.start()
+    out = fleet.infer(features, deadline_s=0.25)     # routed + retried
+    deployer = FleetDeployer(fleet, golden_inputs=[example])
+    result = deployer.deploy(new_params)             # rolling + canary
+    fleet.stop()
+
+Rolling deploys are the robustness centerpiece: each replica is swapped
+via the PR 10 VERIFIED hot-swap (structure/shape/checksum/finiteness),
+then probed with recorded golden input/output pairs — expected outputs
+are computed OFFLINE from the staged params, so a replica that
+installed but serves wrong answers is caught before the deploy
+proceeds.  Any failure rolls the WHOLE fleet back to the pre-deploy
+params: a torn or poisoned push can never take down more than the one
+replica it was caught on, and that replica rolls back too.  Fault site
+``serving.canary`` (``corrupt`` perturbs the observed canary outputs)
+makes the mismatch path provokable; `dl4jtpu_canary_failures_total`
+and `dl4jtpu_fleet_deploy_generation` land on the telemetry spine.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Callable, Optional
+
+import numpy as np
+
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.serving.router import (
+    ReplicaHandle, Router, RouterConfig,
+)
+from deeplearning4j_tpu.serving.server import InferenceServer, ServingConfig
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+class ServingFleet:
+    """N in-process replicas + the router front door.
+
+    ``model_factory`` builds one model per replica (replicas must not
+    share a live model object: each snapshots its own params under its
+    own swap lock).  Replicas are named ``r0..rN-1``; the fleet's
+    ``infer`` goes through the router (health-aware pick, retries,
+    optional hedge), and ``push_weights``/``push_checkpoint`` go
+    through the rolling deployer so `CheckpointStore.serve_into(fleet)`
+    closes the fine-tune→fleet loop."""
+
+    def __init__(self, model_factory: Callable, n_replicas: int = 2,
+                 config: Optional[ServingConfig] = None,
+                 router_config: Optional[RouterConfig] = None,
+                 golden_inputs: Optional[list] = None):
+        if n_replicas < 1:
+            raise ValueError("fleet needs at least one replica")
+        self.replicas: list[InferenceServer] = []
+        for _ in range(n_replicas):
+            cfg = ServingConfig(**vars(config)) if config is not None \
+                else ServingConfig()
+            self.replicas.append(InferenceServer(model_factory(), cfg))
+        self.handles = [
+            ReplicaHandle(f"r{i}", srv,
+                          refresh_s=(router_config or RouterConfig())
+                          .health_refresh_s)
+            for i, srv in enumerate(self.replicas)
+        ]
+        self.router = Router(self.handles, router_config)
+        self.deployer = FleetDeployer(self, golden_inputs=golden_inputs)
+
+    # -- lifecycle ---------------------------------------------------------
+    def warm_start(self, example=None, lengths=None) -> "ServingFleet":
+        for srv in self.replicas:
+            srv.warm_start(example, lengths=lengths)
+        return self
+
+    def start(self) -> "ServingFleet":
+        for srv in self.replicas:
+            srv.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        for srv in self.replicas:
+            srv.stop(timeout)
+
+    def kill_replica(self, index: int) -> None:
+        """Hard-kill one replica mid-traffic (the chaos scenario): its
+        handle answers ``replica_dead`` immediately — exactly what a
+        dead process's connection-refused looks like from the router —
+        and its batcher stops WITHOUT draining; queued requests on it
+        fail explicitly at shutdown, in-flight routing retries them on
+        the survivors."""
+        h = self.handles[index]
+        h.kill()
+        self.replicas[index].stop(timeout=1.0)
+        log.warning("fleet replica %s hard-killed", h.name)
+
+    def revive_replica(self, index: int) -> bool:
+        """Bring a killed replica back: restart it, RE-SYNC it onto the
+        last successfully deployed weights (a deploy that ran while it
+        was dead skipped it — re-admitting it as-is would silently
+        serve the pre-deploy model), canary-verify, and only then mark
+        the handle routable.  Returns False (handle stays dead, router
+        keeps avoiding it) when the re-sync or canary fails."""
+        self.replicas[index].start()
+        if not self.deployer.sync_replica(index):
+            log.warning("fleet replica r%d revive ABORTED: re-sync onto "
+                        "the deployed weights failed — handle stays "
+                        "dead", index)
+            return False
+        self.handles[index].revive()
+        return True
+
+    # -- the request path (the router IS the front door) -------------------
+    def infer(self, features, deadline_s: Optional[float] = None):
+        return self.router.infer(features, deadline_s=deadline_s)
+
+    # -- weight deploys ----------------------------------------------------
+    def push_weights(self, params, net_state=None,
+                     checksum: Optional[int] = None,
+                     source: str = "api") -> bool:
+        """Rolling deploy of `params` (duck-types the single-server
+        `push_weights` contract so fleet and replica are drop-in for
+        each other).  True = installed fleet-wide; False = rolled back
+        everywhere."""
+        return self.deployer.deploy(
+            params, net_state=net_state, checksum=checksum, source=source,
+        )["installed"]
+
+    def push_checkpoint(self, path: str, source: Optional[str] = None,
+                        include_net_state: bool = True) -> bool:
+        return self.deployer.deploy_checkpoint(
+            path, source=source, include_net_state=include_net_state,
+        )["installed"]
+
+    # -- introspection -----------------------------------------------------
+    def stats(self) -> dict:
+        return {
+            "replicas": {h.name: srv.stats()
+                         for h, srv in zip(self.handles, self.replicas)},
+            "router": self.router.stats(),
+            "deploy_generation": self.deployer.generation,
+        }
+
+    def health(self) -> dict:
+        """Fleet-level health: the MINIMUM replica pressure is the
+        front door's headroom (one idle replica = the fleet can take
+        traffic)."""
+        per = {h.name: h.health() for h in self.handles}
+        live = [p["shed_pressure"] for p in per.values()
+                if p.get("status") == "serving"]
+        return {
+            "status": "serving" if live else "unavailable",
+            "shed_pressure": min(live) if live else 1.0,
+            "replicas": per,
+            "deploy_generation": self.deployer.generation,
+        }
+
+
+class CanaryError(RuntimeError):
+    """A swapped replica failed its golden-pair verification."""
+
+
+class FleetDeployer:
+    """Rolling weight deploys with canary verification + fleet rollback.
+
+    The deploy ladder, per replica in order:
+
+    1. **verified hot-swap** (PR 10): structure / shape / checksum /
+       finiteness — a torn or poisoned push rolls back HERE and the
+       deploy aborts;
+    2. **canary probe**: every recorded golden input is routed through
+       the replica's REAL serving path and the outputs must be finite
+       and within `tolerance` of the expected outputs computed offline
+       from the staged params — a replica that installed but serves
+       wrong answers is caught before the deploy proceeds;
+    3. only then does the next replica swap.
+
+    ANY failure rolls every already-swapped replica back to its
+    pre-deploy params (verified hot-swaps again — the rollback gets the
+    same protection as the rollout).  At most one replica ever held bad
+    weights, and only between its swap and its canary check."""
+
+    def __init__(self, fleet: ServingFleet,
+                 golden_inputs: Optional[list] = None,
+                 tolerance: float = 1e-4):
+        self.fleet = fleet
+        self.tolerance = float(tolerance)
+        self._lock = threading.Lock()
+        # deploys are SERIALIZED: two interleaved rolling deploys would
+        # capture each other's mid-roll params as rollback snapshots
+        # and a rollback could leave the fleet on a MIX of both pushes
+        self._deploy_lock = threading.Lock()
+        self._goldens: list = list(golden_inputs or [])
+        # the last successfully deployed (params, net_state): what a
+        # revived replica must be re-synced onto before re-admission
+        self._last_good: Optional[tuple] = None
+        self.generation = 0            # completed fleet-wide deploys
+        self.canary_failures = 0
+        self.rollbacks = 0
+
+    def set_goldens(self, inputs: list) -> None:
+        """Replace the golden input set (one example per entry, no
+        batch dim — the serving request shape)."""
+        with self._lock:
+            self._goldens = list(inputs)
+
+    def golden_inputs(self) -> list:
+        with self._lock:
+            return list(self._goldens)
+
+    # -- expected outputs (offline, from the staged params) ----------------
+    def _expected_outputs(self, server: InferenceServer, params,
+                          net_state) -> list:
+        """Run each golden input through the model's infer program with
+        the STAGED params directly (no replica touched): the reference
+        the canary probes are compared against."""
+        out = []
+        if net_state is not None:
+            ns = net_state
+        else:
+            with server._weights_lock:
+                ns = server.model.net_state
+        for x in self.golden_inputs():
+            feats = server._as_feature_tuple(x)
+            cols = [np.asarray(f)[None] for f in feats]
+            rows = server._call_model(cols, None, params, ns)
+            out.append(tuple(np.asarray(r)[0] for r in rows))
+        return out
+
+    def _canary_check(self, name: str, server: InferenceServer,
+                      expected: list) -> None:
+        """Probe one freshly-swapped replica with the golden inputs
+        through its REAL serving path.  Raises `CanaryError` on any
+        non-finite or out-of-tolerance output.  Fault site
+        ``serving.canary``: ``corrupt`` perturbs the OBSERVED outputs —
+        the deterministic way to provoke the mismatch path."""
+        action = faults.maybe_fail("serving.canary")
+        for x, want in zip(self.golden_inputs(), expected):
+            got = server.infer(x, deadline_s=30.0)
+            rows = got if isinstance(got, tuple) else (got,)
+            if action == "corrupt":
+                rows = tuple(np.asarray(r) + 1.0 for r in rows)
+            for j, (g, w) in enumerate(zip(rows, want)):
+                g = np.asarray(g)
+                if not np.isfinite(g).all():
+                    raise CanaryError(
+                        f"canary {name}: non-finite output {j}"
+                    )
+                if not np.allclose(g, w, rtol=self.tolerance,
+                                   atol=self.tolerance):
+                    err = float(np.max(np.abs(g - np.asarray(w))))
+                    raise CanaryError(
+                        f"canary {name}: output {j} off by {err:.3g} "
+                        f"(tolerance {self.tolerance:g})"
+                    )
+
+    # -- the rolling deploy ------------------------------------------------
+    def deploy(self, params, net_state=None,
+               checksum: Optional[int] = None,
+               source: str = "api") -> dict:
+        """Roll `params` across the fleet replica-by-replica.  Returns
+        ``{"installed", "replicas_updated", "rolled_back", "reason",
+        "generation"}`` — installed=False means the WHOLE fleet is back
+        on its pre-deploy params."""
+        with self._deploy_lock:
+            return self._deploy_locked(
+                params, net_state, checksum, source,
+            )
+
+    def _deploy_locked(self, params, net_state, checksum,
+                       source: str) -> dict:
+        fleet = self.fleet
+        live = [(h, srv) for h, srv in zip(fleet.handles, fleet.replicas)
+                if not h.dead]
+        for h, _ in zip(fleet.handles, fleet.replicas):
+            if h.dead:
+                log.warning("fleet deploy %s skipping dead replica %s",
+                            source, h.name)
+        if not live:
+            log.warning("fleet deploy %s touched no replica (all dead)",
+                        source)
+            return self._result(False, 0, 0, "no_live_replicas")
+        check_canary = bool(self.golden_inputs())
+        if check_canary:
+            try:
+                # pre-flight on the first LIVE replica: staged params
+                # that cannot even run offline must never reach a swap
+                self._expected_outputs(live[0][1], params, net_state)
+            except Exception as exc:
+                log.warning("fleet deploy %s aborted before any swap: "
+                            "offline golden eval failed: %s", source, exc)
+                return self._result(False, 0, 0, f"golden_eval: {exc}")
+        swapped: list[tuple] = []       # (handle, server, old params/state)
+        for h, srv in live:
+            # rollback snapshot under the replica's swap lock: a
+            # concurrent DIRECT push_weights on this server (the
+            # duck-typed contract allows it) must not interleave the
+            # two reads into a mismatched params/net_state pair
+            with srv._weights_lock:
+                old = (srv.model.params, srv.model.net_state)
+            ok = srv.push_weights(
+                params, net_state=net_state, checksum=checksum,
+                source=f"{source}/deploy:{h.name}",
+            )
+            if not ok:
+                # the verified hot-swap already rolled THIS replica
+                # back; undo the rest of the fleet
+                return self._roll_back(
+                    swapped, source, f"hotswap_rejected:{h.name}",
+                )
+            swapped.append((h, srv, old))
+            if check_canary:
+                try:
+                    # expected outputs are computed PER REPLICA, after
+                    # its swap: with net_state=None the push preserves
+                    # each replica's OWN net_state, so a fleet whose
+                    # replicas carry divergent net_state must be
+                    # checked against what THIS replica will serve
+                    # with, not replica 0's copy
+                    expected = self._expected_outputs(
+                        srv, params, net_state,
+                    )
+                    self._canary_check(h.name, srv, expected)
+                except Exception as exc:
+                    with self._lock:
+                        self.canary_failures += 1
+                    _count_canary_failure()
+                    log.warning("fleet deploy %s canary FAILED on %s: %s",
+                                source, h.name, exc)
+                    return self._roll_back(
+                        swapped, source, f"canary:{h.name}: {exc}",
+                    )
+        with self._lock:
+            self.generation += 1
+            gen = self.generation
+            self._last_good = (params, net_state)
+        _gauge_deploy_generation(gen)
+        log.info("fleet deploy %s installed on %d replica(s) "
+                 "(generation %d)", source, len(swapped), gen)
+        return self._result(True, len(swapped), 0, None)
+
+    def sync_replica(self, index: int) -> bool:
+        """Bring ONE replica onto the last successfully deployed
+        weights (the revive path): verified hot-swap + canary check,
+        like a one-replica rolling deploy.  True when the replica is
+        safe to re-admit (also when no deploy has completed yet — the
+        factory weights ARE the fleet's weights then)."""
+        with self._lock:
+            last = self._last_good
+        if last is None:
+            return True
+        params, net_state = last
+        srv = self.fleet.replicas[index]
+        name = self.fleet.handles[index].name
+        if not srv.push_weights(params, net_state=net_state,
+                                source=f"revive:{name}"):
+            return False
+        if self.golden_inputs():
+            try:
+                expected = self._expected_outputs(srv, params, net_state)
+                self._canary_check(name, srv, expected)
+            except Exception as exc:
+                with self._lock:
+                    self.canary_failures += 1
+                _count_canary_failure()
+                log.warning("replica %s revive canary FAILED: %s",
+                            name, exc)
+                return False
+        return True
+
+    def deploy_checkpoint(self, path: str, source: Optional[str] = None,
+                          include_net_state: bool = True) -> dict:
+        """Rolling deploy from a checkpoint file: verified + restored
+        ONCE (manifest CRC via `ModelSerializer.restore`), then the
+        params roll out like any other deploy.  A torn/corrupt file
+        aborts before any replica is touched."""
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+        source = source or f"checkpoint:{path}"
+        try:
+            restored = ModelSerializer.restore(path, verify=True)
+        except Exception as exc:
+            log.warning("fleet deploy %s aborted: checkpoint failed "
+                        "verification/restore: %s", source, exc)
+            return self._result(False, 0, 0, f"checkpoint: {exc}")
+        return self.deploy(
+            restored.params,
+            net_state=restored.net_state if include_net_state else None,
+            source=source,
+        )
+
+    def _roll_back(self, swapped: list, source: str, reason: str) -> dict:
+        """Push every already-swapped replica back to its pre-deploy
+        params (verified hot-swaps: the rollback is protected like the
+        rollout).  The fleet ends exactly where it started."""
+        rolled = 0
+        for h, srv, (old_params, old_net) in reversed(swapped):
+            if srv.push_weights(
+                old_params, net_state=old_net,
+                source=f"{source}/rollback:{h.name}",
+            ):
+                rolled += 1
+            else:                     # pragma: no cover - old params were
+                # serving moments ago; a rejected rollback means the
+                # replica itself is broken — leave it to the router
+                log.error("fleet rollback REJECTED on %s — replica left "
+                          "for the router to eject", h.name)
+        with self._lock:
+            self.rollbacks += 1
+        log.warning("fleet deploy %s ROLLED BACK (%s): %d replica(s) "
+                    "restored", source, reason, rolled)
+        return self._result(False, 0, rolled, reason)
+
+    def _result(self, installed: bool, updated: int, rolled: int,
+                reason: Optional[str]) -> dict:
+        return {
+            "installed": installed,
+            "replicas_updated": updated,
+            "rolled_back": rolled,
+            "reason": reason,
+            "generation": self.generation,
+        }
+
+
+# -- telemetry helpers ------------------------------------------------------
+
+def _count_canary_failure() -> None:
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        registry().counter("dl4jtpu_canary_failures_total").inc()
+    except Exception as e:
+        log.debug("canary failure metric failed: %s", e)
+
+
+def _gauge_deploy_generation(gen: int) -> None:
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        registry().gauge("dl4jtpu_fleet_deploy_generation").set(gen)
+    except Exception as e:
+        log.debug("deploy generation metric failed: %s", e)

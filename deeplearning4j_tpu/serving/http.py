@@ -71,12 +71,16 @@ class ServingHTTPServer:
             def do_GET(self):
                 u = urlparse(self.path)
                 if u.path == "/healthz":
-                    state = outer.server.breaker.state
-                    if state == "open":
-                        self._json({"status": "breaker_open"}, 503)
-                    else:
-                        self._json({"status": "serving",
-                                    "breaker": state})
+                    # the pull-based LB payload (docs/serving.md schema):
+                    # shed_pressure / breaker_state / batch_latency_ewma_s
+                    # / weights_generation let a router stop sending to
+                    # this replica BEFORE it starts shedding
+                    health = outer.server.health()
+                    health["breaker"] = health["breaker_state"]
+                    self._json(
+                        health,
+                        503 if health["status"] == "breaker_open" else 200,
+                    )
                 elif u.path == "/v1/status":
                     self._json(outer.server.stats())
                 else:

@@ -691,6 +691,48 @@ class InferenceServer:
         return warmed
 
     # -- introspection -----------------------------------------------------
+    def shed_pressure(self) -> float:
+        """Advertised shed pressure in [0, 1] — the replica's own view of
+        how close it is to rejecting traffic, published on ``/healthz``
+        and ``/v1/status`` so a router (or any external LB) can stop
+        sending BEFORE the 429/503s start.  Three components, max-combined:
+
+        - queue depth fraction (``depth / max_queue`` — 1.0 = the next
+          offer is a queue_full rejection);
+        - the admission shed estimate for a default-deadline request
+          (``admit_safety x batch EWMA x dispatches`` over
+          ``default_deadline_s`` — exactly the quantity `_admit` sheds
+          on, so pressure ≈ 1 precisely when deadline sheds begin);
+        - breaker state (open = 1.0: everything is rejected; half-open
+          = 0.75: only the single probe gets through)."""
+        depth = self.queue.depth
+        q = depth / self.config.max_queue
+        lat = 0.0
+        est = self._estimated_wait(depth)
+        if est is not None:
+            lat = est / self.config.default_deadline_s
+        b = {"closed": 0.0, "half_open": 0.75, "open": 1.0}.get(
+            self.breaker.state, 1.0,
+        )
+        return min(1.0, max(q, lat, b))
+
+    def health(self) -> dict:
+        """The pull-based health payload (``GET /healthz`` body, and what
+        a `serving.router.Router` polls in-process): enough signal for a
+        load balancer to stop sending to a replica BEFORE it sheds.
+        Schema documented in docs/serving.md."""
+        state = self.breaker.state
+        with self._stats_lock:
+            ewma = self._batch_ewma
+        return {
+            "status": "breaker_open" if state == "open" else "serving",
+            "shed_pressure": round(self.shed_pressure(), 6),
+            "breaker_state": state,
+            "batch_latency_ewma_s": ewma,
+            "weights_generation": self.generation,
+            "queue_depth": self.queue.depth,
+        }
+
     def stats(self) -> dict:
         with self._stats_lock:
             lats = sorted(self._latencies)
@@ -706,6 +748,9 @@ class InferenceServer:
         return {
             "queue_depth": self.queue.depth,
             "generation": self.generation,
+            "weights_generation": self.generation,
+            "shed_pressure": round(self.shed_pressure(), 6),
+            "breaker_state": self.breaker.state,
             "batch_latency_ewma_s": ewma,
             "batch_occupancy": occupancy,
             "p50_s": pct(0.50),

@@ -109,6 +109,19 @@ def count_skipped_checkpoint(path: str, reason: str) -> None:
         log.debug("ckpt skip metric failed: %s", e)
 
 
+def _count_push_error() -> None:
+    """One serve_into fan-out target's push RAISED (distinct from a
+    verified rollback, which the target counts itself)."""
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        registry().counter(
+            "dl4jtpu_serving_hotswap_total"
+        ).inc(result="push_error")
+    except Exception as e:
+        log.debug("serve_into push-error metric failed: %s", e)
+
+
 def _npz_bytes(tree) -> tuple[bytes, int]:
     """(npz bytes, leaf count) for a pytree; multi-host-sharded leaves are
     allgathered (fetch_global) before the single-writer save."""
@@ -507,17 +520,38 @@ class CheckpointStore:
         return ModelSerializer.restore(entry["path"], verify=False)
 
     # -- serving hook ------------------------------------------------------
-    def serve_into(self, server):
-        """Close the fine-tune-and-serve loop: register a save listener
-        that pushes every newly published checkpoint into a live
-        `serving.InferenceServer` as a VERIFIED hot-swap (manifest CRC
-        + finiteness checks run inside ``push_checkpoint``; a torn or
-        poisoned save rolls back and the server keeps its params).
-        Returns the listener — pass it to `remove_save_listener` to
-        detach."""
+    def serve_into(self, *servers):
+        """Close the fine-tune-and-serve loop: register ONE save
+        listener that fans every newly published checkpoint out to each
+        target as a VERIFIED hot-swap (manifest CRC + finiteness checks
+        run inside ``push_checkpoint``; a torn or poisoned save rolls
+        back and the target keeps its params).  Targets are anything
+        speaking ``push_checkpoint(path, source=...)`` — an
+        `serving.InferenceServer`, a `serving.ServingFleet` (whose push
+        is a rolling canary deploy), or a mix.  Fan-out is EXPLICIT and
+        isolated: one target's push raising (dead server, torn file
+        mid-read) is logged and counted
+        (``dl4jtpu_serving_hotswap_total{result="push_error"}``), never
+        aborts the remaining targets.  Returns the listener — pass it
+        to `remove_save_listener` to detach."""
+        if not servers:
+            raise ValueError("serve_into needs at least one target")
+        targets = list(servers)
 
         def _push(step: int, path: str) -> None:
-            server.push_checkpoint(path, source=f"ckpt_step_{step}")
+            for target in targets:
+                try:
+                    target.push_checkpoint(path, source=f"ckpt_step_{step}")
+                except Exception:
+                    # isolation: a broken target must not starve the
+                    # rest of the fan-out (push_checkpoint returning
+                    # False — a verified rollback — is already counted
+                    # by the target itself)
+                    log.exception(
+                        "serve_into push to %r failed at step %d",
+                        target, step,
+                    )
+                    _count_push_error()
 
         self.add_save_listener(_push)
         return _push

@@ -313,6 +313,13 @@ class UIServer:
                     from deeplearning4j_tpu.serving import active_servers
 
                     self._json([s.stats() for s in active_servers()])
+                elif u.path == "/api/serving/fleet":
+                    # fleet front doors in this process: per-replica
+                    # routing state + pulled pressure, retry/hedge/
+                    # ejection counters — the router's dashboard view
+                    from deeplearning4j_tpu.serving import active_routers
+
+                    self._json([r.stats() for r in active_routers()])
                 elif u.path == "/metrics/cluster":
                     # merged fleet exposition: every pushed worker's
                     # families re-labeled worker="...", plus the fleet

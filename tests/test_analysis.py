@@ -312,12 +312,23 @@ class TestTier1Gate:
             "dl4jtpu_serving_weights_generation",
             "dl4jtpu_supervisor_backoff_seconds",
         } <= fams
+        # ISSUE-12 serving-fleet front-door families
+        assert {
+            "dl4jtpu_router_requests_total",
+            "dl4jtpu_router_retries_total",
+            "dl4jtpu_router_hedges_total",
+            "dl4jtpu_replica_ejections_total",
+            "dl4jtpu_fleet_deploy_generation",
+            "dl4jtpu_canary_failures_total",
+            "dl4jtpu_router_replica_pressure",
+        } <= fams
         sites = load_fault_sites(REPO)
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",
             "checkpoint.fsync", "data.next_batch", "data.prefetch",
             "data.decode", "device.sync", "data.device_decode",
             "serving.admit", "serving.infer", "serving.hotswap",
+            "serving.route", "serving.canary",
         }
         assert {"slow", "faults", "serving"} <= load_declared_marks(REPO)
 

@@ -60,6 +60,71 @@ def test_serving_bench_quick_run_and_schema():
     assert "abort" in stages           # per-batch deadline escalated
 
 
+def test_serving_fleet_bench_quick_run_and_schema():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = ""          # bench decides; avoid conftest leak
+    env["BENCH_QUICK"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"),
+         "--serving-fleet"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["schema"] == "bench-serving-fleet/1"
+    assert out["platform"] == "cpu"
+    for row in out["scale"]:
+        assert row["achieved_rps"] > 0
+        assert row["p50_ms"] is not None and row["p99_ms"] is not None
+        # zero silent drops at every fleet width
+        assert row["issued"] == (row["ok"] + row["shed"] + row["errors"]
+                                 + row["timeouts"])
+    # rolling deploy installed fleet-wide while traffic flowed
+    dep = out["deploy"]
+    assert dep["deploy_installed"]
+    assert dep["replicas_updated"] == dep["replicas"]
+    assert dep["during_deploy"]["ok"] > 0
+    # chaos invariants (timing-independent): killed replica ejected,
+    # torn canary deploy rolled back touching at most ONE replica, a
+    # clean deploy installed after, ledger balanced
+    chaos = out["chaos"]
+    assert chaos["all_requests_accounted"]
+    cw = chaos["chaos_window"]
+    assert cw["issued"] == (cw["ok"] + cw["shed"] + cw["errors"]
+                            + cw["timeouts"])
+    assert chaos["ejections"] >= 1
+    assert chaos["torn_deploy_rolled_back"]
+    assert chaos["replicas_ever_on_bad_weights"] <= 1
+    assert chaos["good_deploy_installed_after"]
+    assert chaos["post"]["ok"] > 0
+
+
+def test_committed_serving_fleet_table_meets_acceptance():
+    """The COMMITTED BENCH_SERVING_FLEET.json (full run) carries the
+    ISSUE 12 acceptance: the chaos run (one replica hard-killed
+    mid-traffic + one torn canary deploy under load) completed with
+    every request accounted, the torn deploy rolled back with at most
+    one replica ever on bad weights, and post-chaos p99 <= 2x."""
+    path = os.path.join(REPO, "BENCH_SERVING_FLEET.json")
+    assert os.path.exists(path), "BENCH_SERVING_FLEET.json not committed"
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["schema"] == "bench-serving-fleet/1"
+    assert not doc["quick"]
+    assert [r["replicas"] for r in doc["scale"]] == [1, 2, 4]
+    assert doc["deploy"]["deploy_installed"]
+    assert doc["deploy"]["p99_deploy_ratio"] is not None
+    chaos = doc["chaos"]
+    assert chaos["completed"]
+    assert chaos["all_requests_accounted"]
+    assert chaos["ejections"] >= 1
+    assert chaos["torn_deploy_rolled_back"]
+    assert chaos["replicas_ever_on_bad_weights"] <= 1
+    assert chaos["good_deploy_installed_after"]
+    assert chaos["p99_post_ratio"] <= 2.0
+
+
 def test_committed_serving_table_meets_acceptance():
     """The COMMITTED BENCH_SERVING.json (full, non-quick run) carries
     the ISSUE 11 acceptance: chaos completed, p99 back within 2x after

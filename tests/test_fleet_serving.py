@@ -1,0 +1,983 @@
+"""Serving fleet (ISSUE 12): health-aware routing, replica ejection +
+probation re-admission, cross-replica retries under an explicit budget,
+hedged latency tails, and rolling canary weight deploys with whole-fleet
+rollback.  The client-visible contract under test: a replica failure
+costs at most one counted retry, never an error the client didn't opt
+into, and a torn/poisoned deploy can never leave more than one replica
+on bad weights — and that one rolls back."""
+
+import threading
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn.conf import (
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.observe.metrics import registry
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.serving import (
+    RouterConfig,
+    ServingConfig,
+    ServingError,
+    ServingFleet,
+    ServingRejected,
+    ServingTimeout,
+)
+
+pytestmark = pytest.mark.serving
+
+N_IN, N_OUT = 6, 4
+
+
+def _conf(seed=7):
+    return (
+        NeuralNetConfiguration.builder().seed(seed).list()
+        .layer(Dense(n_out=8)).layer(OutputLayer(n_out=N_OUT))
+        .set_input_type(InputType.feed_forward(N_IN)).build()
+    )
+
+
+def _factory(seed=7):
+    conf = _conf(seed)
+    return lambda: SequentialModel(conf).init()
+
+
+def _fleet(n=2, seed=7, router=None, goldens=None, **server_kw):
+    server_kw.setdefault("max_batch", 4)
+    server_kw.setdefault("linger_s", 0.001)
+    return ServingFleet(
+        _factory(seed), n_replicas=n,
+        config=ServingConfig(**server_kw),
+        router_config=router,
+        golden_inputs=goldens,
+    )
+
+
+def _x(seed=0):
+    return np.random.default_rng(seed).normal(
+        size=(N_IN,)).astype(np.float32)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _crash_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path / "crash"))
+
+
+def _fail_call_model(msg="injected replica failure"):
+    def broken(cols, fmask_col, params, net_state):
+        raise RuntimeError(msg)
+    return broken
+
+
+# -- routing -----------------------------------------------------------------
+
+
+class TestRouting:
+    def test_fleet_output_matches_single_replica(self):
+        fleet = _fleet(n=3)
+        fleet.start()
+        try:
+            ref = SequentialModel(_conf()).init()
+            for seed in range(6):
+                x = _x(seed)
+                out = np.asarray(fleet.infer(x, deadline_s=60.0))
+                np.testing.assert_allclose(
+                    out, np.asarray(ref.output(x[None]))[0],
+                    rtol=1e-5, atol=1e-6,
+                )
+            # traffic spread: no replica was left idle (tie-break
+            # rotation) and every routed try succeeded first time
+            st = fleet.router.stats()
+            assert st["ok"] == 6 and st["retries"] == 0
+            served = [fleet.replicas[i].stats()["completed"]
+                      for i in range(3)]
+            assert sum(served) == 6 and max(served) < 6
+        finally:
+            fleet.stop()
+
+    def test_loaded_replica_is_avoided_before_it_sheds(self):
+        """Pull-based balancing: a replica advertising high shed
+        pressure stops receiving traffic BEFORE it starts rejecting."""
+        fleet = _fleet(n=2)
+        fleet.start()
+        try:
+            loaded = fleet.replicas[0]
+            with loaded._stats_lock:
+                loaded._batch_ewma = 10.0    # "my batches take 10s"
+            assert loaded.shed_pressure() == 1.0
+            for seed in range(5):
+                fleet.infer(_x(seed), deadline_s=60.0)
+            assert loaded.stats()["completed"] == 0
+            assert fleet.replicas[1].stats()["completed"] == 5
+            # and nothing was shed or retried: avoidance, not recovery
+            st = fleet.router.stats()
+            assert st["retries"] == 0 and st["failed"] == 0
+        finally:
+            fleet.stop()
+
+    @pytest.mark.faults
+    def test_route_fault_site_rejects_explicitly(self):
+        fleet = _fleet(n=2)
+        fleet.start()
+        try:
+            faults.arm("serving.route:raise:nth=1")
+            with pytest.raises(ServingRejected) as ei:
+                fleet.infer(_x(0), deadline_s=60.0)
+            assert ei.value.reason == "route_fault"
+            faults.disarm()
+            out = fleet.infer(_x(1), deadline_s=60.0)
+            assert np.isfinite(np.asarray(out)).all()
+        finally:
+            fleet.stop()
+
+
+# -- ejection + probation ----------------------------------------------------
+
+
+class TestEjection:
+    def test_consecutive_failures_eject_then_probation_readmits(
+        self, monkeypatch,
+    ):
+        fleet = _fleet(n=2, router=RouterConfig(
+            eject_threshold=2, probation_s=0.15, retry_budget=1,
+        ))
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            bad = fleet.replicas[0]
+            original = bad._call_model
+            monkeypatch.setattr(bad, "_call_model", _fail_call_model())
+            # failures on r0 are retried on r1 — the client never sees
+            # them; after 2 consecutive failures r0 is ejected
+            for seed in range(8):
+                out = fleet.infer(_x(seed), deadline_s=60.0)
+                assert np.isfinite(np.asarray(out)).all()
+            states = fleet.router.replica_states()
+            assert states["r0"]["state"] == "probation"
+            assert states["r0"]["ejections"] == 1
+            assert fleet.router.stats()["retries"] >= 2
+            # while ejected, r0 receives nothing
+            r0_errors = bad.stats()["errors"]
+            for seed in range(3):
+                fleet.infer(_x(20 + seed), deadline_s=60.0)
+            assert bad.stats()["errors"] == r0_errors
+            # heal the replica, ride out probation: ONE probe re-admits
+            monkeypatch.setattr(bad, "_call_model", original)
+            time.sleep(0.2)
+            for seed in range(3):
+                fleet.infer(_x(40 + seed), deadline_s=60.0)
+            states = fleet.router.replica_states()
+            assert states["r0"]["state"] == "active"
+            assert fleet.router.stats()["readmissions"] == 1
+        finally:
+            fleet.stop()
+
+    def test_failed_probe_restarts_the_probation_timer(self, monkeypatch):
+        fleet = _fleet(n=2, router=RouterConfig(
+            eject_threshold=1, probation_s=0.1, retry_budget=1,
+        ))
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            bad = fleet.replicas[0]
+            monkeypatch.setattr(bad, "_call_model", _fail_call_model())
+            for seed in range(4):
+                fleet.infer(_x(seed), deadline_s=60.0)
+            assert fleet.router.replica_states()["r0"]["state"] == \
+                "probation"
+            time.sleep(0.15)
+            # the probe fails (still broken): back to probation, and
+            # the CLIENT still got its answer via the retry
+            for seed in range(4):
+                out = fleet.infer(_x(10 + seed), deadline_s=60.0)
+                assert np.isfinite(np.asarray(out)).all()
+            assert fleet.router.replica_states()["r0"]["state"] == \
+                "probation"
+            assert fleet.router.stats()["readmissions"] == 0
+        finally:
+            fleet.stop()
+
+    def test_dead_replica_ejected_immediately_and_counted(self):
+        reg = registry()
+        dead_before = reg.counter(
+            "dl4jtpu_replica_ejections_total").value(reason="dead")
+        fleet = _fleet(n=2, router=RouterConfig(
+            probation_s=30.0, retry_budget=1,
+        ))
+        fleet.start()
+        try:
+            fleet.kill_replica(0)
+            # connection-refused shape: first touch ejects, the retry
+            # serves — repeatable, never client-visible
+            for seed in range(4):
+                out = fleet.infer(_x(seed), deadline_s=60.0)
+                assert np.isfinite(np.asarray(out)).all()
+            assert fleet.router.replica_states()["r0"]["state"] == \
+                "probation"
+            assert reg.counter(
+                "dl4jtpu_replica_ejections_total"
+            ).value(reason="dead") == dead_before + 1
+            assert fleet.health()["status"] == "serving"
+        finally:
+            fleet.stop()
+
+
+# -- retries -----------------------------------------------------------------
+
+
+class TestRetries:
+    def test_retry_is_counted_and_transparent(self, monkeypatch):
+        reg = registry()
+        retries_before = reg.counter(
+            "dl4jtpu_router_retries_total").value()
+        fleet = _fleet(n=2, router=RouterConfig(
+            eject_threshold=100, retry_budget=1,
+        ))
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            monkeypatch.setattr(
+                fleet.replicas[0], "_call_model", _fail_call_model(),
+            )
+            oks = 0
+            for seed in range(6):
+                out = fleet.infer(_x(seed), deadline_s=60.0)
+                assert np.isfinite(np.asarray(out)).all()
+                oks += 1
+            assert oks == 6
+            st = fleet.router.stats()
+            assert st["retries"] >= 1
+            assert reg.counter(
+                "dl4jtpu_router_retries_total"
+            ).value() >= retries_before + st["retries"]
+        finally:
+            fleet.stop()
+
+    def test_budget_exhaustion_surfaces_the_original_error(
+        self, monkeypatch,
+    ):
+        fleet = _fleet(n=1, router=RouterConfig(
+            eject_threshold=100, retry_budget=2,
+        ))
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            calls = []
+
+            def broken(cols, fmask_col, params, net_state):
+                calls.append(1)
+                raise RuntimeError(f"boom-{len(calls)}")
+
+            monkeypatch.setattr(fleet.replicas[0], "_call_model", broken)
+            with pytest.raises(ServingError) as ei:
+                fleet.infer(_x(0), deadline_s=60.0)
+            # 1 try + 2 budgeted retries ran, and the FIRST failure is
+            # what the client learns about
+            assert len(calls) == 3
+            assert "boom-1" in str(ei.value)
+            st = fleet.router.stats()
+            assert st["retries"] == 2 and st["failed"] == 1
+        finally:
+            fleet.stop()
+
+    def test_all_replicas_down_is_an_explicit_rejection(self):
+        fleet = _fleet(n=2, router=RouterConfig(
+            probation_s=30.0, retry_budget=1,
+        ))
+        fleet.start()
+        try:
+            fleet.kill_replica(0)
+            fleet.kill_replica(1)
+            with pytest.raises(ServingRejected) as ei:
+                fleet.infer(_x(0), deadline_s=5.0)
+            assert ei.value.reason in ("no_replicas", "replica_dead")
+            assert fleet.health()["status"] == "unavailable"
+        finally:
+            fleet.stop()
+
+
+# -- hedging -----------------------------------------------------------------
+
+
+class TestHedge:
+    def test_hedge_dedup_slower_duplicate_discarded(self, monkeypatch):
+        reg = registry()
+        hedges_before = reg.counter("dl4jtpu_router_hedges_total").value()
+        fleet = _fleet(n=2, router=RouterConfig(
+            hedge_after_s=0.05, retry_budget=0, eject_threshold=100,
+        ))
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            slow = fleet.replicas[0]
+            fast = fleet.replicas[1]
+            slow_orig = slow._call_model
+
+            def delayed(cols, fmask_col, params, net_state):
+                time.sleep(0.4)
+                return slow_orig(cols, fmask_col, params, net_state)
+
+            monkeypatch.setattr(slow, "_call_model", delayed)
+            # steer the pick to the SLOW replica: the fast one
+            # advertises a little pressure, the slow one none
+            with fast._stats_lock:
+                fast._batch_ewma = 0.01
+            x = _x(3)
+            t0 = time.monotonic()
+            out = np.asarray(fleet.infer(x, deadline_s=5.0))
+            took = time.monotonic() - t0
+            ref = SequentialModel(_conf()).init()
+            np.testing.assert_allclose(
+                out, np.asarray(ref.output(x[None]))[0],
+                rtol=1e-5, atol=1e-6,
+            )
+            # the hedge answered: well under the 0.4s the primary needs
+            assert took < 0.35
+            assert fleet.router.stats()["hedges"] == 1
+            assert reg.counter(
+                "dl4jtpu_router_hedges_total"
+            ).value() == hedges_before + 1
+            # exactly one client-visible result for the request
+            assert fleet.router.stats()["ok"] == 1
+        finally:
+            fleet.stop()
+
+
+# -- rolling deploys ---------------------------------------------------------
+
+
+class TestRollingDeploy:
+    def test_happy_path_installs_fleet_wide(self):
+        ex = np.zeros((N_IN,), np.float32)
+        fleet = _fleet(n=3, goldens=[ex, _x(1)])
+        fleet.warm_start(ex)
+        fleet.start()
+        try:
+            m = fleet.replicas[0].model
+            new = jax.tree.map(lambda a: a + 0.25, m.params)
+            res = fleet.deployer.deploy(new, source="test")
+            assert res["installed"]
+            assert res["replicas_updated"] == 3
+            assert fleet.deployer.generation == 1
+            # every replica swapped exactly once and serves the new
+            # weights (parity with a reference model on the new params)
+            ref = SequentialModel(_conf()).init()
+            ref.params = new
+            x = _x(9)
+            want = np.asarray(ref.output(x[None]))[0]
+            for srv in fleet.replicas:
+                assert srv.generation == 1
+            for _ in range(3):
+                np.testing.assert_allclose(
+                    np.asarray(fleet.infer(x, deadline_s=60.0)), want,
+                    rtol=1e-5, atol=1e-6,
+                )
+            assert registry().gauge(
+                "dl4jtpu_fleet_deploy_generation").value() == 1
+        finally:
+            fleet.stop()
+
+    @pytest.mark.faults
+    def test_canary_mismatch_rolls_the_whole_fleet_back(self):
+        reg = registry()
+        canary_before = reg.counter(
+            "dl4jtpu_canary_failures_total").value()
+        ex = np.zeros((N_IN,), np.float32)
+        fleet = _fleet(n=3, goldens=[ex])
+        fleet.warm_start(ex)
+        fleet.start()
+        try:
+            m = fleet.replicas[0].model
+            x = _x(11)
+            before = np.asarray(fleet.infer(x, deadline_s=60.0))
+            faults.arm("serving.canary:corrupt:nth=1")
+            res = fleet.deployer.deploy(
+                jax.tree.map(lambda a: a + 0.25, m.params),
+            )
+            faults.disarm()
+            assert not res["installed"]
+            assert "canary:r0" in res["reason"]
+            assert res["rolled_back"] == 1     # only the canary swapped
+            assert fleet.deployer.generation == 0
+            assert reg.counter(
+                "dl4jtpu_canary_failures_total"
+            ).value() == canary_before + 1
+            # the whole fleet is back on (and never left) the old
+            # weights: outputs unchanged on every route
+            for _ in range(4):
+                np.testing.assert_allclose(
+                    np.asarray(fleet.infer(x, deadline_s=60.0)), before,
+                    rtol=1e-6, atol=1e-7,
+                )
+            # replicas past the canary were NEVER touched
+            assert fleet.replicas[1].generation == 0
+            assert fleet.replicas[2].generation == 0
+        finally:
+            fleet.stop()
+
+    @pytest.mark.faults
+    def test_torn_push_mid_deploy_rolls_back_already_swapped(self):
+        ex = np.zeros((N_IN,), np.float32)
+        fleet = _fleet(n=2, goldens=[ex])
+        fleet.warm_start(ex)
+        fleet.start()
+        try:
+            m = fleet.replicas[0].model
+            x = _x(13)
+            before = np.asarray(fleet.infer(x, deadline_s=60.0))
+            # consult #2 = the SECOND replica's push is torn
+            faults.arm("serving.hotswap:truncate:nth=2")
+            res = fleet.deployer.deploy(
+                jax.tree.map(lambda a: a + 0.5, m.params),
+            )
+            faults.disarm()
+            assert not res["installed"]
+            assert "hotswap_rejected:r1" in res["reason"]
+            assert res["rolled_back"] == 1     # r0 restored
+            for _ in range(4):
+                np.testing.assert_allclose(
+                    np.asarray(fleet.infer(x, deadline_s=60.0)), before,
+                    rtol=1e-6, atol=1e-7,
+                )
+        finally:
+            fleet.stop()
+
+    def test_deploy_checkpoint_verifies_before_touching_replicas(
+        self, tmp_path,
+    ):
+        import os
+
+        from deeplearning4j_tpu.train.checkpoint import ModelSerializer
+
+        ex = np.zeros((N_IN,), np.float32)
+        fleet = _fleet(n=2, goldens=[ex])
+        fleet.warm_start(ex)
+        fleet.start()
+        try:
+            trainer = SequentialModel(_conf(seed=99)).init()
+            path = str(tmp_path / "good.zip")
+            ModelSerializer.write_model(trainer, path)
+            assert fleet.push_checkpoint(path)
+            x = _x(17)
+            np.testing.assert_allclose(
+                np.asarray(fleet.infer(x, deadline_s=60.0)),
+                np.asarray(trainer.output(x[None]))[0],
+                rtol=1e-5, atol=1e-6,
+            )
+            gens = [srv.generation for srv in fleet.replicas]
+            # a torn checkpoint file aborts BEFORE any replica swap
+            torn = str(tmp_path / "torn.zip")
+            ModelSerializer.write_model(trainer, torn)
+            with open(torn, "r+b") as f:
+                f.truncate(max(1, os.path.getsize(torn) // 2))
+            assert not fleet.push_checkpoint(torn)
+            assert [srv.generation for srv in fleet.replicas] == gens
+        finally:
+            fleet.stop()
+
+
+# -- serve_into fan-out (ISSUE 12 satellite) ---------------------------------
+
+
+class TestServeIntoFanOut:
+    def test_multi_target_fan_out_isolates_failures(self, tmp_path):
+        from deeplearning4j_tpu.serving import InferenceServer
+        from deeplearning4j_tpu.train.checkpoint import CheckpointStore
+
+        reg = registry()
+        errs_before = reg.counter(
+            "dl4jtpu_serving_hotswap_total").value(result="push_error")
+        a = InferenceServer(SequentialModel(_conf()).init(),
+                            ServingConfig(max_batch=2)).start()
+        b = InferenceServer(SequentialModel(_conf()).init(),
+                            ServingConfig(max_batch=2)).start()
+
+        class Exploding:
+            def push_checkpoint(self, path, source=None):
+                raise ConnectionError("target down")
+
+        try:
+            store = CheckpointStore(str(tmp_path), keep_last=3)
+            # the exploding target sits FIRST: its failure must not
+            # starve the two live servers behind it
+            store.serve_into(Exploding(), a, b)
+            trainer = SequentialModel(_conf(seed=42)).init()
+            trainer.iteration = 1
+            store.save(trainer)
+            assert a.generation == 1 and b.generation == 1
+            assert reg.counter(
+                "dl4jtpu_serving_hotswap_total"
+            ).value(result="push_error") == errs_before + 1
+            x = _x(23)
+            want = np.asarray(trainer.output(x[None]))[0]
+            for srv in (a, b):
+                np.testing.assert_allclose(
+                    np.asarray(srv.infer(x, deadline_s=60.0)), want,
+                    rtol=1e-5, atol=1e-6,
+                )
+        finally:
+            a.stop()
+            b.stop()
+
+    def test_serve_into_a_fleet_is_a_rolling_deploy(self, tmp_path):
+        from deeplearning4j_tpu.train.checkpoint import CheckpointStore
+
+        ex = np.zeros((N_IN,), np.float32)
+        fleet = _fleet(n=2, goldens=[ex])
+        fleet.warm_start(ex)
+        fleet.start()
+        try:
+            store = CheckpointStore(str(tmp_path), keep_last=3)
+            store.serve_into(fleet)
+            trainer = SequentialModel(_conf(seed=31)).init()
+            trainer.iteration = 5
+            store.save(trainer)
+            assert fleet.deployer.generation == 1
+            assert all(s.generation == 1 for s in fleet.replicas)
+        finally:
+            fleet.stop()
+
+
+# -- status surface ----------------------------------------------------------
+
+
+class TestStatusSurface:
+    def test_health_payload_schema_and_pressure(self):
+        from deeplearning4j_tpu.serving import InferenceServer
+
+        srv = InferenceServer(SequentialModel(_conf()).init(),
+                              ServingConfig(max_batch=4, max_queue=8))
+        h = srv.health()
+        for key in ("status", "shed_pressure", "breaker_state",
+                    "batch_latency_ewma_s", "weights_generation",
+                    "queue_depth"):
+            assert key in h
+        assert h["status"] == "serving" and h["shed_pressure"] == 0.0
+        st = srv.stats()
+        for key in ("shed_pressure", "breaker_state",
+                    "weights_generation", "batch_latency_ewma_s"):
+            assert key in st
+        # queue fill raises the advertised pressure (batcher stopped)
+        for i in range(4):
+            srv.submit(_x(i), deadline_s=60.0)
+        assert srv.health()["shed_pressure"] == pytest.approx(0.5)
+        # an open breaker pins it at 1.0
+        srv.breaker.record_failure()
+        srv.breaker.record_failure()
+        srv.breaker.record_failure()
+        assert srv.breaker.state == "open"
+        assert srv.health()["shed_pressure"] == 1.0
+        assert srv.health()["status"] == "breaker_open"
+        srv.stop()
+
+    def test_healthz_http_carries_the_pull_payload(self):
+        import json
+        import urllib.request
+
+        from deeplearning4j_tpu.serving import (
+            InferenceServer, ServingHTTPServer,
+        )
+
+        srv = InferenceServer(SequentialModel(_conf()).init(),
+                              ServingConfig(max_batch=2)).start()
+        http = ServingHTTPServer(srv).start()
+        try:
+            with urllib.request.urlopen(http.url + "healthz") as r:
+                h = json.load(r)
+            for key in ("status", "shed_pressure", "breaker_state",
+                        "batch_latency_ewma_s", "weights_generation"):
+                assert key in h
+            with urllib.request.urlopen(http.url + "v1/status") as r:
+                st = json.load(r)
+            assert "shed_pressure" in st and "weights_generation" in st
+        finally:
+            http.stop()
+            srv.stop()
+
+    def test_router_pressure_gauge_joins_the_scrape(self):
+        fleet = _fleet(n=2)
+        fleet.start()
+        try:
+            text = registry().to_prometheus_text()
+            name = fleet.router.name
+            for rep in ("r0", "r1"):
+                assert (f'dl4jtpu_router_replica_pressure'
+                        f'{{replica="{rep}",router="{name}"}}') in text
+        finally:
+            fleet.stop()
+
+    def test_two_fleets_keep_distinct_metric_series(self):
+        """Replica names repeat across fleets (r0..rN-1): the router
+        label must keep two fleets' per-replica series apart on the
+        scrape instead of silently merging them."""
+        fa = _fleet(n=1)
+        fb = _fleet(n=1)
+        fa.start()
+        fb.start()
+        try:
+            fa.infer(_x(0), deadline_s=60.0)
+            fb.infer(_x(1), deadline_s=60.0)
+            reg = registry()
+            for fleet in (fa, fb):
+                assert reg.counter(
+                    "dl4jtpu_router_requests_total"
+                ).value(router=fleet.router.name, replica="r0",
+                        outcome="ok") >= 1
+            text = reg.to_prometheus_text()
+            for fleet in (fa, fb):
+                assert (f'replica="r0",router="{fleet.router.name}"'
+                        in text)
+        finally:
+            fa.stop()
+            fb.stop()
+
+    def test_fleet_reporter_ships_a_serving_summary(self):
+        from deeplearning4j_tpu.observe.fleet import (
+            FleetAggregator, _serving_summary,
+        )
+
+        fleet = _fleet(n=2)
+        fleet.start()
+        try:
+            summary = _serving_summary()
+            assert summary is not None
+            assert len(summary["routers"]) >= 1
+            assert any(
+                s.get("status") == "serving" for s in summary["servers"]
+            )
+            agg = FleetAggregator()
+            agg.ingest("w0", {"rank": 0, "serving": summary})
+            view = agg.serving_view()
+            assert "w0" in view and view["w0"]["routers"]
+        finally:
+            fleet.stop()
+
+    def test_ui_fleet_endpoint_lists_routers(self):
+        import json
+        import urllib.request
+
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        fleet = _fleet(n=2)
+        fleet.start()
+        ui = UIServer(port=0)
+        try:
+            fleet.infer(_x(0), deadline_s=60.0)
+            with urllib.request.urlopen(
+                ui.url + "api/serving/fleet"
+            ) as r:
+                rows = json.load(r)
+            assert any(row.get("ok", 0) >= 1 for row in rows)
+            assert all("replicas" in row for row in rows)
+        finally:
+            ui.stop()
+            fleet.stop()
+
+
+# -- review-pass regressions -------------------------------------------------
+
+
+class TestReviewRegressions:
+    def test_probe_slot_survives_a_malformed_request(self, monkeypatch):
+        """A probation probe consumed by a request that fails BEFORE it
+        enqueues (wrong input arity -> ValueError) must release the
+        probe slot — the leak locked a healthy replica out of
+        re-admission forever."""
+        fleet = _fleet(n=2, router=RouterConfig(
+            eject_threshold=1, probation_s=0.1, retry_budget=1,
+        ))
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            bad = fleet.replicas[0]
+            original = bad._call_model
+            monkeypatch.setattr(bad, "_call_model", _fail_call_model())
+            # tie rotation: within two requests one lands on r0, fails
+            # (threshold 1 -> ejected) and is retried on r1
+            for seed in range(2):
+                fleet.infer(_x(seed), deadline_s=60.0)
+            assert fleet.router.replica_states()["r0"]["state"] == \
+                "probation"
+            monkeypatch.setattr(bad, "_call_model", original)
+            time.sleep(0.15)                       # probe window open
+            # the probe draws a malformed request: client error, but
+            # the slot must come back
+            with pytest.raises(ValueError):
+                fleet.infer((_x(1), _x(2)), deadline_s=60.0)
+            # ...and the router's ledger still balances: the malformed
+            # request is a counted client error, not a leak
+            st = fleet.router.stats()
+            assert st["client_errors"] == 1
+            assert st["requests"] == (st["ok"] + st["failed"]
+                                      + st["client_errors"])
+            for seed in range(3):
+                fleet.infer(_x(10 + seed), deadline_s=60.0)
+            assert fleet.router.replica_states()["r0"]["state"] == \
+                "active"
+        finally:
+            fleet.stop()
+
+    def test_revive_resyncs_onto_the_deployed_weights(self):
+        """A deploy that ran while a replica was dead skipped it;
+        revive must re-sync it (verified push + canary) before the
+        router can route to it — re-admitting as-is silently served
+        the pre-deploy model."""
+        ex = np.zeros((N_IN,), np.float32)
+        fleet = _fleet(n=2, goldens=[ex], router=RouterConfig(
+            probation_s=0.05, retry_budget=1,
+        ))
+        fleet.warm_start(ex)
+        fleet.start()
+        try:
+            m = fleet.replicas[0].model
+            fleet.kill_replica(0)
+            new = jax.tree.map(lambda a: a + 0.25, m.params)
+            res = fleet.deployer.deploy(new)
+            assert res["installed"] and res["replicas_updated"] == 1
+            assert fleet.revive_replica(0)
+            # the revived replica serves the DEPLOYED weights
+            ref = SequentialModel(_conf()).init()
+            ref.params = new
+            x = _x(7)
+            want = np.asarray(ref.output(x[None]))[0]
+            np.testing.assert_allclose(
+                np.asarray(fleet.replicas[0].infer(x, deadline_s=60.0)),
+                want, rtol=1e-5, atol=1e-6,
+            )
+            # and the router can use it again (probation probe)
+            time.sleep(0.1)
+            for seed in range(4):
+                np.testing.assert_allclose(
+                    np.asarray(fleet.infer(x, deadline_s=60.0)), want,
+                    rtol=1e-5, atol=1e-6,
+                )
+            assert fleet.router.replica_states()["r0"]["state"] == \
+                "active"
+        finally:
+            fleet.stop()
+
+    def test_concurrent_deploys_are_serialized(self):
+        """Two racing rolling deploys must not interleave: the fleet
+        ends with every replica on the SAME weights and both deploys
+        accounted."""
+        ex = np.zeros((N_IN,), np.float32)
+        fleet = _fleet(n=3, goldens=[ex])
+        fleet.warm_start(ex)
+        fleet.start()
+        try:
+            m = fleet.replicas[0].model
+            a = jax.tree.map(lambda t: t + 0.1, m.params)
+            b = jax.tree.map(lambda t: t + 0.2, m.params)
+            results = []
+            threads = [
+                threading.Thread(
+                    target=lambda p=p: results.append(
+                        fleet.deployer.deploy(p)
+                    )
+                )
+                for p in (a, b)
+            ]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join(60)
+            assert all(r["installed"] for r in results)
+            assert fleet.deployer.generation == 2
+            x = _x(5)
+            outs = [
+                np.asarray(srv.infer(x, deadline_s=60.0))
+                for srv in fleet.replicas
+            ]
+            for o in outs[1:]:
+                np.testing.assert_allclose(o, outs[0], rtol=1e-6,
+                                           atol=1e-7)
+        finally:
+            fleet.stop()
+
+    def test_client_deadline_expiry_does_not_eject_a_healthy_replica(
+        self, monkeypatch,
+    ):
+        """A short-deadline client timing out (no per-try cap binding)
+        says nothing about the replica — three such timeouts must NOT
+        eject it as wedged."""
+        fleet = _fleet(n=1, router=RouterConfig(
+            eject_threshold=3, retry_budget=0, try_timeout_s=None,
+        ))
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            srv = fleet.replicas[0]
+            orig = srv._call_model
+
+            def slow(cols, fmask_col, params, net_state):
+                time.sleep(0.15)
+                return orig(cols, fmask_col, params, net_state)
+
+            monkeypatch.setattr(srv, "_call_model", slow)
+            for seed in range(3):
+                with pytest.raises(ServingTimeout):
+                    fleet.infer(_x(seed), deadline_s=0.05)
+            assert fleet.router.replica_states()["r0"]["state"] == \
+                "active"
+            # a patient client is still served
+            out = fleet.infer(_x(9), deadline_s=5.0)
+            assert np.isfinite(np.asarray(out)).all()
+        finally:
+            fleet.stop()
+
+    def test_retry_can_revisit_the_survivor_of_an_ejection(
+        self, monkeypatch,
+    ):
+        """The exclusion reset must count replicas _pick can ROUTE to:
+        with r0 in (closed-window) probation, a transient failure on
+        the sole active replica is retried on it rather than surfaced
+        with the budget unspent."""
+        fleet = _fleet(n=2, router=RouterConfig(
+            eject_threshold=2, retry_budget=1, probation_s=30.0,
+        ))
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            bad = fleet.replicas[0]
+            monkeypatch.setattr(bad, "_call_model", _fail_call_model())
+            for seed in range(6):        # r0 accumulates 2 -> ejected
+                fleet.infer(_x(seed), deadline_s=60.0)
+            assert fleet.router.replica_states()["r0"]["state"] == \
+                "probation"
+            alive = fleet.replicas[1]
+            orig = alive._call_model
+            calls = []
+
+            def flaky(cols, fmask_col, params, net_state):
+                calls.append(1)
+                if len(calls) == 1:
+                    raise RuntimeError("transient")
+                return orig(cols, fmask_col, params, net_state)
+
+            monkeypatch.setattr(alive, "_call_model", flaky)
+            out = fleet.infer(_x(10), deadline_s=60.0)
+            assert np.isfinite(np.asarray(out)).all()
+            assert len(calls) == 2
+        finally:
+            fleet.stop()
+
+    def test_retry_can_revisit_a_replica_when_the_rest_are_dead(
+        self, monkeypatch,
+    ):
+        """With one replica dead, the exclusion reset must count
+        ROUTABLE replicas: a transient failure on the sole survivor is
+        retried on it, not surfaced with budget unspent."""
+        fleet = _fleet(n=2, router=RouterConfig(
+            eject_threshold=100, retry_budget=1, probation_s=30.0,
+        ))
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            fleet.kill_replica(0)
+            alive = fleet.replicas[1]
+            original = alive._call_model
+            calls = []
+
+            def flaky(cols, fmask_col, params, net_state):
+                calls.append(1)
+                if len(calls) == 1:
+                    raise RuntimeError("transient")
+                return original(cols, fmask_col, params, net_state)
+
+            monkeypatch.setattr(alive, "_call_model", flaky)
+            out = fleet.infer(_x(0), deadline_s=60.0)
+            assert np.isfinite(np.asarray(out)).all()
+            assert len(calls) == 2
+            assert fleet.router.stats()["retries"] == 1
+        finally:
+            fleet.stop()
+
+
+# -- chaos: one replica wedged under load ------------------------------------
+
+
+class TestChaos:
+    def test_one_replica_wedged_under_load_every_request_accounted(
+        self, monkeypatch,
+    ):
+        """The acceptance shape: a wedged replica under concurrent load
+        costs clients at most counted retries.  Every issued request is
+        served, explicitly shed, or explicitly failed — the ledger
+        balances (zero silent drops), the wedge is detected via the
+        per-try deadline, and the replica is ejected."""
+        fleet = _fleet(n=2, router=RouterConfig(
+            eject_threshold=2, probation_s=30.0, retry_budget=1,
+            try_timeout_s=0.15,
+        ))
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            wedged = fleet.replicas[0]
+            orig = wedged._call_model
+
+            def wedge(cols, fmask_col, params, net_state):
+                time.sleep(2.0)
+                return orig(cols, fmask_col, params, net_state)
+
+            monkeypatch.setattr(wedged, "_call_model", wedge)
+            stop = threading.Event()
+            lock = threading.Lock()
+            tally = {"issued": 0, "ok": 0, "shed": 0, "errors": 0,
+                     "timeouts": 0}
+
+            def client(cid):
+                rng = np.random.default_rng(cid)
+                while not stop.is_set():
+                    x = rng.normal(size=(N_IN,)).astype(np.float32)
+                    outcome = "ok"
+                    try:
+                        out = fleet.infer(x, deadline_s=1.0)
+                        assert np.isfinite(np.asarray(out)).all()
+                    except ServingRejected:
+                        outcome = "shed"
+                    except ServingTimeout:
+                        outcome = "timeouts"
+                    except ServingError:
+                        outcome = "errors"
+                    with lock:
+                        tally["issued"] += 1
+                        tally[outcome if outcome != "ok" else "ok"] += 1
+
+            threads = [threading.Thread(target=client, args=(i,))
+                       for i in range(4)]
+            for t in threads:
+                t.start()
+            time.sleep(1.2)
+            stop.set()
+            for t in threads:
+                t.join(30)
+            # zero silent drops: the client-side ledger balances
+            assert tally["issued"] == (
+                tally["ok"] + tally["shed"] + tally["errors"]
+                + tally["timeouts"]
+            )
+            assert tally["issued"] > 0 and tally["ok"] > 0
+            # the wedge was detected and the replica ejected
+            assert fleet.router.replica_states()["r0"]["state"] == \
+                "probation"
+            st = fleet.router.stats()
+            assert st["ejections"] >= 1
+            # the overwhelming majority of traffic was SERVED: after
+            # the ejection (at most ~2 wedged tries in) everything
+            # lands on the healthy replica first try
+            assert tally["ok"] >= tally["issued"] - 4
+        finally:
+            fleet.stop()
