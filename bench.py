@@ -2169,8 +2169,145 @@ def bench_serving() -> None:
     chaos_srv.stop()
     srv.stop()
 
+    # -- phase 4: request tracing + SLO burn alert (ISSUE 13) --------------
+    # 4a: the chaos-plan request — its first try raises (-> one counted
+    # cross-replica retry), the retried try is slowed past hedge_after
+    # (-> one hedge), the hedge wins.  The whole journey must land in
+    # ONE causally-linked trace whose spans account for >= 95% of the
+    # client-observed latency.
+    from deeplearning4j_tpu.observe import (
+        chain_coverage, chain_is_causal, registry, tracer,
+    )
+    from deeplearning4j_tpu.serving import RouterConfig, ServingFleet
+
+    fleet = ServingFleet(
+        lambda: SequentialModel(conf).init(), n_replicas=2,
+        config=ServingConfig(max_batch=8, linger_s=0.001),
+        router_config=RouterConfig(retry_budget=2, hedge_after_s=0.05),
+    )
+    fleet.warm_start(example)
+    fleet.start()
+    rec = tracer()
+    rec.enable()
+    rec.clear()
+    faults.arm("serving.infer:raise:nth=1;"
+               "serving.infer:delay:nth=2,secs=0.2")
+    t0 = time.monotonic()
+    fleet.infer(example, deadline_s=10.0)
+    client_wall_s = time.monotonic() - t0
+    faults.disarm()
+    time.sleep(0.4)        # the discarded hedge loser finishes its batch
+    traced = [s for s in list(rec._spans) if s[5] and "trace" in s[5]]
+    trace_ids = sorted({s[5]["trace"] for s in traced})
+    chain = rec.trace_chain(trace_ids[0]) if trace_ids else []
+    span_names: dict = {}
+    for s in chain:
+        span_names[s["name"]] = span_names.get(s["name"], 0) + 1
+    coverage = chain_coverage(chain)
+    rstats = fleet.router.stats()
+    trace_row = {
+        "plan": "serving.infer:raise:nth=1 (retry) + "
+                "delay:nth=2,secs=0.2 (hedge)",
+        "client_wall_ms": round(client_wall_s * 1000.0, 3),
+        "trace_ids": len(trace_ids),
+        "spans": len(chain),
+        "span_names": span_names,
+        "causal": chain_is_causal(chain),
+        "coverage": round(coverage, 4) if coverage is not None else None,
+        "retries": rstats["retries"],
+        "hedges": rstats["hedges"],
+    }
+    rec.disable()
+    rec.clear()
+    fleet.stop()
+    print(f"[bench] serving request trace: {json.dumps(trace_row)}",
+          file=sys.stderr)
+
+    # 4b: induced overload must fire the fast-window burn alert within
+    # its window, and the alert must clear after recovery.  Real clock,
+    # shrunken windows (the engine's clock is injectable; the bench
+    # proves it on wall time).
+    from deeplearning4j_tpu.observe.slo import (
+        BurnWindow, SLObjective, SLOEngine,
+    )
+
+    fast_w, slow_w = (0.5, 2.0) if QUICK else (1.0, 4.0)
+    engine = SLOEngine(
+        [SLObjective.availability("availability", target=0.99)],
+        windows=(BurnWindow(fast_w, 4.0), BurnWindow(slow_w, 1.0)),
+    )
+    slo_srv = make_server()
+    slo_srv.warm_start(example)
+    slo_srv.start()
+    stop_load = threading.Event()
+
+    def _slo_client():
+        import numpy as _np
+
+        rng = _np.random.default_rng(0)
+        while not stop_load.is_set():
+            try:
+                slo_srv.infer(
+                    rng.normal(size=(n_in,)).astype(_np.float32),
+                    deadline_s=2.0,
+                )
+            except Exception:
+                pass
+
+    load_threads = [threading.Thread(target=_slo_client)
+                    for _ in range(4)]
+    for t in load_threads:
+        t.start()
+    engine.sample()
+    time.sleep(fast_w)                      # healthy baseline window
+    faults.arm("serving.infer:raise:every=2")
+    t_overload = time.monotonic()
+    fired_after_s = None
+    deadline = time.monotonic() + fast_w * 6
+    while time.monotonic() < deadline:
+        if engine.sample()["availability"]["alert"]:
+            fired_after_s = time.monotonic() - t_overload
+            break
+        time.sleep(0.05)
+    faults.disarm()
+    t_recover = time.monotonic()
+    cleared_after_s = None
+    deadline = time.monotonic() + fast_w * 6
+    while time.monotonic() < deadline:
+        if not engine.sample()["availability"]["alert"]:
+            cleared_after_s = time.monotonic() - t_recover
+            break
+        time.sleep(0.05)
+    stop_load.set()
+    for t in load_threads:
+        t.join(10)
+    slo_srv.stop()
+    slo_state = engine.state()["availability"]
+    slo_row = {
+        "objective": {"name": "availability", "target": 0.99},
+        "windows": {"fast_s": fast_w, "slow_s": slow_w,
+                    "fast_threshold": 4.0, "slow_threshold": 1.0},
+        "alert_fired": fired_after_s is not None,
+        "fired_after_s": (round(fired_after_s, 3)
+                          if fired_after_s is not None else None),
+        "fired_within_fast_window": (
+            fired_after_s is not None and fired_after_s <= fast_w * 2
+        ),
+        "alert_cleared": cleared_after_s is not None,
+        "cleared_after_s": (round(cleared_after_s, 3)
+                            if cleared_after_s is not None else None),
+        "alerts_total": slo_state["alerts_total"],
+        "final_burn": slo_state["burn"],
+    }
+    # meta-observability: one full scrape, then read its self-timing
+    reg = registry()
+    reg.to_prometheus_text()
+    slo_row["scrape_seconds"] = reg.gauge("dl4jtpu_scrape_seconds").value()
+    slo_row["registry_series"] = reg.gauge("dl4jtpu_registry_series").value()
+    print(f"[bench] serving slo: {json.dumps(slo_row)}", file=sys.stderr)
+
     doc = {
-        "schema": "bench-serving/1",
+        "schema": "bench-serving/2",
         "platform": jax.default_backend(),
         "env": _env_provenance(),
         "quick": QUICK,
@@ -2181,6 +2318,8 @@ def bench_serving() -> None:
         "curve": curve,
         "warm_start": warm_row,
         "chaos": chaos_row,
+        "request_trace": trace_row,
+        "slo": slo_row,
     }
     if not QUICK:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

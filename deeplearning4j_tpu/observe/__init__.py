@@ -27,6 +27,10 @@ lazily and only where a signal actually comes from a device):
   registry snapshots + traces to the coordinator, which serves a merged
   worker-labeled ``/metrics/cluster``, per-worker skew/straggler
   gauges, and one merged cluster timeline at ``GET /api/trace/cluster``.
+- `observe.slo`: declarative SLO objectives (availability %, latency
+  pX) evaluated over the registry with multi-window burn-rate alerting;
+  alert state lands on the ``dl4jtpu_slo_*`` gauges, ``/healthz``,
+  ``/v1/status``, ``GET /api/slo`` and the fleet push.
 
     from deeplearning4j_tpu.observe import registry, tracer, HealthListener
 
@@ -44,23 +48,37 @@ from deeplearning4j_tpu.observe.metrics import (
     MetricsRegistry,
     registry,
 )
+from deeplearning4j_tpu.observe.slo import (
+    BurnWindow,
+    SLObjective,
+    SLOEngine,
+    active_engine,
+)
 from deeplearning4j_tpu.observe.trace import (
     StepScope,
     TraceRecorder,
+    chain_coverage,
+    chain_is_causal,
     merge_chrome_traces,
     step_scope,
     tracer,
 )
 
 __all__ = [
+    "BurnWindow",
     "Counter",
     "DivergenceError",
     "Gauge",
     "HealthListener",
     "Histogram",
     "MetricsRegistry",
+    "SLOEngine",
+    "SLObjective",
     "StepScope",
     "TraceRecorder",
+    "active_engine",
+    "chain_coverage",
+    "chain_is_causal",
     "merge_chrome_traces",
     "registry",
     "step_scope",

@@ -168,11 +168,13 @@ class FleetAggregator:
         """Accept one pushed snapshot.  Payload keys (all optional):
         ``rank``, ``prom`` (text exposition), ``step_latency_sum``,
         ``step_latency_count``, ``trace`` (Chrome trace doc),
-        ``serving`` (replica/router health summary)."""
+        ``serving`` (replica/router health summary), ``slo`` (the
+        worker's SLO burn-rate state)."""
         now = time.time()
         with self._lock:
             st = self._workers.setdefault(worker, {
                 "rank": None, "prom": "", "trace": None, "serving": None,
+                "slo": None,
                 "sum": 0.0, "count": 0, "recent_mean": None,
                 "first_push": now, "last_push": now,
             })
@@ -182,6 +184,8 @@ class FleetAggregator:
                 st["prom"] = str(payload["prom"])
             if payload.get("serving") is not None:
                 st["serving"] = payload["serving"]
+            if payload.get("slo") is not None:
+                st["slo"] = payload["slo"]
             if payload.get("trace") is not None:
                 doc = payload["trace"]
                 prev = st["trace"]
@@ -266,6 +270,15 @@ class FleetAggregator:
             self._prune_locked()
             return {w: st["serving"] for w, st in self._workers.items()
                     if st.get("serving") is not None}
+
+    def slo_view(self) -> dict:
+        """{worker: last pushed SLO burn-rate state} — the coordinator
+        sees every replica's burn rate (served at ``GET /api/slo``),
+        so a fleet-wide objective breach is one read, not N scrapes."""
+        with self._lock:
+            self._prune_locked()
+            return {w: st["slo"] for w, st in self._workers.items()
+                    if st.get("slo") is not None}
 
     # -- merged expositions -------------------------------------------------
     def _fleet_text(self) -> str:
@@ -451,6 +464,15 @@ def _serving_summary() -> Optional[dict]:
         return None
 
 
+def _slo_state() -> Optional[dict]:
+    """The active SLO engine's state for the worker push (one fresh
+    sample — the coordinator must see burn rates even if nobody scrapes
+    this worker's /metrics).  None when no engine is installed."""
+    from deeplearning4j_tpu.observe.slo import sample_active_state
+
+    return sample_active_state()
+
+
 #: cap on trace events shipped per push — the control-plane transport is
 #: JSON-lines; a full 16k ring would be a multi-MB line
 TRACE_EVENTS_PER_PUSH = 4096
@@ -495,6 +517,9 @@ class FleetReporter:
         serving = _serving_summary()
         if serving is not None:
             out["serving"] = serving
+        slo = _slo_state()
+        if slo is not None:
+            out["slo"] = slo
         self._pending_cursor = None
         t = tracer()
         if t.enabled:

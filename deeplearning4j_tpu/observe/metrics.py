@@ -103,6 +103,17 @@ class _Metric:
         with self._lock:
             return self._series.get(self._key(labels), 0.0)
 
+    def sum_series(self, **match) -> float:
+        """Sum of every series whose label set CONTAINS `match` (no
+        match = all series).  The SLO engine's read primitive: good/bad
+        event totals out of a labeled counter without a snapshot() (and
+        without running the registry's collectors)."""
+        want = set(match.items())
+        with self._lock:
+            return sum(
+                v for k, v in self._series.items() if want <= set(k)
+            )
+
     def expose(self) -> list[str]:
         lines = [
             f"# HELP {self.name} {self.help}",
@@ -211,6 +222,17 @@ class Histogram:
         with self._lock:
             return self._sum
 
+    def count_le(self, value: float) -> int:
+        """Observations <= the largest bucket bound that is <= `value`
+        (exactly what a Prometheus latency-SLI query reads off
+        ``_bucket{le=...}``).  A threshold below the first bound counts
+        nothing, and overflow observations (beyond the last bound) are
+        never counted — their magnitude is unknown.  Pick SLO
+        thresholds ON bucket bounds for exact accounting."""
+        i = bisect.bisect_right(self.buckets, float(value))
+        with self._lock:
+            return sum(self._counts[:i])
+
     def expose(self) -> list[str]:
         lines = [
             f"# HELP {self.name} {self.help}",
@@ -288,6 +310,13 @@ class MetricsRegistry:
                   ) -> Histogram:
         return self._get_or_create(Histogram, name, help, buckets=buckets)
 
+    def get(self, name: str):
+        """The already-registered family (None when absent): the
+        bucket-agnostic READER lookup — the SLO engine must observe a
+        histogram family without asserting its bucket layout."""
+        with self._lock:
+            return self._metrics.get(name)
+
     # -- collectors --------------------------------------------------------
     def register_collector(self, fn: Callable[[], None]) -> None:
         """Register a callback run before every exposition/snapshot; pull
@@ -316,14 +345,30 @@ class MetricsRegistry:
     def to_prometheus_text(self) -> str:
         """Prometheus text exposition format 0.0.4 of every family
         (collectors refreshed first).  Families with no samples yet still
-        emit HELP/TYPE so scrapers see the full schema from step 0."""
+        emit HELP/TYPE so scrapers see the full schema from step 0.
+
+        Meta-observability: the render is timed into
+        ``dl4jtpu_scrape_seconds`` AFTER the text is built, so the gauge
+        a scraper reads describes the PREVIOUS completed scrape — a slow
+        or bloating scrape is itself an outage signal, and it must not
+        be invisible just because it is the scrape."""
+        import time
+
+        t0 = time.perf_counter()
         self.collect()
         with self._lock:
             metrics = [self._metrics[n] for n in sorted(self._metrics)]
         lines: list[str] = []
         for m in metrics:
             lines.extend(m.expose())
-        return "\n".join(lines) + "\n"
+        out = "\n".join(lines) + "\n"
+        with self._lock:
+            meta = self._metrics.get("dl4jtpu_scrape_seconds")
+        if isinstance(meta, Gauge):
+            # only the global registry pre-declares the meta family; a
+            # bare test registry's exposition stays exactly its own
+            meta.set(time.perf_counter() - t0)
+        return out
 
     def snapshot(self, prefixes: Optional[Sequence[str]] = None) -> dict:
         """{family_name: {value|series|histogram}} dict of current state
@@ -358,6 +403,7 @@ def registry() -> MetricsRegistry:
             reg.register_collector(_compile_stats_collector)
             reg.register_collector(_device_memory_collector)
             reg.register_collector(_build_info_collector)
+            reg.register_collector(_registry_meta_collector)
             _REGISTRY = reg
     return _REGISTRY
 
@@ -572,6 +618,57 @@ def _declare_core(reg: MetricsRegistry) -> None:
     reg.gauge("dl4jtpu_supervisor_backoff_seconds",
               "Crash-loop backoff the ElasticSupervisor is currently "
               "sleeping before respawning (0 = not backing off)")
+    # request-level latency attribution (serving/server.py,
+    # serving/router.py): per-request decomposition of where one
+    # inference request's time went — the histogram families behind
+    # /api/serving/slow and the /v1/status breakdown
+    reg.histogram("dl4jtpu_serving_queue_wait_seconds",
+                  "Per served request: enqueue -> its batch was taken "
+                  "(includes the batcher's linger window)")
+    reg.histogram("dl4jtpu_serving_batch_form_seconds",
+                  "Per served request: batch taken -> dispatch entered "
+                  "(coalesce bookkeeping + expiry filtering)")
+    reg.histogram("dl4jtpu_serving_dispatch_seconds",
+                  "Per served request: its batch's stack + weights "
+                  "snapshot + device call + finiteness screen")
+    reg.histogram("dl4jtpu_serving_pad_overhead_seconds",
+                  "Per served request: the share of its batch's "
+                  "dispatch spent computing padding rows "
+                  "(dispatch x padded/bucket)")
+    reg.counter("dl4jtpu_serving_batch_examples_total",
+                "Examples in dispatched serving batches, by kind "
+                "(real=admitted requests, pad=zero rows added to reach "
+                "the power-of-two bucket) — the batch-occupancy "
+                "integral")
+    reg.histogram("dl4jtpu_router_overhead_seconds",
+                  "Per routed request: client wall minus the WINNING "
+                  "try's service time — the retry + hedge + pick "
+                  "overhead the front door added")
+    # SLO burn-rate engine (observe/slo.py); the engine's registry
+    # collector refreshes these at scrape time
+    reg.gauge("dl4jtpu_slo_burn_rate",
+              "Error-budget burn rate per objective and window "
+              "(1.0 = burning exactly the budget; labels: slo, window)")
+    reg.gauge("dl4jtpu_slo_error_budget_remaining",
+              "Fraction of each objective's error budget left since "
+              "the engine started (negative = budget blown)")
+    reg.gauge("dl4jtpu_slo_alert_active",
+              "1 while an objective's multi-window burn alert is "
+              "firing, else 0")
+    reg.counter("dl4jtpu_slo_alerts_total",
+                "Burn-rate alerts fired per objective (rising edges "
+                "only)")
+    # meta-observability: the scrape path describing itself — a slow or
+    # bloating scrape is an outage signal too
+    reg.gauge("dl4jtpu_scrape_seconds",
+              "Wall seconds the PREVIOUS completed /metrics render "
+              "took (collectors + exposition)")
+    reg.gauge("dl4jtpu_registry_families",
+              "Metric families currently registered")
+    reg.gauge("dl4jtpu_registry_series",
+              "Label series across all families (histograms count "
+              "their exposition lines: buckets + +Inf + sum + count) — "
+              "a bloating scrape shows here first")
     # step-timeline ring buffer (observe/trace.py)
     reg.counter("dl4jtpu_trace_spans_dropped_total",
                 "Spans evicted by trace ring-buffer wrap-around (the "
@@ -648,6 +745,26 @@ def _build_info_collector() -> None:
         backend=str(backend),
         device_count=str(device_count),
     )
+
+
+def _registry_meta_collector() -> None:
+    """Registry self-description at scrape time: family count and total
+    label-series count (histograms count their exposition lines).  A
+    scrape that keeps growing — a label leak, an unbounded per-request
+    series — shows up here before it takes the scraper down."""
+    reg = registry()
+    with reg._lock:
+        metrics = list(reg._metrics.values())
+    families = len(metrics)
+    series = 0
+    for m in metrics:
+        if isinstance(m, Histogram):
+            series += len(m.buckets) + 3        # +Inf, _sum, _count
+        else:
+            with m._lock:
+                series += max(len(m._series), 1)
+    reg.gauge("dl4jtpu_registry_families").set(families)
+    reg.gauge("dl4jtpu_registry_series").set(series)
 
 
 def _device_memory_collector() -> None:

@@ -20,6 +20,20 @@ step with five spans: ``etl_wait`` -> ``host_stage`` -> ``dispatch`` ->
 loss scalar ONLY while tracing is enabled, so the default (untraced)
 path keeps full host/device overlap.
 
+**Causally-linked request traces** (the serving plane): spans may carry
+``trace`` / ``span`` / ``parent`` ids (allocated with `next_id()`,
+recorded via the ordinary ``add_complete(..., trace=..., span=...,
+parent=...)``).  One inference request emits a linked chain — router
+pick -> retry/hedge hops -> per-replica admit -> queue wait -> batch
+form -> dispatch — that crosses threads and replicas.  The Chrome
+export emits, per linked span, the thread-track "X" slice PLUS an
+async ``b``/``e`` pair keyed by the trace id (Perfetto draws the whole
+request on one lane), and `to_chrome_trace` adds flow arrows
+(``s``/``f``) binding each child slice to its parent.  `trace_chain`
+returns one request's spans for programmatic audit (the span-count
+ledger), and `chain_is_causal` / `chain_coverage` are the assertions
+the serving tests and bench build on.
+
     from deeplearning4j_tpu.observe import tracer
     t = tracer(); t.enable()
     model.fit(data, epochs=1)
@@ -28,6 +42,7 @@ path keeps full host/device overlap.
 
 from __future__ import annotations
 
+import itertools
 import json
 import logging
 import os
@@ -38,6 +53,71 @@ from functools import wraps
 from typing import Optional
 
 log = logging.getLogger("deeplearning4j_tpu")
+
+
+# -- causal ids --------------------------------------------------------------
+# one process-wide id sequence for trace AND span ids: a span id can never
+# collide with a trace id, so a chain reader needs no namespace bookkeeping.
+# next() on itertools.count is a single C call — atomic under the GIL, no
+# lock on the request path.
+_IDS = itertools.count(1)
+
+
+def next_id() -> int:
+    """Allocate a process-unique trace/span id."""
+    return next(_IDS)
+
+
+def trace_args(trace: Optional[int], span: Optional[int],
+               parent: Optional[int] = None) -> dict:
+    """The causal-link args for `add_complete` (empty when tracing is
+    off / no ids were allocated — call sites don't branch)."""
+    if trace is None or span is None:
+        return {}
+    out = {"trace": trace, "span": span}
+    if parent is not None:
+        out["parent"] = parent
+    return out
+
+
+def chain_is_causal(chain: list) -> bool:
+    """True when `chain` (a `trace_chain` result) is one complete causal
+    tree: exactly one root (no parent), and every other span's parent id
+    is present in the chain — no orphan spans."""
+    if not chain:
+        return False
+    ids = {s["span"] for s in chain}
+    roots = [s for s in chain if s.get("parent") is None]
+    if len(roots) != 1:
+        return False
+    return all(s.get("parent") in ids
+               for s in chain if s.get("parent") is not None)
+
+
+def chain_coverage(chain: list) -> Optional[float]:
+    """Fraction of the root span's wall time covered by the UNION of its
+    direct children's intervals — "how much of the client-observed
+    latency do the recorded hops account for".  None when the chain has
+    no usable root."""
+    roots = [s for s in chain if s.get("parent") is None]
+    if len(roots) != 1 or roots[0]["dur"] <= 0:
+        return None
+    root = roots[0]
+    kids = sorted(
+        ((s["t0"], s["t0"] + s["dur"]) for s in chain
+         if s.get("parent") == root["span"]),
+    )
+    covered, cur_lo, cur_hi = 0.0, None, None
+    for lo, hi in kids:
+        if cur_hi is None or lo > cur_hi:
+            if cur_hi is not None:
+                covered += cur_hi - cur_lo
+            cur_lo, cur_hi = lo, hi
+        else:
+            cur_hi = max(cur_hi, hi)
+    if cur_hi is not None:
+        covered += cur_hi - cur_lo
+    return min(1.0, covered / root["dur"])
 
 
 class _NullSpan:
@@ -171,6 +251,22 @@ class TraceRecorder:
             ev["args"] = args
         return ev
 
+    def _expand(self, span) -> list:
+        """Chrome events for one span: the thread-track "X" slice, plus —
+        for causally-linked spans (args carry a trace id) — an async
+        ``b``/``e`` pair keyed by the trace id, so Perfetto shows the
+        whole request on one lane even as it hops threads/replicas."""
+        ev = self._event(span)
+        out = [ev]
+        args = span[5]
+        if args and "trace" in args:
+            rid = f"{args['trace']:x}"
+            base = {"name": ev["name"], "cat": "request", "id": rid,
+                    "pid": ev["pid"], "tid": ev["tid"]}
+            out.append({**base, "ph": "b", "ts": ev["ts"]})
+            out.append({**base, "ph": "e", "ts": ev["ts"] + ev["dur"]})
+        return out
+
     def appended_total(self) -> int:
         """Spans ever appended (ring contents + wrap evictions) — the
         monotonic cursor base for incremental consumers (the fleet
@@ -195,8 +291,24 @@ class TraceRecorder:
         new_n = total - cursor
         if new_n <= 0:
             return [], max(cursor, total)
+        # `limit` bounds EXPANDED events: a causally-linked span emits 3
+        # (X + async b/e), so slicing spans by `limit` would let a push
+        # carry 3x the events its transport cap was sized for.  Newest
+        # spans win; the first span is always taken so a tiny limit
+        # still makes progress.
+        window = spans[-min(new_n, len(spans)):]
+        selected: list = []
+        used = 0
+        for s in reversed(window):
+            n_ev = 3 if (s[5] and "trace" in s[5]) else 1
+            if selected and used + n_ev > limit:
+                break
+            selected.append(s)
+            used += n_ev
+            if used >= limit:
+                break
         events = [
-            self._event(s) for s in spans[-min(new_n, limit, len(spans)):]
+            ev for s in reversed(selected) for ev in self._expand(s)
         ]
         events.sort(key=lambda e: e["ts"])
         return events, total
@@ -206,14 +318,78 @@ class TraceRecorder:
         among themselves)."""
         if n <= 0:
             return []
-        events = [self._event(s) for s in list(self._spans)[-n:]]
+        events = [
+            ev for s in list(self._spans)[-n:] for ev in self._expand(s)
+        ]
         events.sort(key=lambda e: e["ts"])
         return events
 
-    def to_chrome_trace(self) -> dict:
+    def _flow_events(self, spans: list) -> list:
+        """Flow ``s``/``f`` arrow pairs binding each causally-linked
+        child slice to its parent slice (both ends must be in `spans`;
+        a parent evicted by ring wrap simply draws no arrow)."""
+        by_id = {}
+        for s in spans:
+            args = s[5]
+            if args and "span" in args:
+                by_id[args["span"]] = s
+        out = []
+        for s in spans:
+            args = s[5]
+            parent_id = args.get("parent") if args else None
+            p = by_id.get(parent_id) if parent_id is not None else None
+            if p is None:
+                continue
+            # the "s" end must land INSIDE the parent slice: clamp the
+            # child's start into the parent's interval
+            ts = min(max(s[2], p[2]), p[2] + p[3]) * 1e6
+            fid = f"{args['trace']:x}.{args['span']:x}"
+            out.append({"name": "link", "cat": "request", "ph": "s",
+                        "id": fid, "ts": round(ts, 3),
+                        "pid": self._pid, "tid": p[4]})
+            out.append({"name": "link", "cat": "request", "ph": "f",
+                        "bp": "e", "id": fid,
+                        "ts": round(s[2] * 1e6, 3),
+                        "pid": self._pid, "tid": s[4]})
+        return out
+
+    def trace_chain(self, trace_id: int) -> list:
+        """All recorded spans of one causal trace, t0-sorted: dicts with
+        ``name``/``cat``/``t0``/``dur`` (perf_counter seconds)/``tid``/
+        ``span``/``parent``/``args``.  The programmatic view behind the
+        slow-request exemplars and the span-ledger tests."""
+        out = []
+        for s in list(self._spans):
+            name, cat, t0, dur, tid, args = s
+            if not args or args.get("trace") != trace_id:
+                continue
+            extra = {k: v for k, v in args.items()
+                     if k not in ("trace", "span", "parent")}
+            out.append({
+                "name": name, "cat": cat, "t0": t0, "dur": dur,
+                "tid": tid, "span": args.get("span"),
+                "parent": args.get("parent"), "args": extra,
+            })
+        out.sort(key=lambda s: s["t0"])
+        return out
+
+    def to_chrome_trace(self, limit: Optional[int] = None,
+                        name: Optional[str] = None) -> dict:
         """Chrome trace-event JSON object (the Perfetto-loadable schema:
-        phase "X" complete events, microsecond timestamps)."""
-        events = [self._event(s) for s in list(self._spans)]
+        phase "X" complete events, microsecond timestamps; linked spans
+        additionally emit async lanes and flow arrows).  ``limit`` keeps
+        only the newest N spans, ``name`` substring-filters span names —
+        the mid-incident escape hatches for a big ring
+        (``GET /api/trace?limit=&name=``)."""
+        spans = list(self._spans)
+        total = len(spans)
+        if name:
+            spans = [s for s in spans if name in s[0]]
+        if limit is not None and limit >= 0:
+            # spans[-0:] is the WHOLE list — limit=0 must mean zero
+            spans = spans[-limit:] if limit > 0 else []
+        events = [ev for s in spans for ev in self._expand(s)]
+        events.extend(self._flow_events(spans))
         events.sort(key=lambda e: e["ts"])
         return {
             "traceEvents": events,
@@ -224,6 +400,8 @@ class TraceRecorder:
                 "spans_dropped": self.spans_dropped,
                 "capacity": self.capacity,
                 "pid": self._pid,
+                "spans_total": total,
+                "spans_selected": len(spans),
             },
         }
 

@@ -76,11 +76,22 @@ class ServingError(RuntimeError):
 
 class PendingRequest:
     """One admitted request: features (per-input tuple, NO batch dim),
-    deadline, and a completion event the client thread waits on."""
+    deadline, and a completion event the client thread waits on.
+
+    Request-level observability riders (filled by the server as the
+    request moves — cheap dict/float writes, no locks): ``trace_id`` /
+    ``root_span`` link the request's spans into one causal chain when
+    tracing is on; ``t0_pc`` / ``t_enq_pc`` are perf_counter marks the
+    latency attribution derives its segments from; ``lat`` accumulates
+    the per-request breakdown (queue_wait / batch_form / pad_overhead /
+    dispatch seconds) that feeds the histogram families and the
+    slow-request exemplars."""
 
     __slots__ = ("features", "fmask", "signature", "t_admit", "deadline",
                  "seq", "_event", "_result", "_error", "cancelled",
-                 "orig_len", "padded_len")
+                 "orig_len", "padded_len",
+                 "trace_id", "root_span", "root_parent", "t0_pc",
+                 "t_enq_pc", "lat")
 
     def __init__(self, features: tuple, signature: tuple,
                  deadline: float, fmask=None, seq: int = 0,
@@ -101,6 +112,12 @@ class PendingRequest:
         self._result = None
         self._error: Optional[BaseException] = None
         self.cancelled = False            # client gave up waiting
+        # request-level observability (see class docstring)
+        self.trace_id: Optional[int] = None
+        self.root_span: Optional[int] = None
+        self.root_parent: Optional[int] = None   # a router try's span id
+        self.t0_pc = self.t_enq_pc = time.perf_counter()
+        self.lat: dict = {}
 
     # -- completion (batcher side) ----------------------------------------
     def complete(self, result) -> None:

@@ -16,9 +16,13 @@ server answers fast with a reason, never hangs the socket:
                     -> 409 rolled_back (verification failed; old params
                            keep serving)
   GET  /healthz     -> 200 serving | 503 breaker open (load balancers
-                       pull the replica while it probes recovery)
+                       pull the replica while it probes recovery);
+                       carries the SLO summary (alerting objectives +
+                       fast-window burn) when an `observe.slo` engine
+                       is installed
   GET  /v1/status   -> 200 stats JSON (queue depth, p50/p99, breaker,
-                       swap generation, shed counts)
+                       swap generation, shed counts, per-request
+                       latency_breakdown, slo state)
 
 Multi-input graphs POST ``{"inputs": [[...], [...]]}`` — one nested
 array per network input.  Features arrive as ONE example (no batch
@@ -41,6 +45,23 @@ from deeplearning4j_tpu.serving.admission import (
 )
 
 log = logging.getLogger("deeplearning4j_tpu")
+
+
+def _slo_summary():
+    """The active SLO engine's compact summary (None when no engine is
+    installed — plain replicas pay nothing).  /healthz is a routing
+    decision point, so the engine is SAMPLED on read — the burn rates a
+    load balancer sees must be current even if nothing is scraping
+    /metrics on this replica."""
+    from deeplearning4j_tpu.observe.slo import sample_active_summary
+
+    return sample_active_summary()
+
+
+def _slo_state():
+    from deeplearning4j_tpu.observe.slo import sample_active_state
+
+    return sample_active_state()
 
 
 class ServingHTTPServer:
@@ -77,12 +98,19 @@ class ServingHTTPServer:
                     # this replica BEFORE it starts shedding
                     health = outer.server.health()
                     health["breaker"] = health["breaker_state"]
+                    slo = _slo_summary()
+                    if slo is not None:
+                        health["slo"] = slo
                     self._json(
                         health,
                         503 if health["status"] == "breaker_open" else 200,
                     )
                 elif u.path == "/v1/status":
-                    self._json(outer.server.stats())
+                    stats = outer.server.stats()
+                    slo = _slo_state()
+                    if slo is not None:
+                        stats["slo"] = slo
+                    self._json(stats)
                 else:
                     self._json({"error": "not found"}, 404)
 

@@ -36,6 +36,16 @@ telemetry spine as
 ``dl4jtpu_router_requests_total{replica,outcome}``, and a registry
 collector refreshes ``dl4jtpu_router_replica_pressure{replica}`` at
 scrape time so the fleet scrape carries per-replica headroom.
+
+Request-level observability (ISSUE 13): with tracing enabled, a routed
+request emits ONE causal chain rooted at ``router.request`` — each try
+is a ``router.try`` span (args: replica, outcome), the hedge a
+``router.hedge`` span, and every replica-side chain (admit -> queue
+wait -> batch form -> dispatch) parents under the try that submitted
+it, so Perfetto shows the request hopping replicas.  Always-on (no
+tracing needed): ``dl4jtpu_router_overhead_seconds`` observes, per
+successful request, client wall MINUS the winning try's service time —
+the retry + hedge + pick tax the front door added.
 """
 
 from __future__ import annotations
@@ -47,6 +57,7 @@ import time
 import weakref
 from typing import Optional
 
+from deeplearning4j_tpu.observe import trace as otrace
 from deeplearning4j_tpu.runtime import faults
 from deeplearning4j_tpu.serving.admission import (
     ServingError, ServingRejected, ServingTimeout,
@@ -133,10 +144,11 @@ class ReplicaHandle:
     def pressure(self) -> float:
         return float(self.health().get("shed_pressure", 1.0))
 
-    def submit(self, features, deadline_s: float):
+    def submit(self, features, deadline_s: float, trace_ctx=None):
         if self.dead:
             raise ServingRejected("replica_dead", self.name)
-        return self.server.submit(features, deadline_s=deadline_s)
+        return self.server.submit(features, deadline_s=deadline_s,
+                                  trace_ctx=trace_ctx)
 
 
 class Router:
@@ -168,6 +180,7 @@ class Router:
             "retries": 0, "hedges": 0, "ejections": 0, "readmissions": 0,
         }
         self._rr = 0                    # tie-break rotation
+        self._rec = otrace.tracer()     # cached: no lock per request
         _register_router(self)
 
     # -- routing state ------------------------------------------------------
@@ -338,11 +351,19 @@ class Router:
         deadline_s = (self.config.default_deadline_s
                       if deadline_s is None else float(deadline_s))
         deadline = time.monotonic() + deadline_s
+        t_req0 = time.monotonic()
+        t0_pc = time.perf_counter()
+        # one causal chain per routed request: every try/hedge span and
+        # every replica-side chain parents under this root
+        trace_id = root_span = None
+        if self._rec.enabled:
+            trace_id, root_span = otrace.next_id(), otrace.next_id()
         with self._lock:
             self._counts["requests"] += 1
         budget = int(self.config.retry_budget)
         tried: set[str] = set()
         original: Optional[BaseException] = None
+        retries = 0
         while True:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
@@ -365,9 +386,19 @@ class Router:
                 break
             tried.add(handle.name)
             try:
-                out = self._try_one(handle, probe, features, remaining)
+                out, service_s = self._try_one(
+                    handle, probe, features, remaining,
+                    trace_id, root_span,
+                )
                 with self._lock:
                     self._counts["ok"] += 1
+                # retry + hedge + pick tax: the client's wall minus the
+                # winning try's own service time (always-on attribution)
+                _observe_overhead(
+                    max(0.0, (time.monotonic() - t_req0) - service_s)
+                )
+                self._trace_root(trace_id, root_span, t0_pc, "ok",
+                                 retries=retries)
                 return out
             except (ServingRejected, ServingTimeout, ServingError) as exc:
                 if original is None:
@@ -377,6 +408,7 @@ class Router:
                 if budget <= 0:
                     break
                 budget -= 1
+                retries += 1
                 with self._lock:
                     self._counts["retries"] += 1
                 _count_retry()
@@ -388,6 +420,8 @@ class Router:
                 # must always balance
                 with self._lock:
                     self._counts["client_errors"] += 1
+                self._trace_root(trace_id, root_span, t0_pc,
+                                 "client_error", retries=retries)
                 raise
         with self._lock:
             self._counts["failed"] += 1
@@ -396,6 +430,9 @@ class Router:
                 f"request deadline {deadline_s:.3f}s expired before any "
                 "replica could be tried"
             )
+        self._trace_root(trace_id, root_span, t0_pc, "failed",
+                         retries=retries,
+                         error=type(original).__name__)
         raise original
 
     # ``submit`` would hand back a PendingRequest pinned to ONE replica,
@@ -431,10 +468,15 @@ class Router:
         # the remaining-time check in the loop settles which.
         return isinstance(exc, (ServingError, ServingTimeout))
 
-    def _try_one(self, handle, probe: bool, features, remaining: float):
+    def _try_one(self, handle, probe: bool, features, remaining: float,
+                 trace_id: Optional[int] = None,
+                 root_span: Optional[int] = None):
         """One routed try against `handle`, with the optional hedge.
-        Returns the result or raises; ALWAYS records the try's outcome
-        on the replica's routing state."""
+        Returns ``(result, service_s)`` — the winning dispatch's own
+        wall, for the router-overhead attribution — or raises; ALWAYS
+        records the try's outcome on the replica's routing state.  With
+        tracing on, the try (and hedge) each get a span under the
+        request root, and the replica-side chain parents under it."""
         cap = remaining
         # a timeout only counts as a WEDGE strike when the router's own
         # per-try cap was the binding constraint — a client deadline
@@ -443,13 +485,23 @@ class Router:
                  and self.config.try_timeout_s < remaining)
         if self.config.try_timeout_s is not None:
             cap = min(cap, self.config.try_timeout_s)
+        t_try0 = time.monotonic()
+        # ids allocated BEFORE the submit: the replica-side spans must
+        # be able to parent under the try while it is still in flight
+        tinfo = None
+        if trace_id is not None and self._rec.enabled:
+            tinfo = _TryTrace(trace_id, otrace.next_id(), root_span,
+                              "router.try", handle.name,
+                              time.perf_counter())
         try:
-            req = handle.submit(features, deadline_s=cap)
+            req = handle.submit(features, deadline_s=cap,
+                                trace_ctx=tinfo.ctx if tinfo else None)
         except ServingRejected as exc:
             self._record(
                 handle, "dead" if exc.reason == "replica_dead"
                 else "rejected", probe,
             )
+            self._trace_try(tinfo, "rejected", reason=exc.reason)
             raise
         except BaseException:
             # a NON-serving failure (e.g. wrong input arity raising
@@ -460,24 +512,37 @@ class Router:
             # be probed again
             if probe:
                 self._release_probe(handle)
+            self._trace_try(tinfo, "client_error")
             raise
         hedge_after = self.config.hedge_after_s
         if (hedge_after is None or hedge_after >= cap
                 or len(self.replicas) < 2):
-            return self._resolve(handle, probe, req, cap, wedge)
+            return (self._resolve(handle, probe, req, cap, wedge, tinfo),
+                    time.monotonic() - t_try0)
         if req._event.wait(min(hedge_after, cap)):
-            return self._resolve(handle, probe, req, 0.0, wedge)
+            return (self._resolve(handle, probe, req, 0.0, wedge, tinfo),
+                    time.monotonic() - t_try0)
         # latency tail: ONE duplicate on a different replica
         try:
             alt, alt_probe = self._pick(frozenset((handle.name,)))
         except ServingRejected:
-            return self._resolve(handle, probe, req, cap, wedge)
+            return (self._resolve(handle, probe, req, cap, wedge, tinfo),
+                    time.monotonic() - t_try0)
         t_left = cap - min(hedge_after, cap)
+        hinfo = None
+        if tinfo is not None:
+            hinfo = _TryTrace(trace_id, otrace.next_id(), root_span,
+                              "router.hedge", alt.name,
+                              time.perf_counter())
+        t_hedge0 = time.monotonic()
         try:
-            hreq = alt.submit(features, deadline_s=max(t_left, 0.001))
-        except ServingRejected:
+            hreq = alt.submit(features, deadline_s=max(t_left, 0.001),
+                              trace_ctx=hinfo.ctx if hinfo else None)
+        except ServingRejected as exc:
             self._record(alt, "rejected", alt_probe)
-            return self._resolve(handle, probe, req, cap, wedge)
+            self._trace_try(hinfo, "rejected", reason=exc.reason)
+            return (self._resolve(handle, probe, req, cap, wedge, tinfo),
+                    time.monotonic() - t_try0)
         with self._lock:
             self._counts["hedges"] += 1
         _count_hedge()
@@ -495,24 +560,29 @@ class Router:
         else:
             winner, wprobe, loser, lprobe = handle, probe, alt, alt_probe
             wreq, lreq = req, hreq
+        winfo, linfo = (tinfo, hinfo) if wreq is req else (hinfo, tinfo)
+        w_t0 = t_try0 if wreq is req else t_hedge0
+        l_t0 = t_hedge0 if wreq is req else t_try0
         try:
-            out = self._resolve(winner, wprobe, wreq, 0.0, wedge)
+            out = self._resolve(winner, wprobe, wreq, 0.0, wedge, winfo)
         except (ServingRejected, ServingTimeout, ServingError):
             # the faster completion FAILED: the slower duplicate is the
             # request's remaining hope — await it for the time left.
             # Only the PRIMARY had the full per-try cap by now; the
             # hedge only got the residual window, so a timeout there
             # must not count as a wedge strike against it
-            return self._resolve(loser, lprobe, lreq,
-                                 end - time.monotonic(),
-                                 wedge and loser is handle)
+            return (self._resolve(loser, lprobe, lreq,
+                                  end - time.monotonic(),
+                                  wedge and loser is handle, linfo),
+                    time.monotonic() - l_t0)
         # dedup: the slower duplicate is DISCARDED — cancelled so the
         # losing replica counts it (timeout) and its ledger balances,
         # and its routing state is left untouched (it did nothing wrong)
         lreq.cancelled = True
         if lprobe:
             self._release_probe(loser)
-        return out
+        self._trace_try(linfo, "discarded")
+        return out, time.monotonic() - w_t0
 
     def _release_probe(self, handle) -> None:
         """Free a probe slot whose try resolved without a recordable
@@ -521,14 +591,15 @@ class Router:
             self._state[handle.name]["probe_inflight"] = False
 
     def _resolve(self, handle, probe: bool, req, timeout: float,
-                 wedge: bool = False):
+                 wedge: bool = False, tinfo=None):
         """Await one try's PendingRequest and record the outcome.
         `wedge` = the per-try cap (not the client deadline) bounds this
         wait, so a timeout indicts the replica."""
         try:
             out = req.result(timeout=max(timeout, 0.0))
-        except ServingRejected:
+        except ServingRejected as exc:
             self._record(handle, "rejected", probe)
+            self._trace_try(tinfo, "rejected", reason=exc.reason)
             raise
         except ServingTimeout:
             # wedge detector: the per-try deadline fired — the replica
@@ -536,15 +607,86 @@ class Router:
             # deadline expiring is recorded WITHOUT a failure strike.
             self._record(handle, "timeout" if wedge else "client_timeout",
                          probe)
+            self._trace_try(tinfo, "timeout")
             raise
         except ServingError:
             self._record(handle, "error", probe)
+            self._trace_try(tinfo, "error")
             raise
         self._record(handle, "ok", probe)
+        self._trace_try(tinfo, "ok")
         return out
+
+    # -- request-trace helpers ---------------------------------------------
+    def _trace_root(self, trace_id: Optional[int],
+                    root_span: Optional[int], t0_pc: float, outcome: str,
+                    **args) -> None:
+        if trace_id is None or not self._rec.enabled:
+            return
+        self._rec.add_complete(
+            "router.request", t0_pc, time.perf_counter() - t0_pc,
+            cat="request",
+            **otrace.trace_args(trace_id, root_span),
+            router=self.name, outcome=outcome, **args,
+        )
+
+    def _trace_try(self, tinfo: Optional["_TryTrace"], outcome: str,
+                   **args) -> None:
+        """Close one try/hedge span (no-op when the request is
+        untraced).  Recorded ONCE, at the try's terminal outcome."""
+        if tinfo is None or not self._rec.enabled:
+            return
+        self._rec.add_complete(
+            tinfo.name, tinfo.t0_pc, time.perf_counter() - tinfo.t0_pc,
+            cat="request",
+            **otrace.trace_args(tinfo.trace_id, tinfo.span_id,
+                                tinfo.parent),
+            replica=tinfo.replica, outcome=outcome, **args,
+        )
+
+
+class _TryTrace:
+    """Span bookkeeping for one routed try/hedge: ids allocated before
+    the submit so the replica-side chain can parent under it."""
+
+    __slots__ = ("trace_id", "span_id", "parent", "name", "replica",
+                 "t0_pc")
+
+    def __init__(self, trace_id: int, span_id: int, parent: Optional[int],
+                 name: str, replica: str, t0_pc: float):
+        self.trace_id = trace_id
+        self.span_id = span_id
+        self.parent = parent
+        self.name = name
+        self.replica = replica
+        self.t0_pc = t0_pc
+
+    @property
+    def ctx(self) -> tuple:
+        return (self.trace_id, self.span_id)
 
 
 # -- telemetry helpers (never on the request's critical path) ---------------
+
+_OVERHEAD_HIST = None
+
+
+def _observe_overhead(secs: float) -> None:
+    """Per successful routed request — the family is resolved once
+    (like server.py's `_breakdown_families`): the front door's hot path
+    must not pay a registry lock + lookup per request."""
+    global _OVERHEAD_HIST
+    try:
+        if _OVERHEAD_HIST is None:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            _OVERHEAD_HIST = registry().histogram(
+                "dl4jtpu_router_overhead_seconds"
+            )
+        _OVERHEAD_HIST.observe(secs)
+    except Exception as e:
+        log.debug("router overhead metric failed: %s", e)
+
 
 def _count_try(router: str, replica: str, outcome: str) -> None:
     try:

@@ -26,6 +26,24 @@ Every signal lands on the telemetry spine (`observe/metrics`): latency
 histogram (p50/p99 via buckets), queue depth, batch occupancy,
 shed/breaker/hot-swap counters — scraped at `/metrics`, pushed to the
 fleet endpoints by `FleetReporter` like any other worker metric.
+
+Request-level observability (ISSUE 13): every admitted request carries
+a per-request latency breakdown — queue_wait (enqueue -> batch taken,
+linger included), batch_form (taken -> dispatch entered), dispatch
+(stack + snapshot + device call + screen) and pad_overhead (the
+dispatch share spent on padding rows) — observed into dedicated
+histogram families and summed into `stats()`'s breakdown view.  With
+tracing enabled each request additionally emits a causally-linked span
+chain (`observe/trace`: trace/span/parent ids, async request lanes in
+Perfetto): ``serving.request`` (root) -> ``serving.admit`` ->
+``serving.queue_wait`` -> ``serving.batch_form`` ->
+``serving.dispatch`` — across the client, batcher and (on wedge) the
+watchdog monitor thread, and parented under a router try span when the
+request arrived through the fleet front door (``trace_ctx``).  The
+slowest completed requests are kept in a bounded exemplar ring
+(`slow_requests()`, served at ``GET /api/serving/slow``) with their
+breakdown and full span chain — the mid-incident "where did THAT
+request's time go" answer.
 """
 
 from __future__ import annotations
@@ -40,6 +58,7 @@ from typing import Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observe import trace as otrace
 from deeplearning4j_tpu.runtime import faults
 from deeplearning4j_tpu.serving import batching
 from deeplearning4j_tpu.serving.admission import (
@@ -51,6 +70,37 @@ from deeplearning4j_tpu.serving.hotswap import (
 )
 
 log = logging.getLogger("deeplearning4j_tpu")
+
+#: slowest-request exemplars kept per server (bounded: the ring must
+#: stay readable mid-incident, not become a second unbounded queue)
+SLOW_RING_CAP = 16
+
+_BREAKDOWN_FAMILIES = None
+
+
+def _breakdown_families():
+    """(queue_wait, batch_form, dispatch, pad_overhead histograms,
+    batch-examples counter), resolved once — per-request attribution
+    must not pay registry lookups/locks."""
+    global _BREAKDOWN_FAMILIES
+    if _BREAKDOWN_FAMILIES is None:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        reg = registry()
+        _BREAKDOWN_FAMILIES = (
+            reg.histogram("dl4jtpu_serving_queue_wait_seconds"),
+            reg.histogram("dl4jtpu_serving_batch_form_seconds"),
+            reg.histogram("dl4jtpu_serving_dispatch_seconds"),
+            reg.histogram("dl4jtpu_serving_pad_overhead_seconds"),
+            reg.counter("dl4jtpu_serving_batch_examples_total"),
+        )
+    return _BREAKDOWN_FAMILIES
+
+
+#: the per-request latency segments, in chain order (the breakdown dict
+#: keys, the histogram families and the docs all share this vocabulary)
+BREAKDOWN_SEGMENTS = ("queue_wait", "batch_form", "dispatch",
+                      "pad_overhead")
 
 
 @dataclasses.dataclass
@@ -112,6 +162,13 @@ class InferenceServer:
         self._stats_lock = threading.Lock()
         self._batch_ewma: Optional[float] = None
         self._latencies: deque = deque(maxlen=4096)   # recent request secs
+        # request-level attribution: running segment totals (stats()'s
+        # breakdown view) + the bounded slowest-request exemplar ring
+        self._lat_totals: dict[str, float] = {
+            k: 0.0 for k in BREAKDOWN_SEGMENTS
+        }
+        self._slow: list[dict] = []        # latency-desc, <= SLOW_RING_CAP
+        self._rec = otrace.tracer()        # cached: no lock per request
         self._counts: dict[str, int] = {
             "admitted": 0, "completed": 0, "errors": 0, "timeouts": 0,
             "shed": 0, "batches": 0, "wedged_batches": 0,
@@ -178,13 +235,18 @@ class InferenceServer:
 
     # -- admission ---------------------------------------------------------
     def submit(self, features, deadline_s: Optional[float] = None,
-               features_mask=None) -> PendingRequest:
+               features_mask=None, trace_ctx=None) -> PendingRequest:
         """Admit ONE example (no batch dim; a tuple of arrays for
         multi-input graphs).  Returns a `PendingRequest` whose
         ``result()`` blocks until completion or the deadline.  Raises
         `ServingRejected` synchronously when the request cannot be
         admitted — queue full, breaker open, or the deadline is already
-        unmeetable at the current queue depth."""
+        unmeetable at the current queue depth.
+
+        ``trace_ctx``: optional ``(trace_id, parent_span_id)`` from an
+        upstream hop (the router's try span) — the request's span chain
+        joins that trace instead of starting a fresh one."""
+        t0_pc = time.perf_counter()
         try:
             action = faults.maybe_fail("serving.admit")
         except Exception as exc:
@@ -205,7 +267,8 @@ class InferenceServer:
                 f"circuit breaker is {self.breaker.state}",
             )
         try:
-            return self._admit(features, deadline_s, features_mask)
+            req = self._admit(features, deadline_s, features_mask,
+                              t0_pc=t0_pc, trace_ctx=trace_ctx)
         except BaseException:
             # admits() may have consumed the HALF_OPEN probe slot; a
             # rejection on the way to the queue (deadline shed, queue
@@ -213,8 +276,25 @@ class InferenceServer:
             # release it or the breaker waits forever on a dead probe
             self.breaker.probe_reset()
             raise
+        self._trace_admitted(req, t0_pc)
+        return req
 
-    def _admit(self, features, deadline_s, features_mask) -> PendingRequest:
+    def _trace_admitted(self, req: PendingRequest, t0_pc: float) -> None:
+        """Record the ``serving.admit`` span (submit entry -> enqueued).
+        The ids were allocated in `_admit` BEFORE the offer — a batcher
+        taking the request immediately must already see them.  The root
+        span itself is recorded at completion, when its duration is
+        known."""
+        if req.trace_id is None or not self._rec.enabled:
+            return
+        self._rec.add_complete(
+            "serving.admit", t0_pc, req.t_enq_pc - t0_pc, cat="request",
+            **otrace.trace_args(req.trace_id, otrace.next_id(),
+                                req.root_span),
+        )
+
+    def _admit(self, features, deadline_s, features_mask,
+               t0_pc=None, trace_ctx=None) -> PendingRequest:
         feats = self._as_feature_tuple(features)
         deadline_s = (self.config.default_deadline_s
                       if deadline_s is None else float(deadline_s))
@@ -241,24 +321,45 @@ class InferenceServer:
         # this one completes after ~floor(depth / max_batch) + 1
         # dispatches (the +1 is its own batch); if that (times a safety
         # factor) already exceeds its deadline, it would only burn a
-        # batch slot to time out in — reject now
-        est = self._estimated_wait(self.queue.depth)
-        if est is not None and est > deadline_s:
+        # batch slot to time out in — reject now.  NEVER at depth 0: an
+        # empty queue means this request dispatches in the very next
+        # batch, and dispatching it is the ONLY way the latency EWMA can
+        # refresh — a compile-tainted cold sample would otherwise shed
+        # every future request at admit, freeze the estimate, and take
+        # the replica out of the fleet forever (the cold-replica
+        # deadlock; regression-tested in test_serving_trace.py)
+        depth = self.queue.depth
+        est = self._estimated_wait(depth)
+        if depth > 0 and est is not None and est > deadline_s:
             self._count_shed("deadline")
             raise ServingRejected(
                 "deadline",
                 f"estimated wait {est:.3f}s exceeds deadline "
-                f"{deadline_s:.3f}s at queue depth {self.queue.depth}",
+                f"{deadline_s:.3f}s at queue depth {depth}",
             )
         req = PendingRequest(
             feats, sig, time.monotonic() + deadline_s, fmask=fmask,
             orig_len=orig_len, padded_len=padded_len,
         )
+        if t0_pc is not None:
+            req.t0_pc = t0_pc
+        # causal ids BEFORE the offer: a batcher can take the request
+        # the instant it lands in the queue, and its queue_wait/dispatch
+        # segments must already see the chain ids — allocating after the
+        # offer dropped segments (or forged a second root) under a fast
+        # batcher
+        if self._rec.enabled:
+            if trace_ctx is not None:
+                req.trace_id, req.root_parent = trace_ctx
+            else:
+                req.trace_id = otrace.next_id()
+            req.root_span = otrace.next_id()
         if not self.queue.offer(req):
             self._count_shed("queue_full")
             raise ServingRejected(
                 "queue_full", f"admission queue at {self.queue.max_queue}"
             )
+        req.t_enq_pc = time.perf_counter()
         with self._stats_lock:
             self._counts["admitted"] += 1
         self._gauge_depth()
@@ -290,8 +391,13 @@ class InferenceServer:
     def _estimated_wait(self, depth: int) -> Optional[float]:
         with self._stats_lock:
             ewma = self._batch_ewma
-        if ewma is None:
-            return None                  # no sample yet: admit optimistically
+        if ewma is None or ewma <= 0.0:
+            # no sample yet — OR a coarse clock measured a 0.0s batch
+            # (possible on Windows-resolution monotonic clocks): both
+            # mean "no usable latency signal", so admit optimistically
+            # instead of advertising a certain zero wait (the cold-start
+            # degenerate ISSUE 13 clamps)
+            return None
         dispatches = depth // self.config.max_batch + 1
         return self.config.admit_safety * ewma * dispatches
 
@@ -308,18 +414,25 @@ class InferenceServer:
             reqs = self.queue.take_batch(
                 self.config.max_batch, self.config.linger_s, self._stop,
             )
+            t_taken_pc = time.perf_counter()
             self._gauge_depth()
             if not reqs:
                 continue
             live = []
             now = time.monotonic()
             for r in reqs:
+                # queue_wait closes for every taken request — linger
+                # included — whatever its fate next
+                r.lat["queue_wait"] = t_taken_pc - r.t_enq_pc
+                self._trace_segment(r, "serving.queue_wait", r.t_enq_pc,
+                                    t_taken_pc - r.t_enq_pc)
                 if r.cancelled:
                     # the client already timed out waiting; counting it
                     # keeps "admitted == completed+errors+timeouts+shed"
                     with self._stats_lock:
                         self._counts["timeouts"] += 1
                     self._count_outcome("timeout")
+                    self._trace_finish(r, "timeout")
                 elif r.deadline <= now:
                     # backstop shed: admitted when it looked meetable,
                     # doomed by the time a slot opened — reject
@@ -332,14 +445,23 @@ class InferenceServer:
                 # waiting on a probe that will never dispatch
                 self.breaker.probe_reset()
                 continue
-            self._dispatch(live)
+            self._dispatch(live, t_taken_pc)
 
-    def _dispatch(self, reqs: list[PendingRequest]) -> None:
+    def _dispatch(self, reqs: list[PendingRequest],
+                  t_taken_pc: Optional[float] = None) -> None:
         bucket = batching.batch_bucket(len(reqs), self.config.max_batch)
+        t_form_pc = time.perf_counter()
+        if t_taken_pc is not None:
+            for r in reqs:
+                r.lat["batch_form"] = t_form_pc - t_taken_pc
+                self._trace_segment(r, "serving.batch_form", t_taken_pc,
+                                    t_form_pc - t_taken_pc,
+                                    batch=len(reqs), bucket=bucket)
         with self._inflight_lock:
             self._dispatch_token += 1
             token = self._dispatch_token
-            self._inflight = {"token": token, "reqs": reqs}
+            self._inflight = {"token": token, "reqs": reqs,
+                              "t0_pc": t_form_pc, "bucket": bucket}
         t0 = time.monotonic()
         try:
             outs = self._run_program(reqs, bucket, token)
@@ -355,7 +477,42 @@ class InferenceServer:
         is armed across the device call under `token` — the one
         _dispatch allocated, NOT a re-read of the counter (a concurrent
         warm_start() also draws from it, and a desynced owner would
-        leave one of the two device calls deadline-less)."""
+        leave one of the two device calls deadline-less).  The dispatch
+        latency segment is recorded here iff this call still OWNED the
+        watchdog at disarm — a wedge-abandoned thread's eventual return
+        must not double-record a batch the monitor thread already
+        accounted."""
+        t_d_pc = time.perf_counter()
+        err_name = None
+        try:
+            return self._run_program_inner(reqs, bucket, token)
+        except BaseException as exc:
+            err_name = type(exc).__name__
+            raise
+        finally:
+            if self._claim_trace(token):
+                self._note_dispatch(
+                    reqs, t_d_pc, time.perf_counter() - t_d_pc, bucket,
+                    err_name,
+                )
+
+    def _claim_trace(self, token: int) -> bool:
+        """Consume the ONE dispatch-segment record for `token`'s batch.
+        True while the batch is still the live inflight one AND nobody
+        recorded it yet — the flag is consumed under the lock, so a
+        dispatch returning at the same instant the watchdog aborts can
+        never double-record the segment (the monitor side checks the
+        same flag on the inflight dict it pops)."""
+        with self._inflight_lock:
+            if (self._inflight is None
+                    or self._inflight["token"] != token
+                    or self._inflight.get("trace_done")):
+                return False
+            self._inflight["trace_done"] = True
+            return True
+
+    def _run_program_inner(self, reqs: list[PendingRequest], bucket: int,
+                           token: int):
         cols = batching.stack_batch(
             [r.features for r in reqs], self.n_inputs, bucket,
         )
@@ -457,14 +614,20 @@ class InferenceServer:
             self._last_occupancy = len(reqs) / bucket
             for r in reqs:
                 self._latencies.append(now - r.t_admit)
+                for k in BREAKDOWN_SEGMENTS:
+                    self._lat_totals[k] += r.lat.get(k, 0.0)
         for i, r in enumerate(reqs):
             result = tuple(
                 self._slice_sequence(rows[j][i], r)
                 for j in range(len(rows))
             )
             r.complete(result if len(result) > 1 else result[0])
-            self._observe_latency(now - r.t_admit)
+            lat = now - r.t_admit
+            self._observe_latency(lat)
+            self._observe_breakdown(r)
             self._count_outcome("ok")
+            self._trace_finish(r, "ok")
+            self._note_slow(r, "ok", lat)
         self._gauge_batch(len(reqs), bucket)
 
     @staticmethod
@@ -490,9 +653,12 @@ class InferenceServer:
         )
         with self._stats_lock:
             self._counts["errors"] += len(reqs)
+        now = time.monotonic()
         for r in reqs:
             r.fail(err)
             self._count_outcome("error")
+            self._trace_finish(r, "error", error=type(exc).__name__)
+            self._note_slow(r, "error", now - r.t_admit)
 
     def _claim_inflight(self, token: int) -> bool:
         with self._inflight_lock:
@@ -500,6 +666,90 @@ class InferenceServer:
                 return False
             self._inflight = None
             return True
+
+    # -- request-level attribution (trace spans + breakdown) ---------------
+    def _trace_segment(self, req: PendingRequest, name: str, t0_pc: float,
+                       dur: float, **args) -> None:
+        """One linked latency segment of `req`'s chain (no-op unless
+        tracing is on AND the request was admitted while it was on)."""
+        if req.trace_id is None or not self._rec.enabled:
+            return
+        self._rec.add_complete(
+            name, t0_pc, dur, cat="request",
+            **otrace.trace_args(req.trace_id, otrace.next_id(),
+                                req.root_span),
+            **args,
+        )
+
+    def _note_dispatch(self, reqs: list[PendingRequest], t0_pc: float,
+                       dur: float, bucket: int,
+                       err_name: Optional[str]) -> None:
+        """Close the dispatch segment for every request of one batch:
+        the shared wall (stack + weights snapshot + device call +
+        finiteness screen) plus each request's pad-overhead share —
+        dispatch x (bucket - real) / bucket, the compute the padding
+        rows burned on its behalf."""
+        pad_frac = (bucket - len(reqs)) / bucket if bucket else 0.0
+        extra = {"bucket": bucket, "batch": len(reqs)}
+        if err_name is not None:
+            extra["error"] = err_name
+        for r in reqs:
+            r.lat["dispatch"] = dur
+            r.lat["pad_overhead"] = dur * pad_frac
+            self._trace_segment(r, "serving.dispatch", t0_pc, dur, **extra)
+
+    def _trace_finish(self, req: PendingRequest, outcome: str,
+                      **args) -> None:
+        """Record the request's ROOT span (admit -> now) — the chain's
+        umbrella every segment parents under.  Called exactly once per
+        admitted request, on whichever thread settles its fate."""
+        if req.trace_id is None or not self._rec.enabled:
+            return
+        self._rec.add_complete(
+            "serving.request", req.t0_pc,
+            time.perf_counter() - req.t0_pc, cat="request",
+            **otrace.trace_args(req.trace_id, req.root_span,
+                                req.root_parent),
+            outcome=outcome, **args,
+        )
+
+    def _note_slow(self, req: PendingRequest, outcome: str,
+                   latency_s: float) -> None:
+        """Offer one finished request to the slowest-request exemplar
+        ring (bounded, latency-descending).  Caller holds nothing; the
+        ring is under the stats lock."""
+        entry = {
+            "trace": (f"{req.trace_id:x}" if req.trace_id is not None
+                      else None),
+            "trace_id": req.trace_id,
+            "outcome": outcome,
+            "latency_s": round(latency_s, 6),
+            "t_wall": time.time(),
+            "breakdown_s": {k: round(v, 6) for k, v in req.lat.items()},
+        }
+        with self._stats_lock:
+            slow = self._slow
+            if len(slow) >= SLOW_RING_CAP and \
+                    latency_s <= slow[-1]["latency_s"]:
+                return
+            slow.append(entry)
+            slow.sort(key=lambda e: -e["latency_s"])
+            del slow[SLOW_RING_CAP:]
+
+    def slow_requests(self, spans: bool = True) -> list[dict]:
+        """The slowest-request exemplars (latency-descending), each with
+        its breakdown and — when tracing is on and the spans are still
+        in the ring — its full causal span chain.  Served at
+        ``GET /api/serving/slow``."""
+        with self._stats_lock:
+            out = [dict(e) for e in self._slow]
+        if spans and self._rec.enabled:
+            for e in out:
+                if e["trace_id"] is not None:
+                    e["spans"] = self._rec.trace_chain(e["trace_id"])
+        for e in out:
+            e.pop("trace_id", None)
+        return out
 
     def _on_wedged(self, event: dict) -> None:
         """Watchdog abort stage (monitor thread): the dispatch blew
@@ -527,9 +777,25 @@ class InferenceServer:
         with self._stats_lock:
             self._counts["wedged_batches"] += 1
             self._counts["errors"] += len(inflight["reqs"])
+        # the wedged thread never reached its dispatch-segment record
+        # (and will be denied it by the inflight pop above): close each
+        # request's chain HERE on the monitor thread — an aborted
+        # request still yields one complete, causally-linked trace.
+        # Unless the dispatch thread won the race and already consumed
+        # the record (trace_done) — the segment is recorded exactly once
+        if not inflight.get("trace_done"):
+            t0_pc = inflight.get("t0_pc", time.perf_counter())
+            dur_pc = time.perf_counter() - t0_pc
+            self._note_dispatch(
+                inflight["reqs"], t0_pc, dur_pc,
+                inflight.get("bucket", len(inflight["reqs"])), "Wedged",
+            )
+        now = time.monotonic()
         for r in inflight["reqs"]:
             r.fail(err)
             self._count_outcome("error")
+            self._trace_finish(r, "error", error="wedged")
+            self._note_slow(r, "wedged", now - r.t_admit)
         # the wedged call may NEVER return: abandon its (daemon) thread
         # and hand the queue to a fresh batcher, or the server would be
         # pinned — no dispatches, no breaker probe, no recovery
@@ -544,8 +810,10 @@ class InferenceServer:
             target=self._batcher_loop, args=(gen,),
             name="dl4jtpu-serving", daemon=True,
         )
-        self._thread = t
+        # start BEFORE publishing: a stop() racing the respawn must
+        # never join() a thread that was assigned but not yet started
         t.start()
+        self._thread = t
 
     # -- weight hot-swap ---------------------------------------------------
     def push_weights(self, params, net_state=None,
@@ -704,7 +972,13 @@ class InferenceServer:
           ``default_deadline_s`` — exactly the quantity `_admit` sheds
           on, so pressure ≈ 1 precisely when deadline sheds begin);
         - breaker state (open = 1.0: everything is rejected; half-open
-          = 0.75: only the single probe gets through)."""
+          = 0.75: only the single probe gets through).
+
+        Cold start (no batch-latency sample yet, or a coarse clock
+        measured 0.0): the latency term is simply absent — the queue
+        fraction still reports real backlog, and `_admit` guarantees a
+        depth-0 request always dispatches, so the estimate can never
+        freeze a replica out of the fleet (ISSUE 13 regression)."""
         depth = self.queue.depth
         q = depth / self.config.max_queue
         lat = 0.0
@@ -739,12 +1013,29 @@ class InferenceServer:
             counts = dict(self._counts)
             ewma = self._batch_ewma
             occupancy = self._last_occupancy
+            totals = dict(self._lat_totals)
+            slow_n = len(self._slow)
 
         def pct(p: float):
             if not lats:
                 return None
             return lats[min(len(lats) - 1, int(p * len(lats)))]
 
+        # the request-time decomposition (docs/serving.md): cumulative
+        # seconds per segment over completed requests, plus the same as
+        # fractions — "where does a served request's time go" straight
+        # off /v1/status.  pad_overhead is an OVERLAY (a share of the
+        # dispatch segment, not a sibling): it stays out of the
+        # denominator so queue_wait/batch_form/dispatch partition to 1
+        # and its own fraction reads as "share of request wall time"
+        seg_sum = sum(v for k, v in totals.items() if k != "pad_overhead")
+        breakdown = {
+            "seconds_total": {k: round(v, 6) for k, v in totals.items()},
+            "fraction": (
+                {k: round(v / seg_sum, 4) for k, v in totals.items()}
+                if seg_sum > 0 else None
+            ),
+        }
         return {
             "queue_depth": self.queue.depth,
             "generation": self.generation,
@@ -757,6 +1048,8 @@ class InferenceServer:
             "p99_s": pct(0.99),
             "breaker": self.breaker.stats(),
             "warmed_programs": len(self.warmed_signatures),
+            "latency_breakdown": breakdown,
+            "slow_exemplars": slow_n,
             **counts,
         }
 
@@ -769,6 +1062,7 @@ class InferenceServer:
     def _shed(self, req: PendingRequest, reason: str) -> None:
         req.fail(ServingRejected(reason))
         self._count_shed(reason)
+        self._trace_finish(req, "shed", reason=reason)
 
     def _count_shed(self, reason: str) -> None:
         with self._stats_lock:
@@ -812,6 +1106,24 @@ class InferenceServer:
         except Exception as e:
             log.debug("serving latency metric failed: %s", e)
 
+    def _observe_breakdown(self, req: PendingRequest) -> None:
+        """Per-request latency attribution into the histogram families
+        (completed requests only: a failed dispatch's wall says nothing
+        about where a SERVED request's time goes)."""
+        try:
+            queue_h, form_h, disp_h, pad_h, _ = _breakdown_families()
+            lat = req.lat
+            if "queue_wait" in lat:
+                queue_h.observe(lat["queue_wait"])
+            if "batch_form" in lat:
+                form_h.observe(lat["batch_form"])
+            if "dispatch" in lat:
+                disp_h.observe(lat["dispatch"])
+            if "pad_overhead" in lat:
+                pad_h.observe(lat["pad_overhead"])
+        except Exception as e:
+            log.debug("serving breakdown metric failed: %s", e)
+
     def _gauge_depth(self) -> None:
         try:
             from deeplearning4j_tpu.observe.metrics import registry
@@ -829,6 +1141,10 @@ class InferenceServer:
             reg = registry()
             reg.counter("dl4jtpu_serving_batches_total").inc()
             reg.gauge("dl4jtpu_serving_batch_occupancy").set(real / bucket)
+            examples = _breakdown_families()[4]
+            examples.inc(real, kind="real")
+            if bucket > real:
+                examples.inc(bucket - real, kind="pad")
         except Exception as e:
             log.debug("serving batch metric failed: %s", e)
 

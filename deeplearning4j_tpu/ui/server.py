@@ -11,11 +11,13 @@ charts, auto-refresh, JSON API.
     print(server.url)                     # http://127.0.0.1:<port>/
 
 JSON API: /api/sessions, /api/stats?session=<id>, /api/trace (Chrome
-trace-event JSON of the step-timeline ring buffer), /api/programs (the
-compiled-program registry with XLA cost analysis + roofline),
-/api/trace/cluster (merged per-worker cluster timeline), /api/serving
-(live inference servers: queue depth, p50/p99, breaker, swap
-generation).  Scrape API:
+trace-event JSON of the step-timeline ring buffer; ?limit= and ?name=
+filter it), /api/programs (the compiled-program registry with XLA cost
+analysis + roofline), /api/trace/cluster (merged per-worker cluster
+timeline), /api/serving (live inference servers: queue depth, p50/p99,
+breaker, swap generation), /api/serving/slow (slowest-request
+exemplars with latency breakdown + span chains), /api/slo (SLO
+burn-rate state, local + pushed workers).  Scrape API:
 /metrics (Prometheus text exposition of the process-global
 `observe.metrics` registry — compile taxes, ETL wait, cache hits, step
 latency histogram, health counters, device memory) and /metrics/cluster
@@ -289,10 +291,23 @@ class UIServer:
                     self._text(registry().to_prometheus_text())
                 elif u.path == "/api/trace":
                     # the step-timeline ring buffer as Chrome trace-event
-                    # JSON — save the response and load it in Perfetto
+                    # JSON — save the response and load it in Perfetto.
+                    # ?limit=N keeps only the newest N spans and
+                    # ?name=substr filters span names: the mid-incident
+                    # escape hatches — a 16k-span ring dumped whole is
+                    # unusable exactly when you need it
                     from deeplearning4j_tpu.observe.trace import tracer
 
-                    self._json(tracer().to_chrome_trace())
+                    q = parse_qs(u.query)
+                    try:
+                        limit = (int(q["limit"][0]) if "limit" in q
+                                 else None)
+                    except ValueError:
+                        limit = None
+                    self._json(tracer().to_chrome_trace(
+                        limit=limit,
+                        name=q.get("name", [None])[0],
+                    ))
                 elif u.path == "/api/programs":
                     # the compiled-program registry: per-program compile
                     # tax, XLA flops/bytes, roofline class.  ?analyze=0
@@ -320,6 +335,53 @@ class UIServer:
                     from deeplearning4j_tpu.serving import active_routers
 
                     self._json([r.stats() for r in active_routers()])
+                elif u.path == "/api/serving/slow":
+                    # the slowest-request exemplars across every live
+                    # server in this process: per-request latency
+                    # breakdown + full causal span chain (tracing on) —
+                    # "where did THAT request's time go", mid-incident.
+                    # Chains (a full ring scan each) are attached only
+                    # to the rows that SURVIVE the sort+limit — not to
+                    # every exemplar of every server
+                    from deeplearning4j_tpu.observe.trace import tracer
+                    from deeplearning4j_tpu.serving import active_servers
+
+                    q = parse_qs(u.query)
+                    try:
+                        limit = int(q.get("limit", ["10"])[0])
+                    except ValueError:
+                        limit = 10
+                    rows = []
+                    for s in active_servers():
+                        rows.extend(s.slow_requests(spans=False))
+                    rows.sort(key=lambda r: -r["latency_s"])
+                    rows = rows[:limit]
+                    t = tracer()
+                    if t.enabled:
+                        for r in rows:
+                            if r.get("trace"):
+                                r["spans"] = t.trace_chain(
+                                    int(r["trace"], 16)
+                                )
+                    self._json(rows)
+                elif u.path == "/api/slo":
+                    # SLO burn-rate state: the local engine's view plus
+                    # (on a coordinator) every pushed worker's burn
+                    # rates — "are we meeting the objective right now",
+                    # fleet-wide.  SAMPLED on read, like /healthz and
+                    # /v1/status: the answer must be current even when
+                    # nothing is scraping this process's /metrics
+                    from deeplearning4j_tpu.observe import fleet
+                    from deeplearning4j_tpu.observe.slo import (
+                        sample_active_state,
+                    )
+
+                    agg = fleet.active_aggregator()
+                    self._json({
+                        "local": sample_active_state(),
+                        "workers": (agg.slo_view()
+                                    if agg is not None else {}),
+                    })
                 elif u.path == "/metrics/cluster":
                     # merged fleet exposition: every pushed worker's
                     # families re-labeled worker="...", plus the fleet

@@ -322,6 +322,22 @@ class TestTier1Gate:
             "dl4jtpu_canary_failures_total",
             "dl4jtpu_router_replica_pressure",
         } <= fams
+        # ISSUE-13 request-attribution / SLO / meta-observability families
+        assert {
+            "dl4jtpu_serving_queue_wait_seconds",
+            "dl4jtpu_serving_batch_form_seconds",
+            "dl4jtpu_serving_dispatch_seconds",
+            "dl4jtpu_serving_pad_overhead_seconds",
+            "dl4jtpu_serving_batch_examples_total",
+            "dl4jtpu_router_overhead_seconds",
+            "dl4jtpu_slo_burn_rate",
+            "dl4jtpu_slo_error_budget_remaining",
+            "dl4jtpu_slo_alert_active",
+            "dl4jtpu_slo_alerts_total",
+            "dl4jtpu_scrape_seconds",
+            "dl4jtpu_registry_families",
+            "dl4jtpu_registry_series",
+        } <= fams
         sites = load_fault_sites(REPO)
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",
@@ -330,7 +346,9 @@ class TestTier1Gate:
             "serving.admit", "serving.infer", "serving.hotswap",
             "serving.route", "serving.canary",
         }
-        assert {"slow", "faults", "serving"} <= load_declared_marks(REPO)
+        assert {"slow", "faults", "serving", "slo"} <= load_declared_marks(
+            REPO
+        )
 
 
 # -- CLI ---------------------------------------------------------------

@@ -26,7 +26,7 @@ def test_serving_bench_quick_run_and_schema():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert out["schema"] == "bench-serving/1"
+    assert out["schema"] == "bench-serving/2"
     assert out["platform"] == "cpu"
     assert out["env"]["jax"]
     for row in out["curve"]:
@@ -58,6 +58,19 @@ def test_serving_bench_quick_run_and_schema():
     assert chaos["p99_post_ratio"] is not None
     stages = [s for s, _ in chaos["watchdog_events"]]
     assert "abort" in stages           # per-batch deadline escalated
+    # ISSUE 13 trace/SLO columns (the tier-1 gate the CI satellite
+    # asks for): the chaos-plan request (one retry + one hedge) lands
+    # in ONE causal trace covering >= 95% of the client wall, and the
+    # induced overload fires then clears the fast-window burn alert
+    tr = out["request_trace"]
+    assert tr["trace_ids"] == 1
+    assert tr["causal"]
+    assert tr["coverage"] is not None and tr["coverage"] >= 0.95
+    assert tr["retries"] >= 1 and tr["hedges"] >= 1
+    assert tr["span_names"]["router.request"] == 1
+    slo = out["slo"]
+    assert slo["alert_fired"] and slo["alert_cleared"]
+    assert slo["alerts_total"] >= 1
 
 
 def test_serving_fleet_bench_quick_run_and_schema():
@@ -127,14 +140,18 @@ def test_committed_serving_fleet_table_meets_acceptance():
 
 def test_committed_serving_table_meets_acceptance():
     """The COMMITTED BENCH_SERVING.json (full, non-quick run) carries
-    the ISSUE 11 acceptance: chaos completed, p99 back within 2x after
+    the ISSUE 11 acceptance (chaos completed, p99 back within 2x after
     injection stops, warm-started first request within 1.5x of
-    steady-state."""
+    steady-state) AND the ISSUE 13 acceptance (a chaos-plan request
+    with one retry + one hedge yields a single causally-linked trace
+    covering >= 95% of the client-observed latency; an induced
+    overload fires the fast-window SLO burn alert within its window
+    and clears after recovery)."""
     path = os.path.join(REPO, "BENCH_SERVING.json")
     assert os.path.exists(path), "BENCH_SERVING.json not committed"
     with open(path) as f:
         doc = json.load(f)
-    assert doc["schema"] == "bench-serving/1"
+    assert doc["schema"] == "bench-serving/2"
     assert not doc["quick"]
     assert len(doc["curve"]) >= 4
     chaos = doc["chaos"]
@@ -144,3 +161,12 @@ def test_committed_serving_table_meets_acceptance():
     assert chaos["hotswap_rolled_back"] and chaos["hotswap_installed_after"]
     assert chaos["p99_post_ratio"] <= 2.0
     assert doc["warm_start"]["first_request_ratio"] <= 1.5
+    # ISSUE 13: request-level tracing + SLO burn-rate acceptance
+    tr = doc["request_trace"]
+    assert tr["trace_ids"] == 1
+    assert tr["causal"]
+    assert tr["coverage"] >= 0.95
+    assert tr["retries"] >= 1 and tr["hedges"] >= 1
+    slo = doc["slo"]
+    assert slo["alert_fired"] and slo["fired_within_fast_window"]
+    assert slo["alert_cleared"]

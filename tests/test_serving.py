@@ -194,6 +194,11 @@ class TestAdmission:
         srv = _server()                   # not started: nothing dispatches
         with srv._stats_lock:
             srv._batch_ewma = 1.0         # "batches take a second"
+        # a request must be WAITING: at depth 0 admission is
+        # unconditional (dispatching is the only way the EWMA can
+        # refresh — the ISSUE 13 cold-replica clamp), so the shed
+        # estimate only gates requests that would queue behind others
+        srv.submit(_x(9), deadline_s=60.0)
         with pytest.raises(ServingRejected) as ei:
             srv.submit(_x(0), deadline_s=0.05)
         assert ei.value.reason == "deadline"

@@ -1,0 +1,489 @@
+"""Request-level tracing & latency attribution (ISSUE 13): one admitted
+request = one causally-linked span chain — across the client, batcher,
+router and watchdog threads, through retries, hedges and aborts — plus
+the per-request latency breakdown, the slowest-request exemplars and
+the cold-start admission clamp."""
+
+import json
+import time
+import urllib.request
+from collections import Counter
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.models import SequentialModel
+from deeplearning4j_tpu.nn.conf import (
+    Dense,
+    InputType,
+    NeuralNetConfiguration,
+    OutputLayer,
+)
+from deeplearning4j_tpu.observe import (
+    chain_coverage,
+    chain_is_causal,
+    registry,
+    tracer,
+)
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.serving import (
+    InferenceServer,
+    RouterConfig,
+    ServingConfig,
+    ServingError,
+    ServingFleet,
+    ServingRejected,
+)
+from deeplearning4j_tpu.serving.server import BREAKDOWN_SEGMENTS
+
+pytestmark = pytest.mark.serving
+
+N_IN, N_OUT = 6, 4
+
+
+def _conf(seed=7):
+    return (
+        NeuralNetConfiguration.builder().seed(seed).list()
+        .layer(Dense(n_out=8)).layer(OutputLayer(n_out=N_OUT))
+        .set_input_type(InputType.feed_forward(N_IN)).build()
+    )
+
+
+def _model(seed=7):
+    return SequentialModel(_conf(seed)).init()
+
+
+def _server(model=None, **kw):
+    kw.setdefault("max_batch", 4)
+    kw.setdefault("linger_s", 0.002)
+    return InferenceServer(model or _model(), ServingConfig(**kw))
+
+
+def _fleet(n=2, **router_kw):
+    router_kw.setdefault("retry_budget", 2)
+    return ServingFleet(
+        lambda: _model(), n_replicas=n,
+        config=ServingConfig(max_batch=4, linger_s=0.002),
+        router_config=RouterConfig(**router_kw),
+    )
+
+
+def _x(seed=0):
+    return np.random.default_rng(seed).normal(size=(N_IN,)).astype(
+        np.float32
+    )
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(autouse=True)
+def _crash_dir(tmp_path, monkeypatch):
+    monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path / "crash"))
+
+
+@pytest.fixture()
+def rec():
+    r = tracer()
+    r.enable()
+    r.clear()
+    yield r
+    r.disable()
+    r.clear()
+
+
+def _chains(r):
+    """{trace_id: chain} for every causal trace in the ring."""
+    ids = {s[5]["trace"] for s in list(r._spans) if s[5] and "trace" in s[5]}
+    return {tid: r.trace_chain(tid) for tid in ids}
+
+
+def _settle(r, timeout=2.0):
+    """Wait until the span ring stops growing (in-flight batches —
+    e.g. a discarded hedge loser — finish recording)."""
+    deadline = time.time() + timeout
+    prev = -1
+    while time.time() < deadline:
+        cur = r.appended_total()
+        if cur == prev:
+            return
+        prev = cur
+        time.sleep(0.05)
+
+
+# -- one request, one chain --------------------------------------------------
+
+
+class TestSingleServerChain:
+    def test_served_request_yields_complete_causal_chain(self, rec):
+        srv = _server().start()
+        try:
+            srv.infer(_x(0), deadline_s=10.0)
+        finally:
+            srv.stop()
+        chains = _chains(rec)
+        assert len(chains) == 1
+        chain = next(iter(chains.values()))
+        names = Counter(s["name"] for s in chain)
+        # the exact span ledger of a served request: root + 4 segments
+        assert names == Counter({
+            "serving.request": 1, "serving.admit": 1,
+            "serving.queue_wait": 1, "serving.batch_form": 1,
+            "serving.dispatch": 1,
+        })
+        assert chain_is_causal(chain)
+        root = [s for s in chain if s["parent"] is None][0]
+        assert root["name"] == "serving.request"
+        assert root["args"]["outcome"] == "ok"
+
+    def test_breakdown_histograms_and_request_lat_observed(self, rec):
+        reg = registry()
+        fams = {
+            k: reg.histogram(f"dl4jtpu_serving_{k}_seconds")
+            for k in BREAKDOWN_SEGMENTS
+        }
+        before = {k: h.count for k, h in fams.items()}
+        srv = _server().start()
+        try:
+            req = srv.submit(_x(0), deadline_s=10.0)
+            req.result()
+        finally:
+            srv.stop()
+        for k, h in fams.items():
+            assert h.count == before[k] + 1, k
+        # the request object carries the same decomposition
+        assert set(BREAKDOWN_SEGMENTS) <= set(req.lat)
+        assert all(v >= 0 for v in req.lat.values())
+        # stats() exposes the running totals + fractions
+        bd = srv.stats()["latency_breakdown"]
+        assert set(bd["seconds_total"]) == set(BREAKDOWN_SEGMENTS)
+        assert bd["fraction"] is not None
+        # pad_overhead is an overlay of dispatch, NOT a partition
+        # member: the chain segments alone must sum to 1
+        chain_frac = sum(v for k, v in bd["fraction"].items()
+                         if k != "pad_overhead")
+        assert abs(chain_frac - 1.0) < 0.01
+
+    def test_pad_overhead_and_batch_examples_attribution(self, rec):
+        reg = registry()
+        examples = reg.counter("dl4jtpu_serving_batch_examples_total")
+        real0 = examples.value(kind="real")
+        pad0 = examples.value(kind="pad")
+        srv = _server(max_batch=4, linger_s=0.2).start()
+        try:
+            # three concurrent requests coalesce -> bucket 4, one pad row
+            reqs = [srv.submit(_x(i), deadline_s=10.0) for i in range(3)]
+            outs = [r.result() for r in reqs]
+        finally:
+            srv.stop()
+        assert all(np.isfinite(np.asarray(o)).all() for o in outs)
+        assert examples.value(kind="real") == real0 + 3
+        assert examples.value(kind="pad") == pad0 + 1
+        # each request was charged dispatch x 1/4 of pad overhead
+        for r in reqs:
+            assert r.lat["pad_overhead"] == pytest.approx(
+                r.lat["dispatch"] * 0.25
+            )
+
+    def test_untraced_requests_still_get_breakdown(self):
+        assert not tracer().enabled
+        srv = _server().start()
+        try:
+            req = srv.submit(_x(0), deadline_s=10.0)
+            req.result()
+        finally:
+            srv.stop()
+        assert set(BREAKDOWN_SEGMENTS) <= set(req.lat)
+        assert req.trace_id is None     # no span ids burned
+
+
+# -- failure paths keep the chain complete -----------------------------------
+
+
+class TestFailurePathChains:
+    @pytest.mark.faults
+    def test_retried_request_is_one_complete_trace(self, rec):
+        fleet = _fleet(2, hedge_after_s=None)
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            faults.arm("serving.infer:raise:nth=1")
+            out = fleet.infer(_x(0), deadline_s=10.0)
+        finally:
+            fleet.stop()
+        assert np.isfinite(np.asarray(out)).all()
+        _settle(rec)
+        chains = _chains(rec)
+        assert len(chains) == 1          # ONE trace across both replicas
+        chain = next(iter(chains.values()))
+        assert chain_is_causal(chain)
+        names = Counter(s["name"] for s in chain)
+        # 1 root + 2 tries + 2 full replica chains (failed + served):
+        # the ledger balances, no orphan spans
+        assert names == Counter({
+            "router.request": 1, "router.try": 2,
+            "serving.request": 2, "serving.admit": 2,
+            "serving.queue_wait": 2, "serving.batch_form": 2,
+            "serving.dispatch": 2,
+        })
+        outcomes = sorted(
+            s["args"]["outcome"] for s in chain
+            if s["name"] == "router.try"
+        )
+        assert outcomes == ["error", "ok"]
+        assert fleet.router.stats()["retries"] == 1
+
+    @pytest.mark.faults
+    def test_hedged_request_is_one_complete_trace(self, rec):
+        fleet = _fleet(2, hedge_after_s=0.05)
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            faults.arm("serving.infer:delay:nth=1,secs=0.3")
+            out = fleet.infer(_x(0), deadline_s=10.0)
+            _settle(rec)      # the slow primary finishes after the hedge
+        finally:
+            fleet.stop()
+        assert np.isfinite(np.asarray(out)).all()
+        chains = _chains(rec)
+        assert len(chains) == 1
+        chain = next(iter(chains.values()))
+        assert chain_is_causal(chain)
+        names = Counter(s["name"] for s in chain)
+        assert names["router.hedge"] == 1
+        assert names["router.try"] == 1
+        assert names["serving.request"] == 2     # primary + hedge chains
+        assert names["serving.dispatch"] == 2
+        # the discarded loser recorded its span explicitly
+        discarded = [s for s in chain
+                     if s["args"].get("outcome") == "discarded"]
+        assert len(discarded) == 1
+        assert fleet.router.stats()["hedges"] == 1
+
+    @pytest.mark.faults
+    def test_watchdog_aborted_request_chain_closes(self, rec):
+        """A wedged dispatch is failed by the MONITOR thread; the wedged
+        worker thread never returns in time — the request's chain must
+        still close (dispatch span with error=Wedged, root with
+        outcome=error), with no orphan spans."""
+        srv = _server(breaker_threshold=3).start()
+        try:
+            srv.infer(_x(0), deadline_s=10.0)      # warm the program
+            rec.clear()
+            srv.config.dispatch_timeout_s = 0.05
+            srv._watchdog.floor_s = 0.05
+            faults.arm("serving.infer:delay:nth=1,secs=0.5")
+            with pytest.raises(ServingError) as ei:
+                srv.infer(_x(1), deadline_s=10.0)
+            assert "wedged" in str(ei.value)
+            faults.disarm()
+        finally:
+            srv.config.dispatch_timeout_s = 10.0
+            srv._watchdog.floor_s = 10.0
+            srv.stop()
+        chains = _chains(rec)
+        assert len(chains) == 1
+        chain = next(iter(chains.values()))
+        assert chain_is_causal(chain)
+        names = Counter(s["name"] for s in chain)
+        assert names == Counter({
+            "serving.request": 1, "serving.admit": 1,
+            "serving.queue_wait": 1, "serving.batch_form": 1,
+            "serving.dispatch": 1,
+        })
+        disp = [s for s in chain if s["name"] == "serving.dispatch"][0]
+        assert disp["args"]["error"] == "Wedged"
+        root = [s for s in chain if s["parent"] is None][0]
+        assert root["args"]["outcome"] == "error"
+        # the exemplar ring caught it with its breakdown
+        slow = srv.slow_requests()
+        assert any(e["outcome"] == "wedged" for e in slow)
+
+    @pytest.mark.faults
+    def test_acceptance_chaos_plan_single_trace_covers_95pct(self, rec):
+        """ISSUE 13 acceptance: a chaos-plan request (one retry + one
+        hedge) produces a SINGLE causally-linked trace whose spans
+        account for >= 95% of the client-observed latency."""
+        fleet = _fleet(2, retry_budget=2, hedge_after_s=0.05)
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            # try 1 raises (-> counted retry), try 2 is slowed past
+            # hedge_after (-> one hedge), the hedge wins
+            faults.arm("serving.infer:raise:nth=1;"
+                       "serving.infer:delay:nth=2,secs=0.2")
+            t0 = time.monotonic()
+            out = fleet.infer(_x(0), deadline_s=10.0)
+            client_wall = time.monotonic() - t0
+            faults.disarm()
+            _settle(rec)
+        finally:
+            fleet.stop()
+        assert np.isfinite(np.asarray(out)).all()
+        rstats = fleet.router.stats()
+        assert rstats["retries"] >= 1 and rstats["hedges"] >= 1
+        chains = _chains(rec)
+        assert len(chains) == 1                      # a SINGLE trace
+        chain = next(iter(chains.values()))
+        assert chain_is_causal(chain)                # no orphan spans
+        # ledger: 1 root + 2 tries + 1 hedge + 3 replica chains x 5
+        assert len(chain) == 19
+        root = [s for s in chain if s["parent"] is None][0]
+        # the root span IS the client-observed latency (same call)...
+        assert root["dur"] == pytest.approx(client_wall, rel=0.25)
+        # ...and its children account for >= 95% of it
+        assert chain_coverage(chain) >= 0.95
+
+    def test_router_overhead_histogram_observes(self, rec):
+        reg = registry()
+        h = reg.histogram("dl4jtpu_router_overhead_seconds")
+        before = h.count
+        fleet = _fleet(2)
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        fleet.start()
+        try:
+            fleet.infer(_x(0), deadline_s=10.0)
+        finally:
+            fleet.stop()
+        assert h.count == before + 1
+
+
+# -- slow-request exemplars + endpoints --------------------------------------
+
+
+class TestSlowRequestExemplars:
+    def test_ring_is_bounded_and_latency_descending(self, rec):
+        from deeplearning4j_tpu.serving.server import SLOW_RING_CAP
+
+        srv = _server().start()
+        try:
+            for i in range(SLOW_RING_CAP + 8):
+                srv.infer(_x(i), deadline_s=10.0)
+        finally:
+            srv.stop()
+        slow = srv.slow_requests()
+        assert 0 < len(slow) <= SLOW_RING_CAP
+        lats = [e["latency_s"] for e in slow]
+        assert lats == sorted(lats, reverse=True)
+        top = slow[0]
+        assert set(BREAKDOWN_SEGMENTS) <= set(top["breakdown_s"])
+        # tracing was on: the exemplar carries its full span chain
+        assert "spans" in top and len(top["spans"]) == 5
+
+    def test_api_serving_slow_endpoint(self, rec):
+        import gc
+
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        # /api/serving/slow aggregates EVERY live server in the process
+        # (a WeakSet): drop earlier tests' dead servers so their
+        # untraced exemplars cannot outrank ours
+        gc.collect()
+        srv = _server().start()
+        ui = UIServer(port=0)
+        try:
+            for i in range(3):
+                srv.infer(_x(i), deadline_s=10.0)
+            with urllib.request.urlopen(
+                ui.url + "api/serving/slow?limit=2"
+            ) as r:
+                rows = json.loads(r.read())
+            assert 0 < len(rows) <= 2
+            assert rows[0]["latency_s"] >= rows[-1]["latency_s"]
+            assert "breakdown_s" in rows[0]
+            assert "spans" in rows[0]
+        finally:
+            srv.stop()
+            ui.stop()
+
+    def test_api_trace_limit_and_name_filters(self, rec):
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        srv = _server().start()
+        ui = UIServer(port=0)
+        try:
+            for i in range(4):
+                srv.infer(_x(i), deadline_s=10.0)
+            with urllib.request.urlopen(
+                ui.url + "api/trace?name=serving.dispatch"
+            ) as r:
+                doc = json.loads(r.read())
+            xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+            assert xs and all(
+                e["name"] == "serving.dispatch" for e in xs
+            )
+            assert doc["metadata"]["spans_selected"] < \
+                doc["metadata"]["spans_total"]
+            with urllib.request.urlopen(
+                ui.url + "api/trace?limit=2"
+            ) as r:
+                doc = json.loads(r.read())
+            assert doc["metadata"]["spans_selected"] == 2
+            # limit=0 means ZERO spans, not the whole ring
+            with urllib.request.urlopen(
+                ui.url + "api/trace?limit=0"
+            ) as r:
+                doc = json.loads(r.read())
+            assert doc["metadata"]["spans_selected"] == 0
+            assert doc["traceEvents"] == []
+        finally:
+            srv.stop()
+            ui.stop()
+
+
+# -- cold-start admission clamp (ISSUE 13 bugfix) ----------------------------
+
+
+class TestColdStartClamp:
+    def test_zero_ewma_is_no_signal_not_zero_wait(self):
+        """A coarse clock can feed the EWMA an exact 0.0 — that must
+        read as 'no latency signal' (admit optimistically), never as a
+        confident zero-wait estimate."""
+        srv = _server()
+        with srv._stats_lock:
+            srv._batch_ewma = 0.0
+        assert srv._estimated_wait(100) is None
+        p = srv.shed_pressure()
+        assert 0.0 <= p <= 1.0
+
+    def test_depth_zero_request_always_admits(self):
+        """The cold-replica deadlock: one compile-tainted slow batch
+        seeds a huge EWMA; if deadline sheds then fired at depth 0, no
+        request would ever dispatch again and the EWMA could never
+        refresh — the replica would be frozen out of the fleet."""
+        srv = _server()
+        with srv._stats_lock:
+            srv._batch_ewma = 50.0        # compile-tainted first sample
+        # empty queue: MUST admit despite the hopeless-looking estimate
+        req = srv.submit(_x(0), deadline_s=0.5)
+        assert not req.done
+        # with backlog, the shed estimate applies as before
+        with pytest.raises(ServingRejected) as ei:
+            srv.submit(_x(1), deadline_s=0.5)
+        assert ei.value.reason == "deadline"
+        srv.stop()
+
+    def test_cold_fleet_boot_serves_through_poisoned_ewma(self):
+        """Router + poisoned replica at boot: the depth-0 admit lets a
+        trickle through, the EWMA refreshes down, and the fleet keeps
+        serving — no misroute into a permanent no_replicas outage."""
+        fleet = _fleet(2, retry_budget=1)
+        fleet.warm_start(np.zeros((N_IN,), np.float32))
+        for srv in fleet.replicas:
+            with srv._stats_lock:
+                srv._batch_ewma = 50.0    # every replica looks hopeless
+        fleet.start()
+        try:
+            out = fleet.infer(_x(0), deadline_s=5.0)
+            assert np.isfinite(np.asarray(out)).all()
+            # the dispatched batch refreshed at least one replica's EWMA
+            ewmas = []
+            for srv in fleet.replicas:
+                with srv._stats_lock:
+                    ewmas.append(srv._batch_ewma)
+            assert min(ewmas) < 50.0
+        finally:
+            fleet.stop()

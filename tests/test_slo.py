@@ -1,0 +1,327 @@
+"""SLO burn-rate engine (ISSUE 13): declarative objectives over the
+MetricsRegistry, multi-window burn alerting with an injectable clock,
+gauge exposition, the /healthz + /v1/status + /api/slo joins, the fleet
+push, and the scrape's meta-observability."""
+
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.observe.metrics import MetricsRegistry, registry
+from deeplearning4j_tpu.observe.slo import (
+    BurnWindow,
+    SLObjective,
+    SLOEngine,
+    active_engine,
+)
+
+pytestmark = pytest.mark.slo
+
+WINDOWS = (BurnWindow(10.0, 10.0), BurnWindow(60.0, 2.0))
+
+
+class FakeClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+def _engine(reg, objectives=None, windows=WINDOWS):
+    clock = FakeClock()
+    eng = SLOEngine(
+        objectives or [SLObjective.availability("avail", target=0.99,
+                                                family="t_requests_total")],
+        windows=windows, clock=clock, registry=reg,
+    )
+    return eng, clock
+
+
+# -- objective declaration ---------------------------------------------------
+
+
+class TestObjectives:
+    def test_target_must_be_a_fraction(self):
+        with pytest.raises(ValueError):
+            SLObjective.availability("bad", target=99.9)
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            SLObjective(name="x", target=0.9, kind="throughput")
+
+    def test_duplicate_names_rejected(self):
+        o = SLObjective.availability("a", target=0.9)
+        with pytest.raises(ValueError):
+            SLOEngine([o, o])
+
+    def test_budget_is_one_minus_target(self):
+        assert SLObjective.availability("a", target=0.999).budget == \
+            pytest.approx(0.001)
+
+
+# -- burn-rate evaluation ----------------------------------------------------
+
+
+class TestBurnRates:
+    def test_healthy_traffic_burns_zero(self):
+        reg = MetricsRegistry()
+        c = reg.counter("t_requests_total")
+        eng, clock = _engine(reg)
+        for t in range(0, 70, 5):
+            clock.t = float(t)
+            c.inc(100, outcome="ok")
+            st = eng.sample()["avail"]
+        assert st["burn"] == {"10s": 0.0, "60s": 0.0}
+        assert not st["alert"]
+        assert st["budget_remaining"] == 1.0
+
+    def test_zero_traffic_burns_zero(self):
+        reg = MetricsRegistry()
+        reg.counter("t_requests_total")
+        eng, clock = _engine(reg)
+        for t in (0.0, 30.0, 120.0):
+            clock.t = t
+            st = eng.sample()["avail"]
+        assert st["burn"] == {"10s": 0.0, "60s": 0.0}
+        assert not st["alert"]
+
+    def test_overload_fires_within_fast_window_and_clears(self):
+        """The acceptance shape: induced overload -> the fast-window
+        alert fires within one fast window; recovery -> it clears
+        within one fast window (not one SLOW window)."""
+        reg = MetricsRegistry()
+        c = reg.counter("t_requests_total")
+        eng, clock = _engine(reg)
+        for t in range(0, 60, 5):                  # healthy baseline
+            clock.t = float(t)
+            c.inc(100, outcome="ok")
+            eng.sample()
+        fired_at = None
+        for t in range(60, 120, 2):                # 50% errors
+            clock.t = float(t)
+            c.inc(50, outcome="ok")
+            c.inc(50, outcome="error")
+            if eng.sample()["avail"]["alert"] and fired_at is None:
+                fired_at = t
+        assert fired_at is not None
+        assert fired_at - 60 <= WINDOWS[0].seconds     # within fast window
+        cleared_at = None
+        for t in range(120, 200, 2):               # recovery
+            clock.t = float(t)
+            c.inc(100, outcome="ok")
+            if not eng.sample()["avail"]["alert"] and cleared_at is None:
+                cleared_at = t
+        assert cleared_at is not None
+        assert cleared_at - 120 <= WINDOWS[0].seconds + 2
+        st = eng.state()["avail"]
+        assert st["alerts_total"] == 1             # one rising edge
+        assert st["budget_remaining"] < 0          # budget was blown
+
+    def test_short_blip_does_not_page(self):
+        """The slow window is the blip filter: a burst shorter than its
+        threshold share must not fire the multi-window alert."""
+        reg = MetricsRegistry()
+        c = reg.counter("t_requests_total")
+        eng, clock = _engine(
+            reg, windows=(BurnWindow(10.0, 5.0), BurnWindow(300.0, 30.0)),
+        )
+        for t in range(0, 300, 5):
+            clock.t = float(t)
+            if t == 150:                            # one bad tick
+                c.inc(10, outcome="error")
+            c.inc(100, outcome="ok")
+            st = eng.sample()["avail"]
+            assert not st["alert"], f"paged on a blip at t={t}"
+        assert st["alerts_total"] == 0
+
+    def test_latency_objective_reads_histogram_buckets(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t_latency_seconds", buckets=(0.1, 0.25, 1.0))
+        eng, clock = _engine(reg, objectives=[
+            SLObjective.latency("lat", target=0.9, threshold_s=0.25,
+                                family="t_latency_seconds"),
+        ])
+        eng.sample()                                # empty baseline
+        for _ in range(90):
+            h.observe(0.05)                         # good
+        for _ in range(10):
+            h.observe(0.5)                          # bad
+        clock.t = 5.0
+        st = eng.sample()["lat"]
+        assert st["good"] == 90 and st["bad"] == 10
+        # 10% bad over a 10% budget = burn exactly 1.0
+        assert st["burn"]["10s"] == pytest.approx(1.0)
+
+    def test_count_le_and_sum_series_primitives(self):
+        reg = MetricsRegistry()
+        h = reg.histogram("t_h_seconds", buckets=(0.1, 0.25, 1.0))
+        for v in (0.05, 0.1, 0.2, 0.9, 3.0):
+            h.observe(v)
+        assert h.count_le(0.25) == 3         # 0.05, 0.1, 0.2
+        assert h.count_le(0.05) == 0         # below the first bound
+        # the 3.0 observation sits in the +Inf overflow bucket: its
+        # magnitude is unknown, so it is never counted as <= anything
+        assert h.count_le(10.0) == 4
+        c = reg.counter("t_c_total")
+        c.inc(3, outcome="ok", route="a")
+        c.inc(2, outcome="ok", route="b")
+        c.inc(1, outcome="error", route="a")
+        assert c.sum_series() == 6
+        assert c.sum_series(outcome="ok") == 5
+        assert c.sum_series(route="a") == 4
+        assert c.sum_series(outcome="error", route="a") == 1
+
+
+# -- exposition + lifecycle --------------------------------------------------
+
+
+class TestExpositionAndLifecycle:
+    def test_gauges_refresh_on_sample(self):
+        reg = registry()
+        c = reg.counter("dl4jtpu_serving_requests_total")
+        clock = FakeClock()
+        eng = SLOEngine(
+            [SLObjective.availability("t_gauge_slo", target=0.99)],
+            windows=WINDOWS, clock=clock,
+        )
+        c.inc(10, outcome="ok")
+        eng.sample()
+        clock.t = 5.0
+        c.inc(90, outcome="error")
+        st = eng.sample()["t_gauge_slo"]
+        assert reg.gauge("dl4jtpu_slo_burn_rate").value(
+            slo="t_gauge_slo", window="10s",
+        ) == pytest.approx(st["burn"]["10s"])
+        assert reg.gauge("dl4jtpu_slo_alert_active").value(
+            slo="t_gauge_slo",
+        ) == (1.0 if st["alert"] else 0.0)
+        assert reg.counter("dl4jtpu_slo_alerts_total").value(
+            slo="t_gauge_slo",
+        ) == st["alerts_total"]
+
+    def test_install_makes_every_scrape_an_evaluation_tick(self):
+        reg = registry()
+        eng = SLOEngine(
+            [SLObjective.availability("t_install_slo", target=0.99)],
+            windows=WINDOWS,
+        )
+        eng.install()
+        try:
+            assert active_engine() is eng
+            # install() seeded a baseline sample...
+            assert "t_install_slo" in eng.state()
+            n0 = len(eng._samples["t_install_slo"])
+            reg.to_prometheus_text()            # ...and a scrape ticks
+            assert len(eng._samples["t_install_slo"]) == n0 + 1
+        finally:
+            eng.uninstall()
+        assert active_engine() is None
+        n = len(eng._samples["t_install_slo"])
+        reg.to_prometheus_text()                # no longer ticking
+        assert len(eng._samples["t_install_slo"]) == n
+
+    def test_healthz_and_status_carry_slo_state(self):
+        from deeplearning4j_tpu.models import SequentialModel
+        from deeplearning4j_tpu.nn.conf import (
+            Dense, InputType, NeuralNetConfiguration, OutputLayer,
+        )
+        from deeplearning4j_tpu.serving import (
+            InferenceServer, ServingConfig, ServingHTTPServer,
+        )
+
+        conf = (
+            NeuralNetConfiguration.builder().seed(7).list()
+            .layer(Dense(n_out=8)).layer(OutputLayer(n_out=4))
+            .set_input_type(InputType.feed_forward(6)).build()
+        )
+        srv = InferenceServer(SequentialModel(conf).init(),
+                              ServingConfig(max_batch=4))
+        http = ServingHTTPServer(srv, port=0).start()
+        eng = SLOEngine(
+            [SLObjective.availability("t_http_slo", target=0.99)],
+            windows=WINDOWS,
+        ).install()
+        srv.start()
+        try:
+            eng.sample()
+            srv.infer(np.zeros((6,), np.float32), deadline_s=10.0)
+            with urllib.request.urlopen(http.url + "healthz") as r:
+                health = json.loads(r.read())
+            assert "slo" in health
+            assert health["slo"]["alerting"] == []
+            assert "t_http_slo" in health["slo"]["objectives"]
+            with urllib.request.urlopen(http.url + "v1/status") as r:
+                status = json.loads(r.read())
+            assert "t_http_slo" in status["slo"]
+            assert "latency_breakdown" in status
+        finally:
+            eng.uninstall()
+            srv.stop()
+            http.stop()
+
+    def test_api_slo_endpoint_joins_local_and_workers(self):
+        from deeplearning4j_tpu.observe import fleet as ofleet
+        from deeplearning4j_tpu.ui.server import UIServer
+
+        eng = SLOEngine(
+            [SLObjective.availability("t_api_slo", target=0.99)],
+            windows=WINDOWS,
+        ).install()
+        agg = ofleet.FleetAggregator()
+        ofleet.set_active_aggregator(agg)
+        ui = UIServer(port=0)
+        try:
+            eng.sample()
+            agg.ingest("w0", {"rank": 0, "slo": {
+                "avail": {"alert": True, "burn": {"300s": 20.0}},
+            }})
+            with urllib.request.urlopen(ui.url + "api/slo") as r:
+                doc = json.loads(r.read())
+            assert "t_api_slo" in doc["local"]
+            assert doc["workers"]["w0"]["avail"]["alert"] is True
+        finally:
+            eng.uninstall()
+            ofleet.clear_active_aggregator(agg)
+            ui.stop()
+
+    def test_fleet_push_payload_carries_slo_state(self):
+        from deeplearning4j_tpu.observe.fleet import FleetReporter
+
+        eng = SLOEngine(
+            [SLObjective.availability("t_push_slo", target=0.99)],
+            windows=WINDOWS,
+        ).install()
+        try:
+            reporter = FleetReporter(client=None, rank=0)
+            payload = reporter.payload()
+            assert "t_push_slo" in payload["slo"]
+        finally:
+            eng.uninstall()
+
+
+# -- meta-observability ------------------------------------------------------
+
+
+class TestScrapeMeta:
+    def test_scrape_times_itself_and_counts_series(self):
+        reg = registry()
+        reg.to_prometheus_text()        # the PREVIOUS scrape's timing...
+        text = reg.to_prometheus_text()
+        # ...is exposed on the next one
+        line = [l for l in text.splitlines()
+                if l.startswith("dl4jtpu_scrape_seconds ")]
+        assert line and float(line[0].split()[-1]) > 0
+        fams = reg.gauge("dl4jtpu_registry_families").value()
+        series = reg.gauge("dl4jtpu_registry_series").value()
+        assert fams > 50                 # the pre-declared core schema
+        assert series >= fams            # histograms count their lines
+
+    def test_bare_registry_stays_unpolluted(self):
+        reg = MetricsRegistry()
+        reg.counter("t_only_total").inc()
+        reg.to_prometheus_text()
+        text = reg.to_prometheus_text()
+        assert "dl4jtpu_scrape_seconds" not in text
