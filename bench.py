@@ -46,6 +46,7 @@ useful on CPU; numbers from quick mode are not comparable.
 
 from __future__ import annotations
 
+import functools
 import json
 import math
 import os
@@ -918,6 +919,101 @@ def bench_longctx(peak):
         flops_source="analytic (XLA cost analysis cannot see through the "
                      "Pallas flash-attention call)",
     )
+
+
+def bench_longctx_quant() -> None:
+    """bench.py --longctx: the long-context transformer's INFERENCE
+    path, f32 vs int8-quantized (quant/ptq.py) -> BENCH_LONGCTX_QUANT
+    .json.  Quantization covers the embedding table, every block's
+    attention projections + FFN weights, and the LM head; the flash-
+    attention core and norms stay f32.  Rows: tokens/sec both ways,
+    the measured speedup, prediction agreement (random weights — the
+    TRAINED-model parity gates live in tests/test_quant.py), bytes
+    saved, and which dequant-matmul impl the quantized programs
+    selected.  Quick mode shrinks shapes and does not rewrite the
+    committed table."""
+    import jax
+
+    jax.config.update(
+        "jax_platforms", os.environ.get("BENCH_SERVING_PLATFORM", "cpu")
+    )
+    import numpy as np
+
+    from deeplearning4j_tpu.observe.metrics import registry
+    from deeplearning4j_tpu.quant import (
+        parity_check, quantize, quantized_bytes,
+    )
+    from deeplearning4j_tpu.zoo.transformer import TransformerEncoder
+
+    if QUICK:
+        vocab, d, heads, layers, batch, seq = 256, 64, 4, 2, 2, 128
+        reps = 4
+    else:
+        vocab, d, heads, layers, batch, seq = 8192, 512, 8, 4, 2, 1024
+        reps = 10
+    model = TransformerEncoder(
+        vocab_size=vocab, d_model=d, n_heads=heads, n_layers=layers,
+        causal=True,
+    ).init_model()
+    qmodel = quantize(model)
+    qb = quantized_bytes(qmodel.params)
+    rng = np.random.default_rng(3)
+    ids = rng.integers(0, vocab, (batch, seq)).astype(np.float32)
+
+    impl_counts_before = {
+        impl: registry().counter(
+            "dl4jtpu_quant_dequant_matmul_total"
+        ).value(impl=impl)
+        for impl in ("pallas", "blocked", "xla")
+    }
+
+    def tokens_per_sec(m):
+        ms = _time_jitted(
+            lambda x: m.output(x), ids, reps=reps,
+        )
+        return batch * seq / (ms / 1000.0)
+
+    f32_tps = tokens_per_sec(model)
+    q_tps = tokens_per_sec(qmodel)
+    impls = {
+        impl: registry().counter(
+            "dl4jtpu_quant_dequant_matmul_total"
+        ).value(impl=impl) - impl_counts_before[impl]
+        for impl in ("pallas", "blocked", "xla")
+    }
+    agreement = parity_check(
+        model, qmodel, rng.integers(0, vocab, (2, seq)).astype(
+            np.float32
+        ),
+    )
+    doc = {
+        "schema": "bench-longctx-quant/1",
+        "platform": jax.default_backend(),
+        "env": _env_provenance(),
+        "quick": QUICK,
+        "config": {
+            "vocab": vocab, "d_model": d, "n_heads": heads,
+            "n_layers": layers, "batch": batch, "seq": seq,
+        },
+        "f32_tokens_per_sec": round(f32_tps, 1),
+        "int8_tokens_per_sec": round(q_tps, 1),
+        "speedup_vs_f32": round(q_tps / f32_tps, 3),
+        "bytes": qb,
+        "dequant_matmul_lowerings": impls,
+        "prediction_agreement": agreement["top1_agreement"],
+        "note": (
+            "random-weight agreement; the trained-model parity gates "
+            "(top-1 <= 1%, F1 <= 0.02) are asserted in tier-1 "
+            "(tests/test_quant.py)"
+        ),
+    }
+    if not QUICK:
+        path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                            "BENCH_LONGCTX_QUANT.json")
+        with open(path, "w") as f:
+            json.dump(doc, f, indent=1)
+        print(f"[bench] longctx quant table -> {path}", file=sys.stderr)
+    print(json.dumps(doc))
 
 
 def bench_resnet_ab() -> None:
@@ -1960,6 +2056,186 @@ def _serving_closed_loop(target, clients, duration_s, deadline_s, n_in):
     }
 
 
+def _time_jitted(fn, *args, reps=15):
+    """ms/call of a jitted callable, post-compile."""
+    import jax
+
+    jax.block_until_ready(fn(*args))
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        out = fn(*args)
+    jax.block_until_ready(out)
+    return (time.perf_counter() - t0) / reps * 1000.0
+
+
+def _bench_serving_quantized(run_loop) -> dict:
+    """Phase 5 of --serving: int8 PTQ vs f32 on the SAME serving-shaped
+    MLP — measured throughput at equal client counts, the
+    evaluation-parity gate, the per-shape dequant-matmul kernel table
+    (pallas/blocked vs the XLA dequantize-then-dot baseline), and the
+    roofline-MODELED TPU speedup.
+
+    The measured CPU rows are honest and therefore modest: weight-only
+    int8 pays on memory-bandwidth-bound accelerators, and on this CPU
+    XLA's dequantize materialization gives back what the smaller
+    weights save (sustained random access is DRAM-latency-bound — see
+    docs/quantization.md "What int8 buys, where").  The ≥1.2x serving
+    claim is carried by the modeled row, computed from the cost
+    registry's int8-adjusted params bytes against the published TPU
+    v5e peaks, and must be re-measured when this bench runs on real
+    TPU hardware (BENCH_SERVING_PLATFORM=tpu)."""
+    import numpy as np
+
+    from deeplearning4j_tpu.models import SequentialModel
+    from deeplearning4j_tpu.nn.conf import (
+        Dense, InputType, NeuralNetConfiguration, OutputLayer,
+    )
+    from deeplearning4j_tpu.observe.cost import PEAKS_BY_DEVICE_KIND
+    from deeplearning4j_tpu.ops.dequant_matmul import (
+        dequant_matmul, select_impl,
+    )
+    from deeplearning4j_tpu.quant import (
+        parity_check, quantize, quantized_bytes,
+    )
+    from deeplearning4j_tpu.quant.qtensor import quantize_array
+    from deeplearning4j_tpu.serving import InferenceServer, ServingConfig
+
+    from deeplearning4j_tpu.nn.updaters import Adam
+
+    n_in, hidden, n_out = (64, 256, 8) if QUICK else (256, 1024, 8)
+    conf = (
+        NeuralNetConfiguration.builder().seed(14).updater(Adam(5e-3))
+        .list()
+        .layer(Dense(n_out=hidden)).layer(Dense(n_out=hidden))
+        .layer(OutputLayer(n_out=n_out))
+        .set_input_type(InputType.feed_forward(n_in)).build()
+    )
+    f32_model = SequentialModel(conf).init()
+    # brief fit on separable blobs: the parity gate (top-1 delta <= 1%)
+    # is a statement about models with real decision margins — argmax
+    # of random-init logits flips on rounding noise and gates nothing
+    rng = np.random.default_rng(14)
+    from deeplearning4j_tpu.data.dataset import DataSet
+
+    y_tr = rng.integers(0, n_out, 512)
+    x_tr = rng.normal(0, 0.4, (512, n_in)).astype(np.float32)
+    x_tr[:, :n_out] += np.eye(n_out, dtype=np.float32)[y_tr] * 2.0
+    oh = np.eye(n_out, dtype=np.float32)[y_tr]
+    for _ in range(1 if QUICK else 3):
+        for i in range(0, 512, 64):
+            f32_model.fit_batch(DataSet(x_tr[i:i + 64], oh[i:i + 64]))
+    q_model = quantize(f32_model)
+    y_ev = rng.integers(0, n_out, 128 if QUICK else 512)
+    x_ev = rng.normal(0, 0.4, (len(y_ev), n_in)).astype(np.float32)
+    x_ev[:, :n_out] += np.eye(n_out, dtype=np.float32)[y_ev] * 2.0
+    parity = parity_check(f32_model, q_model, x_ev, labels=y_ev)
+    qb = quantized_bytes(q_model.params)
+
+    # measured: same client counts against both servers
+    example = np.zeros((n_in,), np.float32)
+    window = 0.6 if QUICK else 2.5
+    curve = []
+    for clients in ((2,) if QUICK else (4, 8)):
+        rows = {}
+        for label, model in (("f32", f32_model), ("int8", q_model)):
+            srv = InferenceServer(model, ServingConfig(
+                max_batch=8, max_queue=64, linger_s=0.001,
+            ))
+            srv.warm_start(example)
+            srv.start()
+            rows[label] = run_loop(srv, clients, window, 2.0, n_in)
+            srv.stop()
+        curve.append({
+            "clients": clients,
+            "f32_rps": rows["f32"]["achieved_rps"],
+            "int8_rps": rows["int8"]["achieved_rps"],
+            "f32_p99_ms": rows["f32"]["p99_ms"],
+            "int8_p99_ms": rows["int8"]["p99_ms"],
+            "speedup_vs_f32": (
+                round(rows["int8"]["achieved_rps"]
+                      / rows["f32"]["achieved_rps"], 3)
+                if rows["f32"]["achieved_rps"] else None
+            ),
+        })
+
+    # per-shape kernel table: every impl vs the XLA baseline
+    import jax
+    import jax.numpy as jnp
+
+    shapes = (
+        ((8, 256, 256),) if QUICK
+        else ((8, 512, 512), (8, 2048, 2048), (1, 4096, 4096))
+    )
+    kernel_rows = []
+    for (m, k, n) in shapes:
+        x = jnp.asarray(
+            rng.standard_normal((m, k)).astype(np.float32)
+        )
+        w = rng.standard_normal((k, n)).astype(np.float32)
+        qt = quantize_array(w)
+        wj = jnp.asarray(w)
+        f32_ms = _time_jitted(jax.jit(lambda a, b: a @ b), x, wj)
+        row = {
+            "shape": [m, k, n],
+            "f32_matmul_ms": round(f32_ms, 4),
+            "selected": select_impl(m, k, n),
+        }
+        for impl in ("xla", "blocked", "pallas"):
+            if impl == "pallas" and (m, k, n) != shapes[0]:
+                continue       # interpret mode: numerics-speed only,
+                               # time the smallest shape as evidence
+            fn = jax.jit(
+                functools.partial(dequant_matmul, impl=impl)
+            )
+            row[f"{impl}_ms"] = round(
+                _time_jitted(fn, x, qt.q, qt.scale), 4
+            )
+        kernel_rows.append(row)
+
+    # roofline-modeled TPU speedup off the int8-adjusted params bytes:
+    # serving inference at small batch is weights-bandwidth-bound on
+    # TPU (AI far below the ridge), so dispatch time ~ bytes / membw
+    peak_flops, peak_bw = PEAKS_BY_DEVICE_KIND["TPU v5e"]
+    batch = 8
+    flops = 2.0 * batch * (n_in * hidden + hidden * hidden
+                           + hidden * n_out)
+    bytes_f32 = float(qb["f32_equiv_bytes"])
+    bytes_int8 = float(qb["quantized_bytes"])
+    t_f32 = max(flops / peak_flops, bytes_f32 / peak_bw)
+    t_int8 = max(flops / peak_flops, bytes_int8 / peak_bw)
+    modeled = {
+        "reference_chip": "TPU v5e",
+        "peak_flops": peak_flops,
+        "peak_membw_bytes_per_s": peak_bw,
+        "batch": batch,
+        "flops_per_dispatch": flops,
+        "weight_bytes_f32": bytes_f32,
+        "weight_bytes_int8": bytes_int8,
+        "arithmetic_intensity_f32": round(flops / bytes_f32, 3),
+        "ridge_point": round(peak_flops / peak_bw, 1),
+        "modeled_speedup": round(t_f32 / t_int8, 3),
+        "note": "bandwidth-bound regime: dispatch ~ weight bytes / "
+                "membw; int8+scales cut the streamed bytes ~3.9x",
+    }
+
+    return {
+        "model": f"dense{hidden}x2-out{n_out} (in={n_in})",
+        "scheme": "int8-perchannel-symmetric/1",
+        "parity": parity,
+        "bytes": qb,
+        "curve": curve,
+        "kernel_bench": kernel_rows,
+        "modeled_tpu": modeled,
+        "measured_platform_note": (
+            "CPU rows measure the full serving path honestly; "
+            "weight-only int8 is ~parity on this host (dequantize "
+            "materialization ~cancels the byte savings; random access "
+            "is latency-bound).  The >=1.2x serving economics claim "
+            "is the modeled_tpu row until this bench runs on TPU."
+        ),
+    }
+
+
 def bench_serving() -> None:
     """bench.py --serving: the serving plane under load and under chaos
     -> BENCH_SERVING.json.
@@ -2306,8 +2582,14 @@ def bench_serving() -> None:
     slo_row["registry_series"] = reg.gauge("dl4jtpu_registry_series").value()
     print(f"[bench] serving slo: {json.dumps(slo_row)}", file=sys.stderr)
 
+    # -- phase 5: int8 quantized serving (ISSUE 14) ------------------------
+    quant_row = _bench_serving_quantized(run_loop=_serving_closed_loop)
+    print(f"[bench] serving quantized: "
+          f"{json.dumps({k: v for k, v in quant_row.items() if k != 'kernel_bench'})}",
+          file=sys.stderr)
+
     doc = {
-        "schema": "bench-serving/2",
+        "schema": "bench-serving/3",
         "platform": jax.default_backend(),
         "env": _env_provenance(),
         "quick": QUICK,
@@ -2320,6 +2602,7 @@ def bench_serving() -> None:
         "chaos": chaos_row,
         "request_trace": trace_row,
         "slo": slo_row,
+        "quantized": quant_row,
     }
     if not QUICK:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),
@@ -2724,6 +3007,8 @@ if __name__ == "__main__":
         sys.exit(bench_serving_fleet())
     if "--serving" in sys.argv:
         sys.exit(bench_serving())
+    if "--longctx" in sys.argv:
+        sys.exit(bench_longctx_quant())
     if "--scaling" in sys.argv:
         sys.exit(bench_scaling())
     if "--decode-scaling" in sys.argv:

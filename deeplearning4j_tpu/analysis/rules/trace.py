@@ -53,6 +53,15 @@ JIT_WRAPPERS = {
     # so an impure transform must fail LINT here, not trace later
     "device_transform", "device.device_transform",
     "datavec.device.device_transform",
+    # Pallas kernel bodies: the function handed to pl.pallas_call is
+    # traced (then Mosaic-compiled) exactly like a jit body — an impure
+    # call inside a kernel freezes at trace time, so the TP family must
+    # treat kernels as jit scopes.  Kernels are usually passed as
+    # functools.partial(kernel, static_kw=...) — _collect_traced
+    # resolves that form and treats the partial-bound keywords as
+    # static (they are Python values baked into the trace).
+    "pl.pallas_call", "pallas_call", "pallas.pallas_call",
+    "jax.experimental.pallas.pallas_call",
 }
 PARTIAL_NAMES = {"partial", "functools.partial", "_partial"}
 # Calls whose function-valued arguments are traced when invoked.
@@ -204,6 +213,22 @@ def _collect_traced(tree: ast.Module) -> tuple[list, set]:
                     elif isinstance(arg, ast.Name):
                         for d in defs_by_name.get(arg.id, ()):
                             mark(d, sn, sp)
+                    elif (isinstance(arg, ast.Call)
+                          and dotted_name(arg.func) in PARTIAL_NAMES
+                          and arg.args
+                          and isinstance(arg.args[0], ast.Name)):
+                        # functools.partial(kernel, n_k=..., causal=...)
+                        # handed to a jit wrapper / pallas_call: the
+                        # inner def is the traced body, and the
+                        # partial's KEYWORD bindings are static Python
+                        # values (branching on them is specialization,
+                        # not a tracer branch)
+                        part_static = sn | {
+                            kw.arg for kw in arg.keywords
+                            if kw.arg is not None
+                        }
+                        for d in defs_by_name.get(arg.args[0].id, ()):
+                            mark(d, part_static, sp)
 
     # drop roots lexically nested inside another root: they are covered
     # by the enclosing region (but stay in `marked` for taint seeding)

@@ -698,7 +698,8 @@ class GraphModel(Model):
             from deeplearning4j_tpu.observe import cost
 
             self._infer_fn = cost.register_attr_program(
-                self, "_infer_fn", "infer", ("infer",), infer
+                self, "_infer_fn", "infer",
+                ("infer",) + self._step_key_suffix(), infer,
             )
         return self._infer_fn
 

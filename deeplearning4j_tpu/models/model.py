@@ -558,11 +558,18 @@ class Model:
         return params, opt_state
 
     def _step_key_suffix(self) -> tuple:
-        """Step-fn cache/program-registry key marker for the active
-        update epilogue: ZeRO-1 programs are DIFFERENT XLA programs
-        (sharded update + collectives), and the cost registry must not
-        attribute one's analysis to the other."""
-        return ("zero1",) if self._zero_placement is not None else ()
+        """Step-fn cache/program-registry key markers for program
+        variants that trace to DIFFERENT XLA programs over the same
+        model: the ZeRO-1 sharded update epilogue, and int8-quantized
+        params (quant/ptq.py) whose dequant-matmul forwards read 1/4
+        the weight bytes — the cost registry must not attribute one
+        variant's flops/bytes/roofline analysis to the other."""
+        suffix = ()
+        if self._zero_placement is not None:
+            suffix += ("zero1",)
+        if getattr(self, "_quantized", None) is not None:
+            suffix += ("int8",)
+        return suffix
 
     def _register_program(self, key, fn):
         """Register a freshly built step program with the cost registry

@@ -1441,7 +1441,7 @@ class SequentialModel(Model):
 
     # -- inference ---------------------------------------------------------
     def _get_infer_fn(self, has_fmask: bool = False):
-        key = ("infer", has_fmask)
+        key = ("infer", has_fmask) + self._step_key_suffix()
         if key not in self._step_fns:
 
             @jax.jit
@@ -1502,7 +1502,7 @@ class SequentialModel(Model):
             )
         if not getattr(self, "_rnn_stream_state", None):
             self._rnn_stream_state = self._init_carries(features.shape[0])
-        key = "rnn_step"
+        key = ("rnn_step",) + self._step_key_suffix()
         if key not in self._step_fns:
 
             @jax.jit

@@ -37,6 +37,7 @@ from deeplearning4j_tpu.nn.conf.layers import (
     _dropout,
 )
 from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.quant import functional as quantf
 from deeplearning4j_tpu.ops.attention import mha, ring_attention, ulysses_attention
 from deeplearning4j_tpu.runtime.mesh import SEQ_AXIS, active_mesh, shard_map
 from deeplearning4j_tpu.utils import serde
@@ -131,9 +132,9 @@ def apply_qkv_attention(params, xq, xk, xv, *, n_heads: int, head_size: int,
     h, dh = n_heads, head_size
     dt = xq.dtype
     if project_input:
-        q = (xq @ params["Wq"].astype(dt)).reshape(b, tq, h, dh)
-        k = (xk @ params["Wk"].astype(dt)).reshape(b, xk.shape[1], h, dh)
-        v = (xv @ params["Wv"].astype(dt)).reshape(b, xv.shape[1], h, dh)
+        q = quantf.matmul(xq, params["Wq"]).reshape(b, tq, h, dh)
+        k = quantf.matmul(xk, params["Wk"]).reshape(b, xk.shape[1], h, dh)
+        v = quantf.matmul(xv, params["Wv"]).reshape(b, xv.shape[1], h, dh)
     else:
         q = xq.reshape(b, tq, h, dh)
         k = xk.reshape(b, xk.shape[1], h, dh)
@@ -141,7 +142,7 @@ def apply_qkv_attention(params, xq, xk, xv, *, n_heads: int, head_size: int,
     out = _attend(q, k, v, causal=causal, mask=mask, seq_parallel=seq_parallel)
     out = out.reshape(b, tq, h * dh)
     if project_input:
-        out = out @ params["Wo"].astype(dt)
+        out = quantf.matmul(out, params["Wo"])
     return out
 
 
@@ -378,6 +379,8 @@ class TransformerEncoderBlock(LayerConfig):
         x = x + h
         h, _ = ln.apply(params["ln2"], {}, x)
         h = _dropout(h, self.dropout_rate or 0.0, training, r2)
-        h = self.ffn_activation(h @ params["W1"].astype(x.dtype) + params["b1"].astype(x.dtype))
-        h = h @ params["W2"].astype(x.dtype) + params["b2"].astype(x.dtype)
+        h = self.ffn_activation(
+            quantf.matmul(h, params["W1"]) + params["b1"].astype(x.dtype)
+        )
+        h = quantf.matmul(h, params["W2"]) + params["b2"].astype(x.dtype)
         return x + h, state

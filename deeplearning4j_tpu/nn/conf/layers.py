@@ -31,6 +31,7 @@ from deeplearning4j_tpu.nn.activations import Activation
 from deeplearning4j_tpu.nn.losses import Loss
 from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.quant import functional as quantf
 from deeplearning4j_tpu.utils import serde
 
 # Reserved key in a layer's returned state: an auxiliary loss the compiled
@@ -186,8 +187,9 @@ class Dense(LayerConfig):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         x = _dropout(x, self.dropout_rate or 0.0, training, rng)
-        w = params["W"].astype(x.dtype)
-        y = x @ w
+        # quantf.matmul: `x @ W` for f32 weights, the fused
+        # dequant-matmul (int8 weights, f32 accumulate) after quantize()
+        y = quantf.matmul(x, params["W"])
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
         return self._act()(y), state
@@ -204,8 +206,7 @@ class OutputLayer(Dense):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         x = _dropout(x, self.dropout_rate or 0.0, training, rng)
-        w = params["W"].astype(x.dtype)
-        y = x @ w
+        y = quantf.matmul(x, params["W"])
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
         return y, state   # logits; activation fused into loss / applied at output()
@@ -305,7 +306,9 @@ class Embedding(LayerConfig):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         ids = x.astype(jnp.int32)
-        y = jnp.take(params["W"], ids, axis=0)
+        # quantized tables gather int8 ROWS and dequantize only those —
+        # the lookup touches 1 byte/weight instead of 4
+        y = quantf.embedding_lookup(params["W"], ids)
         return self._act()(y), state
 
 
@@ -363,7 +366,9 @@ class Conv2D(LayerConfig):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         x = _dropout(x, self.dropout_rate or 0.0, training, rng)
-        w = params["W"].astype(x.dtype)
+        # conv_weight: plain dtype cast, or dequantized int8 kernel (the
+        # cast+scale fuse into the conv's weight read)
+        w = quantf.conv_weight(params["W"], x.dtype)
         y = lax.conv_general_dilated(
             x,
             w,
@@ -422,7 +427,7 @@ class SeparableConv2D(LayerConfig):
         c_in = x.shape[-1]
         y = lax.conv_general_dilated(
             x,
-            params["depthW"].astype(x.dtype),
+            quantf.conv_weight(params["depthW"], x.dtype),
             window_strides=_pair(self.stride),
             padding=self.padding.upper(),
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
@@ -430,7 +435,7 @@ class SeparableConv2D(LayerConfig):
         ).astype(x.dtype)
         y = lax.conv_general_dilated(
             y,
-            params["pointW"].astype(x.dtype),
+            quantf.conv_weight(params["pointW"], x.dtype),
             window_strides=(1, 1),
             padding="VALID",
             dimension_numbers=("NHWC", "HWIO", "NHWC"),
@@ -861,7 +866,7 @@ class ChunkedSoftmaxOutputLayer(LayerConfig):
 
     def logits(self, params, h):
         """Dense projection for inference/generation."""
-        y = h @ params["W"].astype(h.dtype)
+        y = quantf.matmul(h, params["W"])
         if self.has_bias:
             y = y + params["b"].astype(h.dtype)
         return y

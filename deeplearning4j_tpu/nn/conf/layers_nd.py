@@ -21,6 +21,7 @@ from deeplearning4j_tpu.nn.activations import Activation
 from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.conf.layers import LayerConfig, PoolingType
 from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.quant import functional as quantf
 from deeplearning4j_tpu.utils import serde
 
 
@@ -76,7 +77,7 @@ class Conv1D(LayerConfig):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         y = lax.conv_general_dilated(
-            x, params["W"].astype(x.dtype),
+            x, quantf.conv_weight(params["W"], x.dtype),
             window_strides=(self.stride,),
             padding=self.padding.upper(),
             rhs_dilation=(self.dilation,),
@@ -126,7 +127,7 @@ class Conv3D(LayerConfig):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         y = lax.conv_general_dilated(
-            x, params["W"].astype(x.dtype),
+            x, quantf.conv_weight(params["W"], x.dtype),
             window_strides=_triple(self.stride),
             padding=self.padding.upper(),
             dimension_numbers=("NDHWC", "DHWIO", "NDHWC"),

@@ -27,6 +27,7 @@ from deeplearning4j_tpu.nn.conf.input_type import InputType
 from deeplearning4j_tpu.nn.conf.layers import LayerConfig, _dropout
 from deeplearning4j_tpu.nn.losses import Loss
 from deeplearning4j_tpu.nn.weights import WeightInit
+from deeplearning4j_tpu.quant import functional as quantf
 from deeplearning4j_tpu.utils import serde
 
 
@@ -453,7 +454,7 @@ class RnnOutputLayer(LayerConfig):
 
     def apply(self, params, state, x, *, training=False, rng=None):
         x = _dropout(x, self.dropout_rate or 0.0, training, rng)
-        y = x @ params["W"].astype(x.dtype)
+        y = quantf.matmul(x, params["W"])
         if self.has_bias:
             y = y + params["b"].astype(x.dtype)
         return y, state  # logits; loss/activation handled by the model

@@ -181,6 +181,35 @@ class ProgramRecord:
         self.peak_bytes: Optional[int] = None
         self.analysis: str = "pending"     # pending|ok|partial|failed: ...
         self._memory_done = False
+        # int8 quantization (quant/ptq.py): as-stored params bytes and
+        # the f32 equivalent, captured from the owner at registration.
+        # XLA's bytes_accessed cannot be trusted for the quantized
+        # path — on CPU the dequantize materialization inflates it, on
+        # TPU cost_analysis cannot see through the Pallas kernel — so
+        # roofline modeling over weight traffic reads THESE.
+        self.params_bytes: Optional[int] = None
+        self.params_bytes_f32_equiv: Optional[int] = None
+        self.quantized = False
+        try:
+            params = getattr(owner, "params", None)
+            if params is not None:
+                from deeplearning4j_tpu.utils.pytree import tree_bytes
+
+                self.params_bytes = tree_bytes(params)
+                q = getattr(owner, "_quantized", None)
+                if q is not None:
+                    from deeplearning4j_tpu.quant.ptq import (
+                        quantized_bytes,
+                    )
+
+                    b = quantized_bytes(params)
+                    self.quantized = True
+                    self.params_bytes_f32_equiv = (
+                        self.params_bytes
+                        - b["quantized_bytes"] + b["f32_equiv_bytes"]
+                    )
+        except Exception as e:
+            log.debug("params-bytes capture failed for %s: %s", key, e)
 
     # -- liveness ----------------------------------------------------------
     def live(self) -> bool:
@@ -346,6 +375,9 @@ class ProgramRecord:
             "peak_bytes": self.peak_bytes,
             "arithmetic_intensity": round(ai, 3) if ai else None,
             "roofline": self.roofline(),
+            "params_bytes": self.params_bytes,
+            "params_bytes_f32_equiv": self.params_bytes_f32_equiv,
+            "quantized": self.quantized,
             "last_dispatch_seconds": self.last_dispatch_seconds,
             "analysis": self.analysis,
         }

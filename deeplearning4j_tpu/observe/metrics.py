@@ -658,6 +658,24 @@ def _declare_core(reg: MetricsRegistry) -> None:
     reg.counter("dl4jtpu_slo_alerts_total",
                 "Burn-rate alerts fired per objective (rising edges "
                 "only)")
+    # int8 post-training quantization (quant/, ops/dequant_matmul.py)
+    reg.gauge("dl4jtpu_quant_params_bytes",
+              "Bytes of the last quantize()d params tree, by kind "
+              "(quantized = int8 values + f32 scales as stored, "
+              "f32_equiv = the same weights at f32) — the serving "
+              "memory the scheme saves")
+    reg.counter("dl4jtpu_quant_dequant_matmul_total",
+                "Quantized matmul sites lowered into compiled "
+                "programs, by impl (pallas = fused TPU kernel, "
+                "blocked = cache-blocked XLA scan, xla = "
+                "dequantize-then-dot baseline).  Counted at TRACE "
+                "time — once per program signature per site, never "
+                "from inside the traced body")
+    reg.counter("dl4jtpu_quant_parity_checks_total",
+                "Quantized-vs-f32 evaluation-parity gate results, by "
+                "result (pass/fail) — bumped by "
+                "quant.parity_check() wherever the gate runs "
+                "(tests, bench rows, pre-deploy checks)")
     # meta-observability: the scrape path describing itself — a slow or
     # bloating scrape is an outage signal too
     reg.gauge("dl4jtpu_scrape_seconds",

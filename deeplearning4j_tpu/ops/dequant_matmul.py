@@ -1,0 +1,249 @@
+"""Fused dequant-matmul — the Pallas kernel library's second kernel.
+
+``y = x @ (q * scale)`` for f32 activations against int8 weights with
+per-output-channel f32 scales, without ever materializing the f32
+weight matrix in HBM.  Three implementations behind one dispatch:
+
+- ``pallas`` — the fused TPU kernel: grid (M, N, K) blocks, the int8
+  weight block is dequantized IN-KERNEL (VMEM-resident, so HBM sees
+  only 1 byte/weight), partial products accumulate in an f32 VMEM
+  scratch, and the per-channel scale is applied once at the final K
+  block (scales commute with the contraction: ``x @ (q·s) == (x @
+  q)·s``).  CPU tier-1 runs the SAME kernel with ``interpret=True``.
+- ``blocked`` — the CPU counterpart of the same algorithm in plain XLA:
+  a ``lax.scan`` over K blocks dequantizes one block at a time (the f32
+  block stays cache-resident instead of writing a full f32 copy of the
+  weights) with f32 accumulation.
+- ``xla`` — dequantize-then-dot, the reference/baseline every other
+  impl must match within 1e-5 rel (bench.py --serving's kernel table
+  times all three per shape).
+
+Selection (``impl=None``): the env override ``DL4JTPU_QUANT_KERNEL``
+(pallas / blocked / xla / auto) wins; auto picks ``pallas`` on TPU when
+the shape tiles, ``blocked`` on CPU when the weight matrix is large
+enough for cache-blocking to beat the baseline's full f32
+materialization (measured crossover ~2^20 weights), else ``xla``.
+Every selection is a TRACE-TIME event and is counted host-side on
+``dl4jtpu_quant_dequant_matmul_total{impl=...}`` — one count per
+compiled program signature per quantized matmul site, never a call
+inside the traced body (tpulint TP004 polices exactly that).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+ENV_KERNEL = "DL4JTPU_QUANT_KERNEL"
+
+#: default tile sizes; K/N blocks must divide the weight dims for the
+#: pallas path (candidates tried largest-first), M pads to the sublane
+DEFAULT_BLOCK_M = 128
+_BLOCK_CANDIDATES = (512, 256, 128)
+#: auto rule: cache-blocking beats the XLA full-materialization
+#: baseline once the weight matrix is large enough that the f32 copy
+#: stops fitting cache (measured crossover ~4 megaweights on the
+#: serving host: tie-to-1.3x at 4M, 4.5x at 9M) — and only with at
+#: least 2 activation rows (at M=1 the scan degenerates into tiny
+#: vector-matrix steps and the baseline wins)
+_BLOCKED_MIN_WEIGHTS = 1 << 22
+_BLOCKED_MIN_M = 2
+IMPLS = ("pallas", "blocked", "xla")
+
+
+def _count_selection(impl: str) -> None:
+    """Trace-time telemetry: which impl a quantized matmul site lowered
+    to.  Never raises into a trace."""
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        registry().counter(
+            "dl4jtpu_quant_dequant_matmul_total"
+        ).inc(impl=impl)
+    except Exception as e:
+        log.debug("dequant-matmul selection metric failed: %s", e)
+
+
+def _pick_block(dim: int) -> int:
+    for b in _BLOCK_CANDIDATES:
+        if dim % b == 0:
+            return b
+    return 0
+
+
+def pallas_eligible(m: int, k: int, n: int) -> bool:
+    """Can the fused kernel serve this shape (without interpret)?  K and
+    N must tile by a candidate block; M pads internally."""
+    return _pick_block(k) > 0 and _pick_block(n) > 0
+
+
+def select_impl(m: int, k: int, n: int) -> str:
+    """The kernel-selection rule (docs/quantization.md):
+    env override > TPU+tileable -> pallas > large-weight CPU -> blocked
+    > xla baseline."""
+    env = os.environ.get(ENV_KERNEL, "").strip().lower()
+    if env in IMPLS:
+        return env
+    from deeplearning4j_tpu.runtime.backend import backend
+
+    if backend().is_tpu and pallas_eligible(m, k, n):
+        return "pallas"
+    if (k * n >= _BLOCKED_MIN_WEIGHTS and m >= _BLOCKED_MIN_M
+            and _pick_block(k) > 0):
+        return "blocked"
+    return "xla"
+
+
+# -- xla baseline -----------------------------------------------------------
+
+def _xla_dequant_dot(x, q, scale):
+    """Dequantize-then-dot: the reference numerics (f32 accumulate)."""
+    w = q.astype(jnp.float32) * scale.astype(jnp.float32)
+    return lax.dot_general(
+        x.astype(jnp.float32), w, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+
+# -- blocked (CPU) ----------------------------------------------------------
+
+def _blocked_dequant_dot(x, q, scale, *, block_k: int):
+    """Scan over K blocks: one (block_k, N) int8 slab dequantizes into a
+    cache-resident f32 block, dots against the matching activation
+    columns, and accumulates in f32 — the weight matrix is read once as
+    int8 and its f32 form never round-trips through memory."""
+    k, n = q.shape
+    nb = k // block_k
+    qb = q.reshape(nb, block_k, n)
+    xb = jnp.moveaxis(
+        x.astype(jnp.float32).reshape(x.shape[:-1] + (nb, block_k)), -2, 0
+    )
+
+    def body(acc, operand):
+        qi, xi = operand
+        acc = acc + lax.dot_general(
+            xi, qi.astype(jnp.float32),
+            (((xi.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return acc, None
+
+    acc0 = jnp.zeros(x.shape[:-1] + (n,), jnp.float32)
+    acc, _ = lax.scan(body, acc0, (qb, xb))
+    return acc * scale.astype(jnp.float32)
+
+
+# -- pallas (TPU; interpret on CPU) ----------------------------------------
+
+def _dm_kernel(x_ref, q_ref, s_ref, o_ref, acc_ref, *, n_k: int):
+    """Grid (n_m, n_n, n_k), K innermost (sequential): dequantize the
+    int8 weight block in VMEM, accumulate f32 partial products in
+    scratch, scale once on the last K block."""
+    kj = pl.program_id(2)
+
+    @pl.when(kj == 0)
+    def _init():
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    acc_ref[...] += jax.lax.dot_general(
+        x_ref[...], q_ref[...].astype(jnp.float32),
+        (((1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    )
+
+    @pl.when(kj == n_k - 1)
+    def _done():
+        # per-output-channel scale, broadcast from the 8-sublane row the
+        # wrapper staged (Mosaic wants (8k, 128k) trailing block dims)
+        o_ref[...] = (acc_ref[...] * s_ref[0, :][None, :]).astype(
+            o_ref.dtype
+        )
+
+
+def _pallas_dequant_dot(x2, q, scale, *, interpret: bool,
+                        block_m: int = DEFAULT_BLOCK_M):
+    """(M, K) @ (K, N) int8 -> (M, N) f32 via the fused kernel.  M is
+    padded to the f32 sublane multiple (8); K/N must tile (the caller
+    checked `pallas_eligible`, or runs interpret where any block
+    works)."""
+    from jax.experimental.pallas import tpu as pltpu
+
+    m, k = x2.shape
+    n = q.shape[1]
+    bk = _pick_block(k) or k
+    bn = _pick_block(n) or n
+    m_pad = max(8, -(-m // 8) * 8)
+    bm = min(block_m, m_pad)
+    m_pad = -(-m_pad // bm) * bm
+    if m_pad != m:
+        x2 = jnp.concatenate(
+            [x2, jnp.zeros((m_pad - m, k), x2.dtype)], axis=0
+        )
+    scale8 = jnp.broadcast_to(
+        scale.astype(jnp.float32)[None, :], (8, n)
+    )
+    kwargs = {}
+    if not interpret:
+        kwargs["compiler_params"] = pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "arbitrary"),
+        )
+    out = pl.pallas_call(
+        functools.partial(_dm_kernel, n_k=k // bk),
+        grid=(m_pad // bm, n // bn, k // bk),
+        in_specs=[
+            pl.BlockSpec((bm, bk), lambda i, j, kk: (i, kk)),
+            pl.BlockSpec((bk, bn), lambda i, j, kk: (kk, j)),
+            pl.BlockSpec((8, bn), lambda i, j, kk: (0, j)),
+        ],
+        out_specs=pl.BlockSpec((bm, bn), lambda i, j, kk: (i, j)),
+        out_shape=jax.ShapeDtypeStruct((m_pad, n), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((bm, bn), jnp.float32)],
+        interpret=interpret,
+        **kwargs,
+    )(x2.astype(jnp.float32), q, scale8)
+    return out[:m]
+
+
+# -- dispatch ---------------------------------------------------------------
+
+def dequant_matmul(x, q, scale, *, impl: str | None = None,
+                   interpret: bool | None = None):
+    """``x @ dequant(q, scale)`` with f32 accumulation.
+
+    ``x``: (..., K) activations (any float dtype; accumulation is f32
+    and the result is f32); ``q``: (K, N) int8; ``scale``: (N,) f32.
+    ``impl`` forces an implementation (tests/bench); None applies
+    `select_impl`.  ``interpret`` forces/suppresses Pallas interpret
+    mode (None = interpret off-TPU, so CPU tier-1 runs the real kernel
+    logic without Mosaic).
+    """
+    *lead, k = x.shape
+    n = q.shape[1]
+    m = 1
+    for d in lead:
+        m *= int(d)
+    chosen = impl or select_impl(m, k, n)
+    if chosen == "blocked" and not _pick_block(k):
+        chosen = "xla"              # K does not tile: baseline
+    # counted AFTER fallback resolution: the impl label must name the
+    # kernel that actually runs (bench rows read this)
+    _count_selection(chosen)
+    if chosen == "pallas":
+        if interpret is None:
+            from deeplearning4j_tpu.runtime.backend import backend
+
+            interpret = not backend().is_tpu
+        x2 = x.reshape(m, k)
+        out = _pallas_dequant_dot(x2, q, scale, interpret=interpret)
+        return out.reshape(*lead, n)
+    if chosen == "blocked":
+        return _blocked_dequant_dot(x, q, scale, block_k=_pick_block(k))
+    return _xla_dequant_dot(x, q, scale)

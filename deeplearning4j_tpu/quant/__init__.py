@@ -1,0 +1,40 @@
+"""Int8 post-training quantization for the inference path.
+
+`quantize(model)` rewrites a trained Sequential/Graph model's matmul,
+conv and embedding weights into `(int8 q, f32 scale)` pairs — symmetric
+per-output-channel scales, f32 accumulation at apply time — held in the
+params tree as a registered `QuantizedTensor` pytree node, so every
+layer of the stack that flattens trees (jit dispatch, hot-swap
+verification, checkpoints, the cost registry, ZeRO-free serving) sees
+plain int8/f32 leaves with zero special-casing.
+
+The quantized dense path dispatches through
+`ops.dequant_matmul.dequant_matmul` — a fused Pallas kernel on TPU
+(int8 weight blocks dequantized in-kernel against f32 activations, f32
+accumulation), a cache-blocked XLA scan on CPU, and the plain
+dequantize-then-dot XLA baseline everywhere else (see
+docs/quantization.md for the selection rule).
+
+Post-training and inference-only: `quantize()` drops the optimizer
+state; keep the f32 model if you intend to keep training.
+"""
+
+from deeplearning4j_tpu.quant.qtensor import QuantizedTensor
+from deeplearning4j_tpu.quant.ptq import (
+    SCHEME,
+    dequantize_tree,
+    is_quantized,
+    parity_check,
+    quantize,
+    quantized_bytes,
+)
+
+__all__ = [
+    "QuantizedTensor",
+    "SCHEME",
+    "dequantize_tree",
+    "is_quantized",
+    "parity_check",
+    "quantize",
+    "quantized_bytes",
+]

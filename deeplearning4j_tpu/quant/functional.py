@@ -1,0 +1,45 @@
+"""Quantization-aware functional ops the layer applies dispatch through.
+
+Each helper accepts EITHER a plain array weight (the f32 path — exactly
+the op the layer ran before quantization existed) or a
+`QuantizedTensor`, so the layer code has one call site and zero
+branches on model state.  The quantized dense path routes through the
+fused dequant-matmul (ops/dequant_matmul.py — kernel-selection rule in
+docs/quantization.md); conv kernels dequantize-then-conv (XLA fuses the
+cast into the conv's weight read); embedding lookups gather int8 ROWS
+first and dequantize only what was gathered — 1/4 of the table bytes
+per lookup, the channel where weight-only int8 pays even on CPU.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.dequant_matmul import dequant_matmul
+from deeplearning4j_tpu.quant.qtensor import QuantizedTensor
+
+
+def matmul(x, w):
+    """``x @ w`` for a plain or quantized weight; quantized runs the
+    fused dequant-matmul with f32 accumulation and returns x.dtype."""
+    if isinstance(w, QuantizedTensor):
+        return dequant_matmul(x, w.q, w.scale).astype(x.dtype)
+    return x @ w.astype(x.dtype)
+
+
+def conv_weight(w, dtype):
+    """Dense kernel for a conv: dequantized (cast folded into the conv)
+    for a QuantizedTensor, the usual dtype cast otherwise."""
+    if isinstance(w, QuantizedTensor):
+        return w.dequant(dtype)
+    return w.astype(dtype)
+
+
+def embedding_lookup(w, ids):
+    """Row gather for plain or quantized embedding tables.  Quantized:
+    gather int8 rows, then dequantize just those rows — the table is
+    touched at 1 byte/weight."""
+    if isinstance(w, QuantizedTensor):
+        rows = jnp.take(w.q, ids, axis=0)
+        return rows.astype(jnp.float32) * w.scale
+    return jnp.take(w, ids, axis=0)

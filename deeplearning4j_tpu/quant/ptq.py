@@ -1,0 +1,298 @@
+"""Post-training quantization: `quantize(model)` -> servable int8 model.
+
+Covers every matmul/conv/embedding weight of the layers that exist
+today — Dense/OutputLayer (and the zoo/modelimport models built from
+them), Conv1D/2D/3D, SeparableConv2D (both kernels), Deconv2D and
+Embedding — with symmetric per-output-channel scales (`qtensor.
+quantize_array`).  Biases, norm parameters, recurrent gates and
+attention projections stay f32: they are a rounding error of the
+weight bytes and the risky numerics.  The quantized layer set is
+derived from the CONFIG (layer types by name), so the same walk
+rebuilds an identical tree STRUCTURE at checkpoint-restore time
+(`requantize_structure` — values then stream in from the file).
+
+The transform is inference-only: the optimizer state is dropped (an
+int8 tree cannot take gradient updates) and `model._quantized` carries
+the scheme marker that keys the cost registry's distinct programs
+(``Model._step_key_suffix``), the checkpoint meta, and the serving
+status surface.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import jax
+
+from deeplearning4j_tpu.quant.qtensor import QuantizedTensor, quantize_array
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+SCHEME = "int8-perchannel-symmetric/1"
+
+
+def _quantizable_types():
+    """(layer types -> quantized-param spec), resolved lazily — the
+    layer modules import quant.functional, so a module-level table here
+    would be a circular import (the PR 8 observe/health lesson).
+
+    A spec is ``{group: names}``: ``""`` names params at the layer's
+    top level, any other key names a NESTED param-dict group (the
+    transformer block keeps its attention projections under
+    ``params["attn"]``).  Plain tuples are shorthand for top-level."""
+    from deeplearning4j_tpu.nn.conf import attention as A
+    from deeplearning4j_tpu.nn.conf import layers as L
+    from deeplearning4j_tpu.nn.conf import layers_nd as LN
+    from deeplearning4j_tpu.nn.conf import recurrent as R
+
+    qkv = ("Wq", "Wk", "Wv", "Wo")
+    return (
+        (L.SeparableConv2D, ("depthW", "pointW")),
+        (L.Conv2D, ("W",)),
+        (L.Deconv2D, ("W",)),
+        (L.Dense, ("W",)),             # OutputLayer subclasses Dense
+        (L.Embedding, ("W",)),
+        (L.ChunkedSoftmaxOutputLayer, ("W",)),
+        (LN.Conv1D, ("W",)),
+        (LN.Conv3D, ("W",)),
+        (R.RnnOutputLayer, ("W",)),
+        (A.SelfAttentionLayer, qkv),
+        (A.TransformerEncoderBlock,
+         {"": ("W1", "W2"), "attn": qkv}),
+    )
+
+
+def _layer_configs(conf) -> dict:
+    """name -> layer config, for Sequential and Graph configurations."""
+    layers = getattr(conf, "layers", None)
+    if layers is not None:
+        return {l.name: l for l in layers}
+    nodes = getattr(conf, "nodes", None)
+    if nodes is not None:
+        return {n.name: n.layer for n in nodes if n.layer is not None}
+    return {}
+
+
+def _quant_spec(layer) -> dict:
+    for cls, spec in _quantizable_types():
+        if isinstance(layer, cls):
+            return spec if isinstance(spec, dict) else {"": spec}
+    return {}
+
+
+def _quantize_group(group: dict, names, *, min_elements: int) -> dict:
+    new = {}
+    for pname, arr in group.items():
+        if (pname in names and getattr(arr, "ndim", 0) >= 2
+                and arr.size >= min_elements
+                and not isinstance(arr, QuantizedTensor)):
+            new[pname] = quantize_array(arr)
+        else:
+            new[pname] = arr
+    return new
+
+
+def quantize_params(conf, params, *, min_elements: int = 0) -> dict:
+    """The params tree with every quantizable weight replaced by a
+    `QuantizedTensor`; everything else is carried by reference."""
+    configs = _layer_configs(conf)
+    out = {}
+    for lname, lp in params.items():
+        layer = configs.get(lname)
+        spec = _quant_spec(layer) if layer is not None else {}
+        if not spec or not isinstance(lp, dict):
+            out[lname] = lp
+            continue
+        new = dict(lp)
+        for group, names in spec.items():
+            if group == "":
+                new.update(_quantize_group(
+                    lp, names, min_elements=min_elements
+                ))
+            elif isinstance(lp.get(group), dict):
+                new[group] = _quantize_group(
+                    lp[group], names, min_elements=min_elements
+                )
+        out[lname] = new
+    return out
+
+
+def quantize(model, *, min_elements: int = 0, copy: bool = True):
+    """Int8-quantize a built model's weights for serving.
+
+    ``copy=True`` (default) returns a NEW model over the same config —
+    the f32 original keeps training/serving untouched.  ``copy=False``
+    converts in place (the checkpoint-restore path, where the f32 tree
+    is about to be discarded anyway).  Either way the result's step-fn
+    cache is empty, so its infer programs rebuild against the int8 tree
+    and register with the cost registry under int8-marked keys.
+    """
+    if model.params is None:
+        model.init()
+    qparams = quantize_params(model.conf, model.params,
+                              min_elements=min_elements)
+    if copy:
+        target = type(model)(model.conf)
+        target.net_state = model.net_state
+        target.iteration = model.iteration
+        target.epoch = model.epoch
+        for attr in ("_serialize_class_name",):
+            if hasattr(model, attr):
+                setattr(target, attr, getattr(model, attr))
+    else:
+        target = model
+        target.opt_state = None            # int8 weights take no updates
+        target._step_fns.clear()           # f32-shaped programs are stale
+        if getattr(target, "_infer_fn", None) is not None:
+            target._infer_fn = None        # GraphModel's cached program
+    target.params = qparams
+    target._quantized = {"scheme": SCHEME, "min_elements": min_elements}
+    _gauge_bytes(qparams)
+    log.info("quantized %d weight tensor(s) (%s)",
+             sum(1 for _ in _iter_quantized(qparams)), SCHEME)
+    return target
+
+
+def requantize_structure(model, meta: dict | None = None):
+    """Rebuild the quantized tree STRUCTURE on a freshly-initialized
+    model (checkpoint restore: structure comes from code, data from the
+    file).  The scales computed here are placeholders — `_load_npz_into`
+    overwrites every leaf positionally right after.  `meta` is the
+    checkpoint's recorded quantization config: the walk must re-run with
+    the SAME knobs (a different min_elements changes the leaf count and
+    the positional load would mis-align), and an unknown scheme is a
+    hard error, not a silent guess."""
+    meta = meta or {}
+    scheme = meta.get("scheme", SCHEME)
+    if scheme != SCHEME:
+        raise ValueError(
+            f"checkpoint quantization scheme {scheme!r} is not supported "
+            f"by this build (expected {SCHEME!r})"
+        )
+    return quantize(
+        model, copy=False,
+        min_elements=int(meta.get("min_elements", 0)),
+    )
+
+
+def _iter_quantized(params):
+    stack = [params]
+    while stack:
+        node = stack.pop()
+        if isinstance(node, QuantizedTensor):
+            yield node
+        elif isinstance(node, dict):
+            stack.extend(node.values())
+
+
+def is_quantized(model) -> bool:
+    return getattr(model, "_quantized", None) is not None
+
+
+def dequantize_tree(params):
+    """The f32 tree a quantized params tree stands for (debug/parity
+    tooling — serving never materializes this)."""
+    def deq(leaf):
+        return leaf.dequant() if isinstance(leaf, QuantizedTensor) else leaf
+
+    return jax.tree.map(
+        deq, params, is_leaf=lambda x: isinstance(x, QuantizedTensor)
+    )
+
+
+def quantized_bytes(params) -> dict:
+    """Byte accounting of a (possibly) quantized tree: actual bytes,
+    the f32-equivalent bytes of the quantized weights, and the ratio —
+    also pushed to the ``dl4jtpu_quant_params_bytes`` gauge."""
+    import numpy as np
+
+    total = 0
+    quantized = 0
+    f32_equiv = 0
+    for leaf in jax.tree.leaves(params):
+        total += int(getattr(leaf, "nbytes", 0))
+    for qt in _iter_quantized(params):
+        quantized += qt.nbytes
+        f32_equiv += int(np.prod(qt.shape)) * 4
+    return {
+        "tree_bytes": total,
+        "quantized_bytes": quantized,
+        "f32_equiv_bytes": f32_equiv,
+        "ratio": (quantized / f32_equiv) if f32_equiv else None,
+    }
+
+
+def _macro_f1(y_true, y_pred, n_classes: int) -> float:
+    import numpy as np
+
+    f1s = []
+    for c in range(n_classes):
+        tp = int(np.sum((y_pred == c) & (y_true == c)))
+        fp = int(np.sum((y_pred == c) & (y_true != c)))
+        fn = int(np.sum((y_pred != c) & (y_true == c)))
+        denom = 2 * tp + fp + fn
+        f1s.append((2 * tp / denom) if denom else 1.0)
+    return float(np.mean(f1s))
+
+
+def parity_check(reference, quantized, features, labels=None, *,
+                 top1_tol: float = 0.01, f1_tol: float = 0.02) -> dict:
+    """The evaluation-parity gate quantized serving ships behind.
+
+    Runs both models' `output()` on `features` and compares argmax
+    predictions: without `labels`, top-1 DISAGREEMENT between the two
+    models must stay within ``top1_tol``; with integer `labels`, the
+    top-1 accuracy delta (vs the labels) gates on ``top1_tol`` and the
+    macro-F1 delta on ``f1_tol``.  The verdict lands on
+    ``dl4jtpu_quant_parity_checks_total{result=pass|fail}`` and the
+    full measurement comes back for bench rows / test asserts.
+    """
+    import numpy as np
+
+    ref_out = reference.output(features)
+    q_out = quantized.output(features)
+    if isinstance(ref_out, tuple):          # multi-output graph: head 0
+        ref_out, q_out = ref_out[0], q_out[0]
+    ref_pred = np.asarray(ref_out).argmax(axis=-1).ravel()
+    q_pred = np.asarray(q_out).argmax(axis=-1).ravel()
+    result = {
+        "n": int(ref_pred.size),
+        "top1_agreement": float((ref_pred == q_pred).mean()),
+    }
+    result["top1_delta"] = 1.0 - result["top1_agreement"]
+    ok = result["top1_delta"] <= top1_tol
+    if labels is not None:
+        y = np.asarray(labels).ravel().astype(np.int64)
+        n_classes = int(np.asarray(ref_out).shape[-1])
+        result["top1_ref"] = float((ref_pred == y).mean())
+        result["top1_quant"] = float((q_pred == y).mean())
+        result["top1_delta"] = abs(
+            result["top1_ref"] - result["top1_quant"]
+        )
+        result["f1_ref"] = _macro_f1(y, ref_pred, n_classes)
+        result["f1_quant"] = _macro_f1(y, q_pred, n_classes)
+        result["f1_delta"] = abs(result["f1_ref"] - result["f1_quant"])
+        ok = (result["top1_delta"] <= top1_tol
+              and result["f1_delta"] <= f1_tol)
+    result["pass"] = bool(ok)
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        registry().counter("dl4jtpu_quant_parity_checks_total").inc(
+            result="pass" if ok else "fail"
+        )
+    except Exception as e:
+        log.debug("quant parity metric failed: %s", e)
+    return result
+
+
+def _gauge_bytes(params) -> None:
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        b = quantized_bytes(params)
+        g = registry().gauge("dl4jtpu_quant_params_bytes")
+        g.set(b["quantized_bytes"], kind="quantized")
+        g.set(b["f32_equiv_bytes"], kind="f32_equiv")
+    except Exception as e:
+        log.debug("quant params-bytes gauge failed: %s", e)

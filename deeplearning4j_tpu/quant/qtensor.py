@@ -1,0 +1,115 @@
+"""QuantizedTensor — the `(int8 q, f32 scale)` weight pair as a pytree node.
+
+Registering the pair as a pytree node (with keys, so path-flattened
+views name the children ``...W.q`` / ``...W.scale``) is what makes the
+quantized tree flow through the whole stack unchanged: jit flattens it
+into plain int8/f32 leaves, hot-swap verification checks those leaves'
+shape/dtype/finiteness individually (finiteness already skips integer
+dtypes), checkpoints save/load them positionally, and `tree_bytes` /
+`param_count` just work.
+
+Dequantization is ``q.astype(dtype) * scale`` with the scale broadcast
+over the LAST axis — the output-channel axis for every supported weight
+layout ((n_in, n_out) dense/embedding, HWIO/…IO conv kernels).
+``astype`` aliases `dequant`, so any layer that still runs the classic
+``params["W"].astype(x.dtype)`` idiom transparently gets the
+dequantized f32 weights (correct, if unfused) instead of crashing.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+class QuantizedTensor:
+    """One quantized weight: int8 values + per-output-channel f32 scales.
+
+    ``q``: int8 array of the original weight's shape; ``scale``: f32 of
+    shape ``(q.shape[-1],)``.  Dequantized value ≈ ``q * scale``.
+    """
+
+    __slots__ = ("q", "scale")
+
+    def __init__(self, q, scale):
+        self.q = q
+        self.scale = scale
+
+    # -- array-ish surface -------------------------------------------------
+    @property
+    def shape(self):
+        return self.q.shape
+
+    @property
+    def ndim(self) -> int:
+        return self.q.ndim
+
+    @property
+    def dtype(self):
+        """Storage dtype (int8) — what the tree's weight leaf holds."""
+        return self.q.dtype
+
+    @property
+    def nbytes(self) -> int:
+        return int(getattr(self.q, "nbytes", 0)) + int(
+            getattr(self.scale, "nbytes", 0)
+        )
+
+    def dequant(self, dtype=jnp.float32):
+        """The dense weights this pair stands for (f32 accumulate path:
+        cast THEN scale, both in the target dtype)."""
+        return self.q.astype(dtype) * self.scale.astype(dtype)
+
+    # legacy layer idiom `params["W"].astype(x.dtype)` keeps working —
+    # it just pays the unfused dequantize-then-use cost
+    astype = dequant
+
+    def __repr__(self) -> str:
+        return (f"QuantizedTensor(shape={tuple(self.shape)}, "
+                f"scale_shape={tuple(np.shape(self.scale))})")
+
+
+def _flatten_with_keys(t: QuantizedTensor):
+    return (
+        ((jax.tree_util.GetAttrKey("q"), t.q),
+         (jax.tree_util.GetAttrKey("scale"), t.scale)),
+        None,
+    )
+
+
+def _flatten(t: QuantizedTensor):
+    return (t.q, t.scale), None
+
+
+def _unflatten(aux, children) -> QuantizedTensor:
+    q, scale = children
+    return QuantizedTensor(q, scale)
+
+
+jax.tree_util.register_pytree_with_keys(
+    QuantizedTensor, _flatten_with_keys, _unflatten, _flatten,
+)
+
+
+def quantize_array(w, *, bits: int = 8) -> QuantizedTensor:
+    """Symmetric per-output-channel int8 quantization of one weight.
+
+    The channel axis is the LAST axis (n_out for dense/embedding, O for
+    HWIO conv kernels); the scale is ``max|w|/127`` per channel and
+    values round to ``[-127, 127]`` (the symmetric range — -128 is never
+    used, so q and -q are both representable).  All-zero channels get
+    scale 1.0 so dequantization stays exact.  Host-side numpy on
+    purpose: PTQ is an offline transform, not a traced op.
+    """
+    if bits != 8:
+        raise ValueError(f"only int8 supported (got bits={bits})")
+    a = np.asarray(w, dtype=np.float32)
+    if a.ndim < 1:
+        raise ValueError("cannot channel-quantize a scalar")
+    qmax = 127.0
+    amax = np.max(np.abs(a), axis=tuple(range(a.ndim - 1)))
+    scale = amax / qmax
+    scale = np.where(scale > 0.0, scale, 1.0).astype(np.float32)
+    q = np.clip(np.round(a / scale), -qmax, qmax).astype(np.int8)
+    return QuantizedTensor(jnp.asarray(q), jnp.asarray(scale))

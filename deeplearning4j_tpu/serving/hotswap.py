@@ -22,9 +22,14 @@ order:
    SOURCE (`weights_checksum`) and carried with the push: bit rot in
    transit fails here (checkpoint pushes get this via the manifest CRC
    in `ModelSerializer.verify` instead);
-4. **finiteness** — every float leaf all-finite, the `iter_valid`
+4. **finiteness** — every FLOAT leaf all-finite, the `iter_valid`
    lesson from the recovery plane: integrity proves the bytes arrived,
-   not that they are worth serving.
+   not that they are worth serving.  Integer leaves are skipped, not
+   rejected: an int8-quantized tree (quant/ptq.py) flattens to mixed
+   int8 weight + f32 scale leaves, and NaN can only live in the
+   scales — which ARE checked.  A quantized push against an f32 live
+   tree (or vice versa) fails the structure check up front, so a
+   trainer can never half-quantize a serving replica.
 """
 
 from __future__ import annotations

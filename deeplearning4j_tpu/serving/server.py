@@ -143,6 +143,10 @@ class InferenceServer:
         self.n_outputs = len(getattr(
             getattr(model, "conf", None), "network_outputs", (),
         )) or 1
+        # int8-quantized model (quant/ptq.py): advertised on the status
+        # surfaces; the dispatch/hot-swap/warm-start machinery is tree-
+        # shape-agnostic (QuantizedTensor flattens to int8+f32 leaves)
+        self.quantized = bool(getattr(model, "_quantized", None))
         self.queue = AdmissionQueue(self.config.max_queue)
         self.breaker = CircuitBreaker(
             threshold=self.config.breaker_threshold,
@@ -1005,6 +1009,7 @@ class InferenceServer:
             "batch_latency_ewma_s": ewma,
             "weights_generation": self.generation,
             "queue_depth": self.queue.depth,
+            "quantized": self.quantized,
         }
 
     def stats(self) -> dict:
@@ -1040,6 +1045,7 @@ class InferenceServer:
             "queue_depth": self.queue.depth,
             "generation": self.generation,
             "weights_generation": self.generation,
+            "quantized": self.quantized,
             "shed_pressure": round(self.shed_pressure(), 6),
             "breaker_state": self.breaker.state,
             "batch_latency_ewma_s": ewma,

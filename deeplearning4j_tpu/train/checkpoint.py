@@ -226,16 +226,18 @@ class ModelSerializer:
             put("netstate.npz", *_npz_bytes(model.net_state))
             if save_updater and model.opt_state is not None:
                 put("updater.npz", *_npz_bytes(model.opt_state))
-            put(
-                "meta.json",
-                json.dumps(
-                    {
-                        "format_version": FORMAT_VERSION,
-                        "iteration": model.iteration,
-                        "epoch": model.epoch,
-                    }
-                ).encode(),
-            )
+            meta = {
+                "format_version": FORMAT_VERSION,
+                "iteration": model.iteration,
+                "epoch": model.epoch,
+            }
+            quantized = getattr(model, "_quantized", None)
+            if quantized is not None:
+                # restore must rebuild the (int8, scale) tree STRUCTURE
+                # before streaming leaves in — record the scheme so it
+                # can re-run the same config-derived quantization walk
+                meta["quantized"] = quantized
+            put("meta.json", json.dumps(meta).encode())
             zf.writestr(MANIFEST_NAME, json.dumps({
                 "format_version": FORMAT_VERSION,
                 "entries": manifest_entries,
@@ -337,11 +339,21 @@ class ModelSerializer:
                 model = GraphModel(conf).init()
             else:
                 raise ValueError(f"unknown model class in checkpoint: {model_class}")
+            meta = json.loads(zf.read("meta.json"))
+            if meta.get("quantized") is not None:
+                # a quantized checkpoint: re-derive the (int8, scale)
+                # tree structure from the config with the SAME recorded
+                # knobs (placeholder values), then let the positional
+                # load below stream the real leaves in
+                from deeplearning4j_tpu.quant.ptq import (
+                    requantize_structure,
+                )
+
+                model = requantize_structure(model, meta["quantized"])
             model.params = _load_npz_into(zf, "params.npz", model.params)
             model.net_state = _load_npz_into(zf, "netstate.npz", model.net_state)
             if "updater.npz" in zf.namelist():
                 model.opt_state = _load_npz_into(zf, "updater.npz", model.opt_state)
-            meta = json.loads(zf.read("meta.json"))
             model.iteration = meta.get("iteration", 0)
             model.epoch = meta.get("epoch", 0)
         return model

@@ -30,6 +30,9 @@ def tree_flatten_with_paths(tree):
                 parts.append(str(p.key))
             elif hasattr(p, "idx"):
                 parts.append(str(p.idx))
+            elif hasattr(p, "name"):
+                # GetAttrKey (e.g. QuantizedTensor's .q / .scale)
+                parts.append(str(p.name))
             else:
                 parts.append(str(p))
         out.append((".".join(parts), leaf))

@@ -1,0 +1,46 @@
+"""tpulint golden fixture: Pallas kernel bodies are jit scopes (TP).
+
+test_analysis.py asserts the EXACT (rule, line) pairs below — keep the
+line layout stable or update the goldens.
+"""
+import functools
+import time
+
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+
+def impure_kernel(x_ref, o_ref):
+    o_ref[...] = x_ref[...] * time.time()   # line 14: TP001
+
+
+def run_impure(x):
+    return pl.pallas_call(
+        impure_kernel,
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def partial_kernel(x_ref, o_ref, *, n_k, causal):
+    if causal:                              # static partial kw: NOT RH102
+        o_ref[...] = x_ref[...] * n_k
+    print("kernel trace")                   # line 27: TP002
+
+
+def run_partial(x):
+    return pl.pallas_call(
+        functools.partial(partial_kernel, n_k=4, causal=True),
+        out_shape=jax.ShapeDtypeStruct(x.shape, x.dtype),
+    )(x)
+
+
+def clean_kernel(x_ref, o_ref, *, scale):
+    # pure: dequant-style cast + scale — must stay silent
+    o_ref[...] = x_ref[...].astype(jnp.float32) * scale
+
+
+def run_clean(x):
+    return pl.pallas_call(
+        functools.partial(clean_kernel, scale=2.0),
+        out_shape=jax.ShapeDtypeStruct(x.shape, jnp.float32),
+    )(x)

@@ -52,6 +52,20 @@ class TestGoldenFixtures:
         assert "telemetry_step -> bump_metrics" in got[3].message
         assert got[0].symbol == "impure_step"
 
+    def test_tp_pallas_kernels_are_jit_scopes(self):
+        """ISSUE 14: the function handed to pl.pallas_call — bare or
+        wrapped in functools.partial — is a traced region for the TP
+        family, with the partial's keyword bindings treated as static
+        (a kernel's `if causal:` is specialization, not a tracer
+        branch); a pure kernel stays silent."""
+        got = lint_fixture("tp_pallas.py")
+        assert pairs(got) == [
+            ("TP001", 14),       # time.time() in a pallas kernel
+            ("TP002", 27),       # print() in a partial-wrapped kernel
+        ]
+        # `if causal:` (static partial kw, line 25) must NOT flag RH102
+        assert not any(f.line == 25 for f in got)
+
     def test_rh_recompile_hazards(self):
         got = lint_fixture("rh_violations.py")
         assert pairs(got) == [
@@ -338,6 +352,12 @@ class TestTier1Gate:
             "dl4jtpu_registry_families",
             "dl4jtpu_registry_series",
         } <= fams
+        # ISSUE-14 int8 post-training-quantization families
+        assert {
+            "dl4jtpu_quant_params_bytes",
+            "dl4jtpu_quant_dequant_matmul_total",
+            "dl4jtpu_quant_parity_checks_total",
+        } <= fams
         sites = load_fault_sites(REPO)
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",
@@ -346,9 +366,9 @@ class TestTier1Gate:
             "serving.admit", "serving.infer", "serving.hotswap",
             "serving.route", "serving.canary",
         }
-        assert {"slow", "faults", "serving", "slo"} <= load_declared_marks(
-            REPO
-        )
+        assert {
+            "slow", "faults", "serving", "slo", "quant",
+        } <= load_declared_marks(REPO)
 
 
 # -- CLI ---------------------------------------------------------------

@@ -26,7 +26,7 @@ def test_serving_bench_quick_run_and_schema():
     )
     assert proc.returncode == 0, proc.stderr[-2000:]
     out = json.loads(proc.stdout.strip().splitlines()[-1])
-    assert out["schema"] == "bench-serving/2"
+    assert out["schema"] == "bench-serving/3"
     assert out["platform"] == "cpu"
     assert out["env"]["jax"]
     for row in out["curve"]:
@@ -71,6 +71,21 @@ def test_serving_bench_quick_run_and_schema():
     slo = out["slo"]
     assert slo["alert_fired"] and slo["alert_cleared"]
     assert slo["alerts_total"] >= 1
+    # ISSUE 14 quantized columns: the parity gate holds, both servers
+    # were actually driven, and the kernel table compares every impl
+    # against the XLA dequantize-then-dot baseline
+    q = out["quantized"]
+    assert q["scheme"] == "int8-perchannel-symmetric/1"
+    assert q["parity"]["pass"]
+    for row in q["curve"]:
+        assert row["f32_rps"] > 0 and row["int8_rps"] > 0
+        assert row["speedup_vs_f32"] is not None
+    assert 0.2 < q["bytes"]["ratio"] < 0.5
+    for row in q["kernel_bench"]:
+        assert row["xla_ms"] > 0 and row["blocked_ms"] > 0
+        assert row["selected"] in ("pallas", "blocked", "xla")
+    assert q["kernel_bench"][0]["pallas_ms"] > 0
+    assert q["modeled_tpu"]["modeled_speedup"] >= 1.2
 
 
 def test_serving_fleet_bench_quick_run_and_schema():
@@ -113,6 +128,43 @@ def test_serving_fleet_bench_quick_run_and_schema():
     assert chaos["post"]["ok"] > 0
 
 
+@pytest.mark.quant
+def test_longctx_quant_bench_quick_run_and_schema():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = ""          # bench decides; avoid conftest leak
+    env["BENCH_QUICK"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--longctx"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["schema"] == "bench-longctx-quant/1"
+    assert out["f32_tokens_per_sec"] > 0
+    assert out["int8_tokens_per_sec"] > 0
+    assert out["speedup_vs_f32"] is not None
+    assert 0.2 < out["bytes"]["ratio"] < 0.5
+    # the quantized transformer's matmul sites actually lowered through
+    # the dequant-matmul dispatch
+    assert sum(out["dequant_matmul_lowerings"].values()) > 0
+    assert out["prediction_agreement"] > 0.9
+
+
+@pytest.mark.quant
+def test_committed_longctx_quant_table():
+    path = os.path.join(REPO, "BENCH_LONGCTX_QUANT.json")
+    assert os.path.exists(path), "BENCH_LONGCTX_QUANT.json not committed"
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["schema"] == "bench-longctx-quant/1"
+    assert not doc["quick"]
+    assert doc["f32_tokens_per_sec"] > 0
+    assert doc["int8_tokens_per_sec"] > 0
+    assert 0.2 < doc["bytes"]["ratio"] < 0.5
+    assert sum(doc["dequant_matmul_lowerings"].values()) > 0
+
+
 def test_committed_serving_fleet_table_meets_acceptance():
     """The COMMITTED BENCH_SERVING_FLEET.json (full run) carries the
     ISSUE 12 acceptance: the chaos run (one replica hard-killed
@@ -151,7 +203,7 @@ def test_committed_serving_table_meets_acceptance():
     assert os.path.exists(path), "BENCH_SERVING.json not committed"
     with open(path) as f:
         doc = json.load(f)
-    assert doc["schema"] == "bench-serving/2"
+    assert doc["schema"] == "bench-serving/3"
     assert not doc["quick"]
     assert len(doc["curve"]) >= 4
     chaos = doc["chaos"]
@@ -170,3 +222,25 @@ def test_committed_serving_table_meets_acceptance():
     slo = doc["slo"]
     assert slo["alert_fired"] and slo["fired_within_fast_window"]
     assert slo["alert_cleared"]
+    # ISSUE 14: quantized serving rows.  The parity gate and the
+    # kernel-vs-XLA-baseline table are platform-independent facts; the
+    # >=1.2x throughput acceptance binds to the MEASURED column on TPU
+    # runs and to the roofline-modeled column on CPU runs (weight-only
+    # int8 is ~parity on a latency-bound CPU host — the committed
+    # measured_platform_note and docs/quantization.md spell this out)
+    q = doc["quantized"]
+    assert q["parity"]["pass"]
+    assert q["parity"]["top1_delta"] <= 0.01
+    # the gate is only meaningful on a model that LEARNED the task
+    assert q["parity"]["top1_ref"] > 0.8
+    assert len(q["curve"]) >= 2
+    for row in q["curve"]:
+        assert row["speedup_vs_f32"] is not None
+    assert len(q["kernel_bench"]) >= 3
+    for row in q["kernel_bench"]:
+        assert row["xla_ms"] > 0 and row["blocked_ms"] > 0
+    if doc["platform"] == "tpu":
+        assert max(r["speedup_vs_f32"] for r in q["curve"]) >= 1.2
+    else:
+        assert q["modeled_tpu"]["modeled_speedup"] >= 1.2
+        assert "measured_platform_note" in q
