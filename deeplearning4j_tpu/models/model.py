@@ -565,8 +565,16 @@ class Model:
         the weight bytes — the cost registry must not attribute one
         variant's flops/bytes/roofline analysis to the other."""
         suffix = ()
-        if self._zero_placement is not None:
-            suffix += ("zero1",)
+        zero = self._zero_placement
+        if zero is not None:
+            from deeplearning4j_tpu.parallel.zero import Zero2Placement
+
+            if isinstance(zero, Zero2Placement):
+                # the accumulation count changes the traced program
+                # (scan length), not just the sharding annotations
+                suffix += (f"zero2x{zero.accum}",)
+            else:
+                suffix += ("zero1",)
         if getattr(self, "_quantized", None) is not None:
             suffix += ("int8",)
         return suffix

@@ -526,6 +526,44 @@ class SequentialModel(Model):
             def core(params, opt_state, net_state, step_i, features,
                      labels, lm, fm, carries):
                 rng = SeedStream.fold(self._stream.root, step_i)
+                zp = self._zero_placement
+                accum = getattr(zp, "accum", 1) if zp is not None else 1
+                if accum > 1 and not with_carries:
+                    # ZeRO-2 microbatch accumulation: scan over m
+                    # microbatches with the grad accumulator SHARDED in
+                    # the carry (parallel/zero.py scan_accumulate) — no
+                    # full replicated gradient persists across the
+                    # accumulation, activation memory drops ~1/m
+                    from deeplearning4j_tpu.parallel.zero import (
+                        split_accum_microbatches,
+                    )
+
+                    micro = split_accum_microbatches(
+                        (features, labels, lm, fm), accum
+                    )
+
+                    def loss_grad_fn(p, state, arrays, micro_i):
+                        f, l, lmm, fmm = arrays
+                        # distinct noise per microbatch: dropout et al.
+                        # must not repeat the same mask m times
+                        rng_i = SeedStream.fold(rng, micro_i)
+
+                        def lf(pp):
+                            loss, new_state, _ = self._step_loss(
+                                pp, state, f, l, lmask=lmm, fmask=fmm,
+                                rng=rng_i, carries=None,
+                            )
+                            return loss, {**state, **new_state}
+
+                        return jax.value_and_grad(lf, has_aux=True)(p)
+
+                    loss, merged_state, grads = zp.scan_accumulate(
+                        loss_grad_fn, params, net_state, micro
+                    )
+                    params, opt_state = self._apply_grads(
+                        params, opt_state, grads
+                    )
+                    return params, opt_state, merged_state, loss, {}
 
                 def loss_fn(p):
                     loss, new_state, new_carries = self._step_loss(

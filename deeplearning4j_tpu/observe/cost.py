@@ -532,6 +532,86 @@ def register_attr_program(owner, attr: str, kind: str, key: Any, fn):
     return wrapped
 
 
+class SignatureAnalysis:
+    """Result of a dispatch-free lowering: XLA cost numbers for a
+    program traced from an ABSTRACT signature — or the reason the
+    analysis could not produce them.  `ok` is True only when flops came
+    back; callers (the autosharding planner) must treat a False result
+    as "do not price this", never as zero cost."""
+
+    __slots__ = ("flops", "bytes_accessed", "ok", "reason")
+
+    def __init__(self, flops=None, bytes_accessed=None, reason=None):
+        self.flops = flops
+        self.bytes_accessed = bytes_accessed
+        self.ok = flops is not None
+        self.reason = reason
+
+    def as_dict(self) -> dict:
+        return {
+            "flops": self.flops,
+            "bytes_accessed": self.bytes_accessed,
+            "ok": self.ok,
+            "reason": self.reason,
+        }
+
+
+def analyze_signature(fn, sig) -> SignatureAnalysis:
+    """Dispatch-free cost analysis: lower `fn` from `sig` (a pytree of
+    jax.ShapeDtypeStruct / concrete placeholders — the positional args
+    tuple) and read ``cost_analysis()`` off the lowering.  No device
+    execution and no backend compile happen — one abstract re-trace.
+
+    The lazy ProgramRecord path (``ensure_analysis``) needs a first
+    real dispatch to capture its signature; the autosharding planner
+    prices candidate placements BEFORE anything ever runs, so this is
+    its entry point.  `fn` may be a registry wrapper (the ``_register_
+    program`` product — its ``__wrapped__`` jitted inner is used), a
+    raw jitted function, or anything exposing ``.lower``.
+
+    Failures (jax 0.4.37/CPU omissions, untraceable signatures) come
+    back as a reason string on the result — the planner records them as
+    per-candidate rejection reasons instead of pricing garbage."""
+    import warnings
+
+    inner = getattr(fn, "__wrapped__", fn)
+    lower = getattr(inner, "lower", None)
+    if lower is None:
+        return SignatureAnalysis(
+            reason=f"not lowerable: {type(inner).__name__} has no .lower"
+        )
+    try:
+        with warnings.catch_warnings():
+            # abstract lowering repeats the dispatch path's donation /
+            # sharding advisories; under warnings-as-errors they would
+            # abort a perfectly good analysis
+            warnings.simplefilter("ignore")
+            lowered = lower(*sig)
+    except Exception as e:
+        return SignatureAnalysis(
+            reason=f"lower failed ({type(e).__name__}: {e})"
+        )
+    try:
+        ca = lowered.cost_analysis()
+    except Exception as e:
+        return SignatureAnalysis(
+            reason=f"cost_analysis failed ({type(e).__name__}: {e})"
+        )
+    if isinstance(ca, (list, tuple)):
+        ca = ca[0] if ca else {}
+    ca = ca or {}
+    flops = float(ca["flops"]) if "flops" in ca else None
+    bytes_accessed = (
+        float(ca["bytes accessed"]) if "bytes accessed" in ca else None
+    )
+    if flops is None:
+        return SignatureAnalysis(
+            bytes_accessed=bytes_accessed,
+            reason="cost_analysis reported no flops",
+        )
+    return SignatureAnalysis(flops=flops, bytes_accessed=bytes_accessed)
+
+
 def analyze_model(model, memory: bool = False) -> list[ProgramRecord]:
     """Cost-analyze every live program owned by `model` (lazy trigger
     for tests/bench/reporting)."""

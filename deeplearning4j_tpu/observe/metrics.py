@@ -540,6 +540,13 @@ def _declare_core(reg: MetricsRegistry) -> None:
               "distribute()d model, by mode (sharded=ZeRO-1 data-axis "
               "shards, replicated=classic DP) — the quantity zero=1 "
               "shrinks ~1/n")
+    reg.gauge("dl4jtpu_grad_state_bytes",
+              "Per-replica gradient-state bytes of the last "
+              "distribute()d model, by mode (zero2=the persistently "
+              "sharded grad accumulator, ~params/n per replica; "
+              "replicated/sharded=the full params-sized transient "
+              "gradient every replica still materializes under "
+              "zero∈{0,1}) — the quantity zero=2 shrinks ~1/n")
     reg.counter("dl4jtpu_update_seconds_total",
                 "Calibrated standalone weight-update-epilogue seconds, "
                 "by mode (sharded/replicated).  The fused step program "
@@ -547,6 +554,21 @@ def _declare_core(reg: MetricsRegistry) -> None:
                 "equivalent jitted update once per measurement "
                 "(parallel/zero.py measure_update_seconds; bench "
                 "--scaling's update_time_ms columns)")
+    # autosharding planner (parallel/planner.py): candidate pricing is
+    # dispatch-free (lowered-only cost analysis), so these are set by
+    # plan() itself, not by any step
+    reg.counter("dl4jtpu_plan_candidates_total",
+                "Candidate ParallelConfigs the autosharding planner "
+                "examined, by verdict (priced=entered the argmin, "
+                "rejected=legality/divisibility/memory/analysis "
+                "failure with a recorded reason)")
+    reg.gauge("dl4jtpu_plan_seconds",
+              "Wall seconds the last plan() spent enumerating and "
+              "pricing its candidate set (no device executions, no "
+              "backend compiles)")
+    reg.gauge("dl4jtpu_plan_predicted_step_seconds",
+              "The cost model's predicted step seconds for the last "
+              "plan()'s picked ParallelConfig")
     # serving plane (serving/): admission, batching, degradation and
     # weight hot-swap telemetry — p50/p99 come from the latency
     # histogram's buckets, queue/breaker state from the gauges

@@ -11,6 +11,11 @@ from deeplearning4j_tpu.parallel.pipeline import (
     pipeline_apply,
     pipeline_train_1f1b,
 )
+from deeplearning4j_tpu.parallel.planner import (
+    PlanError,
+    PlanReport,
+    plan,
+)
 from deeplearning4j_tpu.parallel.strategy import ParallelConfig, param_specs
 from deeplearning4j_tpu.parallel.wrapper import ParallelInference, ParallelWrapper
 
@@ -22,4 +27,7 @@ __all__ = [
     "ParallelInference",
     "pipeline_apply",
     "pipeline_train_1f1b",
+    "plan",
+    "PlanError",
+    "PlanReport",
 ]

@@ -38,11 +38,55 @@ from deeplearning4j_tpu.runtime.mesh import (
 )
 
 
-def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=None):
+def distribute(model, config: ParallelConfig | None = None, devices=None,
+               mesh=None, auto: bool = False, batch=None,
+               memory_cap_bytes: int | None = None):
     """Place an initialized model's state onto a mesh and make fit()/output()
-    shard incoming batches.  Returns the model (for chaining)."""
+    shard incoming batches.  Returns the model (for chaining).
+
+    ``auto=True`` (or DL4J_TPU_AUTO_PLAN=1 with no explicit config)
+    hands placement to the autosharding planner (parallel/planner.py):
+    candidate ParallelConfigs are enumerated, priced WITHOUT a device
+    run (lowered-only cost analysis + roofline + analytic collective
+    terms), memory-gated, and the argmin is installed.  `batch` (a
+    DataSet / (x, y) example, optional — derivable from the model's
+    input type) fixes the batch signature the plan prices;
+    `memory_cap_bytes` tightens the per-replica feasibility gate.  The
+    chosen plan is kept on ``model._plan_report`` and served at
+    ``GET /api/plan``."""
     if model.params is None:
         model.init()
+    if not auto and config is None:
+        from deeplearning4j_tpu.runtime.flags import environment
+
+        auto = environment().auto_plan
+    if auto:
+        if config is not None:
+            raise ValueError(
+                "distribute(auto=True) derives the ParallelConfig — "
+                "pass one or the other, not both"
+            )
+        if mesh is not None:
+            raise ValueError(
+                "distribute(auto=True) sizes the mesh to the planned "
+                "pick — an explicit mesh= would silently override the "
+                "priced placement; pass devices= to bound the search "
+                "instead"
+            )
+        from deeplearning4j_tpu.parallel import planner
+
+        report = planner.plan(
+            model, devices=devices, batch=batch,
+            memory_cap_bytes=memory_cap_bytes,
+        )
+        config = report.pick
+        model._plan_report = report
+        # the pick may be UNDERFILLED (narrower than the hardware —
+        # partition overhead can outrun the parallel win); the mesh
+        # must be exactly the pick's size
+        used = report.pick_candidate().devices_used
+        devices = (list(devices) if devices is not None
+                   else jax.devices())[:used]
     config = config or ParallelConfig.data_parallel()
     mesh = mesh or config.build_mesh(devices)
 
@@ -57,19 +101,56 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
         from deeplearning4j_tpu.runtime.flags import environment
 
         zero = environment().zero
-    if zero not in (0, 1):
+    if zero not in (0, 1, 2):
         raise ValueError(
             f"unknown zero stage {zero!r}; options: 0 (replicated "
-            "update), 1 (sharded opt state + update)"
+            "update), 1 (sharded opt state + update), 2 (ZeRO-1 + "
+            "persistently sharded gradients)"
         )
-    if zero == 1 and (tp or ep or pp or sp_on
+    if zero >= 1 and (tp or ep or pp or sp_on
                       or config.grad_compression != "none"):
         raise ValueError(
-            "zero=1 composes with pure data parallelism only (the "
-            "weight-update shards ride the data axis); drop the "
+            f"zero={zero} composes with pure data parallelism only "
+            "(the weight-update shards ride the data axis); drop the "
             "model/pipe/seq/expert axes and grad_compression, or the "
             "zero stage"
         )
+    if config.grad_accum > 1:
+        if zero != 2:
+            raise ValueError(
+                f"grad_accum={config.grad_accum} is the ZeRO-2 "
+                "microbatch-accumulation knob; set zero=2 (the sharded "
+                "accumulator is what makes accumulation memory-safe)"
+            )
+        if not hasattr(model, "_get_step_fn") or not hasattr(
+            model, "_step_loss"
+        ):
+            raise NotImplementedError(
+                f"{type(model).__name__} does not support ZeRO-2 "
+                "microbatch accumulation (SequentialModel's "
+                "single-batch step owns the accumulation scan)"
+            )
+        # the accumulation scan lives in the single-batch no-carries
+        # step only — a fit that would route through TBPTT or the
+        # carry-threading path would silently ignore the knob and the
+        # promised ~1/m activation-memory reduction would never happen
+        from deeplearning4j_tpu.nn.conf.recurrent import (
+            RecurrentLayerConfig,
+        )
+
+        conf_obj = getattr(model, "conf", None)
+        if conf_obj is not None and (
+            getattr(conf_obj, "backprop_type", "") == "tbptt"
+            or any(isinstance(l, RecurrentLayerConfig)
+                   for l in getattr(conf_obj, "layers", ()))
+        ):
+            raise NotImplementedError(
+                "grad_accum > 1 applies to the single-batch "
+                "feed-forward/CNN step; TBPTT and recurrent "
+                "carry-threading fits do not run the accumulation "
+                "scan — drop grad_accum (zero=2 itself still works "
+                "there)"
+            )
 
     if tp or ep:
         specs = param_specs(
@@ -84,22 +165,40 @@ def distribute(model, config: ParallelConfig | None = None, devices=None, mesh=N
     model.net_state = replicate(model.net_state, mesh)
     from deeplearning4j_tpu.parallel import zero as zero_mod
 
-    if zero == 1:
+    if zero == 2:
+        # ZeRO-2: the opt state is wrapped with a params-shaped grad
+        # accumulator and BOTH live sharded over the data axis; the
+        # epilogue (Zero2Placement.apply) reduce-scatters grads once
+        # into the accumulator, updates per shard, all-gathers params
+        # and re-zeroes the (still resident, still sharded) accumulator
+        model.opt_state = zero_mod.wrap_opt_state(
+            model.params, model.opt_state
+        )
+        model.opt_state = shard_zero1(model.opt_state, mesh)
+        model._zero_placement = zero_mod.Zero2Placement.build(
+            model.params, model.opt_state, mesh,
+            accum=config.grad_accum,
+        )
+    elif zero == 1:
         # ZeRO-1: opt state lives sharded over the data axis; the step
         # programs' update epilogue (Zero1Placement.apply via
         # Model._apply_grads) reduce-scatters grads, updates per shard
-        # and all-gathers params
+        # and all-gathers params.  A prior zero=2 wrapper is dropped
+        # (the accumulator is zeros between steps; nothing is lost).
+        model.opt_state, _ = zero_mod.unwrap_opt_state(model.opt_state)
         model.opt_state = shard_zero1(model.opt_state, mesh)
         model._zero_placement = zero_mod.Zero1Placement.build(
             model.params, model.opt_state, mesh
         )
     else:
+        model.opt_state, _ = zero_mod.unwrap_opt_state(model.opt_state)
         model.opt_state = replicate(model.opt_state, mesh)
-        # a prior distribute(zero=1) must not leak its epilogue into
+        # a prior distribute(zero>=1) must not leak its epilogue into
         # the re-placed replicated state
         model._zero_placement = None
     zero_mod.gauge_opt_state_bytes(
-        model, "sharded" if zero == 1 else "replicated"
+        model,
+        {0: "replicated", 1: "sharded", 2: "zero2"}[zero],
     )
     if pp:
         if not hasattr(model, "_setup_pipeline"):

@@ -60,8 +60,19 @@ class ParallelConfig:
     #   1    -> opt state and the update computation sharded over the
     #           data axis: reduce-scatter grads -> per-shard optimizer
     #           update -> all-gather params (parallel/zero.py)
+    #   2    -> ZeRO-1 plus persistently sharded gradients: a
+    #           params-shaped grad accumulator lives sharded over the
+    #           data axis (inside the wrapped opt_state), each step's
+    #           grads are reduce-scattered once into it, and no full
+    #           replicated gradient persists between (micro)batches
     #   None -> read flags.environment().zero (env DL4J_TPU_ZERO)
     zero: int | None = None
+    # ZeRO-2 microbatch accumulation: the single-batch step splits its
+    # batch into `grad_accum` microbatches and scans over them with the
+    # sharded accumulator in the carry (activation memory ~1/m, grad
+    # state stays 1/n).  1 = no split (bitwise-exact parity with the
+    # replicated epilogue); >1 requires zero=2.
+    grad_accum: int = 1
 
     def mesh_spec(self) -> MeshSpec:
         # the data axis is ALWAYS present (size 1 degrades gracefully) so

@@ -1,4 +1,4 @@
-"""ZeRO-1 sharded weight update for the data-parallel path.
+"""ZeRO-1/2 sharded weight update for the data-parallel path.
 
 "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
 Training" (PAPERS.md) observes that classic DP wastes O(model) memory
@@ -23,10 +23,33 @@ Per-replica optimizer-state memory and update compute both drop to
 ~1/n for every leaf whose leading dim divides the data-axis size;
 ragged/small leaves stay replicated (strategy.zero1_spec_for_leaf).
 
+**ZeRO-2** (`zero=2`, `Zero2Placement`) layers persistently sharded
+GRADIENTS on top: the model carries a params-shaped grad accumulator
+placed with the same data-axis shards as the update
+(``opt_state = {"opt": <optax state>, "grad_accum": <sharded zeros>}``
+— `wrap_opt_state`).  Each step's gradients are reduce-scattered ONCE
+into the sharded accumulator, the optax step runs per-shard against it,
+params are all-gathered, and the accumulator is re-zeroed (still
+sharded, still resident — the persistent grad state the
+``dl4jtpu_grad_state_bytes{mode="zero2"}`` gauge reads, ~params/n per
+replica).  With ``ParallelConfig(grad_accum=m > 1)`` the single-batch
+step splits its batch into m microbatches and lax.scans over them,
+accumulating each microbatch's reduce-scattered grads in a SHARDED
+carry — the accumulation never materializes a full replicated gradient
+(the ZeRO-2 memory claim) and activation memory drops ~1/m.  At the
+default m=1 the numerics are bitwise the replicated epilogue's (zeros +
+g == g); at m>1 the microbatch partial sums reorder the reduction and
+parity is allclose, not bitwise (documented in docs/parallelism.md).
+
+Checkpoints save only the inner optax state — the accumulator is zeros
+at every step boundary by construction, so the on-disk format is
+unchanged from ZeRO-0/1 (`unwrap_opt_state` at save, re-wrapped by the
+next distribute(zero=2) or by recovery's `wrap_like`).
+
 Composition: pure data parallelism only — tensor/pipeline/sequence/
 expert axes and gradient compression raise at distribute() time, the
 same contract grad_compression declares.  Params themselves stay
-replicated (ZeRO-1, not ZeRO-3): inference, evaluate() and the
+replicated (ZeRO-1/2, not ZeRO-3): inference, evaluate() and the
 checkpoint format are unchanged.
 """
 
@@ -93,6 +116,170 @@ class Zero1Placement:
         return params, opt_state
 
 
+# -- ZeRO-2: persistently sharded gradients ----------------------------------
+
+_WRAP_KEYS = frozenset({"opt", "grad_accum"})
+
+
+def is_wrapped(opt_state) -> bool:
+    """True when `opt_state` is the ZeRO-2 wrapper dict holding the
+    inner optax state next to the persistent sharded grad accumulator."""
+    return isinstance(opt_state, dict) and set(opt_state) == _WRAP_KEYS
+
+
+def wrap_opt_state(params, opt_state):
+    """The ZeRO-2 opt-state wrapper: inner optax state + a params-shaped
+    zero grad accumulator (placed by distribute()'s shard_zero1 pass).
+    Idempotent — an already-wrapped tree passes through."""
+    if is_wrapped(opt_state):
+        return opt_state
+    acc = jax.tree.map(
+        lambda p: jax.numpy.zeros(p.shape, p.dtype), params
+    )
+    return {"opt": opt_state, "grad_accum": acc}
+
+
+def unwrap_opt_state(opt_state):
+    """(inner optax state, grad accumulator | None) — the inner tree is
+    what checkpoints persist and what `tx.update` consumes."""
+    if is_wrapped(opt_state):
+        return opt_state["opt"], opt_state["grad_accum"]
+    return opt_state, None
+
+
+def wrap_like(ref_opt_state, opt_state, params):
+    """Match `opt_state`'s wrapping to `ref_opt_state`'s — the recovery
+    rollback primitive: a checkpoint restores the INNER state (the
+    accumulator is zeros at every step boundary and is not persisted),
+    but a zero=2 model's recorded placements expect the wrapped
+    structure."""
+    if is_wrapped(ref_opt_state) and not is_wrapped(opt_state):
+        return wrap_opt_state(params, opt_state)
+    if not is_wrapped(ref_opt_state) and is_wrapped(opt_state):
+        return opt_state["opt"]
+    return opt_state
+
+
+@dataclasses.dataclass
+class Zero2Placement(Zero1Placement):
+    """ZeRO-1's sharded update plus persistently sharded gradients:
+    `apply()` reduce-scatters the step's grads ONCE into the model's
+    sharded accumulator (carried inside the wrapped opt_state), runs
+    the optax update per-shard against the accumulated value, gathers
+    params, and returns the accumulator re-zeroed — between dispatches
+    the only gradient state any replica holds is its 1/n shard.
+
+    `accum` > 1 additionally makes the single-batch step program split
+    its batch into `accum` microbatches and scan over them with the
+    SHARDED accumulation in the carry (`scan_accumulate`)."""
+
+    accum: int = 1
+
+    @staticmethod
+    def build(params, opt_state, mesh: Mesh,
+              data_axis: str = DATA_AXIS,
+              accum: int = 1) -> "Zero2Placement":
+        from deeplearning4j_tpu.parallel.strategy import zero1_shardings
+
+        n = mesh.shape[data_axis]
+        rep = NamedSharding(mesh, P())
+        return Zero2Placement(
+            mesh=mesh,
+            n=n,
+            grad_shardings=zero1_shardings(params, mesh, data_axis),
+            opt_shardings=zero1_shardings(opt_state, mesh, data_axis),
+            param_shardings=jax.tree.map(lambda _: rep, params),
+            accum=max(1, int(accum)),
+        )
+
+    def apply(self, tx, params, opt_state, grads):
+        """The ZeRO-2 epilogue (traced): grads -> reduce-scatter into
+        the persistent sharded accumulator -> per-shard optax update
+        from the accumulated value -> all-gather params -> accumulator
+        re-zeroed.  At the step boundary the accumulator is always
+        zeros, so zeros + g == g bitwise and the numerics are exactly
+        the replicated (and ZeRO-1) epilogue's."""
+        wsc = jax.lax.with_sharding_constraint
+        inner, acc = opt_state["opt"], opt_state["grad_accum"]
+        grads = wsc(grads, self.grad_shardings)
+        acc = jax.tree.map(
+            lambda a, g: a + g.astype(a.dtype), acc, grads
+        )
+        acc = wsc(acc, self.grad_shardings)
+        updates, inner = tx.update(acc, inner, params)
+        updates = wsc(updates, self.grad_shardings)
+        params = jax.tree.map(
+            lambda p, u: p + u.astype(p.dtype), params, updates
+        )
+        params = wsc(params, self.param_shardings)
+        acc = jax.tree.map(
+            lambda a: jax.numpy.zeros_like(a), acc
+        )
+        acc = wsc(acc, self.grad_shardings)
+        inner = wsc(inner, self.opt_shardings["opt"])
+        return params, {"opt": inner, "grad_accum": acc}
+
+    def scan_accumulate(self, loss_grad_fn, params, state0, arrays):
+        """Microbatch-accumulated gradients with a SHARDED carry.
+
+        loss_grad_fn(params, state, micro_arrays, micro_i) ->
+        ((loss, state'), grads) computes one microbatch's gradients
+        (micro_i is the traced scan index — rng-consuming layers must
+        fold it so each microbatch draws distinct noise); `arrays` is a
+        tuple of batch-leading arrays already split to (m, B/m, ...).
+        The scan carries (state, sharded grad accumulator); each
+        iteration reduce-scatters its microbatch grads into the carry,
+        so no full replicated gradient ever persists across
+        microbatches.  Returns (mean loss, final state, MEAN
+        accumulated grads — sharded)."""
+        wsc = jax.lax.with_sharding_constraint
+        m = self.accum
+        acc0 = jax.tree.map(
+            lambda p: jax.numpy.zeros(p.shape, p.dtype), params
+        )
+        acc0 = wsc(acc0, self.grad_shardings)
+
+        def body(carry, xs):
+            micro_i, micro = xs
+            state, acc = carry
+            (loss, state), grads = loss_grad_fn(
+                params, state, micro, micro_i
+            )
+            grads = wsc(grads, self.grad_shardings)
+            acc = jax.tree.map(
+                lambda a, g: a + g.astype(a.dtype), acc, grads
+            )
+            acc = wsc(acc, self.grad_shardings)
+            return (state, acc), loss
+
+        (state, acc), losses = jax.lax.scan(
+            body, (state0, acc0),
+            (jax.numpy.arange(m, dtype=jax.numpy.uint32), arrays),
+        )
+        grads = jax.tree.map(lambda a: a / m, acc)
+        grads = wsc(grads, self.grad_shardings)
+        return losses.mean(), state, grads
+
+
+def split_accum_microbatches(arrays, m: int):
+    """Reshape each batch-leading array (B, ...) -> (m, B/m, ...) for
+    the ZeRO-2 accumulation scan; raises actionably on indivisible
+    batches (shape is known only at trace time)."""
+    def split(a):
+        if a is None:
+            return None
+        b = a.shape[0]
+        if b % m:
+            raise ValueError(
+                f"zero=2 grad_accum={m} needs the batch size to split "
+                f"evenly into microbatches; got batch {b} — pick a "
+                f"batch divisible by {m} or drop grad_accum"
+            )
+        return a.reshape((m, b // m) + a.shape[1:])
+
+    return jax.tree.map(split, arrays)
+
+
 # -- accounting --------------------------------------------------------------
 
 def leaf_bytes_per_replica(leaf) -> int:
@@ -114,15 +301,31 @@ def leaf_bytes_per_replica(leaf) -> int:
 
 def opt_state_bytes_per_replica(opt_state) -> int:
     """Per-replica bytes of an optimizer-state pytree — the quantity
-    ZeRO-1 shrinks ~1/n (and the `dl4jtpu_opt_state_bytes` gauge)."""
+    ZeRO-1/2 shrinks ~1/n (and the `dl4jtpu_opt_state_bytes` gauge).
+    A ZeRO-2 wrapped tree counts its INNER state only; the accumulator
+    is gradient state (`grad_state_bytes_per_replica`)."""
+    inner, _ = unwrap_opt_state(opt_state)
     return sum(
-        leaf_bytes_per_replica(leaf) for leaf in jax.tree.leaves(opt_state)
+        leaf_bytes_per_replica(leaf) for leaf in jax.tree.leaves(inner)
+    )
+
+
+def grad_state_bytes_per_replica(model) -> int:
+    """Per-replica bytes of persistent-or-transient GRADIENT state:
+    the sharded accumulator's shard bytes under ZeRO-2 (~params/n), or
+    the full params-sized transient gradient every replica still
+    materializes during the step under zero∈{0,1}."""
+    _, acc = unwrap_opt_state(model.opt_state)
+    tree = acc if acc is not None else model.params
+    return sum(
+        leaf_bytes_per_replica(leaf) for leaf in jax.tree.leaves(tree)
     )
 
 
 def gauge_opt_state_bytes(model, mode: str) -> int:
-    """Refresh the `dl4jtpu_opt_state_bytes` gauge for this model's
-    current opt-state placement.  mode: "sharded" | "replicated"."""
+    """Refresh the `dl4jtpu_opt_state_bytes` and
+    `dl4jtpu_grad_state_bytes` gauges for this model's current
+    placement.  mode: "sharded" (zero=1) | "replicated" | "zero2"."""
     total = opt_state_bytes_per_replica(model.opt_state)
     try:
         from deeplearning4j_tpu.observe.metrics import registry
@@ -130,6 +333,9 @@ def gauge_opt_state_bytes(model, mode: str) -> int:
         g = registry().gauge("dl4jtpu_opt_state_bytes")
         g.clear()       # one live series: the model's current placement
         g.set(total, mode=mode)
+        gg = registry().gauge("dl4jtpu_grad_state_bytes")
+        gg.clear()
+        gg.set(grad_state_bytes_per_replica(model), mode=mode)
     except Exception as e:      # telemetry must never fail placement
         log.debug("opt-state bytes gauge failed: %s", e)
     return total

@@ -60,8 +60,14 @@ class Environment:
     # path (parallel/zero.py): 0 = replicated optimizer state + update
     # (the classic DP step), 1 = opt state and the update computation
     # sharded over the data axis (reduce-scatter grads -> per-shard
-    # update -> all-gather params).  ParallelConfig(zero=...) overrides.
+    # update -> all-gather params), 2 = ZeRO-1 plus persistently
+    # sharded gradients.  ParallelConfig(zero=...) overrides.
     zero: int = 0
+    # Autosharding planner (parallel/planner.py): when on, a bare
+    # distribute(model) with no explicit ParallelConfig enumerates and
+    # prices candidate placements (dispatch-free) and installs the
+    # argmin — the same path as distribute(model, auto=True).
+    auto_plan: bool = False
 
     def set_nan_panic(self, on: bool) -> None:
         self.nan_panic = on
@@ -86,6 +92,7 @@ class Environment:
             ),
             watchdog_k=float(os.environ.get("DL4J_TPU_WATCHDOG_K", "10")),
             zero=int(os.environ.get("DL4J_TPU_ZERO", "0")),
+            auto_plan=_env_bool("DL4J_TPU_AUTO_PLAN"),
         )
         if _env_bool("DL4J_TPU_NAN_PANIC"):
             env.set_nan_panic(True)

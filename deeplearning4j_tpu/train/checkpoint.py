@@ -167,9 +167,14 @@ class ModelSerializer:
         if distributed.is_chief():
             ModelSerializer.write_model(model, path, save_updater)
         else:
-            # participate in the same fetch collectives, discard the bytes
-            for tree in (model.params, model.net_state,
-                         model.opt_state if save_updater else None):
+            # participate in the same fetch collectives, discard the
+            # bytes — mirroring write_model's unwrap so the collective
+            # sequence matches the chief's exactly
+            from deeplearning4j_tpu.parallel.zero import unwrap_opt_state
+
+            opt = (unwrap_opt_state(model.opt_state)[0]
+                   if save_updater else None)
+            for tree in (model.params, model.net_state, opt):
                 if tree is not None:
                     for leaf in jax.tree.leaves(tree):
                         distributed.fetch_global(leaf)
@@ -225,7 +230,16 @@ class ModelSerializer:
             put("params.npz", *_npz_bytes(model.params))
             put("netstate.npz", *_npz_bytes(model.net_state))
             if save_updater and model.opt_state is not None:
-                put("updater.npz", *_npz_bytes(model.opt_state))
+                # a ZeRO-2 model's grad accumulator is zeros at every
+                # step boundary by construction — persist the INNER
+                # optax state only, keeping the on-disk format identical
+                # across zero stages (restore + distribute re-wraps)
+                from deeplearning4j_tpu.parallel.zero import (
+                    unwrap_opt_state,
+                )
+
+                put("updater.npz",
+                    *_npz_bytes(unwrap_opt_state(model.opt_state)[0]))
             meta = {
                 "format_version": FORMAT_VERSION,
                 "iteration": model.iteration,

@@ -496,6 +496,17 @@ class RecoveryPolicy:
         models re-place every tree onto its recorded shardings
         (replicated params, ZeRO-sharded opt state)."""
         placements = getattr(model, "_placements", None)
+        if restored.opt_state is not None and model.opt_state is not None:
+            # checkpoints persist only the inner optax state; a ZeRO-2
+            # model's recorded placements expect the wrapped structure
+            # (inner + sharded grad accumulator) — re-wrap before
+            # placing (the accumulator restarts at zeros, which is its
+            # exact value at every step boundary)
+            from deeplearning4j_tpu.parallel.zero import wrap_like
+
+            restored.opt_state = wrap_like(
+                model.opt_state, restored.opt_state, restored.params
+            )
         if placements is not None:
             restored.params = RecoveryPolicy._place_like(
                 restored.params, placements["params"]

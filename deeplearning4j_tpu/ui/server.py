@@ -13,7 +13,9 @@ charts, auto-refresh, JSON API.
 JSON API: /api/sessions, /api/stats?session=<id>, /api/trace (Chrome
 trace-event JSON of the step-timeline ring buffer; ?limit= and ?name=
 filter it), /api/programs (the compiled-program registry with XLA cost
-analysis + roofline), /api/trace/cluster (merged per-worker cluster
+analysis + roofline), /api/plan (the autosharding planner's last
+PlanReport: candidates, prices, rejection reasons, pick),
+/api/trace/cluster (merged per-worker cluster
 timeline), /api/serving (live inference servers: queue depth, p50/p99,
 breaker, swap generation), /api/serving/slow (slowest-request
 exemplars with latency breakdown + span chains), /api/slo (SLO
@@ -321,6 +323,22 @@ class UIServer:
                         analyze=q.get("analyze", ["1"])[0] != "0",
                         memory=q.get("memory", ["0"])[0] == "1",
                     ))
+                elif u.path == "/api/plan":
+                    # the autosharding planner's last PlanReport:
+                    # every candidate ParallelConfig with its price
+                    # terms or rejection reason, and the pick
+                    from deeplearning4j_tpu.parallel import planner
+
+                    rep = planner.last_report()
+                    if rep is None:
+                        self._json(
+                            {"error": "no plan has run in this "
+                                      "process (distribute(model, "
+                                      "auto=True) or planner.plan)"},
+                            404,
+                        )
+                    else:
+                        self._json(rep.as_dict())
                 elif u.path == "/api/serving":
                     # live inference servers in this process: queue
                     # depth, p50/p99, breaker state, swap generation —

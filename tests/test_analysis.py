@@ -358,6 +358,13 @@ class TestTier1Gate:
             "dl4jtpu_quant_dequant_matmul_total",
             "dl4jtpu_quant_parity_checks_total",
         } <= fams
+        # ISSUE-15 autosharding-planner + ZeRO-2 families
+        assert {
+            "dl4jtpu_plan_candidates_total",
+            "dl4jtpu_plan_seconds",
+            "dl4jtpu_plan_predicted_step_seconds",
+            "dl4jtpu_grad_state_bytes",
+        } <= fams
         sites = load_fault_sites(REPO)
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",
@@ -367,7 +374,7 @@ class TestTier1Gate:
             "serving.route", "serving.canary",
         }
         assert {
-            "slow", "faults", "serving", "slo", "quant",
+            "slow", "faults", "serving", "slo", "quant", "plan",
         } <= load_declared_marks(REPO)
 
 
