@@ -732,6 +732,30 @@ def _declare_core(reg: MetricsRegistry) -> None:
     reg.gauge("dl4jtpu_fleet_stragglers",
               "Workers whose recent mean step latency exceeds "
               "DL4J_TPU_STRAGGLER_FACTOR x the fleet median")
+    # token-level generation serving (serving/generation.py + kv_cache.py)
+    reg.counter("dl4jtpu_decode_tokens_total",
+                "Tokens emitted by the continuous-batching decode "
+                "engine (prefill first-tokens included) — the "
+                "aggregate tokens/s numerator")
+    reg.gauge("dl4jtpu_kv_pages_used",
+              "KV pool pages currently owned by live streams "
+              "(page 0, the scratch page, never counts)")
+    reg.gauge("dl4jtpu_kv_pages_total",
+              "Allocatable KV pool pages (num_pages - 1; the ratio "
+              "used/total is the occupancy term in shed_pressure)")
+    reg.histogram("dl4jtpu_ttft_seconds",
+                  "Time-to-first-token per stream: submit to the "
+                  "prefill program emitting the first sampled token")
+    reg.gauge("dl4jtpu_decode_batch_occupancy",
+              "Live streams / decode slots after the latest step or "
+              "admission (1.0 = the batch is full; sustained low "
+              "values mean the slot count outruns the traffic)")
+    reg.counter("dl4jtpu_paged_attention_total",
+                "Paged-attention sites lowered into compiled "
+                "programs, by impl (pallas = online-softmax TPU "
+                "kernel, xla = gather-then-attend reference; _int8 "
+                "suffix = fused dequant variant).  Counted at TRACE "
+                "time, never from inside the traced body")
 
 
 def _compile_stats_collector() -> None:

@@ -4,10 +4,19 @@ The reference's only generation story is RNN `rnnTimeStep` streaming; a
 transformer decoded that way recomputes full-sequence attention per token
 (O(T^2) per step).  Here `generate()` introspects a SequentialModel built
 as [Embedding, PositionalEncoding, TransformerEncoderBlock*, head],
-prefllls per-block K/V caches from the prompt in ONE dense forward, then
+prefills per-block K/V caches from the prompt in ONE dense forward, then
 decodes with a `lax.scan` whose body attends one query row against the
 cache — O(T) per step, static shapes throughout, the whole decode loop a
 single compiled XLA program.  Greedy, temperature, and top-k sampling.
+
+This dense-cache `generate()` is the SINGLE-REQUEST REFERENCE PATH: its
+per-position numerics (`_block_step`'s f32 attention, `_sample`'s
+greedy/temperature/top-k rules, the `fold_in(rng, i)` key schedule) are
+the contract the paged serving engine (`serving/generation.py` over
+`ops/paged_attention.py`) must reproduce token-for-token — greedy
+exactly, sampled exactly under a shared seed, int8-KV within the PR 13
+agreement gate.  Change decode semantics HERE first; the paged parity
+tests (`tests/test_paged_generation.py`) hold the engine to this file.
 """
 
 from __future__ import annotations

@@ -1,0 +1,261 @@
+"""Paged decode-step attention — the Pallas kernel library's third kernel.
+
+One query row per sequence attends against K/V that live in PAGES of a
+preallocated pool (``serving/kv_cache.py``) instead of a dense per-request
+cache: ``page_tbl[s, j]`` names the pool page holding positions
+``[j*page_size, (j+1)*page_size)`` of slot ``s``'s sequence, and
+``seq_lens[s]`` bounds the live positions.  Three implementations behind
+one dispatch, mirroring ``ops/dequant_matmul.py``:
+
+- ``xla`` — gather-then-attend reference: the page table gathers the
+  slot's pages into a dense (L, H, Dh) view and the attention math is
+  EXACTLY ``ops/generation.py``'s ``_block_step`` (f32 einsum scores,
+  ``-inf`` masking past ``seq_len``, f32 softmax, f32 einsum output) —
+  masked positions contribute exact zeros, so paged greedy decode is
+  token-identical to the dense reference.
+- ``pallas`` — the paged TPU kernel: grid (slots, pages), the page
+  table rides PrefetchScalarGridSpec so each grid step DMAs ONE pool
+  page into VMEM (HBM never sees a gathered dense copy), and the
+  softmax is accumulated online (running max / normalizer / weighted
+  sum in VMEM scratch) across a slot's pages.  CPU tier-1 runs the
+  SAME kernel with ``interpret=True``.
+- ``pallas_int8`` — the fused int8-KV variant: pages are int8 with
+  per-page scale blocks (``serving/kv_cache.py``'s layout); the kernel
+  dequantizes each page IN VMEM (HBM reads ~1 byte per KV element) and
+  accumulates in f32 — the decode step is HBM-bandwidth-bound, so on
+  TPU the byte ratio is the speedup (bench.py --generate's roofline
+  column).
+
+Selection (``impl=None``): the env override ``DL4JTPU_PAGED_KERNEL``
+(pallas / xla / auto) wins; auto picks ``pallas`` on TPU, ``xla`` on CPU
+(the gather reference IS the fast CPU path — interpret-mode Pallas is a
+correctness vehicle, not a fast one).  int8 pages always take the fused
+path's numerics (dequantize-then-attend), via the kernel on TPU and via
+the XLA reference off it.  Every selection is a TRACE-TIME event counted
+host-side on ``dl4jtpu_paged_attention_total{impl=...}`` — never a call
+inside the traced body (tpulint TP004).
+"""
+
+from __future__ import annotations
+
+import functools
+import logging
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+ENV_KERNEL = "DL4JTPU_PAGED_KERNEL"
+IMPLS = ("pallas", "xla")
+
+#: the in-kernel mask value: a finite stand-in for -inf so the online
+#: softmax's ``exp(score - m)`` underflows to an exact 0.0 on masked
+#: positions instead of producing ``-inf - -inf = nan``
+_MASK = -1e30
+
+
+def _count_selection(impl: str) -> None:
+    """Trace-time telemetry: which impl a paged-attention site lowered
+    to.  Never raises into a trace."""
+    try:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        registry().counter("dl4jtpu_paged_attention_total").inc(impl=impl)
+    except Exception as e:
+        log.debug("paged-attention selection metric failed: %s", e)
+
+
+def select_impl() -> str:
+    """env override > TPU -> pallas > xla gather reference."""
+    env = os.environ.get(ENV_KERNEL, "").strip().lower()
+    if env in IMPLS:
+        return env
+    from deeplearning4j_tpu.runtime.backend import backend
+
+    return "pallas" if backend().is_tpu else "xla"
+
+
+# -- xla gather reference ---------------------------------------------------
+
+def _gather_pages(pages, page_tbl):
+    """(P, ps, ...) pool + (S, maxP) table -> (S, maxP*ps, ...) dense
+    view of each slot's sequence (garbage rows past seq_len are masked
+    by the caller)."""
+    g = pages[page_tbl]                       # (S, maxP, ps, ...)
+    s, mp, ps = g.shape[0], g.shape[1], g.shape[2]
+    return g.reshape((s, mp * ps) + g.shape[3:])
+
+
+def _xla_paged_attention(q, k_pages, v_pages, page_tbl, seq_lens,
+                         k_scale=None, v_scale=None):
+    """Gather-then-attend: `_block_step`'s exact numerics against the
+    page-table-indexed view.  q: (S, H, Dh); pools: (P, ps, H, Dh);
+    int8 pools carry (P, ps, H) per-row scale blocks."""
+    dh = q.shape[-1]
+    k = _gather_pages(k_pages, page_tbl).astype(jnp.float32)
+    v = _gather_pages(v_pages, page_tbl).astype(jnp.float32)
+    if k_scale is not None:
+        k = k * _gather_pages(k_scale, page_tbl)[..., None]
+    if v_scale is not None:
+        v = v * _gather_pages(v_scale, page_tbl)[..., None]
+    ell = k.shape[1]
+    scores = jnp.einsum(
+        "shd,slhd->shl", q.astype(jnp.float32), k
+    ) / np.sqrt(dh)
+    live = jnp.arange(ell)[None, None, :] < seq_lens[:, None, None]
+    scores = jnp.where(live, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    # a fully-masked slot (seq_len 0: an idle decode slot) softmaxes a
+    # row of -inf into nans — zero it so idle slots stay finite
+    p = jnp.where(seq_lens[:, None, None] > 0, p, 0.0)
+    return jnp.einsum("shl,slhd->shd", p, v)
+
+
+# -- pallas (TPU; interpret on CPU) ----------------------------------------
+
+def _pa_kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+               m_ref, l_ref, acc_ref, *, page_size: int, n_pages: int,
+               quant: bool, ks_ref=None, vs_ref=None):
+    """Grid (slots, pages), pages innermost (sequential): online-softmax
+    accumulation of one slot's query row over its page-table-indexed
+    pages.  Scalar-prefetched ``tbl_ref``/``len_ref`` drive the page
+    DMAs via the BlockSpec index maps; this body only needs the mask."""
+    s = pl.program_id(0)
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_ref[...] = jnp.full_like(m_ref, _MASK)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    q = q_ref[0].astype(jnp.float32)              # (H, Dh)
+    k = k_ref[0].astype(jnp.float32)              # (ps, H, Dh)
+    v = v_ref[0].astype(jnp.float32)
+    if quant:
+        k = k * ks_ref[0].astype(jnp.float32)[..., None]
+        v = v * vs_ref[0].astype(jnp.float32)[..., None]
+    dh = q.shape[-1]
+    # (H, ps) scores for this page
+    scores = jnp.einsum("hd,phd->hp", q, k) / np.sqrt(dh)
+    base = j * page_size
+    pos = base + jax.lax.broadcasted_iota(
+        jnp.int32, (1, page_size), 1
+    )                                             # (1, ps)
+    scores = jnp.where(pos < len_ref[s], scores, _MASK)
+    m_prev = m_ref[...]                           # (H, 1)
+    m_new = jnp.maximum(m_prev, jnp.max(scores, axis=-1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p = jnp.exp(scores - m_new)                   # (H, ps); masked -> 0.0
+    l_ref[...] = l_ref[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    acc_ref[...] = acc_ref[...] * alpha + jnp.einsum("hp,phd->hd", p, v)
+    m_ref[...] = m_new
+
+    @pl.when(j == n_pages - 1)
+    def _done():
+        ell = l_ref[...]
+        o_ref[0] = (acc_ref[...]
+                    / jnp.where(ell > 0.0, ell, 1.0)).astype(o_ref.dtype)
+
+
+def _pallas_paged_attention(q, k_pages, v_pages, page_tbl, seq_lens,
+                            k_scale=None, v_scale=None, *,
+                            interpret: bool):
+    s, h, dh = q.shape
+    n_pages = page_tbl.shape[1]
+    page_size = k_pages.shape[1]
+    quant = k_scale is not None
+    kernel = functools.partial(
+        _pa_kernel, page_size=page_size, n_pages=n_pages, quant=quant,
+    )
+    # page blocks are selected by the scalar-prefetched table: grid step
+    # (s, j) DMAs pool page page_tbl[s, j] — the gather never exists in
+    # HBM
+    page_spec = pl.BlockSpec(
+        (1, page_size, h, dh), lambda s_, j, tbl, lens: (tbl[s_, j], 0, 0, 0),
+    )
+    scale_spec = pl.BlockSpec(
+        (1, page_size, h), lambda s_, j, tbl, lens: (tbl[s_, j], 0, 0),
+    )
+    in_specs = [
+        pl.BlockSpec((1, h, dh), lambda s_, j, tbl, lens: (s_, 0, 0)),
+        page_spec, page_spec,
+    ]
+    args = [q, k_pages, v_pages]
+    if quant:
+        in_specs += [scale_spec, scale_spec]
+        args += [k_scale, v_scale]
+
+    def body(tbl_ref, len_ref, q_ref, k_ref, v_ref, *rest):
+        if quant:
+            ks_ref, vs_ref, o_ref, m_ref, l_ref, acc_ref = rest
+            kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref, ks_ref=ks_ref, vs_ref=vs_ref)
+        else:
+            o_ref, m_ref, l_ref, acc_ref = rest
+            kernel(tbl_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                   m_ref, l_ref, acc_ref)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(s, n_pages),
+        in_specs=in_specs,
+        out_specs=pl.BlockSpec(
+            (1, h, dh), lambda s_, j, tbl, lens: (s_, 0, 0),
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((h, 1), jnp.float32),       # running max
+            pltpu.VMEM((h, 1), jnp.float32),       # running normalizer
+            pltpu.VMEM((h, dh), jnp.float32),      # weighted-sum acc
+        ],
+    )
+    out = pl.pallas_call(
+        body,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((s, h, dh), jnp.float32),
+        interpret=interpret,
+    )(page_tbl.astype(jnp.int32), seq_lens.astype(jnp.int32), *args)
+    return out
+
+
+# -- dispatch ---------------------------------------------------------------
+
+def paged_attention(q, k_pages, v_pages, page_tbl, seq_lens, *,
+                    k_scale=None, v_scale=None,
+                    impl: str | None = None,
+                    interpret: bool | None = None):
+    """One decode step of attention against paged K/V.
+
+    ``q``: (S, H, Dh) — one query row per slot; ``k_pages``/``v_pages``:
+    (P, page_size, H, Dh) pools (f32, or int8 with ``k_scale``/
+    ``v_scale`` (P, page_size, H) per-page scale blocks); ``page_tbl``:
+    (S, maxP) int32 pool-page indices; ``seq_lens``: (S,) int32 live
+    positions per slot (position ``p`` of slot ``s`` lives at row
+    ``p % page_size`` of pool page ``page_tbl[s, p // page_size]``).
+    Returns (S, H, Dh) f32.  ``impl`` forces an implementation;
+    ``interpret`` forces/suppresses Pallas interpret mode (None =
+    interpret off-TPU).
+    """
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("int8 pages need BOTH k_scale and v_scale")
+    chosen = impl or select_impl()
+    _count_selection(f"{chosen}_int8" if quant else chosen)
+    if chosen == "pallas":
+        if interpret is None:
+            from deeplearning4j_tpu.runtime.backend import backend
+
+            interpret = not backend().is_tpu
+        return _pallas_paged_attention(
+            q, k_pages, v_pages, page_tbl, seq_lens,
+            k_scale=k_scale, v_scale=v_scale, interpret=interpret,
+        )
+    return _xla_paged_attention(
+        q, k_pages, v_pages, page_tbl, seq_lens,
+        k_scale=k_scale, v_scale=v_scale,
+    )

@@ -39,6 +39,16 @@ Sites wired today:
   ``serving.canary``     FleetDeployer's canary verification
                          (``corrupt`` ⇒ canary output mismatch ⇒ the
                          deploy rolls back)
+  ``serving.prefill``    the generation engine's per-stream prefill
+                         dispatch (``raise`` ⇒ the stream fails
+                         explicitly, its pages released)
+  ``serving.decode``     the generation engine, before each batched
+                         decode step (``raise`` ⇒ a failed step that
+                         fails every in-flight stream; ``delay`` ⇒ a
+                         wedged step under the generation watchdog)
+  ``kv.alloc``           PagedKVCache page allocation (``raise`` ⇒
+                         injected pool exhaustion ⇒ an explicit
+                         kv_exhausted 429)
 
 Plan grammar (also the ``DL4J_TPU_FAULT_PLAN`` env value, so subprocess
 workers inherit the plan from their spawner's environment)::
@@ -120,6 +130,17 @@ SITES: dict = {
                       "('corrupt' perturbs the observed canary outputs "
                       "— the golden mismatch must roll the whole "
                       "deploy back)",
+    "serving.prefill": "the generation engine's per-stream prefill "
+                       "dispatch ('raise' = the stream fails "
+                       "explicitly and its KV pages are released)",
+    "serving.decode": "the generation engine, before each batched "
+                      "decode step ('raise' = a failed step that "
+                      "fails every in-flight stream and releases "
+                      "their pages; 'delay' = a wedged step under "
+                      "the generation watchdog)",
+    "kv.alloc": "PagedKVCache page allocation ('raise' = injected "
+                "pool exhaustion — the request is rejected with an "
+                "explicit kv_exhausted 429, never a silent stall)",
 }
 
 

@@ -19,6 +19,12 @@ from deeplearning4j_tpu.serving.hotswap import (          # noqa: F401
 from deeplearning4j_tpu.serving.fleet import (            # noqa: F401
     CanaryError, FleetDeployer, ServingFleet,
 )
+from deeplearning4j_tpu.serving.generation import (       # noqa: F401
+    GenerationConfig, GenerationEngine, GenerationRequest,
+)
+from deeplearning4j_tpu.serving.kv_cache import (         # noqa: F401
+    KVPoolExhausted, PagedKVCache,
+)
 from deeplearning4j_tpu.serving.http import ServingHTTPServer  # noqa: F401
 from deeplearning4j_tpu.serving.router import (           # noqa: F401
     ReplicaHandle, Router, RouterConfig, active_routers,

@@ -40,6 +40,8 @@ REJECT_STATUS = {
     "breaker_open": 503,
     "admit_fault": 503,
     "shutdown": 503,
+    # generation engine (serving.generation) rejections
+    "kv_exhausted": 429,     # KV page pool has no room — retry later
     # front-door (serving.router) rejections
     "no_replicas": 503,      # every replica ejected/dead/stopped
     "route_fault": 503,      # injected serving.route failure

@@ -28,6 +28,13 @@ replica it was caught on, and that replica rolls back too.  Fault site
 ``serving.canary`` (``corrupt`` perturbs the observed canary outputs)
 makes the mismatch path provokable; `dl4jtpu_canary_failures_total`
 and `dl4jtpu_fleet_deploy_generation` land on the telemetry spine.
+
+Token generation rides the same fleet: `roles=` assigns each replica
+to the prefill or decode group (default ``both``), `generation_config=`
+attaches one `GenerationEngine` per replica, and `fleet.generate`
+routes each stream's prompt pass to a prefill replica and adopts the
+KV-page handoff into a decode replica's continuous batch
+(`Router.pick_for_role` — pressure-aware on both hops).
 """
 
 from __future__ import annotations
@@ -61,9 +68,18 @@ class ServingFleet:
     def __init__(self, model_factory: Callable, n_replicas: int = 2,
                  config: Optional[ServingConfig] = None,
                  router_config: Optional[RouterConfig] = None,
-                 golden_inputs: Optional[list] = None):
+                 golden_inputs: Optional[list] = None,
+                 roles: Optional[list] = None,
+                 generation_config=None):
         if n_replicas < 1:
             raise ValueError("fleet needs at least one replica")
+        if roles is None:
+            roles = ["both"] * n_replicas
+        if len(roles) != n_replicas:
+            raise ValueError(
+                f"roles must name every replica: got {len(roles)} "
+                f"role(s) for {n_replicas} replica(s)"
+            )
         self.replicas: list[InferenceServer] = []
         for _ in range(n_replicas):
             cfg = ServingConfig(**vars(config)) if config is not None \
@@ -72,11 +88,17 @@ class ServingFleet:
         self.handles = [
             ReplicaHandle(f"r{i}", srv,
                           refresh_s=(router_config or RouterConfig())
-                          .health_refresh_s)
+                          .health_refresh_s,
+                          role=roles[i])
             for i, srv in enumerate(self.replicas)
         ]
         self.router = Router(self.handles, router_config)
         self.deployer = FleetDeployer(self, golden_inputs=golden_inputs)
+        # token-generation engines, one per replica, keyed by handle
+        # name — populated by `enable_generation`
+        self.engines: dict = {}
+        if generation_config is not None:
+            self.enable_generation(generation_config)
 
     # -- lifecycle ---------------------------------------------------------
     def warm_start(self, example=None, lengths=None) -> "ServingFleet":
@@ -87,9 +109,17 @@ class ServingFleet:
     def start(self) -> "ServingFleet":
         for srv in self.replicas:
             srv.start()
+        for h in self.handles:
+            eng = self.engines.get(h.name)
+            # prefill-only replicas never run the decode loop: their
+            # engine exists for the prefill programs alone
+            if eng is not None and h.role in ("decode", "both"):
+                eng.start()
         return self
 
     def stop(self, timeout: float = 10.0) -> None:
+        for eng in self.engines.values():
+            eng.stop(timeout)
         for srv in self.replicas:
             srv.stop(timeout)
 
@@ -102,6 +132,9 @@ class ServingFleet:
         the survivors."""
         h = self.handles[index]
         h.kill()
+        eng = self.engines.get(h.name)
+        if eng is not None:
+            eng.stop(timeout=1.0)
         self.replicas[index].stop(timeout=1.0)
         log.warning("fleet replica %s hard-killed", h.name)
 
@@ -124,6 +157,57 @@ class ServingFleet:
     # -- the request path (the router IS the front door) -------------------
     def infer(self, features, deadline_s: Optional[float] = None):
         return self.router.infer(features, deadline_s=deadline_s)
+
+    # -- token generation (prefill/decode disaggregation) ------------------
+    def enable_generation(self, config=None) -> "ServingFleet":
+        """Attach one `GenerationEngine` per replica (sharing the
+        replica's model, swap lock, and breaker).  Engines on
+        decode-capable replicas (`role` decode/both) get their decode
+        loop started by `start()`; prefill-only replicas keep just the
+        prefill programs."""
+        from deeplearning4j_tpu.serving.generation import (
+            GenerationConfig, GenerationEngine,
+        )
+
+        for h, srv in zip(self.handles, self.replicas):
+            if h.name in self.engines:
+                continue
+            cfg = GenerationConfig(**vars(config)) if config is not None \
+                else GenerationConfig()
+            self.engines[h.name] = GenerationEngine(server=srv, config=cfg)
+        return self
+
+    def generate(self, prompt, max_new_tokens: Optional[int] = None, *,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 stop_tokens: tuple = (), on_token=None,
+                 timeout: Optional[float] = 120.0) -> np.ndarray:
+        """One disaggregated stream through the fleet: the router picks
+        a PREFILL-role replica (least pressure, KV occupancy included)
+        whose engine runs the prompt pass and emits a portable handoff,
+        then a DECODE-role replica's engine adopts the handoff into its
+        continuous decode batch.  On a fleet of all-``both`` replicas
+        this degenerates to least-pressure placement of the whole
+        stream — disaggregation is a ROUTING policy, not a different
+        engine."""
+        if not self.engines:
+            raise RuntimeError(
+                "generation is not enabled on this fleet — construct it "
+                "with generation_config= or call enable_generation()"
+            )
+        h_pre = self.router.pick_for_role("prefill")
+        handoff = self.engines[h_pre.name].prefill_detached(
+            prompt, max_new_tokens if max_new_tokens is not None
+            else self.engines[h_pre.name].config.default_max_new,
+            temperature=temperature, top_k=top_k, seed=seed,
+            stop_tokens=stop_tokens,
+        )
+        h_dec = self.router.pick_for_role("decode")
+        log.debug("fleet generate: prefill on %s, decode on %s",
+                  h_pre.name, h_dec.name)
+        req = self.engines[h_dec.name].join_prefilled(
+            handoff, on_token=on_token,
+        )
+        return req.result(timeout)
 
     # -- weight deploys ----------------------------------------------------
     def push_weights(self, params, net_state=None,

@@ -1,0 +1,852 @@
+"""Token-level continuous-batching generation serving.
+
+`ops/generation.py` decodes ONE prompt against a dense per-request
+cache — the right reference semantics, the wrong serving shape: a
+request-at-a-time `generate()` leaves the device idle for every other
+stream while one stream decodes, and its dense cache reserves
+O(prompt + max_new) HBM per request up front.  This module lifts that
+loop into the serving plane the way the Gemma-on-TPU serving stack does:
+
+- **one jitted decode step, fixed slot batch** — `GenerationEngine`
+  advances `slots` sequences ONE token per dispatch.  Shapes are static
+  (slot count, page-table width), so the whole serving life of the
+  engine is a single compiled program; requests join and leave the
+  running batch BETWEEN steps, never inside one (continuous batching at
+  token granularity, not request granularity).
+- **paged KV** — K/V live in `serving/kv_cache.py` pool pages indexed
+  by per-slot page tables; `ops/paged_attention.py` attends one query
+  row per slot against them.  An idle slot points every table entry at
+  the pool's scratch page and carries ``seq_len 0`` — it rides the same
+  program as live slots and contributes garbage that nobody reads.
+- **bucketed prefill** — the prompt runs as a separate program per
+  `flags.bucket_length` bucket (bucket quantum = a page-size multiple,
+  so prompt KV lands page-aligned), emits the first token (that is the
+  TTFT moment) and hands its K/V rows to the pool.  `prefill_detached`
+  / `join_prefilled` split that handoff across replicas — the
+  prefill/decode disaggregation seam `ServingFleet.generate` routes.
+- **the ladder still holds** — admission is a bounded queue (429 when
+  full), KV-pool exhaustion is an explicit ``kv_exhausted`` 429 (never
+  a silent stall), each decode step runs under a `StepWatchdog` whose
+  abort fails every in-flight stream AND releases all their pages, the
+  shared breaker trips on step failures, and a hot-swap lands between
+  decode steps (the step snapshots params under the server's weights
+  lock) so in-flight streams finish — on the new weights — with zero
+  drops.
+
+Numerics contract: greedy paged decode is token-identical to
+`ops.generation.generate` for f32 (same per-position math, same
+`fold_in` RNG schedule, same top-k threshold rule), and int8-KV pages
+are gated by agreement the way PR 13 gated PTQ parity.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass
+from typing import Callable, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.ops.generation import (
+    _block_prefill,
+    _head_logits,
+    _ln,
+    _pe_row,
+    _plan,
+)
+from deeplearning4j_tpu.ops.paged_attention import paged_attention
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.runtime.flags import bucket_length
+from deeplearning4j_tpu.runtime.watchdog import StepWatchdog
+from deeplearning4j_tpu.serving.admission import (
+    AdmissionQueue,
+    ServingError,
+    ServingRejected,
+    ServingTimeout,
+)
+from deeplearning4j_tpu.serving.kv_cache import (
+    SCRATCH_PAGE,
+    KVPoolExhausted,
+    PagedKVCache,
+    quantize_page_rows,
+)
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+
+@dataclass
+class GenerationConfig:
+    """Engine knobs.  ``slots`` and ``max_pages_per_seq`` are STATIC
+    shape parameters of the one decode program; ``page_size`` times
+    ``max_pages_per_seq`` bounds a stream's total length (prompt bucket
+    plus generated tokens)."""
+
+    slots: int = 8                 # decode batch width (static)
+    page_size: int = 16            # KV page rows (bucket_length-quantized)
+    num_pages: int = 128           # pool size (page 0 is scratch)
+    max_pages_per_seq: int = 8     # page-table width (static)
+    kv_dtype: str = "f32"          # f32 | int8 pages
+    prefill_quantum: Optional[int] = None   # default: page_size
+    max_queue: int = 128
+    default_max_new: int = 32
+    attention_impl: Optional[str] = None    # force pallas|xla (None = auto)
+    attention_interpret: Optional[bool] = None
+    watchdog_floor_s: float = 30.0
+    watchdog_cold_floor_s: float = 600.0
+    watchdog_k: float = 10.0
+    poll_s: float = 0.02           # idle-queue poll granularity
+
+
+class GenerationRequest:
+    """One admitted stream: prompt, sampling params, stop conditions,
+    and the token sink the decode loop appends into.  The client waits
+    on `result()`; streaming readers poll `tokens_so_far()` or get
+    ``on_token(token, index)`` callbacks from the engine thread."""
+
+    __slots__ = ("rid", "prompt", "max_new", "temperature", "top_k",
+                 "seed", "stop_tokens", "on_token", "tokens", "error",
+                 "cancelled", "prefilled", "signature", "seq",
+                 "t_submit", "ttft_s", "_event", "_lock")
+
+    _next = [0]
+
+    def __init__(self, prompt: np.ndarray, max_new: int, *,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 stop_tokens: tuple = (), on_token=None, prefilled=None):
+        GenerationRequest._next[0] += 1
+        self.rid = f"gen-{GenerationRequest._next[0]}"
+        self.prompt = np.asarray(prompt, np.int32).reshape(-1)
+        self.max_new = int(max_new)
+        self.temperature = float(temperature)
+        self.top_k = int(top_k)
+        self.seed = int(seed)
+        self.stop_tokens = tuple(int(t) for t in stop_tokens)
+        self.on_token = on_token
+        self.prefilled = prefilled     # disaggregation handoff dict
+        self.tokens: list[int] = []
+        self.error: Optional[BaseException] = None
+        self.cancelled = False
+        self.signature = ("generate",)  # AdmissionQueue grouping key
+        self.seq = 0
+        self.t_submit = time.perf_counter()
+        self.ttft_s: Optional[float] = None
+        self._event = threading.Event()
+        self._lock = threading.Lock()
+
+    # -- engine side -------------------------------------------------------
+    def _record(self, token: int) -> None:
+        with self._lock:
+            if self.ttft_s is None:
+                self.ttft_s = time.perf_counter() - self.t_submit
+            self.tokens.append(int(token))
+            idx = len(self.tokens) - 1
+        if self.on_token is not None:
+            try:
+                self.on_token(int(token), idx)
+            except Exception:
+                log.exception("on_token callback raised")
+
+    def _complete(self) -> None:
+        self._event.set()
+
+    def _fail(self, exc: BaseException) -> None:
+        self.error = exc
+        self._event.set()
+
+    # -- client side -------------------------------------------------------
+    def tokens_so_far(self) -> list[int]:
+        with self._lock:
+            return list(self.tokens)
+
+    @property
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def cancel(self) -> None:
+        self.cancelled = True
+
+    def result(self, timeout: Optional[float] = None) -> np.ndarray:
+        """Block for completion; returns prompt + generated tokens
+        (the `ops.generation.generate` row shape)."""
+        if not self._event.wait(timeout):
+            self.cancelled = True
+            raise ServingTimeout(
+                f"generation {self.rid} incomplete after {timeout}s"
+            )
+        if self.error is not None:
+            raise self.error
+        return np.concatenate(
+            [self.prompt, np.asarray(self.tokens_so_far(), np.int32)]
+        )
+
+
+def _sample_token(logits, temp, top_k, key):
+    """`ops.generation._sample` with RUNTIME sampling params, for one
+    (V,) logits row — temperature/top_k ride the batch as traced
+    per-slot scalars so the sampling config never recompiles the step.
+    The kth-largest threshold (descending sort at [k-1]) is the exact
+    value `lax.top_k(x, k)[0][..., -1]` gives the dense reference, and
+    greedy argmaxes the UNSCALED logits exactly like the reference's
+    ``temperature <= 0`` branch."""
+    logits = logits.astype(jnp.float32)
+    v = logits.shape[-1]
+    greedy = jnp.argmax(logits).astype(jnp.int32)
+    t = jnp.where(temp > 0.0, temp, 1.0)
+    scaled = logits / t
+    order = jnp.sort(scaled)[::-1]
+    kth = jnp.where(top_k > 0, order[jnp.clip(top_k - 1, 0, v - 1)],
+                    -jnp.inf)
+    masked = jnp.where(scaled < kth, -jnp.inf, scaled)
+    samp = jax.random.categorical(key, masked).astype(jnp.int32)
+    return jnp.where(temp <= 0.0, greedy, samp)
+
+
+def _slot_keys(seeds, gen_counts):
+    """Per-slot sampling keys on the dense reference's schedule: the
+    g-th generated token of a stream seeded ``s`` uses
+    ``fold_in(key(s), g)`` (the reference samples its first token with
+    ``fold_in(rng, 0)`` and tick ``i`` with ``fold_in(rng, i + 1)``)."""
+    return jax.vmap(
+        lambda s, g: jax.random.fold_in(jax.random.key(s), g)
+    )(seeds, gen_counts)
+
+
+class GenerationEngine:
+    """Continuous-batching decode engine over a paged KV pool.
+
+        engine = GenerationEngine(model=m, config=GenerationConfig())
+        engine.start()
+        req = engine.submit(prompt_ids, max_new_tokens=32)
+        out = req.result(timeout=30)        # prompt + generated tokens
+
+    Attach to an `InferenceServer` (``server=``) to ride its ladder:
+    params snapshot under the server's weights lock (hot-swap lands
+    between decode steps), step failures feed the shared breaker,
+    admission honors breaker state, and `server.shed_pressure` folds in
+    KV-pool occupancy.  Standalone (``model=``) runs the same engine
+    with its own lock for tests and benchmarks.
+    """
+
+    def __init__(self, model=None, server=None,
+                 config: Optional[GenerationConfig] = None):
+        if (model is None) == (server is None):
+            raise ValueError("pass exactly one of model= or server=")
+        self.server = server
+        self.model = server.model if server is not None else model
+        if self.model.params is None:
+            self.model.init()
+        self.config = cfg = config or GenerationConfig()
+        self._weights_lock = (
+            server._weights_lock if server is not None else threading.Lock()
+        )
+        self.breaker = server.breaker if server is not None else None
+
+        embed, pos, blocks, head = _plan(self.model)
+        self._stack = (embed, pos, tuple(blocks), head)
+        names = [l.name for l in self.model.conf.layers]
+        self._embed_name, self._head_name = names[0], names[-1]
+        self._pos_name = pos.name if pos is not None else None
+        self._block_names = [b.name for b in blocks]
+        self._d = embed.n_out
+        self._n_heads = blocks[0].n_heads
+        self._head_dim = blocks[0].d_model // blocks[0].n_heads
+
+        self.kv = PagedKVCache(
+            n_layers=len(blocks), n_heads=self._n_heads,
+            head_dim=self._head_dim, num_pages=cfg.num_pages,
+            page_size=cfg.page_size, kv_dtype=cfg.kv_dtype,
+        )
+        self._quantum = cfg.prefill_quantum or self.kv.page_size
+        if self._quantum % self.kv.page_size:
+            raise ValueError(
+                f"prefill_quantum {self._quantum} must be a multiple of "
+                f"the page size {self.kv.page_size} (prompt KV must land "
+                "page-aligned)"
+            )
+
+        s, mp = cfg.slots, cfg.max_pages_per_seq
+        # host slot state; the decode step consumes these by value, so
+        # mutating them BETWEEN steps is the continuous-batching join
+        self._page_tbl = np.full((s, mp), SCRATCH_PAGE, np.int32)
+        self._seq_lens = np.zeros(s, np.int32)
+        self._last_tok = np.zeros(s, np.int32)
+        self._gen_counts = np.zeros(s, np.int32)
+        self._temps = np.zeros(s, np.float32)
+        self._top_ks = np.zeros(s, np.int32)
+        self._seeds = np.zeros(s, np.uint32)
+        self._slot_req: list[Optional[GenerationRequest]] = [None] * s
+
+        self.queue = AdmissionQueue(cfg.max_queue)
+        self._mu = threading.Lock()       # slot state + loop generation
+        self._stop = threading.Event()
+        self._loop_gen = 0
+        self._thread: Optional[threading.Thread] = None
+        self.watchdog = StepWatchdog(
+            floor_s=cfg.watchdog_floor_s,
+            cold_floor_s=cfg.watchdog_cold_floor_s,
+            k=cfg.watchdog_k, abort=self._on_wedged, name="generation",
+        )
+        self._steps = 0
+        self._tokens_out = 0
+        self._step_fn = None
+        self._prefill_fns: dict[int, Callable] = {}
+        if server is not None:
+            server.generation_engine = self
+
+    # -- lifecycle ---------------------------------------------------------
+    def start(self) -> "GenerationEngine":
+        if self._thread is not None and self._thread.is_alive():
+            return self
+        self._stop.clear()
+        with self._mu:
+            self._loop_gen += 1
+            gen = self._loop_gen
+        self._thread = threading.Thread(
+            target=self._loop, args=(gen,),
+            name="dl4jtpu-generation", daemon=True,
+        )
+        self._thread.start()
+        return self
+
+    def stop(self, timeout: float = 10.0) -> None:
+        self._stop.set()
+        t = self._thread
+        if t is not None:
+            t.join(timeout)
+        for req in self.queue.drain():
+            req._fail(ServingRejected("shutdown", "engine stopped"))
+        with self._mu:
+            self._fail_active_locked(
+                ServingRejected("shutdown", "engine stopped")
+            )
+
+    # -- admission ---------------------------------------------------------
+    def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
+               temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+               stop_tokens: tuple = (), on_token=None) -> GenerationRequest:
+        """Admit one stream.  Raises `ServingRejected` on a full queue
+        or an open breaker; over-capacity streams (longer than the page
+        table can hold) are client errors (`ValueError`)."""
+        max_new = int(max_new_tokens if max_new_tokens is not None
+                      else self.config.default_max_new)
+        req = GenerationRequest(
+            prompt, max_new, temperature=temperature, top_k=top_k,
+            seed=seed, stop_tokens=stop_tokens, on_token=on_token,
+        )
+        self._validate(req)
+        self._offer(req)
+        return req
+
+    def _validate(self, req: GenerationRequest) -> None:
+        t_p = req.prompt.shape[0]
+        if t_p < 1:
+            raise ValueError("empty prompt")
+        if req.max_new < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        span = max(bucket_length(t_p, self._quantum), t_p + req.max_new)
+        if self.kv.pages_for(span) > self.config.max_pages_per_seq:
+            cap = self.config.max_pages_per_seq * self.kv.page_size
+            raise ValueError(
+                f"stream needs {span} KV positions; the page table holds "
+                f"{cap} (max_pages_per_seq x page_size)"
+            )
+        _, pos, _, _ = self._stack
+        if pos is not None and pos.learned and span > pos.max_length:
+            raise ValueError(
+                f"stream needs {span} positions; learned "
+                f"PositionalEncoding max_length is {pos.max_length}"
+            )
+
+    def _offer(self, req: GenerationRequest) -> None:
+        if self.breaker is not None and not self.breaker.admits():
+            raise ServingRejected(
+                "breaker_open", f"circuit breaker is {self.breaker.state}"
+            )
+        if not self.queue.offer(req):
+            raise ServingRejected(
+                "queue_full",
+                f"generation queue at capacity ({self.queue.max_queue})",
+            )
+
+    def generate(self, prompt, max_new_tokens: int, *,
+                 temperature: float = 0.0, top_k: int = 0, seed: int = 0,
+                 stop_tokens: tuple = (),
+                 timeout: Optional[float] = 120.0) -> np.ndarray:
+        """Blocking convenience wrapper — submit one stream, wait, and
+        return the `ops.generation.generate`-shaped row."""
+        return self.submit(
+            prompt, max_new_tokens, temperature=temperature, top_k=top_k,
+            seed=seed, stop_tokens=stop_tokens,
+        ).result(timeout)
+
+    # -- prefill/decode disaggregation ------------------------------------
+    def prefill_detached(self, prompt, max_new_tokens: int, *,
+                         temperature: float = 0.0, top_k: int = 0,
+                         seed: int = 0, stop_tokens: tuple = ()) -> dict:
+        """Run ONLY the prefill program here and return a portable
+        handoff (prompt K/V rows as host arrays + the first token + the
+        stream's sampling state).  A decode-role replica resumes the
+        stream from it via `join_prefilled` — K/V cross the replica
+        boundary in f32 and land in whatever page dtype the DECODE
+        pool uses, so a f32 prefill replica can feed an int8 decode
+        replica."""
+        req = GenerationRequest(
+            prompt, int(max_new_tokens), temperature=temperature,
+            top_k=top_k, seed=seed, stop_tokens=stop_tokens,
+        )
+        self._validate(req)
+        try:
+            faults.maybe_fail("serving.prefill")
+        except Exception as exc:
+            raise ServingError(f"injected prefill fault: {exc}") from exc
+        k, v, first, ttft_anchor = self._run_prefill(req)
+        return {
+            "prompt": req.prompt, "k": np.asarray(k), "v": np.asarray(v),
+            "first_token": int(first), "max_new": req.max_new,
+            "temperature": req.temperature, "top_k": req.top_k,
+            "seed": req.seed, "stop_tokens": req.stop_tokens,
+            "t_submit": ttft_anchor,
+        }
+
+    def join_prefilled(self, handoff: dict,
+                       on_token=None) -> GenerationRequest:
+        """Admit a stream whose prefill already ran elsewhere (the
+        decode side of the disaggregation seam)."""
+        req = GenerationRequest(
+            handoff["prompt"], handoff["max_new"],
+            temperature=handoff["temperature"], top_k=handoff["top_k"],
+            seed=handoff["seed"], stop_tokens=handoff["stop_tokens"],
+            on_token=on_token, prefilled=handoff,
+        )
+        req.t_submit = handoff.get("t_submit", req.t_submit)
+        self._validate(req)
+        self._offer(req)
+        return req
+
+    # -- compiled programs -------------------------------------------------
+    def _make_prefill(self, t_b: int):
+        embed, pos, blocks, head = self._stack
+        pos_name, head_name = self._pos_name, self._head_name
+        block_names, embed_name = self._block_names, self._embed_name
+        dt = jnp.bfloat16 if self.model._bf16 else jnp.float32
+
+        @jax.jit
+        def prefill(params, prompt_pad, prompt_len, seed, temp, top_k):
+            # prompt_pad: (1, t_b); rows past prompt_len are pad — with
+            # causal attention they influence nothing before them, and
+            # their garbage K/V rows sit beyond seq_len (masked at
+            # decode, overwritten as the stream grows into them)
+            E = params[embed_name]["W"].astype(dt)
+            x = embed._act()(E[prompt_pad])
+            if pos is not None:
+                x, _ = pos.apply(params.get(pos_name, {}), {}, x)
+            ks, vs = [], []
+            for cfg_b, nm in zip(blocks, block_names):
+                x, k, v = _block_prefill(cfg_b, params[nm], x, None)
+                ks.append(k[0])
+                vs.append(v[0])
+            h_last = x[0, prompt_len - 1]
+            logits = _head_logits(head, params[head_name], h_last)
+            first = _sample_token(
+                logits, temp, top_k,
+                jax.random.fold_in(jax.random.key(seed), 0),
+            )
+            return (jnp.stack(ks).astype(jnp.float32),
+                    jnp.stack(vs).astype(jnp.float32), first)
+
+        return prefill
+
+    def _prefill_fn(self, t_b: int):
+        # `jax.jit` construction is lazy (compilation happens at the
+        # first CALL, outside this lock), so memoizing under `_mu` is
+        # cheap even with the decode loop live
+        with self._mu:
+            fn = self._prefill_fns.get(t_b)
+            if fn is None:
+                fn = self._prefill_fns[t_b] = self._make_prefill(t_b)
+        return fn
+
+    def _run_prefill(self, req: GenerationRequest):
+        """Dispatch the bucketed prefill program for one request;
+        returns (k, v, first_token, ttft_anchor) with k/v shaped
+        (n_layers, t_bucket, H, Dh) f32."""
+        t_p = req.prompt.shape[0]
+        t_b = bucket_length(t_p, self._quantum)
+        pad = np.zeros((1, t_b), np.int32)
+        pad[0, :t_p] = req.prompt
+        with self._weights_lock:
+            params = self.model.params
+        k, v, first = self._prefill_fn(t_b)(
+            params, pad, np.int32(t_p), np.uint32(req.seed),
+            np.float32(req.temperature), np.int32(req.top_k),
+        )
+        return k, v, int(first), req.t_submit
+
+    def _make_step(self):
+        embed, pos, blocks, head = self._stack
+        pos_name, head_name = self._pos_name, self._head_name
+        block_names, embed_name = self._block_names, self._embed_name
+        d, ps = self._d, self.kv.page_size
+        h_, dh = self._n_heads, self._head_dim
+        quant = self.kv.kv_dtype == "int8"
+        impl = self.config.attention_impl
+        interp = self.config.attention_interpret
+        n_slots = self.config.slots
+
+        @jax.jit
+        def step(params, k_pages, v_pages, k_scales, v_scales,
+                 page_tbl, seq_lens, last_tok, seeds, gen_counts,
+                 temps, top_ks):
+            dt = jnp.bfloat16 if self.model._bf16 else jnp.float32
+            active = seq_lens > 0
+            pos_idx = seq_lens                       # write position
+            E = params[embed_name]["W"].astype(dt)
+            x_t = embed._act()(E[last_tok])          # (S, D)
+            pe = jax.vmap(
+                lambda t: _pe_row(pos, params.get(pos_name, {}), t, d)
+            )(pos_idx)
+            x_t = x_t + pe.astype(dt)
+            page_of = page_tbl[jnp.arange(n_slots), pos_idx // ps]
+            row_of = pos_idx % ps
+            attend = seq_lens + 1                    # includes this token
+            for li, (cfg_b, nm) in enumerate(zip(blocks, block_names)):
+                lp = params[nm]
+                ap = lp["attn"]
+                hh = _ln(lp["ln1"], x_t)
+                q = (hh @ ap["Wq"].astype(dt)).reshape(n_slots, h_, dh)
+                k_t = (hh @ ap["Wk"].astype(dt)).reshape(n_slots, h_, dh)
+                v_t = (hh @ ap["Wv"].astype(dt)).reshape(n_slots, h_, dh)
+                if quant:
+                    kq, ksc = quantize_page_rows(k_t)
+                    vq, vsc = quantize_page_rows(v_t)
+                    k_pages = k_pages.at[li, page_of, row_of].set(kq)
+                    v_pages = v_pages.at[li, page_of, row_of].set(vq)
+                    k_scales = k_scales.at[li, page_of, row_of].set(ksc)
+                    v_scales = v_scales.at[li, page_of, row_of].set(vsc)
+                    attn = paged_attention(
+                        q.astype(jnp.float32), k_pages[li], v_pages[li],
+                        page_tbl, attend, k_scale=k_scales[li],
+                        v_scale=v_scales[li], impl=impl, interpret=interp,
+                    )
+                else:
+                    k_pages = k_pages.at[li, page_of, row_of].set(
+                        k_t.astype(k_pages.dtype))
+                    v_pages = v_pages.at[li, page_of, row_of].set(
+                        v_t.astype(v_pages.dtype))
+                    attn = paged_attention(
+                        q.astype(jnp.float32), k_pages[li], v_pages[li],
+                        page_tbl, attend, impl=impl, interpret=interp,
+                    )
+                out = attn.reshape(n_slots, h_ * dh).astype(dt)
+                x_t = x_t + out @ ap["Wo"].astype(dt)
+                hh = _ln(lp["ln2"], x_t)
+                hh = cfg_b.ffn_activation(
+                    hh @ lp["W1"].astype(dt) + lp["b1"].astype(dt))
+                x_t = x_t + (hh @ lp["W2"].astype(dt)
+                             + lp["b2"].astype(dt))
+            logits = _head_logits(head, params[head_name], x_t)
+            keys = _slot_keys(seeds, gen_counts)
+            nxt = jax.vmap(_sample_token)(
+                logits.astype(jnp.float32), temps, top_ks, keys,
+            )
+            nxt = jnp.where(active, nxt, 0)
+            return k_pages, v_pages, k_scales, v_scales, nxt
+
+        return step
+
+    # -- the decode loop ---------------------------------------------------
+    def _loop(self, my_gen: int) -> None:
+        try:
+            while not self._stop.is_set():
+                with self._mu:
+                    if self._loop_gen != my_gen:
+                        return
+                    n_active = sum(
+                        r is not None for r in self._slot_req)
+                self._refill(my_gen, block=(n_active == 0))
+                with self._mu:
+                    if self._loop_gen != my_gen:
+                        return
+                    n_active = sum(
+                        r is not None for r in self._slot_req)
+                if n_active == 0:
+                    continue
+                self._decode_step(my_gen)
+        except Exception as exc:                      # never die silently
+            log.exception("generation loop died")
+            with self._mu:
+                if self._loop_gen == my_gen:
+                    self._fail_active_locked(
+                        ServingError(f"generation loop died: {exc}"))
+
+    def _free_slots(self) -> list[int]:
+        return [i for i, r in enumerate(self._slot_req) if r is None]
+
+    def _refill(self, my_gen: int, block: bool) -> None:
+        """Admit queued streams into free slots — the continuous-batching
+        join point, strictly BETWEEN decode steps."""
+        free = self._free_slots()
+        if not free:
+            return
+        if self.queue.depth == 0 and not block:
+            return
+        batch = self.queue.take_batch(
+            len(free), linger_s=0.0, stop=self._stop,
+            poll_s=self.config.poll_s,
+        )
+        for req in batch:
+            if req.cancelled:
+                req._fail(ServingRejected("shutdown", "cancelled"))
+                continue
+            slot = self._free_slots()
+            if not slot:                  # more takes than slots freed
+                self._offer_back(req)
+                continue
+            self._admit_to_slot(my_gen, slot[0], req)
+
+    def _offer_back(self, req: GenerationRequest) -> None:
+        if not self.queue.offer(req):
+            req._fail(ServingRejected("queue_full", "requeue failed"))
+
+    def _admit_to_slot(self, my_gen: int, slot: int,
+                       req: GenerationRequest) -> None:
+        t_p = req.prompt.shape[0]
+        if req.prefilled is None:
+            t_b = bucket_length(t_p, self._quantum)
+        else:
+            t_b = int(req.prefilled["k"].shape[1])
+        span = max(t_b, t_p + req.max_new)
+        try:
+            self.kv.alloc(req.rid, self.kv.pages_for(span))
+        except KVPoolExhausted as exc:
+            # the explicit 429 — the stream never stalls waiting on HBM
+            req._fail(ServingRejected("kv_exhausted", str(exc)))
+            return
+        try:
+            if req.prefilled is None:
+                faults.maybe_fail("serving.prefill")
+                k, v, first, _ = self._run_prefill(req)
+            else:
+                k, v = req.prefilled["k"], req.prefilled["v"]
+                first = req.prefilled["first_token"]
+            tbl = self.kv.write_prefill(req.rid, k, v)
+        except Exception as exc:
+            self.kv.release(req.rid)
+            req._fail(ServingError(f"prefill failed: {exc}"))
+            return
+        req._record(first)
+        self._observe_ttft(req)
+        self._count_tokens(1)
+        if req.max_new <= 1 or first in req.stop_tokens:
+            self.kv.release(req.rid)
+            req._complete()
+            return
+        with self._mu:
+            if self._loop_gen != my_gen:
+                self.kv.release(req.rid)
+                req._fail(ServingError("engine respawned during admit"))
+                return
+            row = np.full(self.config.max_pages_per_seq, SCRATCH_PAGE,
+                          np.int32)
+            row[: len(tbl)] = tbl
+            self._page_tbl[slot] = row
+            self._seq_lens[slot] = t_p
+            self._last_tok[slot] = first
+            self._gen_counts[slot] = 1
+            self._temps[slot] = req.temperature
+            self._top_ks[slot] = req.top_k
+            self._seeds[slot] = np.uint32(req.seed)
+            self._slot_req[slot] = req
+        self._gauge_occupancy()
+
+    def _decode_step(self, my_gen: int) -> None:
+        """One token for every live slot: fault consult -> params
+        snapshot (hot-swap boundary) -> watchdog-armed dispatch ->
+        harvest (stop conditions, page release, slot free)."""
+        try:
+            faults.maybe_fail("serving.decode")
+        except Exception as exc:
+            self._step_failed(my_gen, exc)
+            return
+        if self._step_fn is None:
+            self._step_fn = self._make_step()
+        with self._mu:
+            if self._loop_gen != my_gen:
+                return
+            args = (self._page_tbl.copy(), self._seq_lens.copy(),
+                    self._last_tok.copy(), self._seeds.copy(),
+                    self._gen_counts.copy(), self._temps.copy(),
+                    self._top_ks.copy())
+        with self._weights_lock:
+            # the hot-swap boundary: push_weights installs under this
+            # lock, so a swap lands BETWEEN decode steps and in-flight
+            # streams continue (on the new weights) with zero drops
+            params = self.model.params
+        self._steps += 1
+        self.watchdog.arm(self._steps)
+        t0 = time.perf_counter()
+        try:
+            out = self._step_fn(
+                params, self.kv.k_pages, self.kv.v_pages,
+                self.kv.k_scales, self.kv.v_scales, *args,
+            )
+            nxt = np.asarray(out[4])
+        except Exception as exc:
+            self.watchdog.disarm(None)
+            self._step_failed(my_gen, exc)
+            return
+        self.watchdog.disarm(time.perf_counter() - t0)
+        with self._mu:
+            if self._loop_gen != my_gen:
+                return                     # wedged + respawned: stale
+            self.kv.k_pages, self.kv.v_pages = out[0], out[1]
+            self.kv.k_scales, self.kv.v_scales = out[2], out[3]
+            finished: list[tuple[GenerationRequest, bool]] = []
+            n_live = 0
+            for s, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                if req.cancelled:
+                    self._clear_slot(s)
+                    finished.append((req, False))
+                    continue
+                n_live += 1
+                tok = int(nxt[s])
+                req._record(tok)
+                self._seq_lens[s] += 1
+                self._gen_counts[s] += 1
+                self._last_tok[s] = tok
+                if (self._gen_counts[s] >= req.max_new
+                        or tok in req.stop_tokens):
+                    self._clear_slot(s)
+                    finished.append((req, True))
+        if self.breaker is not None:
+            self.breaker.record_success()
+        self._count_tokens(n_live)
+        for req, ok in finished:
+            self.kv.release(req.rid)
+            if ok:
+                req._complete()
+            else:
+                req._fail(ServingRejected("shutdown", "cancelled"))
+        self._gauge_occupancy()
+
+    def _clear_slot(self, s: int) -> None:
+        """Caller holds self._mu.  Pages are released by the caller
+        (outside the lock) via kv.release."""
+        self._slot_req[s] = None
+        self._page_tbl[s, :] = SCRATCH_PAGE
+        self._seq_lens[s] = 0
+        self._last_tok[s] = 0
+        self._gen_counts[s] = 0
+        self._temps[s] = 0.0
+        self._top_ks[s] = 0
+        self._seeds[s] = 0
+
+    # -- failure paths -----------------------------------------------------
+    def _step_failed(self, my_gen: int, exc: BaseException) -> None:
+        log.error("generation decode step failed: %s", exc)
+        if self.breaker is not None:
+            self.breaker.record_failure()
+        with self._mu:
+            if self._loop_gen != my_gen:
+                return
+            self._fail_active_locked(
+                ServingError(f"decode step failed: {exc}"))
+        self._gauge_occupancy()
+
+    def _fail_active_locked(self, exc: BaseException) -> None:
+        """Caller holds self._mu: fail every in-flight stream and
+        release ALL of their pages — the watchdog-abort contract."""
+        for s, req in enumerate(self._slot_req):
+            if req is None:
+                continue
+            self._clear_slot(s)
+            self.kv.release(req.rid)
+            req._fail(exc)
+
+    def _on_wedged(self, event: dict) -> None:
+        """Watchdog stage-3 abort: the dispatched step never returned.
+        Fail every in-flight stream, release all their pages, trip the
+        breaker, and respawn the loop under a new generation — the
+        wedged thread's eventual return sees a stale generation and
+        discards itself."""
+        log.error("generation decode step wedged: %s", event)
+        if self.breaker is not None:
+            self.breaker.record_failure()
+        with self._mu:
+            self._loop_gen += 1
+            gen = self._loop_gen
+            self._fail_active_locked(
+                ServingError(f"decode step wedged: {event.get('stage')}"))
+        self._gauge_occupancy()
+        if not self._stop.is_set():
+            self._thread = threading.Thread(
+                target=self._loop, args=(gen,),
+                name="dl4jtpu-generation", daemon=True,
+            )
+            self._thread.start()
+
+    # -- introspection -----------------------------------------------------
+    def active_streams(self) -> int:
+        with self._mu:
+            return sum(r is not None for r in self._slot_req)
+
+    def drain(self, timeout: float = 30.0) -> bool:
+        """Wait until no stream is in flight and the queue is empty —
+        True when drained within the timeout."""
+        t_end = time.monotonic() + timeout
+        while time.monotonic() < t_end:
+            if self.active_streams() == 0 and self.queue.depth == 0:
+                return True
+            time.sleep(self.config.poll_s)
+        return False
+
+    def stats(self) -> dict:
+        with self._mu:
+            active = sum(r is not None for r in self._slot_req)
+        return {
+            "slots": self.config.slots,
+            "active_streams": active,
+            "queue_depth": self.queue.depth,
+            "decode_steps": self._steps,
+            "tokens_generated": self._tokens_out,
+            "kv": self.kv.stats(),
+        }
+
+    # -- telemetry ---------------------------------------------------------
+    def _count_tokens(self, n: int) -> None:
+        if n <= 0:
+            return
+        self._tokens_out += n
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().counter("dl4jtpu_decode_tokens_total").inc(n)
+        except Exception as e:
+            log.debug("decode token metric failed: %s", e)
+
+    def _observe_ttft(self, req: GenerationRequest) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            if req.ttft_s is not None:
+                registry().histogram("dl4jtpu_ttft_seconds").observe(
+                    req.ttft_s)
+        except Exception as e:
+            log.debug("ttft metric failed: %s", e)
+
+    def _gauge_occupancy(self) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            with self._mu:
+                active = sum(r is not None for r in self._slot_req)
+            registry().gauge("dl4jtpu_decode_batch_occupancy").set(
+                active / max(1, self.config.slots))
+        except Exception as e:
+            log.debug("occupancy gauge failed: %s", e)

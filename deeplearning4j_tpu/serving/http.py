@@ -11,6 +11,18 @@ server answers fast with a reason, never hangs the socket:
                     -> 503 breaker_open | deadline | admit_fault
                     -> 504 deadline expired after admission
                     -> 500 dispatch failed (wedged / non-finite)
+  POST /v1/generate {"prompt": [1, 7, 3], "max_new_tokens": 32,
+                     "temperature": 0.8, "top_k": 40, "seed": 0,
+                     "stop_tokens": [2], "stream": false}
+                    -> 200 {"tokens", "prompt_len", "ttft_ms",
+                            "generation"}
+                    -> 200 (stream=true) newline-delimited JSON chunks
+                       {"token", "index"} ... then {"done": true}
+                    -> 400 bad request (no engine / over-capacity
+                           stream / malformed prompt)
+                    -> 429 queue_full | kv_exhausted (retry later)
+                    -> 503 breaker_open
+                    -> 500 prefill/decode step failed
   POST /v1/reload   {"path": "/ckpts/ckpt_00000042.zip"}
                     -> 200 installed {"generation"}
                     -> 409 rolled_back (verification failed; old params
@@ -124,10 +136,121 @@ class ServingHTTPServer:
                     return
                 if u.path == "/v1/infer":
                     self._infer(payload)
+                elif u.path == "/v1/generate":
+                    self._generate(payload)
                 elif u.path == "/v1/reload":
                     self._reload(payload)
                 else:
                     self._json({"error": "not found"}, 404)
+
+            def _generate(self, payload):
+                engine = getattr(outer.server, "generation_engine", None)
+                if engine is None:
+                    self._json(
+                        {"error": "no generation engine attached to "
+                                  "this replica"}, 400)
+                    return
+                try:
+                    prompt = np.asarray(
+                        payload.get("prompt"), np.int32).reshape(-1)
+                except (TypeError, ValueError) as exc:
+                    self._json({"error": f"bad prompt: {exc}"}, 400)
+                    return
+                kwargs = dict(
+                    max_new_tokens=payload.get("max_new_tokens"),
+                    temperature=float(payload.get("temperature", 0.0)),
+                    top_k=int(payload.get("top_k", 0)),
+                    seed=int(payload.get("seed", 0)),
+                    stop_tokens=tuple(payload.get("stop_tokens", ())),
+                )
+                timeout = float(payload.get("timeout_s", 120.0))
+                if payload.get("stream"):
+                    self._generate_stream(engine, prompt, kwargs, timeout)
+                    return
+                try:
+                    req = engine.submit(prompt, **kwargs)
+                    out = req.result(timeout)
+                except ServingRejected as exc:
+                    self._json({"error": str(exc), "reason": exc.reason},
+                               exc.status)
+                    return
+                except ServingTimeout as exc:
+                    self._json({"error": str(exc),
+                                "reason": "deadline_expired"}, exc.status)
+                    return
+                except ServingError as exc:
+                    self._json({"error": str(exc),
+                                "reason": "dispatch_failed"}, exc.status)
+                    return
+                except ValueError as exc:   # over-capacity stream etc.
+                    self._json({"error": str(exc)}, 400)
+                    return
+                self._json({
+                    "tokens": np.asarray(out).tolist(),
+                    "prompt_len": int(prompt.shape[0]),
+                    "ttft_ms": (round(req.ttft_s * 1000.0, 3)
+                                if req.ttft_s is not None else None),
+                    "generation": outer.server.generation,
+                })
+
+            def _generate_stream(self, engine, prompt, kwargs, timeout):
+                """Chunked newline-delimited JSON: one {"token", "index"}
+                line per generated token as the decode loop emits it,
+                then a {"done": true} terminator carrying the totals."""
+                import queue as _q
+
+                chunks: _q.Queue = _q.Queue()
+
+                def on_token(tok, idx):
+                    chunks.put((tok, idx))
+
+                try:
+                    req = engine.submit(prompt, on_token=on_token,
+                                        **kwargs)
+                except ServingRejected as exc:
+                    self._json({"error": str(exc), "reason": exc.reason},
+                               exc.status)
+                    return
+                except ValueError as exc:
+                    self._json({"error": str(exc)}, 400)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-ndjson")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+
+                def send(obj):
+                    body = (json.dumps(obj) + "\n").encode()
+                    self.wfile.write(f"{len(body):x}\r\n".encode())
+                    self.wfile.write(body + b"\r\n")
+                    self.wfile.flush()
+
+                import time as _t
+
+                t_end = _t.monotonic() + timeout
+                try:
+                    while True:
+                        try:
+                            tok, idx = chunks.get(timeout=0.1)
+                            send({"token": int(tok), "index": int(idx)})
+                        except _q.Empty:
+                            if req.done and chunks.empty():
+                                break
+                            if _t.monotonic() > t_end:
+                                req.cancel()
+                                break
+                    err = req.error
+                    send({"done": True,
+                          "n_tokens": len(req.tokens_so_far()),
+                          "error": str(err) if err is not None else None,
+                          "ttft_ms": (round(req.ttft_s * 1000.0, 3)
+                                      if req.ttft_s is not None
+                                      else None)})
+                    self.wfile.write(b"0\r\n\r\n")
+                    self.wfile.flush()
+                except (BrokenPipeError, ConnectionResetError):
+                    # client hung up mid-stream: stop decoding for them
+                    req.cancel()
 
             def _infer(self, payload):
                 try:

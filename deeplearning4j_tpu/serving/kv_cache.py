@@ -1,0 +1,328 @@
+"""Paged KV cache — a block allocator over a preallocated HBM pool.
+
+The dense per-request cache `ops/generation.py` seeds is O(max_len) HBM
+per request whether the request uses it or not; a serving engine that
+admits requests of mixed lengths needs the vLLM/Gemma-serving layout
+instead: K/V live in fixed-size PAGES of one preallocated pool, each
+request holds a page table (ordered pool-page indices), and pages
+free-list back on finish/cancel/abort.  Fragmentation is bounded to
+less than one page per sequence, and the decode program's shapes stay
+STATIC (pool, page table width) — the compiled program set is bounded
+exactly the way `flags.bucket_length` bounds the training set, which is
+why ``page_size`` is itself quantized through `bucket_length`.
+
+Layout (per layer, K and V each)::
+
+    pages:  (num_pages, page_size, n_heads, head_dim)   f32 | int8
+    scales: (num_pages, page_size, n_heads)             f32 (int8 only)
+
+Position ``p`` of a request lives at row ``p % page_size`` of pool page
+``table[p // page_size]``.  Page 0 is RESERVED as the engine's scratch
+page (idle decode slots write their garbage rows there), so the
+allocator hands out pages ``1..num_pages-1``.
+
+int8 pages follow `quant.quantize_array`'s scheme — symmetric,
+``scale = max|row| / 127`` with all-zero rows pinned to scale 1.0 —
+applied per (position, head) row over ``head_dim`` (`quantize_page_rows`
+below; the per-page scale BLOCK (page_size, n_heads) travels with its
+page).  K/V rows are written once and never rescaled, so quantization
+error is pure rounding — no clipping against a stale page maximum —
+and the parity gate is the PR 13 agreement gate, not exactness.
+
+The allocator is HOST state (free list + page tables + counters) under
+one lock; the device arrays are owned by the caller (`GenerationEngine`
+threads them through its jitted step functionally).  Exhaustion raises
+`KVPoolExhausted` — mapped by admission to HTTP 429, the explicit
+"retry later" backpressure signal, never a silent stall — and the fault
+site ``kv.alloc`` makes that path provokable (`raise` = injected
+exhaustion).  Occupancy lands on the telemetry spine as
+``dl4jtpu_kv_pages_used`` / ``dl4jtpu_kv_pages_total``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.runtime.flags import bucket_length
+
+log = logging.getLogger("deeplearning4j_tpu")
+
+#: pool page 0 is the scratch page idle slots scribble on — never handed
+#: to a request, never read back
+SCRATCH_PAGE = 0
+
+#: page sizes are quantized to a multiple of this, the same
+#: recompile-hygiene move `flags.bucket_length` makes for the time axis
+PAGE_QUANTUM = 8
+
+
+class KVPoolExhausted(RuntimeError):
+    """The pool has no free page for this allocation.  Admission maps it
+    to an explicit 429 (``kv_exhausted``) — backpressure, never a stall."""
+
+
+def quantize_page_rows(a):
+    """Quantize K/V rows to int8 with per-(position, head) scales over
+    the last (``head_dim``) axis — `quant.quantize_array`'s symmetric
+    scheme (``max|row|/127``, zero rows -> scale 1.0) applied at the
+    granularity a paged append needs: each row is written ONCE with its
+    own scale, so no append ever clips against another row's maximum.
+
+    ``a``: (..., head_dim) float.  Returns ``(q int8, scale f32)`` with
+    ``scale.shape == a.shape[:-1]`` and ``dequant = q * scale[...,None]``.
+    """
+    a = jnp.asarray(a, jnp.float32)
+    amax = jnp.max(jnp.abs(a), axis=-1)
+    scale = jnp.where(amax > 0.0, amax / 127.0, 1.0)
+    q = jnp.clip(jnp.round(a / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale.astype(jnp.float32)
+
+
+class PagedKVCache:
+    """Pool arrays + the block allocator for one transformer stack.
+
+        kv = PagedKVCache(n_layers=2, n_heads=4, head_dim=32,
+                          num_pages=256, page_size=16)
+        pages = kv.alloc("req-1", n_pages=3)     # -> [7, 12, 3]
+        ...decode...
+        kv.release("req-1")                      # pages free-list back
+
+    Device state: ``k_pages``/``v_pages`` are (n_layers, num_pages,
+    page_size, n_heads, head_dim); int8 mode adds ``k_scales``/
+    ``v_scales`` (n_layers, num_pages, page_size, n_heads).  The engine
+    reads these, threads them through its jitted step, and writes the
+    updated arrays back — the allocator never touches them.
+    """
+
+    def __init__(self, n_layers: int, n_heads: int, head_dim: int,
+                 num_pages: int, page_size: int,
+                 kv_dtype: str = "f32"):
+        if kv_dtype not in ("f32", "int8"):
+            raise ValueError(f"kv_dtype must be f32|int8, got {kv_dtype!r}")
+        if num_pages < 2:
+            raise ValueError("pool needs >= 2 pages (page 0 is scratch)")
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.head_dim = int(head_dim)
+        # recompile hygiene: a page size of 13 would give every distinct
+        # prompt-length bucket its own page count AND its own tail shape
+        self.page_size = bucket_length(page_size, PAGE_QUANTUM)
+        self.num_pages = int(num_pages)
+        self.kv_dtype = kv_dtype
+        shape = (self.n_layers, self.num_pages, self.page_size,
+                 self.n_heads, self.head_dim)
+        store = jnp.int8 if kv_dtype == "int8" else jnp.float32
+        self.k_pages = jnp.zeros(shape, store)
+        self.v_pages = jnp.zeros(shape, store)
+        self.k_scales = self.v_scales = None
+        if kv_dtype == "int8":
+            sshape = shape[:-1]
+            # scale 1.0 everywhere: untouched rows dequantize to exact 0
+            self.k_scales = jnp.ones(sshape, jnp.float32)
+            self.v_scales = jnp.ones(sshape, jnp.float32)
+        self._lock = threading.Lock()
+        self._free: list[int] = list(range(self.num_pages - 1, 0, -1))
+        self._tables: dict[object, list[int]] = {}
+        self._alloc_failures = 0
+        self._gauge_total()
+        self._gauge_used(0)
+
+    # -- geometry ----------------------------------------------------------
+    def pages_for(self, length: int) -> int:
+        """Pages needed to hold ``length`` positions (>= 1 so even an
+        empty table owns its first page before decode writes to it)."""
+        return max(1, -(-int(length) // self.page_size))
+
+    def bytes_per_token(self) -> int:
+        """HBM bytes one position costs across layers and K+V (the
+        residency number `bench.py --generate` reports): int8 pays 1
+        byte/element plus the f32 per-(position, head) scale."""
+        elems = self.n_layers * 2 * self.n_heads * self.head_dim
+        if self.kv_dtype == "int8":
+            return elems + self.n_layers * 2 * self.n_heads * 4
+        return elems * 4
+
+    # -- allocation --------------------------------------------------------
+    def alloc(self, rid, n_pages: int) -> list[int]:
+        """Allocate ``n_pages`` pool pages for request ``rid`` (appended
+        to its table if it already holds some).  Raises `KVPoolExhausted`
+        when the free list is short — the caller rejects the request
+        explicitly (429) and MUST NOT retry inside the decode loop.
+        Fault site ``kv.alloc``: ``raise`` = injected exhaustion."""
+        try:
+            faults.maybe_fail("kv.alloc")
+        except Exception as exc:
+            self._count_failure()
+            raise KVPoolExhausted(f"injected exhaustion: {exc}") from exc
+        n_pages = int(n_pages)
+        if n_pages < 0:
+            raise ValueError("n_pages must be >= 0")
+        with self._lock:
+            if n_pages > len(self._free):
+                self._alloc_failures += 1
+                short = n_pages - len(self._free)
+                used = self.num_pages - 1 - len(self._free)
+                err = KVPoolExhausted(
+                    f"kv pool exhausted: need {n_pages} page(s), "
+                    f"{len(self._free)} free ({short} short; "
+                    f"{used}/{self.num_pages - 1} in use)"
+                )
+            else:
+                got = [self._free.pop() for _ in range(n_pages)]
+                self._tables.setdefault(rid, []).extend(got)
+                used = self.num_pages - 1 - len(self._free)
+                err = None
+        if err is not None:
+            self._count_failure()
+            raise err
+        self._gauge_used(used)
+        return got
+
+    def extend(self, rid, length: int) -> list[int]:
+        """Grow ``rid``'s table to cover ``length`` positions; returns
+        the newly allocated pages (possibly [])."""
+        with self._lock:
+            have = len(self._tables.get(rid, ()))
+        need = self.pages_for(length) - have
+        return self.alloc(rid, need) if need > 0 else []
+
+    def release(self, rid) -> int:
+        """Free every page ``rid`` holds (finish, cancel, watchdog
+        abort — all exits funnel here).  Idempotent; returns the number
+        of pages freed."""
+        with self._lock:
+            pages = self._tables.pop(rid, None)
+            if pages:
+                self._free.extend(pages)
+            used = self.num_pages - 1 - len(self._free)
+        if pages:
+            self._gauge_used(used)
+        return len(pages or ())
+
+    def table(self, rid) -> list[int]:
+        with self._lock:
+            return list(self._tables.get(rid, ()))
+
+    # -- introspection -----------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        with self._lock:
+            return len(self._free)
+
+    @property
+    def used_pages(self) -> int:
+        with self._lock:
+            return self.num_pages - 1 - len(self._free)
+
+    def occupancy(self) -> float:
+        """Fraction of allocatable pages in use, in [0, 1] — the KV
+        component of `shed_pressure` (1.0 = the next alloc is a 429)."""
+        with self._lock:
+            return 1.0 - len(self._free) / max(1, self.num_pages - 1)
+
+    def stats(self) -> dict:
+        with self._lock:
+            return {
+                "num_pages": self.num_pages,
+                "page_size": self.page_size,
+                "kv_dtype": self.kv_dtype,
+                "used_pages": self.num_pages - 1 - len(self._free),
+                "free_pages": len(self._free),
+                "requests": len(self._tables),
+                "alloc_failures": self._alloc_failures,
+                "bytes_per_token": self.bytes_per_token(),
+            }
+
+    def leak_check(self) -> Optional[str]:
+        """None when every non-scratch page is either free or owned by
+        exactly one table — the invariant the release-on-every-exit
+        discipline maintains (tests assert on this)."""
+        with self._lock:
+            owned = [p for t in self._tables.values() for p in t]
+            seen = set(owned)
+            if len(seen) != len(owned):
+                return "page owned by two tables"
+            if seen & set(self._free):
+                return "page both free and owned"
+            if SCRATCH_PAGE in seen:
+                return "scratch page handed out"
+            total = len(self._free) + len(owned)
+            if total != self.num_pages - 1:
+                return (f"{self.num_pages - 1 - total} page(s) leaked "
+                        f"({len(self._free)} free + {len(owned)} owned)")
+        return None
+
+    # -- device-side page writes -------------------------------------------
+    def write_prefill(self, rid, k, v) -> np.ndarray:
+        """Write a prompt's K/V rows into ``rid``'s pages (the prefill
+        -> pool handoff).  ``k``/``v``: (n_layers, T, n_heads, head_dim)
+        with T a multiple of ``page_size`` (the prefill bucket quantum
+        guarantees it); the table must already cover T positions.
+        Returns the page table as an int32 array (for the decode step's
+        page-table row)."""
+        pages = self.table(rid)
+        t = int(k.shape[1])
+        n = t // self.page_size
+        if t % self.page_size or n > len(pages):
+            raise ValueError(
+                f"prefill length {t} does not fit {len(pages)} page(s) "
+                f"of {self.page_size}"
+            )
+        idx = jnp.asarray(pages[:n], jnp.int32)
+        ps = self.page_size
+        if self.kv_dtype == "int8":
+            kq, ks = quantize_page_rows(k)
+            vq, vs = quantize_page_rows(v)
+            self.k_pages = self.k_pages.at[:, idx].set(
+                kq.reshape(self.n_layers, n, ps, self.n_heads,
+                           self.head_dim))
+            self.v_pages = self.v_pages.at[:, idx].set(
+                vq.reshape(self.n_layers, n, ps, self.n_heads,
+                           self.head_dim))
+            self.k_scales = self.k_scales.at[:, idx].set(
+                ks.reshape(self.n_layers, n, ps, self.n_heads))
+            self.v_scales = self.v_scales.at[:, idx].set(
+                vs.reshape(self.n_layers, n, ps, self.n_heads))
+        else:
+            self.k_pages = self.k_pages.at[:, idx].set(
+                jnp.asarray(k, jnp.float32).reshape(
+                    self.n_layers, n, ps, self.n_heads, self.head_dim))
+            self.v_pages = self.v_pages.at[:, idx].set(
+                jnp.asarray(v, jnp.float32).reshape(
+                    self.n_layers, n, ps, self.n_heads, self.head_dim))
+        return np.asarray(pages, np.int32)
+
+    # -- telemetry (never on the allocation's critical path) ---------------
+    def _count_failure(self) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().counter("dl4jtpu_serving_shed_total").inc(
+                reason="kv_exhausted"
+            )
+        except Exception as e:
+            log.debug("kv alloc-failure metric failed: %s", e)
+
+    def _gauge_total(self) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().gauge("dl4jtpu_kv_pages_total").set(
+                self.num_pages - 1
+            )
+        except Exception as e:
+            log.debug("kv total gauge failed: %s", e)
+
+    def _gauge_used(self, used: int) -> None:
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().gauge("dl4jtpu_kv_pages_used").set(used)
+        except Exception as e:
+            log.debug("kv used gauge failed: %s", e)

@@ -91,16 +91,31 @@ class RouterConfig:
     try_timeout_s: Optional[float] = None  # per-try cap (wedge detector)
 
 
+#: replica roles for prefill/decode disaggregation: ``prefill`` runs
+#: only prompt prefill programs, ``decode`` only the continuous decode
+#: batch, ``both`` serves everything (the default single-group fleet)
+ROLES = ("prefill", "decode", "both")
+
+
 class ReplicaHandle:
     """One routable replica: an in-process `InferenceServer` today (the
     HTTP frontend wraps the same object, so a remote handle only needs
     to speak `/healthz` + `/v1/infer` — same payloads, same contract).
     Caches the pulled health for `refresh_s` so a hot router does not
-    hammer the replica's locks on every request."""
+    hammer the replica's locks on every request.
 
-    def __init__(self, name: str, server, refresh_s: float = 0.05):
+    ``role`` assigns the replica to a generation serving group
+    (prefill / decode / both); `Router.pick_for_role` steers token
+    traffic by it, while classic `/v1/infer` routing stays
+    role-agnostic."""
+
+    def __init__(self, name: str, server, refresh_s: float = 0.05,
+                 role: str = "both"):
+        if role not in ROLES:
+            raise ValueError(f"role must be one of {ROLES}, got {role!r}")
         self.name = name
         self.server = server
+        self.role = role
         self.refresh_s = float(refresh_s)
         self._lock = threading.Lock()
         self._cached: Optional[dict] = None
@@ -264,6 +279,41 @@ class Router:
             ties = [h for h in pool if pressures[h.name] <= best + 1e-9]
             self._rr += 1
             return ties[self._rr % len(ties)], False
+
+    def pick_for_role(self, need: str):
+        """Least-pressured live ACTIVE replica whose role serves
+        ``need`` (``prefill`` or ``decode``; ``both`` replicas serve
+        either).  Pressure includes the KV-occupancy term
+        (`InferenceServer.shed_pressure`), so a decode replica whose
+        page pool is filling sheds token traffic here — BEFORE its
+        admissions start answering ``kv_exhausted`` 429s.  Raises
+        ``ServingRejected(no_replicas)`` when the role group is empty
+        or fully ejected."""
+        if need not in ("prefill", "decode"):
+            raise ValueError(f"need must be prefill|decode, got {need!r}")
+        pressures = {
+            h.name: h.pressure() for h in self.replicas
+            if not h.dead and h.role in (need, "both")
+        }
+        with self._lock:
+            candidates = [
+                h for h in self.replicas
+                if h.name in pressures
+                and self._state[h.name]["state"] == ACTIVE
+            ]
+            if not candidates:
+                raise ServingRejected(
+                    "no_replicas",
+                    f"no routable {need} replica "
+                    f"({len(self.replicas)} total)",
+                )
+            under = [h for h in candidates
+                     if pressures[h.name] < self.config.pressure_ceiling]
+            pool = under or candidates
+            best = min(pressures[h.name] for h in pool)
+            ties = [h for h in pool if pressures[h.name] <= best + 1e-9]
+            self._rr += 1
+            return ties[self._rr % len(ties)]
 
     def _record(self, handle, outcome: str, probe: bool,
                 eject_reason: Optional[str] = None) -> None:

@@ -212,6 +212,9 @@ class InferenceServer:
         self._stop = threading.Event()
         self._thread: Optional[threading.Thread] = None
         self.warmed_signatures: list[tuple] = []
+        # a serving.generation.GenerationEngine attaches itself here;
+        # /v1/generate and the shed_pressure KV term read through it
+        self.generation_engine = None
         _register_server(self)
 
     # -- lifecycle ---------------------------------------------------------
@@ -976,7 +979,12 @@ class InferenceServer:
           ``default_deadline_s`` — exactly the quantity `_admit` sheds
           on, so pressure ≈ 1 precisely when deadline sheds begin);
         - breaker state (open = 1.0: everything is rejected; half-open
-          = 0.75: only the single probe gets through).
+          = 0.75: only the single probe gets through);
+        - KV-pool occupancy, when a `serving.generation.GenerationEngine`
+          is attached (1.0 = the next stream admission is a
+          ``kv_exhausted`` 429) — this is how a role-aware router
+          steers token traffic away from a decode replica whose page
+          pool is filling.
 
         Cold start (no batch-latency sample yet, or a coarse clock
         measured 0.0): the latency term is simply absent — the queue
@@ -992,7 +1000,14 @@ class InferenceServer:
         b = {"closed": 0.0, "half_open": 0.75, "open": 1.0}.get(
             self.breaker.state, 1.0,
         )
-        return min(1.0, max(q, lat, b))
+        kv = 0.0
+        engine = getattr(self, "generation_engine", None)
+        if engine is not None:
+            try:
+                kv = float(engine.kv.occupancy())
+            except Exception:     # a dying engine must not break health
+                kv = 0.0
+        return min(1.0, max(q, lat, b, kv))
 
     def health(self) -> dict:
         """The pull-based health payload (``GET /healthz`` body, and what

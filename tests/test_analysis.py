@@ -365,6 +365,15 @@ class TestTier1Gate:
             "dl4jtpu_plan_predicted_step_seconds",
             "dl4jtpu_grad_state_bytes",
         } <= fams
+        # ISSUE-16 token-generation serving families
+        assert {
+            "dl4jtpu_decode_tokens_total",
+            "dl4jtpu_kv_pages_used",
+            "dl4jtpu_kv_pages_total",
+            "dl4jtpu_ttft_seconds",
+            "dl4jtpu_decode_batch_occupancy",
+            "dl4jtpu_paged_attention_total",
+        } <= fams
         sites = load_fault_sites(REPO)
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",
@@ -372,9 +381,11 @@ class TestTier1Gate:
             "data.decode", "device.sync", "data.device_decode",
             "serving.admit", "serving.infer", "serving.hotswap",
             "serving.route", "serving.canary",
+            "serving.prefill", "serving.decode", "kv.alloc",
         }
         assert {
             "slow", "faults", "serving", "slo", "quant", "plan",
+            "generation",
         } <= load_declared_marks(REPO)
 
 

@@ -165,6 +165,75 @@ def test_committed_longctx_quant_table():
     assert sum(doc["dequant_matmul_lowerings"].values()) > 0
 
 
+@pytest.mark.generation
+@pytest.mark.slow
+def test_generate_bench_quick_run_and_schema():
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = ""          # bench decides; avoid conftest leak
+    env["BENCH_QUICK"] = "1"
+    proc = subprocess.run(
+        [sys.executable, os.path.join(REPO, "bench.py"), "--generate"],
+        capture_output=True, text=True, timeout=900, env=env, cwd=REPO,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    out = json.loads(proc.stdout.strip().splitlines()[-1])
+    assert out["schema"] == "bench-generate/1"
+    assert out["platform"] == "cpu"
+    assert out["quick"]
+    for row in out["curve"]:
+        assert row["request_at_a_time"]["tokens_per_s"] > 0
+        assert row["engine"]["tokens_per_s"] > 0
+        assert row["engine"]["ttft_mean_s"] > 0
+        # the engine's greedy output is token-identical to the dense
+        # fused-scan reference at EVERY concurrency
+        assert row["greedy_parity"]
+    # bounded program set: zero fresh compiles across the whole
+    # measured window (every curve point after bucket warm-up)
+    assert out["compile_stability"]["fresh_backend_compiles"] == 0
+    q = out["int8_kv"]
+    assert 0.2 < q["residency_ratio"] < 0.5
+    assert q["greedy_agreement_min"] >= 0.9
+    assert out["modeled_tpu"]["modeled_speedup"] > 1.0
+
+
+@pytest.mark.generation
+def test_committed_generate_table_meets_acceptance():
+    """The COMMITTED BENCH_GENERATE.json (full run) carries the ISSUE
+    16 acceptance: greedy paged decode token-identical to the dense
+    reference at every concurrency, zero fresh compiles over the
+    measured window, int8-KV residency <=~0.27 with high greedy
+    agreement, and >=2x aggregate tokens/s at 8 concurrent streams —
+    bound to the MEASURED column on TPU runs and to the
+    roofline-modeled column on CPU runs (the dense baseline is one
+    fused compute-bound scan on CPU; the committed
+    measured_platform_note and docs/serving.md spell this out).  The
+    honest measured CPU win is TTFT: concurrent prefill admission vs
+    queueing behind whole generations."""
+    path = os.path.join(REPO, "BENCH_GENERATE.json")
+    assert os.path.exists(path), "BENCH_GENERATE.json not committed"
+    with open(path) as f:
+        doc = json.load(f)
+    assert doc["schema"] == "bench-generate/1"
+    assert not doc["quick"]
+    assert [r["streams"] for r in doc["curve"]] == [1, 2, 4, 8]
+    for row in doc["curve"]:
+        assert row["greedy_parity"]
+        assert row["engine"]["tokens_per_s"] > 0
+    assert doc["compile_stability"]["fresh_backend_compiles"] == 0
+    q = doc["int8_kv"]
+    assert 0.2 < q["residency_ratio"] < 0.35
+    assert q["greedy_agreement_min"] >= 0.9
+    top = doc["curve"][-1]
+    if doc["platform"] == "tpu":
+        assert top["speedup"] >= 2.0
+    else:
+        assert doc["modeled_tpu"]["modeled_speedup"] >= 2.0
+        assert "measured_platform_note" in doc
+        # the measured CPU claim: TTFT, not aggregate throughput
+        assert top["ttft_speedup"] >= 1.5
+
+
 def test_committed_serving_fleet_table_meets_acceptance():
     """The COMMITTED BENCH_SERVING_FLEET.json (full run) carries the
     ISSUE 12 acceptance: the chaos run (one replica hard-killed

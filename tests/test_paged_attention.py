@@ -1,0 +1,192 @@
+"""ISSUE 16 — paged-attention kernel parity.
+
+The paged pools + page table are the serving plane's KV layout; this
+file holds the three implementations to each other and to the dense
+`_block_step` numerics: the XLA gather reference IS the contract, the
+Pallas online-softmax kernel (interpret mode on CPU) must match it to
+float tolerance, and the fused int8 path must match dequantize-then-
+attend exactly (the dequant is algebraically hoisted, not
+approximated).  Masking is load-bearing: garbage rows past ``seq_len``
+and idle slots (seq_len 0 parked on the scratch page) must never leak
+into an output.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.paged_attention import (
+    IMPLS,
+    paged_attention,
+    select_impl,
+)
+from deeplearning4j_tpu.serving.kv_cache import quantize_page_rows
+
+pytestmark = pytest.mark.generation
+
+S, H, DH = 4, 2, 8          # slots, heads, head_dim
+P, PS, MAXP = 24, 4, 5      # pool pages, page size, table width
+
+
+def _case(seed=0, seq_lens=(7, 1, 13, 4)):
+    """One random decode step: q rows, full pools, a page table whose
+    entries are distinct pages, and per-slot live lengths."""
+    rng = np.random.default_rng(seed)
+    q = rng.standard_normal((S, H, DH)).astype(np.float32)
+    k_pages = rng.standard_normal((P, PS, H, DH)).astype(np.float32)
+    v_pages = rng.standard_normal((P, PS, H, DH)).astype(np.float32)
+    tbl = rng.permutation(np.arange(1, P))[: S * MAXP].reshape(S, MAXP)
+    return (jnp.asarray(q), jnp.asarray(k_pages), jnp.asarray(v_pages),
+            jnp.asarray(tbl.astype(np.int32)),
+            jnp.asarray(np.array(seq_lens, np.int32)))
+
+
+def _dense_reference(q, k_pages, v_pages, tbl, seq_lens):
+    """Per-slot dense softmax attention over the gathered live rows —
+    `ops.generation._block_step`'s numerics, computed independently."""
+    q, kp, vp = map(np.asarray, (q, k_pages, v_pages))
+    tbl, seq_lens = np.asarray(tbl), np.asarray(seq_lens)
+    out = np.zeros_like(q)
+    for s in range(S):
+        n = int(seq_lens[s])
+        if n == 0:
+            continue
+        rows_k = np.concatenate([kp[p] for p in tbl[s]], axis=0)[:n]
+        rows_v = np.concatenate([vp[p] for p in tbl[s]], axis=0)[:n]
+        for h in range(H):
+            scores = rows_k[:, h] @ q[s, h] / np.sqrt(DH)
+            p = np.exp(scores - scores.max())
+            p /= p.sum()
+            out[s, h] = p @ rows_v[:, h]
+    return out
+
+
+class TestF32Parity:
+    def test_xla_matches_dense_reference(self):
+        q, kp, vp, tbl, lens = _case()
+        got = np.asarray(
+            paged_attention(q, kp, vp, tbl, lens, impl="xla"))
+        np.testing.assert_allclose(
+            got, _dense_reference(q, kp, vp, tbl, lens),
+            rtol=1e-5, atol=1e-5)
+
+    def test_pallas_matches_xla(self):
+        q, kp, vp, tbl, lens = _case(seed=1)
+        ref = np.asarray(paged_attention(q, kp, vp, tbl, lens, impl="xla"))
+        got = np.asarray(paged_attention(
+            q, kp, vp, tbl, lens, impl="pallas", interpret=True))
+        np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-6)
+
+    def test_garbage_rows_past_seq_len_are_masked(self):
+        """Poisoning every row past each slot's live length (the exact
+        rows a recycled page carries) must not move any output."""
+        q, kp, vp, tbl, lens = _case(seed=2)
+        kp2, vp2 = np.asarray(kp).copy(), np.asarray(vp).copy()
+        for s in range(S):
+            n = int(np.asarray(lens)[s])
+            for j, p in enumerate(np.asarray(tbl)[s]):
+                for r in range(PS):
+                    if j * PS + r >= n:
+                        kp2[p, r] = 1e4
+                        vp2[p, r] = -1e4
+        for impl, kw in (("xla", {}), ("pallas", {"interpret": True})):
+            a = np.asarray(paged_attention(q, kp, vp, tbl, lens,
+                                           impl=impl, **kw))
+            b = np.asarray(paged_attention(
+                q, jnp.asarray(kp2), jnp.asarray(vp2), tbl, lens,
+                impl=impl, **kw))
+            np.testing.assert_allclose(a, b, rtol=1e-5, atol=1e-6,
+                                       err_msg=impl)
+
+    def test_idle_slot_is_finite(self):
+        """seq_len 0 (an idle decode slot on the scratch page) must
+        produce FINITE output — a plain softmax would nan a fully
+        masked row, and one nan row would poison the whole fused step.
+        The engine discards idle rows via its active mask, so the two
+        impls may differ in the garbage VALUE (xla zeros it, pallas's
+        online softmax leaves uniform-weight garbage); live slots must
+        still agree exactly."""
+        q, kp, vp, tbl, lens = _case(seed=3, seq_lens=(0, 5, 0, 2))
+        outs = {}
+        for impl, kw in (("xla", {}), ("pallas", {"interpret": True})):
+            out = np.asarray(paged_attention(q, kp, vp, tbl, lens,
+                                             impl=impl, **kw))
+            assert np.isfinite(out).all(), impl
+            outs[impl] = out
+        np.testing.assert_allclose(outs["xla"][0], 0.0, atol=1e-6)
+        np.testing.assert_allclose(outs["xla"][2], 0.0, atol=1e-6)
+        for s in (1, 3):                      # the live slots
+            np.testing.assert_allclose(
+                outs["pallas"][s], outs["xla"][s], rtol=1e-5, atol=1e-6)
+
+
+class TestInt8Parity:
+    def _quantized(self, kp, vp):
+        kq = np.zeros(np.asarray(kp).shape, np.int8)
+        ks = np.ones(np.asarray(kp).shape[:-1], np.float32)
+        vq, vs = kq.copy(), ks.copy()
+        for p in range(P):
+            kq[p], ks[p] = map(np.asarray, quantize_page_rows(kp[p]))
+            vq[p], vs[p] = map(np.asarray, quantize_page_rows(vp[p]))
+        return (jnp.asarray(kq), jnp.asarray(ks),
+                jnp.asarray(vq), jnp.asarray(vs))
+
+    def test_fused_matches_dequantize_then_attend(self):
+        """The int8 kernels must equal attention over explicitly
+        dequantized pools — fusion is a layout change, not a numerics
+        change."""
+        q, kp, vp, tbl, lens = _case(seed=4)
+        kq, ks, vq, vs = self._quantized(kp, vp)
+        deq_k = jnp.asarray(kq, jnp.float32) * ks[..., None]
+        deq_v = jnp.asarray(vq, jnp.float32) * vs[..., None]
+        ref = np.asarray(paged_attention(q, deq_k, deq_v, tbl, lens,
+                                         impl="xla"))
+        for impl, kw in (("xla", {}), ("pallas", {"interpret": True})):
+            got = np.asarray(paged_attention(
+                q, kq, vq, tbl, lens, k_scale=ks, v_scale=vs,
+                impl=impl, **kw))
+            np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5,
+                                       err_msg=impl)
+
+    def test_int8_tracks_f32_within_quant_error(self):
+        q, kp, vp, tbl, lens = _case(seed=5)
+        kq, ks, vq, vs = self._quantized(kp, vp)
+        f32 = np.asarray(paged_attention(q, kp, vp, tbl, lens, impl="xla"))
+        i8 = np.asarray(paged_attention(
+            q, kq, vq, tbl, lens, k_scale=ks, v_scale=vs, impl="xla"))
+        assert np.max(np.abs(f32 - i8)) < 0.15
+
+    def test_scales_must_come_in_pairs(self):
+        q, kp, vp, tbl, lens = _case()
+        ks = jnp.ones((P, PS, H), jnp.float32)
+        with pytest.raises(ValueError, match="BOTH"):
+            paged_attention(q, kp, vp, tbl, lens, k_scale=ks)
+
+
+class TestSelection:
+    def test_env_override_wins(self, monkeypatch):
+        from deeplearning4j_tpu.ops import paged_attention as pa
+
+        monkeypatch.setenv(pa.ENV_KERNEL, "xla")
+        assert select_impl() == "xla"
+        monkeypatch.setenv(pa.ENV_KERNEL, "pallas")
+        assert select_impl() == "pallas"
+
+    def test_cpu_defaults_to_xla(self, monkeypatch):
+        from deeplearning4j_tpu.ops import paged_attention as pa
+
+        monkeypatch.delenv(pa.ENV_KERNEL, raising=False)
+        assert select_impl() in IMPLS
+
+    def test_selection_metric_counts(self):
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        q, kp, vp, tbl, lens = _case()
+        before = registry().counter(
+            "dl4jtpu_paged_attention_total").value(impl="xla")
+        paged_attention(q, kp, vp, tbl, lens, impl="xla")
+        after = registry().counter(
+            "dl4jtpu_paged_attention_total").value(impl="xla")
+        assert after == before + 1

@@ -1,0 +1,635 @@
+"""ISSUE 16 — token-level continuous-batching generation serving.
+
+`ops.generation.generate` is the single-request reference; this file
+holds `serving.generation.GenerationEngine` to it token-for-token
+(greedy AND sampled — the engine reproduces the dense path's `fold_in`
+RNG schedule exactly) while exercising the serving ladder around the
+decode loop: paged KV allocation with an explicit ``kv_exhausted`` 429,
+page-leak-free cancel/abort paths, watchdog wedge recovery, hot-swap
+between decode steps with zero dropped streams, the three new fault
+sites, the `/v1/generate` HTTP surface, and the prefill/decode
+disaggregation seam (engine-to-engine and routed through a
+`ServingFleet` with replica roles)."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from deeplearning4j_tpu.ops.generation import generate
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.serving.admission import (
+    REJECT_STATUS,
+    ServingError,
+    ServingRejected,
+)
+from deeplearning4j_tpu.serving.generation import (
+    GenerationConfig,
+    GenerationEngine,
+)
+from deeplearning4j_tpu.serving.kv_cache import (
+    SCRATCH_PAGE,
+    KVPoolExhausted,
+    PagedKVCache,
+    quantize_page_rows,
+)
+from deeplearning4j_tpu.serving.server import InferenceServer
+from deeplearning4j_tpu.zoo.transformer import TransformerEncoder
+
+pytestmark = pytest.mark.generation
+
+VOCAB, D, HEADS, LAYERS = 31, 16, 2, 2
+
+#: the shared engine shape for most tests: 4 slots, 8-row pages, a
+#: 4-wide page table -> streams up to 32 KV positions
+CFG = dict(slots=4, page_size=8, num_pages=64, max_pages_per_seq=4,
+           max_queue=16, default_max_new=8)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TransformerEncoder(
+        vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+        causal=True, seed=5,
+    ).init_model()
+
+
+def _engine(model, **over):
+    return GenerationEngine(
+        model=model, config=GenerationConfig(**{**CFG, **over}))
+
+
+def _dense(model, prompt, max_new, **kw):
+    """The reference row: ops.generation.generate on one prompt."""
+    return np.asarray(
+        generate(model, np.asarray(prompt)[None, :], max_new, **kw))[0]
+
+
+def _prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, VOCAB, n).astype(np.int32)
+
+
+# -- the paged KV allocator --------------------------------------------------
+
+class TestPagedKVCache:
+    def _kv(self, **over):
+        kw = dict(n_layers=2, n_heads=2, head_dim=8, num_pages=8,
+                  page_size=8)
+        kw.update(over)
+        return PagedKVCache(**kw)
+
+    def test_alloc_release_accounting(self):
+        kv = self._kv()
+        assert kv.free_pages == 7          # page 0 is scratch
+        kv.alloc("a", 3)
+        kv.alloc("b", 2)
+        assert kv.used_pages == 5 and kv.free_pages == 2
+        assert len(kv.table("a")) == 3
+        assert SCRATCH_PAGE not in kv.table("a")
+        kv.release("a")
+        kv.release("a")                    # idempotent
+        assert kv.used_pages == 2
+        kv.release("b")
+        assert kv.used_pages == 0 and kv.leak_check() is None
+
+    def test_exhaustion_raises_and_rolls_back(self):
+        kv = self._kv()
+        kv.alloc("a", 6)
+        with pytest.raises(KVPoolExhausted):
+            kv.alloc("b", 2)
+        # the failed alloc must not leak partial grants
+        assert kv.used_pages == 6 and kv.leak_check() is None
+
+    def test_pages_for_and_occupancy(self):
+        kv = self._kv()
+        assert kv.page_size == 8           # quantized to PAGE_QUANTUM
+        assert kv.pages_for(1) == 1
+        assert kv.pages_for(8) == 1
+        assert kv.pages_for(9) == 2
+        kv.alloc("a", 7)
+        assert kv.occupancy() == pytest.approx(1.0)
+        kv.release("a")
+        assert kv.occupancy() == 0.0
+
+    def test_write_prefill_round_trips(self):
+        kv = self._kv()
+        rng = np.random.default_rng(0)
+        k = rng.standard_normal((2, 16, 2, 8)).astype(np.float32)
+        v = rng.standard_normal((2, 16, 2, 8)).astype(np.float32)
+        kv.alloc("a", 2)
+        tbl = kv.write_prefill("a", k, v)
+        got = np.concatenate(
+            [np.asarray(kv.k_pages[:, p]) for p in tbl], axis=1)
+        np.testing.assert_allclose(got, k, rtol=1e-6)
+
+    def test_int8_pages_quantize_within_bound(self):
+        kv = self._kv(kv_dtype="int8")
+        rng = np.random.default_rng(1)
+        k = rng.standard_normal((2, 16, 2, 8)).astype(np.float32)
+        v = rng.standard_normal((2, 16, 2, 8)).astype(np.float32)
+        kv.alloc("a", 2)
+        tbl = kv.write_prefill("a", k, v)
+        deq = np.concatenate(
+            [np.asarray(kv.k_pages[:, p], np.float32)
+             * np.asarray(kv.k_scales[:, p])[..., None]
+             for p in tbl], axis=1)
+        # symmetric int8: error bounded by half a quantization step
+        assert np.max(np.abs(deq - k)) <= np.max(np.abs(k)) / 127.0
+
+    def test_quantize_page_rows_zero_row_safe(self):
+        q, s = quantize_page_rows(jnp.zeros((4, 2, 8)))
+        assert np.all(np.asarray(q) == 0)
+        assert np.all(np.asarray(s) == 1.0)   # never a 0-divide scale
+
+    @pytest.mark.faults
+    def test_kv_alloc_fault_site(self):
+        kv = self._kv()
+        faults.arm("kv.alloc:raise:nth=1")
+        with pytest.raises(KVPoolExhausted):
+            kv.alloc("a", 1)
+        faults.disarm()
+        kv.alloc("a", 1)                   # the pool itself is fine
+        assert kv.used_pages == 1
+
+
+# -- numerics: the engine vs the dense reference -----------------------------
+
+class TestDecodeParity:
+    def test_greedy_token_identical_to_dense(self, model):
+        eng = _engine(model).start()
+        try:
+            for n, max_new in ((3, 6), (7, 12), (14, 10)):
+                p = _prompt(n, seed=n)
+                out = np.asarray(eng.generate(p, max_new, timeout=120.0))
+                np.testing.assert_array_equal(
+                    out, _dense(model, p, max_new), err_msg=f"len {n}")
+        finally:
+            eng.stop()
+
+    @pytest.mark.slow
+    def test_sampled_and_top_k_identical_to_dense(self, model):
+        """Not statistically close — IDENTICAL: the engine reproduces
+        the dense path's per-token `fold_in` schedule and top-k
+        threshold rule exactly."""
+        eng = _engine(model).start()
+        try:
+            p = _prompt(6, seed=9)
+            for kw in (dict(temperature=1.0, seed=3),
+                       dict(temperature=1.3, top_k=5, seed=7)):
+                out = np.asarray(eng.generate(p, 10, timeout=120.0, **kw))
+                np.testing.assert_array_equal(
+                    out, _dense(model, p, 10, **kw), err_msg=str(kw))
+        finally:
+            eng.stop()
+
+    @pytest.mark.slow
+    def test_concurrent_streams_each_match_their_reference(self, model):
+        """The continuous batch is airtight: slots never bleed into
+        each other even with mixed lengths, budgets, and sampling."""
+        eng = _engine(model, slots=3).start()
+        try:
+            specs = [
+                (_prompt(3, seed=1), 8, dict()),
+                (_prompt(9, seed=2), 14, dict(temperature=1.0, seed=4)),
+                (_prompt(5, seed=3), 5, dict(temperature=0.9, top_k=4,
+                                             seed=8)),
+                (_prompt(12, seed=4), 11, dict()),
+                (_prompt(4, seed=5), 9, dict(temperature=1.1, seed=2)),
+            ]
+            reqs = [eng.submit(p, n, **kw) for p, n, kw in specs]
+            for req, (p, n, kw) in zip(reqs, specs):
+                np.testing.assert_array_equal(
+                    np.asarray(req.result(120.0)), _dense(model, p, n, **kw))
+        finally:
+            eng.stop()
+
+    def test_stop_token_truncates_like_the_reference(self, model):
+        p = _prompt(5, seed=6)
+        ref = _dense(model, p, 12)
+        gen = ref[len(p):]
+        stop = int(gen[3])                 # stop on the 4th ref token
+        eng = _engine(model).start()
+        try:
+            out = np.asarray(eng.generate(p, 12, stop_tokens=(stop,),
+                                          timeout=120.0))
+        finally:
+            eng.stop()
+        first = int(np.argmax(gen == stop))
+        np.testing.assert_array_equal(out, ref[: len(p) + first + 1])
+        assert out[-1] == stop
+
+    @pytest.mark.slow
+    def test_int8_kv_agreement_gate(self, model):
+        """int8 KV pages are gated the way PR 13 gated PTQ: high greedy
+        token agreement with the f32 reference, not bit equality."""
+        eng = _engine(model, kv_dtype="int8").start()
+        try:
+            agree = total = 0
+            for n in (4, 9):
+                p = _prompt(n, seed=20 + n)
+                ref = _dense(model, p, 12)[n:]
+                out = np.asarray(eng.generate(p, 12, timeout=120.0))[n:]
+                m = min(len(ref), len(out))
+                agree += int((ref[:m] == out[:m]).sum())
+                total += m
+        finally:
+            eng.stop()
+        assert agree / total >= 0.9, f"int8 agreement {agree}/{total}"
+
+    def test_ttft_is_recorded(self, model):
+        eng = _engine(model).start()
+        try:
+            req = eng.submit(_prompt(4), 3)
+            req.result(120.0)
+            assert req.ttft_s is not None and req.ttft_s > 0
+        finally:
+            eng.stop()
+
+
+# -- admission, capacity, and the explicit 429 -------------------------------
+
+class TestAdmission:
+    def test_over_capacity_stream_is_a_client_error(self, model):
+        eng = _engine(model)
+        with pytest.raises(ValueError, match="KV positions"):
+            eng.submit(_prompt(8), 40)     # 48 > 4 pages x 8 rows
+        with pytest.raises(ValueError, match="empty"):
+            eng.submit(np.zeros(0, np.int32), 4)
+        with pytest.raises(ValueError, match="max_new"):
+            eng.submit(_prompt(4), 0)
+
+    def test_kv_exhaustion_is_an_explicit_429(self, model):
+        # 2 usable pages; the stream needs 3 -> admission answers
+        # kv_exhausted instead of stalling on HBM that will not come
+        eng = _engine(model, num_pages=3).start()
+        try:
+            req = eng.submit(_prompt(17), 4)
+            with pytest.raises(ServingRejected) as ei:
+                req.result(60.0)
+        finally:
+            eng.stop()
+        assert ei.value.reason == "kv_exhausted"
+        assert ei.value.status == 429
+        assert REJECT_STATUS["kv_exhausted"] == 429
+
+    def test_full_queue_rejects(self, model):
+        eng = _engine(model, max_queue=2)   # not started: nothing drains
+        eng.submit(_prompt(3), 2)
+        eng.submit(_prompt(3), 2)
+        with pytest.raises(ServingRejected) as ei:
+            eng.submit(_prompt(3), 2)
+        assert ei.value.reason == "queue_full"
+
+    def test_cancel_releases_every_page(self, model):
+        eng = _engine(model).start()
+        try:
+            req = eng.submit(_prompt(4), 27)
+            deadline = time.monotonic() + 60.0
+            while not req.tokens_so_far():
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            assert eng.kv.used_pages > 0
+            req.cancel()
+            while eng.kv.used_pages and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert eng.kv.used_pages == 0
+            assert eng.kv.leak_check() is None
+        finally:
+            eng.stop()
+
+
+# -- the degradation ladder --------------------------------------------------
+
+class TestLadder:
+    @pytest.mark.faults
+    def test_prefill_fault_fails_the_stream_not_the_engine(self, model):
+        eng = _engine(model).start()
+        try:
+            faults.arm("serving.prefill:raise:nth=1")
+            req = eng.submit(_prompt(4), 4)
+            with pytest.raises(ServingError):
+                req.result(60.0)
+            assert eng.kv.used_pages == 0  # the failed admit released
+            faults.disarm()
+            out = np.asarray(eng.generate(_prompt(4), 4, timeout=120.0))
+            assert out.shape == (8,)
+        finally:
+            eng.stop()
+
+    @pytest.mark.faults
+    def test_decode_fault_fails_active_and_recovers(self, model):
+        eng = _engine(model).start()
+        try:
+            # warm first so the armed consult hits a real decode step
+            eng.generate(_prompt(4), 2, timeout=120.0)
+            faults.arm("serving.decode:raise:nth=1")
+            req = eng.submit(_prompt(4), 6)
+            with pytest.raises(ServingError):
+                req.result(60.0)
+            assert eng.kv.used_pages == 0
+            faults.disarm()
+            p = _prompt(5, seed=31)
+            np.testing.assert_array_equal(
+                np.asarray(eng.generate(p, 5, timeout=120.0)),
+                _dense(model, p, 5))
+        finally:
+            eng.stop()
+
+    @pytest.mark.slow
+    def test_watchdog_abort_releases_pages_and_respawns(self, model):
+        eng = _engine(model).start()
+        try:
+            req = eng.submit(_prompt(4), 27)
+            deadline = time.monotonic() + 60.0
+            while eng.active_streams() == 0:
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            eng._on_wedged({"stage": "abort", "iteration": 0})
+            with pytest.raises(ServingError, match="wedged"):
+                req.result(60.0)
+            assert eng.kv.used_pages == 0
+            assert eng.kv.leak_check() is None
+            # the respawned loop serves the next stream
+            p = _prompt(3, seed=40)
+            np.testing.assert_array_equal(
+                np.asarray(eng.generate(p, 4, timeout=120.0)),
+                _dense(model, p, 4))
+        finally:
+            eng.stop()
+
+    def test_hot_swap_drains_with_zero_dropped_streams(self, model):
+        srv = InferenceServer(model)
+        eng = GenerationEngine(server=srv,
+                               config=GenerationConfig(**CFG)).start()
+        try:
+            reqs = [eng.submit(_prompt(4, seed=50 + i), 20)
+                    for i in range(3)]
+            deadline = time.monotonic() + 60.0
+            while not any(r.tokens_so_far() for r in reqs):
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            new = jax.tree_util.tree_map(
+                lambda a: a * 1.001
+                if jnp.issubdtype(jnp.asarray(a).dtype, jnp.floating)
+                else a,
+                srv.model.params)
+            assert srv.push_weights(new, source="test")
+            for r in reqs:
+                out = np.asarray(r.result(120.0))
+                assert out.shape == (24,)  # full length: zero drops
+                assert r.error is None
+        finally:
+            eng.stop()
+            srv.stop()
+
+    def test_kv_occupancy_feeds_shed_pressure(self, model):
+        srv = InferenceServer(model)
+        eng = GenerationEngine(server=srv,
+                               config=GenerationConfig(**CFG))
+        try:
+            assert srv.generation_engine is eng
+            base = srv.shed_pressure()
+            eng.kv.alloc("x", 60)          # ~95% of the pool
+            assert srv.shed_pressure() >= eng.kv.occupancy() > base
+            eng.kv.release("x")
+        finally:
+            srv.stop()
+
+
+# -- bounded program set -----------------------------------------------------
+
+class TestCompileStability:
+    def test_zero_fresh_compiles_after_warm_up(self, model):
+        from deeplearning4j_tpu.runtime import compile_stats
+
+        eng = _engine(model).start()
+        try:
+            # warm the step program + the 8- and 16-bucket prefills
+            eng.generate(_prompt(4), 3, timeout=120.0)
+            eng.generate(_prompt(12), 3, temperature=1.0, seed=1,
+                         timeout=120.0)
+            snap = compile_stats.snapshot()
+            reqs = [
+                eng.submit(_prompt(3 + i, seed=60 + i), 4 + i,
+                           temperature=float(i % 3) * 0.5,
+                           top_k=(i % 4), seed=i)
+                for i in range(8)          # all within warmed buckets
+            ]
+            for r in reqs:
+                r.result(120.0)
+            delta = compile_stats.snapshot() - snap
+            assert delta.fresh_backend_compiles == 0, delta.as_dict()
+        finally:
+            eng.stop()
+
+
+# -- prefill/decode disaggregation -------------------------------------------
+
+class TestDisaggregation:
+    def test_handoff_between_engines_matches_dense(self, model):
+        pre = _engine(model)               # never started: prefill only
+        dec = _engine(model).start()
+        try:
+            p = _prompt(6, seed=70)
+            handoff = pre.prefill_detached(p, 10, temperature=1.0, seed=5)
+            assert handoff["k"].dtype == np.float32
+            out = np.asarray(dec.join_prefilled(handoff).result(120.0))
+            np.testing.assert_array_equal(
+                out, _dense(model, p, 10, temperature=1.0, seed=5))
+        finally:
+            dec.stop()
+
+    @pytest.mark.slow
+    def test_f32_prefill_feeds_int8_decode(self, model):
+        """The handoff crosses the replica boundary in f32 and lands in
+        the decode pool's OWN page dtype."""
+        pre = _engine(model)
+        dec = _engine(model, kv_dtype="int8").start()
+        try:
+            p = _prompt(5, seed=71)
+            out = np.asarray(
+                dec.join_prefilled(pre.prefill_detached(p, 8))
+                .result(120.0))
+            ref = _dense(model, p, 8)
+            m = min(len(out), len(ref))
+            assert (np.asarray(out[:m]) == ref[:m]).mean() >= 0.8
+        finally:
+            dec.stop()
+
+    @pytest.mark.slow
+    def test_fleet_routes_roles_and_matches_dense(self):
+        from deeplearning4j_tpu.serving.fleet import ServingFleet
+
+        def factory():
+            return TransformerEncoder(
+                vocab_size=VOCAB, d_model=D, n_heads=HEADS,
+                n_layers=LAYERS, causal=True, seed=5,
+            ).init_model()
+
+        fleet = ServingFleet(
+            factory, n_replicas=2, roles=["prefill", "decode"],
+            generation_config=GenerationConfig(**CFG),
+        ).start()
+        try:
+            assert [h.role for h in fleet.handles] == ["prefill", "decode"]
+            assert fleet.engines["r0"]._thread is None   # no decode loop
+            p = _prompt(5, seed=80)
+            out = np.asarray(fleet.generate(p, 9, timeout=120.0))
+            np.testing.assert_array_equal(
+                out, _dense(fleet.replicas[0].model, p, 9))
+        finally:
+            fleet.stop()
+
+    def test_fleet_roles_must_cover_every_replica(self):
+        from deeplearning4j_tpu.serving.fleet import ServingFleet
+
+        with pytest.raises(ValueError, match="roles"):
+            ServingFleet(lambda: None, n_replicas=2, roles=["both"])
+
+    def test_router_rejects_when_role_group_empty(self):
+        from deeplearning4j_tpu.serving.router import (
+            ReplicaHandle, Router,
+        )
+
+        class _Stub:
+            def health(self):
+                return {"status": "serving", "shed_pressure": 0.0,
+                        "breaker_state": "closed"}
+
+        h = ReplicaHandle("r0", _Stub(), role="decode")
+        router = Router([h])
+        assert router.pick_for_role("decode") is h
+        with pytest.raises(ServingRejected) as ei:
+            router.pick_for_role("prefill")    # nobody serves prefill
+        assert ei.value.reason == "no_replicas"
+        with pytest.raises(ValueError, match="role"):
+            ReplicaHandle("r1", _Stub(), role="oracle")
+
+
+# -- the HTTP surface --------------------------------------------------------
+
+class TestHTTPGenerate:
+    @pytest.fixture()
+    def stack(self, model):
+        from deeplearning4j_tpu.serving.http import ServingHTTPServer
+
+        srv = InferenceServer(model)
+        eng = GenerationEngine(server=srv,
+                               config=GenerationConfig(**CFG)).start()
+        http = ServingHTTPServer(srv).start()
+        yield srv, eng, http
+        http.stop()
+        eng.stop()
+        srv.stop()
+
+    def _post(self, url, payload):
+        req = urllib.request.Request(
+            url + "v1/generate", json.dumps(payload).encode(),
+            {"Content-Type": "application/json"})
+        try:
+            with urllib.request.urlopen(req, timeout=120) as r:
+                return r.status, json.loads(r.read())
+        except urllib.error.HTTPError as e:
+            return e.code, json.loads(e.read())
+
+    def test_blocking_generate_matches_dense(self, model, stack):
+        _, _, http = stack
+        p = _prompt(5, seed=90)
+        code, doc = self._post(http.url, {
+            "prompt": p.tolist(), "max_new_tokens": 7})
+        assert code == 200
+        np.testing.assert_array_equal(
+            np.asarray(doc["tokens"]), _dense(model, p, 7))
+        assert doc["prompt_len"] == 5
+        assert doc["ttft_ms"] is not None
+
+    def test_streaming_emits_tokens_then_done(self, model, stack):
+        _, _, http = stack
+        p = _prompt(4, seed=91)
+        req = urllib.request.Request(
+            http.url + "v1/generate",
+            json.dumps({"prompt": p.tolist(), "max_new_tokens": 6,
+                        "stream": True}).encode(),
+            {"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=120) as r:
+            assert r.status == 200
+            lines = [json.loads(ln) for ln in r.read().splitlines() if ln]
+        assert lines[-1]["done"] is True
+        assert lines[-1]["error"] is None
+        toks = [ln["token"] for ln in lines[:-1]]
+        np.testing.assert_array_equal(
+            np.asarray(toks), _dense(model, p, 6)[len(p):])
+
+    def test_over_capacity_and_bad_prompt_are_400(self, stack):
+        _, _, http = stack
+        code, _ = self._post(http.url, {"prompt": _prompt(8).tolist(),
+                                        "max_new_tokens": 40})
+        assert code == 400
+        code, _ = self._post(http.url, {"prompt": "not tokens"})
+        assert code == 400
+
+    def test_replica_without_engine_is_400(self, model):
+        from deeplearning4j_tpu.serving.http import ServingHTTPServer
+
+        srv = InferenceServer(model)
+        http = ServingHTTPServer(srv).start()
+        try:
+            code, doc = self._post(http.url, {"prompt": [1, 2]})
+            assert code == 400
+            assert "engine" in doc["error"]
+        finally:
+            http.stop()
+            srv.stop()
+
+    def test_kv_exhaustion_is_429_over_http(self, model):
+        from deeplearning4j_tpu.serving.http import ServingHTTPServer
+
+        srv = InferenceServer(model)
+        eng = GenerationEngine(
+            server=srv,
+            config=GenerationConfig(**{**CFG, "num_pages": 3})).start()
+        http = ServingHTTPServer(srv).start()
+        try:
+            code, doc = self._post(http.url, {
+                "prompt": _prompt(17).tolist(), "max_new_tokens": 4})
+            assert code == 429
+            assert doc["reason"] == "kv_exhausted"
+        finally:
+            http.stop()
+            eng.stop()
+            srv.stop()
+
+
+# -- telemetry ---------------------------------------------------------------
+
+class TestTelemetry:
+    def test_token_counter_and_kv_gauges_move(self, model):
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        eng = _engine(model).start()
+        try:
+            before = registry().counter("dl4jtpu_decode_tokens_total").value()
+            eng.generate(_prompt(4), 5, timeout=120.0)
+            after = registry().counter("dl4jtpu_decode_tokens_total").value()
+            assert after >= before + 5
+            assert registry().gauge("dl4jtpu_kv_pages_total").value() \
+                == CFG["num_pages"] - 1
+            st = eng.stats()
+            assert st["tokens_generated"] >= 5
+            assert st["kv"]["used_pages"] == 0
+        finally:
+            eng.stop()
