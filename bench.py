@@ -2888,7 +2888,7 @@ def bench_serving_fleet() -> None:
     """bench.py --serving-fleet: N replicas behind the Router front door
     -> BENCH_SERVING_FLEET.json.
 
-    Three phases over one small model:
+    Four phases over one small model:
 
       1. **scale** — closed-loop throughput at replica counts 1/2/4
          (achieved rps, p50/p99, client-side accounting: zero silent
@@ -2902,7 +2902,12 @@ def bench_serving_fleet() -> None:
          shed / retried-then-served), the torn deploy rolls back with
          at most ONE replica ever on the pushed weights, a clean
          deploy installs on the survivors after the storm, and
-         post-chaos p99 returns to within 2x of baseline.
+         post-chaos p99 returns to within 2x of baseline;
+      4. **generation** — a 2-replica DISAGGREGATED fleet (prefill |
+         decode) under routed token streams: TTFT/tokens-per-s
+         percentiles with per-stream cross-replica trace chains, then
+         an induced decode stall that must fire (and clear) the TTFT
+         burn-rate alert and snapshot the serving flight recorder.
 
     CPU by default (the subject is the fleet control plane);
     BENCH_SERVING_PLATFORM overrides.  Quick mode (BENCH_QUICK=1)
@@ -3092,6 +3097,218 @@ def bench_serving_fleet() -> None:
     print(f"[bench] fleet chaos: {json.dumps(chaos_row)}",
           file=sys.stderr)
 
+    # -- phase 4: generation plane (ISSUE 17) ------------------------------
+    # a 2-replica DISAGGREGATED fleet (r0 prefill | r1 decode) driven
+    # through the routed front door: (a) healthy TTFT/tokens-per-s with
+    # tracing on — every stream must land as ONE causal chain whose
+    # spans cover both replicas' work (router picks, prefill, kv
+    # handoff, decode steps); (b) an induced decode stall must fire the
+    # TTFT burn-rate alert within its windows, the alert's rising edge
+    # must snapshot the flight recorder, and the alert must clear after
+    # recovery; (c) the flight ring must account for every settled
+    # stream.
+    from collections import Counter
+
+    from deeplearning4j_tpu.observe import chain_is_causal, tracer
+    from deeplearning4j_tpu.observe.slo import (
+        BurnWindow, SLOEngine, generation_objectives,
+    )
+    from deeplearning4j_tpu.serving import GenerationConfig
+    from deeplearning4j_tpu.zoo.transformer import TransformerEncoder
+
+    gen_fleet = ServingFleet(
+        lambda: TransformerEncoder(
+            vocab_size=31, d_model=16, n_heads=2, n_layers=2,
+            causal=True, seed=5,
+        ).init_model(),
+        n_replicas=2, roles=["prefill", "decode"],
+        generation_config=GenerationConfig(
+            slots=4, page_size=8, num_pages=64, max_pages_per_seq=4,
+            max_queue=32, default_max_new=8,
+        ),
+    ).start()
+    eng_dec = gen_fleet.engines[gen_fleet.handles[1].name]
+    gen_lock = threading.Lock()
+    ttfts: list = []
+    walls: list = []           # (tokens, wall_s) per completed stream
+    gen_out = {"ok": 0, "error": 0}
+    prompt_seq = iter(range(10_000))
+
+    def _one_stream(max_new=8):
+        rng = np.random.default_rng(1000 + next(prompt_seq))
+        prompt = rng.integers(0, 31, 6).astype(np.int32)
+        marks: dict = {}
+        t0 = time.monotonic()
+
+        def _tok(_tok_id, _idx):
+            marks.setdefault("ttft", time.monotonic() - t0)
+
+        try:
+            out = gen_fleet.generate(prompt, max_new, timeout=120.0,
+                                     on_token=_tok)
+            wall = time.monotonic() - t0
+            with gen_lock:
+                gen_out["ok"] += 1
+                if "ttft" in marks:
+                    ttfts.append(marks["ttft"])
+                walls.append((len(out) - len(prompt), wall))
+        except Exception:
+            with gen_lock:
+                gen_out["error"] += 1
+
+    _one_stream()                       # compile warm-up, untraced
+    rec = tracer()
+    rec.enable()
+    rec.clear()
+    n_streams = 8 if QUICK else 24
+    t_healthy0 = time.monotonic()
+    threads = [
+        threading.Thread(target=lambda k=i: [_one_stream()
+                                             for _ in range(k)])
+        for i in [n_streams // 4] * 4
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(300)
+    healthy_s = time.monotonic() - t_healthy0
+    with gen_lock:
+        healthy_tok = sum(n for n, _ in walls)
+        ttfts_ms = sorted(t * 1000.0 for t in ttfts)
+
+    def _pct(xs, p):
+        return (round(xs[min(len(xs) - 1, int(p * len(xs)))], 3)
+                if xs else None)
+
+    # every healthy stream = one causal cross-replica chain
+    chains = [rec.trace_chain(tid) for tid in rec.trace_ids()]
+    need = {"generation.stream", "router.pick", "generation.admit",
+            "generation.prefill", "generation.kv_handoff",
+            "generation.decode_step"}
+    complete = sum(
+        1 for c in chains
+        if chain_is_causal(c) and need <= {s["name"] for s in c}
+    )
+    span_names = Counter(s["name"] for c in chains for s in c)
+    rec.disable()
+    rec.clear()
+
+    # SLO: baseline -> decode stall -> alert fires (and dumps the
+    # flight ring) -> recovery -> alert clears
+    fast_w, slow_w = (0.5, 2.0) if QUICK else (1.0, 4.0)
+    healthy_rate = healthy_tok / max(healthy_s, 1e-9)
+    floor = max(5.0, round(healthy_rate * 0.25, 1))
+    gen_engine = SLOEngine(
+        generation_objectives(ttft_threshold_s=0.25,
+                              tokens_floor_per_s=floor),
+        windows=(BurnWindow(fast_w, 4.0), BurnWindow(slow_w, 1.0)),
+    )
+    dumps_before = eng_dec.flight.dumps_written
+    stop_gen = threading.Event()
+
+    def _gen_client():
+        while not stop_gen.is_set():
+            _one_stream()
+
+    gen_threads = [threading.Thread(target=_gen_client)
+                   for _ in range(3)]
+    for t in gen_threads:
+        t.start()
+    gen_engine.sample()
+    time.sleep(fast_w)                  # healthy baseline window
+    faults.arm("serving.decode:delay:every=1,secs=0.3")
+    t_stall = time.monotonic()
+    gen_fired_after = None
+    deadline = time.monotonic() + fast_w * 10
+    while time.monotonic() < deadline:
+        if gen_engine.sample()["generation_ttft_p95"]["alert"]:
+            gen_fired_after = time.monotonic() - t_stall
+            break
+        time.sleep(0.05)
+    faults.disarm()
+    t_recover = time.monotonic()
+    gen_cleared_after = None
+    deadline = time.monotonic() + fast_w * 10
+    while time.monotonic() < deadline:
+        if not gen_engine.sample()["generation_ttft_p95"]["alert"]:
+            gen_cleared_after = time.monotonic() - t_recover
+            break
+        time.sleep(0.05)
+    stop_gen.set()
+    for t in gen_threads:
+        t.join(300)
+    estats = eng_dec.stats()
+    flight_records = eng_dec.flight.snapshot()
+    dump_path = (eng_dec.flight.dump_paths[-1]
+                 if eng_dec.flight.dump_paths else None)
+    dump_doc = {}
+    if dump_path:
+        with open(dump_path) as f:
+            dump_doc = json.load(f)
+    settled = estats["streams"]["settled"]
+    gen_state = gen_engine.state()
+    gen_row = {
+        "replicas": 2,
+        "roles": ["prefill", "decode"],
+        "plan": "healthy window + serving.decode:delay:every=1,secs=0.3 stall",
+        "streams": dict(gen_out),
+        "outcomes": estats["streams"]["outcomes"],
+        "ttft_ms": {"p50": _pct(ttfts_ms, 0.50),
+                    "p95": _pct(ttfts_ms, 0.95),
+                    "p99": _pct(ttfts_ms, 0.99),
+                    "n": len(ttfts_ms)},
+        "healthy_tokens_per_s": round(healthy_rate, 2),
+        "latency_breakdown": estats["latency_breakdown"],
+        "trace": {
+            "streams_traced": len(chains),
+            "complete_causal_chains": complete,
+            "span_names": dict(span_names),
+        },
+        "slo": {
+            "objectives": {
+                n: {"alert": s["alert"], "burn": s["burn"],
+                    "alerts_total": s["alerts_total"],
+                    **({"rate_per_s": s["rate_per_s"]}
+                       if "rate_per_s" in s else {})}
+                for n, s in gen_state.items()
+            },
+            "tokens_floor_per_s": floor,
+            "ttft_alert_fired": gen_fired_after is not None,
+            "fired_after_s": (round(gen_fired_after, 3)
+                              if gen_fired_after is not None else None),
+            "ttft_alert_cleared": gen_cleared_after is not None,
+            "cleared_after_s": (round(gen_cleared_after, 3)
+                                if gen_cleared_after is not None
+                                else None),
+        },
+        "flight": {
+            "records": len(flight_records),
+            "streams_settled": settled,
+            "all_settled_recorded": (
+                settled <= 256 and len(flight_records) == settled
+            ),
+            "dumps_written": eng_dec.flight.dumps_written,
+            "slo_alert_dumped": (
+                eng_dec.flight.dumps_written > dumps_before
+            ),
+            "last_dump": {
+                "trigger": dump_doc.get("trigger"),
+                "schema": dump_doc.get("schema"),
+                "records": len(dump_doc.get("records", ())),
+            } if dump_doc else None,
+        },
+        "completed": bool(
+            gen_out["ok"] > 0
+            and complete == len(chains) > 0
+            and gen_fired_after is not None
+            and gen_cleared_after is not None
+            and eng_dec.flight.dumps_written > dumps_before
+        ),
+    }
+    gen_fleet.stop()
+    print(f"[bench] fleet generation: {json.dumps(gen_row)}",
+          file=sys.stderr)
+
     doc = {
         "schema": "bench-serving-fleet/1",
         "platform": jax.default_backend(),
@@ -3105,6 +3322,7 @@ def bench_serving_fleet() -> None:
         "scale": scale,
         "deploy": deploy_row,
         "chaos": chaos_row,
+        "generation": gen_row,
     }
     if not QUICK:
         path = os.path.join(os.path.dirname(os.path.abspath(__file__)),

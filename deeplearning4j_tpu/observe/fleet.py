@@ -280,6 +280,22 @@ class FleetAggregator:
             return {w: st["slo"] for w, st in self._workers.items()
                     if st.get("slo") is not None}
 
+    def generation_view(self) -> dict:
+        """{worker: [per-replica generation health]} — the generation
+        plane across the fleet in one read.  Each replica's
+        ``health()`` payload carries a ``generation`` block (stream
+        outcomes, tokens/s, KV occupancy, flight-dump count) when
+        token generation is enabled; this filters the pushed serving
+        summaries down to those blocks so "which replica's decode
+        plane is sick" needs no per-worker scrape."""
+        out: dict = {}
+        for w, summary in self.serving_view().items():
+            gens = [s["generation"] for s in summary.get("servers", ())
+                    if isinstance(s, dict) and "generation" in s]
+            if gens:
+                out[w] = gens
+        return out
+
     # -- merged expositions -------------------------------------------------
     def _fleet_text(self) -> str:
         """The fleet meta-families, rendered directly (these describe the

@@ -756,6 +756,51 @@ def _declare_core(reg: MetricsRegistry) -> None:
                 "kernel, xla = gather-then-attend reference; _int8 "
                 "suffix = fused dequant variant).  Counted at TRACE "
                 "time, never from inside the traced body")
+    # generation-plane observability (serving/generation.py lifecycle
+    # instrumentation + serving/flight.py flight recorder)
+    reg.counter("dl4jtpu_generation_streams_admitted_total",
+                "Streams accepted into the generation admission queue "
+                "(label-free; the demand denominator for throughput "
+                "SLOs — admitted streams waiting through a stall keep "
+                "the window non-idle)")
+    reg.counter("dl4jtpu_generation_streams_total",
+                "Generation streams by final outcome (ok / cancelled / "
+                "kv_exhausted / error / wedged / shutdown) — counted "
+                "exactly once at fate settle, same contract as "
+                "dl4jtpu_serving_requests_total")
+    reg.histogram("dl4jtpu_generation_queue_seconds",
+                  "Per-stream admission-queue wait: enqueue to the "
+                  "decode loop taking the stream")
+    reg.histogram("dl4jtpu_generation_prefill_seconds",
+                  "Per-stream prefill compute (bucketed prompt "
+                  "forward + first-token sample), wherever the "
+                  "prefill ran")
+    reg.histogram("dl4jtpu_generation_handoff_seconds",
+                  "Per-stream KV handoff: prefill completion to KV "
+                  "pages written on the decode replica (local "
+                  "admission: just the page write)")
+    reg.histogram("dl4jtpu_generation_decode_queue_seconds",
+                  "Per-stream slot residency NOT spent in decode "
+                  "compute or sampling (waiting for co-resident "
+                  "streams, refills, respawns)")
+    reg.histogram("dl4jtpu_generation_decode_compute_seconds",
+                  "Per-stream accumulated decode-step device wall "
+                  "(each co-resident stream is charged the full step, "
+                  "like the dispatch segment of /v1/infer)")
+    reg.histogram("dl4jtpu_generation_sampling_seconds",
+                  "Per-stream accumulated host-side harvest/sampling "
+                  "bookkeeping after each decode step")
+    reg.gauge("dl4jtpu_generation_tokens_per_s",
+              "Recent aggregate decode token rate (trailing-window "
+              "estimate refreshed as steps complete) — the live "
+              "numerator behind the throughput SLO")
+    reg.gauge("dl4jtpu_flight_records",
+              "Per-stream records currently held in the serving "
+              "flight-recorder ring")
+    reg.counter("dl4jtpu_flight_dumps_total",
+                "Flight-recorder post-mortem dumps written, by "
+                "trigger (watchdog_abort / breaker_open / "
+                "kv_exhausted_spike / slo_alert)")
 
 
 def _compile_stats_collector() -> None:

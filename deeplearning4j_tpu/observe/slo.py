@@ -5,11 +5,13 @@ could answer "are we meeting our objective RIGHT NOW?".  This module is
 that answer, in the SRE-workbook shape:
 
 - **declarative objectives** (`SLObjective`): availability ("99.9% of
-  requests end ok") read off a labeled counter family, and latency pX
+  requests end ok") read off a labeled counter family, latency pX
   ("99% of requests complete under 250ms") read off a histogram
-  family's cumulative buckets (`Histogram.count_le`).  Both are
-  evaluated directly over the process-global `MetricsRegistry` — no
-  second bookkeeping path that can drift from what /metrics exports.
+  family's cumulative buckets (`Histogram.count_le`), and throughput
+  ("aggregate decode rate stays above 500 tokens/s while there is
+  demand") read off a pair of counters.  All are evaluated directly
+  over the process-global `MetricsRegistry` — no second bookkeeping
+  path that can drift from what /metrics exports.
 - **multi-window burn rates** (`SLOEngine`): each `sample()` appends a
   (t, good, bad) point per objective and derives the error-budget burn
   rate over every configured window — burn 1.0 means "spending exactly
@@ -75,7 +77,16 @@ class SLObjective:
     ``kind="latency"``: good/bad from a HISTOGRAM — observations at or
     under ``threshold_s`` are good (pick thresholds on bucket bounds;
     `count_le` documents the rounding).  `target` is the good fraction
-    the objective promises (0.999 = three nines)."""
+    the objective promises (0.999 = three nines).
+    ``kind="throughput"``: an aggregate-RATE floor — `family` is a
+    cumulative work counter (e.g. tokens generated) and
+    ``demand_family`` a cumulative demand counter (e.g. streams
+    admitted).  The burn rate over a window is the fractional deficit
+    below ``floor_per_s`` divided by the budget, so a total stall
+    burns ``1/budget`` (pages immediately on the classic thresholds)
+    while meeting the floor burns zero.  A window with neither work
+    nor fresh demand is idle and burns zero — a quiet replica is not
+    an outage."""
 
     name: str
     target: float
@@ -83,6 +94,8 @@ class SLObjective:
     family: str = "dl4jtpu_serving_requests_total"
     bad: tuple = (("outcome", "error"), ("outcome", "timeout"))
     threshold_s: float = 0.25
+    floor_per_s: float = 0.0
+    demand_family: str = ""
 
     def __post_init__(self):
         if not 0.0 < self.target < 1.0:
@@ -90,8 +103,13 @@ class SLObjective:
                 f"SLO {self.name!r}: target must be in (0, 1), got "
                 f"{self.target}"
             )
-        if self.kind not in ("availability", "latency"):
+        if self.kind not in ("availability", "latency", "throughput"):
             raise ValueError(f"unknown SLO kind {self.kind!r}")
+        if self.kind == "throughput" and not self.floor_per_s > 0.0:
+            raise ValueError(
+                f"SLO {self.name!r}: throughput objectives need "
+                f"floor_per_s > 0, got {self.floor_per_s}"
+            )
 
     @classmethod
     def availability(cls, name: str, target: float,
@@ -108,6 +126,16 @@ class SLObjective:
                 ) -> "SLObjective":
         return cls(name=name, target=target, kind="latency",
                    family=family, threshold_s=threshold_s)
+
+    @classmethod
+    def throughput(cls, name: str, target: float, floor_per_s: float,
+                   family: str = "dl4jtpu_decode_tokens_total",
+                   demand_family: str =
+                   "dl4jtpu_generation_streams_admitted_total",
+                   ) -> "SLObjective":
+        return cls(name=name, target=target, kind="throughput",
+                   family=family, floor_per_s=floor_per_s,
+                   demand_family=demand_family)
 
     @property
     def budget(self) -> float:
@@ -176,6 +204,15 @@ class SLOEngine:
             total = fam.count
             good = fam.count_le(obj.threshold_s)
             return good, total - good
+        if obj.kind == "throughput":
+            # the "bad" slot carries cumulative DEMAND: the sample
+            # tuples keep their (t, good, bad) shape and the window
+            # scan in _burn_locked needs no second bookkeeping path
+            work = fam.sum_series() if fam is not None else 0
+            dem_fam = (reg.get(obj.demand_family)
+                       if obj.demand_family else None)
+            demand = dem_fam.sum_series() if dem_fam is not None else 0
+            return work, demand
         if fam is None:
             return 0, 0
         total = fam.sum_series()
@@ -189,6 +226,7 @@ class SLOEngine:
         (also available without resampling via `state()`)."""
         now = self._clock()
         out = {}
+        fired = []
         with self._lock:
             for obj in self.objectives:
                 good, bad = self._read(obj)
@@ -223,6 +261,7 @@ class SLOEngine:
                     active = was
                 if active and not was:
                     self._alerts_total[obj.name] += 1
+                    fired.append(obj.name)
                     log.warning(
                         "SLO %s burn alert FIRING: %s", obj.name,
                         {f"{w.seconds:g}s":
@@ -231,14 +270,21 @@ class SLOEngine:
                 elif was and not active:
                     log.info("SLO %s burn alert cleared", obj.name)
                 self._alerting[obj.name] = active
-                base_good, base_bad = self._base[obj.name]
-                dgood = good - base_good
-                dbad = bad - base_bad
-                dtotal = dgood + dbad
-                budget_remaining = (
-                    1.0 - (dbad / dtotal) / max(obj.budget, 1e-12)
-                    if dtotal > 0 else 1.0
-                )
+                if obj.kind == "throughput":
+                    # no cumulative error fraction exists for a rate
+                    # floor: the budget view is 1 - burn over the
+                    # SLOWEST window (the long-horizon deficit)
+                    slow = self.windows[-1]
+                    budget_remaining = max(0.0, 1.0 - burns[slow])
+                else:
+                    base_good, base_bad = self._base[obj.name]
+                    dgood = good - base_good
+                    dbad = bad - base_bad
+                    dtotal = dgood + dbad
+                    budget_remaining = (
+                        1.0 - (dbad / dtotal) / max(obj.budget, 1e-12)
+                        if dtotal > 0 else 1.0
+                    )
                 out[obj.name] = {
                     "kind": obj.kind,
                     "target": obj.target,
@@ -256,31 +302,68 @@ class SLOEngine:
                     "alerts_total": self._alerts_total[obj.name],
                     "budget_remaining": round(budget_remaining, 4),
                 }
+                if obj.kind == "throughput":
+                    fast = self.windows[0]
+                    rate = self._rate_locked(dq, now, fast.seconds)
+                    out[obj.name]["floor_per_s"] = obj.floor_per_s
+                    out[obj.name]["rate_per_s"] = (
+                        round(rate, 4) if rate is not None else None
+                    )
             self._state = out
         self._refresh_gauges(out)
+        # rising edges notify OUTSIDE the engine lock: a listener (the
+        # serving flight recorder) may read back engine/registry state
+        for name in fired:
+            _notify_alert(name, out[name])
         return out
 
     @staticmethod
-    def _burn_locked(obj: SLObjective, dq, now: float,
-                     window_s: float) -> float:
-        """Burn rate over the trailing window: error rate of the events
-        inside it over the error budget.  Reads the NEWEST sample at or
-        before the window start (so the delta spans the full window,
-        never a sliver of it); zero traffic burns zero."""
+    def _window_ref(dq, now: float, window_s: float):
+        """The NEWEST sample at or before the window start (so the
+        delta spans the full window, never a sliver of it)."""
         cutoff = now - window_s
-        t_new, good_new, bad_new = dq[-1]
         ref = dq[0]
         for s in dq:
             if s[0] <= cutoff:
                 ref = s
             else:
                 break
+        return ref
+
+    @classmethod
+    def _burn_locked(cls, obj: SLObjective, dq, now: float,
+                     window_s: float) -> float:
+        """Burn rate over the trailing window.  Availability/latency:
+        error rate of the events inside it over the error budget; zero
+        traffic burns zero.  Throughput: fractional deficit of the
+        work rate below the floor over the budget; a window with no
+        work AND no fresh demand is idle and burns zero."""
+        t_new, good_new, bad_new = dq[-1]
+        ref = cls._window_ref(dq, now, window_s)
         dgood = good_new - ref[1]
         dbad = bad_new - ref[2]
+        if obj.kind == "throughput":
+            dt = t_new - ref[0]
+            if dt <= 0 or (dgood <= 0 and dbad <= 0):
+                return 0.0
+            rate = dgood / dt
+            deficit = max(0.0, 1.0 - rate / max(obj.floor_per_s, 1e-12))
+            return deficit / max(obj.budget, 1e-12)
         dtotal = dgood + dbad
         if dtotal <= 0:
             return 0.0
         return (dbad / dtotal) / max(obj.budget, 1e-12)
+
+    @classmethod
+    def _rate_locked(cls, dq, now: float, window_s: float):
+        """Work rate (events/s) over the trailing window, None when the
+        window has no width yet."""
+        t_new, good_new, _ = dq[-1]
+        ref = cls._window_ref(dq, now, window_s)
+        dt = t_new - ref[0]
+        if dt <= 0:
+            return None
+        return (good_new - ref[1]) / dt
 
     def _refresh_gauges(self, state: dict) -> None:
         try:
@@ -408,3 +491,64 @@ def sample_active_summary() -> Optional[dict]:
     except Exception as e:
         log.debug("slo summary sample failed: %s", e)
         return None
+
+
+# -- alert listeners ----------------------------------------------------------
+# Process-wide rising-edge hooks: `fn(objective_name, state_dict)` runs
+# on every alert FIRING transition of any engine, outside the engine
+# lock.  This is how the serving flight recorder dumps on an SLO page
+# without observe/ ever importing serving/.
+
+_ALERT_LISTENERS: list = []
+_ALERT_LISTENERS_LOCK = threading.Lock()
+
+
+def add_alert_listener(fn: Callable[[str, dict], None]) -> None:
+    with _ALERT_LISTENERS_LOCK:
+        if fn not in _ALERT_LISTENERS:
+            _ALERT_LISTENERS.append(fn)
+
+
+def remove_alert_listener(fn: Callable[[str, dict], None]) -> None:
+    """Idempotent: removing a never-added listener is a no-op."""
+    with _ALERT_LISTENERS_LOCK:
+        if fn in _ALERT_LISTENERS:
+            _ALERT_LISTENERS.remove(fn)
+
+
+def _notify_alert(name: str, state: dict) -> None:
+    with _ALERT_LISTENERS_LOCK:
+        fns = list(_ALERT_LISTENERS)
+    for fn in fns:
+        try:
+            fn(name, state)
+        except Exception as e:
+            # a broken listener must never take the evaluation tick down
+            log.debug("slo alert listener failed for %s: %s", name, e)
+
+
+def generation_objectives(ttft_target: float = 0.95,
+                          ttft_threshold_s: float = 0.5,
+                          tokens_floor_per_s: float = 50.0,
+                          tokens_target: float = 0.9,
+                          success_target: float = 0.99) -> list:
+    """The generation-plane objective set (docs/observability.md):
+    TTFT-p95 over the TTFT histogram, an aggregate tokens/s floor over
+    the decode counter (demand-gated by admissions), and stream
+    success over the per-outcome stream counter."""
+    return [
+        SLObjective.latency(
+            "generation_ttft_p95", target=ttft_target,
+            threshold_s=ttft_threshold_s, family="dl4jtpu_ttft_seconds",
+        ),
+        SLObjective.throughput(
+            "generation_tokens_rate", target=tokens_target,
+            floor_per_s=tokens_floor_per_s,
+        ),
+        SLObjective.availability(
+            "generation_stream_success", target=success_target,
+            family="dl4jtpu_generation_streams_total",
+            bad=(("outcome", "error"), ("outcome", "wedged"),
+                 ("outcome", "kv_exhausted")),
+        ),
+    ]

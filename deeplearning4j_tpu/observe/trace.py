@@ -373,6 +373,12 @@ class TraceRecorder:
         out.sort(key=lambda s: s["t0"])
         return out
 
+    def trace_ids(self) -> set:
+        """Distinct trace ids currently in the ring — enumerate chains
+        (tests, bench sweeps) without poking at the raw span tuples."""
+        return {s[5]["trace"] for s in list(self._spans)
+                if s[5] and "trace" in s[5]}
+
     def to_chrome_trace(self, limit: Optional[int] = None,
                         name: Optional[str] = None) -> dict:
         """Chrome trace-event JSON object (the Perfetto-loadable schema:

@@ -19,6 +19,7 @@ from deeplearning4j_tpu.serving.hotswap import (          # noqa: F401
 from deeplearning4j_tpu.serving.fleet import (            # noqa: F401
     CanaryError, FleetDeployer, ServingFleet,
 )
+from deeplearning4j_tpu.serving.flight import FlightRecorder  # noqa: F401
 from deeplearning4j_tpu.serving.generation import (       # noqa: F401
     GenerationConfig, GenerationEngine, GenerationRequest,
 )

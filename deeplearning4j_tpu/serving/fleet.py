@@ -45,6 +45,7 @@ from typing import Callable, Optional
 
 import numpy as np
 
+from deeplearning4j_tpu.observe import trace as otrace
 from deeplearning4j_tpu.runtime import faults
 from deeplearning4j_tpu.serving.router import (
     ReplicaHandle, Router, RouterConfig,
@@ -194,14 +195,20 @@ class ServingFleet:
                 "generation is not enabled on this fleet — construct it "
                 "with generation_config= or call enable_generation()"
             )
-        h_pre = self.router.pick_for_role("prefill")
+        # One trace id for the WHOLE stream, allocated at the front
+        # door: the prefill replica's spans, the kv handoff, and the
+        # decode replica's step spans all parent onto the same root, so
+        # /api/trace/cluster shows one causal chain across replicas.
+        rec = otrace.tracer()
+        ctx = (otrace.next_id(), otrace.next_id()) if rec.enabled else None
+        h_pre = self.router.pick_for_role("prefill", trace_ctx=ctx)
         handoff = self.engines[h_pre.name].prefill_detached(
             prompt, max_new_tokens if max_new_tokens is not None
             else self.engines[h_pre.name].config.default_max_new,
             temperature=temperature, top_k=top_k, seed=seed,
-            stop_tokens=stop_tokens,
+            stop_tokens=stop_tokens, trace_ctx=ctx,
         )
-        h_dec = self.router.pick_for_role("decode")
+        h_dec = self.router.pick_for_role("decode", trace_ctx=ctx)
         log.debug("fleet generate: prefill on %s, decode on %s",
                   h_pre.name, h_dec.name)
         req = self.engines[h_dec.name].join_prefilled(

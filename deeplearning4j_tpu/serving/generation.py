@@ -37,6 +37,25 @@ Numerics contract: greedy paged decode is token-identical to
 `ops.generation.generate` for f32 (same per-position math, same
 `fold_in` RNG schedule, same top-k threshold rule), and int8-KV pages
 are gated by agreement the way PR 13 gated PTQ parity.
+
+Observability (docs/observability.md "Generation plane"): every stream
+settles through ONE fate point (`_finish`), which records the
+``generation.stream`` root span exactly once, bumps the per-outcome
+stream counter, observes the six-segment latency breakdown
+(queue / prefill / handoff / decode_queue / decode_compute / sampling),
+offers the stream to the slowest-streams exemplar ring
+(``GET /api/generation/slow``), and appends a flight-recorder record —
+so watchdog-aborted, KV-exhausted (429) and client-cancelled streams
+get the same complete causal chain as happy ones, per the PR 12
+contract.  Span taxonomy per stream: ``generation.admit`` (enqueue to
+taken) -> ``generation.prefill`` (bucketed prompt forward, wherever it
+ran) -> ``generation.kv_handoff`` (prefill K/V landing in the decode
+pool; cross-replica it starts at the prefill replica's completion
+mark) -> one ``generation.decode_step`` span per step per co-resident
+stream (args carry the batch composition: co-resident rids and
+per-stream token counts) -> the ``generation.stream`` root.  The trace
+context rides the `prefill_detached` handoff dict, so a disaggregated
+stream is one causal chain across replicas on ``/api/trace/cluster``.
 """
 
 from __future__ import annotations
@@ -44,6 +63,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass
 from typing import Callable, Optional
 
@@ -51,6 +71,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from deeplearning4j_tpu.observe import trace as otrace
 from deeplearning4j_tpu.ops.generation import (
     _block_prefill,
     _head_logits,
@@ -68,6 +89,7 @@ from deeplearning4j_tpu.serving.admission import (
     ServingRejected,
     ServingTimeout,
 )
+from deeplearning4j_tpu.serving.flight import FlightRecorder
 from deeplearning4j_tpu.serving.kv_cache import (
     SCRATCH_PAGE,
     KVPoolExhausted,
@@ -76,6 +98,34 @@ from deeplearning4j_tpu.serving.kv_cache import (
 )
 
 log = logging.getLogger("deeplearning4j_tpu")
+
+#: slowest-stream exemplars kept per engine (the serving twin of
+#: server.SLOW_RING_CAP — bounded, readable mid-incident)
+GEN_SLOW_RING_CAP = 16
+
+#: the per-stream latency segments, in lifecycle order (breakdown dict
+#: keys, histogram families and docs share this vocabulary);
+#: decode_queue is the residual: slot residency not spent in decode
+#: compute or sampling
+GEN_BREAKDOWN_SEGMENTS = ("queue", "prefill", "handoff", "decode_queue",
+                          "decode_compute", "sampling")
+
+_GEN_BREAKDOWN_FAMILIES = None
+
+
+def _gen_breakdown_families() -> dict:
+    """Segment-name -> histogram, resolved once — per-stream
+    attribution must not pay registry lookups/locks."""
+    global _GEN_BREAKDOWN_FAMILIES
+    if _GEN_BREAKDOWN_FAMILIES is None:
+        from deeplearning4j_tpu.observe.metrics import registry
+
+        reg = registry()
+        _GEN_BREAKDOWN_FAMILIES = {
+            seg: reg.histogram(f"dl4jtpu_generation_{seg}_seconds")
+            for seg in GEN_BREAKDOWN_SEGMENTS
+        }
+    return _GEN_BREAKDOWN_FAMILIES
 
 
 @dataclass
@@ -110,7 +160,11 @@ class GenerationRequest:
     __slots__ = ("rid", "prompt", "max_new", "temperature", "top_k",
                  "seed", "stop_tokens", "on_token", "tokens", "error",
                  "cancelled", "prefilled", "signature", "seq",
-                 "t_submit", "ttft_s", "_event", "_lock")
+                 "t_submit", "ttft_s", "_event", "_lock",
+                 # observability riders (engine-written; see _finish):
+                 # trace linkage, latency-segment dict, fate bookkeeping
+                 "trace_id", "root_span", "root_parent", "lat",
+                 "outcome", "trace_done", "t_offer", "t_slot", "pages")
 
     _next = [0]
 
@@ -134,6 +188,15 @@ class GenerationRequest:
         self.seq = 0
         self.t_submit = time.perf_counter()
         self.ttft_s: Optional[float] = None
+        self.trace_id: Optional[int] = None
+        self.root_span: Optional[int] = None
+        self.root_parent: Optional[int] = None
+        self.lat: dict = {}            # segment -> seconds (see _finish)
+        self.outcome: Optional[str] = None
+        self.trace_done = False        # fate settled exactly once
+        self.t_offer: Optional[float] = None
+        self.t_slot: Optional[float] = None
+        self.pages = 0                 # KV pages held at admission
         self._event = threading.Event()
         self._lock = threading.Lock()
 
@@ -294,6 +357,19 @@ class GenerationEngine:
         self._tokens_out = 0
         self._step_fn = None
         self._prefill_fns: dict[int, Callable] = {}
+        # observability: trace recorder handle, slow-stream exemplar
+        # ring, breakdown totals, and the flight recorder with its
+        # SLO-alert rising-edge trigger (detached at stop())
+        self._rec = otrace.tracer()
+        self._stats_lock = threading.Lock()
+        self._slow: list[dict] = []
+        self._lat_totals = {k: 0.0 for k in GEN_BREAKDOWN_SEGMENTS}
+        self._stream_outcomes: dict[str, int] = {}
+        self._streams_settled = 0
+        self._rate_samples: deque = deque(maxlen=64)  # (t, tokens_out)
+        self.flight = FlightRecorder()
+        self.flight.context_fn = self._flight_context
+        self.flight.attach_slo_trigger()
         if server is not None:
             server.generation_engine = self
 
@@ -318,19 +394,26 @@ class GenerationEngine:
         if t is not None:
             t.join(timeout)
         for req in self.queue.drain():
-            req._fail(ServingRejected("shutdown", "engine stopped"))
+            self._finish(req, "shutdown",
+                         ServingRejected("shutdown", "engine stopped"))
         with self._mu:
             self._fail_active_locked(
-                ServingRejected("shutdown", "engine stopped")
+                ServingRejected("shutdown", "engine stopped"),
+                outcome="shutdown",
             )
+        self.flight.detach_slo_trigger()
 
     # -- admission ---------------------------------------------------------
     def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-               stop_tokens: tuple = (), on_token=None) -> GenerationRequest:
+               stop_tokens: tuple = (), on_token=None,
+               trace_ctx=None) -> GenerationRequest:
         """Admit one stream.  Raises `ServingRejected` on a full queue
         or an open breaker; over-capacity streams (longer than the page
-        table can hold) are client errors (`ValueError`)."""
+        table can hold) are client errors (`ValueError`).  `trace_ctx`
+        is an upstream ``(trace_id, root_span)`` pair (the fleet's
+        routed path allocates one so the router pick joins the stream
+        chain); None allocates fresh ids when tracing is on."""
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else self.config.default_max_new)
         req = GenerationRequest(
@@ -338,7 +421,8 @@ class GenerationEngine:
             seed=seed, stop_tokens=stop_tokens, on_token=on_token,
         )
         self._validate(req)
-        self._offer(req)
+        self._init_trace(req, trace_ctx)
+        self._offer_counted(req)
         return req
 
     def _validate(self, req: GenerationRequest) -> None:
@@ -372,6 +456,43 @@ class GenerationEngine:
                 f"generation queue at capacity ({self.queue.max_queue})",
             )
 
+    def _offer_counted(self, req: GenerationRequest) -> None:
+        """Offer + admission bookkeeping: a synchronous reject is
+        counted as a stream outcome (its reason), an accepted stream
+        bumps the demand counter behind throughput SLOs and stamps the
+        enqueue mark the queue segment reads."""
+        try:
+            self._offer(req)
+        except ServingRejected as exc:
+            self._count_stream(exc.reason)
+            raise
+        req.t_offer = time.perf_counter()
+        self._count_admitted()
+
+    def _init_trace(self, req: GenerationRequest, trace_ctx=None) -> None:
+        """Allocate (or adopt) the stream's trace linkage BEFORE the
+        queue sees it — same contract as server._admit.  No-op when
+        tracing is off: untraced streams still get breakdowns."""
+        if not self._rec.enabled:
+            return
+        if trace_ctx is not None:
+            req.trace_id, req.root_span = trace_ctx
+        else:
+            req.trace_id = otrace.next_id()
+            req.root_span = otrace.next_id()
+
+    def _trace_segment(self, req: GenerationRequest, name: str,
+                       t0_pc: float, dur: float, **args) -> None:
+        """One child span of the stream's root chain (no-op untraced)."""
+        if req.trace_id is None or not self._rec.enabled:
+            return
+        self._rec.add_complete(
+            name, t0_pc, dur, cat="generation",
+            **otrace.trace_args(req.trace_id, otrace.next_id(),
+                                req.root_span),
+            **args,
+        )
+
     def generate(self, prompt, max_new_tokens: int, *,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                  stop_tokens: tuple = (),
@@ -386,36 +507,51 @@ class GenerationEngine:
     # -- prefill/decode disaggregation ------------------------------------
     def prefill_detached(self, prompt, max_new_tokens: int, *,
                          temperature: float = 0.0, top_k: int = 0,
-                         seed: int = 0, stop_tokens: tuple = ()) -> dict:
+                         seed: int = 0, stop_tokens: tuple = (),
+                         trace_ctx=None) -> dict:
         """Run ONLY the prefill program here and return a portable
         handoff (prompt K/V rows as host arrays + the first token + the
         stream's sampling state).  A decode-role replica resumes the
         stream from it via `join_prefilled` — K/V cross the replica
         boundary in f32 and land in whatever page dtype the DECODE
         pool uses, so a f32 prefill replica can feed an int8 decode
-        replica."""
+        replica.  The stream's trace context (adopted from `trace_ctx`
+        or allocated here) and timing marks ride the handoff, so the
+        decode replica extends the SAME causal chain."""
         req = GenerationRequest(
             prompt, int(max_new_tokens), temperature=temperature,
             top_k=top_k, seed=seed, stop_tokens=stop_tokens,
         )
         self._validate(req)
+        self._init_trace(req, trace_ctx)
         try:
             faults.maybe_fail("serving.prefill")
         except Exception as exc:
             raise ServingError(f"injected prefill fault: {exc}") from exc
+        t_pre0 = time.perf_counter()
         k, v, first, ttft_anchor = self._run_prefill(req)
-        return {
+        pre_s = time.perf_counter() - t_pre0
+        self._trace_segment(req, "generation.prefill", t_pre0, pre_s,
+                            bucket=int(k.shape[1]), detached=True)
+        out = {
             "prompt": req.prompt, "k": np.asarray(k), "v": np.asarray(v),
             "first_token": int(first), "max_new": req.max_new,
             "temperature": req.temperature, "top_k": req.top_k,
             "seed": req.seed, "stop_tokens": req.stop_tokens,
             "t_submit": ttft_anchor,
+            "prefill_s": pre_s,
+            "t_done_pc": time.perf_counter(),
         }
+        if req.trace_id is not None:
+            out["trace"] = (req.trace_id, req.root_span)
+        return out
 
     def join_prefilled(self, handoff: dict,
                        on_token=None) -> GenerationRequest:
         """Admit a stream whose prefill already ran elsewhere (the
-        decode side of the disaggregation seam)."""
+        decode side of the disaggregation seam).  Adopts the handoff's
+        trace context — the root span settles HERE, where the stream's
+        fate is decided — and its prefill timing for the breakdown."""
         req = GenerationRequest(
             handoff["prompt"], handoff["max_new"],
             temperature=handoff["temperature"], top_k=handoff["top_k"],
@@ -424,7 +560,10 @@ class GenerationEngine:
         )
         req.t_submit = handoff.get("t_submit", req.t_submit)
         self._validate(req)
-        self._offer(req)
+        self._init_trace(req, handoff.get("trace"))
+        if "prefill_s" in handoff:
+            req.lat["prefill"] = float(handoff["prefill_s"])
+        self._offer_counted(req)
         return req
 
     # -- compiled programs -------------------------------------------------
@@ -598,9 +737,19 @@ class GenerationEngine:
             len(free), linger_s=0.0, stop=self._stop,
             poll_s=self.config.poll_s,
         )
+        t_taken = time.perf_counter()
         for req in batch:
+            q0 = req.t_offer if req.t_offer is not None else req.t_submit
+            wait = max(0.0, t_taken - q0)
+            first_take = "queue" not in req.lat
+            req.lat["queue"] = wait
+            if first_take:
+                # cancelled streams keep the segment too: a client
+                # disconnect mid-queue still yields a complete chain
+                self._trace_segment(req, "generation.admit", q0, wait)
             if req.cancelled:
-                req._fail(ServingRejected("shutdown", "cancelled"))
+                self._finish(req, "cancelled",
+                             ServingRejected("shutdown", "cancelled"))
                 continue
             slot = self._free_slots()
             if not slot:                  # more takes than slots freed
@@ -610,7 +759,8 @@ class GenerationEngine:
 
     def _offer_back(self, req: GenerationRequest) -> None:
         if not self.queue.offer(req):
-            req._fail(ServingRejected("queue_full", "requeue failed"))
+            self._finish(req, "queue_full",
+                         ServingRejected("queue_full", "requeue failed"))
 
     def _admit_to_slot(self, my_gen: int, slot: int,
                        req: GenerationRequest) -> None:
@@ -624,31 +774,60 @@ class GenerationEngine:
             self.kv.alloc(req.rid, self.kv.pages_for(span))
         except KVPoolExhausted as exc:
             # the explicit 429 — the stream never stalls waiting on HBM
-            req._fail(ServingRejected("kv_exhausted", str(exc)))
+            self._finish(req, "kv_exhausted",
+                         ServingRejected("kv_exhausted", str(exc)))
+            try:
+                self.flight.note_kv_exhausted()
+            except Exception as e:
+                log.debug("kv spike note failed: %s", e)
             return
+        req.pages = self.kv.pages_for(span)
         try:
             if req.prefilled is None:
                 faults.maybe_fail("serving.prefill")
+                t_pre0 = time.perf_counter()
                 k, v, first, _ = self._run_prefill(req)
+                t_pre1 = time.perf_counter()
+                req.lat["prefill"] = t_pre1 - t_pre0
+                self._trace_segment(req, "generation.prefill",
+                                    t_pre0, t_pre1 - t_pre0, bucket=t_b)
+                hand_t0 = None
             else:
                 k, v = req.prefilled["k"], req.prefilled["v"]
                 first = req.prefilled["first_token"]
+                hand_t0 = req.prefilled.get("t_done_pc")
+            t_w0 = time.perf_counter()
             tbl = self.kv.write_prefill(req.rid, k, v)
+            t_w1 = time.perf_counter()
+            # cross-replica handoff spans from the PREFILL replica's
+            # completion mark (perf_counter is comparable in-process);
+            # the lat entry excludes the decode-side queue wait the
+            # "queue" segment already owns
+            transfer = (max(0.0, req.t_offer - hand_t0)
+                        if hand_t0 is not None and req.t_offer is not None
+                        else 0.0)
+            req.lat["handoff"] = transfer + (t_w1 - t_w0)
+            span_t0 = hand_t0 if hand_t0 is not None else t_w0
+            self._trace_segment(req, "generation.kv_handoff", span_t0,
+                                max(0.0, t_w1 - span_t0), pages=len(tbl))
         except Exception as exc:
             self.kv.release(req.rid)
-            req._fail(ServingError(f"prefill failed: {exc}"))
+            self._finish(req, "error",
+                         ServingError(f"prefill failed: {exc}"))
             return
         req._record(first)
         self._observe_ttft(req)
         self._count_tokens(1)
         if req.max_new <= 1 or first in req.stop_tokens:
             self.kv.release(req.rid)
-            req._complete()
+            self._finish(req, "ok")
             return
         with self._mu:
             if self._loop_gen != my_gen:
                 self.kv.release(req.rid)
-                req._fail(ServingError("engine respawned during admit"))
+                self._finish(
+                    req, "error",
+                    ServingError("engine respawned during admit"))
                 return
             row = np.full(self.config.max_pages_per_seq, SCRATCH_PAGE,
                           np.int32)
@@ -661,6 +840,7 @@ class GenerationEngine:
             self._top_ks[slot] = req.top_k
             self._seeds[slot] = np.uint32(req.seed)
             self._slot_req[slot] = req
+            req.t_slot = time.perf_counter()
         self._gauge_occupancy()
 
     def _decode_step(self, my_gen: int) -> None:
@@ -699,13 +879,16 @@ class GenerationEngine:
             self.watchdog.disarm(None)
             self._step_failed(my_gen, exc)
             return
-        self.watchdog.disarm(time.perf_counter() - t0)
+        step_s = time.perf_counter() - t0
+        self.watchdog.disarm(step_s)
+        t_h0 = time.perf_counter()
         with self._mu:
             if self._loop_gen != my_gen:
                 return                     # wedged + respawned: stale
             self.kv.k_pages, self.kv.v_pages = out[0], out[1]
             self.kv.k_scales, self.kv.v_scales = out[2], out[3]
             finished: list[tuple[GenerationRequest, bool]] = []
+            stepped: list[tuple[GenerationRequest, int]] = []
             n_live = 0
             for s, req in enumerate(self._slot_req):
                 if req is None:
@@ -720,19 +903,41 @@ class GenerationEngine:
                 self._seq_lens[s] += 1
                 self._gen_counts[s] += 1
                 self._last_tok[s] = tok
+                stepped.append((req, int(self._gen_counts[s])))
                 if (self._gen_counts[s] >= req.max_new
                         or tok in req.stop_tokens):
                     self._clear_slot(s)
                     finished.append((req, True))
+            if stepped and self._rec.enabled:
+                # batch-composition attribution: every co-resident
+                # stream gets this step's span, tagged with who shared
+                # the dispatch and how far along each stream is
+                rids = [r.rid for r, _ in stepped]
+                counts = {r.rid: c for r, c in stepped}
+                for req, _ in stepped:
+                    self._trace_segment(
+                        req, "generation.decode_step", t0, step_s,
+                        step=self._steps, batch=rids,
+                        batch_tokens=counts,
+                    )
+        samp_s = max(0.0, time.perf_counter() - t_h0)
+        for req, _ in stepped:
+            # each co-resident stream is charged the full step wall
+            # (like the shared dispatch segment of /v1/infer) plus the
+            # host-side harvest/sampling bookkeeping
+            req.lat["decode_compute"] = (
+                req.lat.get("decode_compute", 0.0) + step_s)
+            req.lat["sampling"] = req.lat.get("sampling", 0.0) + samp_s
         if self.breaker is not None:
             self.breaker.record_success()
         self._count_tokens(n_live)
         for req, ok in finished:
             self.kv.release(req.rid)
             if ok:
-                req._complete()
+                self._finish(req, "ok")
             else:
-                req._fail(ServingRejected("shutdown", "cancelled"))
+                self._finish(req, "cancelled",
+                             ServingRejected("shutdown", "cancelled"))
         self._gauge_occupancy()
 
     def _clear_slot(self, s: int) -> None:
@@ -750,24 +955,36 @@ class GenerationEngine:
     # -- failure paths -----------------------------------------------------
     def _step_failed(self, my_gen: int, exc: BaseException) -> None:
         log.error("generation decode step failed: %s", exc)
+        tripped = False
         if self.breaker is not None:
+            was = self.breaker.state
             self.breaker.record_failure()
+            tripped = was != "open" and self.breaker.state == "open"
         with self._mu:
             if self._loop_gen != my_gen:
                 return
             self._fail_active_locked(
                 ServingError(f"decode step failed: {exc}"))
         self._gauge_occupancy()
+        if tripped:
+            try:
+                self.flight.dump("breaker_open",
+                                 context={"error": str(exc)})
+            except Exception as e:
+                log.debug("breaker flight dump failed: %s", e)
 
-    def _fail_active_locked(self, exc: BaseException) -> None:
+    def _fail_active_locked(self, exc: BaseException,
+                            outcome: str = "error") -> None:
         """Caller holds self._mu: fail every in-flight stream and
-        release ALL of their pages — the watchdog-abort contract."""
+        release ALL of their pages — the watchdog-abort contract.
+        Every stream settles through `_finish`, so aborted streams get
+        closed chains, outcome counts and flight records too."""
         for s, req in enumerate(self._slot_req):
             if req is None:
                 continue
             self._clear_slot(s)
             self.kv.release(req.rid)
-            req._fail(exc)
+            self._finish(req, outcome, exc)
 
     def _on_wedged(self, event: dict) -> None:
         """Watchdog stage-3 abort: the dispatched step never returned.
@@ -782,14 +999,130 @@ class GenerationEngine:
             self._loop_gen += 1
             gen = self._loop_gen
             self._fail_active_locked(
-                ServingError(f"decode step wedged: {event.get('stage')}"))
+                ServingError(f"decode step wedged: {event.get('stage')}"),
+                outcome="wedged",
+            )
         self._gauge_occupancy()
+        try:
+            self.flight.dump("watchdog_abort", context=dict(event))
+        except Exception as e:
+            log.debug("watchdog flight dump failed: %s", e)
         if not self._stop.is_set():
             self._thread = threading.Thread(
                 target=self._loop, args=(gen,),
                 name="dl4jtpu-generation", daemon=True,
             )
             self._thread.start()
+
+    # -- the fate point ----------------------------------------------------
+    def _finish(self, req: GenerationRequest, outcome: str,
+                exc: Optional[BaseException] = None) -> None:
+        """Settle one stream EXACTLY ONCE: finalize the latency
+        breakdown, record the ``generation.stream`` root span, bump the
+        per-outcome counter, offer the stream to the slow ring, append
+        the flight record, then release the client (`_fail`/`_complete`).
+        Racing settlers (watchdog abort vs stop) claim via `trace_done`
+        under the request lock; losers are silent no-ops."""
+        with req._lock:
+            if req.trace_done:
+                return
+            req.trace_done = True
+            req.outcome = outcome
+        t_fate = time.perf_counter()
+        latency = max(0.0, t_fate - req.t_submit)
+        if req.t_slot is not None:
+            resid = (t_fate - req.t_slot
+                     - req.lat.get("decode_compute", 0.0)
+                     - req.lat.get("sampling", 0.0))
+            req.lat["decode_queue"] = max(0.0, resid)
+        self._observe_breakdown(req.lat)
+        self._count_stream(outcome)
+        if req.trace_id is not None and self._rec.enabled:
+            args = dict(otrace.trace_args(req.trace_id, req.root_span,
+                                          req.root_parent))
+            if exc is not None:
+                args["error"] = str(exc)
+            self._rec.add_complete(
+                "generation.stream", req.t_submit, latency,
+                cat="generation", outcome=outcome, rid=req.rid,
+                tokens=len(req.tokens), **args,
+            )
+        self._note_slow(req, outcome, latency)
+        self._flight_record(req, outcome, latency, exc)
+        if exc is not None:
+            req._fail(exc)
+        else:
+            req._complete()
+
+    def _note_slow(self, req: GenerationRequest, outcome: str,
+                   latency_s: float) -> None:
+        """Offer one settled stream to the slowest-streams exemplar
+        ring (bounded, latency-descending — the generation twin of
+        server._note_slow)."""
+        entry = {
+            "kind": "generate",
+            "rid": req.rid,
+            "trace": (f"{req.trace_id:x}" if req.trace_id is not None
+                      else None),
+            "trace_id": req.trace_id,
+            "outcome": outcome,
+            "latency_s": round(latency_s, 6),
+            "ttft_s": (round(req.ttft_s, 6) if req.ttft_s is not None
+                       else None),
+            "tokens": len(req.tokens),
+            "t_wall": time.time(),
+            "breakdown_s": {k: round(v, 6) for k, v in req.lat.items()},
+        }
+        with self._stats_lock:
+            slow = self._slow
+            if len(slow) >= GEN_SLOW_RING_CAP and \
+                    latency_s <= slow[-1]["latency_s"]:
+                return
+            slow.append(entry)
+            slow.sort(key=lambda e: -e["latency_s"])
+            del slow[GEN_SLOW_RING_CAP:]
+
+    def slow_streams(self, spans: bool = True) -> list[dict]:
+        """The slowest-stream exemplars (latency-descending), each with
+        its breakdown and — when tracing is on — its full causal span
+        chain.  Served at ``GET /api/generation/slow`` and merged into
+        ``GET /api/serving/slow``."""
+        with self._stats_lock:
+            out = [dict(e) for e in self._slow]
+        if spans and self._rec.enabled:
+            for e in out:
+                if e["trace_id"] is not None:
+                    e["spans"] = self._rec.trace_chain(e["trace_id"])
+        for e in out:
+            e.pop("trace_id", None)
+        return out
+
+    def _flight_record(self, req: GenerationRequest, outcome: str,
+                       latency_s: float,
+                       exc: Optional[BaseException]) -> None:
+        try:
+            self.flight.record({
+                "rid": req.rid,
+                "trace": (f"{req.trace_id:x}"
+                          if req.trace_id is not None else None),
+                "outcome": outcome,
+                "error": str(exc) if exc is not None else None,
+                "prompt_len": int(req.prompt.shape[0]),
+                "max_new": req.max_new,
+                "tokens": len(req.tokens),
+                "ttft_s": req.ttft_s,
+                "latency_s": round(latency_s, 6),
+                "pages_held": req.pages,
+                "breakdown_s": {k: round(v, 6)
+                                for k, v in req.lat.items()},
+                "t_wall": time.time(),
+            })
+        except Exception as e:
+            log.debug("flight record failed: %s", e)
+
+    def _flight_context(self) -> dict:
+        """Engine/KV snapshot merged into every flight dump."""
+        return {"stats": self.stats()}
 
     # -- introspection -----------------------------------------------------
     def active_streams(self) -> int:
@@ -809,26 +1142,120 @@ class GenerationEngine:
     def stats(self) -> dict:
         with self._mu:
             active = sum(r is not None for r in self._slot_req)
+        with self._stats_lock:
+            totals = dict(self._lat_totals)
+            outcomes = dict(self._stream_outcomes)
+            settled = self._streams_settled
+            slow_n = len(self._slow)
+        total_s = sum(totals.values())
+        breakdown = {
+            k: {
+                "seconds_total": round(v, 6),
+                "fraction": (round(v / total_s, 4)
+                             if total_s > 0 else 0.0),
+            }
+            for k, v in totals.items()
+        }
         return {
             "slots": self.config.slots,
             "active_streams": active,
             "queue_depth": self.queue.depth,
             "decode_steps": self._steps,
             "tokens_generated": self._tokens_out,
+            "tokens_per_s": round(self.tokens_per_s(), 4),
+            "streams": {"settled": settled, "outcomes": outcomes},
+            "latency_breakdown": breakdown,
+            "slow_streams": slow_n,
+            "flight": {"records": len(self.flight),
+                       "dumps": self.flight.dumps_written},
             "kv": self.kv.stats(),
         }
+
+    def health_summary(self) -> dict:
+        """Compact generation block for `InferenceServer.health()` —
+        the Router (and the fleet push behind it) sees a replica's
+        decode pressure and stream outcomes without a /metrics
+        scrape."""
+        with self._mu:
+            active = sum(r is not None for r in self._slot_req)
+        with self._stats_lock:
+            outcomes = dict(self._stream_outcomes)
+        return {
+            "active_streams": active,
+            "queue_depth": self.queue.depth,
+            "kv_occupancy": round(self.kv.occupancy(), 4),
+            "tokens_per_s": round(self.tokens_per_s(), 4),
+            "stream_outcomes": outcomes,
+            "flight_dumps": self.flight.dumps_written,
+        }
+
+    def tokens_per_s(self) -> float:
+        """Recent aggregate decode rate over the trailing rate-sample
+        window (0.0 until two samples exist)."""
+        with self._stats_lock:
+            if len(self._rate_samples) < 2:
+                return 0.0
+            t0, n0 = self._rate_samples[0]
+            t1, n1 = self._rate_samples[-1]
+        dt = t1 - t0
+        return (n1 - n0) / dt if dt > 0 else 0.0
 
     # -- telemetry ---------------------------------------------------------
     def _count_tokens(self, n: int) -> None:
         if n <= 0:
             return
         self._tokens_out += n
+        now = time.perf_counter()
+        with self._stats_lock:
+            self._rate_samples.append((now, self._tokens_out))
         try:
             from deeplearning4j_tpu.observe.metrics import registry
 
-            registry().counter("dl4jtpu_decode_tokens_total").inc(n)
+            reg = registry()
+            reg.counter("dl4jtpu_decode_tokens_total").inc(n)
+            reg.gauge("dl4jtpu_generation_tokens_per_s").set(
+                round(self.tokens_per_s(), 4))
         except Exception as e:
             log.debug("decode token metric failed: %s", e)
+
+    def _count_stream(self, outcome: str) -> None:
+        """One settled (or synchronously rejected) stream, by outcome —
+        the availability numerator/denominator of stream-success SLOs."""
+        with self._stats_lock:
+            self._streams_settled += 1
+            self._stream_outcomes[outcome] = (
+                self._stream_outcomes.get(outcome, 0) + 1)
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().counter("dl4jtpu_generation_streams_total").inc(
+                outcome=outcome)
+        except Exception as e:
+            log.debug("stream outcome metric failed: %s", e)
+
+    def _count_admitted(self) -> None:
+        """Demand counter behind throughput SLOs: admitted streams keep
+        a stalled window non-idle (see SLObjective kind="throughput")."""
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            registry().counter(
+                "dl4jtpu_generation_streams_admitted_total").inc()
+        except Exception as e:
+            log.debug("admitted stream metric failed: %s", e)
+
+    def _observe_breakdown(self, lat: dict) -> None:
+        try:
+            fams = _gen_breakdown_families()
+            with self._stats_lock:
+                for seg in GEN_BREAKDOWN_SEGMENTS:
+                    v = lat.get(seg)
+                    if v is None:
+                        continue
+                    self._lat_totals[seg] += v
+                    fams[seg].observe(v)
+        except Exception as e:
+            log.debug("generation breakdown observe failed: %s", e)
 
     def _observe_ttft(self, req: GenerationRequest) -> None:
         try:

@@ -34,7 +34,11 @@ server answers fast with a reason, never hangs the socket:
                        is installed
   GET  /v1/status   -> 200 stats JSON (queue depth, p50/p99, breaker,
                        swap generation, shed counts, per-request
-                       latency_breakdown, slo state)
+                       latency_breakdown, slo state; when token
+                       generation is enabled, a "generation" block with
+                       stream outcomes, tokens/s, the queue/prefill/
+                       handoff/decode/sampling breakdown, and flight-
+                       recorder counters)
 
 Multi-input graphs POST ``{"inputs": [[...], [...]]}`` — one nested
 array per network input.  Features arrive as ONE example (no batch
@@ -119,6 +123,14 @@ class ServingHTTPServer:
                     )
                 elif u.path == "/v1/status":
                     stats = outer.server.stats()
+                    engine = getattr(outer.server, "generation_engine",
+                                     None)
+                    if engine is not None:
+                        try:
+                            stats["generation"] = engine.stats()
+                        except Exception as e:
+                            log.debug("status generation join "
+                                      "failed: %s", e)
                     slo = _slo_state()
                     if slo is not None:
                         stats["slo"] = slo

@@ -280,7 +280,7 @@ class Router:
             self._rr += 1
             return ties[self._rr % len(ties)], False
 
-    def pick_for_role(self, need: str):
+    def pick_for_role(self, need: str, trace_ctx=None):
         """Least-pressured live ACTIVE replica whose role serves
         ``need`` (``prefill`` or ``decode``; ``both`` replicas serve
         either).  Pressure includes the KV-occupancy term
@@ -288,9 +288,13 @@ class Router:
         page pool is filling sheds token traffic here — BEFORE its
         admissions start answering ``kv_exhausted`` 429s.  Raises
         ``ServingRejected(no_replicas)`` when the role group is empty
-        or fully ejected."""
+        or fully ejected.  `trace_ctx` is a generation stream's
+        ``(trace_id, root_span)``: the pick records a ``router.pick``
+        span into that chain, so the cluster timeline shows WHY a
+        stream landed on its prefill/decode replicas."""
         if need not in ("prefill", "decode"):
             raise ValueError(f"need must be prefill|decode, got {need!r}")
+        t0_pc = time.perf_counter()
         pressures = {
             h.name: h.pressure() for h in self.replicas
             if not h.dead and h.role in (need, "both")
@@ -313,7 +317,27 @@ class Router:
             best = min(pressures[h.name] for h in pool)
             ties = [h for h in pool if pressures[h.name] <= best + 1e-9]
             self._rr += 1
-            return ties[self._rr % len(ties)]
+            chosen = ties[self._rr % len(ties)]
+        self._trace_pick(need, chosen.name, trace_ctx, t0_pc)
+        return chosen
+
+    def _trace_pick(self, need: str, replica: str, trace_ctx,
+                    t0_pc: float) -> None:
+        """One ``router.pick`` span in a generation stream's chain
+        (no-op without a context or with tracing off)."""
+        try:
+            rec = otrace.tracer()
+            if trace_ctx is None or not rec.enabled:
+                return
+            trace_id, parent = trace_ctx
+            rec.add_complete(
+                "router.pick", t0_pc, time.perf_counter() - t0_pc,
+                cat="generation",
+                **otrace.trace_args(trace_id, otrace.next_id(), parent),
+                role=need, replica=replica,
+            )
+        except Exception as e:
+            log.debug("router pick span failed: %s", e)
 
     def _record(self, handle, outcome: str, probe: bool,
                 eject_reason: Optional[str] = None) -> None:

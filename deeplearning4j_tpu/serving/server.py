@@ -1017,7 +1017,7 @@ class InferenceServer:
         state = self.breaker.state
         with self._stats_lock:
             ewma = self._batch_ewma
-        return {
+        out = {
             "status": "breaker_open" if state == "open" else "serving",
             "shed_pressure": round(self.shed_pressure(), 6),
             "breaker_state": state,
@@ -1026,6 +1026,15 @@ class InferenceServer:
             "queue_depth": self.queue.depth,
             "quantized": self.quantized,
         }
+        engine = getattr(self, "generation_engine", None)
+        if engine is not None:
+            try:
+                # rides the fleet push for free: observe/fleet's
+                # _serving_summary ships health() verbatim
+                out["generation"] = engine.health_summary()
+            except Exception as e:  # dying engine must not break health
+                log.debug("generation health join failed: %s", e)
+        return out
 
     def stats(self) -> dict:
         with self._stats_lock:

@@ -18,7 +18,10 @@ PlanReport: candidates, prices, rejection reasons, pick),
 /api/trace/cluster (merged per-worker cluster
 timeline), /api/serving (live inference servers: queue depth, p50/p99,
 breaker, swap generation), /api/serving/slow (slowest-request
-exemplars with latency breakdown + span chains), /api/slo (SLO
+exemplars with latency breakdown + span chains; generation streams
+merged in, tagged kind=infer|generate), /api/generation/slow (slowest
+generation streams only: six-segment breakdown, TTFT, cross-replica
+span chains), /api/slo (SLO
 burn-rate state, local + pushed workers).  Scrape API:
 /metrics (Prometheus text exposition of the process-global
 `observe.metrics` registry — compile taxes, ETL wait, cache hits, step
@@ -358,6 +361,9 @@ class UIServer:
                     # server in this process: per-request latency
                     # breakdown + full causal span chain (tracing on) —
                     # "where did THAT request's time go", mid-incident.
+                    # Generation streams ride the SAME list (tagged
+                    # kind=generate vs kind=infer) so the slowest thing
+                    # in the process surfaces here regardless of plane.
                     # Chains (a full ring scan each) are attached only
                     # to the rows that SURVIVE the sort+limit — not to
                     # every exemplar of every server
@@ -371,7 +377,43 @@ class UIServer:
                         limit = 10
                     rows = []
                     for s in active_servers():
-                        rows.extend(s.slow_requests(spans=False))
+                        for r in s.slow_requests(spans=False):
+                            r.setdefault("kind", "infer")
+                            rows.append(r)
+                        engine = getattr(s, "generation_engine", None)
+                        if engine is not None:
+                            rows.extend(
+                                engine.slow_streams(spans=False))
+                    rows.sort(key=lambda r: -r["latency_s"])
+                    rows = rows[:limit]
+                    t = tracer()
+                    if t.enabled:
+                        for r in rows:
+                            if r.get("trace"):
+                                r["spans"] = t.trace_chain(
+                                    int(r["trace"], 16)
+                                )
+                    self._json(rows)
+                elif u.path == "/api/generation/slow":
+                    # the generation plane's own exemplar view: slowest
+                    # streams only, with the six-segment queue/prefill/
+                    # handoff/decode_queue/decode_compute/sampling
+                    # breakdown, TTFT, and (tracing on) the full
+                    # cross-replica span chain
+                    from deeplearning4j_tpu.observe.trace import tracer
+                    from deeplearning4j_tpu.serving import active_servers
+
+                    q = parse_qs(u.query)
+                    try:
+                        limit = int(q.get("limit", ["10"])[0])
+                    except ValueError:
+                        limit = 10
+                    rows = []
+                    for s in active_servers():
+                        engine = getattr(s, "generation_engine", None)
+                        if engine is not None:
+                            rows.extend(
+                                engine.slow_streams(spans=False))
                     rows.sort(key=lambda r: -r["latency_s"])
                     rows = rows[:limit]
                     t = tracer()

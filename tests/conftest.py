@@ -31,3 +31,12 @@ jax.config.update("jax_default_matmul_precision", "float32")
 @pytest.fixture
 def rng():
     return np.random.default_rng(42)
+
+
+@pytest.fixture(autouse=True)
+def _crash_artifacts_dir(tmp_path, monkeypatch):
+    """Crash artifacts (hang reports, serving flight-recorder dumps) go
+    to tmp, never the repo cwd — watchdog aborts and SLO alerts write
+    post-mortem dumps by design now, including from tests that induce
+    them."""
+    monkeypatch.setenv("DL4JTPU_CRASH_DIR", str(tmp_path / "crash"))

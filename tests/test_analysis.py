@@ -374,6 +374,20 @@ class TestTier1Gate:
             "dl4jtpu_decode_batch_occupancy",
             "dl4jtpu_paged_attention_total",
         } <= fams
+        # ISSUE-17 generation-plane observability families
+        assert {
+            "dl4jtpu_generation_streams_admitted_total",
+            "dl4jtpu_generation_streams_total",
+            "dl4jtpu_generation_queue_seconds",
+            "dl4jtpu_generation_prefill_seconds",
+            "dl4jtpu_generation_handoff_seconds",
+            "dl4jtpu_generation_decode_queue_seconds",
+            "dl4jtpu_generation_decode_compute_seconds",
+            "dl4jtpu_generation_sampling_seconds",
+            "dl4jtpu_generation_tokens_per_s",
+            "dl4jtpu_flight_records",
+            "dl4jtpu_flight_dumps_total",
+        } <= fams
         sites = load_fault_sites(REPO)
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",

@@ -126,6 +126,23 @@ def test_serving_fleet_bench_quick_run_and_schema():
     assert chaos["replicas_ever_on_bad_weights"] <= 1
     assert chaos["good_deploy_installed_after"]
     assert chaos["post"]["ok"] > 0
+    # generation plane (timing-independent invariants): streams ran
+    # through the disaggregated fleet, every traced stream's chain is
+    # complete and causal, the TTFT burn alert fired AND cleared, the
+    # rising edge snapshotted the flight recorder, and the ring
+    # accounted for every settled stream
+    gen = out["generation"]
+    assert gen["streams"]["ok"] > 0
+    tr = gen["trace"]
+    assert tr["streams_traced"] > 0
+    assert tr["complete_causal_chains"] == tr["streams_traced"]
+    slo = gen["slo"]
+    assert slo["ttft_alert_fired"] and slo["ttft_alert_cleared"]
+    assert slo["objectives"]["generation_ttft_p95"]["alerts_total"] >= 1
+    fl = gen["flight"]
+    assert fl["slo_alert_dumped"]
+    assert fl["all_settled_recorded"] or fl["records"] == 256
+    assert gen["completed"]
 
 
 @pytest.mark.quant
@@ -239,7 +256,11 @@ def test_committed_serving_fleet_table_meets_acceptance():
     ISSUE 12 acceptance: the chaos run (one replica hard-killed
     mid-traffic + one torn canary deploy under load) completed with
     every request accounted, the torn deploy rolled back with at most
-    one replica ever on bad weights, and post-chaos p99 <= 2x."""
+    one replica ever on bad weights, and post-chaos p99 <= 2x.  Plus
+    the ISSUE 17 acceptance: a 2-replica disaggregated generation run
+    under an induced decode stall with one complete cross-replica span
+    chain per stream, a TTFT burn-rate alert that fired and cleared,
+    and a flight dump accounting for the admitted streams."""
     path = os.path.join(REPO, "BENCH_SERVING_FLEET.json")
     assert os.path.exists(path), "BENCH_SERVING_FLEET.json not committed"
     with open(path) as f:
@@ -257,6 +278,19 @@ def test_committed_serving_fleet_table_meets_acceptance():
     assert chaos["replicas_ever_on_bad_weights"] <= 1
     assert chaos["good_deploy_installed_after"]
     assert chaos["p99_post_ratio"] <= 2.0
+    gen = doc["generation"]
+    assert gen["completed"]
+    assert gen["roles"] == ["prefill", "decode"]
+    assert gen["trace"]["complete_causal_chains"] \
+        == gen["trace"]["streams_traced"] > 0
+    assert gen["ttft_ms"]["p95"] is not None
+    assert gen["healthy_tokens_per_s"] > 0
+    assert gen["slo"]["ttft_alert_fired"]
+    assert gen["slo"]["ttft_alert_cleared"]
+    assert gen["flight"]["slo_alert_dumped"]
+    assert gen["flight"]["last_dump"]["trigger"] in (
+        "slo_alert", "kv_exhausted_spike", "watchdog_abort",
+        "breaker_open")
 
 
 def test_committed_serving_table_meets_acceptance():

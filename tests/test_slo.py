@@ -50,7 +50,13 @@ class TestObjectives:
 
     def test_unknown_kind_rejected(self):
         with pytest.raises(ValueError):
+            SLObjective(name="x", target=0.9, kind="saturation")
+
+    def test_throughput_needs_a_positive_floor(self):
+        with pytest.raises(ValueError):
             SLObjective(name="x", target=0.9, kind="throughput")
+        with pytest.raises(ValueError):
+            SLObjective.throughput("x", target=0.9, floor_per_s=0.0)
 
     def test_duplicate_names_rejected(self):
         o = SLObjective.availability("a", target=0.9)
@@ -173,6 +179,157 @@ class TestBurnRates:
         assert c.sum_series(outcome="ok") == 5
         assert c.sum_series(route="a") == 4
         assert c.sum_series(outcome="error", route="a") == 1
+
+
+# -- throughput objectives (ISSUE 17) ----------------------------------------
+
+
+class TestThroughputBurn:
+    """The generation-plane rate floor: burn is the fractional deficit
+    below floor_per_s over the budget, gated by demand so a quiet
+    replica never pages."""
+
+    def _tp(self, reg):
+        clock = FakeClock()
+        eng = SLOEngine(
+            [SLObjective.throughput(
+                "tps", target=0.95, floor_per_s=100.0,
+                family="t_tokens_total",
+                demand_family="t_admitted_total")],
+            windows=WINDOWS, clock=clock, registry=reg,
+        )
+        return eng, clock
+
+    def test_meeting_the_floor_burns_zero(self):
+        reg = MetricsRegistry()
+        tok = reg.counter("t_tokens_total")
+        adm = reg.counter("t_admitted_total")
+        eng, clock = self._tp(reg)
+        for t in range(0, 70, 5):
+            clock.t = float(t)
+            tok.inc(500)                      # 100 tokens/s
+            adm.inc()
+            st = eng.sample()["tps"]
+        assert st["kind"] == "throughput"
+        assert st["burn"] == {"10s": 0.0, "60s": 0.0}
+        assert not st["alert"]
+        assert st["floor_per_s"] == 100.0
+        assert st["rate_per_s"] == pytest.approx(100.0)
+        assert st["budget_remaining"] == 1.0
+
+    def test_idle_burns_zero(self):
+        """No work AND no fresh demand = idle, not an outage."""
+        reg = MetricsRegistry()
+        reg.counter("t_tokens_total")
+        reg.counter("t_admitted_total")
+        eng, clock = self._tp(reg)
+        for t in (0.0, 30.0, 120.0):
+            clock.t = t
+            st = eng.sample()["tps"]
+        assert st["burn"] == {"10s": 0.0, "60s": 0.0}
+        assert not st["alert"]
+
+    def test_half_floor_burns_half_deficit_over_budget(self):
+        reg = MetricsRegistry()
+        tok = reg.counter("t_tokens_total")
+        adm = reg.counter("t_admitted_total")
+        eng, clock = self._tp(reg)
+        for t in range(0, 15, 5):
+            clock.t = float(t)
+            tok.inc(250)                      # 50 tokens/s = half floor
+            adm.inc()
+            st = eng.sample()["tps"]
+        # deficit 0.5 over budget 0.05 = burn 10
+        assert st["burn"]["10s"] == pytest.approx(10.0, rel=0.05)
+
+    def test_stall_under_demand_fires_and_clears(self):
+        """The acceptance shape for tokens/s: decode stalls while
+        admissions continue -> the alert fires within one fast window;
+        tokens resume at the floor -> it clears."""
+        reg = MetricsRegistry()
+        tok = reg.counter("t_tokens_total")
+        adm = reg.counter("t_admitted_total")
+        eng, clock = self._tp(reg)
+        for t in range(0, 60, 5):                  # healthy baseline
+            clock.t = float(t)
+            tok.inc(500)
+            adm.inc()
+            eng.sample()
+        fired_at = None
+        for t in range(60, 120, 2):                # stall, demand holds
+            clock.t = float(t)
+            adm.inc()
+            if eng.sample()["tps"]["alert"] and fired_at is None:
+                fired_at = t
+        assert fired_at is not None
+        assert fired_at - 60 <= WINDOWS[0].seconds + 2
+        cleared_at = None
+        for t in range(120, 200, 2):               # recovery at floor
+            clock.t = float(t)
+            tok.inc(200)                           # 100/s
+            adm.inc()
+            if not eng.sample()["tps"]["alert"] and cleared_at is None:
+                cleared_at = t
+        assert cleared_at is not None
+        assert cleared_at - 120 <= WINDOWS[0].seconds + 2
+        assert eng.state()["tps"]["alerts_total"] == 1
+
+
+# -- alert listeners (ISSUE 17) ----------------------------------------------
+
+
+class TestAlertListeners:
+    def test_listener_fires_on_rising_edge_only(self):
+        from deeplearning4j_tpu.observe import slo as slo_mod
+
+        reg = MetricsRegistry()
+        c = reg.counter("t_requests_total")
+        eng, clock = _engine(reg)
+        calls = []
+
+        def listener(name, state):
+            calls.append((name, state["alert"]))
+
+        slo_mod.add_alert_listener(listener)
+        try:
+            for t in range(0, 60, 5):
+                clock.t = float(t)
+                c.inc(100, outcome="ok")
+                eng.sample()
+            assert calls == []
+            for t in range(60, 120, 2):            # sustained errors
+                clock.t = float(t)
+                c.inc(100, outcome="error")
+                eng.sample()
+        finally:
+            slo_mod.remove_alert_listener(listener)
+        assert calls == [("avail", True)]          # one edge, one call
+        # removed listeners stay silent on later edges
+        for t in range(120, 180, 2):
+            clock.t = float(t)
+            c.inc(100, outcome="ok")
+            eng.sample()
+        assert len(calls) == 1
+
+    def test_broken_listener_does_not_break_the_tick(self):
+        from deeplearning4j_tpu.observe import slo as slo_mod
+
+        reg = MetricsRegistry()
+        c = reg.counter("t_requests_total")
+        eng, clock = _engine(reg)
+
+        def bad_listener(name, state):
+            raise RuntimeError("boom")
+
+        slo_mod.add_alert_listener(bad_listener)
+        try:
+            for t in range(0, 120, 5):
+                clock.t = float(t)
+                c.inc(100, outcome="error")
+                st = eng.sample()                  # must not raise
+        finally:
+            slo_mod.remove_alert_listener(bad_listener)
+        assert st["avail"]["alert"]
 
 
 # -- exposition + lifecycle --------------------------------------------------
