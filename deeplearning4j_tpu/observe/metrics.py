@@ -801,6 +801,25 @@ def _declare_core(reg: MetricsRegistry) -> None:
                 "Flight-recorder post-mortem dumps written, by "
                 "trigger (watchdog_abort / breaker_open / "
                 "kv_exhausted_spike / slo_alert)")
+    # speculative decoding (serving/speculative.py drafters + the
+    # generation engine's verify-once dispatch)
+    reg.counter("dl4jtpu_spec_tokens_total",
+                "Speculative-decode token flow by kind: drafted "
+                "(proposed by the stream's drafter), accepted (draft "
+                "tokens the verify pass confirmed and emitted), "
+                "rejected (drafted - accepted), bonus (the corrected "
+                "sample at the first mismatch, or the extra sample "
+                "after an all-accepted chunk)")
+    reg.gauge("dl4jtpu_spec_acceptance_ratio",
+              "Cumulative accepted/drafted over the engine's life "
+              "(0.0 until anything is drafted) — the rate the "
+              "committed bench speedup is quoted at")
+    reg.histogram("dl4jtpu_spec_tokens_per_dispatch",
+                  "Tokens emitted per verify-once dispatch, summed "
+                  "over the dispatch's live streams (each contributes "
+                  "1..spec_k+1: its accepted prefix plus the "
+                  "corrected/bonus sample) — the distribution behind "
+                  "the speculative speedup")
 
 
 def _compile_stats_collector() -> None:

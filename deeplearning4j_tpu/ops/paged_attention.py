@@ -225,6 +225,84 @@ def _pallas_paged_attention(q, k_pages, v_pages, page_tbl, seq_lens,
 
 # -- dispatch ---------------------------------------------------------------
 
+def _xla_paged_attention_chunk(q, k_pages, v_pages, page_tbl,
+                               attend_lens, k_scale=None, v_scale=None):
+    """Chunk-native gather-then-attend: each slot's pages are gathered
+    ONCE and all C chunk queries attend against that view — C× less
+    gather traffic than expanding to S*C pseudo-slots, which is what
+    makes the verify dispatch cheap relative to C plain steps on the
+    gather-bound CPU path.  Per-row numerics are `_xla_paged_attention`
+    exactly (f32 einsum scores over the same contraction, -inf mask,
+    f32 softmax), just batched over the chunk dim."""
+    dh = q.shape[-1]
+    k = _gather_pages(k_pages, page_tbl).astype(jnp.float32)
+    v = _gather_pages(v_pages, page_tbl).astype(jnp.float32)
+    if k_scale is not None:
+        k = k * _gather_pages(k_scale, page_tbl)[..., None]
+    if v_scale is not None:
+        v = v * _gather_pages(v_scale, page_tbl)[..., None]
+    ell = k.shape[1]
+    scores = jnp.einsum(
+        "schd,slhd->schl", q.astype(jnp.float32), k
+    ) / np.sqrt(dh)
+    live = (jnp.arange(ell)[None, None, None, :]
+            < attend_lens[:, :, None, None])
+    scores = jnp.where(live, scores, -jnp.inf)
+    p = jax.nn.softmax(scores, axis=-1)
+    # idle chunk rows (attend_len 0) softmax -inf rows into nans
+    p = jnp.where(attend_lens[:, :, None, None] > 0, p, 0.0)
+    return jnp.einsum("schl,slhd->schd", p, v)
+
+
+def paged_attention_chunk(q, k_pages, v_pages, page_tbl, attend_lens, *,
+                          k_scale=None, v_scale=None,
+                          impl: str | None = None,
+                          interpret: bool | None = None):
+    """Speculative verify-once attention: a C-token CHUNK per slot
+    against the same paged K/V pool.
+
+    ``q``: (S, C, H, Dh) — chunk position ``j`` of slot ``s`` is the
+    query at sequence position ``seq_len + j``; ``attend_lens``:
+    (S, C) int32 live positions PER CHUNK POSITION (causality inside
+    the chunk is expressed as ``attend_lens[s, j] = seq_len + j + 1``
+    with all C K/V rows pre-written by the caller — row ``j`` sees
+    exactly the prefix the plain decode step would have seen after
+    ``j`` sequential steps).  Idle slots carry ``attend_lens == 0``.
+
+    Two routes, same per-row numerics as the 1-query path (which is
+    what keeps speculative greedy decode token-identical to plain
+    decode):
+
+    - ``xla`` — the chunk-native gather reference: one page gather per
+      slot shared by all C queries (`_xla_paged_attention_chunk`).
+    - ``pallas`` — pseudo-slot expansion: the page table row repeats C
+      times, the lens flatten, and the chunk rides the REGULAR
+      `paged_attention` kernel dispatch (int8 variants included) — no
+      new kernel, the grid just sees S*C slots.
+
+    Returns (S, C, H, Dh) f32.
+    """
+    s, c, h, dh = q.shape
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("int8 pages need BOTH k_scale and v_scale")
+    chosen = impl or select_impl()
+    if chosen == "xla":
+        _count_selection("xla_chunk_int8" if quant else "xla_chunk")
+        return _xla_paged_attention_chunk(
+            q, k_pages, v_pages, page_tbl, attend_lens,
+            k_scale=k_scale, v_scale=v_scale,
+        )
+    out = paged_attention(
+        q.reshape(s * c, h, dh),
+        k_pages, v_pages,
+        jnp.repeat(page_tbl, c, axis=0),
+        attend_lens.reshape(s * c),
+        k_scale=k_scale, v_scale=v_scale, impl=impl, interpret=interpret,
+    )
+    return out.reshape(s, c, h, dh)
+
+
 def paged_attention(q, k_pages, v_pages, page_tbl, seq_lens, *,
                     k_scale=None, v_scale=None,
                     impl: str | None = None,

@@ -46,6 +46,10 @@ Sites wired today:
                          decode step (``raise`` ⇒ a failed step that
                          fails every in-flight stream; ``delay`` ⇒ a
                          wedged step under the generation watchdog)
+  ``serving.draft``      the speculative drafter, per drafting stream
+                         (``raise`` ⇒ that stream falls back to plain
+                         decode for good; ``corrupt`` ⇒ garbage drafts
+                         that must all be rejected, output unchanged)
   ``kv.alloc``           PagedKVCache page allocation (``raise`` ⇒
                          injected pool exhaustion ⇒ an explicit
                          kv_exhausted 429)
@@ -138,6 +142,12 @@ SITES: dict = {
                       "fails every in-flight stream and releases "
                       "their pages; 'delay' = a wedged step under "
                       "the generation watchdog)",
+    "serving.draft": "the speculative drafter, once per drafting "
+                     "stream per step ('raise' = the stream's drafter "
+                     "latches OFF and it falls back to plain decode, "
+                     "overhang pages truncated; 'corrupt' = garbage "
+                     "drafts the verify pass must fully reject with "
+                     "output unchanged)",
     "kv.alloc": "PagedKVCache page allocation ('raise' = injected "
                 "pool exhaustion — the request is rejected with an "
                 "explicit kv_exhausted 429, never a silent stall)",

@@ -181,6 +181,7 @@ class ServingFleet:
     def generate(self, prompt, max_new_tokens: Optional[int] = None, *,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                  stop_tokens: tuple = (), on_token=None,
+                 spec_k: Optional[int] = None,
                  timeout: Optional[float] = 120.0) -> np.ndarray:
         """One disaggregated stream through the fleet: the router picks
         a PREFILL-role replica (least pressure, KV occupancy included)
@@ -206,7 +207,7 @@ class ServingFleet:
             prompt, max_new_tokens if max_new_tokens is not None
             else self.engines[h_pre.name].config.default_max_new,
             temperature=temperature, top_k=top_k, seed=seed,
-            stop_tokens=stop_tokens, trace_ctx=ctx,
+            stop_tokens=stop_tokens, spec_k=spec_k, trace_ctx=ctx,
         )
         h_dec = self.router.pick_for_role("decode", trace_ctx=ctx)
         log.debug("fleet generate: prefill on %s, decode on %s",

@@ -79,7 +79,10 @@ from deeplearning4j_tpu.ops.generation import (
     _pe_row,
     _plan,
 )
-from deeplearning4j_tpu.ops.paged_attention import paged_attention
+from deeplearning4j_tpu.ops.paged_attention import (
+    paged_attention,
+    paged_attention_chunk,
+)
 from deeplearning4j_tpu.runtime import faults
 from deeplearning4j_tpu.runtime.flags import bucket_length
 from deeplearning4j_tpu.runtime.watchdog import StepWatchdog
@@ -96,6 +99,7 @@ from deeplearning4j_tpu.serving.kv_cache import (
     PagedKVCache,
     quantize_page_rows,
 )
+from deeplearning4j_tpu.serving import speculative
 
 log = logging.getLogger("deeplearning4j_tpu")
 
@@ -149,6 +153,13 @@ class GenerationConfig:
     watchdog_cold_floor_s: float = 600.0
     watchdog_k: float = 10.0
     poll_s: float = 0.02           # idle-queue poll granularity
+    # speculative decoding (serving/speculative.py): draft length per
+    # stream per step (0 = off; None = DL4J_TPU_SPEC_K), the drafter
+    # (None = DL4J_TPU_SPEC_DRAFTER, default "ngram"), and the small
+    # zoo model the "model" drafter decodes with
+    spec_k: Optional[int] = None
+    spec_drafter: Optional[str] = None
+    spec_draft_model: object = None
 
 
 class GenerationRequest:
@@ -164,13 +175,19 @@ class GenerationRequest:
                  # observability riders (engine-written; see _finish):
                  # trace linkage, latency-segment dict, fate bookkeeping
                  "trace_id", "root_span", "root_parent", "lat",
-                 "outcome", "trace_done", "t_offer", "t_slot", "pages")
+                 "outcome", "trace_done", "t_offer", "t_slot", "pages",
+                 # speculative decode: per-request draft-length override
+                 # (None = engine default, 0 = off for this stream),
+                 # the mid-stream fallback latch, and acceptance counts
+                 "spec_k", "spec_disabled", "spec_drafted",
+                 "spec_accepted")
 
     _next = [0]
 
     def __init__(self, prompt: np.ndarray, max_new: int, *,
                  temperature: float = 0.0, top_k: int = 0, seed: int = 0,
-                 stop_tokens: tuple = (), on_token=None, prefilled=None):
+                 stop_tokens: tuple = (), on_token=None, prefilled=None,
+                 spec_k: Optional[int] = None):
         GenerationRequest._next[0] += 1
         self.rid = f"gen-{GenerationRequest._next[0]}"
         self.prompt = np.asarray(prompt, np.int32).reshape(-1)
@@ -197,6 +214,10 @@ class GenerationRequest:
         self.t_offer: Optional[float] = None
         self.t_slot: Optional[float] = None
         self.pages = 0                 # KV pages held at admission
+        self.spec_k = None if spec_k is None else max(0, int(spec_k))
+        self.spec_disabled = False
+        self.spec_drafted = 0
+        self.spec_accepted = 0
         self._event = threading.Event()
         self._lock = threading.Lock()
 
@@ -357,6 +378,26 @@ class GenerationEngine:
         self._tokens_out = 0
         self._step_fn = None
         self._prefill_fns: dict[int, Callable] = {}
+        # speculative decode: resolve the engine-wide draft length and
+        # drafter once (env knobs DL4J_TPU_SPEC_K/DL4J_TPU_SPEC_DRAFTER,
+        # overridden by explicit config fields); spec_k == 0 keeps the
+        # whole path disabled and the verify program never built
+        k = (cfg.spec_k if cfg.spec_k is not None
+             else speculative.spec_k_from_env(0))
+        self.spec_k = max(0, int(k))
+        self.drafter: Optional[speculative.DraftSource] = None
+        if self.spec_k > 0:
+            self.drafter = speculative.make_drafter(
+                cfg.spec_drafter or speculative.drafter_from_env(),
+                draft_model=cfg.spec_draft_model,
+            )
+        self._verify_fn = None
+        self._vocab = int(
+            self.model.params[self._embed_name]["W"].shape[0])
+        self._spec_counts = {"drafted": 0, "accepted": 0, "rejected": 0,
+                             "bonus": 0, "emitted": 0,
+                             "verify_dispatches": 0,
+                             "plain_dispatches": 0, "fallbacks": 0}
         # observability: trace recorder handle, slow-stream exemplar
         # ring, breakdown totals, and the flight recorder with its
         # SLO-alert rising-edge trigger (detached at stop())
@@ -407,18 +448,23 @@ class GenerationEngine:
     def submit(self, prompt, max_new_tokens: Optional[int] = None, *,
                temperature: float = 0.0, top_k: int = 0, seed: int = 0,
                stop_tokens: tuple = (), on_token=None,
-               trace_ctx=None) -> GenerationRequest:
+               trace_ctx=None, spec_k: Optional[int] = None,
+               ) -> GenerationRequest:
         """Admit one stream.  Raises `ServingRejected` on a full queue
         or an open breaker; over-capacity streams (longer than the page
         table can hold) are client errors (`ValueError`).  `trace_ctx`
         is an upstream ``(trace_id, root_span)`` pair (the fleet's
         routed path allocates one so the router pick joins the stream
-        chain); None allocates fresh ids when tracing is on."""
+        chain); None allocates fresh ids when tracing is on.  `spec_k`
+        overrides the engine's speculative draft length for THIS stream
+        (0 = plain decode; capped at the engine's configured k — the
+        verify program's chunk width is static)."""
         max_new = int(max_new_tokens if max_new_tokens is not None
                       else self.config.default_max_new)
         req = GenerationRequest(
             prompt, max_new, temperature=temperature, top_k=top_k,
             seed=seed, stop_tokens=stop_tokens, on_token=on_token,
+            spec_k=spec_k,
         )
         self._validate(req)
         self._init_trace(req, trace_ctx)
@@ -508,7 +554,8 @@ class GenerationEngine:
     def prefill_detached(self, prompt, max_new_tokens: int, *,
                          temperature: float = 0.0, top_k: int = 0,
                          seed: int = 0, stop_tokens: tuple = (),
-                         trace_ctx=None) -> dict:
+                         trace_ctx=None, spec_k: Optional[int] = None,
+                         ) -> dict:
         """Run ONLY the prefill program here and return a portable
         handoff (prompt K/V rows as host arrays + the first token + the
         stream's sampling state).  A decode-role replica resumes the
@@ -542,6 +589,8 @@ class GenerationEngine:
             "prefill_s": pre_s,
             "t_done_pc": time.perf_counter(),
         }
+        if spec_k is not None:
+            out["spec_k"] = max(0, int(spec_k))
         if req.trace_id is not None:
             out["trace"] = (req.trace_id, req.root_span)
         return out
@@ -557,6 +606,7 @@ class GenerationEngine:
             temperature=handoff["temperature"], top_k=handoff["top_k"],
             seed=handoff["seed"], stop_tokens=handoff["stop_tokens"],
             on_token=on_token, prefilled=handoff,
+            spec_k=handoff.get("spec_k"),
         )
         req.t_submit = handoff.get("t_submit", req.t_submit)
         self._validate(req)
@@ -697,6 +747,124 @@ class GenerationEngine:
 
         return step
 
+    def _make_verify(self):
+        """The speculative verify-once program: ONE dispatch scores a
+        C = spec_k + 1 token chunk per slot (the stream's last token
+        plus its k draft proposals) through the SAME paged pool the
+        plain step uses — shaped like a short prefill, compiled once,
+        so speculation never grows the program set.
+
+        Chunk row ``j`` of slot ``s`` writes K/V at sequence position
+        ``seq_len + j`` and attends positions ``< seq_len + j + 1``
+        (all C rows are written before the chunk attends; masking in
+        `paged_attention_chunk` expresses the in-chunk causality), so
+        its logits are bit-equal to what ``j`` sequential plain steps
+        over the same tokens would produce.  Row ``j``'s token is
+        sampled with the baseline key ``fold_in(key(seed),
+        gen_count + j)`` — the exact `_slot_keys` schedule — which is
+        what makes the harvested accept-prefix + corrected/bonus token
+        BYTE-identical to plain decode at any temperature, not merely
+        distribution-identical."""
+        embed, pos, blocks, head = self._stack
+        pos_name, head_name = self._pos_name, self._head_name
+        block_names, embed_name = self._block_names, self._embed_name
+        d, ps = self._d, self.kv.page_size
+        h_, dh = self._n_heads, self._head_dim
+        quant = self.kv.kv_dtype == "int8"
+        impl = self.config.attention_impl
+        interp = self.config.attention_interpret
+        n_slots = self.config.slots
+        mp = self.config.max_pages_per_seq
+        c = self.spec_k + 1
+        cap = mp * ps
+
+        @jax.jit
+        def verify(params, k_pages, v_pages, k_scales, v_scales,
+                   page_tbl, seq_lens, chunk_toks, seeds, gen_counts,
+                   temps, top_ks):
+            dt = jnp.bfloat16 if self.model._bf16 else jnp.float32
+            n = n_slots * c
+            active = seq_lens > 0
+            act_r = jnp.repeat(active, c)
+            # flattened (S*C, ...) throughout so every matmul keeps the
+            # plain step's 2-D shape (only M grows, S -> S*C)
+            pos2 = seq_lens[:, None] + jnp.arange(c)[None, :]
+            pos_idx = pos2.reshape(n)
+            E = params[embed_name]["W"].astype(dt)
+            x_t = embed._act()(E[chunk_toks.reshape(n)])
+            pe = jax.vmap(
+                lambda t: _pe_row(pos, params.get(pos_name, {}), t, d)
+            )(pos_idx)
+            x_t = x_t + pe.astype(dt)
+            # write guard: a row past the table capacity lands on the
+            # scratch page — NEVER index-clamp into a real page, that
+            # would clobber a live row; rows within capacity but past
+            # the allocated table hit entries that are already
+            # SCRATCH_PAGE.  Accepted rows always fit (emit <= the
+            # admission-funded budget), so only rejected-tail garbage
+            # ever spills.
+            tbl_rep = jnp.repeat(page_tbl, c, axis=0)
+            write_ok = pos_idx < cap
+            page_of = jnp.where(
+                write_ok,
+                tbl_rep[jnp.arange(n),
+                        jnp.minimum(pos_idx // ps, mp - 1)],
+                SCRATCH_PAGE,
+            )
+            row_of = jnp.where(write_ok, pos_idx % ps, 0)
+            attend = jnp.where(active[:, None],
+                               jnp.minimum(pos2 + 1, cap), 0)
+            for li, (cfg_b, nm) in enumerate(zip(blocks, block_names)):
+                lp = params[nm]
+                ap = lp["attn"]
+                hh = _ln(lp["ln1"], x_t)
+                q = (hh @ ap["Wq"].astype(dt)).reshape(n, h_, dh)
+                k_t = (hh @ ap["Wk"].astype(dt)).reshape(n, h_, dh)
+                v_t = (hh @ ap["Wv"].astype(dt)).reshape(n, h_, dh)
+                qc = q.astype(jnp.float32).reshape(n_slots, c, h_, dh)
+                if quant:
+                    kq, ksc = quantize_page_rows(k_t)
+                    vq, vsc = quantize_page_rows(v_t)
+                    k_pages = k_pages.at[li, page_of, row_of].set(kq)
+                    v_pages = v_pages.at[li, page_of, row_of].set(vq)
+                    k_scales = k_scales.at[li, page_of, row_of].set(ksc)
+                    v_scales = v_scales.at[li, page_of, row_of].set(vsc)
+                    attn = paged_attention_chunk(
+                        qc, k_pages[li], v_pages[li], page_tbl, attend,
+                        k_scale=k_scales[li], v_scale=v_scales[li],
+                        impl=impl, interpret=interp,
+                    )
+                else:
+                    k_pages = k_pages.at[li, page_of, row_of].set(
+                        k_t.astype(k_pages.dtype))
+                    v_pages = v_pages.at[li, page_of, row_of].set(
+                        v_t.astype(v_pages.dtype))
+                    attn = paged_attention_chunk(
+                        qc, k_pages[li], v_pages[li], page_tbl, attend,
+                        impl=impl, interpret=interp,
+                    )
+                out = attn.reshape(n, h_ * dh).astype(dt)
+                x_t = x_t + out @ ap["Wo"].astype(dt)
+                hh = _ln(lp["ln2"], x_t)
+                hh = cfg_b.ffn_activation(
+                    hh @ lp["W1"].astype(dt) + lp["b1"].astype(dt))
+                x_t = x_t + (hh @ lp["W2"].astype(dt)
+                             + lp["b2"].astype(dt))
+            logits = _head_logits(head, params[head_name], x_t)
+            keys = _slot_keys(
+                jnp.repeat(seeds, c),
+                (gen_counts[:, None] + jnp.arange(c)[None, :]).reshape(n),
+            )
+            nxt = jax.vmap(_sample_token)(
+                logits.astype(jnp.float32), jnp.repeat(temps, c),
+                jnp.repeat(top_ks, c), keys,
+            )
+            nxt = jnp.where(act_r, nxt, 0)
+            return (k_pages, v_pages, k_scales, v_scales,
+                    nxt.reshape(n_slots, c))
+
+        return verify
+
     # -- the decode loop ---------------------------------------------------
     def _loop(self, my_gen: int) -> None:
         try:
@@ -782,6 +950,14 @@ class GenerationEngine:
                 log.debug("kv spike note failed: %s", e)
             return
         req.pages = self.kv.pages_for(span)
+        if self._req_spec_k(req) > 0:
+            # best-effort overhang so draft rows land in real pages;
+            # a short pool (or a full page table) just means drafts
+            # spill to scratch-masked rows (correct, slightly
+            # wasteful) — never a 429
+            table_cap = self.config.max_pages_per_seq * self.kv.page_size
+            self.kv.reserve_speculative(
+                req.rid, min(span + self.spec_k, table_cap))
         try:
             if req.prefilled is None:
                 faults.maybe_fail("serving.prefill")
@@ -852,6 +1028,17 @@ class GenerationEngine:
         except Exception as exc:
             self._step_failed(my_gen, exc)
             return
+        if self.drafter is not None:
+            drafts = self._gather_drafts(my_gen)
+            if drafts is not None:
+                self._verify_step(my_gen, drafts)
+                return
+            # nothing drafted (cold streams, rejection streak, per-
+            # request opt-outs, fault fallback): ride the plain
+            # one-token program — both programs are warm, so the mix
+            # never compiles
+            with self._stats_lock:
+                self._spec_counts["plain_dispatches"] += 1
         if self._step_fn is None:
             self._step_fn = self._make_step()
         with self._mu:
@@ -939,6 +1126,245 @@ class GenerationEngine:
                 self._finish(req, "cancelled",
                              ServingRejected("shutdown", "cancelled"))
         self._gauge_occupancy()
+
+    # -- speculative decode ------------------------------------------------
+    def _req_spec_k(self, req: GenerationRequest) -> int:
+        """Effective draft length for one stream: the engine's k,
+        optionally lowered per request, zeroed by the fault-fallback
+        latch.  Never above the engine k — the verify program's chunk
+        width is static."""
+        if self.drafter is None or req.spec_disabled:
+            return 0
+        k = (self.spec_k if req.spec_k is None
+             else min(req.spec_k, self.spec_k))
+        return max(0, k)
+
+    def _gather_drafts(self, my_gen: int) -> Optional[list]:
+        """Collect draft proposals for every live slot (engine thread,
+        between dispatches).  Returns a per-slot list of int32 arrays,
+        or None when no stream drafted — the caller falls back to the
+        plain one-token program.  The ``serving.draft`` fault site is
+        consulted once per drafting stream: ``raise`` latches the
+        stream's drafter OFF for the rest of its life (plain decode,
+        overhang pages truncated back); ``corrupt`` swaps the proposal
+        for deterministic garbage the verify pass must reject with
+        output unchanged."""
+        with self._mu:
+            if self._loop_gen != my_gen:
+                return None
+            live = list(enumerate(self._slot_req))
+            gens = self._gen_counts.copy()
+        drafts: list = [None] * self.config.slots
+        any_draft = False
+        for s, req in live:
+            if req is None or req.cancelled:
+                continue
+            # drafting past the remaining budget is pure waste: the
+            # harvest caps emitted tokens at max_new anyway
+            k = min(self._req_spec_k(req),
+                    req.max_new - int(gens[s]) - 1)
+            if k <= 0:
+                continue
+            try:
+                action = faults.maybe_fail("serving.draft")
+            except Exception as exc:
+                log.warning("drafter disabled for %s: %s", req.rid, exc)
+                self._disable_spec(s, req)
+                continue
+            hist = np.concatenate(
+                [req.prompt, np.asarray(req.tokens_so_far(), np.int32)])
+            if action == "corrupt":
+                # deterministic garbage, independent of the real
+                # drafter: rejection sampling must shrug it off
+                d = (int(hist[-1]) + 1
+                     + np.arange(k, dtype=np.int32) * 17) % self._vocab
+                d = d.astype(np.int32)
+            else:
+                try:
+                    d = np.asarray(self.drafter.draft(hist, k),
+                                   np.int32).reshape(-1)[:k]
+                except Exception as exc:
+                    log.warning("drafter failed for %s: %s",
+                                req.rid, exc)
+                    self._disable_spec(s, req)
+                    continue
+            if d.size:
+                drafts[s] = d
+                any_draft = True
+        return drafts if any_draft else None
+
+    def _disable_spec(self, s: int, req: GenerationRequest) -> None:
+        """Latch one stream to plain decode (the mid-stream fallback)
+        and give back its speculative overhang pages — the
+        truncate-on-reject rollback, so a disabled drafter can't leak
+        reserved capacity for the stream's remaining life."""
+        req.spec_disabled = True
+        with self._stats_lock:
+            self._spec_counts["fallbacks"] += 1
+        freed = self.kv.truncate_to(req.rid,
+                                    req.pages * self.kv.page_size)
+        if freed:
+            with self._mu:
+                if self._slot_req[s] is req:
+                    self._page_tbl[s, req.pages:] = SCRATCH_PAGE
+
+    def _verify_step(self, my_gen: int, drafts: list) -> None:
+        """One verify-once dispatch: score the (spec_k + 1)-token chunk
+        for every live slot, then emit each stream's accepted draft
+        prefix plus the corrected/bonus sample — 1..k+1 tokens per
+        stream, byte-identical to sequential plain decode.  Mirrors
+        `_decode_step`'s structure (fault consult already happened);
+        the watchdog arms with the chunk width so the EWMA deadline
+        stays per-token-normalized."""
+        if self._verify_fn is None:
+            self._verify_fn = self._make_verify()
+        c = self.spec_k + 1
+        n_slots = self.config.slots
+        with self._mu:
+            if self._loop_gen != my_gen:
+                return
+            chunk = np.zeros((n_slots, c), np.int32)
+            chunk[:, 0] = self._last_tok
+            dl = np.zeros(n_slots, np.int32)
+            for s in range(n_slots):
+                d = drafts[s]
+                if d is None or d.size == 0:
+                    continue
+                m = min(int(d.size), self.spec_k)
+                chunk[s, 1:1 + m] = d[:m]
+                dl[s] = m
+            gen0 = self._gen_counts.copy()
+            args = (self._page_tbl.copy(), self._seq_lens.copy(),
+                    chunk, self._seeds.copy(), gen0,
+                    self._temps.copy(), self._top_ks.copy())
+        with self._weights_lock:
+            params = self.model.params
+        self._steps += 1
+        self.watchdog.arm(self._steps, n_steps=c)
+        t0 = time.perf_counter()
+        try:
+            out = self._verify_fn(
+                params, self.kv.k_pages, self.kv.v_pages,
+                self.kv.k_scales, self.kv.v_scales, *args,
+            )
+            tgt = np.asarray(out[4])
+        except Exception as exc:
+            self.watchdog.disarm(None)
+            self._step_failed(my_gen, exc)
+            return
+        step_s = time.perf_counter() - t0
+        self.watchdog.disarm(step_s)
+        t_h0 = time.perf_counter()
+        sp = {"drafted": 0, "accepted": 0, "rejected": 0, "bonus": 0}
+        emitted_total = 0
+        with self._mu:
+            if self._loop_gen != my_gen:
+                return                     # wedged + respawned: stale
+            self.kv.k_pages, self.kv.v_pages = out[0], out[1]
+            self.kv.k_scales, self.kv.v_scales = out[2], out[3]
+            finished: list[tuple[GenerationRequest, bool]] = []
+            stepped: list[tuple[GenerationRequest, int, int]] = []
+            for s, req in enumerate(self._slot_req):
+                if req is None:
+                    continue
+                if req.cancelled:
+                    self._clear_slot(s)
+                    finished.append((req, False))
+                    continue
+                budget = req.max_new - int(gen0[s])
+                d_len = int(dl[s])
+                row = tgt[s]
+                # accept-prefix: row j's target sample IS what plain
+                # decode would emit at that position, so a match means
+                # the draft token was exactly right; the first
+                # mismatch's sample is the corrected token, an all-
+                # match chunk appends the bonus sample at row k
+                a = 0
+                while a < d_len and int(row[a]) == int(chunk[s, a + 1]):
+                    a += 1
+                emit = min(a + 1, budget)
+                toks = [int(row[j]) for j in range(emit)]
+                fin = False
+                for j, t in enumerate(toks):
+                    if t in req.stop_tokens:
+                        emit = j + 1
+                        toks = toks[:emit]
+                        fin = True
+                        break
+                for t in toks:
+                    req._record(t)
+                self._seq_lens[s] += emit
+                self._gen_counts[s] += emit
+                self._last_tok[s] = toks[-1]
+                accepted = min(emit, a)
+                sp["drafted"] += d_len
+                sp["accepted"] += accepted
+                sp["rejected"] += d_len - accepted
+                sp["bonus"] += emit - accepted
+                req.spec_drafted += d_len
+                req.spec_accepted += accepted
+                emitted_total += emit
+                stepped.append((req, int(self._gen_counts[s]), emit))
+                if self._gen_counts[s] >= req.max_new or fin:
+                    self._clear_slot(s)
+                    finished.append((req, True))
+            if stepped and self._rec.enabled:
+                rids = [r.rid for r, _, _ in stepped]
+                counts = {r.rid: n for r, n, _ in stepped}
+                emits = {r.rid: e for r, _, e in stepped}
+                for req, _, _ in stepped:
+                    self._trace_segment(
+                        req, "generation.decode_step", t0, step_s,
+                        step=self._steps, batch=rids,
+                        batch_tokens=counts, emitted=emits,
+                        speculative=True,
+                    )
+        samp_s = max(0.0, time.perf_counter() - t_h0)
+        for req, _, _ in stepped:
+            # same attribution semantics as the plain step: every co-
+            # resident stream is charged the full dispatch wall (the
+            # per-token view divides by tokens_generated in stats())
+            req.lat["decode_compute"] = (
+                req.lat.get("decode_compute", 0.0) + step_s)
+            req.lat["sampling"] = req.lat.get("sampling", 0.0) + samp_s
+        if self.breaker is not None:
+            self.breaker.record_success()
+        self._count_tokens(emitted_total)
+        self._count_spec(sp, emitted_total)
+        for req, ok in finished:
+            self.kv.release(req.rid)
+            if ok:
+                self._finish(req, "ok")
+            else:
+                self._finish(req, "cancelled",
+                             ServingRejected("shutdown", "cancelled"))
+        self._gauge_occupancy()
+
+    def _count_spec(self, sp: dict, emitted: int) -> None:
+        """One verify dispatch's speculative accounting: host counters
+        for stats() plus the pre-declared spec metric families."""
+        with self._stats_lock:
+            for kind, v in sp.items():
+                self._spec_counts[kind] += v
+            self._spec_counts["emitted"] += emitted
+            self._spec_counts["verify_dispatches"] += 1
+            drafted = self._spec_counts["drafted"]
+            ratio = (self._spec_counts["accepted"] / drafted
+                     if drafted else 0.0)
+        try:
+            from deeplearning4j_tpu.observe.metrics import registry
+
+            reg = registry()
+            ctr = reg.counter("dl4jtpu_spec_tokens_total")
+            for kind, v in sp.items():
+                if v:
+                    ctr.inc(v, kind=kind)
+            reg.gauge("dl4jtpu_spec_acceptance_ratio").set(
+                round(ratio, 4))
+            reg.histogram("dl4jtpu_spec_tokens_per_dispatch").observe(
+                emitted)
+        except Exception as e:
+            log.debug("spec metric failed: %s", e)
 
     def _clear_slot(self, s: int) -> None:
         """Caller holds self._mu.  Pages are released by the caller
@@ -1147,16 +1573,23 @@ class GenerationEngine:
             outcomes = dict(self._stream_outcomes)
             settled = self._streams_settled
             slow_n = len(self._slow)
+            spec = dict(self._spec_counts)
         total_s = sum(totals.values())
+        # per-token normalization: a speculative step emits 1..k+1
+        # tokens per dispatch, so cross-config comparisons read the
+        # seconds_per_token view, not raw segment walls
+        n_tok = max(1, self._tokens_out)
         breakdown = {
             k: {
                 "seconds_total": round(v, 6),
                 "fraction": (round(v / total_s, 4)
                              if total_s > 0 else 0.0),
+                "seconds_per_token": round(v / n_tok, 9),
             }
             for k, v in totals.items()
         }
-        return {
+        drafted = spec["drafted"]
+        out = {
             "slots": self.config.slots,
             "active_streams": active,
             "queue_depth": self.queue.depth,
@@ -1169,7 +1602,27 @@ class GenerationEngine:
             "flight": {"records": len(self.flight),
                        "dumps": self.flight.dumps_written},
             "kv": self.kv.stats(),
+            "speculative": {
+                "enabled": self.spec_k > 0,
+                "k": self.spec_k,
+                "drafter": (self.drafter.name
+                            if self.drafter is not None else None),
+                "drafted": drafted,
+                "accepted": spec["accepted"],
+                "rejected": spec["rejected"],
+                "bonus": spec["bonus"],
+                "acceptance_ratio": (
+                    round(spec["accepted"] / drafted, 4)
+                    if drafted else 0.0),
+                "verify_dispatches": spec["verify_dispatches"],
+                "plain_dispatches": spec["plain_dispatches"],
+                "tokens_per_dispatch": (
+                    round(spec["emitted"] / spec["verify_dispatches"], 4)
+                    if spec["verify_dispatches"] else 0.0),
+                "fallbacks": spec["fallbacks"],
+            },
         }
+        return out
 
     def health_summary(self) -> dict:
         """Compact generation block for `InferenceServer.health()` —
@@ -1180,7 +1633,9 @@ class GenerationEngine:
             active = sum(r is not None for r in self._slot_req)
         with self._stats_lock:
             outcomes = dict(self._stream_outcomes)
-        return {
+            drafted = self._spec_counts["drafted"]
+            accepted = self._spec_counts["accepted"]
+        out = {
             "active_streams": active,
             "queue_depth": self.queue.depth,
             "kv_occupancy": round(self.kv.occupancy(), 4),
@@ -1188,6 +1643,10 @@ class GenerationEngine:
             "stream_outcomes": outcomes,
             "flight_dumps": self.flight.dumps_written,
         }
+        if self.spec_k > 0:
+            out["spec_acceptance_ratio"] = (
+                round(accepted / drafted, 4) if drafted else 0.0)
+        return out
 
     def tokens_per_s(self) -> float:
         """Recent aggregate decode rate over the trailing rate-sample

@@ -13,7 +13,11 @@ server answers fast with a reason, never hangs the socket:
                     -> 500 dispatch failed (wedged / non-finite)
   POST /v1/generate {"prompt": [1, 7, 3], "max_new_tokens": 32,
                      "temperature": 0.8, "top_k": 40, "seed": 0,
-                     "stop_tokens": [2], "stream": false}
+                     "stop_tokens": [2], "stream": false,
+                     "spec_k": 2}   # optional per-request speculative
+                                    # draft length, capped at the
+                                    # engine's spec_k (0 = plain decode
+                                    # for this stream)
                     -> 200 {"tokens", "prompt_len", "ttft_ms",
                             "generation"}
                     -> 200 (stream=true) newline-delimited JSON chunks
@@ -175,6 +179,12 @@ class ServingHTTPServer:
                     seed=int(payload.get("seed", 0)),
                     stop_tokens=tuple(payload.get("stop_tokens", ())),
                 )
+                if payload.get("spec_k") is not None:
+                    try:
+                        kwargs["spec_k"] = int(payload["spec_k"])
+                    except (TypeError, ValueError) as exc:
+                        self._json({"error": f"bad spec_k: {exc}"}, 400)
+                        return
                 timeout = float(payload.get("timeout_s", 120.0))
                 if payload.get("stream"):
                     self._generate_stream(engine, prompt, kwargs, timeout)

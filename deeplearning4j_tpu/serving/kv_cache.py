@@ -129,6 +129,7 @@ class PagedKVCache:
         self._lock = threading.Lock()
         self._free: list[int] = list(range(self.num_pages - 1, 0, -1))
         self._tables: dict[object, list[int]] = {}
+        self._spec_extra: dict[object, int] = {}   # rid -> overhang pages
         self._alloc_failures = 0
         self._gauge_total()
         self._gauge_used(0)
@@ -192,12 +193,55 @@ class PagedKVCache:
         need = self.pages_for(length) - have
         return self.alloc(rid, need) if need > 0 else []
 
+    def reserve_speculative(self, rid, length: int) -> list[int]:
+        """Best-effort OVERHANG reservation for speculative decode:
+        grow ``rid``'s table to cover ``length`` positions (admission
+        span + draft chunk) so draft K/V rows land in real pages instead
+        of the scratch page.  Unlike `alloc`, a short free list is NOT
+        an error here — speculation is optional capacity, the stream's
+        admission guarantee is already funded — so exhaustion returns
+        ``[]`` without counting an alloc failure or consulting the
+        ``kv.alloc`` fault site.  Returns the pages added."""
+        with self._lock:
+            have = len(self._tables.get(rid, ()))
+            need = self.pages_for(length) - have
+            if need <= 0 or need > len(self._free):
+                return []
+            got = [self._free.pop() for _ in range(need)]
+            self._tables.setdefault(rid, []).extend(got)
+            self._spec_extra[rid] = self._spec_extra.get(rid, 0) + len(got)
+            used = self.num_pages - 1 - len(self._free)
+        self._gauge_used(used)
+        return got
+
+    def truncate_to(self, rid, length: int) -> list[int]:
+        """Truncate-on-reject: free ``rid``'s TAIL pages beyond what
+        ``length`` positions need (rejected speculative overhang, or a
+        stream whose drafter was disabled mid-flight).  The kept prefix
+        is untouched — garbage rows past ``length`` inside the kept
+        pages are masked by seq_len and overwritten as the stream
+        grows, exactly like plain decode's own write-ahead row.
+        Returns the freed pages (possibly [])."""
+        keep = self.pages_for(length)
+        with self._lock:
+            pages = self._tables.get(rid)
+            if not pages or len(pages) <= keep:
+                return []
+            freed = pages[keep:]
+            del pages[keep:]
+            self._free.extend(freed)
+            self._spec_extra.pop(rid, None)
+            used = self.num_pages - 1 - len(self._free)
+        self._gauge_used(used)
+        return freed
+
     def release(self, rid) -> int:
         """Free every page ``rid`` holds (finish, cancel, watchdog
         abort — all exits funnel here).  Idempotent; returns the number
         of pages freed."""
         with self._lock:
             pages = self._tables.pop(rid, None)
+            self._spec_extra.pop(rid, None)
             if pages:
                 self._free.extend(pages)
             used = self.num_pages - 1 - len(self._free)
@@ -235,6 +279,7 @@ class PagedKVCache:
                 "used_pages": self.num_pages - 1 - len(self._free),
                 "free_pages": len(self._free),
                 "requests": len(self._tables),
+                "spec_reserved_pages": sum(self._spec_extra.values()),
                 "alloc_failures": self._alloc_failures,
                 "bytes_per_token": self.bytes_per_token(),
             }

@@ -388,6 +388,12 @@ class TestTier1Gate:
             "dl4jtpu_flight_records",
             "dl4jtpu_flight_dumps_total",
         } <= fams
+        # ISSUE-20 speculative-decoding families
+        assert {
+            "dl4jtpu_spec_tokens_total",
+            "dl4jtpu_spec_acceptance_ratio",
+            "dl4jtpu_spec_tokens_per_dispatch",
+        } <= fams
         sites = load_fault_sites(REPO)
         assert sites == {
             "coordinator.rpc", "heartbeat.send", "checkpoint.write",
@@ -395,7 +401,8 @@ class TestTier1Gate:
             "data.decode", "device.sync", "data.device_decode",
             "serving.admit", "serving.infer", "serving.hotswap",
             "serving.route", "serving.canary",
-            "serving.prefill", "serving.decode", "kv.alloc",
+            "serving.prefill", "serving.decode", "serving.draft",
+            "kv.alloc",
         }
         assert {
             "slow", "faults", "serving", "slo", "quant", "plan",

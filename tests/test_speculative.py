@@ -1,0 +1,514 @@
+"""ISSUE 20 — speculative decoding over the generation engine.
+
+The contract under test is byte-level parity: the verify-once dispatch
+samples the TARGET model at every chunk position with the baseline
+``fold_in`` key schedule, so a speculative engine's output is
+byte-identical to plain decode (and to `ops.generation.generate`) at
+any temperature — drafts only change how many dispatches that output
+costs.  Around that core: the drafter zoo (n-gram prompt lookup and the
+two-model drafter), the ``serving.draft`` fault site (raise => latched
+plain-decode fallback; corrupt => garbage drafts fully rejected),
+speculative KV reservation/truncation with leak checks on every
+rollback path, watchdog per-step normalization for multi-token
+dispatches, and the zero-fresh-compile guarantee with both step
+programs warm."""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from deeplearning4j_tpu.ops.generation import generate
+from deeplearning4j_tpu.runtime import faults
+from deeplearning4j_tpu.runtime.watchdog import StepWatchdog
+from deeplearning4j_tpu.serving import speculative
+from deeplearning4j_tpu.serving.generation import (
+    GenerationConfig,
+    GenerationEngine,
+)
+from deeplearning4j_tpu.serving.kv_cache import PagedKVCache, SCRATCH_PAGE
+from deeplearning4j_tpu.zoo.transformer import TransformerEncoder
+
+pytestmark = pytest.mark.generation
+
+VOCAB, D, HEADS, LAYERS = 31, 16, 2, 2
+
+CFG = dict(slots=4, page_size=8, num_pages=64, max_pages_per_seq=4,
+           max_queue=16, default_max_new=8)
+
+
+@pytest.fixture(autouse=True)
+def _disarm_faults():
+    yield
+    faults.disarm()
+
+
+@pytest.fixture(scope="module")
+def model():
+    return TransformerEncoder(
+        vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+        causal=True, seed=5,
+    ).init_model()
+
+
+@pytest.fixture(scope="module")
+def draft_model():
+    """A smaller, DIFFERENT transformer: drafts that are sometimes
+    right, sometimes wrong — both accept and reject paths exercised."""
+    return TransformerEncoder(
+        vocab_size=VOCAB, d_model=8, n_heads=1, n_layers=1,
+        causal=True, seed=9,
+    ).init_model()
+
+
+def _engine(model, **over):
+    return GenerationEngine(
+        model=model, config=GenerationConfig(**{**CFG, **over}))
+
+
+def _dense(model, prompt, max_new, **kw):
+    return np.asarray(
+        generate(model, np.asarray(prompt)[None, :], max_new, **kw))[0]
+
+
+def _prompt(n, seed=0):
+    return np.random.default_rng(seed).integers(
+        0, VOCAB, n).astype(np.int32)
+
+
+def _loopy_prompt(n, period=3, seed=0):
+    """A prompt with short cycles — n-gram lookup drafts well on it."""
+    base = np.random.default_rng(seed).integers(
+        0, VOCAB, period).astype(np.int32)
+    return np.tile(base, n // period + 1)[:n].copy()
+
+
+# -- drafters ----------------------------------------------------------------
+
+class TestDrafters:
+    def test_ngram_longest_suffix_wins(self):
+        d = speculative.NGramDrafter(max_n=3)
+        h = np.asarray([1, 2, 3, 4, 1, 2, 3], np.int32)
+        # trigram suffix [1,2,3] matched at the start; continuation 4...
+        np.testing.assert_array_equal(d.draft(h, 3), [4, 1, 2])
+
+    def test_ngram_degrades_to_shorter_grams(self):
+        d = speculative.NGramDrafter(max_n=3)
+        # no bigram/trigram repeat, but the unigram 5 recurs
+        np.testing.assert_array_equal(
+            d.draft(np.asarray([5, 6, 5], np.int32), 4), [6, 5])
+
+    def test_ngram_empty_cases(self):
+        d = speculative.NGramDrafter()
+        assert d.draft(np.asarray([7], np.int32), 4).size == 0
+        assert d.draft(np.asarray([1, 2, 3], np.int32), 0).size == 0
+        # no suffix token ever recurs -> nothing to propose
+        assert d.draft(np.asarray([1, 2, 3, 4], np.int32), 4).size == 0
+
+    def test_model_drafter_is_deterministic(self, draft_model):
+        d = speculative.ModelDrafter(draft_model)
+        h = _prompt(6, seed=3)
+        a, b = d.draft(h, 4), d.draft(h, 4)
+        assert a.shape == (4,) and a.dtype == np.int32
+        np.testing.assert_array_equal(a, b)
+
+    def test_make_drafter_names_and_errors(self, draft_model):
+        assert speculative.make_drafter("ngram").name == "ngram"
+        assert speculative.make_drafter("prompt_lookup").name == "ngram"
+        assert speculative.make_drafter(
+            "model", draft_model=draft_model).name == "model"
+        with pytest.raises(ValueError):
+            speculative.make_drafter("model")        # needs a model
+        with pytest.raises(ValueError):
+            speculative.make_drafter("oracle")
+
+    def test_spec_k_env_knob(self, monkeypatch):
+        monkeypatch.delenv(speculative.ENV_SPEC_K, raising=False)
+        assert speculative.spec_k_from_env(0) == 0
+        monkeypatch.setenv(speculative.ENV_SPEC_K, "3")
+        assert speculative.spec_k_from_env(0) == 3
+        monkeypatch.setenv(speculative.ENV_SPEC_K, "-2")
+        assert speculative.spec_k_from_env(0) == 0
+        monkeypatch.setenv(speculative.ENV_SPEC_K, "four")
+        assert speculative.spec_k_from_env(0) == 0
+
+
+# -- speculative KV reservation ----------------------------------------------
+
+class TestSpeculativeReservation:
+    def _kv(self, **over):
+        kw = dict(n_layers=2, n_heads=2, head_dim=8, num_pages=8,
+                  page_size=8)
+        kw.update(over)
+        return PagedKVCache(**kw)
+
+    def test_reserve_then_truncate_roundtrip(self):
+        kv = self._kv()
+        kv.alloc("a", 2)                       # 16 token positions
+        got = kv.reserve_speculative("a", 16 + 8)   # 1 overhang page
+        assert len(got) == 1 and len(kv.table("a")) == 3
+        assert kv.stats()["spec_reserved_pages"] == 1
+        freed = kv.truncate_to("a", 16)
+        assert freed == got and len(kv.table("a")) == 2
+        assert kv.stats()["spec_reserved_pages"] == 0
+        kv.release("a")
+        assert kv.leak_check() is None
+
+    def test_reserve_is_best_effort_on_shortfall(self):
+        kv = self._kv()
+        kv.alloc("a", 6)                       # 6 of 7 usable pages
+        kv.alloc("b", 1)
+        assert kv.free_pages == 0
+        assert kv.reserve_speculative("a", 8 * 7) == []
+        assert kv.stats()["spec_reserved_pages"] == 0
+        kv.release("a")
+        kv.release("b")
+        assert kv.leak_check() is None
+
+    def test_release_drops_speculative_bookkeeping(self):
+        kv = self._kv()
+        kv.alloc("a", 1)
+        kv.reserve_speculative("a", 8 + 8)
+        kv.release("a")
+        assert kv.used_pages == 0
+        assert kv.stats()["spec_reserved_pages"] == 0
+        assert kv.leak_check() is None
+
+
+# -- byte parity with plain decode -------------------------------------------
+
+class TestParity:
+    def test_greedy_byte_identical_across_buckets(self, model):
+        """Prompt lengths straddling the 8/16 prefill buckets, long
+        generations, ngram drafting — every stream byte-equal to the
+        dense reference, with real drafting having happened."""
+        eng = _engine(model, spec_k=4).start()
+        try:
+            cases = [(_loopy_prompt(4, seed=1), 16),
+                     (_loopy_prompt(8, seed=2), 20),
+                     (_loopy_prompt(12, seed=3), 16),
+                     (_prompt(7, seed=4), 12)]
+            reqs = [eng.submit(p, m) for p, m in cases]
+            for (p, m), r in zip(cases, reqs):
+                np.testing.assert_array_equal(
+                    np.asarray(r.result(120.0)), _dense(model, p, m))
+            st = eng.stats()["speculative"]
+            assert st["enabled"] and st["k"] == 4
+            assert st["drafter"] == "ngram"
+            assert st["drafted"] > 0 and st["accepted"] > 0
+            assert st["verify_dispatches"] > 0
+            assert eng.kv.leak_check() is None
+        finally:
+            eng.stop()
+
+    def test_sampled_byte_identical(self, model):
+        """Temperature + top-k + per-stream seeds: the verify chunk
+        samples with the baseline fold_in schedule, so even REJECTED
+        positions resample to the exact baseline token."""
+        eng = _engine(model, spec_k=3).start()
+        try:
+            for seed in (0, 7, 42):
+                p = _loopy_prompt(6, seed=seed)
+                out = np.asarray(eng.submit(
+                    p, 14, temperature=0.9, top_k=5, seed=seed,
+                ).result(120.0))
+                np.testing.assert_array_equal(
+                    out, _dense(model, p, 14, temperature=0.9,
+                                top_k=5, seed=seed))
+            assert eng.stats()["speculative"]["drafted"] > 0
+            assert eng.kv.leak_check() is None
+        finally:
+            eng.stop()
+
+    def test_model_drafter_byte_identical(self, model, draft_model):
+        eng = _engine(model, spec_k=2, spec_drafter="model",
+                      spec_draft_model=draft_model).start()
+        try:
+            p = _prompt(5, seed=11)
+            np.testing.assert_array_equal(
+                np.asarray(eng.generate(p, 12, timeout=120.0)),
+                _dense(model, p, 12))
+            st = eng.stats()["speculative"]
+            assert st["drafter"] == "model" and st["drafted"] > 0
+            assert eng.kv.leak_check() is None
+        finally:
+            eng.stop()
+
+    def test_int8_kv_speculative_decode_runs_leak_free(self, model):
+        """int8 pages ride the same verify path (chunk attention with
+        scale blocks); gated on agreement like the plain int8 engine,
+        byte parity is an f32-only contract."""
+        eng = _engine(model, spec_k=3, kv_dtype="int8").start()
+        try:
+            p = _loopy_prompt(5, seed=36)
+            out = np.asarray(eng.generate(p, 12, timeout=120.0))
+            ref = _dense(model, p, 12)
+            m = min(len(out), len(ref))
+            assert (out[:m] == ref[:m]).mean() >= 0.8
+            assert eng.stats()["speculative"]["drafted"] > 0
+            assert eng.kv.leak_check() is None
+        finally:
+            eng.stop()
+
+    def test_per_request_spec_k_zero_is_plain(self, model):
+        eng = _engine(model, spec_k=4).start()
+        try:
+            p = _loopy_prompt(6, seed=21)
+            req = eng.submit(p, 10, spec_k=0)
+            np.testing.assert_array_equal(
+                np.asarray(req.result(120.0)), _dense(model, p, 10))
+            assert req.spec_drafted == 0
+        finally:
+            eng.stop()
+
+    def test_stop_tokens_respected_mid_chunk(self, model):
+        """A stop token accepted inside a verify chunk must truncate
+        the emitted run exactly where plain decode would stop."""
+        p = _loopy_prompt(6, seed=31)
+        ref = _dense(model, p, 12)
+        gen = ref[len(p):]
+        stop = int(gen[3])                     # stops 4 tokens in
+        first = int(np.argmax(gen == stop))
+        eng = _engine(model, spec_k=4).start()
+        try:
+            out = np.asarray(eng.submit(
+                p, 12, stop_tokens=(stop,)).result(120.0))
+            np.testing.assert_array_equal(
+                out, ref[: len(p) + first + 1])
+            assert out[-1] == stop
+            assert eng.kv.leak_check() is None
+        finally:
+            eng.stop()
+
+
+# -- distribution preservation at scale --------------------------------------
+
+class TestDistributionPreservation:
+    @pytest.mark.slow
+    def test_seeded_sampling_histogram_parity_10k(self, model):
+        """Per-position token histograms over >= 10k sampled tokens
+        (420 seeded streams x 24 positions) are identical between the
+        speculative engine and the dense reference — the rejection
+        sampler provably preserves the output distribution."""
+        n_streams, max_new = 420, 24
+        p = _loopy_prompt(5, seed=100)
+        eng = _engine(model, spec_k=3, max_queue=512).start()
+        try:
+            reqs = [eng.submit(p, max_new, temperature=1.0, seed=s)
+                    for s in range(n_streams)]
+            got = np.stack([
+                np.asarray(r.result(600.0))[len(p):] for r in reqs])
+            assert eng.kv.leak_check() is None
+        finally:
+            eng.stop()
+        ref = np.stack([
+            _dense(model, p, max_new, temperature=1.0, seed=s)[len(p):]
+            for s in range(n_streams)])
+        assert got.size >= 10_000
+        for j in range(max_new):
+            np.testing.assert_array_equal(
+                np.bincount(got[:, j], minlength=VOCAB),
+                np.bincount(ref[:, j], minlength=VOCAB),
+                err_msg=f"histogram diverged at position {j}")
+
+
+# -- the serving.draft fault site --------------------------------------------
+
+class TestDraftFaults:
+    @pytest.mark.faults
+    def test_corrupt_drafts_all_rejected_output_unchanged(self, model):
+        """Garbage drafts cost acceptance, never correctness: armed
+        corrupt on EVERY draft, the output stays byte-identical and
+        no page leaks."""
+        eng = _engine(model, spec_k=4).start()
+        try:
+            faults.arm("serving.draft:corrupt:every=1")
+            p = _loopy_prompt(6, seed=41)
+            out = np.asarray(eng.generate(p, 12, timeout=120.0))
+            faults.disarm()
+            np.testing.assert_array_equal(out, _dense(model, p, 12))
+            st = eng.stats()["speculative"]
+            assert st["drafted"] > 0
+            assert st["acceptance_ratio"] < 0.5   # garbage can't win
+            assert eng.kv.leak_check() is None
+        finally:
+            eng.stop()
+
+    @pytest.mark.faults
+    def test_raise_latches_plain_fallback_mid_stream(self, model):
+        """A drafter failure mid-stream disables speculation for THAT
+        stream only: the overhang pages are truncated back, decode
+        continues plain, and the output is still byte-identical."""
+        eng = _engine(model, spec_k=4).start()
+        try:
+            faults.arm("serving.draft:raise:nth=2")
+            p = _loopy_prompt(6, seed=51)
+            req = eng.submit(p, 14)
+            out = np.asarray(req.result(120.0))
+            np.testing.assert_array_equal(out, _dense(model, p, 14))
+            assert req.spec_disabled
+            assert eng.stats()["speculative"]["fallbacks"] == 1
+            assert eng.kv.stats()["spec_reserved_pages"] == 0
+            assert eng.kv.leak_check() is None
+            faults.disarm()
+            # the NEXT stream drafts normally again
+            req2 = eng.submit(_loopy_prompt(6, seed=52), 10)
+            req2.result(120.0)
+            assert not req2.spec_disabled
+        finally:
+            eng.stop()
+
+    def test_cancel_mid_stream_releases_speculative_pages(self, model):
+        eng = _engine(model, spec_k=4).start()
+        try:
+            req = eng.submit(_loopy_prompt(4, seed=61), 27)
+            deadline = time.monotonic() + 60.0
+            while not req.tokens_so_far():
+                assert time.monotonic() < deadline
+                time.sleep(0.01)
+            req.cancel()
+            while eng.kv.used_pages and time.monotonic() < deadline:
+                time.sleep(0.01)
+            assert eng.kv.used_pages == 0
+            assert eng.kv.stats()["spec_reserved_pages"] == 0
+            assert eng.kv.leak_check() is None
+        finally:
+            eng.stop()
+
+
+# -- watchdog normalization for multi-token dispatches -----------------------
+
+class _Clock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+class TestWatchdogNormalization:
+    def test_verify_dispatch_feeds_per_step_ewma(self):
+        """A C-token verify dispatch disarmed with its full wall time
+        must leave the same per-step EWMA a plain step would — a
+        high-acceptance burst cannot stretch later plain deadlines."""
+        clk = _Clock()
+        wd = StepWatchdog(floor_s=0.001, cold_floor_s=10.0, k=10.0,
+                          ewma_alpha=1.0, threaded=False, clock=clk)
+        wd.arm(1, n_steps=5)
+        clk.t += 0.5
+        wd.disarm(0.5)
+        assert wd.ewma == pytest.approx(0.1)
+        assert wd.deadline_s() == pytest.approx(1.0)   # k * per, C=1
+
+    def test_verify_deadline_scales_with_chunk_width(self):
+        """The C-token dispatch gets a C-times deadline — a healthy
+        verify step is never flagged just for being wider — while the
+        following plain step's deadline snaps back to k*EWMA."""
+        clk = _Clock()
+        wd = StepWatchdog(floor_s=0.001, cold_floor_s=10.0, k=10.0,
+                          ewma_alpha=1.0, threaded=False, clock=clk)
+        wd.arm(1)
+        clk.t += 0.1
+        wd.disarm(0.1)                         # EWMA = 0.1s/step
+        wd.arm(2, n_steps=5)                   # deadline 10*0.1*5 = 5s
+        clk.t += 4.9
+        wd.poll(now=clk.t)
+        assert wd.events == []                 # within the wide deadline
+        wd.disarm(0.5)
+        wd.arm(3, n_steps=1)                   # back to 1s
+        clk.t += 1.01
+        wd.poll(now=clk.t)
+        assert wd.events and wd.events[-1]["stage"] == "warn"
+        assert wd.events[-1]["n_steps"] == 1
+        wd.disarm(None)
+
+    def test_tokens_generated_counts_emitted_not_dispatches(self, model):
+        """The throughput SLI is per emitted token: a speculative run
+        that emits N tokens reports N, however few dispatches it took."""
+        eng = _engine(model, spec_k=4).start()
+        try:
+            out = np.asarray(
+                eng.generate(_loopy_prompt(6, seed=71), 14,
+                             timeout=120.0))
+            st = eng.stats()
+            emitted = out.shape[0] - 6
+            assert st["tokens_generated"] == emitted
+            spec = st["speculative"]
+            dispatches = (spec["verify_dispatches"]
+                          + spec["plain_dispatches"])
+            assert dispatches < emitted        # speculation paid off
+            assert spec["tokens_per_dispatch"] > 1.0
+            # per-token latency attribution exists for every segment
+            for seg in st["latency_breakdown"].values():
+                assert "seconds_per_token" in seg
+        finally:
+            eng.stop()
+
+
+# -- bounded program set -----------------------------------------------------
+
+class TestSpecCompileStability:
+    def test_zero_fresh_compiles_with_both_programs_warm(self, model):
+        from deeplearning4j_tpu.runtime import compile_stats
+
+        eng = _engine(model, spec_k=3).start()
+        try:
+            # warm: verify program (drafting stream), plain program
+            # (spec_k=0 stream), and the 8/16 prefill buckets
+            eng.generate(_loopy_prompt(6, seed=81), 8, timeout=120.0)
+            eng.submit(_prompt(12, seed=82), 6, spec_k=0).result(120.0)
+            snap = compile_stats.snapshot()
+            reqs = [eng.submit(_loopy_prompt(3 + i, seed=83 + i), 5 + i,
+                               temperature=float(i % 2) * 0.8,
+                               top_k=(i % 3), seed=i,
+                               spec_k=(None if i % 2 else 0))
+                    for i in range(6)]
+            for r in reqs:
+                r.result(120.0)
+            delta = compile_stats.snapshot() - snap
+            assert delta.fresh_backend_compiles == 0, delta.as_dict()
+        finally:
+            eng.stop()
+
+
+# -- HTTP knob ---------------------------------------------------------------
+
+class TestHTTPSpecKnob:
+    def test_spec_k_override_rides_the_generate_api(self, model):
+        from deeplearning4j_tpu.serving.http import ServingHTTPServer
+        from deeplearning4j_tpu.serving.server import InferenceServer
+
+        srv = InferenceServer(model)
+        eng = GenerationEngine(
+            server=srv,
+            config=GenerationConfig(**{**CFG, "spec_k": 4})).start()
+        http = ServingHTTPServer(srv).start()
+        try:
+            p = _loopy_prompt(5, seed=92)
+            body = json.dumps({"prompt": p.tolist(),
+                               "max_new_tokens": 10,
+                               "spec_k": 2}).encode()
+            req = urllib.request.Request(
+                http.url + "v1/generate", body,
+                {"Content-Type": "application/json"})
+            with urllib.request.urlopen(req, timeout=120) as r:
+                assert r.status == 200
+                doc = json.loads(r.read())
+            np.testing.assert_array_equal(
+                np.asarray(doc["tokens"]), _dense(model, p, 10))
+            bad = urllib.request.Request(
+                http.url + "v1/generate",
+                json.dumps({"prompt": p.tolist(),
+                            "spec_k": "many"}).encode(),
+                {"Content-Type": "application/json"})
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(bad, timeout=120)
+            assert ei.value.code == 400
+            ei.value.close()                   # drop the error socket
+        finally:
+            http.stop()
+            eng.stop()
+            srv.stop()
